@@ -1,0 +1,81 @@
+"""paddle_tpu: a TPU-native deep-learning framework with PaddlePaddle's
+capability surface on a jax/XLA/Pallas core.
+
+Import as `import paddle_tpu as paddle` — the public namespace mirrors
+`paddle.*` (see SURVEY.md for the layer map this implements). Subpackages are
+lazy (PEP 562) so `import paddle_tpu` stays light.
+"""
+
+from __future__ import annotations
+
+import importlib
+
+__version__ = "0.1.0"
+
+from .core.tensor import Tensor, Parameter, to_tensor
+from .core.tape import no_grad, enable_grad, is_grad_enabled, set_grad_enabled
+from .core.random import seed, get_rng_state, set_rng_state
+from .core.dtype import (
+    float16, bfloat16, float32, float64, int8, int16, int32, int64, uint8,
+    bool_, complex64, complex128, get_default_dtype, set_default_dtype,
+)
+from .core.device import (
+    set_device, get_device, device_count, is_compiled_with_cuda, synchronize,
+)
+from .core.flags import set_flags, get_flags
+from .core.autograd_engine import grad
+
+# the full tensor-op namespace is exported flat, paddle-style
+from .tensor import *  # noqa: F401,F403
+from . import tensor
+
+_LAZY_SUBMODULES = (
+    "nn", "optimizer", "autograd", "amp", "io", "jit", "static", "device",
+    "linalg", "fft", "vision", "distributed", "incubate", "profiler", "metric",
+    "framework", "hapi", "models", "ops", "utils", "distribution", "sparse",
+    "text", "audio", "onnx", "inference",
+)
+
+_LAZY_ATTRS = {
+    "save": ("framework.io", "save"),
+    "load": ("framework.io", "load"),
+    "Model": ("hapi.model", "Model"),
+    "Layer": ("nn.layer.layers", "Layer"),
+    "summary": ("hapi.model_summary", "summary"),
+    "flops": ("hapi.dynamic_flops", "flops"),
+    "DataParallel": ("distributed.parallel", "DataParallel"),
+    "LazyGuard": ("nn.initializer.lazy_init", "LazyGuard"),
+}
+
+
+def __getattr__(name):
+    if name in _LAZY_SUBMODULES:
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    if name in _LAZY_ATTRS:
+        modname, attr = _LAZY_ATTRS[name]
+        mod = importlib.import_module(f".{modname}", __name__)
+        val = getattr(mod, attr)
+        globals()[name] = val
+        return val
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def disable_static(place=None):
+    """paddle API parity: dygraph is the only eager mode here."""
+    return None
+
+
+def enable_static():
+    raise NotImplementedError(
+        "paddle_tpu is dygraph-first; use paddle_tpu.jit.to_static for compiled execution"
+    )
+
+
+def in_dynamic_mode():
+    return True
+
+
+def get_cudnn_version():
+    return None

@@ -1,0 +1,10 @@
+from .tensor import Tensor, Parameter, to_tensor
+from .tape import no_grad, enable_grad, is_grad_enabled, set_grad_enabled, reset_tape, global_tape
+from .autograd_engine import backward, grad
+from . import dtype as dtypes
+from .dtype import to_jax_dtype, get_default_dtype, set_default_dtype
+from . import random as random_state
+from .random import seed, get_rng_state_tracker
+from . import device
+from .flags import set_flags, get_flags, GLOBAL_FLAGS
+from .op_call import apply, wrap_op

@@ -1,0 +1,151 @@
+"""Backward engine: reverse-creation-order walk over the tape.
+
+Reference parity: `egr::Backward()`'s topological queue over GradNodes
+(SURVEY.md §3.1 step 4; upstream paddle/fluid/eager/backward.cc). Here
+creation order IS a topological order, so the walk is a single reversed scan —
+no ready-queue bookkeeping needed. Fully traceable: running this under
+`jax.jit` emits one XLA program for the whole backward pass.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import tape as _tape
+from .tensor import Tensor, _GRAD_HOOKS, _GRAD_HOOK_OWNERS
+
+
+def _zeros_like_meta(shape, dtype):
+    if jnp.issubdtype(dtype, jnp.floating) or jnp.issubdtype(dtype, jnp.complexfloating):
+        return jnp.zeros(shape, dtype)
+    return np.zeros(shape, jax.dtypes.float0)
+
+
+def backward(loss: Tensor, grad_tensor=None, retain_graph: bool = False, targets=None):
+    """Reverse walk from `loss`. `targets` (used by paddle.grad) is an optional
+    set of tensor ids for which gradients must be materialized even when the
+    tensor is an intermediate rather than a leaf."""
+    if loss.stop_gradient:
+        raise RuntimeError(
+            "Tensor.backward() on a tensor with stop_gradient=True — nothing to differentiate."
+        )
+    targets = targets or {}
+    tape = _tape.global_tape()
+    start = loss._tape_node
+    if start is None:
+        if id(loss) in targets:
+            t = targets[id(loss)]
+            seed0 = jnp.ones(loss._data.shape, loss._data.dtype) if grad_tensor is None else (
+                grad_tensor._data if isinstance(grad_tensor, Tensor) else jnp.asarray(grad_tensor))
+            t.grad = Tensor(seed0) if t.grad is None else Tensor(t.grad._data + seed0)
+        return
+
+    if grad_tensor is None:
+        seed = jnp.ones(loss._data.shape, loss._data.dtype)
+    else:
+        seed = grad_tensor._data if isinstance(grad_tensor, Tensor) else jnp.asarray(grad_tensor)
+
+    # cotangents keyed by id(tensor)
+    cot = {id(loss): seed}
+    # keep loss alive and map ids we may need
+    leaf_accum = {}  # id -> (tensor, grad array)
+
+    if id(loss) in targets:
+        t = targets[id(loss)]
+        t.grad = Tensor(seed) if t.grad is None else Tensor(t.grad._data + seed)
+
+    nodes = [n for n in tape.nodes if n.idx <= start.idx]
+    with _tape.no_grad():
+        for node in reversed(nodes):
+            if not any(oid in cot for oid in node.out_ids):
+                continue
+            cots = []
+            for oid, (shape, dtype) in zip(node.out_ids, node.out_meta):
+                c = cot.pop(oid, None)
+                if c is None:
+                    c = _zeros_like_meta(shape, dtype)
+                else:
+                    for hook in _GRAD_HOOKS.get(oid, ()):  # intermediate-grad hooks
+                        r = hook(Tensor(c))
+                        if r is not None:
+                            c = r._data if isinstance(r, Tensor) else jnp.asarray(r)
+                    if oid in targets and oid != id(loss):
+                        # materialize intermediate grads requested by paddle.grad
+                        t = targets[oid]
+                        t.grad = Tensor(c) if t.grad is None else Tensor(t.grad._data + c)
+                cots.append(c)
+            in_cots = node.vjp_fn(cots)
+            for t, g in zip(node.inputs, in_cots):
+                if g is None or (hasattr(g, "dtype") and g.dtype == jax.dtypes.float0):
+                    continue
+                if t._tape_node is not None and t._tape_node.idx < node.idx:
+                    # intermediate produced by an earlier node: keep propagating
+                    tid = id(t)
+                    cot[tid] = cot[tid] + g if tid in cot else g
+                elif t._tape_node is None:
+                    if not t.stop_gradient:
+                        tid = id(t)
+                        if tid in leaf_accum:
+                            leaf_accum[tid] = (t, leaf_accum[tid][1] + g)
+                        else:
+                            leaf_accum[tid] = (t, g)
+                else:
+                    # t produced by this very node (in-place style) — treat as leaf
+                    if not t.stop_gradient:
+                        tid = id(t)
+                        if tid in leaf_accum:
+                            leaf_accum[tid] = (t, leaf_accum[tid][1] + g)
+                        else:
+                            leaf_accum[tid] = (t, g)
+
+        for tid, (t, g) in leaf_accum.items():
+            for hook in _GRAD_HOOKS.get(tid, ()):
+                r = hook(Tensor(g))
+                if r is not None:
+                    g = r._data if isinstance(r, Tensor) else jnp.asarray(r)
+            if t.grad is None:
+                t.grad = Tensor(g, stop_gradient=True)
+            else:
+                t.grad._data = t.grad._data + g
+
+    if not retain_graph:
+        # free the graph (reference frees GradNodes after backward too)
+        kept = [n for n in tape.nodes if n.idx > start.idx]
+        tape.nodes = kept
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=False, allow_unused=False):
+    """paddle.grad parity (ref: python/paddle/autograd/ (U)) — functional form."""
+    if isinstance(outputs, Tensor):
+        outputs = [outputs]
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    if grad_outputs is not None and isinstance(grad_outputs, Tensor):
+        grad_outputs = [grad_outputs]
+
+    saved = [(t, t.grad) for t in inputs]
+    for t in inputs:
+        t.grad = None
+    targets = {id(t): t for t in inputs}
+    try:
+        for i, o in enumerate(outputs):
+            g = grad_outputs[i] if grad_outputs is not None else None
+            backward(o, grad_tensor=g, retain_graph=True if retain_graph is None else retain_graph,
+                     targets=targets)
+        results = []
+        for t in inputs:
+            if t.grad is None:
+                if not allow_unused:
+                    raise RuntimeError(
+                        "One of the differentiated tensors appears unused; pass allow_unused=True."
+                    )
+                results.append(None)
+            else:
+                results.append(t.grad)
+        return results
+    finally:
+        for t, g in saved:
+            t.grad = g

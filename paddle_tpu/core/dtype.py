@@ -1,0 +1,69 @@
+"""Dtype aliases with Paddle-style names (ref: paddle dtype enum in
+paddle/phi/common/data_type.h (U)), mapped to jnp dtypes."""
+
+import jax.numpy as jnp
+import numpy as np
+
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+uint8 = jnp.uint8
+bool_ = jnp.bool_
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_STR2DTYPE = {
+    "float16": float16,
+    "fp16": float16,
+    "bfloat16": bfloat16,
+    "bf16": bfloat16,
+    "float32": float32,
+    "fp32": float32,
+    "float": float32,
+    "float64": float64,
+    "fp64": float64,
+    "double": float64,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int": int32,
+    "int64": int64,
+    "long": int64,
+    "uint8": uint8,
+    "bool": bool_,
+    "complex64": complex64,
+    "complex128": complex128,
+}
+
+
+def to_jax_dtype(dtype):
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        key = dtype.lower()
+        if key.startswith("paddle."):
+            key = key.split(".", 1)[1]
+        if key not in _STR2DTYPE:
+            raise ValueError(f"unknown dtype {dtype!r}")
+        return _STR2DTYPE[key]
+    return jnp.dtype(dtype)
+
+
+_DEFAULT_DTYPE = [float32]
+
+
+def get_default_dtype():
+    return _DEFAULT_DTYPE[0]
+
+
+def set_default_dtype(d):
+    _DEFAULT_DTYPE[0] = to_jax_dtype(d)
+
+
+def is_floating_point_dtype(d):
+    return jnp.issubdtype(jnp.dtype(d), jnp.floating)

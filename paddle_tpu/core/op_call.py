@@ -1,0 +1,119 @@
+"""Eager op dispatch: wrap a jnp/lax function so it consumes/produces Tensors
+and records a vjp closure on the tape.
+
+This is the TPU-native replacement for the reference's generated
+`xxx_ad_func()` C++ layer + PHI kernel dispatch (SURVEY.md §3.1 steps 2-3):
+one generic `apply()` instead of 1000 generated bindings, because jax.vjp
+derives every gradient.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from . import tape as _tape
+from .tensor import Tensor
+
+
+def _is_float(dtype) -> bool:
+    return jnp.issubdtype(dtype, jnp.floating) or jnp.issubdtype(dtype, jnp.complexfloating)
+
+
+def apply(fn, *args, _op_name: str = "", **kwargs):
+    """Run `fn(*arrays, **kwargs)` where Tensor args are unwrapped.
+
+    If the tape is active and any input Tensor requires grad, the primal is
+    computed through `jax.vjp` and the pullback recorded. Non-Tensor args
+    pass through untouched (treated as constants).
+    """
+    tensor_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+    arrays = list(args)
+    in_tensors = []
+    for i in tensor_idx:
+        in_tensors.append(args[i])
+        arrays[i] = args[i]._data
+
+    need_grad = (
+        _tape.tape_enabled()
+        and any(not t.stop_gradient for t in in_tensors)
+    )
+
+    if not need_grad:
+        out = fn(*arrays, **kwargs)
+        return _wrap_outputs(out, stop_gradient=True)
+
+    # differentiate only w.r.t. floating-point tensor inputs
+    diff_idx = [i for i in tensor_idx if _is_float(args[i]._data.dtype)]
+    if not diff_idx:
+        out = fn(*arrays, **kwargs)
+        return _wrap_outputs(out, stop_gradient=True)
+
+    def primal(*diff_arrays):
+        full = list(arrays)
+        for j, i in enumerate(diff_idx):
+            full[i] = diff_arrays[j]
+        return fn(*full, **kwargs)
+
+    out_data, vjp_fn = jax.vjp(primal, *(args[i]._data for i in diff_idx))
+    outs, structure = _flatten_out(out_data)
+    out_tensors = [Tensor(o, stop_gradient=not _is_float(o.dtype)) for o in outs]
+    diff_tensors = [args[i] for i in diff_idx]
+    if any(not t.stop_gradient for t in out_tensors):
+        _tape.global_tape().record(
+            diff_tensors,
+            out_tensors,
+            _VjpAdapter(vjp_fn, len(outs)),
+            name=_op_name or getattr(fn, "__name__", "op"),
+        )
+    return _unflatten_out(out_tensors, structure)
+
+
+class _VjpAdapter:
+    __slots__ = ("vjp_fn", "n_out")
+
+    def __init__(self, vjp_fn, n_out):
+        self.vjp_fn = vjp_fn
+        self.n_out = n_out
+
+    def __call__(self, cotangents):
+        # cotangents: list aligned with flattened outputs
+        if self.n_out == 1:
+            return self.vjp_fn(cotangents[0])
+        return self.vjp_fn(tuple(cotangents))
+
+
+def _out_type(out):
+    # namedtuples (e.g. jnp.linalg results) collapse to plain tuple
+    t = type(out)
+    return tuple if hasattr(out, "_fields") else t
+
+
+def _flatten_out(out):
+    if isinstance(out, (tuple, list)):
+        return list(out), _out_type(out)
+    return [out], None
+
+
+def _unflatten_out(tensors, structure):
+    if structure is None:
+        return tensors[0]
+    return structure(tensors)
+
+
+def _wrap_outputs(out, stop_gradient=True):
+    if isinstance(out, (tuple, list)):
+        return _out_type(out)(Tensor(o, stop_gradient=stop_gradient) for o in out)
+    return Tensor(out, stop_gradient=stop_gradient)
+
+
+def wrap_op(fn, name=None):
+    """Lift a jnp-level function into a Tensor-level op."""
+
+    @functools.wraps(fn)
+    def op(*args, **kwargs):
+        return apply(fn, *args, _op_name=name or fn.__name__, **kwargs)
+
+    return op

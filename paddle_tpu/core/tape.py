@@ -1,0 +1,160 @@
+"""Tape-based autograd engine over JAX.
+
+Design (TPU-first, not a port): the reference implements autograd as a C++
+"eager" engine with generated GradNodes per op (ref layout:
+paddle/fluid/eager/backward.cc, grad_node_info.h — upstream paths, see
+SURVEY.md §2.1 N8). Here each eager op records a `jax.vjp` closure on a
+Python tape instead. Because `jax.vjp` is itself traceable, the *same* tape
+runs under `jax.jit`: tracing a whole train step (forward + `backward()` +
+`optimizer.step()`) yields one fused XLA program — the role the reference's
+dygraph-to-static + CINN stack plays (SURVEY.md §3.4), for free.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+
+import weakref
+
+
+class TapeNode:
+    """One recorded op: inputs, output ids/metadata, and a vjp closure."""
+
+    __slots__ = (
+        "inputs", "out_ids", "out_meta", "vjp_fn", "n_outputs", "idx", "name",
+        "alive_outputs",
+    )
+
+    def __init__(self, inputs, out_ids, out_meta, vjp_fn, n_outputs, idx, name=""):
+        self.inputs = inputs        # list[Tensor] (held strongly until the node is freed)
+        self.out_ids = out_ids      # list[int] ids of output Tensors
+        self.out_meta = out_meta    # list[(shape, dtype)] per output, for zero cotangents
+        self.vjp_fn = vjp_fn        # cotangents(list) -> tuple of input cotangents
+        self.n_outputs = n_outputs
+        self.idx = idx              # monotonically increasing creation index
+        self.name = name
+        self.alive_outputs = n_outputs
+
+    def _output_died(self):
+        self.alive_outputs -= 1
+
+
+class Tape:
+    """A gradient tape. Nodes are kept in creation order; backward walks in reverse.
+
+    Memory parity with the reference's refcounted GradNode graph: when every
+    output Tensor of a node has been garbage-collected, no future backward can
+    reach the node (cotangents are keyed by live output tensors), so it is
+    pruned — this keeps grad-enabled inference loops from growing the tape
+    without bound. Pruning is amortized on record().
+    """
+
+    _COMPACT_EVERY = 512
+
+    def __init__(self):
+        self.nodes = []
+        self._counter = 0
+
+    def record(self, inputs, outputs, vjp_fn, name=""):
+        node = TapeNode(
+            inputs=list(inputs),
+            out_ids=[id(o) for o in outputs],
+            out_meta=[(tuple(o._data.shape), o._data.dtype) for o in outputs],
+            vjp_fn=vjp_fn,
+            n_outputs=len(outputs),
+            idx=self._counter,
+            name=name,
+        )
+        self._counter += 1
+        self.nodes.append(node)
+        for o in outputs:
+            o._tape_node = node
+            weakref.finalize(o, node._output_died)
+        if self._counter % self._COMPACT_EVERY == 0:
+            self.compact()
+        return node
+
+    def compact(self):
+        # iterate: dropping a dead node releases its input refs, which may
+        # kill upstream outputs and let further nodes die in the next sweep
+        while True:
+            live = [n for n in self.nodes if n.alive_outputs > 0]
+            if len(live) == len(self.nodes):
+                break
+            self.nodes = live
+
+
+class _TapeState(threading.local):
+    def __init__(self):
+        self.tape = Tape()
+        self.enabled = True
+        self.depth = 0
+
+
+_STATE = _TapeState()
+
+
+def global_tape() -> Tape:
+    return _STATE.tape
+
+
+def tape_enabled() -> bool:
+    return _STATE.enabled
+
+
+def reset_tape():
+    _STATE.tape = Tape()
+
+
+@contextlib.contextmanager
+def no_grad():
+    """Paddle-parity `paddle.no_grad()`: ops inside are not recorded."""
+    prev = _STATE.enabled
+    _STATE.enabled = False
+    try:
+        yield
+    finally:
+        _STATE.enabled = prev
+
+
+@contextlib.contextmanager
+def enable_grad():
+    prev = _STATE.enabled
+    _STATE.enabled = True
+    try:
+        yield
+    finally:
+        _STATE.enabled = prev
+
+
+def is_grad_enabled() -> bool:
+    return _STATE.enabled
+
+
+@contextlib.contextmanager
+def _grad_mode(mode: bool):
+    prev = _STATE.enabled
+    _STATE.enabled = bool(mode)
+    try:
+        yield
+    finally:
+        _STATE.enabled = prev
+
+
+def set_grad_enabled(mode: bool):
+    """Usable both as a statement and a context manager (paddle parity):
+    the mode flips immediately; entering/exiting the returned context restores
+    the caller's ORIGINAL mode afterwards."""
+    prev = _STATE.enabled
+    _STATE.enabled = bool(mode)
+
+    @contextlib.contextmanager
+    def _ctx():
+        try:
+            yield
+        finally:
+            _STATE.enabled = prev
+
+    return _ctx()

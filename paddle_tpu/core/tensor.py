@@ -1,0 +1,314 @@
+"""The Tensor type: a dygraph-feel wrapper over `jax.Array`.
+
+Reference parity: phi::DenseTensor + the Python Tensor bound via pybind
+(SURVEY.md §2.1 N1/N24 — upstream paths paddle/phi/core/dense_tensor.cc,
+paddle/fluid/pybind/eager_method.cc). TPU-native design: `_data` is always a
+`jax.Array` (or a jax tracer under `jit`), so every Tensor method stays
+traceable; autograd state (`grad`, `stop_gradient`, tape node) lives on the
+Python wrapper, never in the compiled program.
+
+Paddle semantics preserved:
+  * tensors default to `stop_gradient=True`; `Parameter` flips it.
+  * `t.backward()` populates `.grad` on every reachable leaf.
+  * in-place mutators (`add_`, `set_value`, ...) rebind `_data`.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import tape as _tape
+
+
+def _to_jax(value, dtype=None):
+    if isinstance(value, Tensor):
+        data = value._data
+        return data.astype(dtype) if dtype is not None and data.dtype != dtype else data
+    if isinstance(value, (jnp.ndarray, jax.Array)) or hasattr(value, "aval"):
+        return value if dtype is None else value.astype(dtype)
+    return jnp.asarray(value, dtype=dtype)
+
+
+class Tensor:
+    __slots__ = (
+        "_data",
+        "grad",
+        "stop_gradient",
+        "_tape_node",
+        "name",
+        "persistable",
+        "trainable",
+        "__weakref__",
+    )
+
+    def __init__(self, data, dtype=None, stop_gradient=True, name=None):
+        self._data = _to_jax(data, dtype)
+        self.grad = None
+        self.stop_gradient = stop_gradient
+        self._tape_node = None
+        self.name = name
+        self.persistable = False
+        self.trainable = not stop_gradient
+
+    # ---------------- basic properties ----------------
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def T(self):
+        from .. import tensor as ops
+
+        return ops.transpose(self, list(range(self.ndim))[::-1])
+
+    def numel(self):
+        return self.size
+
+    def dim(self):
+        return self.ndim
+
+    @property
+    def place(self):
+        devs = getattr(self._data, "devices", None)
+        try:
+            return next(iter(devs())) if callable(devs) else None
+        except Exception:
+            return None
+
+    # ---------------- conversion ----------------
+    def numpy(self):
+        return np.asarray(self._data)
+
+    def __array__(self, dtype=None):
+        arr = np.asarray(self._data)
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def item(self, *args):
+        return self._data.item(*args)
+
+    def tolist(self):
+        return np.asarray(self._data).tolist()
+
+    def detach(self):
+        t = Tensor(self._data, stop_gradient=True)
+        t.name = self.name
+        return t
+
+    def clone(self):
+        from .op_call import apply
+
+        return apply(lambda x: x + 0, self)
+
+    def astype(self, dtype):
+        from .op_call import apply
+        from .dtype import to_jax_dtype
+
+        jd = to_jax_dtype(dtype)
+        return apply(lambda x: x.astype(jd), self)
+
+    cast = astype
+
+    def cpu(self):
+        return self
+
+    def cuda(self, *a, **k):
+        return self
+
+    def to(self, *args, **kwargs):
+        for a in args:
+            if isinstance(a, (str, jnp.dtype, type(jnp.float32))) and not str(a).startswith(
+                ("cpu", "gpu", "tpu", "xpu")
+            ):
+                try:
+                    return self.astype(a)
+                except Exception:
+                    pass
+        if "dtype" in kwargs and kwargs["dtype"] is not None:
+            return self.astype(kwargs["dtype"])
+        return self
+
+    # ---------------- autograd ----------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        from .autograd_engine import backward as _backward
+
+        _backward(self, grad_tensor=grad_tensor, retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self.grad = None
+
+    clear_gradient = clear_grad
+
+    def zero_(self):
+        self._data = jnp.zeros_like(self._data)
+        return self
+
+    @property
+    def is_leaf(self):
+        return self._tape_node is None
+
+    def register_hook(self, hook):
+        # Gradient hooks: stored on the tensor, applied by the backward engine.
+        if not hasattr(self, "_grad_hooks"):
+            pass
+        hooks = _GRAD_HOOKS.setdefault(id(self), [])
+        hooks.append(hook)
+        _GRAD_HOOK_OWNERS[id(self)] = self
+        class _Removable:
+            def remove(_s):
+                try:
+                    hooks.remove(hook)
+                except ValueError:
+                    pass
+        return _Removable()
+
+    # ---------------- in-place / value ops ----------------
+    def set_value(self, value):
+        self._data = _to_jax(value, self._data.dtype)
+        return self
+
+    def copy_(self, other, *a):
+        return self.set_value(other)
+
+    def fill_(self, value):
+        self._data = jnp.full_like(self._data, value)
+        return self
+
+    def add_(self, y):
+        self._data = self._data + _to_jax(y)
+        return self
+
+    def subtract_(self, y):
+        self._data = self._data - _to_jax(y)
+        return self
+
+    def multiply_(self, y):
+        self._data = self._data * _to_jax(y)
+        return self
+
+    def scale_(self, scale=1.0, bias=0.0):
+        self._data = self._data * scale + bias
+        return self
+
+    def clip_(self, min=None, max=None):
+        self._data = jnp.clip(self._data, min, max)
+        return self
+
+    # ---------------- python protocol ----------------
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __repr__(self):
+        grad_info = "" if self.stop_gradient else ", stop_gradient=False"
+        return f"Tensor(shape={self.shape}, dtype={self._data.dtype}{grad_info},\n       {self._data})"
+
+    def __bool__(self):
+        return bool(self._data)
+
+    def __int__(self):
+        return int(self._data)
+
+    def __float__(self):
+        return float(self._data)
+
+    def __format__(self, spec):
+        if self.ndim == 0:
+            return format(self.item(), spec)
+        return repr(self)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __hash__(self):
+        return id(self)
+
+    def __getitem__(self, idx):
+        from .op_call import apply
+
+        idx = _index_to_jax(idx)
+        return apply(lambda x: x[idx], self)
+
+    def __setitem__(self, idx, value):
+        idx = _index_to_jax(idx)
+        val = _to_jax(value)
+        self._data = self._data.at[idx].set(val)
+
+    # Arithmetic operators are attached by paddle_tpu.tensor (op namespaces) at
+    # import time — mirroring how the reference monkey-patches math methods onto
+    # the pybind Tensor (upstream python/paddle/tensor/math.py).
+
+
+# grad hooks keyed by tensor id (kept out of __slots__ to keep Tensor small)
+_GRAD_HOOKS: dict = {}
+_GRAD_HOOK_OWNERS: dict = {}
+
+
+def _index_to_jax(idx):
+    def conv(i):
+        if isinstance(i, Tensor):
+            return i._data
+        return i
+
+    if isinstance(idx, tuple):
+        return tuple(conv(i) for i in idx)
+    return conv(idx)
+
+
+class Parameter(Tensor):
+    """A trainable Tensor (stop_gradient=False), registered by nn.Layer."""
+
+    __slots__ = ("optimize_attr", "regularizer", "is_distributed", "_sharding_axes")
+
+    def __init__(self, data, dtype=None, name=None, trainable=True):
+        super().__init__(data, dtype=dtype, stop_gradient=not trainable, name=name)
+        self.persistable = True
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.is_distributed = False
+        self._sharding_axes = None  # PartitionSpec-like hint used by auto-parallel
+
+    def __repr__(self):
+        return f"Parameter(name={self.name}, shape={self.shape}, dtype={self._data.dtype})\n       {self._data}"
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor parity (ref: python/paddle/tensor/creation.py (U))."""
+    if isinstance(data, Tensor) and dtype is None:
+        t = Tensor(data._data, stop_gradient=stop_gradient)
+        return t
+    from .dtype import to_jax_dtype
+
+    jd = to_jax_dtype(dtype) if dtype is not None else None
+    if jd is None and isinstance(data, (int, bool, float)):
+        # paddle defaults python floats to float32 (not float64)
+        if isinstance(data, bool):
+            jd = jnp.bool_
+        elif isinstance(data, int):
+            jd = jnp.int32
+        else:
+            jd = jnp.float32
+    if jd is None and isinstance(data, (list, tuple)):
+        arr = np.asarray(data)
+        if arr.dtype == np.float64:
+            jd = jnp.float32
+        elif arr.dtype == np.int64:
+            jd = jnp.int32
+    return Tensor(data, dtype=jd, stop_gradient=stop_gradient)

@@ -1,0 +1,5 @@
+"""paddle.distributed parity namespace — populated incrementally; the full
+fleet/collective surface lands with the distributed layer."""
+
+from . import collective_ctx
+from .collective_ctx import axis_scope

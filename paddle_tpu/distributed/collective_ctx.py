@@ -1,0 +1,52 @@
+"""Named-axis context: which mesh axes are live inside the current
+shard_map/pjit scope.
+
+The reference routes collectives through explicit process groups
+(ProcessGroupNCCL comm rings, SURVEY.md §2.1 N13). TPU-native, a "group" is a
+*named mesh axis*; layers ask this registry whether an axis is in scope and
+then use psum/all_gather over the axis name. fleet/parallel wrappers push axes
+here when they enter a shard_map region.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+
+class _Scope(threading.local):
+    def __init__(self):
+        self.axes = []  # stack of axis-name strings currently mapped
+
+
+_SCOPE = _Scope()
+
+
+@contextlib.contextmanager
+def axis_scope(*axis_names):
+    """Declare that `axis_names` are live named axes (entered by shard_map
+    wrappers in distributed.fleet / distributed.parallel)."""
+    _SCOPE.axes.extend(axis_names)
+    try:
+        yield
+    finally:
+        for _ in axis_names:
+            _SCOPE.axes.pop()
+
+
+def current_axis(name):
+    return name if name in _SCOPE.axes else None
+
+
+def axes_in_scope(names):
+    return [n for n in names if n in _SCOPE.axes]
+
+
+def any_axis_in_scope():
+    return bool(_SCOPE.axes)
+
+
+def psum_scoped(value, axis_name):
+    import jax
+
+    return jax.lax.psum(value, axis_name)

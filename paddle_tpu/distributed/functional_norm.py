@@ -1,0 +1,59 @@
+"""Cross-replica (sync) batch norm (ref: paddle SyncBatchNorm over NCCL
+allreduce, SURVEY.md §2.2). TPU-native: the mean/var reduction is a psum over
+the named data-parallel mesh axis inside shard_map — XLA turns it into one
+fused ICI all-reduce."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.op_call import apply
+from ..core.tensor import Tensor
+from ..tensor.creation import _as_t
+
+
+def sync_batch_norm(x, running_mean, running_var, weight, bias, momentum, epsilon,
+                    data_format, axis_name):
+    x = _as_t(x)
+    channel_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    reduce_axes = tuple(i for i in range(x.ndim) if i != channel_axis)
+
+    def bshape(ndim, c):
+        s = [1] * ndim
+        s[channel_axis] = c
+        return s
+
+    def f(a, *wb):
+        # two-moment psum: E[x], E[x^2] across local batch AND the dp axis
+        cnt_local = 1.0
+        for ax in reduce_axes:
+            cnt_local *= a.shape[ax]
+        s1 = jnp.sum(a, axis=reduce_axes)
+        s2 = jnp.sum(jnp.square(a), axis=reduce_axes)
+        s1 = jax.lax.psum(s1, axis_name)
+        s2 = jax.lax.psum(s2, axis_name)
+        cnt = jax.lax.psum(cnt_local, axis_name)
+        mean = s1 / cnt
+        var = s2 / cnt - jnp.square(mean)
+        out = (a - mean.reshape(bshape(a.ndim, mean.size))) * jax.lax.rsqrt(
+            var.reshape(bshape(a.ndim, var.size)) + epsilon
+        )
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(bshape(a.ndim, wb[i].size))
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(bshape(a.ndim, wb[i].size))
+        return out, mean, var
+
+    args = [x]
+    if weight is not None:
+        args.append(_as_t(weight))
+    if bias is not None:
+        args.append(_as_t(bias))
+    out, mean, var = apply(f, *args, _op_name="sync_batch_norm")
+    if running_mean is not None:
+        running_mean._data = running_mean._data * momentum + mean._data * (1 - momentum)
+        running_var._data = running_var._data * momentum + var._data * (1 - momentum)
+    return out

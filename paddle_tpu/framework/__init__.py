@@ -1,0 +1,21 @@
+from .param_attr import ParamAttr
+from .io import save, load
+from ..core import random_state
+
+
+def seed(s):
+    from ..core.random import seed as _seed
+
+    _seed(s)
+
+
+def get_default_dtype():
+    from ..core.dtype import get_default_dtype as _g
+
+    return _g()
+
+
+def set_default_dtype(d):
+    from ..core.dtype import set_default_dtype as _s
+
+    return _s(d)

@@ -1,0 +1,57 @@
+"""paddle.save / paddle.load parity (ref: python/paddle/framework/io.py (U)).
+
+Format: a single pickle file whose tensor leaves are numpy arrays — same
+"nested state_dict" user contract as the reference's .pdparams. The sharded /
+distributed checkpoint path (tensorstore-style, reshard-on-load) lives in
+paddle_tpu.distributed.checkpoint.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def _to_saveable(obj):
+    if isinstance(obj, Tensor):
+        return _TensorPayload(np.asarray(obj._data))
+    if isinstance(obj, dict):
+        return {k: _to_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_saveable(v) for v in obj)
+    return obj
+
+
+class _TensorPayload:
+    __slots__ = ("array",)
+
+    def __init__(self, array):
+        self.array = array
+
+
+def _from_saveable(obj, return_numpy=False):
+    if isinstance(obj, _TensorPayload):
+        return obj.array if return_numpy else Tensor(obj.array)
+    if isinstance(obj, dict):
+        return {k: _from_saveable(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_from_saveable(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _from_saveable(obj, return_numpy=return_numpy)

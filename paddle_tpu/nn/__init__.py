@@ -1,0 +1,17 @@
+"""paddle.nn parity namespace (ref: python/paddle/nn/__init__.py (U))."""
+
+from . import functional
+from . import initializer
+from .layer import *  # noqa: F401,F403
+from .layer import Layer
+from .clip import (
+    ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm,
+    clip_grad_norm_, clip_grad_value_,
+)
+from ..framework.param_attr import ParamAttr
+
+
+def Parameter(*args, **kwargs):
+    from ..core.tensor import Parameter as _P
+
+    return _P(*args, **kwargs)

@@ -1,0 +1,110 @@
+"""Gradient clipping (ref: python/paddle/nn/clip.py (U)).
+
+ClipGradByGlobalNorm computes ONE fused global norm over all grads — on TPU
+this is a single XLA reduction tree, and under hybrid parallelism the
+distributed optimizer extends the norm with a psum across mesh axes
+(SURVEY.md §7 hard-parts list).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import tape as _tape
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = -max if min is None else min
+
+    def __call__(self, params_grads):
+        out = []
+        with _tape.no_grad():
+            for p, g in params_grads:
+                if g is None:
+                    out.append((p, g))
+                    continue
+                out.append((p, Tensor(jnp.clip(g._data, self.min, self.max))))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def __call__(self, params_grads):
+        out = []
+        with _tape.no_grad():
+            for p, g in params_grads:
+                if g is None:
+                    out.append((p, g))
+                    continue
+                norm = jnp.linalg.norm(g._data.reshape(-1))
+                scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+                out.append((p, Tensor(g._data * scale)))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group", auto_skip_clip=False):
+        self.clip_norm = clip_norm
+        self.group_name = group_name
+
+    def __call__(self, params_grads):
+        with _tape.no_grad():
+            sq = [jnp.sum(jnp.square(g._data.astype(jnp.float32))) for p, g in params_grads if g is not None]
+            if not sq:
+                return params_grads
+            global_sq = sum(sq[1:], sq[0])
+            global_sq = self._allreduce_if_distributed(global_sq)
+            gnorm = jnp.sqrt(global_sq)
+            scale = self.clip_norm / jnp.maximum(gnorm, self.clip_norm)
+            out = []
+            for p, g in params_grads:
+                if g is None:
+                    out.append((p, g))
+                    continue
+                out.append((p, Tensor((g._data.astype(jnp.float32) * scale).astype(g._data.dtype))))
+        return out
+
+    def _allreduce_if_distributed(self, global_sq):
+        """Under shard_map, sum the squared-norm contribution across model-
+        parallel axes so ranks agree on the clip scale (hybrid-parallel
+        parity with HybridParallelClipGrad)."""
+        from ..distributed.collective_ctx import axes_in_scope, psum_scoped
+
+        for ax in axes_in_scope(("mp", "pp", "sharding", "sep")):
+            global_sq = psum_scoped(global_sq, ax)
+        return global_sq
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p.grad._data for p in parameters if p.grad is not None]
+    if not grads:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(g)) for g in grads]))
+    else:
+        total = jnp.power(sum(jnp.sum(jnp.power(jnp.abs(g), norm_type)) for g in grads), 1.0 / norm_type)
+    scale = jnp.minimum(max_norm / jnp.maximum(total, 1e-6), 1.0)
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._data = p.grad._data * scale
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._data = jnp.clip(p.grad._data, -clip_value, clip_value)

@@ -1,0 +1,37 @@
+"""paddle.nn.functional parity namespace."""
+
+from .activation import (
+    relu, relu_, relu6, gelu, silu, swish, sigmoid, hardsigmoid, hardswish,
+    hardtanh, hardshrink, tanh, tanhshrink, leaky_relu, prelu, rrelu, elu,
+    selu, celu, mish, softplus, softshrink, softsign, thresholded_relu,
+    log_sigmoid, softmax, softmax_, log_softmax, gumbel_softmax, glu, maxout,
+)
+from .common import (
+    linear, dropout, dropout2d, dropout3d, alpha_dropout, pad, zeropad2d,
+    embedding, one_hot, cosine_similarity, pixel_shuffle, pixel_unshuffle,
+    channel_shuffle, interpolate, upsample, unfold, fold, label_smooth, bilinear,
+)
+from .conv import (
+    conv1d, conv2d, conv3d, conv1d_transpose, conv2d_transpose, conv3d_transpose,
+)
+from .norm import (
+    layer_norm, batch_norm, group_norm, instance_norm, rms_norm, normalize,
+    local_response_norm,
+)
+from .pooling import (
+    max_pool1d, max_pool2d, max_pool3d, avg_pool1d, avg_pool2d, avg_pool3d,
+    adaptive_avg_pool1d, adaptive_avg_pool2d, adaptive_avg_pool3d,
+    adaptive_max_pool1d, adaptive_max_pool2d, adaptive_max_pool3d,
+)
+from .loss import (
+    cross_entropy, softmax_with_cross_entropy, nll_loss, mse_loss, l1_loss,
+    smooth_l1_loss, binary_cross_entropy, binary_cross_entropy_with_logits,
+    kl_div, margin_ranking_loss, cosine_embedding_loss, hinge_embedding_loss,
+    triplet_margin_loss, square_error_cost, sigmoid_focal_loss, log_loss,
+    ctc_loss,
+)
+from .attention import (
+    scaled_dot_product_attention, flash_attention, flash_attn_unpadded, sdp_kernel,
+)
+
+from . import flash_attention as flash_attention_module  # noqa: F401

@@ -1,0 +1,188 @@
+"""Activation functionals (ref: python/paddle/nn/functional/activation.py (U)).
+All map to jax.nn primitives — XLA fuses them into adjacent matmuls on TPU."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.op_call import apply
+from ...core.tensor import Tensor
+from ...tensor.creation import _as_t
+
+
+def _u(fn, x, name=None):
+    return apply(fn, _as_t(x), _op_name=name or getattr(fn, "__name__", "act"))
+
+
+def relu(x, name=None):
+    return _u(jax.nn.relu, x, "relu")
+
+
+def relu_(x, name=None):
+    x._data = jax.nn.relu(x._data)
+    return x
+
+
+def relu6(x, name=None):
+    return _u(jax.nn.relu6, x, "relu6")
+
+
+def gelu(x, approximate=False, name=None):
+    return _u(lambda a: jax.nn.gelu(a, approximate=approximate), x, "gelu")
+
+
+def silu(x, name=None):
+    return _u(jax.nn.silu, x, "silu")
+
+
+def swish(x, name=None):
+    return silu(x)
+
+
+def sigmoid(x, name=None):
+    return _u(jax.nn.sigmoid, x, "sigmoid")
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return _u(lambda a: jnp.clip(slope * a + offset, 0.0, 1.0), x, "hardsigmoid")
+
+
+def hardswish(x, name=None):
+    return _u(lambda a: a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0, x, "hardswish")
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return _u(lambda a: jnp.clip(a, min, max), x, "hardtanh")
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return _u(lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), x, "hardshrink")
+
+
+def tanh(x, name=None):
+    return _u(jnp.tanh, x, "tanh")
+
+
+def tanhshrink(x, name=None):
+    return _u(lambda a: a - jnp.tanh(a), x, "tanhshrink")
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _u(lambda a: jax.nn.leaky_relu(a, negative_slope), x, "leaky_relu")
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def f(a, w):
+        if w.size == 1:
+            wb = w.reshape(())
+        else:
+            shape = [1] * a.ndim
+            ch_axis = 1 if data_format.startswith("NC") else a.ndim - 1
+            shape[ch_axis] = w.size
+            wb = w.reshape(shape)
+        return jnp.where(a >= 0, a, wb * a)
+
+    return apply(f, _as_t(x), _as_t(weight), _op_name="prelu")
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=False, name=None):
+    if training:
+        from ...core import random_state
+
+        key = random_state.next_key()
+
+        def f(a):
+            r = jax.random.uniform(key, a.shape, a.dtype, lower, upper)
+            return jnp.where(a >= 0, a, r * a)
+
+        return apply(f, _as_t(x), _op_name="rrelu")
+    mid = (lower + upper) / 2.0
+    return _u(lambda a: jnp.where(a >= 0, a, mid * a), x, "rrelu")
+
+
+def elu(x, alpha=1.0, name=None):
+    return _u(lambda a: jax.nn.elu(a, alpha), x, "elu")
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return _u(lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), x, "selu")
+
+
+def celu(x, alpha=1.0, name=None):
+    return _u(lambda a: jax.nn.celu(a, alpha), x, "celu")
+
+
+def mish(x, name=None):
+    return _u(lambda a: a * jnp.tanh(jax.nn.softplus(a)), x, "mish")
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return _u(lambda a: jnp.where(a * beta > threshold, a, jax.nn.softplus(a * beta) / beta), x, "softplus")
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return _u(lambda a: jnp.where(a > threshold, a - threshold, jnp.where(a < -threshold, a + threshold, 0.0)), x, "softshrink")
+
+
+def softsign(x, name=None):
+    return _u(jax.nn.soft_sign, x, "softsign")
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return _u(lambda a: jnp.where(a > threshold, a, value), x, "thresholded_relu")
+
+
+def log_sigmoid(x, name=None):
+    return _u(jax.nn.log_sigmoid, x, "log_sigmoid")
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    from ...core.dtype import to_jax_dtype
+
+    jd = to_jax_dtype(dtype) if dtype else None
+
+    def f(a):
+        if jd is not None:
+            a = a.astype(jd)
+        return jax.nn.softmax(a, axis=axis)
+
+    return _u(f, x, "softmax")
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    x._data = jax.nn.softmax(x._data, axis=axis)
+    return x
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    from ...core.dtype import to_jax_dtype
+
+    jd = to_jax_dtype(dtype) if dtype else None
+
+    def f(a):
+        if jd is not None:
+            a = a.astype(jd)
+        return jax.nn.log_softmax(a, axis=axis)
+
+    return _u(f, x, "log_softmax")
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...tensor.random import gumbel_softmax as _gs
+
+    return _gs(x, temperature, hard, axis)
+
+
+def glu(x, axis=-1, name=None):
+    return _u(lambda a: jax.nn.glu(a, axis=axis), x, "glu")
+
+
+def maxout(x, groups, axis=1, name=None):
+    def f(a):
+        ax = axis % a.ndim
+        c = a.shape[ax]
+        new_shape = a.shape[:ax] + (c // groups, groups) + a.shape[ax + 1:]
+        return jnp.max(a.reshape(new_shape), axis=ax + 1)
+
+    return _u(f, x, "maxout")

@@ -1,0 +1,314 @@
+"""Common functionals: linear, dropout, pad, embedding, interpolate...
+(ref: python/paddle/nn/functional/common.py + input.py (U))."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.op_call import apply
+from ...core.tensor import Tensor
+from ...core import random_state
+from ...tensor.creation import _as_t
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b with W shaped [in, out] (paddle convention — already the
+    MXU-friendly layout; no transpose needed)."""
+    if bias is None:
+        return apply(lambda a, w: a @ w, _as_t(x), _as_t(weight), _op_name="linear")
+    return apply(lambda a, w, b: a @ w + b, _as_t(x), _as_t(weight), _as_t(bias), _op_name="linear")
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    x = _as_t(x)
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return apply(lambda a: a * (1.0 - p), x)
+        return x.clone() if not isinstance(x, Tensor) else x
+    key = random_state.next_key()
+
+    def f(a):
+        shape = list(a.shape)
+        if axis is not None:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
+        return jnp.where(keep, a, 0.0).astype(a.dtype)
+
+    return apply(f, x, _op_name="dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    ax = (0, 1) if data_format == "NCHW" else (0, 3)
+    return dropout(x, p, axis=list(ax), training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    ax = (0, 1) if data_format == "NCDHW" else (0, 4)
+    return dropout(x, p, axis=list(ax), training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    x = _as_t(x)
+    if not training or p == 0.0:
+        return x
+    key = random_state.next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def f(a):
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        q = 1.0 - p
+        a_coef = (q + alpha_p**2 * q * p) ** -0.5
+        b_coef = -a_coef * p * alpha_p
+        return (a_coef * jnp.where(keep, a, alpha_p) + b_coef).astype(a.dtype)
+
+    return apply(f, x, _op_name="alpha_dropout")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    x = _as_t(x)
+    if isinstance(pad, Tensor):
+        pad = [int(v) for v in np.asarray(pad._data)]
+    pad = [int(v) for v in pad]
+
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        # full-form pad: [before0, after0, before1, after1, ...]? paddle uses per-dim pairs
+        widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # partial spatial pad, paddle order: last spatial dims, reversed pairs
+        spatial = len(pad) // 2
+        widths = [(0, 0)] * nd
+        if data_format.startswith("NC"):
+            dims = list(range(2, 2 + spatial))
+        else:
+            dims = list(range(1, 1 + spatial))
+        # paddle pad order is [left, right, top, bottom, ...] i.e. innermost dim first
+        for i, d in enumerate(reversed(dims)):
+            widths[d] = (pad[2 * i], pad[2 * i + 1])
+
+    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+
+    def f(a):
+        if jmode == "constant":
+            return jnp.pad(a, widths, mode="constant", constant_values=value)
+        return jnp.pad(a, widths, mode=jmode)
+
+    return apply(f, x, _op_name="pad")
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    def f(w, i):
+        i = i.astype(jnp.int32)
+        out = jnp.take(w, i, axis=0)
+        if padding_idx is not None:
+            mask = (i == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+
+    return apply(f, _as_t(weight), _as_t(x), _op_name="embedding")
+
+
+def one_hot(x, num_classes, name=None):
+    from ...core.dtype import get_default_dtype
+
+    return apply(lambda i: jax.nn.one_hot(i.astype(jnp.int32), num_classes, dtype=get_default_dtype()), _as_t(x))
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def f(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.linalg.norm(a, axis=axis)
+        nb = jnp.linalg.norm(b, axis=axis)
+        return dot / jnp.maximum(na * nb, eps)
+
+    return apply(f, _as_t(x1), _as_t(x2), _op_name="cosine_similarity")
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c // (r * r), r, r, h, w)
+            a = a.transpose(0, 1, 4, 2, 5, 3)
+            return a.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h, w, r, r, c // (r * r))
+        a = a.transpose(0, 1, 3, 2, 4, 5)
+        return a.reshape(n, h * r, w * r, c // (r * r))
+
+    return apply(f, _as_t(x), _op_name="pixel_shuffle")
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c, h // r, r, w // r, r)
+            a = a.transpose(0, 1, 3, 5, 2, 4)
+            return a.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h // r, r, w // r, r, c)
+        a = a.transpose(0, 1, 3, 2, 4, 5)
+        return a.reshape(n, h // r, w // r, c * r * r)
+
+    return apply(f, _as_t(x), _op_name="pixel_unshuffle")
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            return a.reshape(n, groups, c // groups, h, w).transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+        n, h, w, c = a.shape
+        return a.reshape(n, h, w, groups, c // groups).transpose(0, 1, 2, 4, 3).reshape(n, h, w, c)
+
+    return apply(f, _as_t(x), _op_name="channel_shuffle")
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+                align_mode=0, data_format="NCHW", name=None):
+    x = _as_t(x)
+    spatial_ndim = x.ndim - 2
+    if data_format.startswith("NC"):
+        spatial = x.shape[2:]
+    else:
+        spatial = x.shape[1:-1]
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = [int(s) for s in np.asarray(size._data)]
+        out_size = [int(s._data) if isinstance(s, Tensor) else int(s) for s in (size if isinstance(size, (list, tuple)) else [size] * spatial_ndim)]
+    else:
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * spatial_ndim
+        out_size = [int(s * f) for s, f in zip(spatial, sf)]
+
+    jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+             "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+
+    def f(a):
+        if data_format.startswith("NC"):
+            tgt_shape = a.shape[:2] + tuple(out_size)
+        else:
+            tgt_shape = (a.shape[0],) + tuple(out_size) + (a.shape[-1],)
+        if mode == "nearest":
+            return jax.image.resize(a, tgt_shape, method="nearest")
+        if align_corners and jmode == "linear":
+            # jax.image.resize has no align_corners; emulate with explicit grid
+            return _resize_align_corners(a, tgt_shape, data_format)
+        return jax.image.resize(a, tgt_shape, method=jmode)
+
+    return apply(f, x, _op_name="interpolate")
+
+
+def _resize_align_corners(a, tgt_shape, data_format):
+    # linear interp with corner alignment (matches paddle align_corners=True)
+    src_shape = a.shape
+    if data_format.startswith("NC"):
+        spatial_axes = list(range(2, a.ndim))
+    else:
+        spatial_axes = list(range(1, a.ndim - 1))
+    out = a
+    for ax in spatial_axes:
+        n_in = src_shape[ax]
+        n_out = tgt_shape[ax]
+        if n_in == n_out:
+            continue
+        pos = jnp.linspace(0.0, n_in - 1.0, n_out)
+        lo = jnp.floor(pos).astype(jnp.int32)
+        hi = jnp.minimum(lo + 1, n_in - 1)
+        w = (pos - lo).reshape([-1 if i == ax else 1 for i in range(a.ndim)])
+        lo_v = jnp.take(out, lo, axis=ax)
+        hi_v = jnp.take(out, hi, axis=ax)
+        out = lo_v * (1 - w) + hi_v * w
+    return out
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+             align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+    if len(pd) == 2:
+        pd = [pd[0], pd[1], pd[0], pd[1]]
+
+    def f(a):
+        n, c, h, w = a.shape
+        a = jnp.pad(a, [(0, 0), (0, 0), (pd[0], pd[2]), (pd[1], pd[3])])
+        oh = (a.shape[2] - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        ow = (a.shape[3] - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        patches = []
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                sl = a[:, :, i * dl[0]: i * dl[0] + oh * st[0]: st[0], j * dl[1]: j * dl[1] + ow * st[1]: st[1]]
+                patches.append(sl)
+        out = jnp.stack(patches, axis=2)  # n, c, k*k, oh, ow
+        return out.reshape(n, c * ks[0] * ks[1], oh * ow)
+
+    return apply(f, _as_t(x), _op_name="unfold")
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    os_ = output_sizes if isinstance(output_sizes, (list, tuple)) else [output_sizes] * 2
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+
+    def f(a):
+        n, ckk, L = a.shape
+        c = ckk // (ks[0] * ks[1])
+        ph, pw = os_[0] + 2 * pd[0], os_[1] + 2 * pd[1]
+        oh = (ph - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        ow = (pw - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        a = a.reshape(n, c, ks[0], ks[1], oh, ow)
+        out = jnp.zeros((n, c, ph, pw), a.dtype)
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                out = out.at[:, :, i * dl[0]: i * dl[0] + oh * st[0]: st[0],
+                             j * dl[1]: j * dl[1] + ow * st[1]: st[1]].add(a[:, :, i, j])
+        return out[:, :, pd[0]: ph - pd[0], pd[1]: pw - pd[1]]
+
+    return apply(f, _as_t(x), _op_name="fold")
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def f(l):
+        k = l.shape[-1]
+        if prior_dist is not None:
+            pd = prior_dist._data if isinstance(prior_dist, Tensor) else jnp.asarray(prior_dist)
+            return (1 - epsilon) * l + epsilon * pd
+        return (1 - epsilon) * l + epsilon / k
+
+    return apply(f, _as_t(label), _op_name="label_smooth")
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def f(a, b, w, *bi):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if bi:
+            out = out + bi[0]
+        return out
+
+    args = [_as_t(x1), _as_t(x2), _as_t(weight)]
+    if bias is not None:
+        args.append(_as_t(bias))
+    return apply(f, *args, _op_name="bilinear")

@@ -1,0 +1,173 @@
+"""Convolutions over lax.conv_general_dilated (ref: phi conv kernels via cuDNN,
+SURVEY.md §2.1 N3). On TPU, XLA lowers these straight onto the MXU — the
+cuDNN-algorithm-selection machinery of the reference has no equivalent and
+isn't needed. Weight layout follows paddle: [out_c, in_c/groups, *spatial].
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.op_call import apply
+from ...tensor.creation import _as_t
+
+
+def _norm_tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        out = list(v)
+        if len(out) == 1:
+            out = out * n
+        return tuple(int(x) for x in out)
+    return (int(v),) * n
+
+
+def _norm_padding(padding, n, strides=None):
+    """Returns list of (lo, hi) per spatial dim, or the string SAME/VALID."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, (list, tuple)):
+        flat = list(padding)
+        if len(flat) == n:
+            return [(int(p), int(p)) for p in flat]
+        if len(flat) == 2 * n:
+            return [(int(flat[2 * i]), int(flat[2 * i + 1])) for i in range(n)]
+        if all(isinstance(p, (list, tuple)) for p in flat):
+            # NCHW-style [[0,0],[0,0],[ph,ph],[pw,pw]]
+            sp = flat[-n:]
+            return [(int(p[0]), int(p[1])) for p in sp]
+    return [(int(padding), int(padding))] * n
+
+
+def _dim_numbers(n, channel_last):
+    if n == 1:
+        return ("NWC", "WIO", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+    if n == 2:
+        return ("NHWC", "HWIO", "NHWC") if channel_last else ("NCHW", "OIHW", "NCHW")
+    return ("NDHWC", "DHWIO", "NDHWC") if channel_last else ("NCDHW", "OIDHW", "NCDHW")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n, data_format):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    stride = _norm_tuple(stride, n)
+    dilation = _norm_tuple(dilation, n)
+    pad = _norm_padding(padding, n)
+    dn = _dim_numbers(n, channel_last)
+
+    def f(a, w, *b):
+        # paddle weight layout is [O, I/g, *spatial] == OIHW; lax wants per dn
+        if channel_last:
+            # convert OIHW -> HWIO
+            perm = tuple(range(2, 2 + n)) + (1, 0)
+            w = w.transpose(perm)
+        out = lax.conv_general_dilated(
+            a, w,
+            window_strides=stride,
+            padding=pad,
+            lhs_dilation=None,
+            rhs_dilation=dilation,
+            dimension_numbers=dn,
+            feature_group_count=groups,
+        )
+        if b:
+            shape = [1] * out.ndim
+            shape[1 if not channel_last else out.ndim - 1] = b[0].shape[0]
+            out = out + b[0].reshape(shape)
+        return out
+
+    args = [_as_t(x), _as_t(weight)]
+    if bias is not None:
+        args.append(_as_t(bias))
+    return apply(f, *args, _op_name=f"conv{n}d")
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCL", name=None):
+    df = "NWC" if data_format == "NLC" else "NCW"
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1, df)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2, data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3, data_format)
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, n, data_format, output_size=None):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    stride = _norm_tuple(stride, n)
+    dilation = _norm_tuple(dilation, n)
+    pad = _norm_padding(padding, n)
+    opad = _norm_tuple(output_padding, n) if output_padding else (0,) * n
+    dn = _dim_numbers(n, channel_last)
+
+    def f(a, w, *b):
+        # paddle transpose-conv weight layout: [in_c, out_c/g, *spatial] (IOHW)
+        if groups > 1:
+            # lax handles grouped transposed conv via feature_group_count on the
+            # gradient formulation: reshape to (I, O/g, ...) blocks
+            pass
+        # Use conv_general_dilated with lhs_dilation (fractionally-strided conv)
+        k_eff = [dilation[i] * (w.shape[2 + i] - 1) + 1 for i in range(n)]
+        if isinstance(pad, str):
+            if pad == "VALID":
+                pads = [(0, 0)] * n
+            else:  # SAME: output spatial = input * stride
+                pads = []
+                for i in range(n):
+                    total = max(k_eff[i] - stride[i], 0)
+                    pads.append((total // 2, total - total // 2))
+        else:
+            pads = pad
+        trans_pads = [
+            (k_eff[i] - 1 - pads[i][0], k_eff[i] - 1 - pads[i][1] + opad[i])
+            for i in range(n)
+        ]
+        # weight IOHW -> flip spatial, swap I/O => OIHW for the underlying conv
+        w2 = jnp.flip(w, axis=tuple(range(2, 2 + n)))
+        if groups == 1:
+            w2 = jnp.swapaxes(w2, 0, 1)
+        else:
+            ic, ocg = w2.shape[0], w2.shape[1]
+            w2 = w2.reshape((groups, ic // groups) + w2.shape[1:])
+            w2 = jnp.swapaxes(w2, 1, 2)  # g, O/g, I/g, ...
+            w2 = w2.reshape((ocg * groups, ic // groups) + w2.shape[3:])
+        if channel_last:
+            perm = tuple(range(2, 2 + n)) + (1, 0)
+            w2 = w2.transpose(perm)
+        out = lax.conv_general_dilated(
+            a, w2,
+            window_strides=(1,) * n,
+            padding=trans_pads,
+            lhs_dilation=stride,
+            rhs_dilation=dilation,
+            dimension_numbers=dn,
+            feature_group_count=groups,
+        )
+        if b:
+            shape = [1] * out.ndim
+            shape[1 if not channel_last else out.ndim - 1] = b[0].shape[0]
+            out = out + b[0].reshape(shape)
+        return out
+
+    args = [_as_t(x), _as_t(weight)]
+    if bias is not None:
+        args.append(_as_t(bias))
+    return apply(f, *args, _op_name=f"conv{n}d_transpose")
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCL", name=None):
+    df = "NWC" if data_format == "NLC" else "NCW"
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, 1, df, output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, 2, data_format, output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, 3, data_format, output_size)

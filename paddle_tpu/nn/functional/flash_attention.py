@@ -1,0 +1,8 @@
+"""paddle.nn.functional.flash_attention submodule parity
+(ref: python/paddle/nn/functional/flash_attention.py (U))."""
+
+from .attention import (
+    flash_attention, flash_attn_unpadded, scaled_dot_product_attention, sdp_kernel,
+)
+
+flash_attn_qkvpacked = None  # packed variants are unpacked on TPU (static shapes)

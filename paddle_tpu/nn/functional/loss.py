@@ -1,0 +1,283 @@
+"""Loss functionals (ref: python/paddle/nn/functional/loss.py (U)).
+
+cross_entropy follows paddle semantics: integer or soft labels, ignore_index,
+weight, reduction, label smoothing via soft labels. The sharded-vocab variant
+(c_softmax_with_cross_entropy parity) lives in distributed/parallel_layers.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.op_call import apply
+from ...core.tensor import Tensor
+from ...tensor.creation import _as_t
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0, name=None):
+    args = [_as_t(input), _as_t(label).detach() if not soft_label else _as_t(label)]
+    if weight is not None:
+        args.append(_as_t(weight).detach())
+
+    def f(logits, lbl, *w):
+        logp = jax.nn.log_softmax(logits, axis=axis) if use_softmax else jnp.log(jnp.maximum(logits, 1e-30))
+        n_class = logits.shape[axis]
+        if soft_label or (lbl.ndim == logits.ndim and lbl.shape == logits.shape):
+            soft = lbl
+            if label_smoothing > 0:
+                soft = soft * (1 - label_smoothing) + label_smoothing / n_class
+            loss = -jnp.sum(soft * logp, axis=axis)
+        else:
+            lbl_i = lbl.astype(jnp.int32)
+            if lbl_i.ndim == logits.ndim:
+                lbl_i = jnp.squeeze(lbl_i, axis=axis)
+            valid = lbl_i != ignore_index
+            safe = jnp.where(valid, lbl_i, 0)
+            if label_smoothing > 0:
+                oh = jax.nn.one_hot(safe, n_class, dtype=logp.dtype, axis=axis)
+                oh = oh * (1 - label_smoothing) + label_smoothing / n_class
+                nll = -jnp.sum(oh * logp, axis=axis)
+            else:
+                nll = -jnp.take_along_axis(logp, jnp.expand_dims(safe, axis), axis=axis).squeeze(axis)
+            if w:
+                cw = jnp.take(w[0], safe)
+                nll = nll * cw
+                if reduction == "mean":
+                    denom = jnp.sum(jnp.where(valid, cw, 0.0))
+                    return jnp.sum(jnp.where(valid, nll, 0.0)) / jnp.maximum(denom, 1e-12)
+            loss = jnp.where(valid, nll, 0.0)
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(valid.astype(logp.dtype)), 1.0)
+        return _reduce(loss, reduction)
+
+    return apply(f, *args, _op_name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label, ignore_index=ignore_index,
+                         reduction="none", axis=axis)
+    from .activation import softmax as _softmax
+
+    loss = apply(lambda l: jnp.expand_dims(l, axis), loss)
+    if return_softmax:
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    args = [_as_t(input), _as_t(label).detach()]
+    if weight is not None:
+        args.append(_as_t(weight).detach())
+
+    def f(logp, lbl, *w):
+        lbl_i = lbl.astype(jnp.int32)
+        valid = lbl_i != ignore_index
+        safe = jnp.where(valid, lbl_i, 0)
+        nll = -jnp.take_along_axis(logp, jnp.expand_dims(safe, 1), axis=1).squeeze(1)
+        if w:
+            cw = jnp.take(w[0], safe)
+            nll = nll * cw
+            if reduction == "mean":
+                denom = jnp.sum(jnp.where(valid, cw, 0.0))
+                return jnp.sum(jnp.where(valid, nll, 0.0)) / jnp.maximum(denom, 1e-12)
+        nll = jnp.where(valid, nll, 0.0)
+        if reduction == "mean":
+            return jnp.sum(nll) / jnp.maximum(jnp.sum(valid.astype(logp.dtype)), 1.0)
+        return _reduce(nll, reduction)
+
+    return apply(f, *args, _op_name="nll_loss")
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply(lambda a, b: _reduce(jnp.square(a - b), reduction), _as_t(input), _as_t(label), _op_name="mse_loss")
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply(lambda a, b: _reduce(jnp.abs(a - b), reduction), _as_t(input), _as_t(label), _op_name="l1_loss")
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def f(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+        return _reduce(loss, reduction)
+
+    return apply(f, _as_t(input), _as_t(label), _op_name="smooth_l1_loss")
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    args = [_as_t(input), _as_t(label)]
+    if weight is not None:
+        args.append(_as_t(weight))
+
+    def f(p, y, *w):
+        p = jnp.clip(p, 1e-12, 1 - 1e-12)
+        loss = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+
+    return apply(f, *args, _op_name="bce")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean", pos_weight=None, name=None):
+    args = [_as_t(logit), _as_t(label)]
+    if weight is not None:
+        args.append(_as_t(weight))
+
+    def f(z, y, *w):
+        # numerically-stable BCE-with-logits
+        neg_abs = -jnp.abs(z)
+        if pos_weight is not None:
+            pw = pos_weight._data if isinstance(pos_weight, Tensor) else jnp.asarray(pos_weight)
+            log_w = (pw - 1) * y + 1
+            loss = (1 - y) * z + log_w * (jnp.log1p(jnp.exp(neg_abs)) + jnp.maximum(-z, 0))
+        else:
+            loss = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(neg_abs))
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+
+    return apply(f, *args, _op_name="bce_with_logits")
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def f(lp, t):
+        if log_target:
+            loss = jnp.exp(t) * (t - lp)
+        else:
+            loss = jnp.where(t > 0, t * (jnp.log(jnp.maximum(t, 1e-30)) - lp), 0.0)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / lp.shape[0]
+        return _reduce(loss, reduction)
+
+    return apply(f, _as_t(input), _as_t(label), _op_name="kl_div")
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    return apply(
+        lambda a, b, y: _reduce(jnp.maximum(0.0, -y * (a - b) + margin), reduction),
+        _as_t(input), _as_t(other), _as_t(label), _op_name="margin_ranking_loss",
+    )
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    def f(a, b, y):
+        cos = jnp.sum(a * b, axis=-1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12
+        )
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+
+    return apply(f, _as_t(input1), _as_t(input2), _as_t(label), _op_name="cosine_embedding_loss")
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    return apply(
+        lambda a, y: _reduce(jnp.where(y == 1, a, jnp.maximum(0.0, margin - a)), reduction),
+        _as_t(input), _as_t(label), _op_name="hinge_embedding_loss",
+    )
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def f(a, pos, neg):
+        dp = jnp.power(jnp.sum(jnp.power(jnp.abs(a - pos) + epsilon, p), axis=-1), 1 / p)
+        dn = jnp.power(jnp.sum(jnp.power(jnp.abs(a - neg) + epsilon, p), axis=-1), 1 / p)
+        if swap:
+            dsn = jnp.power(jnp.sum(jnp.power(jnp.abs(pos - neg) + epsilon, p), axis=-1), 1 / p)
+            dn = jnp.minimum(dn, dsn)
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+
+    return apply(f, _as_t(input), _as_t(positive), _as_t(negative), _op_name="triplet_margin_loss")
+
+
+def square_error_cost(input, label):
+    return apply(lambda a, b: jnp.square(a - b), _as_t(input), _as_t(label))
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0, reduction="sum", name=None):
+    def f(z, y, *n):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * jnp.power(1 - p_t, gamma) * ce
+        if n:
+            loss = loss / n[0]
+        return _reduce(loss, reduction)
+
+    args = [_as_t(logit), _as_t(label)]
+    if normalizer is not None:
+        args.append(_as_t(normalizer))
+    return apply(f, *args, _op_name="sigmoid_focal_loss")
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return apply(
+        lambda p, y: -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(1 - p + epsilon),
+        _as_t(input), _as_t(label), _op_name="log_loss",
+    )
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction="mean", norm_by_times=False):
+    """CTC via the classic forward algorithm in log space (lax.scan over time).
+    Shapes: log_probs [T, B, C] (paddle convention)."""
+
+    def f(lp, lbl, in_len, lbl_len):
+        lp = jax.nn.log_softmax(lp, axis=-1)
+        T, B, C = lp.shape
+        S = lbl.shape[1]
+        ext = jnp.full((B, 2 * S + 1), blank, dtype=jnp.int32)
+        ext = ext.at[:, 1::2].set(lbl.astype(jnp.int32))
+        L = 2 * lbl_len.astype(jnp.int32) + 1
+        neg_inf = -1e30
+
+        alpha0 = jnp.full((B, 2 * S + 1), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(lp[0, :, blank])
+        alpha0 = alpha0.at[:, 1].set(jnp.take_along_axis(lp[0], ext[:, 1:2], axis=1)[:, 0])
+
+        same_as_prev2 = jnp.concatenate(
+            [jnp.ones((B, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1
+        )
+
+        def step(alpha, lp_t):
+            a_prev = alpha
+            a_shift1 = jnp.concatenate([jnp.full((B, 1), neg_inf), alpha[:, :-1]], axis=1)
+            a_shift2 = jnp.concatenate([jnp.full((B, 2), neg_inf), alpha[:, :-2]], axis=1)
+            a_shift2 = jnp.where(same_as_prev2, neg_inf, a_shift2)
+            merged = jnp.logaddexp(jnp.logaddexp(a_prev, a_shift1), a_shift2)
+            emit = jnp.take_along_axis(lp_t, ext, axis=1)
+            return merged + emit, None
+
+        def scan_fn(carry, t):
+            alpha = carry
+            new_alpha, _ = step(alpha, lp[t])
+            alpha = jnp.where((t < in_len)[:, None], new_alpha, alpha)
+            return alpha, None
+
+        alpha, _ = jax.lax.scan(scan_fn, alpha0, jnp.arange(1, T))
+        idx_last = L - 1
+        idx_prev = jnp.maximum(L - 2, 0)
+        a_last = jnp.take_along_axis(alpha, idx_last[:, None], axis=1)[:, 0]
+        a_prev = jnp.take_along_axis(alpha, idx_prev[:, None], axis=1)[:, 0]
+        ll = jnp.logaddexp(a_last, a_prev)
+        loss = -ll
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(lbl_len.astype(loss.dtype), 1.0))
+        return _reduce(loss, reduction)
+
+    return apply(
+        f, _as_t(log_probs), _as_t(labels).detach(), _as_t(input_lengths).detach(),
+        _as_t(label_lengths).detach(), _op_name="ctc_loss",
+    )

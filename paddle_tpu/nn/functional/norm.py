@@ -1,0 +1,216 @@
+"""Normalization functionals (ref: phi layer_norm/batch_norm/group_norm
+kernels, SURVEY.md §2.1 N3/N4). XLA fuses these; the Pallas fused variants in
+paddle_tpu.ops provide the hand-tiled fast path and are used automatically by
+the corresponding nn.Layer classes when shapes allow."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.op_call import apply
+from ...core.tensor import Tensor
+from ...tensor.creation import _as_t
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    n_axes = len(list(normalized_shape))
+
+    def f(a, *wb):
+        axes = tuple(range(a.ndim - n_axes, a.ndim))
+        mean = jnp.mean(a, axis=axes, keepdims=True)
+        var = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - mean) * jax.lax.rsqrt(var + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i]
+            i += 1
+        if bias is not None:
+            out = out + wb[i]
+        return out
+
+    args = [_as_t(x)]
+    if weight is not None:
+        args.append(_as_t(weight))
+    if bias is not None:
+        args.append(_as_t(bias))
+    return apply(f, *args, _op_name="layer_norm")
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=False,
+               momentum=0.9, epsilon=1e-5, data_format="NCHW", use_global_stats=None, name=None):
+    x = _as_t(x)
+    channel_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    reduce_axes = tuple(i for i in range(x.ndim) if i != channel_axis)
+    use_batch_stats = training and not use_global_stats
+
+    def bshape(ndim, c):
+        s = [1] * ndim
+        s[channel_axis] = c
+        return s
+
+    if use_batch_stats:
+        def f(a, *wb):
+            mean = jnp.mean(a, axis=reduce_axes)
+            var = jnp.var(a, axis=reduce_axes)
+            out = (a - mean.reshape(bshape(a.ndim, mean.size))) * jax.lax.rsqrt(
+                var.reshape(bshape(a.ndim, var.size)) + epsilon
+            )
+            i = 0
+            if weight is not None:
+                out = out * wb[i].reshape(bshape(a.ndim, wb[i].size))
+                i += 1
+            if bias is not None:
+                out = out + wb[i].reshape(bshape(a.ndim, wb[i].size))
+            return out, mean, var
+
+        args = [x]
+        if weight is not None:
+            args.append(_as_t(weight))
+        if bias is not None:
+            args.append(_as_t(bias))
+        out, batch_mean, batch_var = apply(f, *args, _op_name="batch_norm")
+        # update running stats in place (dygraph semantics)
+        if running_mean is not None:
+            rm = running_mean._data if isinstance(running_mean, Tensor) else running_mean
+            rv = running_var._data if isinstance(running_var, Tensor) else running_var
+            n = 1
+            for ax in reduce_axes:
+                n *= x.shape[ax]
+            unbiased = batch_var._data * (n / max(n - 1, 1))
+            running_mean._data = rm * momentum + batch_mean._data * (1 - momentum)
+            running_var._data = rv * momentum + unbiased * (1 - momentum)
+        return out
+
+    def f(a, m, v, *wb):
+        out = (a - m.reshape(bshape(a.ndim, m.size))) * jax.lax.rsqrt(v.reshape(bshape(a.ndim, v.size)) + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(bshape(a.ndim, wb[i].size))
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(bshape(a.ndim, wb[i].size))
+        return out
+
+    args = [x, _as_t(running_mean).detach(), _as_t(running_var).detach()]
+    if weight is not None:
+        args.append(_as_t(weight))
+    if bias is not None:
+        args.append(_as_t(bias))
+    return apply(f, *args, _op_name="batch_norm")
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None, data_format="NCHW", name=None):
+    def f(a, *wb):
+        if data_format.startswith("NC"):
+            n, c = a.shape[0], a.shape[1]
+            spatial = a.shape[2:]
+            g = a.reshape((n, num_groups, c // num_groups) + spatial)
+            axes = tuple(range(2, g.ndim))
+            mean = jnp.mean(g, axis=axes, keepdims=True)
+            var = jnp.var(g, axis=axes, keepdims=True)
+            out = ((g - mean) * jax.lax.rsqrt(var + epsilon)).reshape(a.shape)
+            shape = [1, c] + [1] * len(spatial)
+        else:
+            n, c = a.shape[0], a.shape[-1]
+            spatial = a.shape[1:-1]
+            g = a.reshape((n,) + spatial + (num_groups, c // num_groups))
+            axes = tuple(range(1, g.ndim - 2)) + (g.ndim - 1,)
+            mean = jnp.mean(g, axis=axes, keepdims=True)
+            var = jnp.var(g, axis=axes, keepdims=True)
+            out = ((g - mean) * jax.lax.rsqrt(var + epsilon)).reshape(a.shape)
+            shape = [1] * (len(spatial) + 1) + [c]
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    args = [_as_t(x)]
+    if weight is not None:
+        args.append(_as_t(weight))
+    if bias is not None:
+        args.append(_as_t(bias))
+    return apply(f, *args, _op_name="group_norm")
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-5, data_format="NCHW", name=None):
+    def f(a, *wb):
+        axes = tuple(range(2, a.ndim)) if data_format.startswith("NC") else tuple(range(1, a.ndim - 1))
+        mean = jnp.mean(a, axis=axes, keepdims=True)
+        var = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - mean) * jax.lax.rsqrt(var + eps)
+        c = a.shape[1] if data_format.startswith("NC") else a.shape[-1]
+        shape = [1] * a.ndim
+        shape[1 if data_format.startswith("NC") else a.ndim - 1] = c
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    args = [_as_t(x)]
+    if weight is not None:
+        args.append(_as_t(weight))
+    if bias is not None:
+        args.append(_as_t(bias))
+    return apply(f, *args, _op_name="instance_norm")
+
+
+def rms_norm(x, weight=None, bias=None, epsilon=1e-6, begin_norm_axis=-1, name=None):
+    """RMSNorm (the reference ships it as paddle.incubate.nn.functional.fused_rms_norm)."""
+
+    def f(a, *wb):
+        ax = begin_norm_axis % a.ndim
+        axes = tuple(range(ax, a.ndim))
+        ms = jnp.mean(jnp.square(a.astype(jnp.float32)), axis=axes, keepdims=True)
+        out = (a.astype(jnp.float32) * jax.lax.rsqrt(ms + epsilon)).astype(a.dtype)
+        i = 0
+        if weight is not None:
+            out = out * wb[i]
+            i += 1
+        if bias is not None:
+            out = out + wb[i]
+        return out
+
+    args = [_as_t(x)]
+    if weight is not None:
+        args.append(_as_t(weight))
+    if bias is not None:
+        args.append(_as_t(bias))
+    return apply(f, *args, _op_name="rms_norm")
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def f(a):
+        n = jnp.linalg.norm(a, ord=p, axis=axis, keepdims=True)
+        return a / jnp.maximum(n, epsilon)
+
+    return apply(f, _as_t(x), _op_name="normalize")
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW", name=None):
+    def f(a):
+        ch_axis = 1 if data_format.startswith("NC") else a.ndim - 1
+        sq = jnp.square(a)
+        half = size // 2
+        c = a.shape[ch_axis]
+        acc = jnp.zeros_like(a)
+        for off in range(-half, size - half):
+            sl = jnp.roll(sq, off, axis=ch_axis)
+            # zero out wrapped entries
+            idx = jnp.arange(c)
+            valid = (idx - off >= 0) & (idx - off < c)
+            shape = [1] * a.ndim
+            shape[ch_axis] = c
+            acc = acc + jnp.where(valid.reshape(shape), sl, 0.0)
+        return a / jnp.power(k + alpha * acc / size, beta)
+
+    return apply(f, _as_t(x), _op_name="local_response_norm")

@@ -1,0 +1,125 @@
+"""Pooling via lax.reduce_window (ref: phi pool kernels (U))."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.op_call import apply
+from ...tensor.creation import _as_t
+from .conv import _norm_tuple, _norm_padding
+
+
+def _pool(x, kernel, stride, padding, n, data_format, reducer, init, ceil_mode=False, average=False, exclusive=True):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    kernel = _norm_tuple(kernel, n)
+    stride = _norm_tuple(stride if stride is not None else kernel, n)
+    pad = _norm_padding(padding, n)
+    if isinstance(pad, str):
+        pad_cfg = pad
+    else:
+        pad_cfg = pad
+
+    def f(a):
+        if channel_last:
+            dims = (1,) + kernel + (1,)
+            strides = (1,) + stride + (1,)
+            pads = [(0, 0)] + (pad_cfg if not isinstance(pad_cfg, str) else []) + [(0, 0)]
+        else:
+            dims = (1, 1) + kernel
+            strides = (1, 1) + stride
+            pads = [(0, 0), (0, 0)] + (pad_cfg if not isinstance(pad_cfg, str) else [])
+        if isinstance(pad_cfg, str):
+            pads = pad_cfg
+        out = lax.reduce_window(a, init, reducer, dims, strides, pads)
+        if average:
+            if exclusive and not isinstance(pads, str) and any(p != (0, 0) for p in pads):
+                ones = jnp.ones_like(a)
+                counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pads)
+                out = out / counts
+            else:
+                out = out / float(np.prod(kernel))
+        return out
+
+    return apply(f, _as_t(x), _op_name=("avg_pool" if average else "max_pool") + f"{n}d")
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCL", name=None):
+    df = "NWC" if data_format == "NLC" else "NCW"
+    return _pool(x, kernel_size, stride, padding, 1, df, lax.max, -jnp.inf, ceil_mode)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, data_format, lax.max, -jnp.inf, ceil_mode)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format, lax.max, -jnp.inf, ceil_mode)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False, data_format="NCL", name=None):
+    df = "NWC" if data_format == "NLC" else "NCW"
+    return _pool(x, kernel_size, stride, padding, 1, df, lax.add, 0.0, ceil_mode, average=True, exclusive=exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True,
+               divisor_override=None, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, data_format, lax.add, 0.0, ceil_mode, average=True, exclusive=exclusive)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True,
+               divisor_override=None, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format, lax.add, 0.0, ceil_mode, average=True, exclusive=exclusive)
+
+
+def _adaptive_pool(x, output_size, n, data_format, mode):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    out_size = output_size if isinstance(output_size, (list, tuple)) else [output_size] * n
+    out_size = [int(s) for s in out_size]
+
+    def f(a):
+        spatial = a.shape[2:] if not channel_last else a.shape[1:-1]
+        out = a
+        for i, (ins, outs) in enumerate(zip(spatial, out_size)):
+            ax = (2 + i) if not channel_last else (1 + i)
+            if outs is None or outs == ins:
+                continue
+            # split into outs segments, paddle-style start/end indices
+            starts = [(j * ins) // outs for j in range(outs)]
+            ends = [-(-((j + 1) * ins) // outs) for j in range(outs)]
+            segs = []
+            for s, e in zip(starts, ends):
+                seg = lax.slice_in_dim(out, s, e, axis=ax)
+                if mode == "avg":
+                    segs.append(jnp.mean(seg, axis=ax, keepdims=True))
+                else:
+                    segs.append(jnp.max(seg, axis=ax, keepdims=True))
+            out = jnp.concatenate(segs, axis=ax)
+        return out
+
+    return apply(f, _as_t(x), _op_name=f"adaptive_{mode}_pool{n}d")
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, "NCW", "avg")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, data_format, "avg")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, data_format, "avg")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 1, "NCW", "max")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 2, "NCHW", "max")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 3, "NCDHW", "max")

@@ -1,0 +1,27 @@
+from .initializer import (
+    Initializer, Constant, Normal, TruncatedNormal, Uniform, XavierNormal,
+    XavierUniform, KaimingNormal, KaimingUniform, Assign, Dirac, Orthogonal,
+    calculate_gain,
+)
+
+
+class LazyGuard:
+    """paddle.LazyGuard parity: in this framework initialization is already
+    lazy-cheap (device arrays materialize on first use), so this is a no-op
+    context manager kept for API compatibility."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    from . import initializer as _m
+
+    _GLOBAL_INIT[0] = weight_init
+    _GLOBAL_INIT[1] = bias_init
+
+
+_GLOBAL_INIT = [None, None]

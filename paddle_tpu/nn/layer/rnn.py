@@ -1,0 +1,339 @@
+"""RNN family via lax.scan (ref: python/paddle/nn/layer/rnn.py (U)).
+
+TPU-native: the whole time loop is one `lax.scan`, so XLA compiles a single
+fused loop body instead of the reference's per-timestep cuDNN dispatch.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .layers import Layer
+from ..initializer import Uniform
+from ...core.op_call import apply
+from ...core.tensor import Tensor
+from ...tensor.creation import _as_t
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None, init_value=0.0, batch_dim_idx=0):
+        b = batch_ref.shape[batch_dim_idx]
+        from ...tensor.creation import full
+
+        return full([b, self.hidden_size], init_value, dtype or "float32")
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh", weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        std = 1.0 / np.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter([hidden_size, input_size], weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size], weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter([hidden_size], bias_ih_attr, is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter([hidden_size], bias_hh_attr, is_bias=True, default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def f(x, h, wi, wh, bi, bh):
+            return act(x @ wi.T + bi + h @ wh.T + bh)
+
+        h = apply(f, _as_t(inputs), _as_t(states), self.weight_ih, self.weight_hh,
+                  self.bias_ih, self.bias_hh, _op_name="simple_rnn_cell")
+        return h, h
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,),)
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, proj_size=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / np.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size], weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size], weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter([4 * hidden_size], bias_ih_attr, is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter([4 * hidden_size], bias_hh_attr, is_bias=True, default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = (self.get_initial_states(inputs), self.get_initial_states(inputs))
+        h0, c0 = states
+
+        def f(x, h, c, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + h @ wh.T + bh
+            i, fgt, g, o = jnp.split(gates, 4, axis=-1)
+            i = jax.nn.sigmoid(i)
+            fgt = jax.nn.sigmoid(fgt)
+            g = jnp.tanh(g)
+            o = jax.nn.sigmoid(o)
+            c_new = fgt * c + i * g
+            h_new = o * jnp.tanh(c_new)
+            return h_new, c_new
+
+        out = apply(f, _as_t(inputs), _as_t(h0), _as_t(c0), self.weight_ih, self.weight_hh,
+                    self.bias_ih, self.bias_hh, _op_name="lstm_cell")
+        h, c = out
+        return h, (h, c)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / np.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size], weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size], weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter([3 * hidden_size], bias_ih_attr, is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter([3 * hidden_size], bias_hh_attr, is_bias=True, default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def f(x, h, wi, wh, bi, bh):
+            gi = x @ wi.T + bi
+            gh = h @ wh.T + bh
+            ir, iz, ic = jnp.split(gi, 3, axis=-1)
+            hr, hz, hc = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            c = jnp.tanh(ic + r * hc)
+            return (1 - z) * c + z * h
+
+        h = apply(f, _as_t(inputs), _as_t(states), self.weight_ih, self.weight_hh,
+                  self.bias_ih, self.bias_hh, _op_name="gru_cell")
+        return h, h
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,),)
+
+
+class RNN(Layer):
+    """Run a cell over time with lax.scan (paddle.nn.RNN parity)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...tensor.manipulation import stack, unbind
+
+        steps = unbind(inputs, 0 if self.time_major else 1)
+        if self.is_reverse:
+            steps = steps[::-1]
+        states = initial_states
+        outs = []
+        for x in steps:
+            out, states = self.cell(x, states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        outputs = stack(outs, 0 if self.time_major else 1)
+        return outputs, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...tensor.manipulation import concat
+
+        st_fw, st_bw = (initial_states if initial_states is not None else (None, None))
+        out_fw, s_fw = self.rnn_fw(inputs, st_fw)
+        out_bw, s_bw = self.rnn_bw(inputs, st_bw)
+        return concat([out_fw, out_bw], axis=-1), (s_fw, s_bw)
+
+
+class _RNNBase(Layer):
+    """Multi-layer (optionally bidirectional) recurrent net over lax.scan.
+
+    The scan runs over raw arrays inside one taped op so the whole unrolled
+    network is a single XLA while-loop — fast on TPU and differentiable."""
+
+    MODE = "RNN_TANH"
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, activation=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirectional = direction in ("bidirect", "bidirectional")
+        self.num_directions = 2 if self.bidirectional else 1
+        gate_mult = {"LSTM": 4, "GRU": 3}.get(self.MODE, 1)
+        std = 1.0 / np.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self._param_names = []
+        for layer in range(num_layers):
+            for direction_i in range(self.num_directions):
+                in_size = input_size if layer == 0 else hidden_size * self.num_directions
+                sfx = f"l{layer}" + ("_reverse" if direction_i else "")
+                wi = self.create_parameter([gate_mult * hidden_size, in_size], weight_ih_attr, default_initializer=init)
+                wh = self.create_parameter([gate_mult * hidden_size, hidden_size], weight_hh_attr, default_initializer=init)
+                bi = self.create_parameter([gate_mult * hidden_size], bias_ih_attr, is_bias=True, default_initializer=init)
+                bh = self.create_parameter([gate_mult * hidden_size], bias_hh_attr, is_bias=True, default_initializer=init)
+                self.add_parameter(f"weight_ih_{sfx}", wi)
+                self.add_parameter(f"weight_hh_{sfx}", wh)
+                self.add_parameter(f"bias_ih_{sfx}", bi)
+                self.add_parameter(f"bias_hh_{sfx}", bh)
+                self._param_names.append(sfx)
+
+    def _cell_step(self, mode):
+        if mode == "LSTM":
+            def step(x, hc, wi, wh, bi, bh):
+                h, c = hc
+                gates = x @ wi.T + bi + h @ wh.T + bh
+                i, f, g, o = jnp.split(gates, 4, axis=-1)
+                c_new = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+                h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+                return h_new, (h_new, c_new)
+        elif mode == "GRU":
+            def step(x, h, wi, wh, bi, bh):
+                gi = x @ wi.T + bi
+                gh = h @ wh.T + bh
+                ir, iz, ic = jnp.split(gi, 3, axis=-1)
+                hr, hz, hc = jnp.split(gh, 3, axis=-1)
+                r = jax.nn.sigmoid(ir + hr)
+                z = jax.nn.sigmoid(iz + hz)
+                c = jnp.tanh(ic + r * hc)
+                h_new = (1 - z) * c + z * h
+                return h_new, h_new
+        else:
+            act = jnp.tanh if self.MODE == "RNN_TANH" else jax.nn.relu
+
+            def step(x, h, wi, wh, bi, bh):
+                h_new = act(x @ wi.T + bi + h @ wh.T + bh)
+                return h_new, h_new
+
+        return step
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        mode = self.MODE
+        is_lstm = mode == "LSTM"
+        nl, nd, hs = self.num_layers, self.num_directions, self.hidden_size
+        time_major = self.time_major
+        step = self._cell_step(mode)
+        params = []
+        for sfx in self._param_names:
+            params += [
+                self._parameters[f"weight_ih_{sfx}"],
+                self._parameters[f"weight_hh_{sfx}"],
+                self._parameters[f"bias_ih_{sfx}"],
+                self._parameters[f"bias_hh_{sfx}"],
+            ]
+
+        init_arrays = []
+        if initial_states is not None:
+            if is_lstm:
+                init_arrays = [_as_t(initial_states[0]), _as_t(initial_states[1])]
+            else:
+                init_arrays = [_as_t(initial_states)]
+
+        def run(x, *flat):
+            c0_all = None
+            if initial_states is not None:
+                if is_lstm:
+                    h0_all, c0_all = flat[0], flat[1]
+                    weights = flat[2:]
+                else:
+                    h0_all = flat[0]
+                    weights = flat[1:]
+            else:
+                h0_all = None
+                weights = flat
+
+            if not time_major:
+                x = jnp.swapaxes(x, 0, 1)  # -> [T, B, ...]
+            b = x.shape[1]
+            out = x
+            last_h, last_c = [], []
+            for layer in range(nl):
+                dir_outs = []
+                for d in range(nd):
+                    idx = (layer * nd + d) * 4
+                    wi, wh, bi, bh = weights[idx:idx + 4]
+                    state_idx = layer * nd + d
+                    if h0_all is not None:
+                        h0 = h0_all[state_idx]
+                        c0 = c0_all[state_idx] if is_lstm else None
+                    else:
+                        h0 = jnp.zeros((b, hs), x.dtype)
+                        c0 = jnp.zeros((b, hs), x.dtype)
+                    carry0 = (h0, c0) if is_lstm else h0
+                    seq = jnp.flip(out, 0) if d == 1 else out
+
+                    def scan_fn(carry, xt, _wi=wi, _wh=wh, _bi=bi, _bh=bh):
+                        h_out, new_carry = step(xt, carry, _wi, _wh, _bi, _bh)
+                        return new_carry, h_out
+
+                    final, ys = lax.scan(scan_fn, carry0, seq)
+                    if d == 1:
+                        ys = jnp.flip(ys, 0)
+                    dir_outs.append(ys)
+                    if is_lstm:
+                        last_h.append(final[0])
+                        last_c.append(final[1])
+                    else:
+                        last_h.append(final)
+                out = jnp.concatenate(dir_outs, axis=-1) if nd == 2 else dir_outs[0]
+            outputs = out if time_major else jnp.swapaxes(out, 0, 1)
+            h_stack = jnp.stack(last_h, 0)
+            if is_lstm:
+                return outputs, h_stack, jnp.stack(last_c, 0)
+            return outputs, h_stack
+
+        out = apply(run, _as_t(inputs), *init_arrays, *params, _op_name=f"rnn_{mode.lower()}")
+        if is_lstm:
+            outputs, h, c = out
+            return outputs, (h, c)
+        outputs, h = out
+        return outputs, h
+
+
+class SimpleRNN(_RNNBase):
+    MODE = "RNN_TANH"
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh", **kw):
+        self.MODE = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(input_size, hidden_size, num_layers, direction, time_major, dropout, **kw)
+
+
+class LSTM(_RNNBase):
+    MODE = "LSTM"
+
+
+class GRU(_RNNBase):
+    MODE = "GRU"

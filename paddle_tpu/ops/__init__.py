@@ -1,0 +1,5 @@
+"""paddle_tpu.ops: the native-kernel layer (TPU counterpart of the reference's
+fused CUDA kernels, SURVEY.md §2.1 N4/N5). Pallas kernels live in
+ops/pallas/; each op exposes an array-level function plus a Tensor wrapper."""
+
+from .flash_attention import flash_attention, flash_attention_arrays

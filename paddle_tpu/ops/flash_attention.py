@@ -1,0 +1,54 @@
+"""Flash attention for TPU.
+
+Reference parity: the vendored FlashAttention-2 CUDA library behind
+paddle.nn.functional.flash_attention (SURVEY.md §2.1 N5). TPU-native design:
+a Pallas blockwise-softmax kernel (ops/pallas/flash.py, arriving with the
+kernel layer) with this XLA fallback — jnp einsum + online-softmax-equivalent
+math that XLA already fuses well on the MXU. Layout [B, S, H, D], matching the
+reference's flash-attn API.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.op_call import apply
+from ..core.tensor import Tensor
+from ..tensor.creation import _as_t
+
+
+def _xla_flash(q, k, v, causal, scale):
+    s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("bshd,bthd->bhst", q, k, preferred_element_type=jnp.float32) * s
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(mask, logits, -1e30)
+    p = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhst,bthd->bshd", p.astype(v.dtype), v)
+
+
+def flash_attention_arrays(q, k, v, causal=False, scale=None):
+    """Array-level entry used by both the Tensor wrapper and jitted models.
+
+    Routes to the Pallas TPU kernel when available, else the XLA path."""
+    try:
+        from .pallas.flash import flash_attention_fwd  # Pallas kernel (TPU)
+
+        if jax.default_backend() == "tpu":
+            return flash_attention_fwd(q, k, v, causal=causal, scale=scale)
+    except Exception:
+        pass
+    return _xla_flash(q, k, v, causal, scale)
+
+
+def flash_attention(query, key, value, causal=False, scale=None):
+    q, k, v = _as_t(query), _as_t(key), _as_t(value)
+    return apply(
+        functools.partial(flash_attention_arrays, causal=causal, scale=scale),
+        q, k, v, _op_name="flash_attention",
+    )

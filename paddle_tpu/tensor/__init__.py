@@ -1,0 +1,126 @@
+"""paddle.tensor-equivalent namespace: re-exports every op and monkey-patches
+them onto Tensor as methods + operators — mirroring how the reference attaches
+its ~700 tensor methods to the pybind Tensor (upstream python/paddle/tensor/__init__.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, Parameter, to_tensor
+
+from .creation import (
+    zeros, ones, full, empty, zeros_like, ones_like, full_like, empty_like,
+    arange, linspace, logspace, eye, meshgrid, tril, triu, diag, diagflat,
+    diag_embed, assign, clone, one_hot, complex, polar,
+)
+from .math import (
+    add, subtract, multiply, divide, floor_divide, remainder, mod, floor_mod,
+    pow, maximum, minimum, fmax, fmin, atan2, hypot, gcd, lcm, heaviside,
+    nextafter, copysign, ldexp, logaddexp, sqrt, rsqrt, square, exp, expm1,
+    log, log2, log10, log1p, abs, neg, negative, sign, sgn, sin, cos, tan,
+    asin, acos, atan, sinh, cosh, tanh, asinh, acosh, atanh, floor, ceil,
+    round, trunc, frac, reciprocal, sigmoid, logsigmoid, erf, erfinv, lgamma,
+    digamma, i0, angle, conj, real, imag, deg2rad, rad2deg, isnan, isinf,
+    isfinite, nan_to_num, clip, scale, stanh, lerp, sum, nansum, mean,
+    nanmean, max, min, amax, amin, prod, std, var, logsumexp, cumsum,
+    cumprod, cummax, cummin, count_nonzero, diff, trace, add_n, matmul, mm,
+    bmm, dot, inner, outer, kron, mv, addmm, cross, allclose, isclose,
+    equal_all, increment, multiplex,
+)
+from .manipulation import (
+    reshape, reshape_, transpose, t, moveaxis, swapaxes, flatten, squeeze,
+    unsqueeze, concat, stack, split, chunk, unbind, unstack, tile, expand,
+    expand_as, broadcast_to, broadcast_tensors, flip, rot90, roll, gather,
+    gather_nd, scatter, scatter_, scatter_nd_add, scatter_nd, index_select,
+    index_sample, index_add, index_put, masked_select, masked_fill,
+    take_along_axis, put_along_axis, take, slice, strided_slice,
+    repeat_interleave, unique, unique_consecutive, nonzero, where,
+    as_complex, as_real, view, view_as, atleast_1d, atleast_2d, atleast_3d,
+    tensordot, shard_index, cast,
+)
+from .logic import (
+    equal, not_equal, greater_than, greater_equal, less_than, less_equal,
+    logical_and, logical_or, logical_xor, logical_not, bitwise_and,
+    bitwise_or, bitwise_xor, bitwise_not, bitwise_left_shift,
+    bitwise_right_shift, all, any, is_empty, is_tensor, in_dynamic_mode,
+)
+from .search import (
+    argmax, argmin, argsort, sort, topk, kthvalue, mode, searchsorted,
+    bucketize, median, nanmedian, quantile, histogram, histogramdd,
+)
+from .linalg import norm
+from .random import (
+    rand, randn, standard_normal, normal, uniform, randint, randint_like,
+    randperm, multinomial, bernoulli, poisson, rand_like, randn_like,
+    uniform_, bernoulli_, exponential_, normal_, gumbel_softmax,
+)
+from .einsum import einsum
+from .attribute import shape as shape_fn, rank, numel, is_complex, is_floating_point, is_integer
+from . import creation, math, manipulation, logic, search, linalg, random, stat
+
+
+def _patch():
+    import builtins as _bi
+    from . import math as _m, manipulation as _mp, logic as _lg, search as _s, creation as _c, linalg as _la, random as _r
+
+    methods = {}
+    for mod in (_m, _mp, _lg, _s, _la):
+        for name in dir(mod):
+            if name.startswith("_"):
+                continue
+            fn = getattr(mod, name)
+            if callable(fn) and not isinstance(fn, type):
+                methods.setdefault(name, fn)
+    # in-place random mutators are legitimate Tensor methods
+    for name in ("uniform_", "normal_", "bernoulli_", "exponential_"):
+        methods[name] = getattr(_r, name)
+
+    skip = {"shape", "slice"}  # don't clobber property / builtin-ish
+    for name, fn in methods.items():
+        if name in skip or hasattr(Tensor, name):
+            continue
+        setattr(Tensor, name, fn)
+
+    # method aliases paddle exposes
+    Tensor.numpy  # exists
+    Tensor.mod = _m.remainder
+    Tensor.pow = _m.pow
+    Tensor.abs = _m.abs
+    Tensor.any = _lg.any
+    Tensor.all = _lg.all
+    Tensor.norm = _la.norm
+    Tensor.flatten = _mp.flatten
+    Tensor.unflatten = lambda self, axis, shape: _mp.reshape(
+        self, self.shape[:axis] + list(shape) + self.shape[axis + 1:]
+    )
+
+    # operators
+    Tensor.__add__ = lambda self, o: _m.add(self, o)
+    Tensor.__radd__ = lambda self, o: _m.add(self, o)
+    Tensor.__sub__ = lambda self, o: _m.subtract(self, o)
+    Tensor.__rsub__ = lambda self, o: _m._rbinary(jnp.subtract, self, o if not isinstance(o, Tensor) else o._data, "rsub")
+    Tensor.__mul__ = lambda self, o: _m.multiply(self, o)
+    Tensor.__rmul__ = lambda self, o: _m.multiply(self, o)
+    Tensor.__truediv__ = lambda self, o: _m.divide(self, o)
+    Tensor.__rtruediv__ = lambda self, o: _m._rbinary(jnp.true_divide, self, o if not isinstance(o, Tensor) else o._data, "rdiv")
+    Tensor.__floordiv__ = lambda self, o: _m.floor_divide(self, o)
+    Tensor.__mod__ = lambda self, o: _m.remainder(self, o)
+    Tensor.__pow__ = lambda self, o: _m.pow(self, o)
+    Tensor.__rpow__ = lambda self, o: _m._rbinary(jnp.power, self, o if not isinstance(o, Tensor) else o._data, "rpow")
+    Tensor.__matmul__ = lambda self, o: _m.matmul(self, o)
+    Tensor.__rmatmul__ = lambda self, o: _m.matmul(o if isinstance(o, Tensor) else to_tensor(o), self)
+    Tensor.__neg__ = lambda self: _m.neg(self)
+    Tensor.__abs__ = lambda self: _m.abs(self)
+    Tensor.__invert__ = lambda self: _lg.logical_not(self) if self.dtype == jnp.bool_ else _lg.bitwise_not(self)
+    Tensor.__eq__ = lambda self, o: _lg.equal(self, o)
+    Tensor.__ne__ = lambda self, o: _lg.not_equal(self, o)
+    Tensor.__lt__ = lambda self, o: _lg.less_than(self, o)
+    Tensor.__le__ = lambda self, o: _lg.less_equal(self, o)
+    Tensor.__gt__ = lambda self, o: _lg.greater_than(self, o)
+    Tensor.__ge__ = lambda self, o: _lg.greater_equal(self, o)
+    Tensor.__and__ = lambda self, o: _lg.logical_and(self, o) if self.dtype == jnp.bool_ else _lg.bitwise_and(self, o)
+    Tensor.__or__ = lambda self, o: _lg.logical_or(self, o) if self.dtype == jnp.bool_ else _lg.bitwise_or(self, o)
+    Tensor.__xor__ = lambda self, o: _lg.logical_xor(self, o) if self.dtype == jnp.bool_ else _lg.bitwise_xor(self, o)
+
+
+_patch()

@@ -1,0 +1,33 @@
+"""Tensor attribute queries (ref: python/paddle/tensor/attribute.py (U))."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .creation import _as_t
+
+
+def shape(x):
+    from .creation import to_tensor as _tt
+    return Tensor(jnp.asarray(_as_t(x).shape, dtype=jnp.int64)) if False else Tensor(jnp.asarray(_as_t(x).shape))
+
+
+def rank(x):
+    return Tensor(jnp.asarray(_as_t(x).ndim))
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(_as_t(x).size))
+
+
+def is_complex(x):
+    return jnp.issubdtype(_as_t(x).dtype, jnp.complexfloating)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(_as_t(x).dtype, jnp.floating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(_as_t(x).dtype, jnp.integer)

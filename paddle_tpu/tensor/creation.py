@@ -1,0 +1,161 @@
+"""Tensor creation ops (ref: python/paddle/tensor/creation.py (U))."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, to_tensor
+from ..core.dtype import to_jax_dtype, get_default_dtype
+from ..core.op_call import apply
+
+
+def _dt(dtype, default=None):
+    if dtype is None:
+        return default if default is not None else get_default_dtype()
+    return to_jax_dtype(dtype)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if dtype is None:
+        if isinstance(fill_value, bool):
+            dtype = jnp.bool_
+        elif isinstance(fill_value, int):
+            dtype = jnp.int32
+        else:
+            dtype = get_default_dtype()
+    else:
+        dtype = to_jax_dtype(dtype)
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    return Tensor(jnp.full(_shape(shape), fill_value, dtype))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return apply(lambda a: jnp.zeros_like(a, dtype=_dt(dtype, a.dtype) if dtype else None), _as_t(x))
+
+
+def ones_like(x, dtype=None, name=None):
+    return apply(lambda a: jnp.ones_like(a, dtype=_dt(dtype, a.dtype) if dtype else None), _as_t(x))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return apply(lambda a: jnp.full_like(a, fill_value, dtype=_dt(dtype, a.dtype) if dtype else None), _as_t(x))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    for v in (start, end, step):
+        if isinstance(v, Tensor):
+            raise TypeError("arange bounds must be python scalars")
+    if dtype is None:
+        # int32 is the TPU-native integer width (x64 stays disabled)
+        dtype = jnp.int32 if all(isinstance(v, (int, type(None))) for v in (start, end, step)) else get_default_dtype()
+    else:
+        dtype = to_jax_dtype(dtype)
+    if end is None:
+        start, end = 0, start
+    return Tensor(jnp.arange(start, end, step, dtype=dtype))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return Tensor(jnp.linspace(start, stop, int(num), dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(start, stop, int(num), base=base, dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+def meshgrid(*args, **kwargs):
+    args = [_as_t(a) for a in (args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args)]
+    outs = jnp.meshgrid(*[a._data for a in args], indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+def tril(x, diagonal=0, name=None):
+    return apply(lambda a: jnp.tril(a, k=diagonal), _as_t(x))
+
+
+def triu(x, diagonal=0, name=None):
+    return apply(lambda a: jnp.triu(a, k=diagonal), _as_t(x))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    x = _as_t(x)
+    if x.ndim == 1 and padding_value != 0:
+        def f(a):
+            n = a.shape[0] + abs(offset)
+            base = jnp.full((n, n), padding_value, a.dtype)
+            return base + jnp.diagflat(a - padding_value, k=offset) * 0 + (jnp.diagflat(a, k=offset) - jnp.diagflat(jnp.full_like(a, padding_value), k=offset))
+        return apply(f, x)
+    return apply(lambda a: jnp.diag(a, k=offset), x)
+
+
+def diagflat(x, offset=0, name=None):
+    return apply(lambda a: jnp.diagflat(a, k=offset), _as_t(x))
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    def f(a):
+        out = jnp.zeros(a.shape + (a.shape[-1] + abs(offset),), a.dtype)
+        idx = jnp.arange(a.shape[-1])
+        out = out.at[..., idx, idx + max(offset, 0)].set(a) if offset >= 0 else out.at[..., idx - offset, idx].set(a)
+        # embed into (dim1, dim2): default trailing two dims
+        return out
+    return apply(f, _as_t(x))
+
+
+def assign(x, output=None):
+    x = _as_t(x)
+    out = apply(lambda a: a + 0, x)
+    if output is not None:
+        output.set_value(out._data)
+        return output
+    return out
+
+
+def clone(x, name=None):
+    return _as_t(x).clone()
+
+
+def one_hot(x, num_classes, name=None):
+    return apply(lambda a: jnp.eye(num_classes, dtype=get_default_dtype())[a.astype(jnp.int32)], _as_t(x))
+
+
+def complex(real, imag, name=None):
+    return apply(lambda r, i: r + 1j * i, _as_t(real), _as_t(imag))
+
+
+def polar(abs_, angle, name=None):
+    return apply(lambda r, t: r * jnp.exp(1j * t), _as_t(abs_), _as_t(angle))
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._data))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._data) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def _as_t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)

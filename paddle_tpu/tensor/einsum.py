@@ -1,0 +1,14 @@
+"""einsum (ref: python/paddle/tensor/einsum.py (U)) — delegates to jnp.einsum,
+which XLA maps straight onto MXU contractions."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.op_call import apply
+from .creation import _as_t
+
+
+def einsum(equation, *operands):
+    ts = [_as_t(o) for o in operands]
+    return apply(lambda *xs: jnp.einsum(equation, *xs), *ts, _op_name="einsum")

@@ -1,0 +1,189 @@
+"""Linear algebra (ref: python/paddle/tensor/linalg.py (U)) over jnp.linalg.
+
+Note: on TPU most decompositions (svd/qr/eigh) lower to XLA's host-offloaded
+or polynomial implementations; fine for the API surface, not a perf path.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.op_call import apply
+from .creation import _as_t
+from .math import matmul, dot, cross  # re-exported by paddle.linalg
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    def f(a):
+        if axis is None and p is None:
+            return jnp.linalg.norm(a.reshape(-1))
+        if axis is None:
+            return jnp.linalg.norm(a.reshape(-1), ord=p)
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        o = p if p is not None else (2 if isinstance(ax, int) else "fro")
+        if o == "fro" and isinstance(ax, int):
+            o = 2
+        return jnp.linalg.norm(a, ord=o, axis=ax, keepdims=keepdim)
+
+    return apply(f, _as_t(x), _op_name="norm")
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return apply(lambda a: jnp.linalg.vector_norm(a, ord=p, axis=ax, keepdims=keepdim), _as_t(x))
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    return apply(lambda a: jnp.linalg.matrix_norm(a, ord=p, keepdims=keepdim), _as_t(x))
+
+
+def cond(x, p=None, name=None):
+    return apply(lambda a: jnp.linalg.cond(a, p=p), _as_t(x))
+
+
+def det(x, name=None):
+    return apply(jnp.linalg.det, _as_t(x))
+
+
+def slogdet(x, name=None):
+    def f(a):
+        sign, logdet = jnp.linalg.slogdet(a)
+        return jnp.stack([sign, logdet])
+
+    return apply(f, _as_t(x))
+
+
+def inv(x, name=None):
+    return apply(jnp.linalg.inv, _as_t(x))
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply(lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian), _as_t(x))
+
+
+def svd(x, full_matrices=False, name=None):
+    out = apply(lambda a: jnp.linalg.svd(a, full_matrices=full_matrices), _as_t(x))
+    return out[0], out[1], out[2]
+
+
+def svdvals(x, name=None):
+    return apply(lambda a: jnp.linalg.svd(a, compute_uv=False), _as_t(x))
+
+
+def qr(x, mode="reduced", name=None):
+    out = apply(lambda a: jnp.linalg.qr(a, mode=mode), _as_t(x))
+    return (out[0], out[1]) if mode != "r" else out
+
+
+def eig(x, name=None):
+    import numpy as np
+
+    # jnp.linalg.eig is CPU-only in jax; route via numpy eagerly (API parity)
+    w, v = np.linalg.eig(np.asarray(_as_t(x)._data))
+    return Tensor(w), Tensor(v)
+
+
+def eigh(x, UPLO="L", name=None):
+    out = apply(lambda a: jnp.linalg.eigh(a, UPLO=UPLO), _as_t(x))
+    return out[0], out[1]
+
+
+def eigvals(x, name=None):
+    import numpy as np
+
+    return Tensor(np.linalg.eigvals(np.asarray(_as_t(x)._data)))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply(lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), _as_t(x))
+
+
+def cholesky(x, upper=False, name=None):
+    def f(a):
+        l = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(l, -1, -2).conj() if upper else l
+
+    return apply(f, _as_t(x))
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    from jax.scipy.linalg import cho_solve
+
+    return apply(lambda b, c: cho_solve((c, not upper), b), _as_t(x), _as_t(y))
+
+
+def solve(x, y, name=None):
+    return apply(jnp.linalg.solve, _as_t(x), _as_t(y))
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    from jax.scipy.linalg import solve_triangular
+
+    return apply(
+        lambda a, b: solve_triangular(a, b, lower=not upper, trans=1 if transpose else 0, unit_diagonal=unitriangular),
+        _as_t(x), _as_t(y),
+    )
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    out = apply(lambda a, b: jnp.linalg.lstsq(a, b, rcond=rcond), _as_t(x), _as_t(y))
+    return tuple(out)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    from jax.scipy.linalg import lu_factor
+
+    out = apply(lambda a: lu_factor(a), _as_t(x))
+    lu_mat, piv = out[0], out[1]
+    if get_infos:
+        from .creation import zeros
+
+        return lu_mat, piv, zeros([1], dtype="int32")
+    return lu_mat, piv
+
+
+def matrix_power(x, n, name=None):
+    return apply(lambda a: jnp.linalg.matrix_power(a, n), _as_t(x))
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return apply(lambda a: jnp.linalg.matrix_rank(a, rtol=tol), _as_t(x))
+
+
+def multi_dot(x, name=None):
+    ts = [_as_t(t) for t in x]
+    return apply(lambda *xs: jnp.linalg.multi_dot(xs), *ts)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    x = _as_t(x)
+    qv = q if q is not None else min(6, x.shape[-2], x.shape[-1])
+
+    def f(a):
+        m = a - a.mean(axis=-2, keepdims=True) if center else a
+        u, s, vt = jnp.linalg.svd(m, full_matrices=False)
+        return u[..., :qv], s[..., :qv], jnp.swapaxes(vt, -1, -2)[..., :qv]
+
+    out = apply(f, x)
+    return out[0], out[1], out[2]
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply(lambda a: jnp.corrcoef(a, rowvar=rowvar), _as_t(x))
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return apply(lambda a: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0), _as_t(x))
+
+
+def householder_product(x, tau, name=None):
+    def f(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        q = jnp.eye(m, dtype=a.dtype)
+        for i in range(t.shape[-1]):
+            v = jnp.concatenate([jnp.zeros(i, a.dtype), jnp.ones(1, a.dtype), a[i + 1:, i]])
+            q = q @ (jnp.eye(m, dtype=a.dtype) - t[i] * jnp.outer(v, v))
+        return q[:, :n]
+
+    return apply(f, _as_t(x), _as_t(tau))

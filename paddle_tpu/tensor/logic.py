@@ -1,0 +1,102 @@
+"""Comparison / logical / bitwise ops (ref: python/paddle/tensor/logic.py (U))."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.op_call import apply
+from .creation import _as_t
+
+
+def _cmp(fn, x, y):
+    x = _as_t(x)
+    if isinstance(y, Tensor):
+        return apply(fn, x.detach(), y.detach())
+    return apply(lambda a: fn(a, y), x.detach())
+
+
+def equal(x, y, name=None):
+    return _cmp(jnp.equal, x, y)
+
+
+def not_equal(x, y, name=None):
+    return _cmp(jnp.not_equal, x, y)
+
+
+def greater_than(x, y, name=None):
+    return _cmp(jnp.greater, x, y)
+
+
+def greater_equal(x, y, name=None):
+    return _cmp(jnp.greater_equal, x, y)
+
+
+def less_than(x, y, name=None):
+    return _cmp(jnp.less, x, y)
+
+
+def less_equal(x, y, name=None):
+    return _cmp(jnp.less_equal, x, y)
+
+
+def logical_and(x, y, out=None, name=None):
+    return _cmp(jnp.logical_and, x, y)
+
+
+def logical_or(x, y, out=None, name=None):
+    return _cmp(jnp.logical_or, x, y)
+
+
+def logical_xor(x, y, out=None, name=None):
+    return _cmp(jnp.logical_xor, x, y)
+
+
+def logical_not(x, out=None, name=None):
+    return apply(jnp.logical_not, _as_t(x).detach())
+
+
+def bitwise_and(x, y, out=None, name=None):
+    return _cmp(jnp.bitwise_and, x, y)
+
+
+def bitwise_or(x, y, out=None, name=None):
+    return _cmp(jnp.bitwise_or, x, y)
+
+
+def bitwise_xor(x, y, out=None, name=None):
+    return _cmp(jnp.bitwise_xor, x, y)
+
+
+def bitwise_not(x, out=None, name=None):
+    return apply(jnp.bitwise_not, _as_t(x).detach())
+
+
+def bitwise_left_shift(x, y, is_arithmetic=True, out=None, name=None):
+    return _cmp(jnp.left_shift, x, y)
+
+
+def bitwise_right_shift(x, y, is_arithmetic=True, out=None, name=None):
+    return _cmp(jnp.right_shift, x, y)
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return apply(lambda a: jnp.all(a, axis=ax, keepdims=keepdim), _as_t(x).detach())
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return apply(lambda a: jnp.any(a, axis=ax, keepdims=keepdim), _as_t(x).detach())
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(_as_t(x).size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def in_dynamic_mode():
+    return True

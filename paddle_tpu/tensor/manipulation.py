@@ -1,0 +1,440 @@
+"""Shape/layout manipulation ops (ref: python/paddle/tensor/manipulation.py (U)).
+
+All static-shape ops here are jit-safe; the data-dependent ones (masked_select,
+nonzero, unique) are eager-only — under `to_static` use their fixed-size
+variants (where with fill, topk) as the reference's to_static guide also does.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor
+from ..core.op_call import apply
+from .creation import _as_t
+
+
+def _ints(v):
+    if isinstance(v, Tensor):
+        return tuple(int(s) for s in np.asarray(v._data).reshape(-1))
+    if isinstance(v, (int, np.integer)):
+        return int(v)
+    return tuple(int(s._data) if isinstance(s, Tensor) else int(s) for s in v)
+
+
+def reshape(x, shape, name=None):
+    shape = _ints(shape)
+    return apply(lambda a: jnp.reshape(a, shape), _as_t(x), _op_name="reshape")
+
+
+def reshape_(x, shape, name=None):
+    x._data = jnp.reshape(x._data, _ints(shape))
+    return x
+
+
+def transpose(x, perm, name=None):
+    perm = _ints(perm)
+    return apply(lambda a: jnp.transpose(a, perm), _as_t(x), _op_name="transpose")
+
+
+def t(x, name=None):
+    x = _as_t(x)
+    if x.ndim < 2:
+        return x.clone()
+    return apply(lambda a: a.T, x, _op_name="t")
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply(lambda a: jnp.moveaxis(a, source, destination), _as_t(x))
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return apply(lambda a: jnp.swapaxes(a, axis0, axis1), _as_t(x))
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    x = _as_t(x)
+    nd = x.ndim
+    sa = start_axis % nd if nd else 0
+    ea = stop_axis % nd if nd else 0
+
+    def f(a):
+        shape = a.shape[:sa] + (-1,) + a.shape[ea + 1:]
+        return jnp.reshape(a, shape)
+
+    return apply(f, x, _op_name="flatten")
+
+
+def squeeze(x, axis=None, name=None):
+    if axis is not None:
+        axis = _ints(axis)
+        if isinstance(axis, int):
+            axis = (axis,)
+        axis = tuple(a for a in axis)
+
+    def f(a):
+        if axis is None:
+            return jnp.squeeze(a)
+        ax = tuple(a2 % a.ndim for a2 in axis if a.shape[a2 % a.ndim] == 1)
+        return jnp.squeeze(a, axis=ax) if ax else a
+
+    return apply(f, _as_t(x), _op_name="squeeze")
+
+
+def unsqueeze(x, axis, name=None):
+    axis = _ints(axis)
+    return apply(lambda a: jnp.expand_dims(a, axis), _as_t(x), _op_name="unsqueeze")
+
+
+def concat(x, axis=0, name=None):
+    ts = [_as_t(t) for t in x]
+    ax = int(axis._data) if isinstance(axis, Tensor) else int(axis)
+    return apply(lambda *xs: jnp.concatenate(xs, axis=ax), *ts, _op_name="concat")
+
+
+def stack(x, axis=0, name=None):
+    ts = [_as_t(t) for t in x]
+    return apply(lambda *xs: jnp.stack(xs, axis=axis), *ts, _op_name="stack")
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = _as_t(x)
+    ax = int(axis._data) if isinstance(axis, Tensor) else int(axis)
+    n = x.shape[ax]
+    if isinstance(num_or_sections, int):
+        if n % num_or_sections != 0:
+            raise ValueError(
+                f"split: axis {ax} length {n} is not divisible by num_or_sections={num_or_sections}"
+            )
+        sizes = [n // num_or_sections] * num_or_sections
+    else:
+        sizes = [int(s) for s in num_or_sections]
+        neg = [i for i, s in enumerate(sizes) if s < 0]
+        if neg:
+            sizes[neg[0]] = n - sum(s for s in sizes if s >= 0)
+    offsets = np.cumsum([0] + sizes[:-1])
+
+    def f(a):
+        return tuple(lax.slice_in_dim(a, int(o), int(o + s), axis=ax) for o, s in zip(offsets, sizes))
+
+    return list(apply(f, x, _op_name="split"))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0, name=None):
+    x = _as_t(x)
+    n = x.shape[axis]
+    outs = split(x, n, axis)
+    return [squeeze(o, axis) for o in outs]
+
+
+unstack = unbind
+
+
+def tile(x, repeat_times, name=None):
+    rt = _ints(repeat_times)
+    return apply(lambda a: jnp.tile(a, rt), _as_t(x), _op_name="tile")
+
+
+def expand(x, shape, name=None):
+    shape = _ints(shape)
+    x = _as_t(x)
+
+    def f(a):
+        tgt = list(shape)
+        # paddle allows -1 meaning "keep this dim"
+        off = len(tgt) - a.ndim
+        for i in range(len(tgt)):
+            if tgt[i] == -1:
+                tgt[i] = a.shape[i - off] if i >= off else 1
+        return jnp.broadcast_to(a, tgt)
+
+    return apply(f, x, _op_name="expand")
+
+
+def expand_as(x, y, name=None):
+    return apply(lambda a, b: jnp.broadcast_to(a, b.shape), _as_t(x), _as_t(y).detach(), _op_name="expand_as")
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    ts = [_as_t(t) for t in inputs]
+    outs = apply(lambda *xs: tuple(jnp.broadcast_arrays(*xs)), *ts)
+    return list(outs)
+
+
+def flip(x, axis, name=None):
+    ax = _ints(axis)
+    return apply(lambda a: jnp.flip(a, axis=ax), _as_t(x), _op_name="flip")
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply(lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), _as_t(x))
+
+
+def roll(x, shifts, axis=None, name=None):
+    sh = _ints(shifts)
+    ax = _ints(axis) if axis is not None else None
+    return apply(lambda a: jnp.roll(a, sh, axis=ax), _as_t(x), _op_name="roll")
+
+
+def gather(x, index, axis=0, name=None):
+    ax = int(axis._data) if isinstance(axis, Tensor) else int(axis)
+    return apply(lambda a, i: jnp.take(a, i.astype(jnp.int32), axis=ax), _as_t(x), _as_t(index), _op_name="gather")
+
+
+def gather_nd(x, index, name=None):
+    def f(a, i):
+        i = i.astype(jnp.int32)
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return a[idx]
+
+    return apply(f, _as_t(x), _as_t(index), _op_name="gather_nd")
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def f(a, i, u):
+        i = i.astype(jnp.int32).reshape(-1)
+        if overwrite:
+            return a.at[i].set(u)
+        return a.at[i].set(0).at[i].add(u)
+
+    return apply(f, _as_t(x), _as_t(index), _as_t(updates), _op_name="scatter")
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    out = scatter(x, index, updates, overwrite)
+    x._data = out._data
+    return x
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def f(a, i, u):
+        i = i.astype(jnp.int32)
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return a.at[idx].add(u)
+
+    return apply(f, _as_t(x), _as_t(index), _as_t(updates), _op_name="scatter_nd_add")
+
+
+def scatter_nd(index, updates, shape, name=None):
+    from .creation import zeros
+
+    base = zeros(shape, dtype=_as_t(updates).dtype)
+    return scatter_nd_add(base, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    return gather(x, index, axis)
+
+
+def index_sample(x, index, name=None):
+    def f(a, i):
+        rows = jnp.arange(a.shape[0])[:, None]
+        return a[rows, i.astype(jnp.int32)]
+
+    return apply(f, _as_t(x), _as_t(index), _op_name="index_sample")
+
+
+def index_add(x, index, axis, value, name=None):
+    def f(a, i, v):
+        i = i.astype(jnp.int32)
+        am = jnp.moveaxis(a, axis, 0)
+        vm = jnp.moveaxis(v, axis, 0)
+        return jnp.moveaxis(am.at[i].add(vm), 0, axis)
+
+    return apply(f, _as_t(x), _as_t(index), _as_t(value), _op_name="index_add")
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    def f(a, v, *idx):
+        idx = tuple(i.astype(jnp.int32) if jnp.issubdtype(i.dtype, jnp.integer) else i for i in idx)
+        return a.at[idx].add(v) if accumulate else a.at[idx].set(v)
+
+    return apply(f, _as_t(x), _as_t(value), *[_as_t(i) for i in indices], _op_name="index_put")
+
+
+def masked_select(x, mask, name=None):
+    # data-dependent shape: eager only
+    x, mask = _as_t(x), _as_t(mask)
+    return Tensor(x._data[np.asarray(mask._data)])
+
+
+def masked_fill(x, mask, value, name=None):
+    v = value._data if isinstance(value, Tensor) else value
+    return apply(lambda a, m: jnp.where(m, v, a), _as_t(x), _as_t(mask).detach(), _op_name="masked_fill")
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    return apply(lambda a, i: jnp.take_along_axis(a, i.astype(jnp.int32), axis=axis), _as_t(arr), _as_t(indices), _op_name="take_along_axis")
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    def f(a, i, v):
+        i = i.astype(jnp.int32)
+        v = jnp.broadcast_to(v, i.shape) if not hasattr(v, "shape") or v.shape != i.shape else v
+        dims = []
+        for d in range(a.ndim):
+            if d == axis:
+                dims.append(i)
+            else:
+                shape = [1] * a.ndim
+                shape[d] = a.shape[d]
+                dims.append(jnp.broadcast_to(jnp.arange(a.shape[d]).reshape(shape), i.shape))
+        idx = tuple(dims)
+        if reduce == "add":
+            return a.at[idx].add(v)
+        if reduce in ("mul", "multiply"):
+            return a.at[idx].multiply(v)
+        return a.at[idx].set(v)
+
+    return apply(f, _as_t(arr), _as_t(indices), _as_t(values), _op_name="put_along_axis")
+
+
+def take(x, index, mode="raise", name=None):
+    m = {"raise": "clip", "wrap": "wrap", "clip": "clip"}[mode]
+    return apply(lambda a, i: jnp.take(a.reshape(-1), i.astype(jnp.int32), mode=m), _as_t(x), _as_t(index))
+
+
+def slice(input, axes, starts, ends, name=None):
+    axes = _ints(axes)
+    starts = _ints(starts)
+    ends = _ints(ends)
+
+    def f(a):
+        out = a
+        for ax, st, en in zip(axes, starts, ends):
+            n = a.shape[ax]
+            st2 = max(st + n, 0) if st < 0 else min(st, n)
+            en2 = max(en + n, 0) if en < 0 else min(en, n)
+            out = lax.slice_in_dim(out, st2, en2, axis=ax)
+        return out
+
+    return apply(f, _as_t(input), _op_name="slice")
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    import builtins
+
+    axes, starts, ends, strides = _ints(axes), _ints(starts), _ints(ends), _ints(strides)
+
+    def f(a):
+        idx = [builtins.slice(None)] * a.ndim
+        for ax, st, en, sd in zip(axes, starts, ends, strides):
+            idx[ax] = builtins.slice(st, en, sd)
+        return a[tuple(idx)]
+
+    return apply(f, _as_t(x), _op_name="strided_slice")
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    r = repeats._data if isinstance(repeats, Tensor) else repeats
+    return apply(lambda a: jnp.repeat(a, r, axis=axis), _as_t(x), _op_name="repeat_interleave")
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    # data-dependent: eager only
+    x = _as_t(x)
+    res = np.unique(
+        np.asarray(x._data), return_index=return_index, return_inverse=return_inverse,
+        return_counts=return_counts, axis=axis,
+    )
+    if not isinstance(res, tuple):
+        return Tensor(res)
+    return tuple(Tensor(r) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    x = np.asarray(_as_t(x)._data)
+    if axis is None:
+        x = x.reshape(-1)
+    keep = np.concatenate([[True], x[1:] != x[:-1]]) if x.ndim == 1 else None
+    out = x[keep]
+    rets = [Tensor(out)]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        rets.append(Tensor(inv))
+    if return_counts:
+        idx = np.nonzero(keep)[0]
+        counts = np.diff(np.append(idx, len(x)))
+        rets.append(Tensor(counts))
+    return rets[0] if len(rets) == 1 else tuple(rets)
+
+
+def nonzero(x, as_tuple=False, name=None):
+    x = _as_t(x)
+    nz = np.nonzero(np.asarray(x._data))
+    if as_tuple:
+        return tuple(Tensor(n) for n in nz)
+    return Tensor(np.stack(nz, axis=-1).astype(np.int64))
+
+
+def where(condition, x=None, y=None, name=None):
+    cond = _as_t(condition)
+    if x is None and y is None:
+        return nonzero(cond, as_tuple=True)
+    xv = x if isinstance(x, Tensor) else _as_t(x)
+    yv = y if isinstance(y, Tensor) else _as_t(y)
+    return apply(lambda c, a, b: jnp.where(c, a, b), cond.detach(), xv, yv, _op_name="where")
+
+
+def as_complex(x, name=None):
+    return apply(lambda a: lax.complex(a[..., 0], a[..., 1]), _as_t(x))
+
+
+def as_real(x, name=None):
+    return apply(lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1), _as_t(x))
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return _as_t(x).astype(shape_or_dtype)
+
+
+def view_as(x, other, name=None):
+    return reshape(x, _as_t(other).shape)
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [apply(jnp.atleast_1d, _as_t(t)) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [apply(jnp.atleast_2d, _as_t(t)) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [apply(jnp.atleast_3d, _as_t(t)) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def tensordot(x, y, axes=2, name=None):
+    ax = axes
+    if isinstance(ax, Tensor):
+        ax = _ints(ax)
+    return apply(lambda a, b: jnp.tensordot(a, b, axes=ax), _as_t(x), _as_t(y))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    size = index_num // nshards
+
+    def f(i):
+        in_shard = (i // size) == shard_id
+        return jnp.where(in_shard, i % size, ignore_value)
+
+    return apply(f, _as_t(input))
+
+
+def cast(x, dtype):
+    return _as_t(x).astype(dtype)

@@ -1,0 +1,540 @@
+"""Math ops (ref: python/paddle/tensor/math.py (U)) over jnp — XLA fuses the
+elementwise chains into surrounding matmuls on TPU, so these stay unfused here."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor, to_tensor
+from ..core.op_call import apply
+from .creation import _as_t
+
+
+def _b(x):
+    """Coerce binary operand: Tensor passes through, scalars stay raw (jnp broadcasts)."""
+    return x if isinstance(x, Tensor) else x
+
+
+def _binary(fn, x, y, name):
+    x = _as_t(x) if not isinstance(x, Tensor) else x
+    if isinstance(y, Tensor):
+        return apply(fn, x, y, _op_name=name)
+    return apply(lambda a: fn(a, y), x, _op_name=name)
+
+
+def _rbinary(fn, x, y, name):
+    # y op x with x Tensor
+    return apply(lambda a: fn(y, a), x, _op_name=name)
+
+
+def _unary(fn, x, name=None):
+    return apply(fn, _as_t(x), _op_name=name or fn.__name__)
+
+
+# ----- elementwise binary -----
+def add(x, y, name=None):
+    return _binary(jnp.add, x, y, "add")
+
+
+def subtract(x, y, name=None):
+    return _binary(jnp.subtract, x, y, "subtract")
+
+
+def multiply(x, y, name=None):
+    return _binary(jnp.multiply, x, y, "multiply")
+
+
+def divide(x, y, name=None):
+    return _binary(jnp.true_divide, x, y, "divide")
+
+
+def floor_divide(x, y, name=None):
+    return _binary(jnp.floor_divide, x, y, "floor_divide")
+
+
+def remainder(x, y, name=None):
+    return _binary(jnp.remainder, x, y, "remainder")
+
+
+mod = remainder
+floor_mod = remainder
+
+
+def pow(x, y, name=None):
+    return _binary(jnp.power, x, y, "pow")
+
+
+def maximum(x, y, name=None):
+    return _binary(jnp.maximum, x, y, "maximum")
+
+
+def minimum(x, y, name=None):
+    return _binary(jnp.minimum, x, y, "minimum")
+
+
+def fmax(x, y, name=None):
+    return _binary(jnp.fmax, x, y, "fmax")
+
+
+def fmin(x, y, name=None):
+    return _binary(jnp.fmin, x, y, "fmin")
+
+
+def atan2(x, y, name=None):
+    return _binary(jnp.arctan2, x, y, "atan2")
+
+
+def hypot(x, y, name=None):
+    return _binary(jnp.hypot, x, y, "hypot")
+
+
+def gcd(x, y, name=None):
+    return _binary(jnp.gcd, x, y, "gcd")
+
+
+def lcm(x, y, name=None):
+    return _binary(jnp.lcm, x, y, "lcm")
+
+
+def heaviside(x, y, name=None):
+    return _binary(jnp.heaviside, x, y, "heaviside")
+
+
+def nextafter(x, y, name=None):
+    return _binary(jnp.nextafter, x, y, "nextafter")
+
+
+def copysign(x, y, name=None):
+    return _binary(jnp.copysign, x, y, "copysign")
+
+
+def ldexp(x, y, name=None):
+    return _binary(lambda a, b: a * (2.0 ** b), x, y, "ldexp")
+
+
+def logaddexp(x, y, name=None):
+    return _binary(jnp.logaddexp, x, y, "logaddexp")
+
+
+# ----- elementwise unary -----
+def sqrt(x, name=None):
+    return _unary(jnp.sqrt, x)
+
+
+def rsqrt(x, name=None):
+    return _unary(lax.rsqrt, x, "rsqrt")
+
+
+def square(x, name=None):
+    return _unary(jnp.square, x)
+
+
+def exp(x, name=None):
+    return _unary(jnp.exp, x)
+
+
+def expm1(x, name=None):
+    return _unary(jnp.expm1, x)
+
+
+def log(x, name=None):
+    return _unary(jnp.log, x)
+
+
+def log2(x, name=None):
+    return _unary(jnp.log2, x)
+
+
+def log10(x, name=None):
+    return _unary(jnp.log10, x)
+
+
+def log1p(x, name=None):
+    return _unary(jnp.log1p, x)
+
+
+def abs(x, name=None):
+    return _unary(jnp.abs, x)
+
+
+def neg(x, name=None):
+    return _unary(jnp.negative, x, "neg")
+
+
+negative = neg
+
+
+def sign(x, name=None):
+    return _unary(jnp.sign, x)
+
+
+def sgn(x, name=None):
+    return _unary(jnp.sign, x)
+
+
+def sin(x, name=None):
+    return _unary(jnp.sin, x)
+
+
+def cos(x, name=None):
+    return _unary(jnp.cos, x)
+
+
+def tan(x, name=None):
+    return _unary(jnp.tan, x)
+
+
+def asin(x, name=None):
+    return _unary(jnp.arcsin, x, "asin")
+
+
+def acos(x, name=None):
+    return _unary(jnp.arccos, x, "acos")
+
+
+def atan(x, name=None):
+    return _unary(jnp.arctan, x, "atan")
+
+
+def sinh(x, name=None):
+    return _unary(jnp.sinh, x)
+
+
+def cosh(x, name=None):
+    return _unary(jnp.cosh, x)
+
+
+def tanh(x, name=None):
+    return _unary(jnp.tanh, x)
+
+
+def asinh(x, name=None):
+    return _unary(jnp.arcsinh, x, "asinh")
+
+
+def acosh(x, name=None):
+    return _unary(jnp.arccosh, x, "acosh")
+
+
+def atanh(x, name=None):
+    return _unary(jnp.arctanh, x, "atanh")
+
+
+def floor(x, name=None):
+    return _unary(jnp.floor, x)
+
+
+def ceil(x, name=None):
+    return _unary(jnp.ceil, x)
+
+
+def round(x, name=None):
+    return _unary(jnp.round, x)
+
+
+def trunc(x, name=None):
+    return _unary(jnp.trunc, x)
+
+
+def frac(x, name=None):
+    return _unary(lambda a: a - jnp.trunc(a), x, "frac")
+
+
+def reciprocal(x, name=None):
+    return _unary(jnp.reciprocal, x)
+
+
+def sigmoid(x, name=None):
+    return _unary(jax.nn.sigmoid, x, "sigmoid")
+
+
+def logsigmoid(x, name=None):
+    return _unary(jax.nn.log_sigmoid, x, "logsigmoid")
+
+
+def erf(x, name=None):
+    return _unary(jax.scipy.special.erf, x, "erf")
+
+
+def erfinv(x, name=None):
+    return _unary(jax.scipy.special.erfinv, x, "erfinv")
+
+
+def lgamma(x, name=None):
+    return _unary(jax.scipy.special.gammaln, x, "lgamma")
+
+
+def digamma(x, name=None):
+    return _unary(jax.scipy.special.digamma, x, "digamma")
+
+
+def i0(x, name=None):
+    return _unary(jnp.i0, x)
+
+
+def angle(x, name=None):
+    return _unary(jnp.angle, x)
+
+
+def conj(x, name=None):
+    return _unary(jnp.conj, x)
+
+
+def real(x, name=None):
+    return _unary(jnp.real, x)
+
+
+def imag(x, name=None):
+    return _unary(jnp.imag, x)
+
+
+def deg2rad(x, name=None):
+    return _unary(jnp.deg2rad, x)
+
+
+def rad2deg(x, name=None):
+    return _unary(jnp.rad2deg, x)
+
+
+def isnan(x, name=None):
+    return _unary(jnp.isnan, x)
+
+
+def isinf(x, name=None):
+    return _unary(jnp.isinf, x)
+
+
+def isfinite(x, name=None):
+    return _unary(jnp.isfinite, x)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return _unary(lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf), x, "nan_to_num")
+
+
+def clip(x, min=None, max=None, name=None):
+    lo = min._data if isinstance(min, Tensor) else min
+    hi = max._data if isinstance(max, Tensor) else max
+    return _unary(lambda a: jnp.clip(a, lo, hi), x, "clip")
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s = scale._data if isinstance(scale, Tensor) else scale
+    if bias_after_scale:
+        out = _unary(lambda a: a * s + bias, x, "scale")
+    else:
+        out = _unary(lambda a: (a + bias) * s, x, "scale")
+    return out
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return _unary(lambda a: scale_b * jnp.tanh(scale_a * a), x, "stanh")
+
+
+def lerp(x, y, weight, name=None):
+    w = weight._data if isinstance(weight, Tensor) else weight
+    return apply(lambda a, b: a + w * (b - a), _as_t(x), _as_t(y), _op_name="lerp")
+
+
+# ----- reductions -----
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        return tuple(int(a) for a in axis.numpy().reshape(-1))
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    from ..core.dtype import to_jax_dtype
+
+    jd = to_jax_dtype(dtype) if dtype else None
+    return _unary(lambda a: jnp.sum(a, axis=_axis(axis), dtype=jd, keepdims=keepdim), x, "sum")
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    return _unary(lambda a: jnp.nansum(a, axis=_axis(axis), keepdims=keepdim), x, "nansum")
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return _unary(lambda a: jnp.mean(a, axis=_axis(axis), keepdims=keepdim), x, "mean")
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return _unary(lambda a: jnp.nanmean(a, axis=_axis(axis), keepdims=keepdim), x, "nanmean")
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return _unary(lambda a: jnp.max(a, axis=_axis(axis), keepdims=keepdim), x, "max")
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return _unary(lambda a: jnp.min(a, axis=_axis(axis), keepdims=keepdim), x, "min")
+
+
+def amax(x, axis=None, keepdim=False, name=None):
+    return max(x, axis, keepdim)
+
+
+def amin(x, axis=None, keepdim=False, name=None):
+    return min(x, axis, keepdim)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    return _unary(lambda a: jnp.prod(a, axis=_axis(axis), keepdims=keepdim), x, "prod")
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return _unary(lambda a: jnp.std(a, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim), x, "std")
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return _unary(lambda a: jnp.var(a, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim), x, "var")
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return _unary(lambda a: jax.scipy.special.logsumexp(a, axis=_axis(axis), keepdims=keepdim), x, "logsumexp")
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    return _unary(lambda a: jnp.cumsum(a.reshape(-1) if axis is None else a, axis=None if axis is None else _axis(axis)), x, "cumsum")
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    return _unary(lambda a: jnp.cumprod(a.reshape(-1) if dim is None else a, axis=None if dim is None else _axis(dim)), x, "cumprod")
+
+
+def _cum_extreme(x, axis, op):
+    ax = 0 if axis is None else _axis(axis)
+    x2 = x if axis is not None else _unary(lambda a: a.reshape(-1), x)
+
+    def f(a):
+        n = a.shape[ax]
+        shape = [1] * a.ndim
+        shape[ax] = n
+        idx = jnp.broadcast_to(jnp.arange(n).reshape(shape), a.shape)
+
+        def combine(c1, c2):
+            v1, i1 = c1
+            v2, i2 = c2
+            take2 = op(v2, v1)
+            return jnp.where(take2, v2, v1), jnp.where(take2, i2, i1)
+
+        return lax.associative_scan(combine, (a, idx), axis=ax)
+
+    out = apply(f, x2)
+    return out[0], out[1]
+
+
+def cummax(x, axis=None, dtype=None, name=None):
+    return _cum_extreme(x, axis, lambda a, b: a >= b)
+
+
+def cummin(x, axis=None, dtype=None, name=None):
+    return _cum_extreme(x, axis, lambda a, b: a <= b)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return _unary(lambda a: jnp.count_nonzero(a, axis=_axis(axis), keepdims=keepdim), x, "count_nonzero")
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    p = prepend._data if isinstance(prepend, Tensor) else prepend
+    ap = append._data if isinstance(append, Tensor) else append
+    return _unary(lambda a: jnp.diff(a, n=n, axis=axis, prepend=p, append=ap), x, "diff")
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return _unary(lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2), x, "trace")
+
+
+def add_n(inputs, name=None):
+    if isinstance(inputs, Tensor):
+        return inputs
+    ts = [_as_t(t) for t in inputs]
+    return apply(lambda *xs: jnp.sum(jnp.stack(xs), axis=0) if len(xs) > 1 else xs[0], *ts, _op_name="add_n")
+
+
+# ----- matmul family -----
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+
+    return apply(f, _as_t(x), _as_t(y), _op_name="matmul")
+
+
+def mm(x, y, name=None):
+    return matmul(x, y)
+
+
+def bmm(x, y, name=None):
+    return matmul(x, y)
+
+
+def dot(x, y, name=None):
+    return apply(lambda a, b: jnp.sum(a * b, axis=-1), _as_t(x), _as_t(y), _op_name="dot")
+
+
+def inner(x, y, name=None):
+    return apply(jnp.inner, _as_t(x), _as_t(y), _op_name="inner")
+
+
+def outer(x, y, name=None):
+    return apply(jnp.outer, _as_t(x), _as_t(y), _op_name="outer")
+
+
+def kron(x, y, name=None):
+    return apply(jnp.kron, _as_t(x), _as_t(y), _op_name="kron")
+
+
+def mv(x, vec, name=None):
+    return apply(jnp.matmul, _as_t(x), _as_t(vec), _op_name="mv")
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply(lambda i, a, b: beta * i + alpha * (a @ b), _as_t(input), _as_t(x), _as_t(y), _op_name="addmm")
+
+
+def cross(x, y, axis=None, name=None):
+    ax = axis if axis is not None else -1
+    return apply(lambda a, b: jnp.cross(a, b, axis=ax), _as_t(x), _as_t(y), _op_name="cross")
+
+
+# ----- comparisons that return values -----
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply(lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan), _as_t(x), _as_t(y))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply(lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan), _as_t(x), _as_t(y))
+
+
+def equal_all(x, y, name=None):
+    return apply(lambda a, b: jnp.array_equal(a, b), _as_t(x), _as_t(y))
+
+
+def increment(x, value=1.0, name=None):
+    x._data = x._data + value
+    return x
+
+
+def multiplex(inputs, index, name=None):
+    """Row r of the output comes from inputs[index[r]][r] (paddle semantics)."""
+    ts = [_as_t(t) for t in inputs]
+    idx = _as_t(index).detach()
+
+    def f(i, *xs):
+        stacked = jnp.stack(xs)  # [n_inputs, rows, ...]
+        rows = jnp.arange(stacked.shape[1])
+        sel = i.reshape(-1).astype(jnp.int32)
+        return stacked[sel, rows]
+
+    return apply(f, idx, *ts, _op_name="multiplex")

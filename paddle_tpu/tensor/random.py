@@ -1,0 +1,147 @@
+"""Random sampling ops (ref: python/paddle/tensor/random.py (U)).
+
+Stateful-looking API over jax's functional PRNG: each call pulls a fresh key
+from the global counter stream (core/random.py), which jit.to_static threads
+through compiled programs as a traced argument.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dtype import to_jax_dtype, get_default_dtype
+from ..core import random_state
+from ..core.op_call import apply
+from .creation import _shape, _as_t
+
+
+def _dt(dtype, default=None):
+    return to_jax_dtype(dtype) if dtype is not None else (default or get_default_dtype())
+
+
+def rand(shape, dtype=None, name=None):
+    return Tensor(jax.random.uniform(random_state.next_key(), _shape(shape), _dt(dtype)))
+
+
+def randn(shape, dtype=None, name=None):
+    return Tensor(jax.random.normal(random_state.next_key(), _shape(shape), _dt(dtype)))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._data if isinstance(mean, Tensor) else mean
+        s = std._data if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(getattr(m, "shape", ()), getattr(s, "shape", ()))
+        return Tensor(jax.random.normal(random_state.next_key(), shp) * s + m)
+    shp = _shape(shape) if shape is not None else ()
+    return Tensor(jax.random.normal(random_state.next_key(), shp) * std + mean)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.PRNGKey(seed) if seed else random_state.next_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), _dt(dtype), minval=min, maxval=max))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    x._data = jax.random.uniform(random_state.next_key(), tuple(x.shape), x.dtype, minval=min, maxval=max)
+    return x
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(random_state.next_key(), _shape(shape), low, high, dtype=_dt(dtype, jnp.int32)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    x = _as_t(x)
+    return randint(low, high, tuple(x.shape), dtype or str(x.dtype))
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(random_state.next_key(), n).astype(_dt(dtype, jnp.int32)))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    x = _as_t(x)
+
+    def f(a, key):
+        logits = jnp.log(jnp.maximum(a, 1e-30))
+        if replacement:
+            return jax.random.categorical(key, logits, axis=-1, shape=(num_samples,) if a.ndim == 1 else (a.shape[0], num_samples)).T if False else (
+                jax.random.categorical(key, logits[None] if a.ndim == 1 else logits, axis=-1,
+                                       shape=(num_samples, 1) if a.ndim == 1 else (num_samples, a.shape[0]))
+            )
+        # without replacement: gumbel top-k
+        g = jax.random.gumbel(key, a.shape)
+        _, idx = jax.lax.top_k(logits + g, num_samples)
+        return idx
+
+    key = random_state.next_key()
+    if replacement:
+        logits = jnp.log(jnp.maximum(x._data, 1e-30))
+        if x.ndim == 1:
+            out = jax.random.categorical(key, logits, shape=(num_samples,))
+        else:
+            out = jax.random.categorical(key, logits[:, None, :], axis=-1, shape=(x.shape[0], num_samples))
+        return Tensor(out.astype(jnp.int32))
+    g = jax.random.gumbel(key, tuple(x.shape))
+    logits = jnp.log(jnp.maximum(x._data, 1e-30))
+    _, idx = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(idx.astype(jnp.int32))
+
+
+def bernoulli(x, name=None):
+    x = _as_t(x)
+    return Tensor(jax.random.bernoulli(random_state.next_key(), x._data).astype(x.dtype))
+
+
+def bernoulli_(x, p=0.5, name=None):
+    x._data = jax.random.bernoulli(random_state.next_key(), p, tuple(x.shape)).astype(x.dtype)
+    return x
+
+
+def poisson(x, name=None):
+    x = _as_t(x)
+    return Tensor(jax.random.poisson(random_state.next_key(), x._data).astype(x.dtype))
+
+
+def exponential_(x, lam=1.0, name=None):
+    x._data = (jax.random.exponential(random_state.next_key(), tuple(x.shape)) / lam).astype(x.dtype)
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    x._data = (jax.random.normal(random_state.next_key(), tuple(x.shape)) * std + mean).astype(x.dtype)
+    return x
+
+
+def rand_like(x, dtype=None, name=None):
+    x = _as_t(x)
+    return rand(tuple(x.shape), dtype or x.dtype)
+
+
+def randn_like(x, dtype=None, name=None):
+    x = _as_t(x)
+    return randn(tuple(x.shape), dtype or x.dtype)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    x = _as_t(x)
+    key = random_state.next_key()
+
+    def f(a):
+        g = jax.random.gumbel(key, a.shape, a.dtype)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            y_hard = jax.nn.one_hot(jnp.argmax(y, axis=axis), a.shape[axis], dtype=a.dtype, axis=axis)
+            y = y_hard + y - jax.lax.stop_gradient(y)
+        return y
+
+    return apply(f, x, _op_name="gumbel_softmax")

@@ -1,0 +1,140 @@
+"""Search/sort ops (ref: python/paddle/tensor/search.py (U))."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor
+from ..core.op_call import apply
+from .creation import _as_t
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def f(a):
+        if axis is None:
+            r = jnp.argmax(a.reshape(-1))
+            return r.reshape((1,) * a.ndim) if keepdim else r
+        r = jnp.argmax(a, axis=axis)
+        return jnp.expand_dims(r, axis) if keepdim else r
+
+    return apply(f, _as_t(x).detach())
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def f(a):
+        if axis is None:
+            r = jnp.argmin(a.reshape(-1))
+            return r.reshape((1,) * a.ndim) if keepdim else r
+        r = jnp.argmin(a, axis=axis)
+        return jnp.expand_dims(r, axis) if keepdim else r
+
+    return apply(f, _as_t(x).detach())
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    def f(a):
+        idx = jnp.argsort(a, axis=axis, stable=stable or True)
+        return jnp.flip(idx, axis=axis) if descending else idx
+
+    return apply(f, _as_t(x).detach())
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    def f(a):
+        s = jnp.sort(a, axis=axis)
+        return jnp.flip(s, axis=axis) if descending else s
+
+    return apply(f, _as_t(x), _op_name="sort")
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    if isinstance(k, Tensor):
+        k = int(k._data)
+    x = _as_t(x)
+
+    def f(a):
+        ax = axis % a.ndim
+        am = jnp.moveaxis(a, ax, -1)
+        if largest:
+            v, i = lax.top_k(am, k)
+        else:
+            v, i = lax.top_k(-am, k)
+            v = -v
+        return jnp.moveaxis(v, -1, ax), jnp.moveaxis(i, -1, ax)
+
+    out = apply(f, x, _op_name="topk")
+    return out[0], out[1]
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def f(a):
+        s = jnp.sort(a, axis=axis)
+        i = jnp.argsort(a, axis=axis)
+        v = jnp.take(s, k - 1, axis=axis)
+        ix = jnp.take(i, k - 1, axis=axis)
+        if keepdim:
+            v = jnp.expand_dims(v, axis)
+            ix = jnp.expand_dims(ix, axis)
+        return v, ix
+
+    out = apply(f, _as_t(x))
+    return out[0], out[1]
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    import numpy as np
+    from scipy import stats as _stats  # available via numpy ecosystem
+
+    a = np.asarray(_as_t(x)._data)
+    m = _stats.mode(a, axis=axis, keepdims=keepdim)
+    return Tensor(m.mode), Tensor(m.count)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    side = "right" if right else "left"
+
+    def f(s, v):
+        if s.ndim == 1:
+            return jnp.searchsorted(s, v, side=side)
+        import jax
+
+        return jax.vmap(lambda s1, v1: jnp.searchsorted(s1, v1, side=side))(
+            s.reshape(-1, s.shape[-1]), v.reshape(-1, v.shape[-1])
+        ).reshape(v.shape)
+
+    return apply(f, _as_t(sorted_sequence).detach(), _as_t(values).detach())
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    return apply(lambda a: jnp.median(a, axis=axis, keepdims=keepdim), _as_t(x), _op_name="median")
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.nanmedian(a, axis=axis, keepdims=keepdim), _as_t(x))
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    qv = q._data if isinstance(q, Tensor) else q
+    return apply(lambda a: jnp.quantile(a, jnp.asarray(qv), axis=axis, keepdims=keepdim, method=interpolation), _as_t(x))
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    def f(a):
+        lo, hi = (min, max) if (min != 0 or max != 0) else (a.min(), a.max())
+        h, _ = jnp.histogram(a, bins=bins, range=(lo, hi))
+        return h
+
+    return apply(f, _as_t(input).detach())
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    import numpy as np
+
+    h, edges = np.histogramdd(np.asarray(_as_t(x)._data), bins=bins, range=ranges, density=density,
+                              weights=None if weights is None else np.asarray(_as_t(weights)._data))
+    return Tensor(h), [Tensor(e) for e in edges]

@@ -1,0 +1,15 @@
+"""Statistics namespace (ref: python/paddle/tensor/stat.py (U)) — thin
+re-exports; the implementations live in math/search."""
+
+from .math import mean, std, var, nanmean, nansum
+from .search import median, nanmedian, quantile
+from ..core.op_call import apply
+from .creation import _as_t
+
+import jax.numpy as jnp
+
+
+def numel(x, name=None):
+    from .attribute import numel as _n
+
+    return _n(x)

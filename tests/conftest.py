@@ -1,0 +1,29 @@
+"""Test harness: force an 8-device virtual CPU platform (SURVEY.md §4 —
+single-process SPMD tests replace the reference's multi-GPU subprocess
+pattern).
+
+NOTE: the axon sitecustomize imports jax and pins jax_platforms to
+"axon,cpu" at interpreter start; we must (a) add the host-device-count XLA
+flag before the CPU backend initializes and (b) re-pin jax_platforms to cpu
+so tests never touch the TPU tunnel.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_seed():
+    import paddle_tpu as paddle
+
+    paddle.seed(2024)
+    yield

@@ -1,0 +1,282 @@
+"""Layer tests vs NumPy references (SURVEY.md §4 API/layer-test pattern)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+class TestLayerBase:
+    def test_parameter_registration(self):
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 3)
+                self.w = paddle.Parameter(np.zeros((2, 2), np.float32))
+
+            def forward(self, x):
+                return self.fc(x) + self.w.sum()
+
+        m = M()
+        names = [n for n, _ in m.named_parameters()]
+        assert set(names) == {"fc.weight", "fc.bias", "w"}
+        assert len(m.parameters()) == 3
+
+    def test_state_dict_roundtrip(self):
+        m = nn.Linear(4, 3)
+        sd = m.state_dict()
+        m2 = nn.Linear(4, 3)
+        missing, unexpected = m2.set_state_dict(sd)
+        assert not missing and not unexpected
+        np.testing.assert_array_equal(m2.weight.numpy(), m.weight.numpy())
+
+    def test_train_eval_propagates(self):
+        m = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+        m.eval()
+        assert not m[1].training
+        m.train()
+        assert m[1].training
+
+    def test_buffers(self):
+        bn = nn.BatchNorm1D(3)
+        assert "_mean" in dict(bn.named_buffers())
+        assert "_mean" in bn.state_dict()
+
+    def test_hooks(self):
+        m = nn.Linear(2, 2)
+        calls = []
+        h = m.register_forward_post_hook(lambda layer, inp, out: calls.append(1))
+        m(paddle.randn([1, 2]))
+        assert calls
+        h.remove()
+
+    def test_sublayers_apply(self):
+        m = nn.Sequential(nn.Linear(2, 2), nn.Sequential(nn.Linear(2, 2)))
+        assert len(m.sublayers()) == 3
+        seen = []
+        m.apply(lambda l: seen.append(type(l).__name__))
+        assert "Linear" in seen
+
+
+class TestCoreLayers:
+    def test_linear_matches_numpy(self):
+        fc = nn.Linear(4, 3)
+        x = paddle.randn([5, 4])
+        ref = x.numpy() @ fc.weight.numpy() + fc.bias.numpy()
+        np.testing.assert_allclose(fc(x).numpy(), ref, rtol=1e-5, atol=1e-6)
+
+    def test_embedding(self):
+        emb = nn.Embedding(10, 4, padding_idx=0)
+        out = emb(paddle.to_tensor([[1, 0], [2, 3]]))
+        assert out.shape == [2, 2, 4]
+        np.testing.assert_array_equal(out.numpy()[0, 1], np.zeros(4))
+
+    def test_conv2d_shape_and_value(self):
+        conv = nn.Conv2D(2, 4, 3, padding=1, stride=2)
+        x = paddle.randn([1, 2, 8, 8])
+        assert conv(x).shape == [1, 4, 4, 4]
+        # value check vs explicit correlation for a tiny case
+        c = nn.Conv2D(1, 1, 2, bias_attr=False)
+        k = c.weight.numpy()[0, 0]
+        a = np.random.rand(1, 1, 3, 3).astype(np.float32)
+        out = c(paddle.to_tensor(a)).numpy()[0, 0]
+        ref = np.array([[ (a[0,0,i:i+2,j:j+2]*k).sum() for j in range(2)] for i in range(2)])
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_conv_transpose_inverts_stride(self):
+        ct = nn.Conv2DTranspose(3, 2, 2, stride=2)
+        x = paddle.randn([1, 3, 4, 4])
+        assert ct(x).shape == [1, 2, 8, 8]
+
+    def test_groupnorm_layernorm_rmsnorm(self):
+        x = paddle.randn([2, 4, 3, 3])
+        gn = nn.GroupNorm(2, 4)
+        out = gn(x)
+        grouped = out.numpy().reshape(2, 2, 2 * 9)
+        np.testing.assert_allclose(grouped.mean(-1), 0, atol=1e-5)
+
+        rms = nn.RMSNorm(6)
+        y = paddle.randn([2, 6])
+        o = rms(y)
+        ref = y.numpy() / np.sqrt((y.numpy() ** 2).mean(-1, keepdims=True) + 1e-6)
+        np.testing.assert_allclose(o.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_batchnorm_running_stats(self):
+        bn = nn.BatchNorm1D(3, momentum=0.5)
+        x = paddle.to_tensor(np.random.rand(8, 3).astype(np.float32) + 5)
+        bn(x)
+        assert bn._mean.numpy().mean() > 1.0  # moved toward batch mean
+        bn.eval()
+        y = bn(x)
+        ref = (x.numpy() - bn._mean.numpy()) / np.sqrt(bn._variance.numpy() + 1e-5)
+        np.testing.assert_allclose(y.numpy(), ref * bn.weight.numpy() + bn.bias.numpy(), rtol=1e-4, atol=1e-4)
+
+    def test_dropout_modes(self):
+        x = paddle.ones([1000])
+        d = nn.Dropout(0.5)
+        y = d(x)
+        kept = (y.numpy() != 0).mean()
+        assert 0.3 < kept < 0.7
+        np.testing.assert_allclose(y.numpy()[y.numpy() != 0], 2.0, rtol=1e-6)
+        d.eval()
+        np.testing.assert_array_equal(d(x).numpy(), x.numpy())
+
+    def test_activations(self):
+        a = np.random.randn(4, 4).astype(np.float32)
+        x = paddle.to_tensor(a)
+        np.testing.assert_allclose(F.relu(x).numpy(), np.maximum(a, 0))
+        np.testing.assert_allclose(F.sigmoid(x).numpy(), 1 / (1 + np.exp(-a)), rtol=1e-5)
+        from scipy.special import erf
+
+        np.testing.assert_allclose(
+            F.gelu(x).numpy(), 0.5 * a * (1 + erf(a / np.sqrt(2))), rtol=1e-5, atol=1e-6
+        )
+        np.testing.assert_allclose(F.silu(x).numpy(), a / (1 + np.exp(-a)), rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(F.softmax(x).numpy().sum(-1), np.ones(4), rtol=1e-5)
+
+    def test_pools(self):
+        x = paddle.to_tensor(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+        mp = F.max_pool2d(x, 2, 2)
+        np.testing.assert_array_equal(mp.numpy()[0, 0], [[5, 7], [13, 15]])
+        ap = F.avg_pool2d(x, 2, 2)
+        np.testing.assert_allclose(ap.numpy()[0, 0], [[2.5, 4.5], [10.5, 12.5]])
+        aap = F.adaptive_avg_pool2d(x, 2)
+        np.testing.assert_allclose(aap.numpy()[0, 0], [[2.5, 4.5], [10.5, 12.5]])
+
+    def test_interpolate(self):
+        x = paddle.to_tensor(np.arange(4, dtype=np.float32).reshape(1, 1, 2, 2))
+        up = F.interpolate(x, scale_factor=2, mode="nearest")
+        assert up.shape == [1, 1, 4, 4]
+        assert up.numpy()[0, 0, 0, 0] == 0 and up.numpy()[0, 0, 3, 3] == 3
+
+    def test_pad(self):
+        x = paddle.ones([1, 1, 2, 2])
+        y = F.pad(x, [1, 1, 0, 0])
+        assert y.shape == [1, 1, 2, 4]
+
+
+class TestRecurrent:
+    def test_lstm_shapes_and_grad(self):
+        lstm = nn.LSTM(4, 8, num_layers=2)
+        x = paddle.randn([2, 5, 4])
+        out, (h, c) = lstm(x)
+        assert out.shape == [2, 5, 8]
+        assert h.shape == [2, 2, 8] and c.shape == [2, 2, 8]
+        out.mean().backward()
+        assert lstm._parameters["weight_ih_l0"].grad is not None
+
+    def test_bidirectional(self):
+        gru = nn.GRU(4, 8, direction="bidirectional")
+        out, h = gru(paddle.randn([2, 5, 4]))
+        assert out.shape == [2, 5, 16]
+
+    def test_cell_matches_rnn(self):
+        cell = nn.LSTMCell(4, 8)
+        x = paddle.randn([2, 4])
+        h, (h2, c2) = cell(x)
+        assert h.shape == [2, 8]
+
+
+class TestTransformer:
+    def test_encoder_decoder(self):
+        model = nn.Transformer(d_model=16, nhead=4, num_encoder_layers=2,
+                               num_decoder_layers=2, dim_feedforward=32)
+        src = paddle.randn([2, 6, 16])
+        tgt = paddle.randn([2, 4, 16])
+        out = model(src, tgt)
+        assert out.shape == [2, 4, 16]
+
+    def test_causal_mask_blocks_future(self):
+        mha = nn.MultiHeadAttention(8, 2)
+        mha.eval()
+        x = paddle.randn([1, 4, 8])
+        mask = nn.Transformer.generate_square_subsequent_mask(4)
+        out1 = mha(x, x, x, attn_mask=mask)
+        x2_np = x.numpy().copy()
+        x2_np[0, 3] = 999.0  # future token change must not affect position 0
+        x2 = paddle.to_tensor(x2_np)
+        out2 = mha(x2, x2, x2, attn_mask=mask)
+        np.testing.assert_allclose(out1.numpy()[0, 0], out2.numpy()[0, 0], rtol=1e-4, atol=1e-5)
+
+    def test_incremental_cache_matches_full(self):
+        mha = nn.MultiHeadAttention(8, 2)
+        mha.eval()
+        x = paddle.randn([1, 3, 8])
+        mask = nn.Transformer.generate_square_subsequent_mask(3)
+        full = mha(x, x, x, attn_mask=mask)
+        cache = mha.gen_cache(x[:, :0, :])
+        outs = []
+        for i in range(3):
+            step = x[:, i : i + 1, :]
+            o, cache = mha(step, step, step, None, cache)
+            outs.append(o.numpy())
+        inc = np.concatenate(outs, axis=1)
+        np.testing.assert_allclose(full.numpy(), inc, rtol=1e-4, atol=1e-5)
+
+
+class TestLosses:
+    def test_cross_entropy_ignore_index(self):
+        logits = paddle.randn([4, 5])
+        labels = paddle.to_tensor([1, 2, -100, 3])
+        loss = F.cross_entropy(logits, labels, ignore_index=-100)
+        l = logits.numpy() - logits.numpy().max(-1, keepdims=True)
+        p = np.exp(l) / np.exp(l).sum(-1, keepdims=True)
+        ref = -np.log(p[[0, 1, 3], [1, 2, 3]]).mean()
+        np.testing.assert_allclose(float(loss), ref, rtol=1e-5)
+
+    def test_soft_label_and_smoothing(self):
+        logits = paddle.randn([2, 3])
+        soft = paddle.to_tensor(np.array([[0.2, 0.3, 0.5], [1.0, 0, 0]], np.float32))
+        loss = F.cross_entropy(logits, soft, soft_label=True)
+        assert float(loss) > 0
+        loss2 = F.cross_entropy(logits, paddle.to_tensor([1, 0]), label_smoothing=0.1)
+        assert float(loss2) > 0
+
+    def test_mse_bce(self):
+        a, b = paddle.randn([3, 3]), paddle.randn([3, 3])
+        np.testing.assert_allclose(float(F.mse_loss(a, b)), ((a.numpy() - b.numpy()) ** 2).mean(), rtol=1e-5)
+        p = paddle.uniform([4], min=0.1, max=0.9)
+        y = paddle.to_tensor([1.0, 0, 1, 0])
+        ref = -(y.numpy() * np.log(p.numpy()) + (1 - y.numpy()) * np.log(1 - p.numpy())).mean()
+        np.testing.assert_allclose(float(F.binary_cross_entropy(p, y)), ref, rtol=1e-4)
+        logits = paddle.randn([4])
+        l1 = F.binary_cross_entropy_with_logits(logits, y)
+        l2 = F.binary_cross_entropy(F.sigmoid(logits), y)
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-4)
+
+    def test_kl_nll(self):
+        logp = F.log_softmax(paddle.randn([3, 4]))
+        tgt = F.softmax(paddle.randn([3, 4]))
+        assert float(F.kl_div(logp, tgt, reduction="batchmean")) is not None
+        lbl = paddle.to_tensor([0, 1, 2])
+        np.testing.assert_allclose(
+            float(F.nll_loss(logp, lbl)),
+            -logp.numpy()[[0, 1, 2], [0, 1, 2]].mean(),
+            rtol=1e-5,
+        )
+
+    def test_ctc_loss_runs(self):
+        T, B, C, S = 6, 2, 5, 3
+        log_probs = paddle.randn([T, B, C])
+        labels = paddle.to_tensor(np.random.randint(1, C, (B, S)))
+        loss = F.ctc_loss(log_probs, labels,
+                          paddle.to_tensor([T, T]), paddle.to_tensor([S, 2]))
+        assert np.isfinite(float(loss))
+
+
+class TestClip:
+    def test_global_norm_clip(self):
+        g1 = paddle.to_tensor(np.ones(4, np.float32) * 3)
+        g2 = paddle.to_tensor(np.ones(4, np.float32) * 4)
+        p1, p2 = paddle.Parameter(np.zeros(4)), paddle.Parameter(np.zeros(4))
+        clip = nn.ClipGradByGlobalNorm(1.0)
+        out = clip([(p1, g1), (p2, g2)])
+        total = np.sqrt(sum((g.numpy() ** 2).sum() for _, g in out))
+        np.testing.assert_allclose(total, 1.0, rtol=1e-5)
+
+    def test_value_clip(self):
+        clip = nn.ClipGradByValue(0.5)
+        (_, g), = clip([(None, paddle.to_tensor([1.0, -2.0]))])
+        np.testing.assert_allclose(g.numpy(), [0.5, -0.5])

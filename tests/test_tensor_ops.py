@@ -1,0 +1,245 @@
+"""Op tests vs NumPy references (SURVEY.md §4 OpTest pattern)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def t(a, sg=True):
+    return paddle.to_tensor(np.asarray(a, np.float32), stop_gradient=sg)
+
+
+class TestCreation:
+    def test_basic(self):
+        assert paddle.zeros([2, 3]).shape == [2, 3]
+        assert paddle.ones([2]).numpy().tolist() == [1.0, 1.0]
+        assert paddle.full([2], 7).numpy().tolist() == [7, 7]
+        np.testing.assert_array_equal(paddle.arange(5).numpy(), np.arange(5))
+        np.testing.assert_allclose(paddle.linspace(0, 1, 5).numpy(), np.linspace(0, 1, 5))
+        assert paddle.eye(3).numpy()[1, 1] == 1.0
+
+    def test_like(self):
+        x = t([[1, 2], [3, 4]])
+        assert paddle.zeros_like(x).shape == [2, 2]
+        assert float(paddle.full_like(x, 5).numpy()[0, 0]) == 5.0
+
+    def test_tril_triu_diag(self):
+        x = t(np.arange(9).reshape(3, 3))
+        np.testing.assert_array_equal(paddle.tril(x).numpy(), np.tril(x.numpy()))
+        np.testing.assert_array_equal(paddle.triu(x).numpy(), np.triu(x.numpy()))
+        np.testing.assert_array_equal(paddle.diag(t([1, 2, 3])).numpy(), np.diag([1, 2, 3]))
+
+    def test_one_hot(self):
+        oh = paddle.one_hot(paddle.to_tensor([0, 2]), 3)
+        np.testing.assert_array_equal(oh.numpy(), [[1, 0, 0], [0, 0, 1]])
+
+
+class TestMath:
+    def test_elementwise(self):
+        a, b = np.random.rand(3, 4), np.random.rand(3, 4)
+        x, y = t(a), t(b)
+        np.testing.assert_allclose((x + y).numpy(), a + b, rtol=1e-6, atol=1e-7)
+        np.testing.assert_allclose((x - y).numpy(), a - b, rtol=1e-6, atol=1e-7)
+        np.testing.assert_allclose((x * y).numpy(), a * b, rtol=1e-6, atol=1e-7)
+        np.testing.assert_allclose((x / y).numpy(), a / b, rtol=1e-5)
+        np.testing.assert_allclose(paddle.maximum(x, y).numpy(), np.maximum(a, b))
+        np.testing.assert_allclose((x**2).numpy(), a**2, rtol=1e-6)
+        np.testing.assert_allclose(paddle.sqrt(x).numpy(), np.sqrt(a), rtol=1e-6)
+        np.testing.assert_allclose(paddle.exp(x).numpy(), np.exp(a), rtol=1e-6)
+        np.testing.assert_allclose(paddle.log(x).numpy(), np.log(a), rtol=1e-5, atol=1e-6)
+
+    def test_scalar_broadcast(self):
+        x = t([1.0, 2.0])
+        np.testing.assert_allclose((2 * x + 1).numpy(), [3.0, 5.0])
+        np.testing.assert_allclose((1 / x).numpy(), [1.0, 0.5])
+        np.testing.assert_allclose((x - 1).numpy(), [0.0, 1.0])
+        np.testing.assert_allclose((3 - x).numpy(), [2.0, 1.0])
+
+    def test_reductions(self):
+        a = np.random.rand(2, 3, 4)
+        x = t(a)
+        np.testing.assert_allclose(float(x.sum()), a.sum(), rtol=1e-5)
+        np.testing.assert_allclose(x.mean(axis=1).numpy(), a.mean(1), rtol=1e-5)
+        np.testing.assert_allclose(x.max(axis=[0, 2]).numpy(), a.max((0, 2)), rtol=1e-6)
+        np.testing.assert_allclose(x.prod(axis=0).numpy(), a.prod(0), rtol=1e-5)
+        np.testing.assert_allclose(x.std(axis=-1, unbiased=True).numpy(), a.std(-1, ddof=1), rtol=1e-4)
+        np.testing.assert_allclose(paddle.logsumexp(x, axis=1).numpy(),
+                                   np.log(np.exp(a).sum(1)), rtol=1e-5)
+
+    def test_cumsum_cummax(self):
+        a = np.random.rand(3, 4)
+        x = t(a)
+        np.testing.assert_allclose(paddle.cumsum(x, axis=1).numpy(), np.cumsum(a, 1), rtol=1e-5)
+        v, i = paddle.cummax(x, axis=1)
+        np.testing.assert_allclose(v.numpy(), np.maximum.accumulate(a, 1), rtol=1e-6)
+
+    def test_matmul_family(self):
+        a, b = np.random.rand(2, 3, 4), np.random.rand(2, 4, 5)
+        np.testing.assert_allclose(paddle.matmul(t(a), t(b)).numpy(), a @ b, rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.matmul(t(a), t(b.transpose(0, 2, 1)), transpose_y=True).numpy(), a @ b, rtol=1e-5
+        )
+        v1, v2 = np.random.rand(4), np.random.rand(4)
+        np.testing.assert_allclose(float(paddle.dot(t(v1), t(v2))), v1 @ v2, rtol=1e-5)
+        np.testing.assert_allclose(paddle.outer(t(v1), t(v2)).numpy(), np.outer(v1, v2), rtol=1e-5)
+
+    def test_clip_trig(self):
+        a = np.random.randn(3, 3)
+        np.testing.assert_allclose(paddle.clip(t(a), -0.5, 0.5).numpy(), np.clip(a, -0.5, 0.5), rtol=1e-6)
+        np.testing.assert_allclose(paddle.sin(t(a)).numpy(), np.sin(a), rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(paddle.atan2(t(a), t(a + 1)).numpy(), np.arctan2(a, a + 1), rtol=1e-5, atol=1e-6)
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        a = np.arange(24).reshape(2, 3, 4).astype(np.float32)
+        x = t(a)
+        assert x.reshape([4, 6]).shape == [4, 6]
+        assert x.reshape([-1, 4]).shape == [6, 4]
+        np.testing.assert_array_equal(
+            paddle.transpose(x, [2, 0, 1]).numpy(), a.transpose(2, 0, 1)
+        )
+        assert paddle.flatten(x, 1).shape == [2, 12]
+
+    def test_squeeze_unsqueeze(self):
+        x = t(np.zeros((2, 1, 3)))
+        assert paddle.squeeze(x, 1).shape == [2, 3]
+        assert paddle.unsqueeze(x, 0).shape == [1, 2, 1, 3]
+        assert paddle.unsqueeze(x, [0, 4]).shape == [1, 2, 1, 3, 1]
+
+    def test_concat_stack_split(self):
+        a = np.random.rand(2, 3)
+        x = t(a)
+        assert paddle.concat([x, x], axis=0).shape == [4, 3]
+        assert paddle.stack([x, x], axis=1).shape == [2, 2, 3]
+        parts = paddle.split(t(np.arange(12).reshape(2, 6)), 3, axis=1)
+        assert len(parts) == 3 and parts[0].shape == [2, 2]
+        parts = paddle.split(t(np.arange(12).reshape(2, 6)), [1, 2, -1], axis=1)
+        assert parts[2].shape == [2, 3]
+
+    def test_gather_scatter(self):
+        a = np.arange(12).reshape(3, 4).astype(np.float32)
+        idx = paddle.to_tensor([2, 0])
+        np.testing.assert_array_equal(paddle.gather(t(a), idx, axis=0).numpy(), a[[2, 0]])
+        np.testing.assert_array_equal(paddle.index_select(t(a), idx, axis=1).numpy(), a[:, [2, 0]])
+        out = paddle.scatter(t(a), paddle.to_tensor([0]), t(np.full((1, 4), 9.0)))
+        assert out.numpy()[0, 0] == 9.0
+
+    def test_where_masked(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        x = t(a)
+        np.testing.assert_array_equal(
+            paddle.where(x > 0, x, paddle.zeros_like(x)).numpy(), np.where(a > 0, a, 0)
+        )
+        np.testing.assert_array_equal(paddle.masked_select(x, x > 0).numpy(), a[a > 0])
+
+    def test_tile_expand_roll_flip(self):
+        a = np.arange(6).reshape(2, 3).astype(np.float32)
+        x = t(a)
+        np.testing.assert_array_equal(paddle.tile(x, [2, 1]).numpy(), np.tile(a, (2, 1)))
+        assert paddle.expand(t(np.ones((1, 3))), [4, 3]).shape == [4, 3]
+        np.testing.assert_array_equal(paddle.roll(x, 1, axis=0).numpy(), np.roll(a, 1, 0))
+        np.testing.assert_array_equal(paddle.flip(x, [1]).numpy(), a[:, ::-1])
+
+    def test_take_along_put_along(self):
+        a = np.random.rand(3, 4).astype(np.float32)
+        idx = np.argsort(a, axis=1)
+        out = paddle.take_along_axis(t(a), paddle.to_tensor(idx), axis=1)
+        np.testing.assert_allclose(out.numpy(), np.take_along_axis(a, idx, 1))
+
+    def test_unique_nonzero(self):
+        x = paddle.to_tensor([1, 2, 2, 3, 1])
+        np.testing.assert_array_equal(paddle.unique(x).numpy(), [1, 2, 3])
+        nz = paddle.nonzero(paddle.to_tensor([0, 1, 0, 2]))
+        np.testing.assert_array_equal(nz.numpy().reshape(-1), [1, 3])
+
+
+class TestLogicSearch:
+    def test_compare(self):
+        x, y = t([1, 2, 3]), t([2, 2, 2])
+        np.testing.assert_array_equal((x < y).numpy(), [True, False, False])
+        np.testing.assert_array_equal(paddle.equal(x, y).numpy(), [False, True, False])
+        assert bool(paddle.all(t([1, 1]).astype("bool")))
+        assert bool(paddle.any((x > 2)))
+
+    def test_argmax_sort_topk(self):
+        a = np.random.rand(3, 5)
+        x = t(a)
+        np.testing.assert_array_equal(paddle.argmax(x, axis=1).numpy(), a.argmax(1))
+        np.testing.assert_allclose(paddle.sort(x, axis=1).numpy(), np.sort(a, 1), rtol=1e-6)
+        v, i = paddle.topk(x, 2, axis=1)
+        np.testing.assert_allclose(v.numpy(), np.sort(a, 1)[:, ::-1][:, :2], rtol=1e-6)
+
+    def test_searchsorted_median(self):
+        s = t([1.0, 3.0, 5.0, 7.0])
+        np.testing.assert_array_equal(
+            paddle.searchsorted(s, t([2.0, 6.0])).numpy(), [1, 3]
+        )
+        assert float(paddle.median(t([1.0, 2.0, 3.0]))) == 2.0
+
+
+class TestLinalg:
+    def test_norm_det_inv(self):
+        a = np.random.rand(3, 3) + np.eye(3)
+        x = t(a)
+        np.testing.assert_allclose(float(paddle.linalg.norm(x)), np.linalg.norm(a), rtol=1e-5)
+        np.testing.assert_allclose(float(paddle.linalg.det(x)), np.linalg.det(a), rtol=1e-4)
+        np.testing.assert_allclose(paddle.linalg.inv(x).numpy(), np.linalg.inv(a), rtol=1e-4, atol=1e-5)
+
+    def test_svd_qr_cholesky(self):
+        a = np.random.rand(4, 3)
+        u, s, vh = paddle.linalg.svd(t(a))
+        np.testing.assert_allclose(s.numpy(), np.linalg.svd(a, compute_uv=False), rtol=1e-4)
+        q, r = paddle.linalg.qr(t(a))
+        np.testing.assert_allclose((q @ r).numpy(), a, rtol=1e-4, atol=1e-5)
+        spd = a.T @ a + np.eye(3)
+        l = paddle.linalg.cholesky(t(spd))
+        np.testing.assert_allclose((l @ l.T).numpy(), spd, rtol=1e-4, atol=1e-5)
+
+    def test_solve_eigh(self):
+        a = np.random.rand(3, 3) + 3 * np.eye(3)
+        b = np.random.rand(3, 2)
+        np.testing.assert_allclose(
+            paddle.linalg.solve(t(a), t(b)).numpy(), np.linalg.solve(a, b), rtol=1e-4, atol=1e-5
+        )
+        sym = (a + a.T) / 2
+        w, v = paddle.linalg.eigh(t(sym))
+        np.testing.assert_allclose(w.numpy(), np.linalg.eigh(sym)[0], rtol=1e-4, atol=1e-5)
+
+
+class TestEinsumRandom:
+    def test_einsum(self):
+        a, b = np.random.rand(2, 3), np.random.rand(3, 4)
+        np.testing.assert_allclose(paddle.einsum("ij,jk->ik", t(a), t(b)).numpy(), a @ b, rtol=1e-5)
+
+    def test_random_shapes_and_determinism(self):
+        paddle.seed(7)
+        a = paddle.randn([3, 3]).numpy()
+        paddle.seed(7)
+        b = paddle.randn([3, 3]).numpy()
+        np.testing.assert_array_equal(a, b)
+        assert paddle.randint(0, 10, [5]).numpy().max() < 10
+        p = paddle.randperm(10).numpy()
+        assert sorted(p.tolist()) == list(range(10))
+
+    def test_bernoulli_multinomial(self):
+        probs = paddle.full([100], 0.5)
+        s = paddle.bernoulli(probs).numpy()
+        assert 10 < s.sum() < 90
+        m = paddle.multinomial(paddle.to_tensor([0.1, 0.2, 0.7]), 2)
+        assert m.shape == [2]
+
+
+class TestDtypeCast:
+    def test_astype(self):
+        x = paddle.to_tensor([1.5, 2.5])
+        assert str(x.astype("int32").dtype) == "int32"
+        assert str(x.astype(paddle.float16).dtype) == "float16"
+        y = paddle.cast(x, "bool")
+        assert y.numpy().tolist() == [True, True]
+
+    def test_default_dtypes(self):
+        assert str(paddle.to_tensor(1.0).dtype) == "float32"
+        assert str(paddle.to_tensor(1).dtype) == "int32"
+        assert str(paddle.to_tensor(True).dtype) == "bool"
