@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Benchmark: flagship GPT (ERNIE/LLaMA-style) jitted train step on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+value = tokens/sec/chip; vs_baseline = achieved MFU / 0.50 (BASELINE.md's
+derived A100-parity anchor — no published reference numbers exist, see
+BASELINE.md provenance).
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def main():
+    import jax
+
+    backend = jax.default_backend()
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    on_tpu = backend in ("tpu", "axon")
+    if on_tpu:
+        # ~0.5B-param config: big enough for meaningful MFU, fits 16G HBM
+        cfg = GPTConfig(vocab_size=32000, hidden_size=1536, intermediate_size=4096,
+                        num_hidden_layers=12, num_attention_heads=12,
+                        max_position_embeddings=2048)
+        batch, seq, steps = 8, 1024, 20
+    else:
+        cfg = GPTConfig(vocab_size=1024, hidden_size=256, intermediate_size=688,
+                        num_hidden_layers=4, num_attention_heads=8,
+                        max_position_embeddings=512)
+        batch, seq, steps = 2, 128, 3
+
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    if on_tpu:
+        model.to(dtype="bfloat16")  # bf16 params + activations on the MXU
+    n_params = sum(p.size for p in model.parameters())
+
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters(),
+                                 multi_precision=True)
+
+    def loss_fn(net, ids, labels):
+        loss, _ = net(ids, labels=labels)
+        return loss
+
+    step = paddle.jit.TrainStep(model, loss_fn, opt)
+
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+
+    # compile + warmup
+    step(ids, ids)
+    step(ids, ids)
+    import jax.numpy as jnp
+
+    jnp.zeros(()).block_until_ready()
+
+    t0 = time.time()
+    for _ in range(steps):
+        loss = step(ids, ids)
+    float(loss)  # sync
+    dt = time.time() - t0
+
+    tokens_per_sec = batch * seq * steps / dt
+    # 6*N FLOPs/token (fwd+bwd); attention FLOPs excluded (conservative)
+    flops_per_tok = 6 * n_params
+    peak = {"axon": 197e12, "tpu": 197e12}.get(backend, 1e12)  # v5e bf16 peak
+    mfu = tokens_per_sec * flops_per_tok / peak
+    print(json.dumps({
+        "metric": f"tokens/sec/chip GPT-{n_params/1e6:.0f}M bf16 train (b{batch}xs{seq}, {backend})",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.50, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,129 @@
+"""AMP autocast (ref: python/paddle/amp/auto_cast.py (U) — O1 white/black
+op lists, O2 pure-half with master weights).
+
+TPU-native stance: bfloat16 is the native half type (MXU runs bf16 natively;
+no loss scaling needed for bf16). The white/black list mechanism is preserved:
+whitelisted ops (matmul/conv) cast inputs to the amp dtype inside `apply()`,
+blacklisted ops (softmax/norms/reductions) compute in fp32 — same split the
+reference encodes in its AMP lists.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax.numpy as jnp
+
+from ..core.dtype import to_jax_dtype
+
+# mirror of the reference's default O1 lists (ops named by our op names)
+WHITE_LIST = {
+    "matmul", "linear", "conv1d", "conv2d", "conv3d", "conv1d_transpose",
+    "conv2d_transpose", "conv3d_transpose", "einsum", "mm", "bmm", "mv",
+    "flash_attention", "scaled_dot_product_attention",
+}
+BLACK_LIST = {
+    "exp", "square", "log", "mean", "sum", "cos_sim", "softmax",
+    "softmax_with_cross_entropy", "sigmoid_cross_entropy_with_logits",
+    "cross_entropy", "layer_norm", "batch_norm", "group_norm", "instance_norm",
+    "rms_norm", "logsumexp", "erf", "erfinv", "pow", "log_softmax",
+    "sync_batch_norm", "norm", "var", "std",
+}
+
+
+def white_list():
+    return {"float16": {"O1": WHITE_LIST, "O2": WHITE_LIST}, "bfloat16": {"O1": WHITE_LIST, "O2": WHITE_LIST}}
+
+
+def black_list():
+    return {"float16": {"O1": BLACK_LIST, "O2": BLACK_LIST}, "bfloat16": {"O1": BLACK_LIST, "O2": BLACK_LIST}}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+        self.custom_white = set()
+        self.custom_black = set()
+
+
+_STATE = _AmpState()
+
+
+def amp_state():
+    return _STATE
+
+
+def amp_dtype_for(op_name: str):
+    """Called by core.op_call: returns the compute dtype for op_name under the
+    active autocast scope, or None for 'leave as is'."""
+    if not _STATE.enabled:
+        return None
+    if not op_name:
+        # unnamed ops (misc linalg/search helpers) are never auto-cast — even
+        # under O2 — since their dtype support is op-specific
+        return None
+    if op_name in _STATE.custom_black or op_name in BLACK_LIST:
+        return jnp.float32
+    if _STATE.level == "O2":
+        return _STATE.dtype
+    if op_name in _STATE.custom_white or op_name in WHITE_LIST:
+        return _STATE.dtype
+    return None
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    from ..core import op_call as _op_call
+
+    prev = (_STATE.enabled, _STATE.dtype, _STATE.level, _STATE.custom_white, _STATE.custom_black)
+    prev_hook = _op_call._AMP_LOOKUP
+    _STATE.enabled = enable
+    _STATE.dtype = to_jax_dtype(dtype)
+    _STATE.level = level
+    _STATE.custom_white = set(custom_white_list or ())
+    _STATE.custom_black = set(custom_black_list or ())
+    # the dispatch hook is installed only while a scope is active, so eager
+    # dispatch outside autocast stays a single `is None` check
+    _op_call.set_amp_lookup(amp_dtype_for)
+    try:
+        yield
+    finally:
+        (_STATE.enabled, _STATE.dtype, _STATE.level, _STATE.custom_white, _STATE.custom_black) = prev
+        _op_call.set_amp_lookup(prev_hook)
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2 decoration: cast float params to the amp dtype; Adam-family
+    optimizers keep fp32 master weights automatically (multi_precision path)."""
+    jd = to_jax_dtype(dtype)
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    for m in model_list:
+        if level == "O2":
+            m.to(dtype=jd)
+    opt_single = optimizers is not None and not isinstance(optimizers, (list, tuple))
+    opt_list = [] if optimizers is None else ([optimizers] if opt_single else list(optimizers))
+    for o in opt_list:
+        if hasattr(o, "_multi_precision"):
+            o._multi_precision = True
+    if optimizers is None:
+        return models
+    return (model_list[0] if single else model_list), (opt_list[0] if opt_single else opt_list)
+
+
+def is_bf16_supported():
+    return True
+
+
+def is_float16_supported():
+    return True
+
+

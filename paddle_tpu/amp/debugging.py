@@ -1,0 +1,72 @@
+"""paddle.amp.debugging parity (ref: python/paddle/amp/debugging.py (U)):
+nan/inf checking. TPU-native backing: jax debug_nans plus an explicit
+tensor-checker API."""
+
+from __future__ import annotations
+
+import contextlib
+import enum
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+class DebugMode(enum.Enum):
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL = 2
+
+
+class TensorCheckerConfig:
+    def __init__(self, enable, debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT,
+                 output_dir=None, checked_op_list=None, skipped_op_list=None,
+                 debug_step=None, stack_height_limit=1):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.output_dir = output_dir
+
+
+_CONFIG = [None]
+
+
+def enable_tensor_checker(config: TensorCheckerConfig):
+    _CONFIG[0] = config
+    if config.enable:
+        jax.config.update("jax_debug_nans", True)
+
+
+def disable_tensor_checker():
+    _CONFIG[0] = None
+    jax.config.update("jax_debug_nans", False)
+
+
+def check_numerics(tensor, op_type="", var_name="", debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT):
+    data = tensor._data if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+    n_nan = int(jnp.sum(jnp.isnan(data)))
+    n_inf = int(jnp.sum(jnp.isinf(data)))
+    if (n_nan or n_inf) and debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT:
+        raise FloatingPointError(
+            f"check_numerics: {op_type}/{var_name} has {n_nan} nan, {n_inf} inf"
+        )
+    from ..tensor.creation import _as_t
+
+    return Tensor(jnp.asarray([n_nan])), Tensor(jnp.asarray([n_inf]))
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    yield
+
+
+def enable_operator_stats_collection():
+    pass
+
+
+def disable_operator_stats_collection():
+    pass
+
+
+def compare_accuracy(dump_path, another_dump_path, output_filename, loss_scale=1, dump_all_tensors=False):
+    raise NotImplementedError("accuracy comparison dumps are not supported on the TPU build")

@@ -1,0 +1,155 @@
+"""GradScaler: dynamic loss scaling (ref: python/paddle/amp/grad_scaler.py (U)).
+
+Needed for fp16 parity; bf16 training on TPU normally runs unscaled (the
+default `enable` honors that — scaling is a no-op unless fp16 is in play or
+the user forces it)."""
+
+from __future__ import annotations
+
+import enum
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import tape as _tape
+
+
+class OptimizerState(enum.Enum):
+    INIT = 0
+    UNSCALED = 1
+    STEPPED = 2
+
+
+class AmpScaler:
+    def __init__(self, enable=True, init_loss_scaling=2.0**16, incr_ratio=2.0,
+                 decr_ratio=0.5, incr_every_n_steps=2000, decr_every_n_nan_or_inf=1,
+                 use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+        self._state = OptimizerState.INIT
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        params = optimizer._parameter_list
+        inv = 1.0 / self._scale
+        found = jnp.zeros((), jnp.bool_)
+        with _tape.no_grad():
+            for p in params:
+                if p.grad is None:
+                    continue
+                g = p.grad._data.astype(jnp.float32) * inv
+                found = found | ~jnp.all(jnp.isfinite(g))
+                p.grad._data = g.astype(p.grad._data.dtype)
+        self._found_inf = bool(found)
+        self._state = OptimizerState.UNSCALED
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        if self._state != OptimizerState.UNSCALED:
+            self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self._state = OptimizerState.STEPPED
+
+    def update(self):
+        if not self._enable or not self._dynamic:
+            self._state = OptimizerState.INIT
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._state = OptimizerState.INIT
+
+    def minimize(self, optimizer, loss):
+        scaled = self.scale(loss)
+        scaled.backward()
+        self.step(optimizer)
+        self.update()
+        optimizer.clear_grad()
+
+    # -------- introspection / state --------
+    def get_loss_scaling(self):
+        return Tensor(jnp.asarray(self._scale, jnp.float32))
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+    def get_incr_ratio(self):
+        return self._incr_ratio
+
+    def set_incr_ratio(self, v):
+        self._incr_ratio = v
+
+    def get_decr_ratio(self):
+        return self._decr_ratio
+
+    def set_decr_ratio(self, v):
+        self._decr_ratio = v
+
+    def get_incr_every_n_steps(self):
+        return self._incr_every
+
+    def set_incr_every_n_steps(self, v):
+        self._incr_every = v
+
+    def get_decr_every_n_nan_or_inf(self):
+        return self._decr_every
+
+    def set_decr_every_n_nan_or_inf(self, v):
+        self._decr_every = v
+
+    def state_dict(self):
+        return {
+            "scale": self._scale,
+            "incr_ratio": self._incr_ratio,
+            "decr_ratio": self._decr_ratio,
+            "incr_every_n_steps": self._incr_every,
+            "decr_every_n_nan_or_inf": self._decr_every,
+            "incr_count": self._good_steps,
+            "decr_count": self._bad_steps,
+            "use_dynamic_loss_scaling": self._dynamic,
+        }
+
+    def load_state_dict(self, state):
+        self._scale = state.get("scale", self._scale)
+        self._incr_ratio = state.get("incr_ratio", self._incr_ratio)
+        self._decr_ratio = state.get("decr_ratio", self._decr_ratio)
+        self._incr_every = state.get("incr_every_n_steps", self._incr_every)
+        self._decr_every = state.get("decr_every_n_nan_or_inf", self._decr_every)
+        self._good_steps = state.get("incr_count", 0)
+        self._bad_steps = state.get("decr_count", 0)
+
+
+class GradScaler(AmpScaler):
+    pass

@@ -1,0 +1,107 @@
+"""paddle.autograd parity (ref: python/paddle/autograd/ (U)): backward,
+PyLayer custom autograd, hooks. PyLayer ≡ custom forward + custom vjp recorded
+on the tape."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import tape as _tape
+from ..core.autograd_engine import backward as _backward_one, grad
+from ..core.tape import no_grad, enable_grad, is_grad_enabled, set_grad_enabled
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+    for t, g in zip(tensors, grad_tensors):
+        _backward_one(t, grad_tensor=g, retain_graph=retain_graph)
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = []
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = list(tensors)
+
+    def saved_tensor(self):
+        return self._saved
+
+    saved_tensors = property(lambda self: self._saved)
+
+    def mark_not_inplace(self, *args):
+        self.not_inplace_tensors = args
+
+    def set_materialize_grads(self, value):
+        self._materialize = value
+
+
+class PyLayerMeta(type):
+    def __init__(cls, name, bases, attrs):
+        super().__init__(name, bases, attrs)
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """Custom autograd op (ref: paddle.autograd.PyLayer).
+
+    class Cube(PyLayer):
+        @staticmethod
+        def forward(ctx, x): ctx.save_for_backward(x); return x**3
+        @staticmethod
+        def backward(ctx, dy): (x,) = ctx.saved_tensor(); return dy * 3 * x**2
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        in_tensors = [a for a in args if isinstance(a, Tensor)]
+        with _tape.no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        outs = [o if isinstance(o, Tensor) else Tensor(o) for o in outs]
+
+        need_grad = _tape.tape_enabled() and any(not t.stop_gradient for t in in_tensors)
+        if need_grad:
+            diff_inputs = [t for t in in_tensors if not t.stop_gradient]
+
+            def vjp_fn(cotangents):
+                cts = [Tensor(c) for c in cotangents]
+                with _tape.no_grad():
+                    grads = cls.backward(ctx, *cts)
+                if not isinstance(grads, (tuple, list)):
+                    grads = (grads,)
+                # paddle contract: backward returns one grad per Tensor input,
+                # in forward order; grads for stop_gradient inputs are dropped
+                out_grads = []
+                for i, t in enumerate(in_tensors):
+                    if t.stop_gradient:
+                        continue
+                    g = grads[i] if i < len(grads) else None
+                    out_grads.append(
+                        None if g is None else (g._data if isinstance(g, Tensor) else jnp.asarray(g))
+                    )
+                return tuple(out_grads)
+
+            for o in outs:
+                o.stop_gradient = False
+            _tape.global_tape().record(diff_inputs, outs, vjp_fn, name=cls.__name__)
+        return out if isinstance(out, (tuple, list)) else outs[0]
+
+
+def is_pylayer_op(x):
+    return isinstance(x, PyLayer)

@@ -22,6 +22,38 @@ def _is_float(dtype) -> bool:
     return jnp.issubdtype(dtype, jnp.floating) or jnp.issubdtype(dtype, jnp.complexfloating)
 
 
+# AMP integration point: paddle_tpu.amp installs a lookup op_name -> dtype
+# (or None) here when an auto_cast scope may be active. Kept as a hook so the
+# hot eager path pays nothing when AMP was never imported.
+_AMP_LOOKUP = None
+
+
+def set_amp_lookup(fn):
+    global _AMP_LOOKUP
+    _AMP_LOOKUP = fn
+
+
+def _maybe_amp_wrap(fn, op_name):
+    if _AMP_LOOKUP is None:
+        return fn
+    jd = _AMP_LOOKUP(op_name)
+    if jd is None:
+        return fn
+
+    def wrapped(*arrays, **kw):
+        # real floats only: complex inputs must never be truncated to a real
+        # half dtype, and integers pass through untouched
+        cast = [
+            a.astype(jd)
+            if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating) and a.dtype != jd
+            else a
+            for a in arrays
+        ]
+        return fn(*cast, **kw)
+
+    return wrapped
+
+
 def apply(fn, *args, _op_name: str = "", **kwargs):
     """Run `fn(*arrays, **kwargs)` where Tensor args are unwrapped.
 
@@ -29,6 +61,7 @@ def apply(fn, *args, _op_name: str = "", **kwargs):
     computed through `jax.vjp` and the pullback recorded. Non-Tensor args
     pass through untouched (treated as constants).
     """
+    fn = _maybe_amp_wrap(fn, _op_name)
     tensor_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
     arrays = list(args)
     in_tensors = []

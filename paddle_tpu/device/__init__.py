@@ -1,0 +1,95 @@
+"""paddle.device parity (ref: python/paddle/device/ (U))."""
+
+from ..core.device import (
+    set_device, get_device, get_default_device, device_count,
+    is_compiled_with_cuda, is_compiled_with_tpu, synchronize, Place,
+)
+
+
+def get_available_device():
+    import jax
+
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return []
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def is_compiled_with_cinn():
+    # XLA plays CINN's role and is always present
+    return True
+
+
+def is_compiled_with_distribute():
+    return True
+
+
+class cuda:
+    """paddle.device.cuda stubs (no CUDA on the TPU build)."""
+
+    @staticmethod
+    def device_count():
+        return 0
+
+    @staticmethod
+    def is_available():
+        return False
+
+    @staticmethod
+    def empty_cache():
+        pass
+
+    @staticmethod
+    def synchronize(device=None):
+        synchronize()
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        return 0
+
+    @staticmethod
+    def memory_allocated(device=None):
+        return 0
+
+
+class tpu:
+    """TPU introspection — the CUDAPlace analog."""
+
+    @staticmethod
+    def device_count():
+        import jax
+
+        return sum(1 for d in jax.devices() if d.platform in ("tpu", "axon"))
+
+    @staticmethod
+    def is_available():
+        return tpu.device_count() > 0
+
+    @staticmethod
+    def synchronize():
+        synchronize()
+
+    @staticmethod
+    def memory_stats(device=None):
+        import jax
+
+        devs = [d for d in jax.devices() if d.platform in ("tpu", "axon")]
+        if not devs:
+            return {}
+        try:
+            return devs[0].memory_stats() or {}
+        except Exception:
+            return {}

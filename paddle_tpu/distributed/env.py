@@ -1,0 +1,44 @@
+"""Process-level distributed environment (ref: PADDLE_TRAINER_ID /
+PADDLE_TRAINERS_NUM env contract, SURVEY.md §2.2 P21).
+
+On TPU, one process per host; jax.distributed supplies process_index/count
+once initialized. Before that (or single-host), the PADDLE_* env vars are
+honored so launcher-style scripts behave identically.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def get_rank():
+    try:
+        import jax
+
+        if jax.process_count() > 1:
+            return jax.process_index()
+    except Exception:
+        pass
+    return int(os.environ.get("PADDLE_TRAINER_ID", os.environ.get("RANK", 0)))
+
+
+def get_world_size():
+    try:
+        import jax
+
+        if jax.process_count() > 1:
+            return jax.process_count()
+    except Exception:
+        pass
+    return int(os.environ.get("PADDLE_TRAINERS_NUM", os.environ.get("WORLD_SIZE", 1)))
+
+
+def is_initialized():
+    return _INITIALIZED[0]
+
+
+_INITIALIZED = [False]
+
+
+def mark_initialized():
+    _INITIALIZED[0] = True

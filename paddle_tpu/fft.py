@@ -1,0 +1,63 @@
+"""paddle.fft parity over jnp.fft (ref: python/paddle/fft.py (U))."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.op_call import apply
+from .tensor.creation import _as_t
+
+
+def _mk(fn_name, jfn):
+    def f(x, n=None, axis=-1, norm="backward", name=None):
+        return apply(lambda a: jfn(a, n=n, axis=axis, norm=norm), _as_t(x), _op_name=fn_name)
+
+    f.__name__ = fn_name
+    return f
+
+
+fft = _mk("fft", jnp.fft.fft)
+ifft = _mk("ifft", jnp.fft.ifft)
+rfft = _mk("rfft", jnp.fft.rfft)
+irfft = _mk("irfft", jnp.fft.irfft)
+hfft = _mk("hfft", jnp.fft.hfft)
+ihfft = _mk("ihfft", jnp.fft.ihfft)
+
+
+def _mk_n(fn_name, jfn):
+    def f(x, s=None, axes=None, norm="backward", name=None):
+        ax = tuple(axes) if axes is not None else None
+        return apply(lambda a: jfn(a, s=s, axes=ax, norm=norm), _as_t(x), _op_name=fn_name)
+
+    f.__name__ = fn_name
+    return f
+
+
+fft2 = _mk_n("fft2", jnp.fft.fft2)
+ifft2 = _mk_n("ifft2", jnp.fft.ifft2)
+rfft2 = _mk_n("rfft2", jnp.fft.rfft2)
+irfft2 = _mk_n("irfft2", jnp.fft.irfft2)
+fftn = _mk_n("fftn", jnp.fft.fftn)
+ifftn = _mk_n("ifftn", jnp.fft.ifftn)
+rfftn = _mk_n("rfftn", jnp.fft.rfftn)
+irfftn = _mk_n("irfftn", jnp.fft.irfftn)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    from .core.tensor import Tensor
+
+    return Tensor(jnp.fft.fftfreq(n, d))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    from .core.tensor import Tensor
+
+    return Tensor(jnp.fft.rfftfreq(n, d))
+
+
+def fftshift(x, axes=None, name=None):
+    return apply(lambda a: jnp.fft.fftshift(a, axes=axes), _as_t(x))
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply(lambda a: jnp.fft.ifftshift(a, axes=axes), _as_t(x))

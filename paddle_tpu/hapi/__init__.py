@@ -1,0 +1,4 @@
+from .model import Model
+from .model_summary import summary
+from .dynamic_flops import flops
+from . import callbacks

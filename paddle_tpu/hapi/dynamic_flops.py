@@ -1,0 +1,41 @@
+"""paddle.flops parity (ref: python/paddle/hapi/dynamic_flops.py (U)) —
+analytic FLOPs count for the common layer types."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    import paddle_tpu as paddle
+    from ..nn.layer.common import Linear
+    from ..nn.layer.conv import _ConvNd
+
+    total = [0]
+    hooks = []
+
+    def count(layer, inputs, output):
+        from ..core.tensor import Tensor
+
+        x = inputs[0] if inputs else None
+        if custom_ops and type(layer) in custom_ops:
+            total[0] += custom_ops[type(layer)](layer, x, output)
+            return
+        if isinstance(layer, Linear):
+            total[0] += 2 * layer.weight.size * (x.size // x.shape[-1] if x is not None else 1)
+        elif isinstance(layer, _ConvNd):
+            if isinstance(output, Tensor):
+                out_el = output.size
+                total[0] += 2 * out_el * layer.weight.size // layer.weight.shape[0]
+
+    for l in net.sublayers(include_self=True):
+        hooks.append(l.register_forward_post_hook(count))
+    x = paddle.randn(list(input_size))
+    net.eval()
+    with paddle.no_grad():
+        net(x)
+    for h in hooks:
+        h.remove()
+    if print_detail:
+        print(f"Total FLOPs: {total[0]:,}")
+    return total[0]

@@ -1,0 +1,248 @@
+"""paddle.Model high-level API (ref: python/paddle/hapi/model.py (U)).
+
+fit/evaluate/predict over the dygraph core; when `prepare(jit=True)` (or
+Model(..., jit=True)) the inner loop runs through jit.TrainStep so the whole
+step is one XLA program — the hapi analog of the reference's
+`Model.prepare(...)+to_static` path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..core import tape as _tape
+from .callbacks import Callback, ProgBarLogger, ModelCheckpoint, LRScheduler as LRCallback
+from ..metric import Metric
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self.stop_training = False
+        self._train_step = None
+        self._use_jit = False
+
+    # -------------- setup --------------
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None, jit=False):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is None:
+            self._metrics = []
+        elif isinstance(metrics, Metric):
+            self._metrics = [metrics]
+        else:
+            self._metrics = list(metrics)
+        self._use_jit = jit
+        if jit and optimizer is not None and loss is not None:
+            from ..jit.train_step import TrainStep
+
+            loss_layer = loss
+
+            def loss_fn(net, *batch):
+                *xs, y = batch
+                out = net(*xs)
+                return loss_layer(out, y)
+
+            self._train_step = TrainStep(self.network, loss_fn, optimizer)
+        return self
+
+    # -------------- steps --------------
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = _to_list(inputs)
+        labels = _to_list(labels)
+        if self._train_step is not None and update:
+            loss = self._train_step(*inputs, *labels)
+            self._optimizer._lr_step()
+            return [float(loss)]
+        outs = self.network(*[_as_tensor(x) for x in inputs])
+        loss = self._loss(outs, *[_as_tensor(y) for y in labels]) if self._loss else outs
+        loss.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+            self._optimizer._lr_step()
+        return [float(loss)]
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = _to_list(inputs)
+        labels = _to_list(labels)
+        with _tape.no_grad():
+            outs = self.network(*[_as_tensor(x) for x in inputs])
+            metrics_out = []
+            loss_val = None
+            if self._loss is not None and labels:
+                loss_val = float(self._loss(outs, *[_as_tensor(y) for y in labels]))
+            for m in self._metrics:
+                corr = m.compute(outs, *[_as_tensor(y) for y in labels])
+                metrics_out.append(m.update(corr))
+        return loss_val, metrics_out
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        with _tape.no_grad():
+            outs = self.network(*[_as_tensor(x) for x in _to_list(inputs)])
+        return [o.numpy() for o in _to_list(outs)]
+
+    # -------------- loops --------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        loader = _as_loader(train_data, batch_size, shuffle, drop_last, num_workers)
+        eval_loader = _as_loader(eval_data, batch_size, False, False, num_workers) if eval_data is not None else None
+
+        cbs = [ProgBarLogger(log_freq, verbose=verbose), LRCallback()]
+        if save_dir:
+            cbs.append(ModelCheckpoint(save_freq, save_dir))
+        cbs += list(callbacks or [])
+        for cb in cbs:
+            cb.set_model(self)
+            cb.set_params({"epochs": epochs, "steps": _safe_len(loader), "verbose": verbose})
+
+        self.stop_training = False
+        for cb in cbs:
+            cb.on_train_begin()
+        step_count = 0
+        for epoch in range(epochs):
+            if hasattr(loader, "batch_sampler") and hasattr(loader.batch_sampler, "set_epoch"):
+                loader.batch_sampler.set_epoch(epoch)
+            for cb in cbs:
+                cb.on_epoch_begin(epoch)
+            logs = {}
+            for m in self._metrics:
+                m.reset()
+            for step, batch in enumerate(loader):
+                for cb in cbs:
+                    cb.on_train_batch_begin(step)
+                ins, lbls = _split_batch(batch)
+                losses = self.train_batch(ins, lbls)
+                logs = {"loss": losses}
+                logs["lr"] = self._optimizer.get_lr()
+                for cb in cbs:
+                    cb.on_train_batch_end(step, logs)
+                step_count += 1
+                if num_iters is not None and step_count >= num_iters:
+                    break
+            for cb in cbs:
+                cb.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                self._run_eval(eval_loader, cbs)
+            if self.stop_training or (num_iters is not None and step_count >= num_iters):
+                break
+        for cb in cbs:
+            cb.on_train_end(logs)
+
+    def _run_eval(self, loader, cbs):
+        for cb in cbs:
+            cb.on_eval_begin()
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for step, batch in enumerate(loader):
+            ins, lbls = _split_batch(batch)
+            loss, _ = self.eval_batch(ins, lbls)
+            if loss is not None:
+                losses.append(loss)
+        logs = {}
+        if losses:
+            logs["loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            name = m.name()
+            res = m.accumulate()
+            if isinstance(name, list):
+                for n, r in zip(name, res if isinstance(res, list) else [res]):
+                    logs[n] = r
+            else:
+                logs[name] = res
+        for cb in cbs:
+            cb.on_eval_end(logs)
+        return logs
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        loader = _as_loader(eval_data, batch_size, False, False, num_workers)
+        cbs = list(callbacks or [])
+        for cb in cbs:
+            cb.set_model(self)
+        return self._run_eval(loader, cbs)
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        loader = _as_loader(test_data, batch_size, False, False, num_workers)
+        outputs = []
+        for batch in loader:
+            ins, _ = _split_batch(batch)
+            outputs.append(self.predict_batch(ins))
+        if stack_outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([o[i] for o in outputs]) for i in range(n_out)]
+        return outputs
+
+    # -------------- persistence --------------
+    def save(self, path, training=True):
+        from ..framework.io import save as fsave
+
+        fsave(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            fsave(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        import os
+
+        from ..framework.io import load as fload
+
+        self.network.set_state_dict(fload(path + ".pdparams"))
+        if not reset_optimizer and self._optimizer is not None and os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(fload(path + ".pdopt"))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        from .model_summary import summary
+
+        return summary(self.network, input_size, dtypes=dtype)
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]
+
+
+def _as_tensor(x):
+    return x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+
+
+def _split_batch(batch):
+    if isinstance(batch, (list, tuple)) and len(batch) >= 2:
+        return list(batch[:-1]), [batch[-1]]
+    return _to_list(batch), []
+
+
+def _safe_len(loader):
+    try:
+        return len(loader)
+    except TypeError:
+        return None
+
+
+def _as_loader(data, batch_size, shuffle, drop_last, num_workers):
+    from ..io import DataLoader, Dataset
+
+    if data is None:
+        return None
+    if isinstance(data, DataLoader):
+        return data
+    if isinstance(data, Dataset):
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          drop_last=drop_last, num_workers=num_workers)
+    return data  # assume iterable of batches

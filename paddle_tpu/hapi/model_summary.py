@@ -1,0 +1,31 @@
+"""paddle.summary parity (ref: python/paddle/hapi/model_summary.py (U))."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    rows = []
+    total_params = 0
+    trainable_params = 0
+    for name, layer in net.named_sublayers(include_self=True):
+        n_params = sum(p.size for p in layer._parameters.values() if p is not None)
+        if n_params == 0 and layer._sub_layers:
+            continue
+        total_params += n_params
+        trainable_params += sum(
+            p.size for p in layer._parameters.values() if p is not None and p.trainable
+        )
+        rows.append((name or type(layer).__name__, type(layer).__name__, n_params))
+    width = max((len(r[0]) for r in rows), default=10) + 2
+    lines = [f"{'Layer':<{width}}{'Type':<28}{'Params':>12}", "-" * (width + 40)]
+    for name, ty, n in rows:
+        lines.append(f"{name:<{width}}{ty:<28}{n:>12,}")
+    lines.append("-" * (width + 40))
+    lines.append(f"Total params: {total_params:,}")
+    lines.append(f"Trainable params: {trainable_params:,}")
+    print("\n".join(lines))
+    return {"total_params": total_params, "trainable_params": trainable_params}

@@ -1,0 +1,2 @@
+from . import functional
+from .layer import FusedMultiHeadAttention, FusedFeedForward, FusedTransformerEncoderLayer

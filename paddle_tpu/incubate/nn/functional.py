@@ -1,0 +1,176 @@
+"""Fused functional entry points (ref: python/paddle/incubate/nn/functional/
+(U): fused_multi_head_attention, fused_feedforward, fused_rotary_position_
+embedding, fused_rms_norm, fused_layer_norm, fused_linear, ...).
+
+TPU stance: "fused" = routed through the Pallas kernel layer (paddle_tpu.ops)
+or expressed so XLA's fusion pass emits one kernel. Signatures mirror the
+reference so incubate users can switch without edits.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.op_call import apply
+from ...core.tensor import Tensor
+from ...tensor.creation import _as_t
+from ...nn import functional as F
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    if transpose_weight:
+        from ...tensor.manipulation import t as _t
+
+        weight = _t(weight)
+    return F.linear(x, weight, bias)
+
+
+def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False, activation="gelu"):
+    from ...tensor.math import matmul
+
+    out = matmul(x, y, transpose_x=trans_x, transpose_y=trans_y)
+    out = out + bias
+    return getattr(F, activation)(out)
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False, name=None):
+    from ...tensor.math import matmul
+
+    out = matmul(x, y, transpose_x=transpose_x, transpose_y=transpose_y)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def fused_bias_act(x, bias=None, dequant_scales=None, shift=None, smooth=None,
+                   act_method="gelu", **kw):
+    if bias is not None:
+        x = x + bias
+    return getattr(F, act_method)(x)
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5, residual_alpha=1.0,
+                     begin_norm_axis=1, bias=None, residual=None, quant_scale=-1, **kw):
+    if bias is not None:
+        x = x + bias
+    if residual is not None:
+        x = x + residual * residual_alpha
+    x_t = _as_t(x)
+    norm_shape = tuple(x_t.shape[begin_norm_axis:])
+    out = F.layer_norm(x_t, list(norm_shape), norm_weight, norm_bias, epsilon)
+    return out
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6, begin_norm_axis=1,
+                   bias=None, residual=None, **kw):
+    if bias is not None:
+        x = x + bias
+    if residual is not None:
+        x = x + residual
+    from ...ops.rms_norm import rms_norm as pallas_rms
+
+    return pallas_rms(x, norm_weight, norm_bias, epsilon, begin_norm_axis)
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train", name=None):
+    return F.dropout(x, p, training=training, mode=mode) + y
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True,
+                                    time_major=False, rotary_emb_base=10000.0):
+    """paddle.incubate.nn.functional.fused_rotary_position_embedding parity.
+    q/k/v: [batch, seq, heads, head_dim]."""
+    from ...ops.rope import apply_rotary_emb
+
+    outs = []
+    for t in (q, k, v):
+        if t is None:
+            outs.append(None)
+            continue
+        outs.append(apply_rotary_emb(t, sin=sin, cos=cos, position_ids=position_ids,
+                                     neox=use_neox_rotary_style, base=rotary_emb_base))
+    return tuple(outs)
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=False,
+                               pre_ln_scale=None, pre_ln_bias=None, ln_scale=None,
+                               ln_bias=None, pre_ln_epsilon=1e-5, qkv_bias=None,
+                               linear_bias=None, cache_kv=None, attn_mask=None,
+                               dropout_rate=0.5, attn_dropout_rate=0.5, ln_epsilon=1e-5,
+                               training=True, mode="upscale_in_train", ring_id=-1,
+                               add_residual=True, num_heads=None, transpose_qkv_wb=False,
+                               name=None):
+    """Fused MHA block parity (ref: fused_attention_op.cu behavior): optional
+    pre-LN -> qkv -> flash attention -> out proj -> dropout -> residual (+LN)."""
+    x = _as_t(x)
+    residual = x
+    if pre_layer_norm:
+        ln_shape = [x.shape[-1]]
+        x = F.layer_norm(x, ln_shape, pre_ln_scale, pre_ln_bias, pre_ln_epsilon)
+    qkvw = _as_t(qkv_weight)
+    b, s, e = x.shape
+    if transpose_qkv_wb:
+        # weight [e, 3e]
+        qkv = F.linear(x, qkvw, qkv_bias)
+        n_heads = num_heads
+        head_dim = e // n_heads
+        qkv_r = qkv.reshape([b, s, 3, n_heads, head_dim])
+    else:
+        # weight [3, n_heads, head_dim, e]
+        n_heads = qkvw.shape[1]
+        head_dim = qkvw.shape[2]
+        from ...tensor.einsum import einsum
+
+        qkv_r = einsum("bse,tnde->bstnd", x, qkvw)
+        if qkv_bias is not None:
+            qkv_r = qkv_r + _as_t(qkv_bias).reshape([1, 1, 3, n_heads, head_dim])
+    q = qkv_r[:, :, 0]
+    k = qkv_r[:, :, 1]
+    v = qkv_r[:, :, 2]
+    ctx = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
+                                         dropout_p=attn_dropout_rate, training=training)
+    ctx = ctx.reshape([b, s, n_heads * head_dim])
+    out = F.linear(ctx, linear_weight, linear_bias)
+    out = F.dropout(out, dropout_rate, training=training, mode=mode)
+    if add_residual:
+        out = residual + out
+    if not pre_layer_norm:
+        out = F.layer_norm(out, [out.shape[-1]], ln_scale, ln_bias, ln_epsilon)
+    return out
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None, ln2_scale=None,
+                      ln2_bias=None, dropout1_rate=0.5, dropout2_rate=0.5,
+                      activation="relu", ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True, mode="upscale_in_train",
+                      ring_id=-1, name=None):
+    x = _as_t(x)
+    residual = x
+    if pre_layer_norm:
+        x = F.layer_norm(x, [x.shape[-1]], ln1_scale, ln1_bias, ln1_epsilon)
+    out = F.linear(x, linear1_weight, linear1_bias)
+    out = getattr(F, activation)(out)
+    out = F.dropout(out, dropout1_rate, training=training, mode=mode)
+    out = F.linear(out, linear2_weight, linear2_bias)
+    out = F.dropout(out, dropout2_rate, training=training, mode=mode)
+    out = residual + out
+    if not pre_layer_norm:
+        out = F.layer_norm(out, [out.shape[-1]], ln2_scale, ln2_bias, ln2_epsilon)
+    return out
+
+
+def fused_multi_transformer(*args, **kwargs):
+    raise NotImplementedError(
+        "fused_multi_transformer (inference generation loop) lands with the "
+        "serving path; use models.gpt with cache-based decode meanwhile"
+    )
+
+
+def masked_multihead_attention(*args, **kwargs):
+    raise NotImplementedError("use F.scaled_dot_product_attention with a mask")
+
+
+def fused_ec_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias, act_type="gelu"):
+    raise NotImplementedError("MoE lands with distributed.moe (expert-parallel layer)")

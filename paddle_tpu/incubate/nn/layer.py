@@ -1,0 +1,119 @@
+"""Fused transformer layers (ref: python/paddle/incubate/nn/layer/
+fused_transformer.py (U)) — same API, computing through the fused functional
+entry points."""
+
+from __future__ import annotations
+
+from ...nn.layer.layers import Layer
+from ...nn.initializer import XavierUniform, Constant
+from . import functional as IF
+
+
+class FusedMultiHeadAttention(Layer):
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5, attn_dropout_rate=0.5,
+                 kdim=None, vdim=None, normalize_before=False, need_weights=False,
+                 qkv_weight_attr=None, qkv_bias_attr=None, linear_weight_attr=None,
+                 linear_bias_attr=None, pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None, epsilon=1e-5, nranks=1,
+                 ring_id=-1, transpose_qkv_wb=False, name=None):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        self.normalize_before = normalize_before
+        self._epsilon = epsilon
+        self._transpose_qkv_wb = transpose_qkv_wb
+        if transpose_qkv_wb:
+            self.qkv_weight = self.create_parameter([embed_dim, 3 * embed_dim],
+                                                    attr=qkv_weight_attr,
+                                                    default_initializer=XavierUniform())
+            self.qkv_bias = self.create_parameter([3 * embed_dim], attr=qkv_bias_attr, is_bias=True)
+        else:
+            self.qkv_weight = self.create_parameter([3, num_heads, self.head_dim, embed_dim],
+                                                    attr=qkv_weight_attr,
+                                                    default_initializer=XavierUniform())
+            self.qkv_bias = self.create_parameter([3 * embed_dim], attr=qkv_bias_attr, is_bias=True)
+        self.linear_weight = self.create_parameter([embed_dim, embed_dim], attr=linear_weight_attr,
+                                                   default_initializer=XavierUniform())
+        self.linear_bias = self.create_parameter([embed_dim], attr=linear_bias_attr, is_bias=True)
+        self.pre_ln_scale = self.create_parameter([embed_dim], attr=pre_ln_scale_attr,
+                                                  default_initializer=Constant(1.0))
+        self.pre_ln_bias = self.create_parameter([embed_dim], attr=pre_ln_bias_attr, is_bias=True)
+        self.ln_scale = self.create_parameter([embed_dim], attr=ln_scale_attr,
+                                              default_initializer=Constant(1.0))
+        self.ln_bias = self.create_parameter([embed_dim], attr=ln_bias_attr, is_bias=True)
+
+    def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
+        return IF.fused_multi_head_attention(
+            query, self.qkv_weight, self.linear_weight,
+            pre_layer_norm=self.normalize_before,
+            pre_ln_scale=self.pre_ln_scale, pre_ln_bias=self.pre_ln_bias,
+            ln_scale=self.ln_scale, ln_bias=self.ln_bias,
+            qkv_bias=self.qkv_bias, linear_bias=self.linear_bias,
+            attn_mask=attn_mask, dropout_rate=self.dropout_rate,
+            attn_dropout_rate=self.attn_dropout_rate, training=self.training,
+            num_heads=self.num_heads, transpose_qkv_wb=self._transpose_qkv_wb,
+        )
+
+
+class FusedFeedForward(Layer):
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1, epsilon=1e-5,
+                 activation="relu", act_dropout_rate=None, normalize_before=False,
+                 linear1_weight_attr=None, linear1_bias_attr=None,
+                 linear2_weight_attr=None, linear2_bias_attr=None,
+                 ln1_scale_attr=None, ln1_bias_attr=None, ln2_scale_attr=None,
+                 ln2_bias_attr=None, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self._activation = activation
+        self._dropout_rate = dropout_rate
+        self._act_dropout_rate = dropout_rate if act_dropout_rate is None else act_dropout_rate
+        self._epsilon = epsilon
+        self._normalize_before = normalize_before
+        self.linear1_weight = self.create_parameter([d_model, dim_feedforward],
+                                                    attr=linear1_weight_attr,
+                                                    default_initializer=XavierUniform())
+        self.linear1_bias = self.create_parameter([dim_feedforward], attr=linear1_bias_attr, is_bias=True)
+        self.linear2_weight = self.create_parameter([dim_feedforward, d_model],
+                                                    attr=linear2_weight_attr,
+                                                    default_initializer=XavierUniform())
+        self.linear2_bias = self.create_parameter([d_model], attr=linear2_bias_attr, is_bias=True)
+        self.ln1_scale = self.create_parameter([d_model], attr=ln1_scale_attr,
+                                               default_initializer=Constant(1.0))
+        self.ln1_bias = self.create_parameter([d_model], attr=ln1_bias_attr, is_bias=True)
+        self.ln2_scale = self.create_parameter([d_model], attr=ln2_scale_attr,
+                                               default_initializer=Constant(1.0))
+        self.ln2_bias = self.create_parameter([d_model], attr=ln2_bias_attr, is_bias=True)
+
+    def forward(self, src, cache=None):
+        return IF.fused_feedforward(
+            src, self.linear1_weight, self.linear2_weight, self.linear1_bias,
+            self.linear2_bias, self.ln1_scale, self.ln1_bias, self.ln2_scale,
+            self.ln2_bias, self._act_dropout_rate, self._dropout_rate,
+            self._activation, self._epsilon, self._epsilon,
+            self._normalize_before, self.training,
+        )
+
+
+class FusedTransformerEncoderLayer(Layer):
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None, act_dropout_rate=None,
+                 normalize_before=False, weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead,
+            dropout_rate=dropout_rate,
+            attn_dropout_rate=dropout_rate if attn_dropout_rate is None else attn_dropout_rate,
+            normalize_before=normalize_before,
+        )
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation,
+            act_dropout_rate=dropout_rate if act_dropout_rate is None else act_dropout_rate,
+            normalize_before=normalize_before,
+        )
+
+    def forward(self, src, src_mask=None, cache=None):
+        out = self.fused_attn(src, attn_mask=src_mask)
+        return self.ffn(out)

@@ -1,0 +1,40 @@
+"""paddle.inference parity (ref: AnalysisPredictor, SURVEY.md §2.1 N19 —
+declared out of core scope there; this shim serves the API so inference
+scripts can load jit-saved StableHLO artifacts)."""
+
+from __future__ import annotations
+
+
+class Config:
+    def __init__(self, model_path=None, params_path=None):
+        self.model_path = model_path
+
+    def enable_use_gpu(self, *a, **k):
+        pass
+
+    def disable_gpu(self):
+        pass
+
+    def switch_ir_optim(self, flag=True):
+        pass
+
+    def enable_memory_optim(self):
+        pass
+
+
+class Predictor:
+    def __init__(self, config):
+        from ..jit.api import load as jit_load
+
+        prefix = config.model_path
+        if prefix and prefix.endswith(".pdmodel"):
+            prefix = prefix[: -len(".pdmodel")]
+        self._layer = jit_load(prefix)
+
+    def run(self, inputs):
+        outs = self._layer(*inputs)
+        return list(outs) if isinstance(outs, (list, tuple)) else [outs]
+
+
+def create_predictor(config):
+    return Predictor(config)

@@ -1,0 +1,9 @@
+from .dataset import (
+    Dataset, IterableDataset, TensorDataset, ComposeDataset, ZipDataset,
+    ConcatDataset, ChainDataset, Subset, random_split,
+)
+from .sampler import (
+    Sampler, SequenceSampler, RandomSampler, WeightedRandomSampler,
+    SubsetRandomSampler, BatchSampler, DistributedBatchSampler,
+)
+from .dataloader import DataLoader, default_collate_fn, get_worker_info

@@ -1,0 +1,163 @@
+"""DataLoader (ref: python/paddle/io/dataloader/dataloader_iter.py (U)).
+
+TPU-native design: the reference's multiprocess workers + pinned-memory +
+CUDA-stream H2D pipeline becomes a threaded prefetch pipeline feeding
+device_put — on TPU VMs the host is roomy and jax transfers are async, so
+worker *threads* (NumPy releases the GIL) plus a bounded prefetch queue give
+the same overlap without fork/IPC fragility. A native C++ prefetcher can slot
+under `paddle_tpu.utils.hostloader` for decode-heavy pipelines.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .dataset import IterableDataset
+from .sampler import BatchSampler
+
+
+def default_collate_fn(batch):
+    sample = batch[0]
+    if isinstance(sample, np.ndarray):
+        return Tensor(np.stack(batch))
+    if isinstance(sample, Tensor):
+        return Tensor(np.stack([np.asarray(s._data) for s in batch]))
+    if isinstance(sample, (int, np.integer)):
+        return Tensor(np.asarray(batch, np.int64 if False else np.int32))
+    if isinstance(sample, float):
+        return Tensor(np.asarray(batch, np.float32))
+    if isinstance(sample, (list, tuple)):
+        transposed = list(zip(*batch))
+        return type(sample)(default_collate_fn(list(items)) for items in transposed)
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([d[k] for d in batch]) for k in sample}
+    if isinstance(sample, str):
+        return list(batch)
+    return Tensor(np.asarray(batch))
+
+
+class DataLoader:
+    def __init__(self, dataset, feed_list=None, places=None, return_list=True,
+                 batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
+                 collate_fn=None, num_workers=0, use_buffer_reader=True,
+                 prefetch_factor=2, use_shared_memory=True, timeout=0,
+                 worker_init_fn=None, persistent_workers=False):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = max(prefetch_factor, 1)
+        self.timeout = timeout
+        self._iterable = isinstance(dataset, IterableDataset)
+        if self._iterable:
+            self.batch_size = batch_size
+            self.batch_sampler = None
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            if batch_size is None:
+                self.batch_sampler = None
+                self.batch_size = None
+            else:
+                self.batch_sampler = BatchSampler(
+                    dataset, shuffle=shuffle, batch_size=batch_size, drop_last=drop_last
+                )
+
+    def __len__(self):
+        if self._iterable:
+            raise TypeError("length of IterableDataset DataLoader is unknown")
+        if self.batch_sampler is None:
+            return len(self.dataset)
+        return len(self.batch_sampler)
+
+    # ---------------- iteration ----------------
+    def _fetch(self, indices):
+        return self.collate_fn([self.dataset[i] for i in indices])
+
+    def _iter_sync(self):
+        if self._iterable:
+            batch = []
+            for sample in self.dataset:
+                batch.append(sample)
+                if len(batch) == self.batch_size:
+                    yield self.collate_fn(batch)
+                    batch = []
+            if batch and not self.drop_last:
+                yield self.collate_fn(batch)
+            return
+        if self.batch_sampler is None:
+            for i in range(len(self.dataset)):
+                yield self.collate_fn([self.dataset[i]])
+            return
+        for indices in self.batch_sampler:
+            yield self._fetch(indices)
+
+    def _iter_threaded(self):
+        """num_workers>0: worker threads fetch+collate; a bounded queue keeps
+        `num_workers * prefetch_factor` batches in flight, preserving order."""
+        index_iter = iter(self.batch_sampler)
+        max_inflight = self.num_workers * self.prefetch_factor
+        results = {}
+        results_lock = threading.Condition()
+        task_q = queue.Queue()
+        n_submitted = 0
+        n_consumed = 0
+        done_submitting = False
+
+        def worker():
+            while True:
+                item = task_q.get()
+                if item is None:
+                    return
+                seq, indices = item
+                try:
+                    out = self._fetch(indices)
+                except Exception as e:  # propagate to consumer
+                    out = e
+                with results_lock:
+                    results[seq] = out
+                    results_lock.notify_all()
+
+        threads = [threading.Thread(target=worker, daemon=True) for _ in range(self.num_workers)]
+        for t in threads:
+            t.start()
+        try:
+            # prime
+            for _ in range(max_inflight):
+                try:
+                    task_q.put((n_submitted, next(index_iter)))
+                    n_submitted += 1
+                except StopIteration:
+                    done_submitting = True
+                    break
+            while n_consumed < n_submitted or not done_submitting:
+                with results_lock:
+                    while n_consumed not in results:
+                        results_lock.wait(timeout=self.timeout or None)
+                    out = results.pop(n_consumed)
+                n_consumed += 1
+                if isinstance(out, Exception):
+                    raise out
+                if not done_submitting:
+                    try:
+                        task_q.put((n_submitted, next(index_iter)))
+                        n_submitted += 1
+                    except StopIteration:
+                        done_submitting = True
+                yield out
+        finally:
+            for _ in threads:
+                task_q.put(None)
+
+    def __iter__(self):
+        if self.num_workers and self.num_workers > 0 and not self._iterable and self.batch_sampler is not None:
+            return self._iter_threaded()
+        return self._iter_sync()
+
+
+def get_worker_info():
+    return None

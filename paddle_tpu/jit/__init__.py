@@ -1,0 +1,5 @@
+from .api import (
+    to_static, not_to_static, save, load, InputSpec, StaticFunction,
+    TranslatedLayer, enable_to_static, ignore_module,
+)
+from .train_step import TrainStep

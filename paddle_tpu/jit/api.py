@@ -1,0 +1,315 @@
+"""paddle.jit parity: to_static, save, load, TrainStep.
+
+Reference mapping (SURVEY.md §3.4): the dy2static AST/bytecode translator +
+ProgramDesc + InterpreterCore + CINN pipeline collapses to `jax.jit` — the
+tape-based eager ops are themselves traceable, so tracing the user's Python
+callable once yields the whole fwd(+bwd+step) as one XLA program. What remains
+of the subsystem is the ergonomics: input-spec caching, state
+functionalization (parameters/buffers in, updated buffers out), RNG threading,
+and save/load of compiled artifacts via jax.export (the .pdmodel analog is a
+serialized StableHLO module).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import pickle
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, Parameter
+from ..core import tape as _tape
+from ..core import random_state
+from ..nn.layer.layers import Layer
+
+
+class InputSpec:
+    """paddle.static.InputSpec parity."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        from ..core.dtype import to_jax_dtype
+
+        self.shape = list(shape)
+        self.dtype = to_jax_dtype(dtype)
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, str(tensor.dtype), name)
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+
+def _spec_key(args, kwargs):
+    def leaf_key(a):
+        if isinstance(a, Tensor):
+            return ("T", tuple(a._data.shape), str(a._data.dtype))
+        if isinstance(a, (np.ndarray,)):
+            return ("A", a.shape, str(a.dtype))
+        if isinstance(a, (list, tuple)):
+            return tuple(leaf_key(x) for x in a)
+        return ("S", repr(a))
+
+    return (tuple(leaf_key(a) for a in args),
+            tuple(sorted((k, leaf_key(v)) for k, v in kwargs.items())))
+
+
+class StaticFunction:
+    """Callable wrapping fn (optionally bound to a Layer) with jit caching."""
+
+    def __init__(self, function, layer=None, input_spec=None, full_graph=True):
+        self._fn = function
+        self._layer = layer
+        self._input_spec = input_spec
+        self._cache = {}
+        functools.update_wrapper(self, function)
+
+    @property
+    def function(self):
+        return self._fn
+
+    def concrete_program_specified_input_spec(self, *a, **k):
+        return None
+
+    def _build(self, tree_args, tree_kwargs):
+        layer = self._layer
+        fn = self._fn
+
+        state_names = list(layer.state_dict().keys()) if layer is not None else []
+
+        def array_fn(rng_key, state_arrays, *flat_arrays):
+            args, kwargs = _unflatten_args(tree_args, tree_kwargs, flat_arrays)
+            with random_state.fork_rng(rng_key):
+                if layer is not None:
+                    arrays = dict(zip(state_names, state_arrays))
+                    with layer.use_state(arrays):
+                        out = fn(*args, **kwargs)
+                        new_state = [layer.state_dict()[k]._data for k in state_names]
+                else:
+                    out = fn(*args, **kwargs)
+                    new_state = []
+            out_flat, out_tree = _flatten_out(out)
+            return tuple(o._data if isinstance(o, Tensor) else o for o in out_flat), tuple(new_state), out_tree
+
+        # out_tree is trace-time static; capture via cell
+        out_tree_box = {}
+
+        def jittable(rng_key, state_arrays, *flat_arrays):
+            outs, new_state, out_tree = array_fn(rng_key, state_arrays, *flat_arrays)
+            out_tree_box["tree"] = out_tree
+            return outs, new_state
+
+        return jax.jit(jittable), out_tree_box, state_names
+
+    def __call__(self, *args, **kwargs):
+        key = _spec_key(args, kwargs)
+        if key not in self._cache:
+            tree_args, tree_kwargs = _make_tree(args, kwargs)
+            self._cache[key] = self._build(tree_args, tree_kwargs)
+        jitted, out_tree_box, state_names = self._cache[key]
+
+        flat = _flatten_args(args, kwargs)
+        rng_key = random_state.next_key()
+        if self._layer is not None:
+            sd = self._layer.state_dict()
+            state_arrays = [sd[k]._data for k in state_names]
+        else:
+            state_arrays = []
+        outs, new_state = jitted(rng_key, state_arrays, *flat)
+        if self._layer is not None:
+            sd = self._layer.state_dict()
+            for k, arr in zip(state_names, new_state):
+                sd[k]._data = arr
+        out_tensors = [Tensor(o) for o in outs]
+        return _unflatten_tree(out_tree_box["tree"], out_tensors)
+
+    # paddle API surface
+    def get_concrete_program(self, *args, **kwargs):
+        return None
+
+    @property
+    def program_cache(self):
+        return self._cache
+
+
+def _make_tree(args, kwargs):
+    """Record positions of Tensors; everything else is a static constant."""
+
+    def conv(a):
+        if isinstance(a, Tensor):
+            return ("leaf",)
+        if isinstance(a, np.ndarray):
+            return ("leaf_np",)
+        if isinstance(a, (list, tuple)):
+            return ("seq", type(a).__name__, [conv(x) for x in a])
+        return ("const", a)
+
+    return [conv(a) for a in args], {k: conv(v) for k, v in kwargs.items()}
+
+
+def _flatten_args(args, kwargs):
+    flat = []
+
+    def walk(a):
+        if isinstance(a, Tensor):
+            flat.append(a._data)
+        elif isinstance(a, np.ndarray):
+            flat.append(jnp.asarray(a))
+        elif isinstance(a, (list, tuple)):
+            for x in a:
+                walk(x)
+
+    for a in args:
+        walk(a)
+    for k in sorted(kwargs):
+        walk(kwargs[k])
+    return flat
+
+
+def _unflatten_args(tree_args, tree_kwargs, flat):
+    it = iter(flat)
+
+    def build(node):
+        tag = node[0]
+        if tag in ("leaf", "leaf_np"):
+            return Tensor(next(it))
+        if tag == "seq":
+            seq = [build(x) for x in node[2]]
+            return tuple(seq) if node[1] == "tuple" else seq
+        return node[1]
+
+    args = [build(n) for n in tree_args]
+    kwargs = {}
+    for k in sorted(tree_kwargs):
+        kwargs[k] = build(tree_kwargs[k])
+    return args, kwargs
+
+
+def _flatten_out(out):
+    flat, tree = [], None
+
+    def conv(o):
+        if isinstance(o, Tensor):
+            flat.append(o)
+            return ("leaf", len(flat) - 1)
+        if isinstance(o, (list, tuple)):
+            return ("seq", type(o).__name__, [conv(x) for x in o])
+        if isinstance(o, dict):
+            return ("dict", {k: conv(v) for k, v in o.items()})
+        return ("const", o)
+
+    tree = conv(out)
+    return flat, tree
+
+
+def _unflatten_tree(tree, tensors):
+    def build(node):
+        tag = node[0]
+        if tag == "leaf":
+            return tensors[node[1]]
+        if tag == "seq":
+            seq = [build(x) for x in node[2]]
+            return tuple(seq) if node[1] == "tuple" else seq
+        if tag == "dict":
+            return {k: build(v) for k, v in node[1].items()}
+        return node[1]
+
+    return build(tree)
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
+              full_graph=True, **kwargs):
+    """Decorator/wrapper: compile a function or a Layer's forward with XLA."""
+
+    def decorate(obj):
+        if isinstance(obj, Layer):
+            static = StaticFunction(obj.forward, layer=obj, input_spec=input_spec)
+            obj.forward = static
+            return obj
+        return StaticFunction(obj, layer=None, input_spec=input_spec)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+def ignore_module(modules):
+    pass
+
+
+def enable_to_static(flag):
+    pass
+
+
+# ---------------------------------------------------------------- save/load
+def save(layer, path, input_spec=None, **configs):
+    """jit.save parity: weights (.pdiparams analog) + a serialized StableHLO
+    inference function via jax.export (.pdmodel analog)."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    from ..framework.io import save as fsave
+
+    if isinstance(layer, Layer):
+        fsave(layer.state_dict(), path + ".pdiparams")
+        if input_spec:
+            sd = layer.state_dict()
+            names = list(sd.keys())
+
+            def infer_fn(state_arrays, *arg_arrays):
+                arrays = dict(zip(names, state_arrays))
+                with _tape.no_grad():
+                    with layer.use_state(arrays):
+                        out = layer(*[Tensor(a) for a in arg_arrays])
+                outs = out if isinstance(out, (list, tuple)) else [out]
+                return tuple(o._data for o in outs)
+
+            example_args = [
+                jnp.zeros([1 if (s is None or s < 0) else s for s in spec.shape], spec.dtype)
+                for spec in input_spec
+            ]
+            state_arrays = [sd[k]._data for k in names]
+            exported = jax.export.export(jax.jit(infer_fn))(state_arrays, *example_args)
+            with open(path + ".pdmodel", "wb") as f:
+                blob = {
+                    "stablehlo": exported.serialize(),
+                    "input_spec": [(list(s.shape), str(np.dtype(s.dtype) if s.dtype != jnp.bfloat16 else "bfloat16")) for s in input_spec],
+                    "state_names": names,
+                }
+                pickle.dump(blob, f)
+    else:
+        raise TypeError("jit.save expects a Layer")
+
+
+class TranslatedLayer(Layer):
+    """jit.load result: runs the deserialized StableHLO program."""
+
+    def __init__(self, exported, state_arrays):
+        super().__init__()
+        self._exported = exported
+        self._state_arrays = state_arrays
+
+    def forward(self, *args):
+        arrs = [a._data if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
+        outs = self._exported.call(self._state_arrays, *arrs)
+        outs = [Tensor(o) for o in outs]
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def load(path, **configs):
+    with open(path + ".pdmodel", "rb") as f:
+        blob = pickle.load(f)
+    exported = jax.export.deserialize(blob["stablehlo"])
+    from ..framework.io import load as fload
+
+    sd = fload(path + ".pdiparams")
+    state_arrays = [sd[k]._data for k in blob["state_names"]]
+    return TranslatedLayer(exported, state_arrays)

@@ -1,0 +1,114 @@
+"""TrainStep: the whole training step (forward + backward + optimizer) as ONE
+compiled XLA program.
+
+This is the TPU performance path that replaces the reference's
+to_static-training + CINN pipeline (SURVEY.md §3.4): parameters and optimizer
+state are functionalized into explicit pytree arguments (donated, so updates
+are in-place in HBM), the tape runs at trace time, and XLA fuses fwd+bwd+adam
+across the step. The same object also powers fleet.distributed_model's jitted
+path, where `shardings` place params/batch on a mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import tape as _tape
+from ..core import random_state
+
+
+class TrainStep:
+    def __init__(self, model, loss_fn, optimizer, scaler=None, donate=True,
+                 mesh=None, in_shardings=None):
+        """loss_fn(model, *batch_tensors) -> loss Tensor (scalar)."""
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.donate = donate
+        self.mesh = mesh
+        self._jitted = None
+        self._param_names = None
+        self._buffer_names = None
+
+    def _ensure_states(self):
+        # materialize optimizer accumulators before tracing
+        for p in self.optimizer._parameter_list:
+            self.optimizer._state_for(p)
+
+    def _build(self):
+        model = self.model
+        opt = self.optimizer
+        sd = model.state_dict()
+        params = {n: t for n, t in sd.items() if isinstance(t, Tensor) and not t.stop_gradient}
+        buffers = {n: t for n, t in sd.items() if n not in params}
+        self._param_names = list(params.keys())
+        self._buffer_names = list(buffers.keys())
+        name_by_id = {id(p): n for n, p in params.items()}
+        loss_fn = self.loss_fn
+
+        def step_fn(param_arrays, buffer_arrays, opt_states, lr, rng_key, *batch):
+            arrays = dict(zip(self._param_names, param_arrays))
+            arrays.update(zip(self._buffer_names, buffer_arrays))
+            with random_state.fork_rng(rng_key):
+                with model.use_state(arrays):
+                    sd_live = model.state_dict()
+                    live_params = [sd_live[n] for n in self._param_names]
+                    for p in live_params:
+                        p.grad = None
+                    loss = loss_fn(model, *[Tensor(b) for b in batch])
+                    loss.backward()
+                    params_grads = [(p, p.grad) for p in live_params if p.grad is not None]
+                    if opt._grad_clip is not None:
+                        params_grads = opt._grad_clip(params_grads)
+                    grad_by_id = {id(p): g for p, g in params_grads}
+                    new_params = []
+                    new_opt_states = []
+                    with _tape.no_grad():
+                        for n, st in zip(self._param_names, opt_states):
+                            p = sd_live[n]
+                            g = grad_by_id.get(id(p))
+                            if g is None:
+                                new_params.append(p._data)
+                                new_opt_states.append(st)
+                                continue
+                            plr = lr * p.optimize_attr.get("learning_rate", 1.0)
+                            np_, nst = opt._update(p._data, g._data, st, plr)
+                            new_params.append(np_)
+                            new_opt_states.append(nst)
+                    new_buffers = [model.state_dict()[n]._data for n in self._buffer_names]
+                    # clear tracer grads so they don't leak out of the trace
+                    for p in live_params:
+                        p.grad = None
+            return new_params, new_buffers, new_opt_states, loss._data
+
+        donate = (0, 2) if self.donate else ()
+        self._jitted = jax.jit(step_fn, donate_argnums=donate)
+
+    def __call__(self, *batch):
+        if self._jitted is None:
+            self._ensure_states()
+            self._build()
+        sd = self.model.state_dict()
+        param_arrays = [sd[n]._data for n in self._param_names]
+        buffer_arrays = [sd[n]._data for n in self._buffer_names]
+        opt = self.optimizer
+        opt_states = [opt._accumulators[id(sd[n])] if id(sd[n]) in opt._accumulators
+                      else opt._state_for(sd[n]) for n in self._param_names]
+        lr = jnp.asarray(opt.get_lr(), jnp.float32)
+        rng_key = random_state.next_key()
+        batch_arrays = [b._data if isinstance(b, Tensor) else jnp.asarray(b) for b in batch]
+        new_params, new_buffers, new_opt_states, loss = self._jitted(
+            param_arrays, buffer_arrays, opt_states, lr, rng_key, *batch_arrays
+        )
+        for n, arr in zip(self._param_names, new_params):
+            sd[n]._data = arr
+        for n, arr in zip(self._buffer_names, new_buffers):
+            sd[n]._data = arr
+        for n, st in zip(self._param_names, new_opt_states):
+            opt._accumulators[id(sd[n])] = st
+        opt._step_count += 1
+        return Tensor(loss)

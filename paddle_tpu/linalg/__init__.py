@@ -1,0 +1,8 @@
+"""paddle.linalg namespace (ref: python/paddle/linalg.py (U))."""
+
+from ..tensor.linalg import (
+    matmul, dot, cross, norm, vector_norm, matrix_norm, cond, det, slogdet,
+    inv, pinv, svd, svdvals, qr, eig, eigh, eigvals, eigvalsh, cholesky,
+    cholesky_solve, solve, triangular_solve, lstsq, lu, matrix_power,
+    matrix_rank, multi_dot, pca_lowrank, corrcoef, cov, householder_product,
+)

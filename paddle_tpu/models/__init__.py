@@ -1,0 +1,10 @@
+"""Model zoo (the PaddleNLP/ppdiffusers-analog families, in-repo since the
+TPU build is self-contained): transformer LMs (ERNIE/LLaMA-style), BERT,
+and the diffusion UNet."""
+
+from . import gpt
+from . import bert
+from . import unet
+from .gpt import GPTConfig, GPTModel, GPTForCausalLM, ERNIE_7B, LLAMA2_13B
+from .bert import BertConfig, BertModel, BertForMaskedLM
+from .unet import UNetConfig, UNet2DConditionModel

@@ -1,0 +1,8 @@
+"""paddle.onnx stub: on the TPU build the export interchange format is
+StableHLO via paddle_tpu.jit.save (jax.export), not ONNX."""
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    raise NotImplementedError(
+        "ONNX export is replaced by StableHLO export: use paddle_tpu.jit.save"
+    )

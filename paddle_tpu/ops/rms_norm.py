@@ -1,0 +1,45 @@
+"""Fused RMSNorm op (ref: fused_rms_norm CUDA kernel in
+paddle/phi/kernels/fusion/gpu (U)). XLA fuses the jnp path into one kernel;
+the Pallas tiled variant (ops/pallas/norms.py) takes over on TPU for long
+rows where explicit VMEM tiling wins."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.op_call import apply
+from ..tensor.creation import _as_t
+
+
+def rms_norm_arrays(x, weight=None, bias=None, epsilon=1e-6, begin_norm_axis=-1):
+    ax = begin_norm_axis % x.ndim
+    axes = tuple(range(ax, x.ndim))
+    xf = x.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(xf), axis=axes, keepdims=True)
+    out = (xf * jax.lax.rsqrt(ms + epsilon)).astype(x.dtype)
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def rms_norm(x, weight=None, bias=None, epsilon=1e-6, begin_norm_axis=-1):
+    args = [_as_t(x)]
+    if weight is not None:
+        args.append(_as_t(weight))
+    if bias is not None:
+        args.append(_as_t(bias))
+
+    def f(a, *wb):
+        i = 0
+        w = b = None
+        if weight is not None:
+            w = wb[i]
+            i += 1
+        if bias is not None:
+            b = wb[i]
+        return rms_norm_arrays(a, w, b, epsilon, begin_norm_axis)
+
+    return apply(f, *args, _op_name="rms_norm")

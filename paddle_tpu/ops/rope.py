@@ -1,0 +1,75 @@
+"""Rotary position embedding (ref: fused_rope kernel,
+paddle/phi/kernels/fusion/gpu/fused_rope* (U)).
+
+Pure-jnp expression — XLA fuses the sin/cos generation + rotate into the
+surrounding attention matmuls, which is exactly what the reference's fused
+CUDA kernel hand-writes. Layout [batch, seq, heads, head_dim].
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.op_call import apply
+from ..core.tensor import Tensor
+from ..tensor.creation import _as_t
+
+
+def _sin_cos(seq_len, head_dim, base, dtype, position_ids=None):
+    inv_freq = 1.0 / (base ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    if position_ids is None:
+        t = jnp.arange(seq_len, dtype=jnp.float32)
+        freqs = jnp.outer(t, inv_freq)  # [S, D/2]
+    else:
+        freqs = position_ids.astype(jnp.float32)[..., None] * inv_freq  # [..., S, D/2]
+    return jnp.sin(freqs).astype(dtype), jnp.cos(freqs).astype(dtype)
+
+
+def rope_arrays(x, sin=None, cos=None, position_ids=None, neox=True, base=10000.0):
+    b, s, h, d = x.shape
+    if sin is None or cos is None:
+        sin, cos = _sin_cos(s, d, base, jnp.float32, position_ids)
+    else:
+        # accept paddle-style [1, S, 1, D] or [S, D/2]
+        sin = jnp.squeeze(sin)
+        cos = jnp.squeeze(cos)
+        if sin.shape[-1] == d:  # full-dim tables: take the half-table
+            sin = sin[..., : d // 2]
+            cos = cos[..., : d // 2]
+
+    def to_bs1d(t):
+        # normalize to [B or 1, S, 1, D/2] (head axis broadcast)
+        if t.ndim == 2:  # [S, D/2]
+            return t[None, :, None, :]
+        if t.ndim == 3:  # [B, S, D/2] (per-batch position_ids)
+            return t[:, :, None, :]
+        return t
+
+    sin = to_bs1d(sin)
+    cos = to_bs1d(cos)
+    xf = x.astype(jnp.float32)
+    if neox:
+        x1 = xf[..., : d // 2]
+        x2 = xf[..., d // 2:]
+        o1 = x1 * cos - x2 * sin
+        o2 = x2 * cos + x1 * sin
+        out = jnp.concatenate([o1, o2], axis=-1)
+    else:
+        x1 = xf[..., 0::2]
+        x2 = xf[..., 1::2]
+        o1 = x1 * cos - x2 * sin
+        o2 = x2 * cos + x1 * sin
+        out = jnp.stack([o1, o2], axis=-1).reshape(xf.shape)
+    return out.astype(x.dtype)
+
+
+def apply_rotary_emb(x, sin=None, cos=None, position_ids=None, neox=True, base=10000.0):
+    x = _as_t(x)
+    sin_a = sin._data if isinstance(sin, Tensor) else sin
+    cos_a = cos._data if isinstance(cos, Tensor) else cos
+    pos_a = position_ids._data if isinstance(position_ids, Tensor) else position_ids
+
+    def f(a):
+        return rope_arrays(a, sin_a, cos_a, pos_a, neox, base)
+
+    return apply(f, x, _op_name="fused_rope")

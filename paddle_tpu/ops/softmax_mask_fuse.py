@@ -1,0 +1,25 @@
+"""Fused masked softmax (ref: paddle.incubate.softmax_mask_fuse /
+softmax_mask_fuse_upper_triangle over fused CUDA kernels (U)). One jnp
+expression — XLA emits a single fused kernel."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.op_call import apply
+from ..tensor.creation import _as_t
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    return apply(lambda a, m: jax.nn.softmax(a + m, axis=-1), _as_t(x), _as_t(mask).detach(),
+                 _op_name="softmax_mask_fuse")
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    def f(a):
+        s_q, s_k = a.shape[-2], a.shape[-1]
+        causal = jnp.tril(jnp.ones((s_q, s_k), bool), k=s_k - s_q)
+        return jax.nn.softmax(jnp.where(causal, a, -1e30), axis=-1)
+
+    return apply(f, _as_t(x), _op_name="softmax_mask_fuse_upper_triangle")

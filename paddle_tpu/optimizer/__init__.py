@@ -1,0 +1,3 @@
+from .optimizer import Optimizer
+from .optimizers import SGD, Momentum, Adagrad, RMSProp, Adam, AdamW, Adamax, Lamb
+from . import lr

@@ -1,0 +1,143 @@
+"""Optimizer base (ref: python/paddle/optimizer/optimizer.py (U)).
+
+Design: every optimizer's math lives in a pure `_update(param, grad, state,
+lr) -> (new_param, new_state)` array function. Eager `.step()` applies it
+mutating wrappers in-place (dygraph parity); the SAME function is reused by
+jit.train_step and the distributed sharded optimizers, so there is exactly one
+implementation of each update rule (the reference needs separate CPU/GPU/fused
+kernels + multi_tensor paths — XLA fuses ours).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, Parameter
+from ..core import tape as _tape
+from ..nn.clip import ClipGradBase
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        from .lr import LRScheduler
+
+        if parameters is None:
+            raise ValueError("parameters must be provided (dygraph mode)")
+        self._parameter_list = list(parameters)
+        self._learning_rate = learning_rate
+        self._grad_clip = grad_clip
+        if weight_decay is None:
+            self._weight_decay = 0.0
+        elif isinstance(weight_decay, (int, float)):
+            self._weight_decay = float(weight_decay)
+        else:  # L2Decay-style object
+            self._weight_decay = float(getattr(weight_decay, "_coeff", getattr(weight_decay, "coeff", 0.0)))
+        self._accumulators = {}  # param id -> dict(state_name -> jnp array)
+        self._step_count = 0
+        self._param_names = {}
+        for i, p in enumerate(self._parameter_list):
+            self._param_names[id(p)] = p.name or f"param_{i}"
+
+    # -------- lr --------
+    def get_lr(self):
+        from .lr import LRScheduler
+
+        if isinstance(self._learning_rate, LRScheduler):
+            return self._learning_rate.get_lr()
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        self._learning_rate = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._learning_rate = scheduler
+
+    # -------- state --------
+    def _state_for(self, p):
+        st = self._accumulators.get(id(p))
+        if st is None:
+            st = self._init_state(p)
+            self._accumulators[id(p)] = st
+        return st
+
+    def _init_state(self, p):
+        return {}
+
+    def state_dict(self):
+        out = {"LR_Scheduler": {}, "master_weights": {}}
+        from .lr import LRScheduler
+
+        if isinstance(self._learning_rate, LRScheduler):
+            out["LR_Scheduler"] = self._learning_rate.state_dict()
+        for p in self._parameter_list:
+            name = self._param_names[id(p)]
+            for k, v in self._accumulators.get(id(p), {}).items():
+                out[f"{name}.{k}"] = Tensor(v) if not isinstance(v, Tensor) else v
+        out["global_step"] = self._step_count
+        return out
+
+    def set_state_dict(self, state):
+        from .lr import LRScheduler
+
+        if isinstance(self._learning_rate, LRScheduler) and state.get("LR_Scheduler"):
+            self._learning_rate.set_state_dict(state["LR_Scheduler"])
+        self._step_count = int(state.get("global_step", 0))
+        for p in self._parameter_list:
+            name = self._param_names[id(p)]
+            st = self._state_for(p)
+            for k in list(st):
+                key = f"{name}.{k}"
+                if key in state:
+                    v = state[key]
+                    st[k] = v._data if isinstance(v, Tensor) else jnp.asarray(v)
+
+    # -------- core --------
+    def _update(self, param, grad, state, lr):
+        raise NotImplementedError
+
+    def _decay_exempt(self, p):
+        """AdamW-style decoupled decay skips biases/norms by convention flag."""
+        return getattr(p, "no_weight_decay", False)
+
+    def step(self):
+        params_grads = [(p, p.grad) for p in self._parameter_list
+                        if p.trainable and p.grad is not None]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        self._step_count += 1
+        lr = self.get_lr()
+        with _tape.no_grad():
+            for p, g in params_grads:
+                if g is None:
+                    continue
+                state = self._state_for(p)
+                param_lr = lr * p.optimize_attr.get("learning_rate", 1.0)
+                new_p, new_state = self._update(p._data, g._data, state, param_lr)
+                p._data = new_p
+                self._accumulators[id(p)] = new_state
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._parameter_list:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    # epoch-style lr step passthrough
+    def _lr_step(self):
+        from .lr import LRScheduler
+
+        if isinstance(self._learning_rate, LRScheduler):
+            self._learning_rate.step()
+
+
+def _apply_l2(grad, param, coeff):
+    """Classic (coupled) L2 regularization: grad += coeff * param."""
+    if coeff:
+        return grad + coeff * param
+    return grad

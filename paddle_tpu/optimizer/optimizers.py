@@ -1,0 +1,234 @@
+"""Concrete optimizers (ref: python/paddle/optimizer/{sgd,momentum,adam,
+adamw,lamb,rmsprop,adagrad}.py (U)). Each _update is a pure array function —
+the single source of truth reused by eager step(), jit train steps, and the
+ZeRO-sharded distributed optimizers. The reference's fused/multi_tensor CUDA
+paths (fused_adam, SURVEY.md §2.1 N4) are unnecessary: XLA fuses the whole
+update chain into one kernel per parameter (and the jitted train step fuses
+across parameters)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer, _apply_l2
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+
+    def _update(self, param, grad, state, lr):
+        grad = _apply_l2(grad, param, self._weight_decay)
+        return param - lr * grad, state
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _init_state(self, p):
+        return {"velocity": jnp.zeros_like(p._data)}
+
+    def _update(self, param, grad, state, lr):
+        grad = _apply_l2(grad, param, self._weight_decay)
+        v = self._momentum * state["velocity"] + grad
+        if self._nesterov:
+            update = grad + self._momentum * v
+        else:
+            update = v
+        return param - lr * update, {"velocity": v}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _init_state(self, p):
+        return {"moment": jnp.full_like(p._data, self._init_acc)}
+
+    def _update(self, param, grad, state, lr):
+        grad = _apply_l2(grad, param, self._weight_decay)
+        m = state["moment"] + jnp.square(grad)
+        return param - lr * grad / (jnp.sqrt(m) + self._epsilon), {"moment": m}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _init_state(self, p):
+        st = {"mean_square": jnp.zeros_like(p._data), "velocity": jnp.zeros_like(p._data)}
+        if self._centered:
+            st["mean_grad"] = jnp.zeros_like(p._data)
+        return st
+
+    def _update(self, param, grad, state, lr):
+        grad = _apply_l2(grad, param, self._weight_decay)
+        ms = self._rho * state["mean_square"] + (1 - self._rho) * jnp.square(grad)
+        new_state = dict(state, mean_square=ms)
+        if self._centered:
+            mg = self._rho * state["mean_grad"] + (1 - self._rho) * grad
+            denom = jnp.sqrt(ms - jnp.square(mg) + self._epsilon)
+            new_state["mean_grad"] = mg
+        else:
+            denom = jnp.sqrt(ms + self._epsilon)
+        v = self._momentum * state["velocity"] + lr * grad / denom
+        new_state["velocity"] = v
+        return param - v, new_state
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._multi_precision = multi_precision
+
+    def _init_state(self, p):
+        st = {
+            "moment1": jnp.zeros_like(p._data, dtype=jnp.float32),
+            "moment2": jnp.zeros_like(p._data, dtype=jnp.float32),
+            "beta1_pow": jnp.ones((), jnp.float32),
+            "beta2_pow": jnp.ones((), jnp.float32),
+        }
+        if self._multi_precision and p._data.dtype != jnp.float32:
+            st["master_weight"] = p._data.astype(jnp.float32)
+        return st
+
+    def _adam_math(self, param, grad, state, lr, decoupled_wd=0.0, coupled_l2=0.0):
+        master = state.get("master_weight", param)
+        p32 = master.astype(jnp.float32)
+        g32 = grad.astype(jnp.float32)
+        if coupled_l2:
+            g32 = g32 + coupled_l2 * p32
+        b1p = state["beta1_pow"] * self._beta1
+        b2p = state["beta2_pow"] * self._beta2
+        m1 = self._beta1 * state["moment1"] + (1 - self._beta1) * g32
+        m2 = self._beta2 * state["moment2"] + (1 - self._beta2) * jnp.square(g32)
+        m1_hat = m1 / (1 - b1p)
+        m2_hat = m2 / (1 - b2p)
+        if decoupled_wd:
+            p32 = p32 * (1 - lr * decoupled_wd)
+        p32 = p32 - lr * m1_hat / (jnp.sqrt(m2_hat) + self._epsilon)
+        new_state = dict(state, moment1=m1, moment2=m2, beta1_pow=b1p, beta2_pow=b2p)
+        if "master_weight" in state:
+            new_state["master_weight"] = p32
+        return p32.astype(param.dtype), new_state
+
+    def _update(self, param, grad, state, lr):
+        return self._adam_math(param, grad, state, lr, coupled_l2=self._weight_decay)
+
+
+class AdamW(Adam):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision, name)
+        self._coeff = float(weight_decay) if isinstance(weight_decay, (int, float)) else float(
+            getattr(weight_decay, "_coeff", 0.01))
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
+
+    def step(self):
+        # stash per-param decay decisions before the generic loop
+        self._decay_map = {}
+        for p in self._parameter_list:
+            name = self._param_names[id(p)]
+            use = True
+            if self._apply_decay_param_fun is not None:
+                use = self._apply_decay_param_fun(name)
+            self._decay_map[id(p)] = self._coeff if use else 0.0
+        params_grads = [(p, p.grad) for p in self._parameter_list
+                        if p.trainable and p.grad is not None]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        self._step_count += 1
+        lr = self.get_lr()
+        from ..core import tape as _tape
+
+        with _tape.no_grad():
+            for p, g in params_grads:
+                if g is None:
+                    continue
+                state = self._state_for(p)
+                param_lr = lr * p.optimize_attr.get("learning_rate", 1.0)
+                if self._lr_ratio is not None:
+                    param_lr = param_lr * self._lr_ratio(p)
+                new_p, new_state = self._adam_math(
+                    p._data, g._data, state, param_lr,
+                    decoupled_wd=self._decay_map.get(id(p), self._coeff),
+                )
+                p._data = new_p
+                self._accumulators[id(p)] = new_state
+
+
+class Adamax(Adam):
+    def _init_state(self, p):
+        return {
+            "moment": jnp.zeros_like(p._data, dtype=jnp.float32),
+            "inf_norm": jnp.zeros_like(p._data, dtype=jnp.float32),
+            "beta1_pow": jnp.ones((), jnp.float32),
+        }
+
+    def _update(self, param, grad, state, lr):
+        g32 = _apply_l2(grad.astype(jnp.float32), param.astype(jnp.float32), self._weight_decay)
+        b1p = state["beta1_pow"] * self._beta1
+        m = self._beta1 * state["moment"] + (1 - self._beta1) * g32
+        u = jnp.maximum(self._beta2 * state["inf_norm"], jnp.abs(g32) + 1e-12)
+        p32 = param.astype(jnp.float32) - (lr / (1 - b1p)) * m / (u + self._epsilon)
+        return p32.astype(param.dtype), dict(state, moment=m, inf_norm=u, beta1_pow=b1p)
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _init_state(self, p):
+        return {
+            "moment1": jnp.zeros_like(p._data, dtype=jnp.float32),
+            "moment2": jnp.zeros_like(p._data, dtype=jnp.float32),
+            "beta1_pow": jnp.ones((), jnp.float32),
+            "beta2_pow": jnp.ones((), jnp.float32),
+        }
+
+    def _update(self, param, grad, state, lr):
+        p32 = param.astype(jnp.float32)
+        g32 = grad.astype(jnp.float32)
+        b1p = state["beta1_pow"] * self._beta1
+        b2p = state["beta2_pow"] * self._beta2
+        m1 = self._beta1 * state["moment1"] + (1 - self._beta1) * g32
+        m2 = self._beta2 * state["moment2"] + (1 - self._beta2) * jnp.square(g32)
+        m1_hat = m1 / (1 - b1p)
+        m2_hat = m2 / (1 - b2p)
+        r = m1_hat / (jnp.sqrt(m2_hat) + self._epsilon)
+        wd = self._lamb_wd
+        update = r + wd * p32
+        w_norm = jnp.linalg.norm(p32.reshape(-1))
+        u_norm = jnp.linalg.norm(update.reshape(-1))
+        trust = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
+        p32 = p32 - lr * trust * update
+        return p32.astype(param.dtype), dict(state, moment1=m1, moment2=m2,
+                                             beta1_pow=b1p, beta2_pow=b2p)

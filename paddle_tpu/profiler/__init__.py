@@ -1,0 +1,171 @@
+"""paddle.profiler parity (ref: python/paddle/profiler/profiler.py (U) — the
+Python scheduler/RecordEvent face of N20).
+
+TPU-native backing: jax.profiler (XLA/xprof traces viewable in TensorBoard or
+Perfetto) replaces the host tracer + CUPTI stack. RecordEvent maps to
+jax.profiler.TraceAnnotation so user spans appear inside the device trace.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import enum
+import os
+import time
+
+import jax
+
+
+class ProfilerTarget(enum.Enum):
+    CPU = 0
+    GPU = 1
+    CUSTOM_DEVICE = 2
+    TPU = 3
+
+
+class ProfilerState(enum.Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+def make_scheduler(closed=0, ready=0, record=1, repeat=0, skip_first=0):
+    total = closed + ready + record
+
+    def scheduler(step):
+        s = step - skip_first
+        if s < 0:
+            return ProfilerState.CLOSED
+        if repeat and s >= repeat * total:
+            return ProfilerState.CLOSED
+        pos = s % total
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == total - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    def handler(prof):
+        prof._export_dir = dir_name
+
+    return handler
+
+
+export_protobuf = export_chrome_tracing
+
+
+class Profiler:
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, record_shapes=False, profile_memory=False,
+                 with_flops=False, emit_nvtx=False):
+        self._scheduler = scheduler if callable(scheduler) else (
+            make_scheduler(closed=scheduler[0], ready=0, record=scheduler[1] - scheduler[0])
+            if isinstance(scheduler, (tuple, list)) else None
+        )
+        self._on_trace_ready = on_trace_ready
+        self._timer_only = timer_only
+        self._step = 0
+        self._active = False
+        self._export_dir = os.environ.get("PADDLE_TPU_PROFILE_DIR", "/tmp/paddle_tpu_profile")
+        self._step_times = []
+        self._last_step_t = None
+
+    def start(self):
+        self._last_step_t = time.time()
+        if not self._timer_only:
+            try:
+                jax.profiler.start_trace(self._export_dir)
+                self._active = True
+            except Exception:
+                self._active = False
+        return self
+
+    def stop(self):
+        if self._active:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._active = False
+        if self._on_trace_ready:
+            self._on_trace_ready(self)
+
+    def step(self, num_samples=None):
+        now = time.time()
+        if self._last_step_t is not None:
+            self._step_times.append((now - self._last_step_t, num_samples))
+        self._last_step_t = now
+        self._step += 1
+
+    def step_info(self, unit=None):
+        if not self._step_times:
+            return ""
+        dt, ns = self._step_times[-1]
+        ips = (ns / dt) if (ns and dt > 0) else (1.0 / dt if dt > 0 else 0.0)
+        return f"batch_cost: {dt:.5f} s, ips: {ips:.3f} {unit or 'steps'}/s"
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False, time_unit="ms"):
+        times = [t for t, _ in self._step_times]
+        if not times:
+            return "no steps recorded"
+        import numpy as np
+
+        return (f"steps: {len(times)}  avg: {np.mean(times)*1e3:.3f} ms  "
+                f"p50: {np.percentile(times,50)*1e3:.3f} ms  p99: {np.percentile(times,99)*1e3:.3f} ms")
+
+    def export(self, path=None, format="json"):
+        # xplane files land in self._export_dir via stop_trace
+        return self._export_dir
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+class RecordEvent:
+    """User-annotated span; shows up in the xprof/TensorBoard trace."""
+
+    def __init__(self, name, event_type=None):
+        self.name = name
+        self._ann = None
+
+    def begin(self):
+        self._ann = jax.profiler.TraceAnnotation(self.name)
+        self._ann.__enter__()
+
+    def end(self):
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+            self._ann = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+def load_profiler_result(filename):
+    raise NotImplementedError("open xprof traces with TensorBoard / Perfetto")
+
+
+@contextlib.contextmanager
+def profiler_guard(*a, **k):
+    p = Profiler()
+    p.start()
+    try:
+        yield p
+    finally:
+        p.stop()

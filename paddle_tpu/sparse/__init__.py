@@ -1,0 +1,61 @@
+"""paddle.sparse parity (minimal; ref: python/paddle/sparse/ (U),
+SURVEY.md §2.1 N26 — low priority on TPU: XLA has no sparse codegen, so COO
+ops are expressed densely via scatter/gather; jax.experimental.sparse (BCOO)
+backs matmul)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..tensor.creation import _as_t
+
+
+class SparseCooTensor(Tensor):
+    __slots__ = ("indices_", "values_", "dense_shape")
+
+    def __init__(self, indices, values, shape):
+        from jax.experimental import sparse as jsparse
+
+        self.indices_ = _as_t(indices)
+        self.values_ = _as_t(values)
+        self.dense_shape = list(shape)
+        bcoo = jsparse.BCOO((self.values_._data, self.indices_._data.T), shape=tuple(shape))
+        super().__init__(bcoo.todense())
+
+    def indices(self):
+        return self.indices_
+
+    def values(self):
+        return self.values_
+
+    def to_dense(self):
+        return Tensor(self._data)
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None, stop_gradient=True):
+    if shape is None:
+        idx = _as_t(indices).numpy()
+        shape = (idx.max(axis=1) + 1).tolist()
+    return SparseCooTensor(indices, values, shape)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None, stop_gradient=True):
+    import numpy as np
+
+    crows_np = _as_t(crows).numpy()
+    cols_np = _as_t(cols).numpy()
+    rows = np.repeat(np.arange(len(crows_np) - 1), np.diff(crows_np))
+    indices = np.stack([rows, cols_np])
+    return SparseCooTensor(indices, values, shape)
+
+
+def matmul(x, y, name=None):
+    from ..tensor.math import matmul as dense_matmul
+
+    return dense_matmul(x.to_dense() if isinstance(x, SparseCooTensor) else x,
+                        y.to_dense() if isinstance(y, SparseCooTensor) else y)
+
+
+def masked_matmul(x, y, mask, name=None):
+    raise NotImplementedError("masked sparse matmul is not supported on the TPU build")

@@ -1,0 +1,61 @@
+"""paddle.static compatibility shim.
+
+The reference's static graph stack (ProgramDesc/Executor/InterpreterCore —
+SURVEY.md §2.1 N10/N11) is deliberately NOT rebuilt: under XLA the compiled
+program IS the static graph, produced by tracing (`paddle_tpu.jit.to_static`).
+This module keeps the commonly-used entry points alive, mapping them to their
+trace-based equivalents, and raises informative errors for the legacy
+Program-construction API.
+"""
+
+from ..jit.api import InputSpec
+from ..nn import Layer  # re-export convenience
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None, **kwargs):
+    raise NotImplementedError(
+        "Static Program serialization is replaced by paddle_tpu.jit.save "
+        "(weights + serialized StableHLO via jax.export)."
+    )
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    raise NotImplementedError("Use paddle_tpu.jit.load.")
+
+
+class Program:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "Explicit Program construction does not exist on the TPU build; "
+            "decorate your function with paddle_tpu.jit.to_static instead."
+        )
+
+
+def default_main_program():
+    raise NotImplementedError("No global static program; use jit.to_static.")
+
+
+def default_startup_program():
+    raise NotImplementedError("No global static program; use jit.to_static.")
+
+
+class Executor:
+    def __init__(self, place=None):
+        raise NotImplementedError(
+            "The XLA runtime executes compiled programs directly; use "
+            "jit.to_static / jit.TrainStep instead of Executor.run."
+        )
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    return InputSpec(shape, dtype, name)
+
+
+def name_scope(prefix=None):
+    import contextlib
+
+    return contextlib.nullcontext()
+
+
+class amp:  # paddle.static.amp namespace placeholder
+    pass

@@ -1,0 +1,4 @@
+"""paddle.text stub — dataset downloads need network; the TPU build keeps the
+namespace for import compatibility (full NLP models live in paddle_tpu.models)."""
+
+__all__ = []

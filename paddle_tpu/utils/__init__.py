@@ -1,0 +1,31 @@
+"""paddle.utils parity (subset; ref: python/paddle/utils/ (U))."""
+
+from . import unique_name
+from . import cpp_extension
+
+
+def try_import(module_name, err_msg=None):
+    import importlib
+
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(err_msg or f"{module_name} is required but not installed")
+
+
+def run_check():
+    """paddle.utils.run_check parity: verify the device works."""
+    import jax
+    import jax.numpy as jnp
+
+    d = jax.devices()[0]
+    x = jnp.ones((128, 128))
+    y = (x @ x).block_until_ready()
+    print(f"paddle_tpu works on {d.platform}:{d.id} ({float(y[0,0])})")
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    def decorator(fn):
+        return fn
+
+    return decorator

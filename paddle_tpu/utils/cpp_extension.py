@@ -1,0 +1,94 @@
+"""Custom native-op extension loader (ref: python/paddle/utils/cpp_extension/
+(U), SURVEY.md §2.2 P29).
+
+TPU-native shape: a custom op is a C++ shared library exposing plain C
+symbols, registered as an XLA FFI custom call OR called on host via ctypes
+from a jax.pure_callback. This module compiles C++ sources with the system
+toolchain (g++ — no CUDA, no pybind11) and returns a ctypes handle plus a
+helper to wrap host functions as differentiable paddle ops.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import sysconfig
+
+
+DEFAULT_FLAGS = ["-O3", "-fPIC", "-shared", "-std=c++17", "-march=native"]
+
+
+def load(name, sources, extra_cxx_flags=None, extra_include_paths=None,
+         build_directory=None, verbose=False, **kwargs):
+    """Compile `sources` into lib<name>.so and return a ctypes.CDLL."""
+    build_dir = build_directory or os.path.join(
+        os.environ.get("PADDLE_TPU_EXT_DIR", os.path.expanduser("~/.cache/paddle_tpu_ext"))
+    )
+    os.makedirs(build_dir, exist_ok=True)
+    src_key = hashlib.sha1()
+    for s in sources:
+        with open(s, "rb") as f:
+            src_key.update(f.read())
+    tag = src_key.hexdigest()[:12]
+    out = os.path.join(build_dir, f"lib{name}_{tag}.so")
+    if not os.path.exists(out):
+        cmd = ["g++", *DEFAULT_FLAGS]
+        for inc in (extra_include_paths or []):
+            cmd += ["-I", inc]
+        cmd += ["-I", sysconfig.get_paths()["include"]]
+        cmd += list(sources) + (extra_cxx_flags or []) + ["-o", out]
+        if verbose:
+            print(" ".join(cmd))
+        subprocess.run(cmd, check=True, capture_output=not verbose)
+    return ctypes.CDLL(out)
+
+
+def host_op(lib, fn_name, out_shape_fn, arg_dtypes=None):
+    """Wrap a C symbol `void fn(const float* in, float* out, long n)`-style
+    host function as a paddle op via jax.pure_callback."""
+    import numpy as np
+    import jax
+
+    from ..core.op_call import apply
+    from ..tensor.creation import _as_t
+
+    cfn = getattr(lib, fn_name)
+
+    def host_call(a):
+        a = np.ascontiguousarray(a)
+        out = np.empty(out_shape_fn(a.shape), a.dtype)
+        cfn(
+            a.ctypes.data_as(ctypes.c_void_p),
+            out.ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_long(a.size),
+        )
+        return out
+
+    def op(x):
+        x = _as_t(x)
+
+        def f(arr):
+            shape = out_shape_fn(arr.shape)
+            return jax.pure_callback(
+                host_call, jax.ShapeDtypeStruct(shape, arr.dtype), arr
+            )
+
+        return apply(f, x, _op_name=fn_name)
+
+    return op
+
+
+class CppExtension:
+    def __init__(self, sources, *args, **kwargs):
+        self.sources = sources
+
+
+class CUDAExtension(CppExtension):
+    def __init__(self, *a, **k):
+        raise NotImplementedError("no CUDA on the TPU build; write a Pallas kernel instead")
+
+
+def setup(**kwargs):
+    raise NotImplementedError("use paddle_tpu.utils.cpp_extension.load for JIT builds")

@@ -1,0 +1,29 @@
+"""paddle.utils.unique_name parity."""
+
+from __future__ import annotations
+
+import contextlib
+
+_COUNTERS = {}
+
+
+def generate(key):
+    idx = _COUNTERS.get(key, 0)
+    _COUNTERS[key] = idx + 1
+    return f"{key}_{idx}"
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    global _COUNTERS
+    saved = _COUNTERS
+    _COUNTERS = {}
+    try:
+        yield
+    finally:
+        _COUNTERS = saved
+
+
+def switch(new_generator=None):
+    global _COUNTERS
+    _COUNTERS = {}

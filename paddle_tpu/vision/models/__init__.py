@@ -1,0 +1,8 @@
+from .resnet import (
+    ResNet, resnet18, resnet34, resnet50, resnet101, resnet152,
+    wide_resnet50_2, wide_resnet101_2, resnext50_32x4d, resnext101_64x4d,
+)
+from .lenet import LeNet
+from .vgg import VGG, vgg11, vgg13, vgg16, vgg19
+from .mobilenetv2 import MobileNetV2, mobilenet_v2
+from .alexnet import AlexNet, alexnet

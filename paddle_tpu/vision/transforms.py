@@ -1,0 +1,249 @@
+"""paddle.vision.transforms parity (numpy-array transforms; ref:
+python/paddle/vision/transforms/ (U)). Images are HWC numpy arrays or CHW
+Tensors; ToTensor converts HWC uint8 -> CHW float32/255."""
+
+from __future__ import annotations
+
+import random as pyrandom
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+class BaseTransform:
+    def __init__(self, keys=None):
+        self.keys = keys
+
+    def __call__(self, inputs):
+        return self._apply_image(inputs)
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+
+def _as_np(img):
+    if isinstance(img, Tensor):
+        return np.asarray(img._data)
+    return np.asarray(img)
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW", keys=None):
+        super().__init__(keys)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        arr = _as_np(img)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        if arr.dtype == np.uint8:
+            arr = arr.astype(np.float32) / 255.0
+        else:
+            arr = arr.astype(np.float32)
+        if self.data_format == "CHW":
+            arr = arr.transpose(2, 0, 1)
+        return Tensor(arr)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False, keys=None):
+        super().__init__(keys)
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        arr = _as_np(img).astype(np.float32)
+        if self.data_format == "CHW":
+            shape = (-1, 1, 1)
+        else:
+            shape = (1, 1, -1)
+        out = (arr - self.mean.reshape(shape)) / self.std.reshape(shape)
+        return Tensor(out) if isinstance(img, Tensor) else out
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = size if isinstance(size, (list, tuple)) else (size, size)
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        import jax
+
+        arr = _as_np(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3) and arr.shape[0] < arr.shape[2]
+        h, w = (arr.shape[1], arr.shape[2]) if chw else (arr.shape[0], arr.shape[1])
+        th, tw = self.size
+        if chw:
+            tgt = (arr.shape[0], th, tw)
+        else:
+            tgt = (th, tw) + ((arr.shape[2],) if arr.ndim == 3 else ())
+        method = {"bilinear": "linear", "nearest": "nearest", "bicubic": "cubic"}[self.interpolation]
+        out = np.asarray(jax.image.resize(arr.astype(np.float32), tgt, method=method))
+        if arr.dtype == np.uint8:
+            out = out.clip(0, 255).astype(np.uint8)
+        return out
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        super().__init__(keys)
+        self.size = size if isinstance(size, (list, tuple)) else (size, size)
+
+    def _apply_image(self, img):
+        arr = _as_np(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3) and arr.shape[0] < arr.shape[2]
+        h, w = (arr.shape[1], arr.shape[2]) if chw else (arr.shape[0], arr.shape[1])
+        th, tw = self.size
+        i = max((h - th) // 2, 0)
+        j = max((w - tw) // 2, 0)
+        if chw:
+            return arr[:, i:i + th, j:j + tw]
+        return arr[i:i + th, j:j + tw]
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.size = size if isinstance(size, (list, tuple)) else (size, size)
+        self.padding = padding
+
+    def _apply_image(self, img):
+        arr = _as_np(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3) and arr.shape[0] < arr.shape[2]
+        if self.padding:
+            p = self.padding if isinstance(self.padding, (list, tuple)) else [self.padding] * 4
+            if chw:
+                arr = np.pad(arr, [(0, 0), (p[1], p[3]), (p[0], p[2])])
+            else:
+                pads = [(p[1], p[3]), (p[0], p[2])] + ([(0, 0)] if arr.ndim == 3 else [])
+                arr = np.pad(arr, pads)
+        h, w = (arr.shape[1], arr.shape[2]) if chw else (arr.shape[0], arr.shape[1])
+        th, tw = self.size
+        i = pyrandom.randint(0, max(h - th, 0))
+        j = pyrandom.randint(0, max(w - tw, 0))
+        if chw:
+            return arr[:, i:i + th, j:j + tw]
+        return arr[i:i + th, j:j + tw]
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        arr = _as_np(img)
+        if pyrandom.random() < self.prob:
+            chw = arr.ndim == 3 and arr.shape[0] in (1, 3) and arr.shape[0] < arr.shape[2]
+            return arr[:, :, ::-1].copy() if chw else arr[:, ::-1].copy()
+        return arr
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        arr = _as_np(img)
+        if pyrandom.random() < self.prob:
+            chw = arr.ndim == 3 and arr.shape[0] in (1, 3) and arr.shape[0] < arr.shape[2]
+            return arr[:, ::-1].copy() if chw else arr[::-1].copy()
+        return arr
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = size if isinstance(size, (list, tuple)) else (size, size)
+        self.scale = scale
+        self.ratio = ratio
+        self._resize = Resize(self.size, interpolation)
+
+    def _apply_image(self, img):
+        import math
+
+        arr = _as_np(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3) and arr.shape[0] < arr.shape[2]
+        h, w = (arr.shape[1], arr.shape[2]) if chw else (arr.shape[0], arr.shape[1])
+        area = h * w
+        for _ in range(10):
+            target_area = area * pyrandom.uniform(*self.scale)
+            aspect = math.exp(pyrandom.uniform(math.log(self.ratio[0]), math.log(self.ratio[1])))
+            cw = int(round(math.sqrt(target_area * aspect)))
+            ch = int(round(math.sqrt(target_area / aspect)))
+            if cw <= w and ch <= h:
+                i = pyrandom.randint(0, h - ch)
+                j = pyrandom.randint(0, w - cw)
+                crop = arr[:, i:i + ch, j:j + cw] if chw else arr[i:i + ch, j:j + cw]
+                return self._resize(crop)
+        return self._resize(CenterCrop(min(h, w))(arr))
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        super().__init__(keys)
+        self.order = order
+
+    def _apply_image(self, img):
+        arr = _as_np(img)
+        if arr.ndim == 2:
+            arr = arr[..., None]
+        return arr.transpose(self.order)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        arr = _as_np(img).astype(np.float32)
+        factor = pyrandom.uniform(max(0, 1 - self.value), 1 + self.value)
+        return (arr * factor).clip(0, 255).astype(np.uint8) if _as_np(img).dtype == np.uint8 else arr * factor
+
+
+# functional API
+def to_tensor(pic, data_format="CHW"):
+    return ToTensor(data_format)(pic)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    return Normalize(mean, std, data_format)(img)
+
+
+def resize(img, size, interpolation="bilinear"):
+    return Resize(size, interpolation)(img)
+
+
+def hflip(img):
+    arr = _as_np(img)
+    chw = arr.ndim == 3 and arr.shape[0] in (1, 3) and arr.shape[0] < arr.shape[2]
+    return arr[:, :, ::-1].copy() if chw else arr[:, ::-1].copy()
+
+
+def center_crop(img, output_size):
+    return CenterCrop(output_size)(img)
+
+
+def crop(img, top, left, height, width):
+    arr = _as_np(img)
+    chw = arr.ndim == 3 and arr.shape[0] in (1, 3) and arr.shape[0] < arr.shape[2]
+    if chw:
+        return arr[:, top:top + height, left:left + width]
+    return arr[top:top + height, left:left + width]

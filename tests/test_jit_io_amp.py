@@ -1,0 +1,227 @@
+"""to_static / TrainStep / io / amp tests (SURVEY.md §4 dy2static pattern:
+eager vs compiled parity)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+class TestToStatic:
+    def test_eager_static_parity(self):
+        def fn(x, y):
+            return paddle.tanh(x) @ y + x.sum()
+
+        static_fn = paddle.jit.to_static(fn)
+        a, b = paddle.randn([4, 4]), paddle.randn([4, 4])
+        np.testing.assert_allclose(static_fn(a, b).numpy(), fn(a, b).numpy(), rtol=1e-5, atol=1e-6)
+
+    def test_cache_by_shape(self):
+        calls = []
+
+        @paddle.jit.to_static
+        def fn(x):
+            calls.append(1)
+            return x * 2
+
+        fn(paddle.ones([2, 3]))
+        fn(paddle.ones([2, 3]))
+        assert len(calls) == 1  # traced once
+        fn(paddle.ones([4, 3]))
+        assert len(calls) == 2  # retraced on new shape
+
+    def test_layer_to_static_updates_buffers(self):
+        bn = nn.BatchNorm1D(4)
+        bn = paddle.jit.to_static(bn)
+        x = paddle.randn([8, 4]) * 3 + 1
+        bn(x)
+        assert abs(float(bn._mean.numpy().mean())) > 1e-4  # running stats moved
+
+    def test_randomness_varies_across_calls(self):
+        drop = nn.Dropout(0.5)
+        drop = paddle.jit.to_static(drop)
+        x = paddle.ones([100])
+        a = drop(x).numpy()
+        b = drop(x).numpy()
+        assert not np.array_equal(a, b)  # rng key threaded per call
+
+    def test_jit_save_load_roundtrip(self, tmp_path):
+        layer = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        layer.eval()
+        path = str(tmp_path / "model")
+        paddle.jit.save(layer, path, input_spec=[paddle.jit.InputSpec([1, 4])])
+        loaded = paddle.jit.load(path)
+        x = paddle.randn([1, 4])
+        np.testing.assert_allclose(loaded(x).numpy(), layer(x).numpy(), rtol=1e-5, atol=1e-6)
+
+
+class TestTrainStep:
+    def test_matches_eager_training(self):
+        paddle.seed(3)
+        X = np.random.RandomState(0).rand(32, 4).astype(np.float32)
+        Y = X.sum(-1, keepdims=True)
+
+        def build():
+            paddle.seed(7)
+            m = nn.Linear(4, 1)
+            o = paddle.optimizer.Adam(learning_rate=0.1, parameters=m.parameters())
+            return m, o
+
+        # eager
+        m1, o1 = build()
+        for _ in range(5):
+            loss = F.mse_loss(m1(paddle.to_tensor(X)), paddle.to_tensor(Y))
+            loss.backward()
+            o1.step()
+            o1.clear_grad()
+        # jitted
+        m2, o2 = build()
+        step = paddle.jit.TrainStep(m2, lambda net, x, y: F.mse_loss(net(x), y), o2)
+        for _ in range(5):
+            step(paddle.to_tensor(X), paddle.to_tensor(Y))
+        np.testing.assert_allclose(m1.weight.numpy(), m2.weight.numpy(), rtol=1e-4, atol=1e-5)
+
+    def test_grad_clip_inside_step(self):
+        m = nn.Linear(4, 1)
+        o = paddle.optimizer.SGD(learning_rate=1.0, parameters=m.parameters(),
+                                 grad_clip=nn.ClipGradByGlobalNorm(0.01))
+        step = paddle.jit.TrainStep(m, lambda net, x, y: F.mse_loss(net(x), y) * 1000, o)
+        w0 = m.weight.numpy().copy()
+        step(paddle.randn([8, 4]), paddle.randn([8, 1]))
+        delta = np.linalg.norm(
+            np.concatenate([(m.weight.numpy() - w0).ravel(),
+                            (m.bias.numpy() - 0 * m.bias.numpy()).ravel() * 0])
+        )
+        assert delta < 0.02  # bounded by clip * lr plus bias
+
+
+class TestIO:
+    def test_dataloader_shapes_order(self):
+        from paddle_tpu.io import DataLoader, Dataset
+
+        class DS(Dataset):
+            def __len__(self):
+                return 10
+
+            def __getitem__(self, i):
+                return np.full((2,), i, np.float32), i
+
+        dl = DataLoader(DS(), batch_size=3, drop_last=False)
+        batches = list(dl)
+        assert len(batches) == 4
+        assert batches[0][0].shape == [3, 2]
+        assert batches[-1][0].shape == [1, 2]
+        np.testing.assert_array_equal(batches[0][1].numpy(), [0, 1, 2])
+
+    def test_threaded_loader_preserves_order(self):
+        from paddle_tpu.io import DataLoader, Dataset
+
+        class DS(Dataset):
+            def __len__(self):
+                return 50
+
+            def __getitem__(self, i):
+                import time
+
+                time.sleep(0.001 * (i % 3))
+                return np.asarray([i], np.float32)
+
+        dl = DataLoader(DS(), batch_size=5, num_workers=3)
+        got = np.concatenate([b.numpy().ravel() for b in dl])
+        np.testing.assert_array_equal(got, np.arange(50, dtype=np.float32))
+
+    def test_distributed_batch_sampler_partitions(self):
+        from paddle_tpu.io import DistributedBatchSampler, Dataset
+
+        class DS(Dataset):
+            def __len__(self):
+                return 12
+
+            def __getitem__(self, i):
+                return i
+
+        seen = []
+        for rank in range(3):
+            s = DistributedBatchSampler(DS(), batch_size=2, num_replicas=3, rank=rank)
+            for batch in s:
+                seen.extend(batch)
+        assert sorted(seen) == list(range(12))
+
+    def test_random_split_and_concat(self):
+        from paddle_tpu.io import random_split, ConcatDataset, TensorDataset
+
+        ds = TensorDataset([paddle.arange(10).reshape([10, 1])])
+        a, b = random_split(ds, [7, 3])
+        assert len(a) == 7 and len(b) == 3
+        cat = ConcatDataset([a, b])
+        assert len(cat) == 10
+
+
+class TestAmp:
+    def test_autocast_matmul_bf16(self):
+        a = paddle.randn([4, 4])
+        with paddle.amp.auto_cast(dtype="bfloat16", level="O1"):
+            out = paddle.matmul(a, a)
+        assert out.dtype == paddle.bfloat16
+
+    def test_blacklist_stays_fp32(self):
+        a = paddle.randn([4, 4])
+        with paddle.amp.auto_cast(dtype="bfloat16", level="O1"):
+            out = F.softmax(a)
+        assert out.dtype == paddle.float32
+
+    def test_o2_decorate_casts_params(self):
+        m = nn.Linear(4, 4)
+        opt = paddle.optimizer.Adam(parameters=m.parameters())
+        m, opt = paddle.amp.decorate(m, opt, level="O2", dtype="bfloat16")
+        assert m.weight.dtype == paddle.bfloat16
+        assert opt._multi_precision
+
+    def test_grad_flows_through_autocast(self):
+        m = nn.Linear(4, 4)
+        x = paddle.randn([2, 4])
+        with paddle.amp.auto_cast(dtype="bfloat16"):
+            out = m(x).sum()
+        out.backward()
+        assert m.weight.grad is not None
+        assert m.weight.grad.dtype == paddle.float32  # grads back in param dtype
+
+
+class TestPyLayer:
+    def test_custom_vjp(self):
+        from paddle_tpu.autograd import PyLayer
+
+        class Exp2(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return paddle.exp(x * 2)
+
+            @staticmethod
+            def backward(ctx, dy):
+                (x,) = ctx.saved_tensor()
+                return dy * 2 * paddle.exp(x * 2)
+
+        x = paddle.to_tensor(0.5, stop_gradient=False)
+        y = Exp2.apply(x)
+        y.backward()
+        np.testing.assert_allclose(float(x.grad), 2 * np.exp(1.0), rtol=1e-5)
+
+
+class TestCheckpointing:
+    def test_model_save_load(self, tmp_path):
+        net = nn.Linear(3, 3)
+        m = paddle.Model(net)
+        m.prepare(paddle.optimizer.Adam(parameters=net.parameters()), nn.MSELoss())
+        p = str(tmp_path / "ck")
+        m.save(p)
+        assert os.path.exists(p + ".pdparams")
+        net2 = nn.Linear(3, 3)
+        m2 = paddle.Model(net2)
+        m2.prepare(paddle.optimizer.Adam(parameters=net2.parameters()), nn.MSELoss())
+        m2.load(p)
+        np.testing.assert_array_equal(net.weight.numpy(), net2.weight.numpy())

@@ -1,0 +1,161 @@
+"""Model-zoo tests: shapes, gradients, decode parity, recompute parity."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.models import (
+    GPTConfig, GPTForCausalLM, BertConfig, BertForMaskedLM, UNetConfig,
+    UNet2DConditionModel,
+)
+
+TINY_GPT = GPTConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                     num_hidden_layers=2, num_attention_heads=4,
+                     max_position_embeddings=64)
+
+
+class TestGPT:
+    def test_loss_and_grads(self):
+        m = GPTForCausalLM(TINY_GPT)
+        ids = paddle.randint(0, 128, [2, 16])
+        loss, logits = m(ids, labels=ids)
+        assert logits.shape == [2, 16, 128]
+        loss.backward()
+        assert m.model.layers[0].self_attn.q_proj.weight.grad is not None
+        assert m.model.embed_tokens.weight.grad is not None
+
+    def test_causality(self):
+        m = GPTForCausalLM(TINY_GPT)
+        m.eval()
+        ids = paddle.randint(0, 128, [1, 8])
+        logits1 = m(ids)
+        ids2 = ids.numpy().copy()
+        ids2[0, -1] = (ids2[0, -1] + 1) % 128  # change last token
+        logits2 = m(paddle.to_tensor(ids2))
+        # positions < 7 unaffected
+        np.testing.assert_allclose(logits1.numpy()[0, :7], logits2.numpy()[0, :7], atol=1e-4)
+
+    def test_cached_decode_matches_full_forward(self):
+        m = GPTForCausalLM(TINY_GPT)
+        ids = paddle.randint(0, 128, [2, 6])
+        gen = m.generate(ids, max_new_tokens=2, temperature=0)
+        # last generated token must equal argmax of full forward on the prefix
+        full = m(gen[:, :-1])
+        nxt = paddle.argmax(full[:, -1], axis=-1)
+        np.testing.assert_array_equal(gen.numpy()[:, -1], nxt.numpy())
+
+    def test_gqa(self):
+        cfg = GPTConfig(vocab_size=64, hidden_size=64, intermediate_size=128,
+                        num_hidden_layers=1, num_attention_heads=8,
+                        num_key_value_heads=2, max_position_embeddings=32)
+        m = GPTForCausalLM(cfg)
+        out = m(paddle.randint(0, 64, [1, 8]))
+        assert out.shape == [1, 8, 64]
+
+    def test_recompute_parity(self):
+        paddle.seed(11)
+        m1 = GPTForCausalLM(TINY_GPT)
+        sd = m1.state_dict()
+        cfg2 = GPTConfig(**{**TINY_GPT.__dict__, "use_recompute": True})
+        m2 = GPTForCausalLM(cfg2)
+        m2.set_state_dict(sd)
+        ids = paddle.randint(0, 128, [2, 8])
+        l1, _ = m1(ids, labels=ids)
+        l2, _ = m2(ids, labels=ids)
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+        l1.backward()
+        l2.backward()
+        g1 = m1.model.layers[0].mlp.gate_proj.weight.grad.numpy()
+        g2 = m2.model.layers[0].mlp.gate_proj.weight.grad.numpy()
+        np.testing.assert_allclose(g1, g2, rtol=1e-4, atol=1e-6)
+
+
+class TestBert:
+    def test_mlm_loss(self):
+        cfg = BertConfig(vocab_size=100, hidden_size=32, num_hidden_layers=2,
+                         num_attention_heads=2, intermediate_size=64,
+                         max_position_embeddings=64)
+        m = BertForMaskedLM(cfg)
+        ids = paddle.randint(0, 100, [2, 10])
+        labels = ids.numpy().copy()
+        labels[:, ::2] = -100  # only score odd positions
+        loss, logits = m(ids, labels=paddle.to_tensor(labels))
+        assert logits.shape == [2, 10, 100]
+        loss.backward()
+        assert m.bert.embeddings.word_embeddings.weight.grad is not None
+
+    def test_attention_mask(self):
+        cfg = BertConfig(vocab_size=50, hidden_size=32, num_hidden_layers=1,
+                         num_attention_heads=2, intermediate_size=64,
+                         max_position_embeddings=32)
+        m = BertForMaskedLM(cfg)
+        m.eval()
+        ids = paddle.randint(0, 50, [1, 6])
+        mask = paddle.to_tensor(np.array([[1, 1, 1, 0, 0, 0]], np.float32))
+        out1 = m(ids, attention_mask=mask)
+        ids2 = ids.numpy().copy()
+        ids2[0, 4] = (ids2[0, 4] + 7) % 50  # masked-out position changed
+        out2 = m(paddle.to_tensor(ids2), attention_mask=mask)
+        np.testing.assert_allclose(out1.numpy()[0, :3], out2.numpy()[0, :3], atol=1e-4)
+
+
+class TestUNet:
+    def test_shapes_and_grad(self):
+        cfg = UNetConfig(block_out_channels=(16, 32), layers_per_block=1,
+                         cross_attention_dim=16, attention_head_dim=2,
+                         norm_num_groups=4, in_channels=4, out_channels=4)
+        m = UNet2DConditionModel(cfg)
+        lat = paddle.randn([2, 4, 8, 8])
+        t = paddle.to_tensor([1, 2])
+        ctx = paddle.randn([2, 3, 16])
+        out = m(lat, t, ctx)
+        assert out.shape == [2, 4, 8, 8]
+        (out ** 2).mean().backward()
+        assert m.conv_in.weight.grad is not None
+
+    def test_conditioning_matters(self):
+        cfg = UNetConfig(block_out_channels=(16, 32), layers_per_block=1,
+                         cross_attention_dim=16, attention_head_dim=2,
+                         norm_num_groups=4)
+        m = UNet2DConditionModel(cfg)
+        m.eval()
+        lat = paddle.randn([1, 4, 8, 8])
+        t = paddle.to_tensor([5])
+        o1 = m(lat, t, paddle.randn([1, 3, 16]))
+        o2 = m(lat, t, paddle.randn([1, 3, 16]))
+        assert not np.allclose(o1.numpy(), o2.numpy())
+
+
+class TestVision:
+    def test_resnet50_shape(self):
+        m = paddle.vision.models.resnet50(num_classes=10)
+        out = m(paddle.randn([1, 3, 64, 64]))
+        assert out.shape == [1, 10]
+
+    def test_resnet18_trains(self):
+        m = paddle.vision.models.resnet18(num_classes=4)
+        opt = paddle.optimizer.Momentum(learning_rate=0.01, parameters=m.parameters())
+        x = paddle.randn([2, 3, 32, 32])
+        y = paddle.to_tensor([0, 1])
+        loss = nn.functional.cross_entropy(m(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        assert np.isfinite(float(loss))
+
+    def test_mobilenet_lenet(self):
+        m = paddle.vision.models.mobilenet_v2(num_classes=7, scale=0.35)
+        assert m(paddle.randn([1, 3, 32, 32])).shape == [1, 7]
+        l = paddle.vision.models.LeNet()
+        assert l(paddle.randn([1, 1, 28, 28])).shape == [1, 10]
+
+    def test_transforms(self):
+        from paddle_tpu.vision import transforms as T
+
+        img = (np.random.rand(32, 32, 3) * 255).astype(np.uint8)
+        pipeline = T.Compose([T.Resize(16), T.CenterCrop(8), T.ToTensor(),
+                              T.Normalize(mean=[0.5] * 3, std=[0.5] * 3)])
+        out = pipeline(img)
+        assert out.shape == [3, 8, 8]
+        assert float(out.numpy().max()) <= 1.0 + 1e-6

@@ -1,0 +1,203 @@
+"""Optimizer tests vs hand-rolled NumPy references (SURVEY.md §4: the
+reference compares Adam against a NumPy implementation)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def make_param(val):
+    return paddle.Parameter(np.asarray(val, np.float32))
+
+
+def set_grad(p, g):
+    p.grad = paddle.to_tensor(np.asarray(g, np.float32))
+
+
+class TestSGDMomentum:
+    def test_sgd(self):
+        p = make_param([1.0, 2.0])
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[p])
+        set_grad(p, [1.0, 1.0])
+        opt.step()
+        np.testing.assert_allclose(p.numpy(), [0.9, 1.9], rtol=1e-6)
+
+    def test_momentum_matches_numpy(self):
+        p = make_param([1.0])
+        opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9, parameters=[p])
+        v = 0.0
+        x = 1.0
+        for i in range(3):
+            g = 2 * x
+            set_grad(p, [g])
+            opt.step()
+            v = 0.9 * v + g
+            x = x - 0.1 * v
+        np.testing.assert_allclose(p.numpy(), [x], rtol=1e-5)
+
+    def test_weight_decay_l2(self):
+        p = make_param([1.0])
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[p], weight_decay=0.1)
+        set_grad(p, [0.0])
+        opt.step()
+        np.testing.assert_allclose(p.numpy(), [1.0 - 0.1 * 0.1], rtol=1e-6)
+
+
+class TestAdamFamily:
+    def np_adam(self, x, grads, lr=0.01, b1=0.9, b2=0.999, eps=1e-8):
+        m = v = 0.0
+        b1p = b2p = 1.0
+        for g in grads:
+            b1p *= b1
+            b2p *= b2
+            m = b1 * m + (1 - b1) * g
+            v = b2 * v + (1 - b2) * g * g
+            x = x - lr * (m / (1 - b1p)) / (np.sqrt(v / (1 - b2p)) + eps)
+        return x
+
+    def test_adam_matches_numpy(self):
+        p = make_param([1.0])
+        opt = paddle.optimizer.Adam(learning_rate=0.01, parameters=[p])
+        grads = [0.5, -0.3, 0.8, 0.1]
+        for g in grads:
+            set_grad(p, [g])
+            opt.step()
+        np.testing.assert_allclose(p.numpy(), [self.np_adam(1.0, grads)], rtol=1e-5)
+
+    def test_adamw_decoupled_decay(self):
+        p = make_param([1.0])
+        opt = paddle.optimizer.AdamW(learning_rate=0.01, parameters=[p], weight_decay=0.1)
+        set_grad(p, [0.5])
+        opt.step()
+        # decoupled: (1 - lr*wd) applied to weight before adam update
+        ref = self.np_adam(1.0 * (1 - 0.01 * 0.1), [0.5])
+        np.testing.assert_allclose(p.numpy(), [ref], rtol=1e-4)
+
+    def test_adamw_exclude_fn(self):
+        p1, p2 = make_param([1.0]), make_param([1.0])
+        p1.name, p2.name = "w", "bias"
+        opt = paddle.optimizer.AdamW(
+            learning_rate=0.01, parameters=[p1, p2], weight_decay=0.5,
+            apply_decay_param_fun=lambda n: n == "w",
+        )
+        set_grad(p1, [0.0])
+        set_grad(p2, [0.0])
+        opt.step()
+        assert p1.numpy()[0] < 1.0  # decayed
+        np.testing.assert_allclose(p2.numpy(), [1.0], atol=1e-7)  # excluded
+
+    def test_lamb_trust_ratio(self):
+        p = make_param(np.ones(4))
+        opt = paddle.optimizer.Lamb(learning_rate=0.01, parameters=[p])
+        set_grad(p, np.full(4, 0.1))
+        opt.step()
+        assert p.numpy()[0] < 1.0
+
+    def test_multi_precision_master_weights(self):
+        p = paddle.Parameter(np.ones(3, np.float16))
+        opt = paddle.optimizer.Adam(learning_rate=0.01, parameters=[p], multi_precision=True)
+        set_grad(p, np.full(3, 0.5, np.float16))
+        opt.step()
+        st = opt._accumulators[id(p)]
+        assert "master_weight" in st
+        assert str(st["master_weight"].dtype) == "float32"
+        assert str(p.dtype) == "float16"
+
+
+class TestStatePersistence:
+    def test_optimizer_state_roundtrip(self):
+        p = make_param([1.0])
+        p.name = "p0"
+        opt = paddle.optimizer.Adam(learning_rate=0.01, parameters=[p])
+        set_grad(p, [0.5])
+        opt.step()
+        sd = opt.state_dict()
+
+        p2 = make_param([1.0])
+        p2.name = "p0"
+        opt2 = paddle.optimizer.Adam(learning_rate=0.01, parameters=[p2])
+        opt2.set_state_dict(sd)
+        m1 = opt._accumulators[id(p)]["moment1"]
+        m2 = opt2._accumulators[id(p2)]["moment1"]
+        np.testing.assert_allclose(np.asarray(m1), np.asarray(m2))
+
+
+class TestLRSchedulers:
+    def test_step_decay(self):
+        s = paddle.optimizer.lr.StepDecay(0.1, step_size=2, gamma=0.5)
+        lrs = []
+        for _ in range(5):
+            lrs.append(s.get_lr())
+            s.step()
+        np.testing.assert_allclose(lrs, [0.1, 0.1, 0.05, 0.05, 0.025], rtol=1e-6)
+
+    def test_linear_warmup_into_cosine(self):
+        base = paddle.optimizer.lr.CosineAnnealingDecay(0.1, T_max=10)
+        s = paddle.optimizer.lr.LinearWarmup(base, warmup_steps=4, start_lr=0.0, end_lr=0.1)
+        lrs = [s.get_lr()]
+        for _ in range(4):
+            s.step()
+            lrs.append(s.get_lr())
+        assert lrs[0] == 0.0
+        assert abs(lrs[2] - 0.05) < 1e-6
+        assert lrs[4] <= 0.1 + 1e-9
+
+    def test_noam(self):
+        s = paddle.optimizer.lr.NoamDecay(d_model=512, warmup_steps=10, learning_rate=1.0)
+        for _ in range(9):
+            s.step()
+        peak_region = s.get_lr()
+        for _ in range(100):
+            s.step()
+        assert s.get_lr() < peak_region
+
+    def test_reduce_on_plateau(self):
+        s = paddle.optimizer.lr.ReduceOnPlateau(0.1, patience=1, factor=0.5)
+        s.step(1.0)
+        s.step(1.0)
+        s.step(1.0)
+        assert s.get_lr() == pytest.approx(0.05)
+
+    def test_one_cycle(self):
+        s = paddle.optimizer.lr.OneCycleLR(max_learning_rate=1.0, total_steps=10)
+        lrs = []
+        for _ in range(10):
+            lrs.append(s.get_lr())
+            s.step()
+        assert max(lrs) <= 1.0 + 1e-9
+        assert lrs[0] < max(lrs)
+        assert lrs[-1] < max(lrs)
+
+    def test_scheduler_in_optimizer(self):
+        p = make_param([1.0])
+        s = paddle.optimizer.lr.ExponentialDecay(0.1, gamma=0.5)
+        opt = paddle.optimizer.SGD(learning_rate=s, parameters=[p])
+        assert opt.get_lr() == pytest.approx(0.1)
+        s.step()
+        assert opt.get_lr() == pytest.approx(0.05)
+
+
+class TestGradScaler:
+    def test_scale_unscale_roundtrip(self):
+        p = make_param([1.0])
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[p])
+        scaler = paddle.amp.GradScaler(init_loss_scaling=8.0)
+        x = paddle.to_tensor(2.0)
+        loss = (p * x).sum()
+        scaler.scale(loss).backward()
+        np.testing.assert_allclose(p.grad.numpy(), [16.0])  # scaled by 8
+        scaler.step(opt)
+        scaler.update()
+        np.testing.assert_allclose(p.numpy(), [1.0 - 0.1 * 2.0], rtol=1e-6)
+
+    def test_skip_on_inf(self):
+        p = make_param([1.0])
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[p])
+        scaler = paddle.amp.GradScaler(init_loss_scaling=8.0, decr_every_n_nan_or_inf=1)
+        p.grad = paddle.to_tensor(np.array([np.inf], np.float32))
+        scaler.step(opt)
+        scaler.update()
+        np.testing.assert_allclose(p.numpy(), [1.0])  # step skipped
+        assert float(scaler.get_loss_scaling()) == 4.0  # halved
