@@ -98,24 +98,40 @@ def apply(fn, *args, _op_name: str = "", **kwargs):
         _tape.global_tape().record(
             diff_tensors,
             out_tensors,
-            _VjpAdapter(vjp_fn, len(outs)),
+            _VjpAdapter(vjp_fn, [jax.typeof(o) for o in outs]),
             name=_op_name or getattr(fn, "__name__", "op"),
         )
     return _unflatten_out(out_tensors, structure)
 
 
-class _VjpAdapter:
-    __slots__ = ("vjp_fn", "n_out")
+def _match_vma(ct, expected_aval):
+    """Inside shard_map, primal outputs carry varying-manual-axes (vma) types
+    (e.g. float32[...]{V:mp}); a cotangent built outside that op (ones_like,
+    or the pullback of a replicating collective like psum) may be replicated.
+    Promote it with pcast so jax.vjp accepts it — mathematically a no-op."""
+    vma = getattr(expected_aval, "vma", None)
+    if not vma:
+        return ct
+    have = getattr(jax.typeof(ct), "vma", frozenset())
+    missing = tuple(vma - have)
+    if missing:
+        ct = jax.lax.pcast(ct, missing, to="varying")
+    return ct
 
-    def __init__(self, vjp_fn, n_out):
+
+class _VjpAdapter:
+    __slots__ = ("vjp_fn", "out_avals")
+
+    def __init__(self, vjp_fn, out_avals):
         self.vjp_fn = vjp_fn
-        self.n_out = n_out
+        self.out_avals = out_avals
 
     def __call__(self, cotangents):
         # cotangents: list aligned with flattened outputs
-        if self.n_out == 1:
-            return self.vjp_fn(cotangents[0])
-        return self.vjp_fn(tuple(cotangents))
+        cts = [_match_vma(ct, av) for ct, av in zip(cotangents, self.out_avals)]
+        if len(self.out_avals) == 1:
+            return self.vjp_fn(cts[0])
+        return self.vjp_fn(tuple(cts))
 
 
 def _out_type(out):

@@ -1,5 +1,61 @@
-"""paddle.distributed parity namespace — populated incrementally; the full
-fleet/collective surface lands with the distributed layer."""
+"""paddle.distributed parity namespace.
+
+Reference: python/paddle/distributed/ (U) — collectives, parallel env, fleet,
+hybrid-parallel layers (SURVEY.md §2.2 P9-P23). TPU-native core: a named-axis
+jax Mesh replaces comm rings; see topology.py / communication.py.
+"""
 
 from . import collective_ctx
 from .collective_ctx import axis_scope
+from .topology import (
+    CommunicateTopology,
+    Group,
+    HybridCommunicateGroup,
+    ReduceOp,
+    create_hybrid_communicate_group,
+    get_hybrid_communicate_group,
+    set_hybrid_communicate_group,
+)
+from .communication import (
+    all_gather,
+    all_gather_object,
+    all_reduce,
+    alltoall,
+    alltoall_single,
+    barrier,
+    broadcast,
+    destroy_process_group,
+    get_group,
+    isend,
+    irecv,
+    new_group,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+    shift,
+    wait,
+)
+from .parallel import (
+    DataParallel,
+    ParallelEnv,
+    get_rank,
+    get_world_size,
+    init_parallel_env,
+    is_initialized,
+    spawn,
+)
+from .recompute import recompute
+
+__all__ = [
+    "all_gather", "all_gather_object", "all_reduce", "alltoall",
+    "alltoall_single", "barrier", "broadcast", "destroy_process_group",
+    "get_group", "isend", "irecv", "new_group", "recv", "reduce",
+    "reduce_scatter", "scatter", "send", "shift", "wait", "ReduceOp",
+    "DataParallel", "ParallelEnv", "get_rank", "get_world_size",
+    "init_parallel_env", "is_initialized", "spawn", "recompute",
+    "Group", "CommunicateTopology", "HybridCommunicateGroup",
+    "get_hybrid_communicate_group", "set_hybrid_communicate_group",
+    "create_hybrid_communicate_group", "axis_scope",
+]

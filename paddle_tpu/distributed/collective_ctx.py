@@ -20,6 +20,13 @@ class _Scope(threading.local):
 
 
 _SCOPE = _Scope()
+_SCOPE_EXIT_HOOKS = []
+
+
+def register_scope_exit(fn):
+    """Run `fn()` whenever the outermost axis scope exits (used to drop
+    per-trace buffers, e.g. pending p2p sends)."""
+    _SCOPE_EXIT_HOOKS.append(fn)
 
 
 @contextlib.contextmanager
@@ -32,6 +39,9 @@ def axis_scope(*axis_names):
     finally:
         for _ in axis_names:
             _SCOPE.axes.pop()
+        if not _SCOPE.axes:
+            for fn in _SCOPE_EXIT_HOOKS:
+                fn()
 
 
 def current_axis(name):

@@ -1,0 +1,375 @@
+"""paddle.distributed functional collectives.
+
+Reference parity: python/paddle/distributed/communication/ (U) —
+all_reduce/all_gather/reduce_scatter/broadcast/scatter/alltoall/send/recv over
+ProcessGroupNCCL comm rings (SURVEY.md §2.2 P9, §2.1 N13/N14).
+
+TPU-native design — there is ONE communication regime, SPMD: a collective is a
+named-axis XLA op (`lax.psum`, `lax.all_gather`, `lax.psum_scatter`,
+`lax.all_to_all`, `lax.ppermute`) executed inside `shard_map`/`pjit` over the
+device mesh, where XLA schedules it onto ICI/DCN and overlaps it with compute
+(replacing the reference's dedicated NCCL comm streams, SURVEY.md §3.2).
+Eager calls outside any mapped axis are the world-size-1 degenerate case and
+are identity — matching the reference's behavior on a 1-GPU group. Calling an
+eager collective on a >1 group is a programming error here (there is no
+per-rank eager tensor in single-controller jax) and raises with guidance.
+
+Gradient support: every wrapper routes through `core.op_call.apply`, so tape
+autograd records the vjp jax derives for the collective (psum ↔ psum, etc.).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.op_call import apply
+from ..core.tensor import Tensor
+from . import collective_ctx
+from .topology import Group, ReduceOp, get_hybrid_communicate_group
+
+__all__ = [
+    "ReduceOp", "all_reduce", "all_gather", "all_gather_object", "reduce",
+    "reduce_scatter", "broadcast", "scatter", "alltoall", "alltoall_single",
+    "send", "recv", "isend", "irecv", "barrier", "wait", "get_group",
+    "new_group", "destroy_process_group", "shift",
+]
+
+_GROUPS = {}
+
+
+def _default_group():
+    hcg = get_hybrid_communicate_group()
+    if hcg is not None:
+        # world group over every mesh axis is rarely what callers want; the
+        # default eager group is the data-parallel group, matching the
+        # reference's default comm group for DataParallel scripts
+        return hcg.get_data_parallel_group()
+    return Group(axis_name=None, nranks=1)
+
+
+def _resolve(group):
+    if group is None:
+        return _default_group()
+    return group
+
+
+def new_group(ranks=None, backend=None, timeout=None, axis_name=None):
+    """Create a Group. TPU-native: a group must correspond to a mesh axis to
+    be usable inside compiled code; `axis_name` picks it. Plain rank lists
+    produce an opaque group usable only for bookkeeping/world-size-1."""
+    g = Group(
+        axis_name=axis_name,
+        nranks=len(ranks) if ranks else 1,
+        ranks=ranks or [0],
+    )
+    _GROUPS[g.id] = g
+    return g
+
+
+def get_group(gid=0):
+    return _GROUPS.get(gid) or _default_group()
+
+
+def destroy_process_group(group=None):
+    if group is None:
+        _GROUPS.clear()
+    else:
+        _GROUPS.pop(group.id, None)
+
+
+def _axis_live(group):
+    """The axis over which this collective should compile, or None (eager)."""
+    if group.axis_name is None:
+        return None
+    return collective_ctx.current_axis(group.axis_name)
+
+
+def _eager_guard(group, opname):
+    if group.nranks == 1:
+        return  # degenerate world: identity
+    raise RuntimeError(
+        f"paddle.distributed.{opname} on a {group.nranks}-rank group was "
+        f"called outside shard_map scope for axis {group.axis_name!r}. "
+        "TPU-native collectives compile inside shard_map/pjit (use "
+        "fleet.distributed_model / shard_map, or a world-size-1 group)."
+    )
+
+
+def _unary(tensor, fn, in_place=True):
+    out = apply(fn, tensor) if isinstance(tensor, Tensor) else fn(tensor)
+    if in_place and isinstance(tensor, Tensor):
+        tensor._data = out._data
+        tensor._tape_node = out._tape_node
+        tensor.stop_gradient = out.stop_gradient
+        return None
+    return out
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """In-place all-reduce (ref: communication/all_reduce.py (U))."""
+    group = _resolve(group)
+    axis = _axis_live(group)
+    if axis is None:
+        _eager_guard(group, "all_reduce")
+        return None
+
+    def fn(x):
+        if op == ReduceOp.SUM:
+            return lax.psum(x, axis)
+        if op == ReduceOp.MAX:
+            return lax.pmax(x, axis)
+        if op == ReduceOp.MIN:
+            return lax.pmin(x, axis)
+        if op == ReduceOp.PROD:
+            return jnp.prod(lax.all_gather(x, axis, axis=0, tiled=False), axis=0)
+        if op == ReduceOp.AVG:
+            return lax.pmean(x, axis)
+        raise ValueError(f"unknown ReduceOp {op}")
+
+    return _unary(tensor, fn)
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
+    """Gather shards from every rank (ref: communication/all_gather.py (U)).
+
+    SPMD form: returns/extends with the gathered global tensor. The reference
+    fills `tensor_list` with per-rank tensors; we append per-rank slices so
+    caller code written against the reference API keeps working."""
+    group = _resolve(group)
+    ax = _axis_live(group)
+    if ax is None:
+        _eager_guard(group, "all_gather")
+        if tensor_list is not None:
+            tensor_list.append(tensor)
+            return None
+        return tensor
+
+    gathered = apply(lambda x: lax.all_gather(x, ax, axis=axis, tiled=False), tensor)
+    if tensor_list is not None:
+        # tiled=False inserts the nranks dimension at position `axis`
+        from ..tensor.manipulation import unstack
+
+        tensor_list.extend(unstack(gathered, axis=axis))
+        return None
+    return gathered
+
+
+def all_gather_object(object_list, obj, group=None):
+    group = _resolve(group)
+    if group.nranks == 1:
+        object_list.append(obj)
+        return None
+    raise RuntimeError("all_gather_object requires host-side exchange; use "
+                       "jax.experimental.multihost_utils in multi-process mode")
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    """Reduce-to-root == all_reduce under SPMD (every shard holds the result;
+    XLA DCE drops it on non-consuming ranks)."""
+    return all_reduce(tensor, op=op, group=group, sync_op=sync_op)
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None, sync_op=True):
+    """ref: communication/reduce_scatter.py (U). Output `tensor` receives this
+    rank's reduced shard (psum_scatter over the axis)."""
+    group = _resolve(group)
+    ax = _axis_live(group)
+    src = tensor_or_tensor_list
+    if isinstance(src, (list, tuple)):
+        from ..tensor.manipulation import concat
+
+        src = concat(list(src), axis=0)
+    if ax is None:
+        _eager_guard(group, "reduce_scatter")
+        if isinstance(tensor, Tensor):
+            tensor._data = src._data if isinstance(src, Tensor) else src
+        return None
+    out = apply(lambda x: lax.psum_scatter(x, ax, scatter_dimension=0, tiled=True), src)
+    if isinstance(tensor, Tensor):
+        tensor._data = out._data
+        tensor._tape_node = out._tape_node
+        tensor.stop_gradient = out.stop_gradient
+        return None
+    return out
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    """Under SPMD a broadcast is: select the source shard on every rank."""
+    group = _resolve(group)
+    ax = _axis_live(group)
+    if ax is None:
+        _eager_guard(group, "broadcast")
+        return None
+    src_in_group = group.get_group_rank(src)
+    if src_in_group < 0:
+        if 0 <= src < group.nranks:
+            src_in_group = src  # caller passed a group-local rank
+        else:
+            raise ValueError(
+                f"broadcast src={src} is not a member of group {group.ranks}")
+
+    def fn(x):
+        # all_gather then index the source slice: compiles to a broadcast
+        return lax.all_gather(x, ax, axis=0, tiled=False)[src_in_group]
+
+    return _unary(tensor, fn)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    group = _resolve(group)
+    ax = _axis_live(group)
+    if ax is None:
+        _eager_guard(group, "scatter")
+        if tensor_list:
+            t = tensor_list[src if src < len(tensor_list) else 0]
+            tensor._data = t._data if isinstance(t, Tensor) else t
+        return None
+    from ..tensor.manipulation import stack
+
+    full = stack(list(tensor_list), axis=0)
+
+    def fn(x):
+        idx = lax.axis_index(ax)
+        # every rank holds the full stack (src-replicated); take own slice
+        return lax.dynamic_index_in_dim(x, idx, axis=0, keepdims=False)
+
+    out = apply(fn, full)
+    tensor._data = out._data
+    tensor._tape_node = out._tape_node
+    tensor.stop_gradient = out.stop_gradient
+    return None
+
+
+def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    """ref: communication/all_to_all.py (U). SPMD: lax.all_to_all."""
+    group = _resolve(group)
+    ax = _axis_live(group)
+    if ax is None:
+        _eager_guard(group, "alltoall")
+        out_tensor_list.extend(in_tensor_list)
+        return None
+    from ..tensor.manipulation import stack
+
+    full = stack(list(in_tensor_list), axis=0)
+    out = apply(
+        lambda x: lax.all_to_all(x, ax, split_axis=0, concat_axis=0, tiled=False),
+        full,
+    )
+    for i in range(group.nranks):
+        out_tensor_list.append(out[i])
+    return None
+
+
+def alltoall_single(
+    out_tensor, in_tensor, in_split_sizes=None, out_split_sizes=None,
+    group=None, sync_op=True,
+):
+    group = _resolve(group)
+    ax = _axis_live(group)
+    if ax is None:
+        _eager_guard(group, "alltoall_single")
+        out_tensor._data = in_tensor._data
+        return None
+    if in_split_sizes or out_split_sizes:
+        raise NotImplementedError("uneven alltoall splits are not supported on TPU "
+                                  "(XLA all_to_all requires equal splits)")
+    out = apply(
+        lambda x: lax.all_to_all(x, ax, split_axis=0, concat_axis=0, tiled=True),
+        in_tensor,
+    )
+    if isinstance(out_tensor, Tensor):
+        out_tensor._data = out._data
+        out_tensor._tape_node = out._tape_node
+        out_tensor.stop_gradient = out.stop_gradient
+        return None
+    return out
+
+
+def shift(tensor, offset=1, group=None):
+    """TPU-native p2p primitive: circular shift along the group axis via
+    `lax.ppermute` — the building block pipeline/ring layers use instead of
+    the reference's send_v2/recv_v2 ops (SURVEY.md §2.1 N14)."""
+    group = _resolve(group)
+    ax = _axis_live(group)
+    if ax is None:
+        _eager_guard(group, "shift")
+        return tensor
+    n = group.nranks
+    perm = [(i, (i + offset) % n) for i in range(n)]
+    return apply(lambda x: lax.ppermute(x, ax, perm), tensor)
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """p2p send. SPMD form: uniform-shift ppermute (dst = my_rank + k for the
+    same k on every rank — the only pattern pipeline parallelism needs).
+    The shifted value is buffered per (axis, offset) until the matching
+    recv(); the buffer is cleared when the axis scope exits, so a send left
+    unconsumed (aborted trace) cannot leak a stale tracer into a later
+    program."""
+    group = _resolve(group)
+    ax = _axis_live(group)
+    if ax is None:
+        _eager_guard(group, "send")
+        return None
+    offset = (dst - group.rank) % group.nranks
+    _P2P_BUF.setdefault((ax, offset), []).append(shift(tensor, offset=offset, group=group))
+    return None
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    group = _resolve(group)
+    ax = _axis_live(group)
+    if ax is None:
+        _eager_guard(group, "recv")
+        return None
+    offset = (group.rank - src) % group.nranks
+    pending = _P2P_BUF.get((ax, offset))
+    if not pending:
+        raise RuntimeError(
+            f"recv(src={src}) on axis {ax!r}: no matching send() with shift "
+            f"{offset} in this SPMD program")
+    out = pending.pop(0)
+    tensor._data = out._data
+    tensor._tape_node = out._tape_node
+    tensor.stop_gradient = out.stop_gradient
+    return None
+
+
+_P2P_BUF: dict = {}
+collective_ctx.register_scope_exit(_P2P_BUF.clear)
+
+
+def isend(tensor, dst=0, group=None):
+    send(tensor, dst=dst, group=group)
+    return _DoneTask()
+
+
+def irecv(tensor, src=0, group=None):
+    recv(tensor, src=src, group=group)
+    return _DoneTask()
+
+
+class _DoneTask:
+    """Collectives compile into the XLA program — by the time Python sees the
+    result the op is scheduled; wait() is a no-op (reference returns a Task
+    backed by a cuda event)."""
+
+    def wait(self):
+        return True
+
+    def is_completed(self):
+        return True
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    return None
+
+
+def barrier(group=None):
+    """No-op under single-controller SPMD; multi-process sync happens at
+    compile/dispatch boundaries (jax.distributed coordination service)."""
+    return None

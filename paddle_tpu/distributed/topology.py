@@ -1,0 +1,321 @@
+"""Hybrid-parallel topology over a `jax.sharding.Mesh`.
+
+Reference parity: python/paddle/distributed/fleet/base/topology.py (U) —
+`CommunicateTopology` + `HybridCommunicateGroup` build the 4D/5D process grid
+[data, pipe, sharding, sep, model] and create an NCCL comm group per axis
+(SURVEY.md §2.2 P11).
+
+TPU-native design: the process grid IS a `jax.sharding.Mesh` with named axes
+("dp", "pp", "sharding", "sep", "mp"). A "communication group" is not a comm
+ring object that owns sockets — it is a *named mesh axis*; collectives become
+`lax.psum`/`all_gather`/`ppermute` over the axis name inside `shard_map`, and
+XLA lowers them onto ICI (intra-slice) / DCN (multi-slice) links. `Group`
+therefore carries only (axis name, size, coordinate), plus enough metadata for
+the paddle.distributed API surface (ranks lists, group ids).
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+
+import numpy as np
+
+# paddle axis name -> mesh axis name
+_AXIS_ALIASES = {
+    "data": "dp",
+    "pipe": "pp",
+    "sharding": "sharding",
+    "sep": "sep",
+    "model": "mp",
+}
+# canonical hybrid order, matching the reference's topology order
+HYBRID_ORDER = ("data", "pipe", "sharding", "sep", "model")
+
+
+def mesh_axis_name(paddle_name: str) -> str:
+    return _AXIS_ALIASES.get(paddle_name, paddle_name)
+
+
+class ReduceOp:
+    """paddle.distributed.ReduceOp parity."""
+
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+class Group:
+    """A communication group == one named axis of the device mesh.
+
+    The reference's Group wraps an NCCL communicator (process_group_nccl.cc);
+    here it names a mesh axis so collectives compile to XLA collectives.
+    """
+
+    _next_gid = itertools.count(0)
+
+    def __init__(self, axis_name, nranks, rank_in_group=0, ranks=None, mesh=None):
+        self.axis_name = axis_name  # mesh axis ('dp', 'mp', ...) or None (world)
+        self.nranks = int(nranks)
+        self.rank = int(rank_in_group)
+        self.ranks = list(ranks) if ranks is not None else list(range(self.nranks))
+        self.mesh = mesh
+        self.id = next(Group._next_gid)
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    @property
+    def process_group(self):  # reference API compat
+        return self
+
+    def get_group_rank(self, global_rank):
+        try:
+            return self.ranks.index(global_rank)
+        except ValueError:
+            return -1
+
+    def is_member(self):
+        return True
+
+    def __repr__(self):
+        return f"Group(axis={self.axis_name!r}, nranks={self.nranks}, rank={self.rank})"
+
+
+class CommunicateTopology:
+    """Rank-grid arithmetic (reference: CommunicateTopology, topology.py (U))."""
+
+    def __init__(self, hybrid_group_names=None, dims=None):
+        self._parallel_names = list(hybrid_group_names or HYBRID_ORDER)
+        self._dims = list(dims or [1] * len(self._parallel_names))
+        if len(self._dims) != len(self._parallel_names):
+            raise ValueError("dims and hybrid_group_names length mismatch")
+        self.coordinate = collections.namedtuple("Coordinate", self._parallel_names)
+        self.world_size = int(np.prod(self._dims))
+        ranges = [range(d) for d in self._dims]
+        all_coords = [self.coordinate(*c) for c in itertools.product(*ranges)]
+        self._coord2rank = dict(zip(all_coords, range(self.world_size)))
+        self._rank2coord = dict(zip(self._coord2rank.values(), self._coord2rank.keys()))
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def get_rank(self, **kwargs):
+        return self._coord2rank[self.coordinate(**kwargs)]
+
+    def get_coord(self, rank):
+        return self._rank2coord[rank]
+
+    def get_axis_list(self, axis_name, index):
+        """All global ranks whose coordinate on `axis_name` equals `index`."""
+        axis = self._parallel_names.index(axis_name)
+        return sorted(r for c, r in self._coord2rank.items() if c[axis] == index)
+
+    def get_comm_list(self, axis_name):
+        """List of rank-lists, one per communicator along `axis_name`."""
+        axis = self._parallel_names.index(axis_name)
+        other_ranges = [
+            range(d) for i, d in enumerate(self._dims) if i != axis
+        ]
+        comm_list = []
+        for other in itertools.product(*other_ranges):
+            ranks = []
+            for i in range(self._dims[axis]):
+                coord = list(other)
+                coord.insert(axis, i)
+                ranks.append(self._coord2rank[self.coordinate(*coord)])
+            comm_list.append(ranks)
+        return comm_list
+
+    def get_rank_from_stage(self, global_rank, **kwargs):
+        coord = self.get_coord(global_rank)
+        tf = coord._replace(**kwargs)._asdict()
+        return self.get_rank(**tf)
+
+
+def build_mesh(dims_by_name, devices=None):
+    """Build the device mesh for a hybrid topology.
+
+    dims_by_name: ordered {paddle_axis_name: degree}. Degree-1 axes are kept in
+    the mesh so PartitionSpecs may always name them.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    n = int(np.prod(list(dims_by_name.values())))
+    if n > len(devices):
+        raise ValueError(
+            f"topology needs {n} devices, only {len(devices)} available"
+        )
+    dev = np.array(devices[:n]).reshape(tuple(dims_by_name.values()))
+    names = tuple(mesh_axis_name(k) for k in dims_by_name)
+    return Mesh(dev, names)
+
+
+class HybridCommunicateGroup:
+    """Reference parity: HybridCommunicateGroup (topology.py (U)).
+
+    Owns the jax Mesh and hands out per-axis Groups. In single-process SPMD
+    the "current rank" is a virtual coordinate (default 0 on every axis);
+    under multi-process jax.distributed it is the process's first device's
+    coordinate.
+    """
+
+    def __init__(self, topology: CommunicateTopology, devices=None, global_rank=None):
+        self._topo = topology
+        names = topology.get_hybrid_group_names()
+        dims = [topology.get_dim(n) for n in names]
+        self._mesh = build_mesh(dict(zip(names, dims)), devices)
+        self.nranks = topology.world_size
+        self.global_rank = 0 if global_rank is None else int(global_rank)
+
+        self._dp_degree = self._degree("data")
+        self._pp_degree = self._degree("pipe")
+        self._sharding_degree = self._degree("sharding")
+        self._sep_degree = self._degree("sep")
+        self._mp_degree = self._degree("model")
+
+        coord = topology.get_coord(self.global_rank)
+        self._groups = {}
+        for name in names:
+            idx = getattr(coord, name)
+            # ranks along this axis that share all *other* coordinates:
+            comm = None
+            for rl in topology.get_comm_list(name):
+                if self.global_rank in rl:
+                    comm = rl
+                    break
+            self._groups[name] = Group(
+                mesh_axis_name(name),
+                topology.get_dim(name),
+                rank_in_group=idx,
+                ranks=comm,
+                mesh=self._mesh,
+            )
+
+        global _HCG
+        _HCG = self
+
+    def _degree(self, name):
+        try:
+            return self._topo.get_dim(name)
+        except ValueError:
+            return 1
+
+    # ---- mesh access (TPU-native extension) ----
+    @property
+    def mesh(self):
+        return self._mesh
+
+    def topology(self):
+        return self._topo
+
+    def get_parallel_mode(self):
+        # reference returns a ParallelMode enum; keep simple strings
+        if self._mp_degree > 1 or self._pp_degree > 1 or self._sharding_degree > 1:
+            return "hybrid"
+        return "data" if self._dp_degree > 1 else "single"
+
+    # ---- per-axis accessors, reference API names ----
+    def get_data_parallel_rank(self):
+        return self._groups["data"].rank
+
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_data_parallel_group(self):
+        return self._groups["data"]
+
+    def get_data_parallel_group_src_rank(self):
+        return self._groups["data"].ranks[0]
+
+    def get_model_parallel_rank(self):
+        return self._groups["model"].rank
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_model_parallel_group(self):
+        return self._groups["model"]
+
+    def get_model_parallel_group_src_rank(self):
+        return self._groups["model"].ranks[0]
+
+    def get_stage_id(self):
+        return self._groups["pipe"].rank
+
+    def get_pipe_parallel_rank(self):
+        return self._groups["pipe"].rank
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_pipe_parallel_group(self):
+        return self._groups["pipe"]
+
+    def get_sharding_parallel_rank(self):
+        return self._groups["sharding"].rank
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sharding_parallel_group(self):
+        return self._groups["sharding"]
+
+    def get_sharding_parallel_group_src_rank(self):
+        return self._groups["sharding"].ranks[0]
+
+    def get_sep_parallel_rank(self):
+        return self._groups["sep"].rank
+
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    def get_sep_parallel_group(self):
+        return self._groups["sep"]
+
+    def get_global_rank(self):
+        return self.global_rank
+
+    def get_rank_from_stage(self, stage_id, **kwargs):
+        return self._topo.get_rank_from_stage(self.global_rank, pipe=stage_id, **kwargs)
+
+    # first/last pipeline stage helpers (reference: is_first_stage property)
+    @property
+    def is_first_stage(self):
+        return self.get_stage_id() == 0
+
+    @property
+    def is_last_stage(self):
+        return self.get_stage_id() == self._pp_degree - 1
+
+
+_HCG = None
+
+
+def get_hybrid_communicate_group():
+    return _HCG
+
+
+def set_hybrid_communicate_group(hcg):
+    global _HCG
+    _HCG = hcg
+
+
+def create_hybrid_communicate_group(dp=1, mp=1, pp=1, sharding=1, sep=1, devices=None):
+    """Convenience builder used by fleet.init and tests."""
+    topo = CommunicateTopology(
+        hybrid_group_names=list(HYBRID_ORDER),
+        dims=[dp, pp, sharding, sep, mp],
+    )
+    return HybridCommunicateGroup(topo, devices=devices)

@@ -27,7 +27,9 @@ def default_collate_fn(batch):
     if isinstance(sample, Tensor):
         return Tensor(np.stack([np.asarray(s._data) for s in batch]))
     if isinstance(sample, (int, np.integer)):
-        return Tensor(np.asarray(batch, np.int64 if False else np.int32))
+        # int32, not the reference's int64: x64 is disabled jax-side, and
+        # int32 indices are what TPU embedding/gather kernels want
+        return Tensor(np.asarray(batch, np.int32))
     if isinstance(sample, float):
         return Tensor(np.asarray(batch, np.float32))
     if isinstance(sample, (list, tuple)):
@@ -137,7 +139,11 @@ class DataLoader:
             while n_consumed < n_submitted or not done_submitting:
                 with results_lock:
                     while n_consumed not in results:
-                        results_lock.wait(timeout=self.timeout or None)
+                        if not results_lock.wait(timeout=self.timeout or None) \
+                                and self.timeout:
+                            raise RuntimeError(
+                                f"DataLoader worker timed out after "
+                                f"{self.timeout}s waiting for batch {n_consumed}")
                     out = results.pop(n_consumed)
                 n_consumed += 1
                 if isinstance(out, Exception):

@@ -28,6 +28,7 @@ class TrainStep:
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
+        self.scaler = scaler if (scaler is not None and scaler.is_enable()) else None
         self.donate = donate
         self.mesh = mesh
         self._jitted = None
@@ -50,7 +51,10 @@ class TrainStep:
         name_by_id = {id(p): n for n, p in params.items()}
         loss_fn = self.loss_fn
 
-        def step_fn(param_arrays, buffer_arrays, opt_states, lr, rng_key, *batch):
+        scaler = self.scaler
+
+        def step_fn(param_arrays, buffer_arrays, opt_states, lr, rng_key,
+                    scaler_state, *batch):
             arrays = dict(zip(self._param_names, param_arrays))
             arrays.update(zip(self._buffer_names, buffer_arrays))
             with random_state.fork_rng(rng_key):
@@ -60,7 +64,23 @@ class TrainStep:
                     for p in live_params:
                         p.grad = None
                     loss = loss_fn(model, *[Tensor(b) for b in batch])
-                    loss.backward()
+                    found_inf = jnp.zeros((), jnp.bool_)
+                    if scaler is None:
+                        loss.backward()
+                    else:
+                        # dynamic loss scaling, fully in-program (the
+                        # reference's GradScaler.scale/unscale_/update,
+                        # grad_scaler.py (U), staged into one XLA step)
+                        scale, good, bad = scaler_state
+                        (loss * Tensor(scale)).backward()
+                        inv = 1.0 / scale
+                        with _tape.no_grad():
+                            for p in live_params:
+                                if p.grad is None:
+                                    continue
+                                g32 = p.grad._data.astype(jnp.float32) * inv
+                                found_inf = found_inf | ~jnp.all(jnp.isfinite(g32))
+                                p.grad._data = g32.astype(p.grad._data.dtype)
                     params_grads = [(p, p.grad) for p in live_params if p.grad is not None]
                     if opt._grad_clip is not None:
                         params_grads = opt._grad_clip(params_grads)
@@ -77,13 +97,35 @@ class TrainStep:
                                 continue
                             plr = lr * p.optimize_attr.get("learning_rate", 1.0)
                             np_, nst = opt._update(p._data, g._data, st, plr)
+                            if scaler is not None:
+                                # skip the step on inf/nan grads
+                                np_ = jnp.where(found_inf, p._data, np_)
+                                nst = jax.tree.map(
+                                    lambda new, old: jnp.where(found_inf, old, new),
+                                    nst, st)
                             new_params.append(np_)
                             new_opt_states.append(nst)
                     new_buffers = [model.state_dict()[n]._data for n in self._buffer_names]
                     # clear tracer grads so they don't leak out of the trace
                     for p in live_params:
                         p.grad = None
-            return new_params, new_buffers, new_opt_states, loss._data
+            if scaler is None:
+                new_scaler_state = scaler_state
+            else:
+                # GradScaler.update() semantics, traced
+                bad1 = jnp.where(found_inf, bad + 1, jnp.zeros_like(bad))
+                good1 = jnp.where(found_inf, jnp.zeros_like(good), good + 1)
+                dec = found_inf & (bad1 >= scaler._decr_every)
+                inc = (~found_inf) & (good1 >= scaler._incr_every)
+                if not scaler._dynamic:
+                    dec = inc = jnp.zeros((), jnp.bool_)
+                new_scale = jnp.where(
+                    dec, jnp.maximum(scale * scaler._decr_ratio, 1.0),
+                    jnp.where(inc, scale * scaler._incr_ratio, scale))
+                new_scaler_state = (new_scale,
+                                    jnp.where(inc, jnp.zeros_like(good1), good1),
+                                    jnp.where(dec, jnp.zeros_like(bad1), bad1))
+            return new_params, new_buffers, new_opt_states, loss._data, new_scaler_state
 
         donate = (0, 2) if self.donate else ()
         self._jitted = jax.jit(step_fn, donate_argnums=donate)
@@ -101,9 +143,19 @@ class TrainStep:
         lr = jnp.asarray(opt.get_lr(), jnp.float32)
         rng_key = random_state.next_key()
         batch_arrays = [b._data if isinstance(b, Tensor) else jnp.asarray(b) for b in batch]
-        new_params, new_buffers, new_opt_states, loss = self._jitted(
-            param_arrays, buffer_arrays, opt_states, lr, rng_key, *batch_arrays
+        if self.scaler is not None:
+            scaler_state = (jnp.asarray(self.scaler._scale, jnp.float32),
+                            jnp.asarray(self.scaler._good_steps, jnp.int32),
+                            jnp.asarray(self.scaler._bad_steps, jnp.int32))
+        else:
+            scaler_state = ()
+        new_params, new_buffers, new_opt_states, loss, new_scaler_state = self._jitted(
+            param_arrays, buffer_arrays, opt_states, lr, rng_key, scaler_state,
+            *batch_arrays
         )
+        if self.scaler is not None:
+            self.scaler._scale, self.scaler._good_steps, self.scaler._bad_steps = (
+                new_scaler_state)
         for n, arr in zip(self._param_names, new_params):
             sd[n]._data = arr
         for n, arr in zip(self._buffer_names, new_buffers):

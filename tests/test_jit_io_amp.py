@@ -225,3 +225,30 @@ class TestCheckpointing:
         m2.prepare(paddle.optimizer.Adam(parameters=net2.parameters()), nn.MSELoss())
         m2.load(p)
         np.testing.assert_array_equal(net.weight.numpy(), net2.weight.numpy())
+
+
+class TestTrainStepScaler:
+    def test_dynamic_loss_scaling_in_train_step(self):
+        """Scaler staged into the jitted step: scale grows on good steps,
+        halves on inf, and an inf step leaves params untouched."""
+        paddle.seed(0)
+        m = nn.Linear(4, 4)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2, parameters=m.parameters())
+        scaler = paddle.amp.GradScaler(
+            init_loss_scaling=2.0**10, incr_every_n_steps=2,
+            decr_every_n_nan_or_inf=1)
+        step = paddle.jit.TrainStep(
+            m, lambda net, x, y: nn.functional.mse_loss(net(x), y), opt,
+            scaler=scaler)
+        x = paddle.randn([8, 4])
+        y = paddle.randn([8, 4])
+        l0 = float(step(x, y))
+        for _ in range(3):
+            l1 = float(step(x, y))
+        assert l1 < l0
+        assert float(scaler._scale) == 2.0**12  # two incr_every_n_steps=2 bumps
+        w_before = m.weight.numpy().copy()
+        xinf = paddle.to_tensor(np.full((8, 4), 1e30, np.float32))
+        step(xinf, y)
+        np.testing.assert_array_equal(m.weight.numpy(), w_before)
+        assert float(scaler._scale) == 2.0**11  # halved on inf
