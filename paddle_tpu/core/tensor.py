@@ -274,7 +274,8 @@ def _index_to_jax(idx):
 class Parameter(Tensor):
     """A trainable Tensor (stop_gradient=False), registered by nn.Layer."""
 
-    __slots__ = ("optimize_attr", "regularizer", "is_distributed", "_sharding_axes")
+    __slots__ = ("optimize_attr", "regularizer", "is_distributed",
+                 "_sharding_axes", "sequence_parallel")
 
     def __init__(self, data, dtype=None, name=None, trainable=True):
         super().__init__(data, dtype=dtype, stop_gradient=not trainable, name=name)
@@ -284,6 +285,7 @@ class Parameter(Tensor):
         self.regularizer = None
         self.is_distributed = False
         self._sharding_axes = None  # PartitionSpec-like hint used by auto-parallel
+        self.sequence_parallel = False  # grads need an mp-allreduce (SP regions)
 
     def __repr__(self):
         return f"Parameter(name={self.name}, shape={self.shape}, dtype={self._data.dtype})\n       {self._data}"

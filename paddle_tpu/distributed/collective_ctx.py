@@ -21,6 +21,7 @@ class _Scope(threading.local):
 
 _SCOPE = _Scope()
 _SCOPE_EXIT_HOOKS = []
+_SCOPE_ENTER_HOOKS = []
 
 
 def register_scope_exit(fn):
@@ -29,10 +30,19 @@ def register_scope_exit(fn):
     _SCOPE_EXIT_HOOKS.append(fn)
 
 
+def register_scope_enter(fn):
+    """Run `fn()` whenever an outermost axis scope is entered — a fresh trace
+    must never see buffers left behind by an earlier aborted trace."""
+    _SCOPE_ENTER_HOOKS.append(fn)
+
+
 @contextlib.contextmanager
 def axis_scope(*axis_names):
     """Declare that `axis_names` are live named axes (entered by shard_map
     wrappers in distributed.fleet / distributed.parallel)."""
+    if not _SCOPE.axes:
+        for fn in _SCOPE_ENTER_HOOKS:
+            fn()
     _SCOPE.axes.extend(axis_names)
     try:
         yield

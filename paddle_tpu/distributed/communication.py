@@ -21,6 +21,7 @@ autograd records the vjp jax derives for the collective (psum ↔ psum, etc.).
 from __future__ import annotations
 
 import functools
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -206,11 +207,8 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
         return None
     src_in_group = group.get_group_rank(src)
     if src_in_group < 0:
-        if 0 <= src < group.nranks:
-            src_in_group = src  # caller passed a group-local rank
-        else:
-            raise ValueError(
-                f"broadcast src={src} is not a member of group {group.ranks}")
+        raise ValueError(
+            f"broadcast src={src} is not a member of group {group.ranks}")
 
     def fn(x):
         # all_gather then index the source slice: compiles to a broadcast
@@ -339,8 +337,27 @@ def recv(tensor, src=0, group=None, sync_op=True):
     return None
 
 
-_P2P_BUF: dict = {}
+class _P2PBuf(threading.local):
+    """Pending sends, per thread (axis scopes are thread-local too): a send
+    buffered in one thread must never satisfy — or be cleared by — another
+    thread's trace."""
+
+    def __init__(self):
+        self.pending = {}
+
+    def setdefault(self, key, default):
+        return self.pending.setdefault(key, default)
+
+    def get(self, key):
+        return self.pending.get(key)
+
+    def clear(self):
+        self.pending.clear()
+
+
+_P2P_BUF = _P2PBuf()
 collective_ctx.register_scope_exit(_P2P_BUF.clear)
+collective_ctx.register_scope_enter(_P2P_BUF.clear)
 
 
 def isend(tensor, dst=0, group=None):

@@ -1,0 +1,9 @@
+"""paddle.distributed.fleet (ref: python/paddle/distributed/fleet/ (U),
+SURVEY.md P10-P18). TPU-native: strategy-driven wrappers over the hybrid
+device mesh."""
+from ..topology import (
+    get_hybrid_communicate_group,
+    set_hybrid_communicate_group,
+)
+from . import meta_parallel
+from .utils import sequence_parallel_utils

@@ -1,0 +1,2 @@
+from . import mp_ops, random
+from .random import get_rng_state_tracker, model_parallel_random_seed

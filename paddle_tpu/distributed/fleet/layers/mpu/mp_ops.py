@@ -1,0 +1,220 @@
+"""Model-parallel communication primitives.
+
+Reference parity: fleet/layers/mpu/mp_ops.py (U) — `_c_identity`, `_c_split`,
+`_c_concat`, `_mp_allreduce`, `_c_lookup_table`,
+`_c_softmax_with_cross_entropy` over the mp NCCL ring (SURVEY.md §2.2 P12,
+§2.1 N14).
+
+TPU-native design: each primitive is a named-axis op executed inside
+`shard_map` over the 'mp' mesh axis. The asymmetric-gradient pairs
+(identity-forward/allreduce-backward and its dual) are `jax.custom_vjp`
+functions; the rest (all_gather / psum_scatter) use the vjps jax derives.
+Outside any mapped axis these all degrade to the mp=1 identity, matching the
+reference's single-rank behavior.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .....core.op_call import apply
+from .....core.tensor import Tensor
+from .... import collective_ctx
+
+
+# ---------------------------------------------------------------- raw (jnp)
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def identity_fwd_allreduce_bwd(x, axis_name):
+    """ref `_c_identity`: forward passes through; backward all-reduces the
+    gradient over the mp axis (the column-parallel input path)."""
+    return x
+
+
+def _id_fwd(x, axis_name):
+    return x, None
+
+
+def _id_bwd(axis_name, _, g):
+    return (lax.psum(g, axis_name),)
+
+
+identity_fwd_allreduce_bwd.defvjp(_id_fwd, _id_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def allreduce_fwd_identity_bwd(x, axis_name):
+    """ref `_mp_allreduce` (and row-parallel output path): forward
+    all-reduces partial sums; backward passes the gradient through."""
+    return lax.psum(x, axis_name)
+
+
+def _ar_fwd(x, axis_name):
+    return lax.psum(x, axis_name), None
+
+
+def _ar_bwd(axis_name, _, g):
+    return (g,)
+
+
+allreduce_fwd_identity_bwd.defvjp(_ar_fwd, _ar_bwd)
+
+
+def split_last_dim(x, axis_name):
+    """ref `_c_split`: keep this rank's slice of the last dim. Backward is the
+    all-gather jax derives from dynamic_slice + the surrounding shard_map."""
+    n = lax.axis_size(axis_name)
+    i = lax.axis_index(axis_name)
+    size = x.shape[-1] // n
+    return lax.dynamic_slice_in_dim(x, i * size, size, axis=-1)
+
+
+def concat_last_dim(x, axis_name):
+    """ref `_c_concat`: all-gather shards and concatenate on the last dim."""
+    return lax.all_gather(x, axis_name, axis=x.ndim - 1, tiled=True)
+
+
+def gather_axis(x, axis_name, axis):
+    """all-gather along `axis` (sequence-parallel gather)."""
+    return lax.all_gather(x, axis_name, axis=axis, tiled=True)
+
+
+def reduce_scatter_axis(x, axis_name, axis):
+    """reduce-scatter along `axis` (sequence-parallel reduce path)."""
+    return lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
+
+
+def vocab_parallel_embedding_lookup(ids, local_weight, axis_name):
+    """ref `_c_lookup_table` + VocabParallelEmbedding.forward: each rank owns
+    rows [i*per, (i+1)*per) of the embedding table; out-of-range ids produce
+    zeros and the partial lookups are summed over the mp axis."""
+    n = lax.axis_size(axis_name)
+    i = lax.axis_index(axis_name)
+    per = local_weight.shape[0]
+    start = i * per
+    local_ids = ids - start
+    mask = (local_ids >= 0) & (local_ids < per)
+    safe = jnp.where(mask, local_ids, 0)
+    out = jnp.take(local_weight, safe, axis=0)
+    out = out * mask[..., None].astype(out.dtype)
+    return lax.psum(out, axis_name)
+
+
+def _vp_ce_compute(local_logits, labels, axis_name, ignore_index):
+    i = lax.axis_index(axis_name)
+    per = local_logits.shape[-1]
+    start = i * per
+
+    f32 = local_logits.astype(jnp.float32)
+    lmax = lax.pmax(lax.stop_gradient(jnp.max(f32, axis=-1)), axis_name)
+    shifted = f32 - lmax[..., None]
+    sumexp = lax.psum(jnp.sum(jnp.exp(shifted), axis=-1), axis_name)
+
+    local_label = labels - start
+    in_range = (local_label >= 0) & (local_label < per)
+    safe = jnp.where(in_range, local_label, 0)
+    tgt = jnp.take_along_axis(shifted, safe[..., None], axis=-1)[..., 0]
+    tgt = lax.psum(tgt * in_range.astype(tgt.dtype), axis_name)
+
+    loss = jnp.log(sumexp) - tgt
+    keep = None
+    if ignore_index >= 0:
+        keep = (labels != ignore_index).astype(loss.dtype)
+        loss = loss * keep
+    return loss, (shifted, sumexp, safe, in_range, keep)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def vocab_parallel_cross_entropy(local_logits, labels, axis_name,
+                                 ignore_index=-100):
+    """ref `_c_softmax_with_cross_entropy` (a fused CUDA op with a
+    hand-written grad): softmax cross-entropy over vocab-sharded logits
+    without materializing the full vocab dim — global max via pmax, global
+    sum-exp via psum, target logit recovered by masking.
+
+    The VJP is hand-written like the reference's: grad wrt the local logits is
+    (softmax_local − onehot_local)·ḡ with NO backward collective. Relying on
+    jax's psum-transpose(=psum) here would scale grads by the axis size,
+    because the replicated loss double-counts each rank's contribution."""
+    return _vp_ce_compute(local_logits, labels, axis_name, ignore_index)[0]
+
+
+def _vp_ce_fwd(local_logits, labels, axis_name, ignore_index):
+    loss, res = _vp_ce_compute(local_logits, labels, axis_name, ignore_index)
+    proto = jnp.zeros((0,), local_logits.dtype)  # carries the input dtype
+    return loss, (res, labels.shape, proto)
+
+
+def _vp_ce_bwd(axis_name, ignore_index, saved, g):
+    (shifted, sumexp, safe, in_range, keep), lbl_shape, proto = saved
+    in_dtype = proto.dtype
+    p = jnp.exp(shifted) / sumexp[..., None]
+    onehot = (jax.nn.one_hot(safe, shifted.shape[-1], dtype=p.dtype)
+              * in_range[..., None].astype(p.dtype))
+    gg = g if keep is None else g * keep
+    grad = gg[..., None] * (p - onehot)
+    import numpy as np
+    zero_lbl = np.zeros(lbl_shape, dtype=jax.dtypes.float0)
+    return grad.astype(in_dtype), zero_lbl
+
+
+vocab_parallel_cross_entropy.defvjp(_vp_ce_fwd, _vp_ce_bwd)
+
+
+# ------------------------------------------------------------- Tensor-level
+
+def _axis_or_none(group=None):
+    """Resolve the live mp axis: the group's mesh axis if it is currently
+    mapped (inside shard_map), else None (mp=1 degenerate)."""
+    name = getattr(group, "axis_name", None) or "mp"
+    return collective_ctx.current_axis(name)
+
+
+def _c_identity(t, group=None, skip_c_identity_dynamic=False):
+    axis = _axis_or_none(group)
+    if axis is None:
+        return t
+    return apply(lambda x: identity_fwd_allreduce_bwd(x, axis), t)
+
+
+def mp_allreduce_sum(t, group=None):
+    axis = _axis_or_none(group)
+    if axis is None:
+        return t
+    return apply(lambda x: allreduce_fwd_identity_bwd(x, axis), t)
+
+
+_mp_allreduce = mp_allreduce_sum
+
+
+def _c_split(t, group=None):
+    axis = _axis_or_none(group)
+    if axis is None:
+        return t
+    return apply(lambda x: split_last_dim(x, axis), t)
+
+
+def _c_concat(t, group=None):
+    axis = _axis_or_none(group)
+    if axis is None:
+        return t
+    return apply(lambda x: concat_last_dim(x, axis), t)
+
+
+def _parallel_linear(x, weight, bias, gather_out=True, group=None):
+    """ref `_parallel_linear` helper: column-parallel matmul."""
+    axis = _axis_or_none(group)
+    y = apply(
+        lambda a, w: jnp.matmul(a, w),
+        _c_identity(x, group) if axis else x,
+        weight,
+    )
+    if bias is not None:
+        y = apply(lambda a, b: a + b, y, bias)
+    if axis and gather_out:
+        y = _c_concat(y, group)
+    return y

@@ -1,0 +1,59 @@
+"""Per-parallel-axis RNG streams.
+
+Reference parity: fleet/layers/mpu/random.py (U) — `RNGStatesTracker` with
+'global_seed' (identical across mp ranks) and 'local_seed' (distinct per mp
+rank) streams used for dropout in tensor-parallel blocks (SURVEY.md §2.2 P12).
+
+TPU-native design: streams are fold_in-counter key streams (core.random). The
+*local* stream folds `lax.axis_index('mp')` into every key when the mp axis is
+live inside shard_map, giving each rank a distinct-but-deterministic stream
+with zero cross-device state; eagerly (single controller, GSPMD) the model is
+globally consistent anyway so local==global.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+from jax import lax
+
+from .....core import random as _random
+from .... import collective_ctx
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+_tracker = _random.default_tracker
+
+
+def get_rng_state_tracker():
+    return _tracker()
+
+
+class _LocalKeyStream(_random._KeyStream):
+    """Key stream that decorrelates per-mp-rank when 'mp' is mapped."""
+
+    def next_key(self):
+        k = super().next_key()
+        if collective_ctx.current_axis("mp") is not None:
+            k = jax.random.fold_in(k, lax.axis_index("mp"))
+        return k
+
+
+def model_parallel_random_seed(seed=None):
+    """ref `model_parallel_random_seed`: seed the tracker with a dedicated
+    model-parallel stream."""
+    tr = _tracker()
+    base = int(seed) if seed is not None else 0
+    tr.states[MODEL_PARALLEL_RNG] = _LocalKeyStream(base + 1024)
+    return tr
+
+
+@contextlib.contextmanager
+def model_parallel_rng():
+    """Dropout inside TP blocks draws from the per-rank stream."""
+    tr = _tracker()
+    if MODEL_PARALLEL_RNG not in tr.states:
+        model_parallel_random_seed(0)
+    with tr.rng_state(MODEL_PARALLEL_RNG):
+        yield

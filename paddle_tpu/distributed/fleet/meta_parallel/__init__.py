@@ -1,0 +1,9 @@
+"""fleet.meta_parallel (ref: fleet/meta_parallel/__init__.py (U))."""
+from .parallel_layers import (
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    get_rng_state_tracker,
+    model_parallel_random_seed,
+)

@@ -1,0 +1,184 @@
+"""Tensor-parallel layers.
+
+Reference parity: fleet/meta_parallel/parallel_layers/mp_layers.py (U) —
+`VocabParallelEmbedding`, `ColumnParallelLinear`, `RowParallelLinear`,
+`ParallelCrossEntropy` over the mp comm group (SURVEY.md §2.2 P12).
+
+TPU-native design — one layer, two regimes:
+
+* **GSPMD (eager / pjit)**: the layer holds the FULL logical weight tagged
+  with a `_sharding_axes` hint (e.g. `(None, 'mp')`). Math is the plain
+  dense op; when the params are device_put/constrained to the hybrid mesh,
+  XLA's SPMD partitioner emits exactly the Megatron collectives the
+  reference hand-codes (identity/allreduce pairs).
+* **Explicit shard_map**: when the 'mp' axis is live (collective_ctx), the
+  layer computes on its LOCAL shard with the explicit named-axis primitives
+  in mpu.mp_ops — identical math to the reference's comm-ring version, used
+  by the pipeline runtime and by parity tests.
+
+Weights are always *initialized* full-size so serial and sharded runs see
+bit-identical parameters (slice k of the full init == rank k's shard).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .....core.op_call import apply
+from .....nn import functional as F
+from .....nn.initializer import Normal, XavierNormal
+from .....nn.layer.layers import Layer
+from ....topology import get_hybrid_communicate_group
+from .... import collective_ctx
+from ...layers.mpu import mp_ops
+
+
+def _mp_world(mp_group):
+    if mp_group is not None:
+        return mp_group.nranks
+    hcg = get_hybrid_communicate_group()
+    return hcg.get_model_parallel_world_size() if hcg is not None else 1
+
+
+def _shard_mode(world):
+    return world > 1 and collective_ctx.current_axis("mp") is not None
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding with the vocab dim sharded over 'mp'."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self._num_embeddings = num_embeddings
+        self._embedding_dim = embedding_dim
+        self._world = _mp_world(mp_group)
+        self._group = mp_group
+        if num_embeddings % self._world:
+            raise ValueError(
+                f"vocab size {num_embeddings} not divisible by mp degree {self._world}")
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=Normal(0.0, 1.0) if weight_attr is None else None,
+        )
+        self.weight.is_distributed = self._world > 1
+        self.weight._sharding_axes = ("mp", None)
+
+    def forward(self, x):
+        if _shard_mode(self._world):
+            return apply(
+                lambda ids, w: mp_ops.vocab_parallel_embedding_lookup(ids, w, "mp"),
+                x, self.weight)
+        return F.embedding(x, self.weight)
+
+    def extra_repr(self):
+        return f"{self._num_embeddings}, {self._embedding_dim}, mp={self._world}"
+
+
+class ColumnParallelLinear(Layer):
+    """Linear with the OUT features sharded over 'mp' (Y = X·[W1|W2|...])."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self._in_features = in_features
+        self._out_features = out_features
+        self._world = _mp_world(mp_group)
+        self._group = mp_group
+        self.gather_output = gather_output
+        if out_features % self._world:
+            raise ValueError(
+                f"out_features {out_features} not divisible by mp degree {self._world}")
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=XavierNormal(),
+        )
+        self.weight.is_distributed = self._world > 1
+        self.weight._sharding_axes = (None, "mp")
+        if has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+            self.bias.is_distributed = self._world > 1
+            self.bias._sharding_axes = ("mp",)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        if _shard_mode(self._world):
+            x = mp_ops._c_identity(x, self._group)
+            y = apply(lambda a, w: jnp.matmul(a, w), x, self.weight)
+            if self.bias is not None:
+                y = apply(lambda a, b: a + b, y, self.bias)
+            if self.gather_output:
+                y = mp_ops._c_concat(y, self._group)
+            return y
+        return F.linear(x, self.weight, self.bias)
+
+    def extra_repr(self):
+        return (f"in={self._in_features}, out={self._out_features}, "
+                f"mp={self._world}, gather_output={self.gather_output}")
+
+
+class RowParallelLinear(Layer):
+    """Linear with the IN features sharded over 'mp'; partial products are
+    summed over the axis, bias added after the reduce."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self._in_features = in_features
+        self._out_features = out_features
+        self._world = _mp_world(mp_group)
+        self._group = mp_group
+        self.input_is_parallel = input_is_parallel
+        if in_features % self._world:
+            raise ValueError(
+                f"in_features {in_features} not divisible by mp degree {self._world}")
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=XavierNormal(),
+        )
+        self.weight.is_distributed = self._world > 1
+        self.weight._sharding_axes = ("mp", None)
+        if has_bias:
+            # bias is NOT sharded: applied once, after the cross-rank reduce
+            self.bias = self.create_parameter([out_features], is_bias=True)
+            self.bias._sharding_axes = (None,)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        if _shard_mode(self._world):
+            if not self.input_is_parallel:
+                x = mp_ops._c_split(x, self._group)
+            y = apply(lambda a, w: jnp.matmul(a, w), x, self.weight)
+            y = mp_ops.mp_allreduce_sum(y, self._group)
+            if self.bias is not None:
+                y = apply(lambda a, b: a + b, y, self.bias)
+            return y
+        return F.linear(x, self.weight, self.bias)
+
+    def extra_repr(self):
+        return (f"in={self._in_features}, out={self._out_features}, "
+                f"mp={self._world}, input_is_parallel={self.input_is_parallel}")
+
+
+class ParallelCrossEntropy(Layer):
+    """Softmax cross-entropy over vocab-sharded logits (ref
+    `ParallelCrossEntropy` / `c_softmax_with_cross_entropy`)."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self._group = mp_group
+        self._world = _mp_world(mp_group)
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        if _shard_mode(self._world):
+            return apply(
+                lambda lg, lb: mp_ops.vocab_parallel_cross_entropy(
+                    lg, lb, "mp", ignore_index=self.ignore_index)[..., None],
+                input, label)
+        return F.cross_entropy(input, label, reduction="none",
+                               ignore_index=self.ignore_index)

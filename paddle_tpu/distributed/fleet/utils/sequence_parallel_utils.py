@@ -1,0 +1,163 @@
+"""Megatron-style sequence parallelism.
+
+Reference parity: fleet/utils/sequence_parallel_utils.py (U) — ScatterOp /
+GatherOp on the sequence dim tied to the mp group,
+`ColumnSequenceParallelLinear`, `RowSequenceParallelLinear`,
+`mark_as_sequence_parallel_parameter` (SURVEY.md §2.2 P15).
+
+TPU-native design: SP is the reduce_scatter/all_gather placement mode of TP —
+the all-gather before a column-parallel matmul and the reduce-scatter after a
+row-parallel one, both along the sequence dim over the 'mp' axis. jax derives
+the correct vjps (all_gather ↔ psum_scatter), so no hand-written backward
+pairs are needed. Layer-norm params living in the sequence-parallel region
+are tagged `sequence_parallel=True` so the hybrid optimizer can all-reduce
+their grads over mp (they see only 1/mp of the tokens per rank).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ....core.op_call import apply
+from ....nn import functional as F
+from ....nn.initializer import XavierNormal
+from ....nn.layer.layers import Layer
+from ... import collective_ctx
+from ...topology import get_hybrid_communicate_group
+from ..layers.mpu import mp_ops
+
+_SEQ_AXIS = 0  # reference keeps activations [s, b, h] in SP regions; we keep
+               # [b, s, h] and scatter dim 1
+_DEFAULT_SP_DIM = 1
+
+
+def _live(world):
+    return world > 1 and collective_ctx.current_axis("mp") is not None
+
+
+def _world():
+    hcg = get_hybrid_communicate_group()
+    return hcg.get_model_parallel_world_size() if hcg is not None else 1
+
+
+def scatter(t, axis=_DEFAULT_SP_DIM):
+    """ScatterOp: forward keeps this rank's sequence block; backward
+    all-gathers (derived by jax from dynamic_slice under shard_map)."""
+    if not _live(_world()):
+        return t
+
+    def f(x):
+        n = lax.axis_size("mp")
+        i = lax.axis_index("mp")
+        size = x.shape[axis] // n
+        return lax.dynamic_slice_in_dim(x, i * size, size, axis=axis)
+
+    return apply(f, t)
+
+
+def all_gather(t, axis=_DEFAULT_SP_DIM):
+    """GatherOp: forward all-gathers sequence blocks; backward reduce-scatters."""
+    if not _live(_world()):
+        return t
+    return apply(lambda x: mp_ops.gather_axis(x, "mp", axis), t)
+
+
+def reduce_scatter(t, axis=_DEFAULT_SP_DIM):
+    """forward reduce-scatter over mp along the sequence dim; backward
+    all-gathers."""
+    if not _live(_world()):
+        return t
+    return apply(lambda x: mp_ops.reduce_scatter_axis(x, "mp", axis), t)
+
+
+def mark_as_sequence_parallel_parameter(param):
+    param.sequence_parallel = True
+    return param
+
+
+def is_sequence_parallel_parameter(param):
+    return getattr(param, "sequence_parallel", False)
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
+                                               fuse_allreduce=False):
+    """Compat shim: under SPMD the mp-allreduce of SP-region param grads is
+    emitted by the hybrid optimizer (see HybridParallelOptimizer), not by
+    backward hooks — nothing to register eagerly."""
+    return model
+
+
+class ColumnSequenceParallelLinear(Layer):
+    """all-gather(seq) → column-parallel matmul; input/output stay
+    sequence-sharded outside, hidden-sharded inside."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=False, mp_group=None, name=None):
+        super().__init__()
+        self._world = _mp_world = _world()
+        self._group = mp_group
+        self.gather_output = gather_output
+        if out_features % max(self._world, 1):
+            raise ValueError("out_features not divisible by mp degree")
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=XavierNormal())
+        self.weight.is_distributed = self._world > 1
+        self.weight._sharding_axes = (None, "mp")
+        self.bias = (self.create_parameter([out_features], is_bias=True)
+                     if has_bias else None)
+        if self.bias is not None:
+            self.bias._sharding_axes = ("mp",)
+
+    def forward(self, x):
+        if _live(self._world):
+            x = all_gather(x)
+            y = apply(lambda a, w: jnp.matmul(a, w), x, self.weight)
+            if self.bias is not None:
+                y = apply(lambda a, b: a + b, y, self.bias)
+            if self.gather_output:
+                y = mp_ops._c_concat(y, self._group)
+            return y
+        return F.linear(x, self.weight, self.bias)
+
+
+class RowSequenceParallelLinear(Layer):
+    """row-parallel matmul → reduce-scatter(seq) instead of allreduce."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=True, mp_group=None,
+                 name=None):
+        super().__init__()
+        self._world = _world()
+        self._group = mp_group
+        self.input_is_parallel = input_is_parallel
+        if in_features % max(self._world, 1):
+            raise ValueError("in_features not divisible by mp degree")
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=XavierNormal())
+        self.weight.is_distributed = self._world > 1
+        self.weight._sharding_axes = ("mp", None)
+        self.bias = (self.create_parameter([out_features], is_bias=True)
+                     if has_bias else None)
+        if self.bias is not None:
+            self.bias._sharding_axes = (None,)
+            mark_as_sequence_parallel_parameter(self.bias)
+
+    def forward(self, x):
+        if _live(self._world):
+            if not self.input_is_parallel:
+                x = mp_ops._c_split(x, self._group)
+            y = apply(lambda a, w: jnp.matmul(a, w), x, self.weight)
+            y = reduce_scatter(y)
+            if self.bias is not None:
+                y = apply(lambda a, b: a + b, y, self.bias)
+            return y
+        return F.linear(x, self.weight, self.bias)
+
+
+GatherOp = all_gather
+ScatterOp = scatter
+AllGatherOp = all_gather
+ReduceScatterOp = reduce_scatter

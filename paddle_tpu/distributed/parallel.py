@@ -39,7 +39,9 @@ class ParallelEnv:
         self.world_size = int(
             os.getenv("PADDLE_TRAINERS_NUM", os.getenv("WORLD_SIZE", "1"))
         )
-        self.device_id = int(os.getenv("FLAGS_selected_tpus", "0"))
+        # reference convention allows a comma-separated device list
+        # (FLAGS_selected_gpus="0,1,2,3"); first entry is this proc's device
+        self.device_id = int(os.getenv("FLAGS_selected_tpus", "0").split(",")[0])
         eps = os.getenv("PADDLE_TRAINER_ENDPOINTS", "")
         self.trainer_endpoints = eps.split(",") if eps else []
         self.current_endpoint = os.getenv("PADDLE_CURRENT_ENDPOINT", "")

@@ -139,8 +139,12 @@ class DataLoader:
             while n_consumed < n_submitted or not done_submitting:
                 with results_lock:
                     while n_consumed not in results:
-                        if not results_lock.wait(timeout=self.timeout or None) \
-                                and self.timeout:
+                        got_notify = results_lock.wait(timeout=self.timeout or None)
+                        # re-check the predicate before timing out: wait() can
+                        # return False even though the batch landed just as the
+                        # deadline elapsed
+                        if not got_notify and self.timeout \
+                                and n_consumed not in results:
                             raise RuntimeError(
                                 f"DataLoader worker timed out after "
                                 f"{self.timeout}s waiting for batch {n_consumed}")

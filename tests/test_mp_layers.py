@@ -1,0 +1,222 @@
+"""Tensor-parallel layer parity tests (SURVEY.md §4 distributed pattern:
+single-process SPMD on the 8-device CPU mesh, correctness = numerical parity
+with the serial model)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.fleet.meta_parallel import (
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from paddle_tpu.distributed.fleet.utils import sequence_parallel_utils as spu
+
+MP = 4
+
+
+@pytest.fixture()
+def hcg():
+    dist.set_hybrid_communicate_group(None)
+    return dist.create_hybrid_communicate_group(dp=2, mp=MP)
+
+
+def _spec(param):
+    axes = getattr(param, "_sharding_axes", None)
+    return P(*axes) if axes else P()
+
+
+def _run_sharded(hcg, layer, x_np, n_out=1, extra=None, extra_spec=P()):
+    """shard_map the layer's forward over 'mp' with params sliced per rank
+    according to their _sharding_axes hints."""
+    names = list(layer.state_dict())
+    params = [layer.state_dict()[k]._data for k in names]
+    specs = [_spec(layer.state_dict()[k]) for k in names]
+
+    def body(x, *args):
+        if extra is not None:
+            ps, ex = args[:-1], args[-1]
+        else:
+            ps, ex = args, None
+        with dist.axis_scope("mp"):
+            with layer.use_state(dict(zip(names, ps))):
+                out = (layer(paddle.Tensor(x), paddle.Tensor(ex))
+                       if ex is not None else layer(paddle.Tensor(x)))
+        return out._data
+
+    in_specs = [P()] + specs + ([extra_spec] if extra is not None else [])
+    f = shard_map(body, mesh=hcg.mesh, in_specs=tuple(in_specs),
+                  out_specs=P(), check_vma=False)
+    args = [x_np] + params + ([extra] if extra is not None else [])
+    return np.asarray(f(*args))
+
+
+class TestColumnParallelLinear:
+    def test_parity_and_grad(self, hcg):
+        layer = ColumnParallelLinear(16, 24, gather_output=True)
+        x = np.random.RandomState(0).randn(4, 16).astype(np.float32)
+        ref = layer(paddle.Tensor(x)).numpy()  # serial path (mp not live)
+        out = _run_sharded(hcg, layer, x)
+        np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+    def test_no_gather_keeps_local(self, hcg):
+        layer = ColumnParallelLinear(8, 16, gather_output=False)
+        x = np.random.RandomState(1).randn(2, 8).astype(np.float32)
+        names = list(layer.state_dict())
+        params = [layer.state_dict()[k]._data for k in names]
+        specs = [_spec(layer.state_dict()[k]) for k in names]
+
+        def body(x, *ps):
+            with dist.axis_scope("mp"):
+                with layer.use_state(dict(zip(names, ps))):
+                    out = layer(paddle.Tensor(x))
+            return out._data
+
+        f = shard_map(body, mesh=hcg.mesh, in_specs=tuple([P()] + specs),
+                      out_specs=P(None, "mp"), check_vma=False)
+        out = np.asarray(f(x, *params))
+        ref = layer(paddle.Tensor(x)).numpy()
+        np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+
+class TestRowParallelLinear:
+    def test_parity(self, hcg):
+        layer = RowParallelLinear(16, 12, input_is_parallel=False)
+        x = np.random.RandomState(2).randn(4, 16).astype(np.float32)
+        ref = layer(paddle.Tensor(x)).numpy()
+        out = _run_sharded(hcg, layer, x)
+        np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+
+class TestVocabParallelEmbedding:
+    def test_parity(self, hcg):
+        layer = VocabParallelEmbedding(32, 8)
+        ids = np.array([[0, 5, 31, 17], [8, 9, 15, 16]], np.int32)
+        ref = layer(paddle.Tensor(ids)).numpy()
+        out = _run_sharded(hcg, layer, ids)
+        np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+
+class TestParallelCrossEntropy:
+    def test_parity(self, hcg):
+        B, V = 6, 32
+        rng = np.random.RandomState(3)
+        logits = rng.randn(B, V).astype(np.float32)
+        labels = rng.randint(0, V, size=(B,)).astype(np.int32)
+        ce = ParallelCrossEntropy()
+        ref = ce(paddle.Tensor(logits), paddle.Tensor(labels)).numpy().reshape(B)
+
+        def body(lg, lb):
+            with dist.axis_scope("mp"):
+                out = ce(paddle.Tensor(lg), paddle.Tensor(lb))
+            return out._data
+
+        f = shard_map(body, mesh=hcg.mesh, in_specs=(P(None, "mp"), P()),
+                      out_specs=P(), check_vma=False)
+        out = np.asarray(f(logits, labels)).reshape(B)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    def test_grad_parity(self, hcg):
+        """End-to-end: grad of the sharded vocab-parallel CE wrt logits
+        matches softmax(p)-onehot computed serially."""
+        B, V = 4, 16
+        rng = np.random.RandomState(4)
+        logits = rng.randn(B, V).astype(np.float32)
+        labels = rng.randint(0, V, size=(B,)).astype(np.int32)
+
+        def sharded_loss(lg, lb):
+            # loss from vocab_parallel_cross_entropy is already replicated
+            # (inner psums); psum transpose is identity so plain sum/B gives
+            # per-rank grads matching the serial slice
+            from paddle_tpu.distributed.fleet.layers.mpu import mp_ops
+            loss = mp_ops.vocab_parallel_cross_entropy(lg, lb, "mp")
+            return jnp.sum(loss) / B
+
+        def body(lg, lb):
+            with dist.axis_scope("mp"):
+                g = jax.grad(sharded_loss)(lg, lb)
+            return g
+
+        f = shard_map(body, mesh=hcg.mesh, in_specs=(P(None, "mp"), P()),
+                      out_specs=P(None, "mp"), check_vma=False)
+        g = np.asarray(f(logits, labels))
+
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        ref = p.copy()
+        ref[np.arange(B), labels] -= 1.0
+        ref /= B
+        np.testing.assert_allclose(g, ref, rtol=1e-5, atol=1e-5)
+
+
+class TestSequenceParallel:
+    def test_column_row_sp_roundtrip(self, hcg):
+        """seq-sharded x → ColumnSP(gather seq) → RowSP(reduce-scatter seq)
+        matches the serial two-matmul reference."""
+        B, S, H = 2, 8, 16
+        col = spu.ColumnSequenceParallelLinear(H, 2 * H, gather_output=False)
+        row = spu.RowSequenceParallelLinear(2 * H, H, input_is_parallel=True)
+        x = np.random.RandomState(5).randn(B, S, H).astype(np.float32)
+        ref = row(col(paddle.Tensor(x))).numpy()
+
+        all_names, all_params, all_specs = [], [], []
+        for layer in (col, row):
+            for k, v in layer.state_dict().items():
+                all_names.append((layer, k))
+                all_params.append(v._data)
+                all_specs.append(_spec(v))
+
+        def body(x, *ps):
+            with dist.axis_scope("mp"):
+                cd = {k: p for (ly, k), p in zip(all_names, ps) if ly is col}
+                rd = {k: p for (ly, k), p in zip(all_names, ps) if ly is row}
+                with col.use_state(cd), row.use_state(rd):
+                    out = row(col(paddle.Tensor(x)))
+            return out._data
+
+        f = shard_map(body, mesh=hcg.mesh,
+                      in_specs=tuple([P(None, "mp")] + all_specs),
+                      out_specs=P(None, "mp"), check_vma=False)
+        out = np.asarray(f(x, *all_params))
+        np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+    def test_scatter_gather_inverse(self, hcg):
+        x = np.arange(2 * 8 * 4, dtype=np.float32).reshape(2, 8, 4)
+
+        def body(x):
+            with dist.axis_scope("mp"):
+                s = spu.scatter(paddle.Tensor(x))
+                g = spu.all_gather(s)
+            return g._data
+
+        f = shard_map(body, mesh=hcg.mesh, in_specs=P(), out_specs=P(),
+                      check_vma=False)
+        np.testing.assert_allclose(np.asarray(f(x)), x)
+
+
+class TestRNGTracker:
+    def test_local_stream_differs_per_rank(self, hcg):
+        from paddle_tpu.distributed.fleet.layers.mpu.random import (
+            model_parallel_random_seed, model_parallel_rng)
+
+        model_parallel_random_seed(7)
+
+        def body(_):
+            with dist.axis_scope("mp"):
+                with model_parallel_rng():
+                    from paddle_tpu.core.random import next_key
+                    k = next_key()
+            return jax.random.uniform(k, (1,))
+
+        f = shard_map(body, mesh=hcg.mesh, in_specs=P("mp"), out_specs=P("mp"),
+                      check_vma=False)
+        out = np.asarray(f(np.zeros((MP, 1), np.float32))).ravel()
+        assert len(np.unique(out)) == MP  # distinct stream per mp rank
