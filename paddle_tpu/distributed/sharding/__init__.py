@@ -1,0 +1,23 @@
+"""ZeRO parameter/gradient/optimizer-state sharding.
+
+Reference parity: python/paddle/distributed/sharding/group_sharded.py +
+fleet/meta_parallel/sharding/ (U) — `group_sharded_parallel` stages os /
+os_g / p_g_os a.k.a. ZeRO-1/2/3 with optional CPU offload (SURVEY.md §2.2
+P14).
+"""
+
+from .group_sharded import (
+    GroupShardedStage2,
+    GroupShardedStage3,
+    GroupShardedTrainStep,
+    DygraphShardingOptimizer,
+    group_sharded_parallel,
+    save_group_sharded_model,
+    sharding_spec_for,
+)
+
+__all__ = [
+    "GroupShardedStage2", "GroupShardedStage3", "GroupShardedTrainStep",
+    "DygraphShardingOptimizer", "group_sharded_parallel",
+    "save_group_sharded_model", "sharding_spec_for",
+]

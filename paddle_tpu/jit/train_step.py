@@ -41,6 +41,12 @@ class TrainStep:
             self.optimizer._state_for(p)
 
     def _build(self):
+        self._jitted = jax.jit(self._make_step_fn(),
+                               donate_argnums=(0, 2) if self.donate else ())
+
+    def _make_step_fn(self):
+        """Construct the pure step function (params/buffers/opt-state pytrees
+        in, updated pytrees out) — subclasses jit it with their own shardings."""
         model = self.model
         opt = self.optimizer
         sd = model.state_dict()
@@ -127,8 +133,7 @@ class TrainStep:
                                     jnp.where(dec, jnp.zeros_like(bad1), bad1))
             return new_params, new_buffers, new_opt_states, loss._data, new_scaler_state
 
-        donate = (0, 2) if self.donate else ()
-        self._jitted = jax.jit(step_fn, donate_argnums=donate)
+        return step_fn
 
     def __call__(self, *batch):
         if self._jitted is None:
