@@ -7,3 +7,5 @@ from .parallel_layers import (
     get_rng_state_tracker,
     model_parallel_random_seed,
 )
+from .parallel_layers.pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc
+from .pipeline_parallel import PipelineParallel

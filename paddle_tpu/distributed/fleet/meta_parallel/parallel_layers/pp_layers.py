@@ -1,0 +1,189 @@
+"""Pipeline-parallel layer description & segmentation.
+
+Reference parity: fleet/meta_parallel/parallel_layers/pp_layers.py (U) —
+`LayerDesc`, `SharedLayerDesc`, `PipelineLayer` with uniform / 'layer:Class'
+segmentation (SURVEY.md §2.2 P13).
+
+TPU-native design: single-controller SPMD materializes EVERY stage's layers in
+one process (the reference materializes only the local rank's stage); the
+per-stage partition feeds the compiled ppermute schedule in
+pipeline_parallel.py, and weight tying (SharedLayerDesc) is plain object reuse
+instead of a broadcast group.
+
+Interface contract for the compiled schedule: stages 0..S-2 must emit the
+same-shaped hidden activation (stage 0 maps the raw input microbatch to it);
+the final stage's layers + loss_fn map hidden → scalar loss.
+"""
+
+from __future__ import annotations
+
+import math
+
+from .....nn.layer.layers import Layer
+
+
+class LayerDesc:
+    """Lazy layer constructor (ref LayerDesc)."""
+
+    def __init__(self, layer_class, *inputs, **kwargs):
+        self.layer_class = layer_class
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_class, Layer):
+            raise TypeError(f"LayerDesc expects an nn.Layer subclass, got {layer_class}")
+
+    def build_layer(self):
+        return self.layer_class(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_class.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """Weight-tied layer (ref SharedLayerDesc: embedding/output tying across
+    stages). Single-controller: every position with the same `key` reuses ONE
+    instance, so tying is structural, with `forward_func` selecting the view."""
+
+    def __init__(self, key, layer_class, *inputs, forward_func=None,
+                 shared_weight_attr="weight", **kwargs):
+        super().__init__(layer_class, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class _FuncWrapper(Layer):
+    """Plain callables in the desc list (paddle allows lambdas)."""
+
+    def __init__(self, fn):
+        super().__init__()
+        self._fn = fn
+
+    def forward(self, *args, **kwargs):
+        return self._fn(*args, **kwargs)
+
+
+class _SharedView(Layer):
+    """A reuse of a SharedLayerDesc instance at another pipeline position."""
+
+    def __init__(self, inner, forward_func=None):
+        super().__init__()
+        self._inner_ref = [inner]  # hide from sublayer registry: params are
+        # owned (and counted) by the first occurrence
+        self._forward_func = forward_func
+
+    def forward(self, *args, **kwargs):
+        inner = self._inner_ref[0]
+        if self._forward_func is not None:
+            return self._forward_func(inner, *args, **kwargs)
+        return inner(*args, **kwargs)
+
+
+class PipelineLayer(Layer):
+    """ref PipelineLayer: takes the desc list, segments it into pp stages.
+
+    `forward` runs the full serial model (the pp=1 path and the parity
+    reference); the compiled 1F1B/GPipe schedule lives in PipelineParallel.
+    """
+
+    def __init__(self, layers, num_stages=None, topology=None, loss_fn=None,
+                 seg_method="uniform", recompute_interval=0, recompute_ctx=None,
+                 num_virtual_pipeline_stages=None):
+        super().__init__()
+        from ....topology import get_hybrid_communicate_group
+
+        self._loss_fn = loss_fn
+        self._recompute_interval = recompute_interval
+        self._num_virtual_stages = num_virtual_pipeline_stages or 1
+        self._topo = topology
+        if num_stages is None:
+            hcg = get_hybrid_communicate_group()
+            num_stages = (hcg.get_pipe_parallel_world_size()
+                          if hcg is not None else 1)
+        self._num_stages = int(num_stages)
+
+        # materialize descs; SharedLayerDesc instances dedupe by key
+        shared = {}
+        items = []
+        for d in layers:
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name in shared:
+                    items.append(_SharedView(shared[d.layer_name], d.forward_func))
+                else:
+                    inner = d.build_layer()
+                    shared[d.layer_name] = inner
+                    items.append(inner if d.forward_func is None
+                                 else _SharedView(inner, d.forward_func))
+                    if d.forward_func is not None:
+                        # first occurrence must still own the params
+                        items[-1].add_sublayer("shared", inner)
+            elif isinstance(d, LayerDesc):
+                items.append(d.build_layer())
+            elif isinstance(d, Layer):
+                items.append(d)
+            elif callable(d):
+                items.append(_FuncWrapper(d))
+            else:
+                raise TypeError(f"unsupported pipeline item {d!r}")
+        self.run_function = items
+        for i, it in enumerate(items):
+            self.add_sublayer(str(i), it)
+
+        self._seg_method = seg_method
+        self.segment_parts = self._segment(seg_method)
+
+    # ------------------------------------------------------------ segmenting
+    def _segment(self, method):
+        n, S = len(self.run_function), self._num_stages
+        if S == 1:
+            return [0, n]
+        if method.startswith("layer:"):
+            cls_name = method.split(":", 1)[1]
+            block_idx = [i for i, it in enumerate(self.run_function)
+                         if type(it).__name__ == cls_name]
+            if not block_idx:
+                raise ValueError(f"seg_method {method!r}: no layer of class "
+                                 f"{cls_name} in the desc list")
+            if len(block_idx) < S:
+                raise ValueError(f"{len(block_idx)} {cls_name} blocks cannot "
+                                 f"fill {S} stages")
+            per = len(block_idx) / S
+            bounds = [0]
+            for k in range(1, S):
+                bounds.append(block_idx[math.ceil(k * per)])
+            bounds.append(n)
+            return bounds
+        # uniform: equal item count per stage
+        if n < S:
+            raise ValueError(f"{n} layers cannot fill {S} stages")
+        per = n / S
+        return [0] + [math.ceil(k * per) for k in range(1, S)] + [n]
+
+    # ------------------------------------------------------------ access
+    @property
+    def num_stages(self):
+        return self._num_stages
+
+    def get_stage_layers(self, stage_id):
+        lo, hi = self.segment_parts[stage_id], self.segment_parts[stage_id + 1]
+        return self.run_function[lo:hi]
+
+    def stage_param_names(self, stage_id):
+        names = []
+        lo, hi = self.segment_parts[stage_id], self.segment_parts[stage_id + 1]
+        for i in range(lo, hi):
+            prefix = str(i)
+            for n, _ in self._sub_layers[prefix].named_parameters(prefix=prefix):
+                names.append(n)
+        return names
+
+    # ------------------------------------------------------------ serial ref
+    def forward(self, x):
+        for it in self.run_function:
+            x = it(x)
+        return x
+
+    def compute_loss(self, logits, labels):
+        if self._loss_fn is None:
+            raise RuntimeError("PipelineLayer built without loss_fn")
+        return self._loss_fn(logits, labels)

@@ -1,0 +1,239 @@
+"""Pipeline-parallel runtime: the microbatch schedule as ONE compiled program.
+
+Reference parity: fleet/meta_parallel/pipeline_parallel.py +
+pp_utils/p2p_communication.py (U) — `PipelineParallel.train_batch` running
+1F1B/GPipe microbatch schedules with NCCL p2p between stage ranks
+(SURVEY.md §2.2 P13, §3.3 step 4).
+
+TPU-native design: no p2p runtime, no shape negotiation, no interceptor
+actors. The whole schedule is data: a `lax.scan` over ticks inside
+`shard_map` over the 'pp' mesh axis; at each tick every device runs its
+stage (one `lax.switch` branch — embedding stage consumes the raw
+microbatch, the final stage computes the loss) and hands its activation to
+the next stage with a ring `lax.ppermute`. XLA overlaps the permute with
+compute (the reference needs dedicated comm streams + event sync for this,
+SURVEY.md §2.1 N13). Backward is `jax.grad` through the scan — the reverse
+schedule with exact activation economy chosen by XLA, `jax.checkpoint` per
+stage giving the recompute variant (ref recompute_interval). Warmup/drain
+bubbles are masked ticks, matching GPipe; the steady-state compute/comm
+pattern equals 1F1B's because forward and backward of one scan tick fuse.
+
+Gradient flow across stages needs no reducer: stage params enter replicated
+(in_spec P()), so shard_map's transpose inserts the psum that sums each
+param's gradient from its owning stage (zeros elsewhere) — and the same psum
+doubles as the dp gradient all-reduce when the 'dp' axis is live.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ....core import random as random_state
+from ....core import tape as _tape
+from ....core.op_call import apply
+from ....core.tensor import Tensor
+from ....nn.layer.layers import Layer
+from ... import collective_ctx
+from ...topology import get_hybrid_communicate_group
+from .parallel_layers.pp_layers import PipelineLayer
+
+try:
+    from jax import shard_map
+except ImportError:  # older jax layout
+    from jax.experimental.shard_map import shard_map
+
+
+class PipelineParallel(Layer):
+    """ref PipelineParallel (meta_parallel): wraps a PipelineLayer and runs
+    the compiled microbatch schedule. Composition with dp is native (batch
+    sharded over 'dp'); pp×mp composition lands with the fleet facade."""
+
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__()
+        if not isinstance(layers, PipelineLayer):
+            raise TypeError("PipelineParallel expects a PipelineLayer")
+        self._layers = layers
+        self._hcg = hcg or get_hybrid_communicate_group()
+        cfg = {}
+        if strategy is not None:
+            cfg = getattr(strategy, "pipeline_configs", None) or (
+                strategy if isinstance(strategy, dict) else {})
+        self.accumulate_steps = int(cfg.get("accumulate_steps", 1))
+        self.micro_batch_size = cfg.get("micro_batch_size", None)
+        self._train_step = None
+        self._pp_fn_cache = {}
+
+    # ----------------------------------------------------------- plumbing
+    def forward(self, x):
+        return self._layers(x)
+
+    def parameters(self, *a, **k):
+        return self._layers.parameters(*a, **k)
+
+    def named_parameters(self, *a, **k):
+        return self._layers.named_parameters(*a, **k)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, sd, *a, **k):
+        return self._layers.set_state_dict(sd, *a, **k)
+
+    def no_sync(self):
+        import contextlib
+
+        return contextlib.nullcontext()
+
+    # ----------------------------------------------------------- schedule
+    def _pipeline_pure_fn(self, n_micro):
+        """Build pure(x_mbs, y_mbs, key, *params) -> scalar loss, shard_mapped
+        over the hybrid mesh with the tick loop inside."""
+        if n_micro in self._pp_fn_cache:
+            return self._pp_fn_cache[n_micro]
+
+        pp = self._layers
+        S = pp.num_stages
+        mesh = self._hcg.mesh
+        names = list(pp.state_dict())
+        remat = pp._recompute_interval and pp._recompute_interval > 0
+        dp_live = "dp" in mesh.shape and mesh.shape["dp"] > 1
+
+        def spmd(x_mbs, y_mbs, base_key, *params):
+            s = lax.axis_index("pp")
+
+            with _tape.no_grad(), collective_ctx.axis_scope("pp"), \
+                    pp.use_state(dict(zip(names, params))):
+
+                def run_items(items, t_in):
+                    for it in items:
+                        t_in = it(t_in)
+                    return t_in
+
+                def make_branch(k):
+                    items = pp.get_stage_layers(k)
+                    is_last = k == S - 1
+
+                    def br(x_mb, hid, y_mb, key):
+                        with random_state.fork_rng(key):
+                            if S == 1:
+                                out = run_items(items, Tensor(x_mb))
+                                loss = pp.compute_loss(out, Tensor(y_mb))
+                                return hid, jnp.mean(loss._data).astype(jnp.float32)
+                            if is_last:
+                                out = run_items(items, Tensor(hid))
+                                loss = pp.compute_loss(out, Tensor(y_mb))
+                                return hid, jnp.mean(loss._data).astype(jnp.float32)
+                            src = Tensor(x_mb) if k == 0 else Tensor(hid)
+                            out = run_items(items, src)
+                            return (out._data.astype(hid.dtype),
+                                    jnp.zeros((), jnp.float32))
+
+                    return jax.checkpoint(br) if remat else br
+
+                branches = [make_branch(k) for k in range(S)]
+
+                # hidden buffer: shape/dtype of stage 0's output
+                def stage0_shape(x_mb, key):
+                    with random_state.fork_rng(key):
+                        out = run_items(pp.get_stage_layers(0), Tensor(x_mb))
+                    return out._data
+
+                probe_key = jax.random.fold_in(base_key, 0)
+                if S > 1:
+                    hid_sd = jax.eval_shape(stage0_shape, x_mbs[0], probe_key)
+                else:
+                    hid_sd = jax.eval_shape(lambda a: a[..., :1].astype(jnp.float32),
+                                            x_mbs[0])
+                hid0 = jnp.zeros(hid_sd.shape, hid_sd.dtype)
+
+                T = n_micro + S - 1
+                perm = [(i, (i + 1) % S) for i in range(S)]
+
+                def tick(carry, t):
+                    hid, loss_sum = carry
+                    key_t = jax.random.fold_in(base_key, t)
+                    m0 = jnp.clip(t, 0, n_micro - 1)
+                    mL = jnp.clip(t - (S - 1), 0, n_micro - 1)
+                    x_mb = jnp.take(x_mbs, m0, axis=0)
+                    y_mb = jnp.take(y_mbs, mL, axis=0)
+                    hid_next, loss_t = lax.switch(
+                        jnp.minimum(s, S - 1), branches, x_mb, hid, y_mb, key_t)
+                    valid = (t >= S - 1) & (t - (S - 1) < n_micro)
+                    loss_sum = loss_sum + jnp.where(valid, loss_t, 0.0)
+                    if S > 1:
+                        hid_next = lax.ppermute(hid_next, "pp", perm)
+                    return (hid_next, loss_sum), None
+
+                (_, loss_sum), _ = lax.scan(
+                    tick, (hid0, jnp.zeros((), jnp.float32)), jnp.arange(T))
+
+            loss = lax.psum(loss_sum, "pp") / n_micro if S > 1 else loss_sum / n_micro
+            if dp_live:
+                loss = lax.pmean(loss, "dp")
+            return loss
+
+        batch_spec = P(None, "dp") if dp_live else P()
+
+        def pure(x_mbs, y_mbs, base_key, *params):
+            f = shard_map(
+                spmd, mesh=mesh,
+                in_specs=(batch_spec, batch_spec, P()) + tuple(P() for _ in params),
+                out_specs=P(), check_vma=False)
+            return f(x_mbs, y_mbs, base_key, *params)
+
+        self._pp_fn_cache[n_micro] = (pure, names)
+        return self._pp_fn_cache[n_micro]
+
+    def _loss_fn_for(self, n_micro):
+        pure, names = self._pipeline_pure_fn(n_micro)
+
+        def loss_fn(model, x_mbs, y_mbs):
+            sd = model.state_dict()
+            key = random_state.next_key()
+            return apply(pure, x_mbs, y_mbs, key,
+                         *[sd[n] for n in names], _op_name="pipeline")
+
+        return loss_fn
+
+    def _split_micro(self, t):
+        arr = t._data if isinstance(t, Tensor) else jnp.asarray(t)
+        n = self.accumulate_steps
+        if arr.shape[0] % n:
+            raise ValueError(
+                f"batch dim {arr.shape[0]} not divisible by accumulate_steps {n}")
+        return Tensor(arr.reshape((n, arr.shape[0] // n) + arr.shape[1:]))
+
+    # ----------------------------------------------------------- API
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        """ref train_batch: one full fwd+bwd+step over accumulate_steps
+        microbatches, compiled once."""
+        x, y = data
+        x_mbs, y_mbs = self._split_micro(x), self._split_micro(y)
+        if self._train_step is None:
+            from ....jit.train_step import TrainStep
+
+            self._train_step = TrainStep(
+                self._layers, self._loss_fn_for(self.accumulate_steps),
+                optimizer, scaler=scaler)
+        loss = self._train_step(x_mbs, y_mbs)
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return loss
+
+    def eval_batch(self, data, compute_loss=True):
+        x, y = data
+        was_training = self._layers.training
+        self._layers.eval()
+        try:
+            with _tape.no_grad():
+                out = self._layers(x if isinstance(x, Tensor) else Tensor(x))
+                if compute_loss:
+                    return self._layers.compute_loss(
+                        out, y if isinstance(y, Tensor) else Tensor(y))
+                return out
+        finally:
+            if was_training:
+                self._layers.train()

@@ -1,0 +1,196 @@
+"""Pipeline parallelism tests: the compiled ppermute schedule must match the
+serial model numerically (SURVEY.md §4 — the reference asserts
+hybrid-parallel losses equal the single-process run; same invariant here, on
+the 8-device CPU mesh)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.fleet.meta_parallel import (
+    LayerDesc,
+    PipelineLayer,
+    PipelineParallel,
+    SharedLayerDesc,
+)
+from paddle_tpu.jit.train_step import TrainStep
+
+H = 16
+
+
+class Block(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(H, H)
+
+    def forward(self, x):
+        return paddle.tanh(self.fc(x))
+
+
+class Head(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(H, 4)
+
+    def forward(self, x):
+        return self.fc(x)
+
+
+def _mse(logits, labels):
+    return nn.functional.mse_loss(logits, labels)
+
+
+def _descs():
+    return ([LayerDesc(nn.Linear, 8, H)] +
+            [LayerDesc(Block) for _ in range(6)] +
+            [LayerDesc(Head)])
+
+
+def _batch(B=16):
+    rng = np.random.RandomState(0)
+    return (rng.randn(B, 8).astype(np.float32),
+            rng.randn(B, 4).astype(np.float32))
+
+
+def _serial_losses(pp_model, n_steps=3, lr=0.05, n_micro=4):
+    """Reference: same PipelineLayer trained serially, microbatch-averaged
+    loss (grad accumulation == microbatching)."""
+    opt = paddle.optimizer.Momentum(learning_rate=lr,
+                                    parameters=pp_model.parameters())
+
+    def loss_fn(model, x, y):
+        xs, ys = x._data, y._data
+        n = n_micro
+        mb = xs.shape[0] // n
+        total = None
+        for i in range(n):
+            out = model(paddle.Tensor(xs[i * mb:(i + 1) * mb]))
+            l = _mse(out, paddle.Tensor(ys[i * mb:(i + 1) * mb]))
+            total = l if total is None else total + l
+        return total / n
+
+    step = TrainStep(pp_model, loss_fn, opt)
+    x, y = _batch()
+    return [float(step(paddle.to_tensor(x), paddle.to_tensor(y)))
+            for _ in range(n_steps)]
+
+
+class TestSegmentation:
+    def test_uniform(self):
+        dist.set_hybrid_communicate_group(None)
+        dist.create_hybrid_communicate_group(pp=4)
+        pl = PipelineLayer(_descs(), loss_fn=_mse)
+        assert pl.num_stages == 4
+        assert pl.segment_parts[0] == 0 and pl.segment_parts[-1] == 8
+        sizes = [pl.segment_parts[i + 1] - pl.segment_parts[i] for i in range(4)]
+        assert sum(sizes) == 8 and max(sizes) - min(sizes) <= 1
+
+    def test_layer_seg_method(self):
+        dist.set_hybrid_communicate_group(None)
+        dist.create_hybrid_communicate_group(pp=2)
+        pl = PipelineLayer(_descs(), loss_fn=_mse, seg_method="layer:Block")
+        # prefix (input Linear) joins stage 0; blocks split 3/3
+        assert pl.segment_parts == [0, 4, 8]
+
+    def test_stage_param_names(self):
+        dist.set_hybrid_communicate_group(None)
+        dist.create_hybrid_communicate_group(pp=4)
+        pl = PipelineLayer(_descs(), loss_fn=_mse)
+        all_names = set(pl.state_dict())
+        per_stage = [set(pl.stage_param_names(k)) for k in range(4)]
+        assert set().union(*per_stage) == all_names
+        for a in range(4):
+            for b in range(a + 1, 4):
+                assert not (per_stage[a] & per_stage[b])
+
+
+class TestPipelineParity:
+    @pytest.mark.parametrize("pp,n_micro", [(2, 4), (4, 8), (1, 4)])
+    def test_train_batch_matches_serial(self, pp, n_micro):
+        dist.set_hybrid_communicate_group(None)
+        dist.create_hybrid_communicate_group(pp=pp)
+        paddle.seed(7)
+        model = PipelineLayer(_descs(), loss_fn=_mse)
+        ref = _serial_losses(model, n_micro=n_micro)
+
+        dist.set_hybrid_communicate_group(None)
+        hcg = dist.create_hybrid_communicate_group(pp=pp)
+        paddle.seed(7)
+        model2 = PipelineLayer(_descs(), loss_fn=_mse)
+        runner = PipelineParallel(model2, hcg,
+                                  {"accumulate_steps": n_micro})
+        opt = paddle.optimizer.Momentum(learning_rate=0.05,
+                                        parameters=model2.parameters())
+        x, y = _batch()
+        losses = [float(runner.train_batch(
+            (paddle.to_tensor(x), paddle.to_tensor(y)), opt))
+            for _ in range(3)]
+        np.testing.assert_allclose(losses, ref, rtol=2e-5, atol=1e-6)
+
+    def test_dp_pp_composition(self):
+        dist.set_hybrid_communicate_group(None)
+        dist.create_hybrid_communicate_group(pp=2)
+        paddle.seed(9)
+        model = PipelineLayer(_descs(), loss_fn=_mse)
+        ref = _serial_losses(model, n_micro=2)
+
+        dist.set_hybrid_communicate_group(None)
+        hcg = dist.create_hybrid_communicate_group(dp=4, pp=2)
+        paddle.seed(9)
+        model2 = PipelineLayer(_descs(), loss_fn=_mse)
+        runner = PipelineParallel(model2, hcg, {"accumulate_steps": 2})
+        opt = paddle.optimizer.Momentum(learning_rate=0.05,
+                                        parameters=model2.parameters())
+        x, y = _batch()
+        losses = [float(runner.train_batch(
+            (paddle.to_tensor(x), paddle.to_tensor(y)), opt))
+            for _ in range(3)]
+        np.testing.assert_allclose(losses, ref, rtol=2e-5, atol=1e-6)
+
+    def test_recompute_matches(self):
+        dist.set_hybrid_communicate_group(None)
+        hcg = dist.create_hybrid_communicate_group(pp=2)
+        paddle.seed(11)
+        model = PipelineLayer(_descs(), loss_fn=_mse)
+        runner = PipelineParallel(model, hcg, {"accumulate_steps": 2})
+        opt = paddle.optimizer.Momentum(learning_rate=0.05,
+                                        parameters=model.parameters())
+        x, y = _batch()
+        base = float(runner.train_batch((paddle.to_tensor(x), paddle.to_tensor(y)), opt))
+
+        dist.set_hybrid_communicate_group(None)
+        hcg = dist.create_hybrid_communicate_group(pp=2)
+        paddle.seed(11)
+        model_r = PipelineLayer(_descs(), loss_fn=_mse, recompute_interval=1)
+        runner_r = PipelineParallel(model_r, hcg, {"accumulate_steps": 2})
+        opt_r = paddle.optimizer.Momentum(learning_rate=0.05,
+                                          parameters=model_r.parameters())
+        remat = float(runner_r.train_batch((paddle.to_tensor(x), paddle.to_tensor(y)), opt_r))
+        np.testing.assert_allclose(remat, base, rtol=1e-6)
+
+    def test_eval_batch(self):
+        dist.set_hybrid_communicate_group(None)
+        hcg = dist.create_hybrid_communicate_group(pp=2)
+        model = PipelineLayer(_descs(), loss_fn=_mse)
+        runner = PipelineParallel(model, hcg, {"accumulate_steps": 2})
+        x, y = _batch()
+        loss = runner.eval_batch((paddle.to_tensor(x), paddle.to_tensor(y)))
+        assert np.isfinite(float(loss))
+
+
+class TestSharedLayerDesc:
+    def test_tied_weights_single_instance(self):
+        dist.set_hybrid_communicate_group(None)
+        dist.create_hybrid_communicate_group(pp=2)
+        descs = ([SharedLayerDesc("emb", nn.Linear, 8, H)] +
+                 [LayerDesc(Block) for _ in range(2)] +
+                 [SharedLayerDesc("emb", nn.Linear, 8, H,
+                                  forward_func=lambda l, x: l(x))])
+        pl = PipelineLayer(descs, loss_fn=_mse)
+        names = [n for n, _ in pl.named_parameters()]
+        # tied layer contributes its params exactly once
+        assert len(names) == len(set(names))
+        n_linear_params = sum(1 for n in names if n.startswith(("0.", "3.")))
+        assert n_linear_params == 2  # weight+bias of the ONE shared instance
