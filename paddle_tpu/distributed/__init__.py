@@ -59,3 +59,6 @@ __all__ = [
     "get_hybrid_communicate_group", "set_hybrid_communicate_group",
     "create_hybrid_communicate_group", "axis_scope",
 ]
+
+from . import fleet
+from . import sharding

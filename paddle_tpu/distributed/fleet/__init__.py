@@ -6,4 +6,16 @@ from ..topology import (
     set_hybrid_communicate_group,
 )
 from . import meta_parallel
+from .base.distributed_strategy import DistributedStrategy
+from .fleet import (
+    TensorParallel,
+    distributed_model,
+    distributed_optimizer,
+    distributed_scaler,
+    fleet,
+    init,
+    is_first_worker,
+    worker_index,
+    worker_num,
+)
 from .utils import sequence_parallel_utils

@@ -1,0 +1,132 @@
+"""The Fleet facade: init → distributed_model → distributed_optimizer.
+
+Reference parity: fleet/fleet.py (U) — the singleton users drive hybrid
+training through (SURVEY.md §2.2 P10, §3.3). TPU-native: `init` builds the
+hybrid mesh (HybridCommunicateGroup over jax devices) from
+DistributedStrategy.hybrid_configs; `distributed_model` picks the runtime
+wrapper (PipelineParallel / TensorParallel / DataParallel); the optimizer
+wrapper adds hybrid-aware grad clipping. There is no role maker service —
+rendezvous is jax.distributed (see distributed.parallel.init_parallel_env).
+"""
+
+from __future__ import annotations
+
+from ...nn.layer.layers import Layer
+from .. import collective_ctx
+from ..parallel import DataParallel, init_parallel_env
+from ..topology import (
+    HybridCommunicateGroup,
+    create_hybrid_communicate_group,
+    get_hybrid_communicate_group,
+)
+from .base.distributed_strategy import DistributedStrategy
+from .meta_parallel import PipelineParallel
+from .meta_parallel.parallel_layers.pp_layers import PipelineLayer
+
+
+class _Fleet:
+    def __init__(self):
+        self._strategy = None
+        self._initialized = False
+
+    # ------------------------------------------------------------- init
+    def init(self, role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
+        import jax
+
+        self._strategy = strategy or DistributedStrategy()
+        degrees = self._strategy.hybrid_degrees(jax.device_count())
+        create_hybrid_communicate_group(**degrees)
+        init_parallel_env()
+        self._initialized = True
+        return self
+
+    @property
+    def is_initialized(self):
+        return self._initialized
+
+    def is_first_worker(self):
+        return self.worker_index() == 0
+
+    def worker_index(self):
+        hcg = get_hybrid_communicate_group()
+        return hcg.get_global_rank() if hcg else 0
+
+    def worker_num(self):
+        hcg = get_hybrid_communicate_group()
+        return hcg.nranks if hcg else 1
+
+    def get_hybrid_communicate_group(self):
+        return get_hybrid_communicate_group()
+
+    @property
+    def strategy(self):
+        return self._strategy
+
+    # ------------------------------------------------------- model/opt
+    def distributed_model(self, model):
+        """ref fleet.distributed_model: wrap for the active parallelism."""
+        hcg = get_hybrid_communicate_group()
+        if hcg is None:
+            raise RuntimeError("call fleet.init() first")
+        if isinstance(model, PipelineLayer):
+            return PipelineParallel(model, hcg, self._strategy)
+        if hcg.get_model_parallel_world_size() > 1:
+            return TensorParallel(model, hcg, strategy=self._strategy)
+        return DataParallel(model)
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        from .meta_optimizers.dygraph_optimizer.hybrid_parallel_optimizer import (
+            HybridParallelOptimizer,
+        )
+
+        hcg = get_hybrid_communicate_group()
+        return HybridParallelOptimizer(optimizer, hcg,
+                                       strategy or self._strategy)
+
+    def distributed_scaler(self, scaler):
+        """AMP GradScaler is hybrid-safe as-is: inf detection and scale state
+        are computed inside the one compiled step on replicated values."""
+        return scaler
+
+    # ------------------------------------------------------- state io
+    def save(self, *a, **k):
+        raise NotImplementedError("use paddle.save / fleet utils checkpoint")
+
+    def barrier_worker(self):
+        pass
+
+
+class TensorParallel(Layer):
+    """ref meta_parallel.TensorParallel: the mp wrapper. Forward runs the
+    layer unchanged — under GSPMD the TP layers' sharding hints place the
+    weights, and inside shard_map regions fleet enters the 'mp' scope."""
+
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg or get_hybrid_communicate_group()
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def parameters(self, *a, **k):
+        return self._layers.parameters(*a, **k)
+
+    def named_parameters(self, *a, **k):
+        return self._layers.named_parameters(*a, **k)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, sd, *a, **k):
+        return self._layers.set_state_dict(sd, *a, **k)
+
+
+fleet = _Fleet()
+init = fleet.init
+distributed_model = fleet.distributed_model
+distributed_optimizer = fleet.distributed_optimizer
+distributed_scaler = fleet.distributed_scaler
+worker_index = fleet.worker_index
+worker_num = fleet.worker_num
+is_first_worker = fleet.is_first_worker

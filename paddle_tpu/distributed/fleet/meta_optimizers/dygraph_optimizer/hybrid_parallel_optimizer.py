@@ -1,0 +1,57 @@
+"""HybridParallelOptimizer: the optimizer wrapper fleet hands back.
+
+Reference parity: fleet/meta_optimizers/dygraph_optimizer/
+hybrid_parallel_optimizer.py (U) — wraps the user optimizer with (a)
+HybridParallelClipGrad (global grad-norm allreduced across mp/pp/sharding
+groups), (b) sequence-parallel param grad allreduce, (c) the
+distributed_scaler hookup (SURVEY.md §2.2 P18, §3.3 step 6).
+
+TPU-native: grads computed under jit/GSPMD are already *global* values, so
+(a) reduces to the stock ClipGradByGlobalNorm (which additionally psums over
+any live shard_map axes — see nn/clip.py), and (b) is only needed in the
+explicit shard_map regime, where it's an mp-psum over SP-tagged params'
+grads applied at step time.
+"""
+
+from __future__ import annotations
+
+from .....core import tape as _tape
+from .....core.tensor import Tensor
+from .... import collective_ctx
+
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer, hcg=None, strategy=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+
+    # grads of sequence-parallel params (LN/bias inside SP regions) see only
+    # local tokens — sum them over mp before stepping (ref
+    # register_sequence_parallel_allreduce_hooks)
+    def _sync_sp_grads(self):
+        ax = collective_ctx.current_axis("mp")
+        if ax is None:
+            return
+        import jax
+
+        with _tape.no_grad():
+            for p in self._inner_opt._parameter_list:
+                if getattr(p, "sequence_parallel", False) and p.grad is not None:
+                    p.grad._data = jax.lax.psum(p.grad._data, ax)
+
+    def step(self):
+        self._sync_sp_grads()
+        return self._inner_opt.step()
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return [], []
+
+    def clear_grad(self, set_to_zero=True):
+        return self._inner_opt.clear_grad()
+
+    def __getattr__(self, name):
+        return getattr(self._inner_opt, name)

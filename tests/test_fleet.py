@@ -1,0 +1,116 @@
+"""Fleet facade tests: init → distributed_model → distributed_optimizer
+drives an end-to-end hybrid step (SURVEY.md §3.3 call stack)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet import DistributedStrategy
+from paddle_tpu.distributed.fleet.meta_parallel import (
+    LayerDesc, PipelineLayer, PipelineParallel,
+)
+
+
+class TestDistributedStrategy:
+    def test_defaults_and_update_semantics(self):
+        s = DistributedStrategy()
+        assert s.hybrid_configs["mp_degree"] == 1
+        s.hybrid_configs = {"mp_degree": 2, "pp_degree": 2}
+        # update-in-place: unspecified keys keep defaults (reference behavior)
+        assert s.hybrid_configs["mp_degree"] == 2
+        assert s.hybrid_configs["sharding_degree"] == 1
+        assert s.hybrid_degrees(8) == {"dp": 2, "mp": 2, "pp": 2,
+                                       "sharding": 1, "sep": 1}
+
+    def test_rejects_unknown_keys_and_bad_degrees(self):
+        s = DistributedStrategy()
+        with pytest.raises(ValueError, match="unknown"):
+            s.hybrid_configs = {"dp_degreee": 2}
+        s.hybrid_configs = {"mp_degree": 3}
+        with pytest.raises(ValueError, match="not divisible"):
+            s.hybrid_degrees(8)
+
+    def test_amp_pipeline_configs(self):
+        s = DistributedStrategy()
+        s.amp = True
+        s.amp_configs = {"init_loss_scaling": 1024.0}
+        assert s.amp_configs["init_loss_scaling"] == 1024.0
+        assert s.amp_configs["incr_ratio"] == 2.0
+        s.pipeline_configs = {"accumulate_steps": 4}
+        assert s.pipeline_configs["accumulate_steps"] == 4
+        assert "amp" in repr(s)
+
+
+class TestFleetInit:
+    def test_init_builds_mesh(self):
+        dist.set_hybrid_communicate_group(None)
+        s = DistributedStrategy()
+        s.hybrid_configs = {"dp_degree": 2, "mp_degree": 2, "pp_degree": 2}
+        fleet.init(is_collective=True, strategy=s)
+        hcg = fleet.fleet.get_hybrid_communicate_group()
+        assert hcg.get_data_parallel_world_size() == 2
+        assert hcg.get_model_parallel_world_size() == 2
+        assert hcg.get_pipe_parallel_world_size() == 2
+        assert fleet.worker_num() == 8
+        assert fleet.is_first_worker()
+
+    def test_distributed_model_dispatch(self):
+        dist.set_hybrid_communicate_group(None)
+        s = DistributedStrategy()
+        s.hybrid_configs = {"dp_degree": 4, "mp_degree": 2}
+        fleet.init(strategy=s)
+        m = nn.Linear(4, 4)
+        dm = fleet.distributed_model(m)
+        assert type(dm).__name__ == "TensorParallel"
+
+        dist.set_hybrid_communicate_group(None)
+        s2 = DistributedStrategy()
+        fleet.init(strategy=s2)
+        dm2 = fleet.distributed_model(nn.Linear(4, 4))
+        assert type(dm2).__name__ == "DataParallel"
+
+    def test_pipeline_model_end_to_end(self):
+        dist.set_hybrid_communicate_group(None)
+        s = DistributedStrategy()
+        s.hybrid_configs = {"dp_degree": 2, "pp_degree": 4}
+        s.pipeline_configs = {"accumulate_steps": 4}
+        fleet.init(strategy=s)
+        paddle.seed(3)
+        model = PipelineLayer(
+            [LayerDesc(nn.Linear, 8, 16)] +
+            [LayerDesc(nn.Linear, 16, 16) for _ in range(6)] +
+            [LayerDesc(nn.Linear, 16, 4)],
+            loss_fn=nn.functional.mse_loss)
+        dm = fleet.distributed_model(model)
+        assert isinstance(dm, PipelineParallel)
+        assert dm.accumulate_steps == 4
+        opt = fleet.distributed_optimizer(
+            paddle.optimizer.AdamW(learning_rate=1e-3,
+                                   parameters=model.parameters()))
+        rng = np.random.RandomState(0)
+        x = rng.randn(16, 8).astype(np.float32)
+        y = rng.randn(16, 4).astype(np.float32)
+        l0 = float(dm.train_batch((paddle.to_tensor(x), paddle.to_tensor(y)), opt))
+        for _ in range(4):
+            l = float(dm.train_batch((paddle.to_tensor(x), paddle.to_tensor(y)), opt))
+        assert l < l0
+
+
+class TestHybridParallelOptimizer:
+    def test_wraps_and_steps(self):
+        dist.set_hybrid_communicate_group(None)
+        fleet.init(strategy=DistributedStrategy())
+        m = nn.Linear(4, 2)
+        opt = fleet.distributed_optimizer(
+            paddle.optimizer.SGD(learning_rate=0.1, parameters=m.parameters()))
+        x = paddle.randn([8, 4])
+        loss = m(x).sum()
+        w0 = np.asarray(m.weight._data).copy()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        assert not np.allclose(np.asarray(m.weight._data), w0)
+        assert opt.get_lr() == 0.1  # __getattr__ passthrough
