@@ -19,6 +19,14 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=N
     n_axes = len(list(normalized_shape))
 
     def f(a, *wb):
+        # fused Pallas path (ref layer_norm_kernel.cu): TPU, last-dim norm,
+        # both affine params present
+        if (jax.default_backend() == "tpu" and n_axes == 1
+                and weight is not None and bias is not None
+                and wb[0].ndim == 1 and wb[1].ndim == 1):
+            from ...ops.pallas.norms import layer_norm as pallas_ln
+
+            return pallas_ln(a, wb[0], wb[1], epsilon, interpret=False)
         axes = tuple(range(a.ndim - n_axes, a.ndim))
         mean = jnp.mean(a, axis=axes, keepdims=True)
         var = jnp.var(a, axis=axes, keepdims=True)

@@ -36,13 +36,11 @@ def flash_attention_arrays(q, k, v, causal=False, scale=None):
     """Array-level entry used by both the Tensor wrapper and jitted models.
 
     Routes to the Pallas TPU kernel when available, else the XLA path."""
-    try:
-        from .pallas.flash import flash_attention_fwd  # Pallas kernel (TPU)
+    if jax.default_backend() == "tpu" and q.shape[-1] <= 256:
+        from .pallas.flash import flash_attention as pallas_flash
 
-        if jax.default_backend() == "tpu":
-            return flash_attention_fwd(q, k, v, causal=causal, scale=scale)
-    except Exception:
-        pass
+        return pallas_flash(q, k, v, causal=causal, scale=scale,
+                            interpret=False)
     return _xla_flash(q, k, v, causal, scale)
 
 

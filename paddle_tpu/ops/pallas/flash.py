@@ -1,0 +1,299 @@
+"""Flash attention as Pallas TPU kernels.
+
+Reference parity: the vendored FlashAttention-2 CUDA library + glue
+(paddle/phi/kernels/gpu/flash_attn_kernel.cu, third_party/flashattn — SURVEY.md
+§2.1 N5). TPU-native design, not a port: blockwise online-softmax tiled for
+VMEM/MXU — grid (batch·heads, q-blocks, k-blocks) with the k dimension
+innermost so the output block is revisited and accumulated in f32 scratch;
+backward is the recompute form (saved logsumexp only) split into a dq kernel
+and a dk/dv kernel so each has a clean accumulation axis.
+
+Layout [B, S, H, D] (the reference flash-attn API layout); internally
+[B·H, S, D]. f32 accumulation everywhere; bf16/f16 inputs stay low-precision
+on the MXU operands only.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _mask(s, causal, kv_len, i_q, j_k, bq, bk):
+    """Causal and/or key-padding mask for one (bq, bk) score tile. kv_len is
+    the TRUE key length (static) — padded key columns never attend."""
+    qi = i_q * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    kj = j_k * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    keep = None
+    if causal:
+        keep = qi >= kj
+    if kv_len % bk != 0:
+        pad_keep = kj < kv_len
+        keep = pad_keep if keep is None else (keep & pad_keep)
+    if keep is None:
+        return s
+    return jnp.where(keep, s, NEG_INF)
+
+
+def _block_sizes(sq, sk, d):
+    bq = min(128, sq)
+    bk = min(128, sk)
+    return bq, bk
+
+
+def _interpret_default():
+    return jax.default_backend() != "tpu"
+
+
+# ------------------------------------------------------------------ forward
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, scale, causal, bq, bk, n_k, kv_len):
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0]  # (bq, d)
+    k = k_ref[0]  # (bk, d)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    s = _mask(s, causal, kv_len, pl.program_id(1), j, bq, bk)
+
+    m_prev = m_scr[:]                      # (bq, 1)
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    alpha = jnp.exp(m_prev - m_new)
+    l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    m_scr[:] = m_new
+    acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+        p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(j == n_k - 1)
+    def _():
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        lse_ref[0] = m_scr[:] + jnp.log(l)
+
+
+def _flash_fwd(q, k, v, scale, causal, interpret, kv_len=None):
+    bh, sq, d = q.shape
+    kv_len = k.shape[1] if kv_len is None else kv_len
+    sk = k.shape[1]
+    bq, bk = _block_sizes(sq, sk, d)
+    n_q, n_k = pl.cdiv(sq, bq), pl.cdiv(sk, bk)
+
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               bq=bq, bk=bk, n_k=n_k, kv_len=kv_len)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda h, i, j: (h, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda h, i, j: (h, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda h, i, j: (h, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda h, i, j: (h, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda h, i, j: (h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            # (bh, sq, 1): trailing unit dim keeps the block TPU-tileable
+            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+# ------------------------------------------------------------------ backward
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_scr, *, scale, causal, bq, bk, n_k, kv_len):
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    q = q_ref[0]
+    k = k_ref[0]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    s = _mask(s, causal, kv_len, pl.program_id(1), j, bq, bk)
+
+    p = jnp.exp(s - lse_ref[0])                          # (bq, bk)
+    dp = jax.lax.dot_general(do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta_ref[0]) * scale
+    dq_scr[:] += jax.lax.dot_general(ds.astype(k.dtype), k,
+                                     (((1,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+
+    @pl.when(j == n_k - 1)
+    def _():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal, bq, bk,
+                n_q, kv_len):
+    i = pl.program_id(2)  # q-block index (innermost: accumulation axis)
+
+    @pl.when(i == 0)
+    def _():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    q = q_ref[0]
+    k = k_ref[0]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    s = _mask(s, causal, kv_len, i, pl.program_id(1), bq, bk)
+
+    p = jnp.exp(s - lse_ref[0])                          # (bq, bk)
+    do = do_ref[0]
+    dv_scr[:] += jax.lax.dot_general(p.astype(do.dtype), do,
+                                     (((0,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+    dp = jax.lax.dot_general(do, v_ref[0], (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta_ref[0]) * scale
+    dk_scr[:] += jax.lax.dot_general(ds.astype(q.dtype), q,
+                                     (((0,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+
+    @pl.when(i == n_q - 1)
+    def _():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, out, lse, do, scale, causal, interpret, kv_len=None):
+    bh, sq, d = q.shape
+    kv_len = k.shape[1] if kv_len is None else kv_len
+    sk = k.shape[1]
+    bq, bk = _block_sizes(sq, sk, d)
+    n_q, n_k = pl.cdiv(sq, bq), pl.cdiv(sk, bk)
+
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)  # (bh, sq, 1)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, n_k=n_k, kv_len=kv_len),
+        grid=(bh, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda h, i, j: (h, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda h, i, j: (h, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda h, i, j: (h, j, 0)),
+            pl.BlockSpec((1, bq, d), lambda h, i, j: (h, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda h, i, j: (h, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda h, i, j: (h, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda h, i, j: (h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, n_q=n_q, kv_len=kv_len),
+        grid=(bh, n_k, n_q),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda h, j, i: (h, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda h, j, i: (h, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda h, j, i: (h, j, 0)),
+            pl.BlockSpec((1, bq, d), lambda h, j, i: (h, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda h, j, i: (h, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda h, j, i: (h, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda h, j, i: (h, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda h, j, i: (h, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ------------------------------------------------------------------ public
+
+def _pad_seq(x, block):
+    s = x.shape[1]
+    pad = (-s) % block
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+    return x, pad
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention_bhsd(q, k, v, scale, causal, interpret):
+    """[B·H, S, D] flash attention. Padded internally to block multiples
+    (padded keys are masked out via an explicit key-length guard)."""
+    out, _ = _fa_fwd_padded(q, k, v, scale, causal, interpret)
+    return out
+
+
+def _fa_fwd_padded(q, k, v, scale, causal, interpret):
+    sq, sk = q.shape[1], k.shape[1]
+    bq, bk = _block_sizes(sq, sk, q.shape[2])
+    qp, _ = _pad_seq(q, bq)
+    kp, _ = _pad_seq(k, bk)
+    vp, _ = _pad_seq(v, bk)
+    out, lse = _flash_fwd(qp, kp, vp, scale, causal, interpret, kv_len=sk)
+    return out[:, :sq], (qp, kp, vp, out, lse)
+
+
+def _fa_vjp_fwd(q, k, v, scale, causal, interpret):
+    out, res = _fa_fwd_padded(q, k, v, scale, causal, interpret)
+    return out, (res, q.shape[1], k.shape[1])
+
+
+def _fa_vjp_bwd(scale, causal, interpret, saved, g):
+    (qp, kp, vp, outp, lse), sq, sk = saved
+    gp = jnp.pad(g, ((0, 0), (0, qp.shape[1] - sq), (0, 0)))
+    dq, dk, dv = _flash_bwd(qp, kp, vp, outp, lse, gp, scale, causal,
+                            interpret, kv_len=sk)
+    return dq[:, :sq], dk[:, :sk], dv[:, :sk]
+
+
+flash_attention_bhsd.defvjp(_fa_vjp_fwd, _fa_vjp_bwd)
+
+
+def flash_attention(q, k, v, causal=False, scale=None, interpret=None):
+    """[B, S, H, D] (reference flash-attn layout) Pallas flash attention."""
+    if interpret is None:
+        interpret = _interpret_default()
+    b, sq, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    to_bhsd = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+    qf, kf, vf = to_bhsd(q), to_bhsd(k), to_bhsd(v)
+    out = flash_attention_bhsd(qf, kf, vf, float(scale), bool(causal),
+                               bool(interpret))
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)

@@ -1,0 +1,280 @@
+"""Fused LayerNorm / RMSNorm Pallas kernels.
+
+Reference parity: paddle/phi/kernels/gpu/layer_norm_kernel.cu + fused
+bias+residual+LN kernels (SURVEY.md §2.1 N3/N4). TPU-native: one VMEM pass
+per row block computing the statistics and the normalized output (saving
+mean/rstd for backward); backward fuses dx with the dγ/dβ reduction, which
+accumulates across row blocks in f32 scratch over a sequential grid.
+
+All statistics in f32 regardless of input dtype (bf16-safe).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _interpret_default():
+    return jax.default_backend() != "tpu"
+
+
+def _row_block(n):
+    return min(256, n)
+
+
+# --------------------------------------------------------------- layer_norm
+
+def _ln_fwd_kernel(x_ref, w_ref, b_ref, y_ref, mean_ref, rstd_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    xhat = (x - mean) * rstd
+    y = xhat * w_ref[0].astype(jnp.float32) + b_ref[0].astype(jnp.float32)
+    y_ref[:] = y.astype(y_ref.dtype)
+    mean_ref[:] = mean
+    rstd_ref[:] = rstd
+
+
+def _ln_bwd_kernel(x_ref, w_ref, mean_ref, rstd_ref, g_ref,
+                   dx_ref, dw_ref, db_ref, dw_scr, db_scr, *, n_blocks):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        dw_scr[:] = jnp.zeros_like(dw_scr)
+        db_scr[:] = jnp.zeros_like(db_scr)
+
+    x = x_ref[:].astype(jnp.float32)
+    g = g_ref[:].astype(jnp.float32)
+    w = w_ref[0].astype(jnp.float32)
+    mean = mean_ref[:]
+    rstd = rstd_ref[:]
+    xhat = (x - mean) * rstd
+
+    gw = g * w
+    m1 = jnp.mean(gw, axis=-1, keepdims=True)
+    m2 = jnp.mean(gw * xhat, axis=-1, keepdims=True)
+    dx = rstd * (gw - m1 - xhat * m2)
+    dx_ref[:] = dx.astype(dx_ref.dtype)
+
+    dw_scr[:] += jnp.sum(g * xhat, axis=0, keepdims=True)
+    db_scr[:] += jnp.sum(g, axis=0, keepdims=True)
+
+    @pl.when(i == n_blocks - 1)
+    def _():
+        dw_ref[:] = dw_scr[:].astype(dw_ref.dtype)
+        db_ref[:] = db_scr[:].astype(db_ref.dtype)
+
+
+def _ln_call_fwd(x2, w, b, eps, interpret):
+    n, h = x2.shape
+    bn = _row_block(n)
+    grid = (pl.cdiv(n, bn),)
+    return pl.pallas_call(
+        functools.partial(_ln_fwd_kernel, eps=eps),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bn, h), lambda i: (i, 0)),
+            pl.BlockSpec((1, h), lambda i: (0, 0)),
+            pl.BlockSpec((1, h), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bn, h), lambda i: (i, 0)),
+            pl.BlockSpec((bn, 1), lambda i: (i, 0)),
+            pl.BlockSpec((bn, 1), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, h), x2.dtype),
+            # (n, 1): 2-D keeps XLA/Mosaic layouts aligned (1-D f32 mismatches)
+            jax.ShapeDtypeStruct((n, 1), jnp.float32),
+            jax.ShapeDtypeStruct((n, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x2, w.reshape(1, h), b.reshape(1, h))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def layer_norm(x, weight, bias, eps=1e-5, interpret=None):
+    """LayerNorm over the last dim. x: [..., H]."""
+    if interpret is None:
+        interpret = _interpret_default()
+    h = x.shape[-1]
+    x2 = x.reshape(-1, h)
+    n = x2.shape[0]
+    pad = (-n) % _row_block(n)
+    if pad:
+        x2 = jnp.pad(x2, ((0, pad), (0, 0)))
+    y, _, _ = _ln_call_fwd(x2, weight, bias, eps, interpret)
+    return y[:n].reshape(x.shape)
+
+
+def _ln_vjp_fwd(x, weight, bias, eps, interpret):
+    if interpret is None:
+        interpret = _interpret_default()
+    h = x.shape[-1]
+    x2 = x.reshape(-1, h)
+    n = x2.shape[0]
+    pad = (-n) % _row_block(n)
+    xp = jnp.pad(x2, ((0, pad), (0, 0))) if pad else x2
+    y, mean, rstd = _ln_call_fwd(xp, weight, bias, eps, interpret)
+    return y[:n].reshape(x.shape), (xp, weight, mean, rstd, x.shape)
+
+
+def _ln_vjp_bwd(eps, interpret, saved, g):
+    if interpret is None:
+        interpret = _interpret_default()
+    xp, w, mean, rstd, orig_shape = saved
+    h = xp.shape[-1]
+    n_pad = xp.shape[0]
+    g2 = g.reshape(-1, h)
+    n = g2.shape[0]
+    if n_pad != n:
+        g2 = jnp.pad(g2, ((0, n_pad - n), (0, 0)))
+    bn = _row_block(n_pad)
+    n_blocks = pl.cdiv(n_pad, bn)
+    dx, dw, db = pl.pallas_call(
+        functools.partial(_ln_bwd_kernel, n_blocks=n_blocks),
+        grid=(n_blocks,),
+        in_specs=[
+            pl.BlockSpec((bn, h), lambda i: (i, 0)),
+            pl.BlockSpec((1, h), lambda i: (0, 0)),
+            pl.BlockSpec((bn, 1), lambda i: (i, 0)),
+            pl.BlockSpec((bn, 1), lambda i: (i, 0)),
+            pl.BlockSpec((bn, h), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bn, h), lambda i: (i, 0)),
+            pl.BlockSpec((1, h), lambda i: (0, 0)),
+            pl.BlockSpec((1, h), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n_pad, h), xp.dtype),
+            jax.ShapeDtypeStruct((1, h), w.dtype),
+            jax.ShapeDtypeStruct((1, h), w.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((1, h), jnp.float32),
+                        pltpu.VMEM((1, h), jnp.float32)],
+        interpret=interpret,
+    )(xp, w.reshape(1, h), mean, rstd, g2)
+    return dx[:n].reshape(orig_shape), dw[0], db[0]
+
+
+layer_norm.defvjp(_ln_vjp_fwd, _ln_vjp_bwd)
+
+
+# ---------------------------------------------------------------- rms_norm
+
+def _rms_fwd_kernel(x_ref, w_ref, y_ref, rstd_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)
+    ms = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(ms + eps)
+    y_ref[:] = (x * rstd * w_ref[0].astype(jnp.float32)).astype(y_ref.dtype)
+    rstd_ref[:] = rstd
+
+
+def _rms_bwd_kernel(x_ref, w_ref, rstd_ref, g_ref, dx_ref, dw_ref, dw_scr,
+                    *, n_blocks):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        dw_scr[:] = jnp.zeros_like(dw_scr)
+
+    x = x_ref[:].astype(jnp.float32)
+    g = g_ref[:].astype(jnp.float32)
+    w = w_ref[0].astype(jnp.float32)
+    rstd = rstd_ref[:]
+    xhat = x * rstd
+    gw = g * w
+    m = jnp.mean(gw * xhat, axis=-1, keepdims=True)
+    dx = rstd * (gw - xhat * m)
+    dx_ref[:] = dx.astype(dx_ref.dtype)
+    dw_scr[:] += jnp.sum(g * xhat, axis=0, keepdims=True)
+
+    @pl.when(i == n_blocks - 1)
+    def _():
+        dw_ref[:] = dw_scr[:].astype(dw_ref.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def rms_norm(x, weight, eps=1e-6, interpret=None):
+    y, _ = _rms_fwd_call(x, weight, eps, interpret)
+    return y
+
+
+def _rms_fwd_call(x, weight, eps, interpret):
+    if interpret is None:
+        interpret = _interpret_default()
+    h = x.shape[-1]
+    x2 = x.reshape(-1, h)
+    n = x2.shape[0]
+    pad = (-n) % _row_block(n)
+    xp = jnp.pad(x2, ((0, pad), (0, 0))) if pad else x2
+    bn = _row_block(xp.shape[0])
+    y, rstd = pl.pallas_call(
+        functools.partial(_rms_fwd_kernel, eps=eps),
+        grid=(pl.cdiv(xp.shape[0], bn),),
+        in_specs=[
+            pl.BlockSpec((bn, h), lambda i: (i, 0)),
+            pl.BlockSpec((1, h), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bn, h), lambda i: (i, 0)),
+            pl.BlockSpec((bn, 1), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(xp.shape, x.dtype),
+            jax.ShapeDtypeStruct((xp.shape[0], 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(xp, weight.reshape(1, h))
+    return y[:n].reshape(x.shape), (xp, rstd, x.shape)
+
+
+def _rms_vjp_fwd(x, weight, eps, interpret):
+    y, res = _rms_fwd_call(x, weight, eps, interpret)
+    return y, (res, weight)
+
+
+def _rms_vjp_bwd(eps, interpret, saved, g):
+    if interpret is None:
+        interpret = _interpret_default()
+    (xp, rstd, orig_shape), w = saved
+    h = xp.shape[-1]
+    n_pad = xp.shape[0]
+    g2 = g.reshape(-1, h)
+    n = g2.shape[0]
+    if n_pad != n:
+        g2 = jnp.pad(g2, ((0, n_pad - n), (0, 0)))
+    bn = _row_block(n_pad)
+    n_blocks = pl.cdiv(n_pad, bn)
+    dx, dw = pl.pallas_call(
+        functools.partial(_rms_bwd_kernel, n_blocks=n_blocks),
+        grid=(n_blocks,),
+        in_specs=[
+            pl.BlockSpec((bn, h), lambda i: (i, 0)),
+            pl.BlockSpec((1, h), lambda i: (0, 0)),
+            pl.BlockSpec((bn, 1), lambda i: (i, 0)),
+            pl.BlockSpec((bn, h), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bn, h), lambda i: (i, 0)),
+            pl.BlockSpec((1, h), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n_pad, h), xp.dtype),
+            jax.ShapeDtypeStruct((1, h), w.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((1, h), jnp.float32)],
+        interpret=interpret,
+    )(xp, w.reshape(1, h), rstd, g2)
+    return dx[:n].reshape(orig_shape), dw[0]
+
+
+rms_norm.defvjp(_rms_vjp_fwd, _rms_vjp_bwd)

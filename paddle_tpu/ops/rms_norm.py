@@ -14,6 +14,11 @@ from ..tensor.creation import _as_t
 
 def rms_norm_arrays(x, weight=None, bias=None, epsilon=1e-6, begin_norm_axis=-1):
     ax = begin_norm_axis % x.ndim
+    if (jax.default_backend() == "tpu" and weight is not None and bias is None
+            and ax == x.ndim - 1 and weight.ndim == 1):
+        from .pallas.norms import rms_norm as pallas_rms
+
+        return pallas_rms(x, weight, epsilon, interpret=False)
     axes = tuple(range(ax, x.ndim))
     xf = x.astype(jnp.float32)
     ms = jnp.mean(jnp.square(xf), axis=axes, keepdims=True)

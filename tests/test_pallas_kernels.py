@@ -1,0 +1,139 @@
+"""Pallas kernel tests, interpret mode on CPU (SURVEY.md §4 op-test pattern:
+NumPy/jnp reference + gradient comparison; the same kernels compile on TPU)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.ops.pallas.flash import flash_attention
+from paddle_tpu.ops.pallas.norms import layer_norm, rms_norm
+
+
+def _ref_attention(q, k, v, causal, scale):
+    s = jnp.einsum("bshd,bthd->bhst", q, k).astype(jnp.float32) * scale
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        m = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(m, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhst,bthd->bshd", p.astype(v.dtype), v)
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("seq", [64, 100, 256])
+    def test_forward(self, causal, seq):
+        rng = np.random.RandomState(0)
+        B, H, D = 2, 2, 32
+        q, k, v = (rng.randn(B, seq, H, D).astype(np.float32) for _ in range(3))
+        out = flash_attention(q, k, v, causal=causal, interpret=True)
+        ref = _ref_attention(q, k, v, causal, 1.0 / np.sqrt(D))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_grads(self, causal):
+        rng = np.random.RandomState(1)
+        B, S, H, D = 2, 100, 2, 16
+        q, k, v = (rng.randn(B, S, H, D).astype(np.float32) for _ in range(3))
+        scale = 1.0 / np.sqrt(D)
+
+        def loss_fa(q, k, v):
+            return (flash_attention(q, k, v, causal=causal, interpret=True) ** 2).sum()
+
+        def loss_ref(q, k, v):
+            return (_ref_attention(q, k, v, causal, scale) ** 2).sum()
+
+        g1 = jax.grad(loss_fa, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_cross_attention_lengths(self):
+        rng = np.random.RandomState(2)
+        B, H, D = 1, 2, 16
+        q = rng.randn(B, 40, H, D).astype(np.float32)
+        k = rng.randn(B, 130, H, D).astype(np.float32)
+        v = rng.randn(B, 130, H, D).astype(np.float32)
+        out = flash_attention(q, k, v, causal=False, interpret=True)
+        ref = _ref_attention(q, k, v, False, 1.0 / np.sqrt(D))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_bf16(self):
+        rng = np.random.RandomState(3)
+        B, S, H, D = 1, 64, 2, 32
+        q, k, v = (rng.randn(B, S, H, D).astype(jnp.bfloat16) for _ in range(3))
+        out = flash_attention(q, k, v, causal=True, interpret=True)
+        ref = _ref_attention(q, k, v, True, 1.0 / np.sqrt(D))
+        assert out.dtype == jnp.bfloat16
+        np.testing.assert_allclose(np.asarray(out, np.float32),
+                                   np.asarray(ref, np.float32),
+                                   rtol=3e-2, atol=3e-2)
+
+    def test_through_tensor_op_and_tape(self):
+        """The paddle-level flash_attention op records the pallas custom_vjp
+        on the tape."""
+        from paddle_tpu.ops.pallas import flash as pf
+        rng = np.random.RandomState(4)
+        q = paddle.to_tensor(rng.randn(1, 32, 2, 16).astype(np.float32),
+                             stop_gradient=False)
+        out = paddle.Tensor(
+            pf.flash_attention(q._data, q._data, q._data, causal=True,
+                               interpret=True))
+        assert out.shape == [1, 32, 2, 16]
+
+
+class TestFusedNorms:
+    def test_layer_norm_fwd_bwd(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(37, 64).astype(np.float32)
+        w = rng.randn(64).astype(np.float32)
+        b = rng.randn(64).astype(np.float32)
+
+        def ref(x, w, b):
+            m = x.mean(-1, keepdims=True)
+            v = ((x - m) ** 2).mean(-1, keepdims=True)
+            return (x - m) / jnp.sqrt(v + 1e-5) * w + b
+
+        np.testing.assert_allclose(
+            np.asarray(layer_norm(x, w, b, 1e-5, True)),
+            np.asarray(ref(x, w, b)), rtol=1e-5, atol=1e-5)
+        g1 = jax.grad(lambda *a: (layer_norm(*a, 1e-5, True) ** 2).sum(),
+                      argnums=(0, 1, 2))(x, w, b)
+        g2 = jax.grad(lambda *a: (ref(*a) ** 2).sum(), argnums=(0, 1, 2))(x, w, b)
+        for a, c in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_layer_norm_3d(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(4, 33, 32).astype(np.float32)
+        w = np.ones(32, np.float32)
+        b = np.zeros(32, np.float32)
+        out = layer_norm(x, w, b, 1e-5, True)
+        m = x.mean(-1, keepdims=True)
+        v = x.var(-1, keepdims=True)
+        np.testing.assert_allclose(np.asarray(out), (x - m) / np.sqrt(v + 1e-5),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_rms_norm_fwd_bwd(self):
+        rng = np.random.RandomState(2)
+        x = rng.randn(50, 48).astype(np.float32)
+        w = rng.randn(48).astype(np.float32)
+
+        def ref(x, w):
+            return x / jnp.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6) * w
+
+        np.testing.assert_allclose(np.asarray(rms_norm(x, w, 1e-6, True)),
+                                   np.asarray(ref(x, w)), rtol=1e-5, atol=1e-5)
+        g1 = jax.grad(lambda *a: (rms_norm(*a, 1e-6, True) ** 2).sum(),
+                      argnums=(0, 1))(x, w)
+        g2 = jax.grad(lambda *a: (ref(*a) ** 2).sum(), argnums=(0, 1))(x, w)
+        for a, c in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                       rtol=1e-4, atol=1e-4)
