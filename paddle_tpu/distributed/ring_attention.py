@@ -1,0 +1,158 @@
+"""Long-context attention: ring (context parallel) + Ulysses (head scatter).
+
+Reference parity: the 'sep' topology axis + all_to_all primitives live in
+core Paddle (SURVEY.md §2.2 P16); ring/context-parallel flash attention and
+Ulysses attention are implemented in the PaddleNLP ecosystem on top of them.
+Per SURVEY.md §5 (long-context is first-class here) both live in-core:
+
+* **ring_flash_attention** — q/k/v sharded on the sequence dim over the ring
+  axis; N steps of blockwise attention with online log-sum-exp combination
+  while k/v blocks rotate around the ring via `lax.ppermute` (ICI
+  neighbor-exchange; XLA overlaps the permute with the block compute). The
+  causal schedule masks block pairs by origin rank: full attention for
+  earlier blocks, intra-block causal on the diagonal, zero contribution for
+  later blocks.
+* **ulysses_attention** — `lax.all_to_all` swaps the sequence shard for a
+  head shard (DeepSpeed-Ulysses), runs ordinary (flash) attention on full
+  sequences for H/N heads, and swaps back. Needs num_heads % ring_size == 0.
+
+Both are pure jax functions over arrays (use inside shard_map); Tensor-level
+wrappers route through op_call.apply so tape autograd records them.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.op_call import apply
+from . import collective_ctx
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, scale, mode, q_off, k_off):
+    """One [B, Sq, H, D] x [B, Sk, H, D] attention block.
+
+    mode: 0 = full, 1 = causal w/ global offsets, 2 = masked out entirely.
+    Returns (unnormalized-out-factors): softmax numerator out and row lse.
+    """
+    s = jnp.einsum("bshd,bthd->bhst", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    sq, sk = s.shape[-2], s.shape[-1]
+    if mode == 1:
+        qi = q_off + lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        kj = k_off + lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where(qi >= kj, s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    m = jnp.maximum(m, NEG_INF)  # guard all-masked rows
+    p = jnp.exp(s - m)
+    l = jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
+    out = jnp.einsum("bhst,bthd->bshd", (p / l).astype(v.dtype), v)
+    lse = (m + jnp.log(l))[..., 0]  # [B, H, Sq]
+    # out is the NORMALIZED block output; lse its log-softmax mass, so blocks
+    # combine as out_total = Σ_b out_b·softmax_b(lse)
+    return out.astype(jnp.float32), lse
+
+
+def ring_flash_attention_arrays(q, k, v, causal=False, scale=None,
+                                axis_name="sep"):
+    """[B, S_local, H, D] ring attention inside shard_map over `axis_name`."""
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    s_local = q.shape[1]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, t):
+        kk, vv, m_run, num, den = carry
+        src = (idx - t) % n  # origin rank of the k/v block we hold now
+
+        # block score vs this kv block, with the causal ring schedule
+        if causal:
+            # diagonal: intra-block causal; earlier src: full; later: masked
+            out_full, lse_full = _block_attn(q, kk, vv, scale, 0, 0, 0)
+            out_diag, lse_diag = _block_attn(
+                q, kk, vv, scale, 1, 0, 0)
+            is_diag = (src == idx)
+            is_later = src > idx
+            out_b = jnp.where(is_diag, out_diag, out_full)
+            lse_b = jnp.where(is_diag, lse_diag, lse_full)
+            lse_b = jnp.where(is_later, NEG_INF, lse_b)
+            out_b = jnp.where(is_later, 0.0, out_b)
+        else:
+            out_b, lse_b = _block_attn(q, kk, vv, scale, 0, 0, 0)
+
+        # online log-sum-exp combine: running (m, num, den) over blocks
+        m_new = jnp.maximum(m_run, lse_b)
+        alpha = jnp.exp(m_run - m_new)
+        beta = jnp.exp(lse_b - m_new)
+        num = num * alpha[..., None].transpose(0, 2, 1, 3) \
+            + out_b * beta[..., None].transpose(0, 2, 1, 3)
+        den = den * alpha + beta
+        # rotate kv to the next rank (skip the last, unused, hop)
+        kk = lax.ppermute(kk, axis_name, perm)
+        vv = lax.ppermute(vv, axis_name, perm)
+        return (kk, vv, m_new, num, den), None
+
+    b, _, h, d = q.shape
+    m0 = jnp.full((b, h, s_local), NEG_INF, jnp.float32)
+    num0 = jnp.zeros((b, s_local, h, d), jnp.float32)
+    den0 = jnp.zeros((b, h, s_local), jnp.float32)
+    (_, _, _, num, den), _ = lax.scan(
+        step, (k, v, m0, num0, den0), jnp.arange(n))
+    den = jnp.maximum(den, 1e-30)
+    out = num / den[..., None].transpose(0, 2, 1, 3)
+    return out.astype(q.dtype)
+
+
+def ulysses_attention_arrays(q, k, v, causal=False, scale=None,
+                             axis_name="sep", attn_fn=None):
+    """Ulysses: all_to_all seq-shard -> head-shard, attend, swap back."""
+    n = lax.axis_size(axis_name)
+    h = q.shape[2]
+    if h % n:
+        raise ValueError(f"num_heads {h} not divisible by sep degree {n}")
+
+    def seq2head(x):
+        # [B, S/N, H, D] -> [B, S, H/N, D]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def head2seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qh, kh, vh = seq2head(q), seq2head(k), seq2head(v)
+    if attn_fn is None:
+        from ..ops.flash_attention import flash_attention_arrays as attn_fn
+    out = attn_fn(qh, kh, vh, causal=causal, scale=scale)
+    return head2seq(out)
+
+
+# ------------------------------------------------------------ Tensor level
+
+def _wrap(fn_arrays):
+    @functools.wraps(fn_arrays)
+    def op(q, k, v, causal=False, scale=None, axis_name="sep", group=None):
+        name = getattr(group, "axis_name", None) or axis_name
+        if collective_ctx.current_axis(name) is None:
+            # sep=1 degenerate: ordinary attention
+            from ..ops.flash_attention import flash_attention
+
+            return flash_attention(q, k, v, causal=causal, scale=scale)
+        return apply(
+            lambda a, b, c: fn_arrays(a, b, c, causal=causal, scale=scale,
+                                      axis_name=name),
+            q, k, v, _op_name=fn_arrays.__name__)
+
+    return op
+
+
+ring_flash_attention = _wrap(ring_flash_attention_arrays)
+ulysses_attention = _wrap(ulysses_attention_arrays)
