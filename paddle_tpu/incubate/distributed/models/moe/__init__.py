@@ -1,0 +1,8 @@
+"""MoE / expert parallelism (ref: python/paddle/incubate/distributed/models/
+moe/ (U) — MoELayer, GShard/Switch gates, global_scatter/global_gather
+all-to-all dispatch; SURVEY.md §2.2 P17)."""
+
+from .gate import GShardGate, NaiveGate, SwitchGate
+from .moe_layer import MoELayer
+
+__all__ = ["MoELayer", "GShardGate", "SwitchGate", "NaiveGate"]

@@ -1,0 +1,151 @@
+"""MoE gates.
+
+Reference parity: moe/gate/{gshard_gate,switch_gate,naive_gate}.py (U).
+TPU-native: gates emit fixed-capacity one-hot dispatch/combine tensors
+(the GShard einsum formulation) instead of index lists — static shapes are
+what XLA/MXU need; token dropping happens via capacity masking, not
+variable-length buffers.
+
+All return (dispatch [T,E,C] bool-ish f32, combine [T,E,C] f32, aux_loss).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _capacity(num_tokens, num_experts, top_k, capacity_factor):
+    cap = int(num_tokens * top_k * capacity_factor / num_experts)
+    return max(cap, 1)
+
+
+def _one_hot_dispatch(expert_idx, gate_w, num_experts, capacity):
+    """expert_idx [T] int, gate_w [T] f32 -> dispatch/combine [T, E, C].
+
+    Position within each expert's buffer = cumulative count of earlier tokens
+    routed to the same expert; tokens past capacity are dropped.
+    """
+    t = expert_idx.shape[0]
+    onehot = jax.nn.one_hot(expert_idx, num_experts, dtype=jnp.float32)  # [T,E]
+    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot                     # [T,E]
+    pos_in_e = jnp.sum(pos, axis=-1).astype(jnp.int32)                    # [T]
+    keep = pos_in_e < capacity
+    pos_oh = jax.nn.one_hot(pos_in_e, capacity, dtype=jnp.float32)        # [T,C]
+    dispatch = onehot[:, :, None] * pos_oh[:, None, :]                    # [T,E,C]
+    dispatch = dispatch * keep[:, None, None].astype(jnp.float32)
+    combine = dispatch * gate_w[:, None, None]
+    return dispatch, combine
+
+
+def _load_balance_loss(probs, expert_idx, num_experts):
+    """GShard/Switch aux loss: E * Σ_e f_e · P_e."""
+    me = jnp.mean(probs, axis=0)                                  # [E]
+    ce = jnp.mean(jax.nn.one_hot(expert_idx, num_experts, dtype=probs.dtype),
+                  axis=0)
+    return num_experts * jnp.sum(me * ce)
+
+
+class NaiveGate:
+    """ref NaiveGate: plain top-k, no aux loss."""
+
+    top_k = 2
+
+    def __init__(self, top_k=2, capacity_factor=1.0):
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+
+    def __call__(self, logits):
+        t, e = logits.shape
+        cap = _capacity(t, e, self.top_k, self.capacity_factor)
+        probs = jax.nn.softmax(logits, axis=-1)
+        disp = None
+        comb = None
+        remaining = probs
+        occupancy = jnp.zeros((e,), jnp.float32)  # slots used by prior rounds
+        for _ in range(self.top_k):
+            idx = jnp.argmax(remaining, axis=-1)
+            w = jnp.take_along_axis(probs, idx[:, None], axis=-1)[:, 0]
+            oh = jax.nn.one_hot(idx, e, dtype=jnp.float32)
+            pos = (jnp.cumsum(oh, axis=0) - 1.0) * oh + occupancy * oh
+            pos_in_e = jnp.sum(pos, axis=-1).astype(jnp.int32)
+            keep = pos_in_e < cap
+            pos_oh = jax.nn.one_hot(pos_in_e, cap, dtype=jnp.float32)
+            d = oh[:, :, None] * pos_oh[:, None, :] \
+                * keep[:, None, None].astype(jnp.float32)
+            c = d * w[:, None, None]
+            disp = d if disp is None else jnp.maximum(disp, d)
+            comb = c if comb is None else comb + c
+            occupancy = occupancy + jnp.sum(oh, axis=0)
+            remaining = remaining * (1.0 - oh.astype(probs.dtype))
+        return disp, comb, jnp.zeros((), probs.dtype)
+
+
+class SwitchGate:
+    """ref SwitchGate: top-1 routing with load-balance aux loss."""
+
+    top_k = 1
+
+    def __init__(self, capacity_factor=1.25, aux_loss_weight=1.0):
+        self.capacity_factor = capacity_factor
+        self.aux_loss_weight = aux_loss_weight
+
+    def __call__(self, logits):
+        t, e = logits.shape
+        cap = _capacity(t, e, 1, self.capacity_factor)
+        probs = jax.nn.softmax(logits, axis=-1)
+        idx = jnp.argmax(probs, axis=-1)
+        w = jnp.take_along_axis(probs, idx[:, None], axis=-1)[:, 0]
+        dispatch, combine = _one_hot_dispatch(idx, w, e, cap)
+        aux = _load_balance_loss(probs, idx, e) * self.aux_loss_weight
+        return dispatch, combine, aux
+
+
+class GShardGate:
+    """ref GShardGate: top-2 with normalized weights + aux loss."""
+
+    top_k = 2
+
+    def __init__(self, capacity_factor=2.0, aux_loss_weight=1.0):
+        self.capacity_factor = capacity_factor
+        self.aux_loss_weight = aux_loss_weight
+
+    def __call__(self, logits):
+        t, e = logits.shape
+        cap = _capacity(t, e, 2, self.capacity_factor)
+        probs = jax.nn.softmax(logits, axis=-1)
+
+        idx1 = jnp.argmax(probs, axis=-1)
+        mask1 = jax.nn.one_hot(idx1, e, dtype=probs.dtype)
+        probs2 = probs * (1.0 - mask1)
+        idx2 = jnp.argmax(probs2, axis=-1)
+
+        w1 = jnp.take_along_axis(probs, idx1[:, None], -1)[:, 0]
+        w2 = jnp.take_along_axis(probs, idx2[:, None], -1)[:, 0]
+        denom = jnp.maximum(w1 + w2, 1e-9)
+        w1, w2 = w1 / denom, w2 / denom
+
+        # top-1 tokens first in each expert buffer (they matter more), then
+        # top-2 tokens fill remaining capacity
+        d1, c1 = _one_hot_dispatch(idx1, w1, e, cap)
+        # offset top-2 positions past the top-1 occupancy of that expert
+        oh1 = jax.nn.one_hot(idx1, e, dtype=jnp.float32)
+        oh2 = jax.nn.one_hot(idx2, e, dtype=jnp.float32)
+        count1 = jnp.sum(oh1, axis=0)                             # [E]
+        pos2 = (jnp.cumsum(oh2, axis=0) - 1.0) * oh2 + count1 * oh2
+        pos_in_e2 = jnp.sum(pos2, axis=-1).astype(jnp.int32)
+        keep2 = pos_in_e2 < cap
+        pos_oh2 = jax.nn.one_hot(pos_in_e2, cap, dtype=jnp.float32)
+        d2 = oh2[:, :, None] * pos_oh2[:, None, :] \
+            * keep2[:, None, None].astype(jnp.float32)
+        c2 = d2 * w2[:, None, None]
+
+        dispatch = jnp.maximum(d1, d2)
+        combine = c1 + c2
+        aux = _load_balance_loss(probs, idx1, e) * self.aux_loss_weight
+        return dispatch, combine, aux
+
+
+GATES = {"naive": NaiveGate, "switch": SwitchGate, "gshard": GShardGate}

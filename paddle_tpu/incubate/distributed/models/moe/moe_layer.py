@@ -1,0 +1,136 @@
+"""MoELayer: fixed-capacity einsum dispatch + expert-parallel all_to_all.
+
+Reference parity: moe/moe_layer.py (U) — MoELayer dispatching tokens to
+experts through `global_scatter`/`global_gather` NCCL all-to-alls
+(SURVEY.md §2.1 N14, §2.2 P17).
+
+TPU-native design: the GShard SPMD formulation. Dispatch/combine are
+one-hot [T, E, C] einsums (static shapes, MXU-friendly, no index lists);
+expert weights are STACKED on a leading expert dim (one big batched matmul
+per expert layer — exactly what the MXU wants) instead of a Python list of
+modules; expert parallelism is `lax.all_to_all` on the capacity buffers
+over the chosen mesh axis, each rank computing its E/n local experts.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .....core.op_call import apply
+from .....core.tensor import Tensor
+from .....distributed import collective_ctx
+from .....nn import functional as F
+from .....nn.initializer import XavierNormal
+from .....nn.layer.layers import Layer
+from .gate import GATES
+
+
+class MoELayer(Layer):
+    """Feed-forward MoE block: x -> gate -> expert MLPs -> combine.
+
+    Args mirror the reference MoELayer where applicable; experts are an
+    internal stacked MLP (d_model -> d_hidden -> d_model, `activation`).
+    `axis_name` selects the expert-parallel mesh axis ('dp' is the usual EP
+    group — the reference builds its moe_group over data ranks).
+    """
+
+    def __init__(self, d_model, d_hidden, num_experts, gate="gshard",
+                 top_k=None, capacity_factor=None, activation="gelu",
+                 axis_name="dp", moe_group=None, name=None):
+        super().__init__()
+        self.d_model = d_model
+        self.d_hidden = d_hidden
+        self.num_experts = num_experts
+        self.axis_name = getattr(moe_group, "axis_name", None) or axis_name
+        if isinstance(gate, str):
+            kwargs = {}
+            if capacity_factor is not None:
+                kwargs["capacity_factor"] = capacity_factor
+            if gate == "naive" and top_k is not None:
+                kwargs["top_k"] = top_k
+            gate = GATES[gate](**kwargs)
+        self.gate = gate
+        self.activation = activation
+        self.l_aux = None  # set each forward (ref keeps it on the layer)
+
+        self.gate_weight = self.create_parameter(
+            [d_model, num_experts], default_initializer=XavierNormal())
+        self.w1 = self.create_parameter(
+            [num_experts, d_model, d_hidden], default_initializer=XavierNormal())
+        self.b1 = self.create_parameter([num_experts, 1, d_hidden], is_bias=True)
+        self.w2 = self.create_parameter(
+            [num_experts, d_hidden, d_model], default_initializer=XavierNormal())
+        self.b2 = self.create_parameter([num_experts, 1, d_model], is_bias=True)
+        # expert weights shard over the EP axis
+        for p in (self.w1, self.b1, self.w2, self.b2):
+            p._sharding_axes = (self.axis_name,) + (None,) * (p._data.ndim - 1)
+
+    # ------------------------------------------------------------ experts
+    def _experts(self, x_ecm, w1, b1, w2, b2):
+        """x [E_loc, C', M] with stacked weights -> [E_loc, C', M]."""
+        act = getattr(jax.nn, self.activation)
+        h = jnp.einsum("ecm,emh->ech", x_ecm, w1,
+                       preferred_element_type=jnp.float32).astype(x_ecm.dtype)
+        h = act(h + b1)
+        y = jnp.einsum("ech,ehm->ecm", h, w2,
+                       preferred_element_type=jnp.float32).astype(x_ecm.dtype)
+        return y + b2
+
+    def _forward_arrays(self, x, gw, w1, b1, w2, b2, axis):
+        """x [T, M]; returns (y [T, M], aux loss scalar)."""
+        logits = jnp.einsum("tm,me->te", x, gw,
+                            preferred_element_type=jnp.float32)
+        dispatch, combine, aux = self.gate(logits)
+        expert_in = jnp.einsum("tec,tm->ecm", dispatch.astype(x.dtype), x)
+
+        if axis is not None:
+            n = lax.axis_size(axis)
+            e_loc = self.num_experts // n
+            # [E, C, M] -> send each rank its experts' buffers, gather the
+            # buffers every rank built for OUR experts along capacity
+            expert_in = expert_in.reshape(n, e_loc, -1, x.shape[-1])
+            # split dim0 (destination rank) and restack it at dim0 as the
+            # SOURCE rank: out[s] = rank s's buffers for OUR experts
+            expert_in = lax.all_to_all(expert_in, axis, split_axis=0,
+                                       concat_axis=0, tiled=False)
+            # [n, e_loc, C, M] -> [e_loc, n*C, M]
+            expert_in = expert_in.transpose(1, 0, 2, 3).reshape(
+                e_loc, -1, x.shape[-1])
+            i = lax.axis_index(axis)
+            w1 = lax.dynamic_slice_in_dim(w1, i * e_loc, e_loc, 0)
+            b1 = lax.dynamic_slice_in_dim(b1, i * e_loc, e_loc, 0)
+            w2 = lax.dynamic_slice_in_dim(w2, i * e_loc, e_loc, 0)
+            b2 = lax.dynamic_slice_in_dim(b2, i * e_loc, e_loc, 0)
+            out = self._experts(expert_in, w1, b1, w2, b2)
+            # reverse: [e_loc, n*C, M] -> [n, e_loc, C, M] -> [E, C, M]
+            out = out.reshape(e_loc, n, -1, x.shape[-1]).transpose(1, 0, 2, 3)
+            out = lax.all_to_all(out, axis, split_axis=0, concat_axis=0,
+                                 tiled=False)
+            # [n, e_loc, C, M], dim0 = expert-owner rank -> global expert order
+            out = out.reshape(self.num_experts, -1, x.shape[-1])
+        else:
+            out = self._experts(expert_in, w1, b1, w2, b2)
+
+        y = jnp.einsum("tec,ecm->tm", combine.astype(x.dtype), out)
+        return y, aux
+
+    def forward(self, x):
+        axis = collective_ctx.current_axis(self.axis_name)
+        shape = x.shape
+        m = shape[-1]
+
+        def f(xa, gw, w1, b1, w2, b2):
+            flat = xa.reshape(-1, m)
+            y, aux = self._forward_arrays(flat, gw, w1, b1, w2, b2, axis)
+            return y.reshape(xa.shape), aux
+
+        y, aux = apply(f, x, self.gate_weight, self.w1, self.b1, self.w2,
+                       self.b2, _op_name="moe")
+        self.l_aux = aux
+        return y
+
+    def extra_repr(self):
+        return (f"d_model={self.d_model}, experts={self.num_experts}, "
+                f"gate={type(self.gate).__name__}, axis={self.axis_name}")
