@@ -63,3 +63,4 @@ __all__ = [
 from . import fleet
 from . import sharding
 from .ring_attention import ring_flash_attention, ulysses_attention
+from . import checkpoint

@@ -1,0 +1,19 @@
+"""`python -m paddle_tpu.distributed.launch` — the training launcher.
+
+Reference parity: python/paddle/distributed/launch/ (U) — Context →
+CollectiveController, pod/job model, rendezvous masters, env injection,
+per-rank log capture, watcher (SURVEY.md §2.2 P21).
+
+TPU-native design: ONE process per host (all local chips belong to a single
+jax process), so there is no per-GPU process fan-out; the controller's job
+reduces to (a) exporting the env contract (PADDLE_TRAINER_ID /
+PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ENDPOINTS, kept name-compatible so
+reference scripts port unchanged) for `jax.distributed.initialize`'s
+coordination service (which replaces TCPStore/ETCDMaster), (b) per-rank log
+redirection, and (c) the watcher: restart-on-failure with checkpoint
+autoresume (the reference's elastic manager collapses to this under jax's
+fixed-slice model — membership changes mean a new slice, not an in-place
+rescale).
+"""
+
+from .main import launch, main  # noqa: F401

@@ -1,0 +1,98 @@
+"""Launcher implementation (see package docstring; ref launch/main.py (U))."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import runpy
+import subprocess
+import sys
+import time
+
+
+def _parse(argv):
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="TPU training launcher (one process per host)")
+    p.add_argument("--nnodes", type=str, default="1",
+                   help="node count or range 'N' / 'N:M'")
+    p.add_argument("--master", type=str, default=None,
+                   help="coordinator address host:port (rank-0 host)")
+    p.add_argument("--rank", type=int,
+                   default=int(os.getenv("POD_RANK", os.getenv("RANK", "0"))),
+                   help="this host's rank in [0, nnodes)")
+    p.add_argument("--log_dir", type=str, default=None,
+                   help="write per-rank logs to this dir")
+    p.add_argument("--max_restarts", type=int, default=0,
+                   help="watcher: relaunch the script this many times on "
+                        "failure (autoresume from user checkpoints)")
+    p.add_argument("--devices", "--gpus", "--tpus", type=str, default=None,
+                   help="visible device ids (TPU: informational)")
+    p.add_argument("script", type=str, help="training script")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def _export_env(args):
+    nnodes = int(str(args.nnodes).split(":")[0])
+    env = {
+        "PADDLE_TRAINER_ID": str(args.rank),
+        "PADDLE_TRAINERS_NUM": str(nnodes),
+        "RANK": str(args.rank),
+        "WORLD_SIZE": str(nnodes),
+    }
+    if args.master:
+        eps = [args.master]
+        env["PADDLE_TRAINER_ENDPOINTS"] = ",".join(eps)
+        env["PADDLE_CURRENT_ENDPOINT"] = args.master if args.rank == 0 else ""
+        env["MASTER_ADDR"], _, port = args.master.partition(":")
+        env["MASTER_PORT"] = port or "8090"
+    if args.devices:
+        env["FLAGS_selected_tpus"] = args.devices
+    os.environ.update(env)
+    return env
+
+
+def launch(argv=None):
+    args = _parse(argv if argv is not None else sys.argv[1:])
+    _export_env(args)
+
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+
+    attempt = 0
+    while True:
+        if attempt == 0 and not args.log_dir:
+            # common case: run in-process (no fork) — jax owns the devices
+            sys.argv = [args.script] + list(args.script_args)
+            runpy.run_path(args.script, run_name="__main__")
+            return 0
+        # watcher mode: subprocess so a crash can be observed and restarted
+        log = None
+        if args.log_dir:
+            log = open(os.path.join(
+                args.log_dir, f"workerlog.{args.rank}.{attempt}"), "w")
+        proc = subprocess.run(
+            [sys.executable, args.script] + list(args.script_args),
+            stdout=log or None, stderr=subprocess.STDOUT if log else None)
+        if log:
+            log.close()
+        if proc.returncode == 0:
+            return 0
+        if attempt >= args.max_restarts:
+            print(f"[launch] worker failed (rc={proc.returncode}), "
+                  f"restarts exhausted", file=sys.stderr)
+            return proc.returncode
+        attempt += 1
+        print(f"[launch] worker failed (rc={proc.returncode}); restart "
+              f"{attempt}/{args.max_restarts} (autoresume from checkpoint)",
+              file=sys.stderr)
+        time.sleep(3)
+
+
+def main():
+    raise SystemExit(launch())
+
+
+if __name__ == "__main__":
+    main()
