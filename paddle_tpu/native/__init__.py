@@ -1,0 +1,177 @@
+"""ctypes bindings for the native runtime core (see native.cc).
+
+Build-on-first-import with g++ (no pybind11 in this image — SURVEY.md §2.1
+N24 maps to plain C ABI + ctypes). The .so is cached next to the source and
+rebuilt when native.cc changes. Every entry point degrades to a pure-Python
+fallback if the toolchain is unavailable, so the framework never hard-fails.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "native.cc")
+
+_lib = None
+_lib_err = None
+_lock = threading.Lock()
+
+
+def _build_and_load():
+    global _lib, _lib_err
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    so_path = os.path.join(_DIR, f"_native_{digest}.so")
+    if not os.path.exists(so_path):
+        tmp = so_path + ".tmp"
+        cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+               _SRC, "-o", tmp]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+        except (subprocess.CalledProcessError, FileNotFoundError) as e:
+            _lib_err = getattr(e, "stderr", str(e))
+            return None
+        os.replace(tmp, so_path)
+        # drop stale builds
+        for f_ in os.listdir(_DIR):
+            if f_.startswith("_native_") and f_.endswith(".so") \
+                    and f_ != os.path.basename(so_path):
+                try:
+                    os.unlink(os.path.join(_DIR, f_))
+                except OSError:
+                    pass
+    lib = ctypes.CDLL(so_path)
+    lib.pt_trace_begin.argtypes = [ctypes.c_char_p]
+    lib.pt_trace_instant.argtypes = [ctypes.c_char_p]
+    lib.pt_trace_export.argtypes = [ctypes.c_char_p]
+    lib.pt_trace_export.restype = ctypes.c_int
+    lib.pt_trace_event_count.restype = ctypes.c_uint64
+    lib.pt_buf_alloc.argtypes = [ctypes.c_size_t]
+    lib.pt_buf_alloc.restype = ctypes.c_void_p
+    lib.pt_buf_free.argtypes = [ctypes.c_void_p, ctypes.c_size_t]
+    lib.pt_buf_stats.argtypes = [ctypes.POINTER(ctypes.c_uint64)]
+    lib.pt_collate.argtypes = [ctypes.c_void_p,
+                               ctypes.POINTER(ctypes.c_void_p),
+                               ctypes.c_size_t, ctypes.c_size_t, ctypes.c_int]
+    return lib
+
+
+def get_lib():
+    """The loaded native library, building it on first use (None if no
+    toolchain)."""
+    global _lib
+    if _lib is None:
+        with _lock:
+            if _lib is None:
+                _lib = _build_and_load()
+    return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+# ------------------------------------------------------------- tracer API
+
+def trace_enable(on=True):
+    lib = get_lib()
+    if lib:
+        lib.pt_trace_enable(1 if on else 0)
+
+
+def trace_begin(name: str):
+    lib = get_lib()
+    if lib:
+        lib.pt_trace_begin(name.encode())
+
+
+def trace_end():
+    lib = get_lib()
+    if lib:
+        lib.pt_trace_end()
+
+
+def trace_export(path: str) -> bool:
+    lib = get_lib()
+    return bool(lib) and lib.pt_trace_export(path.encode()) == 0
+
+
+def trace_clear():
+    lib = get_lib()
+    if lib:
+        lib.pt_trace_clear()
+
+
+def trace_event_count() -> int:
+    lib = get_lib()
+    return int(lib.pt_trace_event_count()) if lib else 0
+
+
+# ------------------------------------------------------------ buffer pool
+
+def buf_stats():
+    lib = get_lib()
+    if not lib:
+        return {"bytes_live": 0, "bytes_pooled": 0, "n_alloc": 0, "n_reuse": 0}
+    out = (ctypes.c_uint64 * 4)()
+    lib.pt_buf_stats(out)
+    return {"bytes_live": out[0], "bytes_pooled": out[1],
+            "n_alloc": out[2], "n_reuse": out[3]}
+
+
+class StagingBuffer:
+    """Pooled page-aligned host buffer (ref pinned allocator N18) exposed as
+    a numpy array for H2D staging."""
+
+    def __init__(self, nbytes):
+        import numpy as np
+
+        self.nbytes = int(nbytes)
+        lib = get_lib()
+        if lib:
+            self._ptr = lib.pt_buf_alloc(self.nbytes)
+            buf = (ctypes.c_char * self.nbytes).from_address(self._ptr)
+            self.array = np.frombuffer(buf, dtype=np.uint8)
+        else:
+            self._ptr = None
+            self.array = np.empty(self.nbytes, dtype=np.uint8)
+
+    def release(self):
+        if self._ptr is not None:
+            get_lib().pt_buf_free(self._ptr, self.nbytes)
+            self._ptr = None
+            self.array = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+
+# --------------------------------------------------------------- collate
+
+def collate_stack(samples, out=None):
+    """np.stack(samples) through the native parallel-memcpy path. Samples
+    must be same-shape, same-dtype, C-contiguous ndarrays."""
+    import numpy as np
+
+    lib = get_lib()
+    first = samples[0]
+    if (lib is None or not first.flags["C_CONTIGUOUS"]
+            or any(s.shape != first.shape or s.dtype != first.dtype
+                   or not s.flags["C_CONTIGUOUS"] for s in samples[1:])):
+        return np.stack(samples)
+    n = len(samples)
+    if out is None:
+        out = np.empty((n,) + first.shape, dtype=first.dtype)
+    ptrs = (ctypes.c_void_p * n)(
+        *[s.ctypes.data_as(ctypes.c_void_p) for s in samples])
+    lib.pt_collate(out.ctypes.data_as(ctypes.c_void_p), ptrs, n,
+                   first.nbytes, 0)
+    return out

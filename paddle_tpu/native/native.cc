@@ -1,0 +1,320 @@
+// paddle_tpu native runtime core.
+//
+// Reference parity: the C++ side of the reference framework that is NOT
+// subsumed by XLA/PJRT (SURVEY.md §2.1):
+//   * host tracer (N20, paddle/fluid/platform/profiler/host_tracer.cc):
+//     RecordEvent span collection + chrome-trace export, here a lock-free
+//     per-thread buffer design so instrumentation stays ~ns-cheap.
+//   * host staging allocator (N18, paddle/fluid/memory/allocation/
+//     pinned_allocator.cc): page-aligned pooled host buffers for H2D staging
+//     with reuse stats (the device side is XLA's BFC — nothing to build).
+//   * DataLoader batch collation (P6 worker core): parallel memcpy gather of
+//     sample buffers into one batch buffer, off the GIL.
+//
+// Built by paddle_tpu/native/__init__.py with g++ -O2 -shared; bound via
+// ctypes (no pybind11 in this image).
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <functional>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+// ----------------------------------------------------------- host tracer
+
+namespace {
+
+struct TraceEvent {
+  uint32_t name_id;
+  uint64_t ts_us;
+  uint64_t dur_us;
+};
+
+struct OpenSpan {
+  uint32_t name_id;
+  uint64_t ts_us;
+};
+
+uint64_t now_us() {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+struct ThreadTrace {
+  uint64_t tid;
+  std::vector<TraceEvent> events;
+  std::vector<OpenSpan> stack;
+};
+
+std::mutex g_trace_mu;                      // registry + name table only
+std::vector<ThreadTrace*> g_threads;        // owned forever (leak by design)
+std::unordered_map<std::string, uint32_t> g_name_ids;
+std::vector<std::string> g_names;
+std::atomic<bool> g_trace_on{false};
+
+ThreadTrace* tls_trace() {
+  thread_local ThreadTrace* t = nullptr;
+  if (t == nullptr) {
+    t = new ThreadTrace();
+    t->tid = std::hash<std::thread::id>()(std::this_thread::get_id()) & 0xffffff;
+    std::lock_guard<std::mutex> lk(g_trace_mu);
+    g_threads.push_back(t);
+  }
+  return t;
+}
+
+uint32_t intern_name(const char* name) {
+  std::lock_guard<std::mutex> lk(g_trace_mu);
+  auto it = g_name_ids.find(name);
+  if (it != g_name_ids.end()) return it->second;
+  uint32_t id = static_cast<uint32_t>(g_names.size());
+  g_names.emplace_back(name);
+  g_name_ids.emplace(name, id);
+  return id;
+}
+
+}  // namespace
+
+extern "C" {
+
+void pt_trace_enable(int on) { g_trace_on.store(on != 0); }
+
+int pt_trace_enabled() { return g_trace_on.load() ? 1 : 0; }
+
+void pt_trace_begin(const char* name) {
+  if (!g_trace_on.load(std::memory_order_relaxed)) return;
+  ThreadTrace* t = tls_trace();
+  t->stack.push_back({intern_name(name), now_us()});
+}
+
+void pt_trace_end() {
+  if (!g_trace_on.load(std::memory_order_relaxed)) return;
+  ThreadTrace* t = tls_trace();
+  if (t->stack.empty()) return;
+  OpenSpan s = t->stack.back();
+  t->stack.pop_back();
+  t->events.push_back({s.name_id, s.ts_us, now_us() - s.ts_us});
+}
+
+void pt_trace_instant(const char* name) {
+  if (!g_trace_on.load(std::memory_order_relaxed)) return;
+  ThreadTrace* t = tls_trace();
+  t->events.push_back({intern_name(name), now_us(), 0});
+}
+
+uint64_t pt_trace_event_count() {
+  std::lock_guard<std::mutex> lk(g_trace_mu);
+  uint64_t n = 0;
+  for (auto* t : g_threads) n += t->events.size();
+  return n;
+}
+
+// chrome-trace JSON (ref chrometracing_logger.cc). Returns 0 on success.
+int pt_trace_export(const char* path) {
+  std::lock_guard<std::mutex> lk(g_trace_mu);
+  FILE* f = std::fopen(path, "w");
+  if (!f) return -1;
+  std::fputs("{\"traceEvents\":[", f);
+  bool first = true;
+  for (auto* t : g_threads) {
+    for (const TraceEvent& e : t->events) {
+      if (!first) std::fputc(',', f);
+      first = false;
+      std::fprintf(f,
+                   "{\"name\":\"%s\",\"ph\":\"X\",\"pid\":0,\"tid\":%llu,"
+                   "\"ts\":%llu,\"dur\":%llu}",
+                   g_names[e.name_id].c_str(),
+                   static_cast<unsigned long long>(t->tid),
+                   static_cast<unsigned long long>(e.ts_us),
+                   static_cast<unsigned long long>(e.dur_us));
+    }
+  }
+  std::fputs("]}", f);
+  std::fclose(f);
+  return 0;
+}
+
+void pt_trace_clear() {
+  std::lock_guard<std::mutex> lk(g_trace_mu);
+  for (auto* t : g_threads) t->events.clear();
+}
+
+}  // extern "C"
+
+// ----------------------------------------------- host staging buffer pool
+
+namespace {
+
+constexpr size_t kAlign = 4096;  // page-aligned: DMA-friendly staging
+
+struct BufPool {
+  std::mutex mu;
+  // size-class (rounded to 64KiB) -> free buffers
+  std::unordered_map<size_t, std::vector<void*>> free_list;
+  std::atomic<uint64_t> bytes_live{0};
+  std::atomic<uint64_t> bytes_pooled{0};
+  std::atomic<uint64_t> n_alloc{0};
+  std::atomic<uint64_t> n_reuse{0};
+};
+
+BufPool g_pool;
+
+size_t size_class(size_t n) {
+  constexpr size_t kGran = 64 * 1024;
+  return (n + kGran - 1) / kGran * kGran;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* pt_buf_alloc(size_t size) {
+  size_t cls = size_class(size);
+  {
+    std::lock_guard<std::mutex> lk(g_pool.mu);
+    auto it = g_pool.free_list.find(cls);
+    if (it != g_pool.free_list.end() && !it->second.empty()) {
+      void* p = it->second.back();
+      it->second.pop_back();
+      g_pool.bytes_pooled -= cls;
+      g_pool.bytes_live += cls;
+      g_pool.n_reuse++;
+      return p;
+    }
+  }
+  void* p = nullptr;
+  if (posix_memalign(&p, kAlign, cls) != 0) return nullptr;
+  g_pool.bytes_live += cls;
+  g_pool.n_alloc++;
+  return p;
+}
+
+void pt_buf_free(void* p, size_t size) {
+  if (!p) return;
+  size_t cls = size_class(size);
+  std::lock_guard<std::mutex> lk(g_pool.mu);
+  g_pool.free_list[cls].push_back(p);
+  g_pool.bytes_live -= cls;
+  g_pool.bytes_pooled += cls;
+}
+
+void pt_buf_trim() {
+  std::lock_guard<std::mutex> lk(g_pool.mu);
+  for (auto& kv : g_pool.free_list) {
+    for (void* p : kv.second) std::free(p);
+    g_pool.bytes_pooled -= kv.second.size() * kv.first;
+    kv.second.clear();
+  }
+}
+
+// out[0]=bytes_live out[1]=bytes_pooled out[2]=n_alloc out[3]=n_reuse
+void pt_buf_stats(uint64_t* out) {
+  out[0] = g_pool.bytes_live.load();
+  out[1] = g_pool.bytes_pooled.load();
+  out[2] = g_pool.n_alloc.load();
+  out[3] = g_pool.n_reuse.load();
+}
+
+}  // extern "C"
+
+// -------------------------------------------------- parallel batch collate
+
+namespace {
+
+class WorkerPool {
+ public:
+  explicit WorkerPool(int n) : stop_(false) {
+    for (int i = 0; i < n; ++i)
+      workers_.emplace_back([this] { this->run(); });
+  }
+
+  void parallel_for(size_t n, const std::function<void(size_t)>& fn) {
+    if (n == 0) return;
+    std::unique_lock<std::mutex> lk(mu_);
+    fn_ = &fn;
+    next_.store(0);
+    done_.store(0);
+    total_ = n;
+    epoch_++;
+    cv_.notify_all();
+    done_cv_.wait(lk, [this] { return done_.load() == total_; });
+    fn_ = nullptr;
+  }
+
+ private:
+  void run() {
+    uint64_t seen_epoch = 0;
+    for (;;) {
+      const std::function<void(size_t)>* fn;
+      size_t total;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_.wait(lk, [&] { return stop_ || epoch_ != seen_epoch; });
+        if (stop_) return;
+        seen_epoch = epoch_;
+        fn = fn_;
+        total = total_;
+      }
+      for (;;) {
+        size_t i = next_.fetch_add(1);
+        if (i >= total) break;
+        (*fn)(i);
+        if (done_.fetch_add(1) + 1 == total) {
+          std::lock_guard<std::mutex> lk(mu_);
+          done_cv_.notify_all();
+        }
+      }
+    }
+  }
+
+  std::vector<std::thread> workers_;
+  std::mutex mu_;
+  std::condition_variable cv_, done_cv_;
+  const std::function<void(size_t)>* fn_ = nullptr;
+  std::atomic<size_t> next_{0}, done_{0};
+  size_t total_ = 0;
+  uint64_t epoch_ = 0;
+  bool stop_;
+};
+
+WorkerPool* pool(int nthreads) {
+  static WorkerPool* p = new WorkerPool(
+      std::max(2, std::min(nthreads > 0 ? nthreads : 8,
+                           (int)std::thread::hardware_concurrency())));
+  return p;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Gather n sample buffers (srcs[i], bytes_per each) into dst, in parallel.
+void pt_collate(void* dst, void** srcs, size_t n, size_t bytes_per,
+                int nthreads) {
+  char* out = static_cast<char*>(dst);
+  if (n * bytes_per < (8u << 20)) {  // small batch: threads cost more
+    for (size_t i = 0; i < n; ++i)
+      std::memcpy(out + i * bytes_per, srcs[i], bytes_per);
+    return;
+  }
+  // one contiguous range per task (per-item dispatch drowns in coordination)
+  size_t n_tasks = 8;
+  size_t per = (n + n_tasks - 1) / n_tasks;
+  pool(nthreads)->parallel_for(n_tasks, [&](size_t t) {
+    size_t lo = t * per, hi = std::min(n, lo + per);
+    for (size_t i = lo; i < hi; ++i)
+      std::memcpy(out + i * bytes_per, srcs[i], bytes_per);
+  });
+}
+
+}  // extern "C"
