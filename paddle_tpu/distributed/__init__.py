@@ -64,3 +64,23 @@ from . import fleet
 from . import sharding
 from .ring_attention import ring_flash_attention, ulysses_attention
 from . import checkpoint
+from . import auto_parallel
+from .auto_parallel import (
+    DistModel,
+    Partial,
+    ProcessMesh,
+    Replicate,
+    Shard,
+    dtensor_from_fn,
+    reshard,
+    shard_layer,
+    shard_optimizer,
+    shard_tensor,
+    unshard_dtensor,
+)
+
+__all__ += [
+    "ProcessMesh", "Shard", "Replicate", "Partial", "shard_tensor",
+    "dtensor_from_fn", "reshard", "shard_layer", "shard_optimizer",
+    "unshard_dtensor", "DistModel",
+]

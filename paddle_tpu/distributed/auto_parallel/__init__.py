@@ -1,0 +1,39 @@
+"""paddle.distributed.auto_parallel parity (semi-auto parallel API).
+
+Reference: python/paddle/distributed/auto_parallel/ (U) — ProcessMesh,
+shard_tensor with Shard/Replicate/Partial placements, reshard, shard_layer,
+shard_optimizer, and the static Engine (SURVEY.md §2.2 P23, ~80k LoC of
+completion/partition/reshard passes).
+
+TPU-native design: the reference implements its own SPMD propagation
+(completion pass), partitioner, and reshard pass because it must rewrite a
+serialized Program. Under XLA *GSPMD is that whole pipeline*: placements
+lower to a `NamedSharding` on the backing `jax.Array`, op-level propagation
+is done by the compiler, and `reshard` is a `device_put` that XLA turns into
+the minimal collective. `Partial` — which the reference tracks as a
+first-class placement — is realized here at the API boundary (a partial
+tensor materializes the unreduced addends; `reshard` to Replicate emits the
+psum), since inside jit XLA manages partial values internally.
+"""
+
+from .placement import Partial, Placement, Replicate, Shard
+from .process_mesh import ProcessMesh
+from .api import (
+    DistModel,
+    dtensor_from_fn,
+    get_placements,
+    get_process_mesh,
+    reshard,
+    shard_layer,
+    shard_optimizer,
+    shard_tensor,
+    to_static,
+    unshard_dtensor,
+)
+
+__all__ = [
+    "ProcessMesh", "Placement", "Shard", "Replicate", "Partial",
+    "shard_tensor", "dtensor_from_fn", "reshard", "shard_layer",
+    "shard_optimizer", "to_static", "DistModel", "get_placements",
+    "get_process_mesh", "unshard_dtensor",
+]

@@ -1,0 +1,253 @@
+"""Semi-auto-parallel API: shard_tensor / reshard / shard_layer / shard_optimizer.
+
+Reference parity: python/paddle/distributed/auto_parallel/api.py (U). The
+reference routes these through dist_tensor C++ bindings plus program passes;
+here every entry point is a `jax.device_put` with a `NamedSharding` — GSPMD
+does propagation, partitioning, and reshard-collective insertion.
+
+Partial semantics note: eagerly (outside jit) a `jax.Array` cannot hold a
+different addend per mesh coordinate, so a Partial dist-tensor stores the
+*logical total* and partial-ness as metadata; `reshard(..., Replicate())`
+materializes the reduction result ("avg" divides by the partial axis size,
+matching the reference's r_to_p + reduce pipeline). Inside jit, XLA tracks
+true per-device partial values on its own.
+"""
+
+from __future__ import annotations
+
+import weakref
+
+import jax
+import numpy as np
+
+from ...core.tensor import Tensor
+from .placement import Partial, Placement, Replicate, Shard, named_sharding, spec_to_placements
+from .process_mesh import ProcessMesh
+
+# id(tensor) -> (ProcessMesh, tuple(placements)); entries die with the tensor
+_DIST_ATTRS: dict = {}
+
+
+def _record(t, mesh, placements):
+    key = id(t)
+    _DIST_ATTRS[key] = (mesh, tuple(placements))
+    weakref.finalize(t, _DIST_ATTRS.pop, key, None)
+
+
+def _as_tensor(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def get_process_mesh(t):
+    """The ProcessMesh a dist tensor lives on (derived from its jax sharding
+    if it was produced by sharding propagation rather than shard_tensor)."""
+    rec = _DIST_ATTRS.get(id(t))
+    if rec is not None:
+        return rec[0]
+    sh = getattr(t._data, "sharding", None)
+    if sh is not None and hasattr(sh, "mesh") and sh.mesh.axis_names:
+        return ProcessMesh.from_jax(sh.mesh)
+    return None
+
+
+def get_placements(t):
+    """Per-mesh-dim placements of a dist tensor (paddle `Tensor.placements`)."""
+    rec = _DIST_ATTRS.get(id(t))
+    if rec is not None:
+        return list(rec[1])
+    sh = getattr(t._data, "sharding", None)
+    if sh is None or not hasattr(sh, "spec"):
+        return None
+    return spec_to_placements(sh.spec, sh.mesh.axis_names, t._data.ndim)
+
+
+def shard_tensor(data, mesh, placements, dtype=None, place=None, stop_gradient=None):
+    """Place `data` on `mesh` according to `placements` (one per mesh dim)."""
+    t = _as_tensor(data)
+    if not isinstance(mesh, ProcessMesh):
+        raise TypeError(f"mesh must be a ProcessMesh, got {type(mesh)}")
+    if len(placements) != mesh.ndim:
+        raise ValueError(
+            f"{len(placements)} placements for a {mesh.ndim}-d mesh")
+    for p in placements:
+        if not isinstance(p, Placement):
+            raise TypeError(f"placements must be Placement objects, got {p!r}")
+    sharding = named_sharding(mesh, placements, t._data.ndim)
+    out = Tensor(jax.device_put(t._data, sharding),
+                 stop_gradient=t.stop_gradient if stop_gradient is None else stop_gradient)
+    _record(out, mesh, placements)
+    return out
+
+
+def dtensor_from_fn(fn, mesh, placements, *args, **kwargs):
+    """Build a tensor with `fn(*args, **kwargs)` and shard it (paddle parity:
+    used to materialize large params directly with a distributed layout)."""
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def reshard(dist_tensor, mesh, placements):
+    """Move a dist tensor to a new placement (XLA inserts the collective)."""
+    t = _as_tensor(dist_tensor)
+    cur = get_placements(t) or []
+    data = t._data
+    partial_dims = [i for i, p in enumerate(cur) if isinstance(p, Partial)]
+    if partial_dims:
+        src_mesh = get_process_mesh(t)
+        for i in partial_dims:
+            if i < len(placements) and isinstance(placements[i], Partial):
+                continue  # stays partial on this dim
+            if cur[i].reduce_type == "avg":
+                data = data / src_mesh.shape[i]
+    sharding = named_sharding(mesh, placements, data.ndim)
+    out = Tensor(jax.device_put(data, sharding), stop_gradient=t.stop_gradient)
+    _record(out, mesh, placements)
+    return out
+
+
+def unshard_dtensor(dist_tensor):
+    """Gather to a fully-replicated local tensor (paddle parity)."""
+    t = _as_tensor(dist_tensor)
+    mesh = get_process_mesh(t)
+    if mesh is None:
+        return t
+    return reshard(t, mesh, [Replicate()] * mesh.ndim)
+
+
+# ------------------------------------------------------------------ layers
+
+def shard_layer(layer, process_mesh, shard_fn=None, input_fn=None, output_fn=None):
+    """Shard every parameter of `layer` onto `process_mesh`.
+
+    shard_fn(sublayer_name, sublayer, process_mesh) may call shard_tensor on
+    the sublayer's params; params it leaves alone are replicated (reference
+    default). input_fn/output_fn hook the layer boundary (e.g. to shard the
+    batch in and gather logits out).
+    """
+    if not isinstance(process_mesh, ProcessMesh):
+        raise TypeError("process_mesh must be a ProcessMesh")
+
+    def _replicate_param(p):
+        if _DIST_ATTRS.get(id(p)) is None:
+            placements = [Replicate()] * process_mesh.ndim
+            sharding = named_sharding(process_mesh, placements, p._data.ndim)
+            p._data = jax.device_put(p._data, sharding)
+            _record(p, process_mesh, placements)
+
+    for name, sub in layer.named_sublayers(include_self=True):
+        if shard_fn is not None:
+            shard_fn(name, sub, process_mesh)
+    for _, p in layer.named_parameters():
+        _replicate_param(p)
+
+    if input_fn is not None:
+        layer.register_forward_pre_hook(
+            lambda lyr, inputs: input_fn(inputs, process_mesh))
+    if output_fn is not None:
+        layer.register_forward_post_hook(
+            lambda lyr, inputs, outputs: output_fn(outputs, process_mesh))
+    return layer
+
+
+def shard_parameter(param, mesh, placements):
+    """In-place placement of an existing Parameter (keeps identity so the
+    optimizer's id-keyed accumulators still match)."""
+    sharding = named_sharding(mesh, placements, param._data.ndim)
+    param._data = jax.device_put(param._data, sharding)
+    _record(param, mesh, placements)
+    return param
+
+
+# ------------------------------------------------------------------ optimizer
+
+class _ShardOptimizer:
+    """paddle.distributed.shard_optimizer result: the wrapped optimizer, with
+    accumulator state placed like its parameter (or per a custom shard_fn —
+    the hook the reference uses for ZeRO-style optimizer-state sharding)."""
+
+    def __init__(self, optimizer, shard_fn=None):
+        self._inner_opt = optimizer
+        self._shard_fn = shard_fn
+
+    def _place_state(self, p, state):
+        placed = {}
+        for k, v in state.items():
+            if self._shard_fn is not None:
+                placed[k] = self._shard_fn(k, p, v)
+            elif getattr(v, "ndim", 0) == getattr(p._data, "ndim", -1) and v.shape == p._data.shape:
+                placed[k] = jax.device_put(v, p._data.sharding)
+            else:
+                placed[k] = v
+        return placed
+
+    def _state_for(self, p):
+        opt = self._inner_opt
+        st = opt._accumulators.get(id(p))
+        if st is None:
+            st = self._place_state(p, opt._init_state(p))
+            opt._accumulators[id(p)] = st
+        return st
+
+    def __getattr__(self, name):
+        return getattr(self._inner_opt, name)
+
+    def __setattr__(self, name, value):
+        if name in ("_inner_opt", "_shard_fn"):
+            object.__setattr__(self, name, value)
+        else:
+            setattr(self._inner_opt, name, value)
+
+
+def shard_optimizer(optimizer, shard_fn=None):
+    return _ShardOptimizer(optimizer, shard_fn)
+
+
+# ------------------------------------------------------------------ to_static
+
+class DistModel:
+    """paddle.distributed.to_static result: a compiled distributed train step.
+
+    Reference: the static auto-parallel Engine (completion→partition→reshard
+    over a Program). Here: paddle_tpu.jit.TrainStep jitted under the mesh —
+    GSPMD performs all three passes during XLA compilation.
+    """
+
+    def __init__(self, layer, loader=None, loss=None, optimizer=None, strategy=None):
+        self.network = layer
+        self._loader = loader
+        self._loss = loss
+        self._optimizer = optimizer
+        self._mode = "train"
+        self._step = None
+
+    def train(self):
+        self._mode = "train"
+        self.network.train()
+
+    def eval(self):
+        self._mode = "eval"
+        self.network.eval()
+
+    def _loss_fn(self, net, *batch):
+        *inputs, label = batch
+        out = net(*inputs)
+        loss = self._loss(out, label)
+        return loss
+
+    def __call__(self, *batch):
+        from ...jit.train_step import TrainStep
+
+        batch = [_as_tensor(b) for b in batch]
+        if self._mode == "train" and self._optimizer is not None:
+            if self._step is None:
+                self._step = TrainStep(self.network, self._loss_fn,
+                                       self._optimizer)
+            return self._step(*batch)
+        *inputs, label = batch
+        out = self.network(*inputs)
+        return self._loss(out, label) if self._loss is not None else out
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None):
+    if isinstance(optimizer, _ShardOptimizer) is False and optimizer is not None:
+        optimizer = shard_optimizer(optimizer)
+    return DistModel(layer, loader, loss, optimizer, strategy)

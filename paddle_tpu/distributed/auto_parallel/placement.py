@@ -1,0 +1,120 @@
+"""Placement types and conversion to/from jax PartitionSpec.
+
+Reference parity: paddle.distributed.{Shard,Replicate,Partial}
+(python/paddle/distributed/auto_parallel/placement_type.py (U)). A placements
+list has one entry per *mesh dimension*: `placements[i]` says what mesh dim i
+does to the tensor (shard a tensor dim / replicate / hold partial sums).
+"""
+
+from __future__ import annotations
+
+from jax.sharding import NamedSharding, PartitionSpec
+
+
+class Placement:
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicated(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+
+class Replicate(Placement):
+    def is_replicated(self):
+        return True
+
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("Replicate")
+
+
+class Shard(Placement):
+    def __init__(self, dim):
+        self.dim = int(dim)
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+    def get_dim(self):
+        return self.dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("Shard", self.dim))
+
+
+class Partial(Placement):
+    """Pending-reduction placement. reduce_type: "sum" | "avg" | "max" | "min"."""
+
+    def __init__(self, reduce_type="sum"):
+        self.reduce_type = reduce_type
+
+    def is_partial(self):
+        return True
+
+    def __repr__(self):
+        return f"Partial(reduce_type={self.reduce_type})"
+
+    def __eq__(self, other):
+        return isinstance(other, Partial) and other.reduce_type == self.reduce_type
+
+    def __hash__(self):
+        return hash(("Partial", self.reduce_type))
+
+
+def placements_to_spec(placements, mesh_dim_names, tensor_ndim):
+    """[per-mesh-dim placements] -> PartitionSpec (per-tensor-dim axis names).
+
+    Partial contributes no sharding at the SPMD level (the unreduced value is
+    replicated per mesh coordinate); callers track partial-ness separately.
+    """
+    per_dim = [[] for _ in range(tensor_ndim)]
+    for mesh_dim, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            if pl.dim >= tensor_ndim:
+                raise ValueError(
+                    f"Shard(dim={pl.dim}) out of range for ndim={tensor_ndim}")
+            per_dim[pl.dim].append(mesh_dim_names[mesh_dim])
+    entries = []
+    for axes in per_dim:
+        if not axes:
+            entries.append(None)
+        elif len(axes) == 1:
+            entries.append(axes[0])
+        else:
+            entries.append(tuple(axes))
+    while entries and entries[-1] is None:
+        entries.pop()
+    return PartitionSpec(*entries)
+
+
+def spec_to_placements(spec, mesh_dim_names, tensor_ndim):
+    """PartitionSpec -> per-mesh-dim placements list (inverse of the above)."""
+    placements = [Replicate() for _ in mesh_dim_names]
+    entries = tuple(spec) if spec is not None else ()
+    for tensor_dim, entry in enumerate(entries):
+        if entry is None:
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        for ax in axes:
+            placements[list(mesh_dim_names).index(ax)] = Shard(tensor_dim)
+    return placements
+
+
+def named_sharding(process_mesh, placements, tensor_ndim):
+    jmesh = process_mesh.jax_mesh()
+    spec = placements_to_spec(placements, jmesh.axis_names, tensor_ndim)
+    return NamedSharding(jmesh, spec)

@@ -1,0 +1,131 @@
+"""ProcessMesh: the auto-parallel device grid.
+
+Reference parity: python/paddle/distributed/auto_parallel/process_mesh.py (U).
+There a ProcessMesh is an N-d array of *process ranks* used by the
+completion/partition passes; here it is a thin, hashable description that
+lowers to a `jax.sharding.Mesh` over the matching jax devices — all placement
+math then rides GSPMD.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _default_dim_names(ndim):
+    return [f"d{i}" for i in range(ndim)]
+
+
+class ProcessMesh:
+    def __init__(self, mesh=None, dim_names=None, shape=None, process_ids=None):
+        if mesh is not None:
+            arr = np.asarray(mesh, dtype=np.int64)
+        else:
+            if shape is None or process_ids is None:
+                raise ValueError("give either `mesh` or (`shape`,`process_ids`)")
+            arr = np.asarray(process_ids, dtype=np.int64).reshape(shape)
+        self._mesh = arr
+        self._dim_names = list(dim_names) if dim_names else _default_dim_names(arr.ndim)
+        if len(self._dim_names) != arr.ndim:
+            raise ValueError(
+                f"{len(self._dim_names)} dim_names for a {arr.ndim}-d mesh")
+        self._jax_mesh = None
+
+    # ---------------- reference API surface ----------------
+    @property
+    def shape(self):
+        return list(self._mesh.shape)
+
+    @property
+    def ndim(self):
+        return self._mesh.ndim
+
+    @property
+    def process_ids(self):
+        return self._mesh.flatten().tolist()
+
+    @property
+    def dim_names(self):
+        return list(self._dim_names)
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    def get_dim_size(self, dim_name):
+        return self._mesh.shape[self._dim_names.index(dim_name)]
+
+    def get_rank_by_dim_and_process_id(self, dim_name, process_id):
+        axis = self._dim_names.index(dim_name)
+        coords = np.argwhere(self._mesh == process_id)
+        if coords.size == 0:
+            return -1
+        return int(coords[0][axis])
+
+    def __getitem__(self, index):
+        sub = self._mesh[index]
+        if sub.ndim == 0:
+            sub = sub.reshape(1)
+            return ProcessMesh(sub, dim_names=[self._dim_names[-1]])
+        # dims consumed by integer indexing lose their names
+        if isinstance(index, tuple):
+            dropped = sum(1 for i in index if isinstance(i, int))
+        else:
+            dropped = 1 if isinstance(index, int) else 0
+        return ProcessMesh(sub, dim_names=self._dim_names[dropped:])
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and np.array_equal(self._mesh, other._mesh)
+                and self._dim_names == other._dim_names)
+
+    def __hash__(self):
+        return hash((self._mesh.tobytes(), tuple(self._dim_names)))
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dim_names={self._dim_names})"
+
+    # ---------------- TPU lowering ----------------
+    def jax_mesh(self):
+        """The jax.sharding.Mesh this ProcessMesh denotes.
+
+        Process ids index `jax.devices()` — on a multi-host slice those are
+        global device ids, so the same ProcessMesh literal works on every
+        host (SPMD single-program contract).
+        """
+        if self._jax_mesh is None:
+            import jax
+            from jax.sharding import Mesh
+
+            devices = jax.devices()
+            if len(devices) < self._mesh.size:
+                # fall back to the virtual CPU platform (tests / dry runs)
+                cpu = jax.devices("cpu")
+                if len(cpu) >= self._mesh.size:
+                    devices = cpu
+                else:
+                    raise RuntimeError(
+                        f"ProcessMesh needs {self._mesh.size} devices, have "
+                        f"{len(devices)}")
+            dev_arr = np.empty(self._mesh.shape, dtype=object)
+            for coord in np.ndindex(self._mesh.shape):
+                dev_arr[coord] = devices[int(self._mesh[coord])]
+            self._jax_mesh = Mesh(dev_arr, tuple(self._dim_names))
+        return self._jax_mesh
+
+    @classmethod
+    def from_jax(cls, jmesh):
+        ids = np.vectorize(lambda d: d.id)(jmesh.devices)
+        return cls(ids, dim_names=list(jmesh.axis_names))
+
+
+_GLOBAL_MESH = None
+
+
+def set_mesh(mesh):
+    global _GLOBAL_MESH
+    _GLOBAL_MESH = mesh
+
+
+def get_mesh():
+    return _GLOBAL_MESH

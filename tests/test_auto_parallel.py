@@ -1,0 +1,231 @@
+"""Semi-auto parallel API (paddle.distributed.auto_parallel parity — SURVEY.md
+P23) on the 8-device virtual CPU mesh."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import (
+    Partial, ProcessMesh, Replicate, Shard,
+    dtensor_from_fn, reshard, shard_layer, shard_optimizer, shard_tensor,
+    unshard_dtensor,
+)
+from paddle_tpu.distributed.auto_parallel.api import get_placements, get_process_mesh
+
+
+def make_mesh():
+    return ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]], dim_names=["x", "y"])
+
+
+class TestProcessMesh:
+    def test_shape_and_names(self):
+        m = make_mesh()
+        assert m.shape == [2, 4]
+        assert m.dim_names == ["x", "y"]
+        assert m.process_ids == list(range(8))
+        assert m.get_dim_size("y") == 4
+
+    def test_jax_mesh(self):
+        jm = make_mesh().jax_mesh()
+        assert jm.axis_names == ("x", "y")
+        assert jm.devices.shape == (2, 4)
+
+    def test_submesh(self):
+        m = make_mesh()
+        sub = m[0]
+        assert sub.shape == [4]
+        assert sub.dim_names == ["y"]
+
+    def test_eq_hash(self):
+        assert make_mesh() == make_mesh()
+        assert hash(make_mesh()) == hash(make_mesh())
+
+
+class TestShardTensor:
+    def test_shard_rows(self):
+        m = make_mesh()
+        x = paddle.to_tensor(np.arange(32, dtype=np.float32).reshape(8, 4))
+        d = shard_tensor(x, m, [Shard(0), Replicate()])
+        # each of the 2 x-coordinate groups holds half the rows
+        shard_shapes = {s.data.shape for s in d._data.addressable_shards}
+        assert shard_shapes == {(4, 4)}
+        np.testing.assert_array_equal(np.asarray(d._data), np.asarray(x._data))
+        assert get_placements(d)[0] == Shard(0)
+        assert get_process_mesh(d) == m
+
+    def test_shard_both_axes(self):
+        m = make_mesh()
+        x = paddle.to_tensor(np.arange(64, dtype=np.float32).reshape(8, 8))
+        d = shard_tensor(x, m, [Shard(0), Shard(1)])
+        assert {s.data.shape for s in d._data.addressable_shards} == {(4, 2)}
+
+    def test_bad_placement_count(self):
+        with pytest.raises(ValueError):
+            shard_tensor(paddle.ones([4]), make_mesh(), [Replicate()])
+
+    def test_dtensor_from_fn(self):
+        m = make_mesh()
+        d = dtensor_from_fn(paddle.ones, m, [Replicate(), Shard(0)], [8, 2])
+        assert d.shape == [8, 2]
+        assert {s.data.shape for s in d._data.addressable_shards} == {(2, 2)}
+
+
+class TestReshard:
+    def test_shard_to_replicate(self):
+        m = make_mesh()
+        x = paddle.to_tensor(np.random.rand(8, 4).astype(np.float32))
+        d = shard_tensor(x, m, [Shard(0), Replicate()])
+        r = reshard(d, m, [Replicate(), Replicate()])
+        assert {s.data.shape for s in r._data.addressable_shards} == {(8, 4)}
+        np.testing.assert_allclose(np.asarray(r._data), np.asarray(x._data))
+
+    def test_replicate_to_shard(self):
+        m = make_mesh()
+        x = paddle.to_tensor(np.random.rand(8, 4).astype(np.float32))
+        d = shard_tensor(x, m, [Replicate(), Replicate()])
+        r = reshard(d, m, [Replicate(), Shard(1)])
+        assert {s.data.shape for s in r._data.addressable_shards} == {(8, 1)}
+
+    def test_partial_sum_to_replicate(self):
+        m = make_mesh()
+        x = paddle.to_tensor(np.full((4, 4), 6.0, np.float32))
+        d = shard_tensor(x, m, [Partial(), Replicate()])
+        assert get_placements(d)[0].is_partial()
+        r = reshard(d, m, [Replicate(), Replicate()])
+        np.testing.assert_allclose(np.asarray(r._data), 6.0)
+
+    def test_partial_avg(self):
+        m = make_mesh()
+        x = paddle.to_tensor(np.full((4,), 8.0, np.float32))
+        d = shard_tensor(x, m, [Partial("avg"), Replicate()])
+        r = reshard(d, m, [Replicate(), Replicate()])
+        np.testing.assert_allclose(np.asarray(r._data), 4.0)  # /mesh dim size 2
+
+    def test_unshard(self):
+        m = make_mesh()
+        x = paddle.to_tensor(np.random.rand(8, 4).astype(np.float32))
+        d = shard_tensor(x, m, [Shard(0), Shard(1)])
+        u = unshard_dtensor(d)
+        np.testing.assert_allclose(np.asarray(u._data), np.asarray(x._data))
+
+
+class TestShardLayer:
+    def test_default_replicates(self):
+        m = make_mesh()
+        layer = nn.Linear(8, 8)
+        shard_layer(layer, m)
+        for _, p in layer.named_parameters():
+            assert get_placements(p) == [Replicate(), Replicate()]
+
+    def test_custom_shard_fn(self):
+        m = make_mesh()
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 8))
+
+        def shard_fn(name, sub, mesh):
+            if isinstance(sub, nn.Linear):
+                from paddle_tpu.distributed.auto_parallel.api import shard_parameter
+                shard_parameter(sub.weight, mesh, [Replicate(), Shard(1)])
+
+        shard_layer(net, m, shard_fn)
+        w0 = net[0].weight
+        assert get_placements(w0)[1] == Shard(1)
+        # forward still numerically identical to unsharded
+        x = paddle.to_tensor(np.random.rand(4, 8).astype(np.float32))
+        y = net(x)
+        assert y.shape == [4, 8]
+
+    def test_sharded_forward_parity(self):
+        m = make_mesh()
+        paddle.seed(7)
+        net = nn.Sequential(nn.Linear(8, 32), nn.GELU(), nn.Linear(32, 4))
+        x = paddle.to_tensor(np.random.rand(16, 8).astype(np.float32))
+        ref = np.asarray(net(x)._data)
+
+        def shard_fn(name, sub, mesh):
+            if isinstance(sub, nn.Linear):
+                from paddle_tpu.distributed.auto_parallel.api import shard_parameter
+                shard_parameter(sub.weight, mesh, [Replicate(), Shard(1)])
+
+        shard_layer(net, m, shard_fn)
+        out = np.asarray(net(x)._data)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+class TestShardOptimizer:
+    def test_states_follow_param_sharding(self):
+        m = make_mesh()
+        paddle.seed(0)
+        layer = nn.Linear(8, 16)
+        from paddle_tpu.distributed.auto_parallel.api import shard_parameter
+        shard_parameter(layer.weight, m, [Replicate(), Shard(1)])
+        opt = shard_optimizer(paddle.optimizer.AdamW(
+            learning_rate=1e-3, parameters=layer.parameters()))
+        st = opt._state_for(layer.weight)
+        assert st["moment1"].sharding == layer.weight._data.sharding
+
+    def test_training_parity_with_serial(self):
+        m = make_mesh()
+
+        def build():
+            paddle.seed(3)
+            net = nn.Sequential(nn.Linear(8, 32), nn.Tanh(), nn.Linear(32, 1))
+            opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                         parameters=net.parameters())
+            return net, opt
+
+        rng = np.random.RandomState(0)
+        X = rng.rand(64, 8).astype(np.float32)
+        Y = X.sum(-1, keepdims=True).astype(np.float32)
+
+        def run(net, opt, steps=5):
+            losses = []
+            for _ in range(steps):
+                loss = nn.functional.mse_loss(net(paddle.to_tensor(X)),
+                                              paddle.to_tensor(Y))
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                losses.append(float(loss._data))
+            return losses
+
+        net_s, opt_s = build()
+        serial = run(net_s, opt_s)
+
+        net_d, opt_d = build()
+
+        def shard_fn(name, sub, mesh):
+            if isinstance(sub, nn.Linear) and sub.weight.shape[1] % mesh.get_dim_size("y") == 0:
+                from paddle_tpu.distributed.auto_parallel.api import shard_parameter
+                shard_parameter(sub.weight, mesh, [Replicate(), Shard(1)])
+
+        shard_layer(net_d, m, shard_fn)
+        dist_losses = run(net_d, shard_optimizer(opt_d))
+        np.testing.assert_allclose(dist_losses, serial, rtol=1e-4, atol=1e-6)
+
+
+class TestDistModel:
+    def test_to_static_train_loop(self):
+        m = make_mesh()
+        paddle.seed(11)
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+        shard_layer(net, m)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                     parameters=net.parameters())
+        model = dist.auto_parallel.to_static(
+            net, loss=nn.functional.mse_loss, optimizer=opt)
+        model.train()
+        rng = np.random.RandomState(1)
+        X = rng.rand(32, 8).astype(np.float32)
+        Y = X.sum(-1, keepdims=True).astype(np.float32)
+        first = float(model(paddle.to_tensor(X), paddle.to_tensor(Y))._data)
+        for _ in range(20):
+            last = float(model(paddle.to_tensor(X), paddle.to_tensor(Y))._data)
+        assert last < first * 0.5
+        model.eval()
+        eval_loss = model(paddle.to_tensor(X), paddle.to_tensor(Y))
+        assert float(eval_loss._data) > 0
