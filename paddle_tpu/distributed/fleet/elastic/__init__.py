@@ -1,0 +1,9 @@
+from .manager import (
+    ElasticManager, ElasticStatus,
+    MembershipStore, FileMembershipStore, LocalMembershipStore,
+)
+
+__all__ = [
+    "ElasticManager", "ElasticStatus",
+    "MembershipStore", "FileMembershipStore", "LocalMembershipStore",
+]

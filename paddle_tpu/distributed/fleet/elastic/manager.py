@@ -1,0 +1,203 @@
+"""Elastic training manager (ref: fleet/elastic/manager.py (U)).
+
+The reference watches an etcd prefix for node join/leave and relaunches the
+trainer with a new world size. The TPU rebuild keeps the same state machine
+(HOLD/COMPLETED/RESTART/EXIT) but swaps etcd for a pluggable membership
+store: a shared-filesystem heartbeat directory (works on any TPU pod slice,
+where /tmp or NFS is shared per-host) or an in-memory store for tests.
+Recovery is checkpoint-autoresume: on membership change the manager asks the
+launcher to relaunch the script; the training loop resumes from the latest
+sharded checkpoint (distributed/checkpoint reshard-on-load handles a changed
+device count).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+import time
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class MembershipStore:
+    """Abstract membership store: register heartbeats, list live nodes."""
+
+    def register(self, node_id: str, meta: dict):
+        raise NotImplementedError
+
+    def heartbeat(self, node_id: str):
+        raise NotImplementedError
+
+    def deregister(self, node_id: str):
+        raise NotImplementedError
+
+    def live_nodes(self, ttl: float) -> dict:
+        raise NotImplementedError
+
+
+class FileMembershipStore(MembershipStore):
+    """Heartbeat files under a shared directory — one JSON file per node."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, node_id):
+        return os.path.join(self.root, f"node.{node_id}.json")
+
+    def register(self, node_id, meta):
+        with open(self._path(node_id), "w") as f:
+            json.dump({"meta": meta, "ts": time.time()}, f)
+
+    def heartbeat(self, node_id):
+        p = self._path(node_id)
+        try:
+            with open(p) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            rec = {"meta": {}}
+        rec["ts"] = time.time()
+        with open(p, "w") as f:
+            json.dump(rec, f)
+
+    def deregister(self, node_id):
+        try:
+            os.unlink(self._path(node_id))
+        except OSError:
+            pass
+
+    def live_nodes(self, ttl):
+        now = time.time()
+        out = {}
+        for fn in os.listdir(self.root):
+            if not (fn.startswith("node.") and fn.endswith(".json")):
+                continue
+            try:
+                with open(os.path.join(self.root, fn)) as f:
+                    rec = json.load(f)
+            except (OSError, ValueError):
+                continue
+            if now - rec.get("ts", 0) <= ttl:
+                out[fn[len("node."):-len(".json")]] = rec.get("meta", {})
+        return out
+
+
+class LocalMembershipStore(MembershipStore):
+    """In-process store for unit tests."""
+
+    def __init__(self):
+        self._nodes = {}
+        self._lock = threading.Lock()
+
+    def register(self, node_id, meta):
+        with self._lock:
+            self._nodes[node_id] = (meta, time.time())
+
+    def heartbeat(self, node_id):
+        with self._lock:
+            if node_id in self._nodes:
+                meta, _ = self._nodes[node_id]
+                self._nodes[node_id] = (meta, time.time())
+
+    def deregister(self, node_id):
+        with self._lock:
+            self._nodes.pop(node_id, None)
+
+    def live_nodes(self, ttl):
+        now = time.time()
+        with self._lock:
+            return {k: m for k, (m, ts) in self._nodes.items()
+                    if now - ts <= ttl}
+
+
+class ElasticManager:
+    """Watches cluster membership; decides HOLD / RESTART / EXIT.
+
+    Paddle semantics kept: `np` may be a fixed int or an "min:max" elastic
+    range; below min → HOLD (wait for nodes), change within range → RESTART
+    with the new world size, above max → extra nodes told to EXIT.
+    """
+
+    def __init__(self, node_id=None, np="1", store=None, heartbeat_interval=1.0,
+                 ttl=None):
+        self.node_id = node_id or os.getenv("PADDLE_TRAINER_ID", "0")
+        lo, _, hi = str(np).partition(":")
+        self.min_np = int(lo)
+        self.max_np = int(hi) if hi else self.min_np
+        self.elastic = self.max_np > self.min_np
+        self.store = store if store is not None else FileMembershipStore(
+            os.getenv("PADDLE_ELASTIC_DIR", "/tmp/paddle_tpu_elastic"))
+        self.interval = heartbeat_interval
+        self.ttl = ttl if ttl is not None else 3 * heartbeat_interval
+        self._stop = threading.Event()
+        self._thread = None
+        self._world = None  # membership snapshot at enter()
+
+    # ------------------------------------------------------------- lifecycle
+    def enter(self, meta=None):
+        self.store.register(self.node_id, meta or {})
+        self._thread = threading.Thread(target=self._beat, daemon=True)
+        self._thread.start()
+        self._world = sorted(self.store.live_nodes(self.ttl))
+        return self
+
+    def exit(self, completed=True):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2 * self.interval)
+        self.store.deregister(self.node_id)
+
+    def _beat(self):
+        while not self._stop.wait(self.interval):
+            self.store.heartbeat(self.node_id)
+
+    # --------------------------------------------------------------- policy
+    def poll(self):
+        """One membership check → an ElasticStatus decision."""
+        live = sorted(self.store.live_nodes(self.ttl))
+        n = len(live)
+        if n < self.min_np:
+            return ElasticStatus.HOLD
+        if n > self.max_np:
+            # deterministic trim: highest-sorted extras exit
+            if self.node_id in live[self.max_np:]:
+                return ElasticStatus.EXIT
+            live = live[:self.max_np]
+            n = self.max_np
+        if live != self._world:
+            self._world = live
+            return ElasticStatus.RESTART
+        return ElasticStatus.COMPLETED
+
+    def watch(self, timeout=None, on_restart=None):
+        """Block until a scale event (or timeout). Returns final status."""
+        t0 = time.time()
+        while True:
+            st = self.poll()
+            if st == ElasticStatus.RESTART and on_restart is not None:
+                on_restart(self.world_size())
+            if st in (ElasticStatus.RESTART, ElasticStatus.EXIT):
+                return st
+            if timeout is not None and time.time() - t0 >= timeout:
+                return st
+            time.sleep(self.interval)
+
+    def world_size(self):
+        return len(self._world or [])
+
+    def signal_handler(self, sig=signal.SIGTERM):
+        """Install a handler that deregisters on SIGTERM (preemption)."""
+        def h(signum, frame):
+            self.exit(completed=False)
+            raise SystemExit(128 + signum)
+
+        signal.signal(sig, h)

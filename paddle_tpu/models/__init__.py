@@ -5,6 +5,11 @@ and the diffusion UNet."""
 from . import gpt
 from . import bert
 from . import unet
+from . import llama
 from .gpt import GPTConfig, GPTModel, GPTForCausalLM, ERNIE_7B, LLAMA2_13B
 from .bert import BertConfig, BertModel, BertForMaskedLM
 from .unet import UNetConfig, UNet2DConditionModel
+from .llama import (
+    LlamaConfig, LlamaModel, LlamaForCausalLM,
+    LLAMA2_7B, LLAMA3_8B,
+)

@@ -1,0 +1,39 @@
+"""LLaMA model family (BASELINE.md: LLaMA-2-13B stage-3+recompute config).
+
+The decoder recipe (pre-norm RMSNorm, RoPE, SwiGLU, optional GQA) is shared
+with the flagship implementation in models/gpt.py; this module gives it the
+LLaMA naming plus the standard config presets so users of the reference's
+ecosystem (PaddleNLP `LlamaForCausalLM`) find the same surface here.
+"""
+
+from .gpt import (
+    GPTConfig as LlamaConfig,
+    GPTAttention as LlamaAttention,
+    GPTMLP as LlamaMLP,
+    GPTDecoderLayer as LlamaDecoderLayer,
+    GPTModel as LlamaModel,
+    GPTForCausalLM as LlamaForCausalLM,
+)
+
+LLAMA2_7B = LlamaConfig(
+    vocab_size=32000, hidden_size=4096, intermediate_size=11008,
+    num_hidden_layers=32, num_attention_heads=32,
+    max_position_embeddings=4096,
+)
+LLAMA2_13B = LlamaConfig(
+    vocab_size=32000, hidden_size=5120, intermediate_size=13824,
+    num_hidden_layers=40, num_attention_heads=40,
+    max_position_embeddings=4096,
+)
+# LLaMA-3-style GQA preset (8 kv heads) — exercises the grouped-query path
+LLAMA3_8B = LlamaConfig(
+    vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+    num_hidden_layers=32, num_attention_heads=32, num_key_value_heads=8,
+    max_position_embeddings=8192, rope_theta=500000.0,
+)
+
+__all__ = [
+    "LlamaConfig", "LlamaAttention", "LlamaMLP", "LlamaDecoderLayer",
+    "LlamaModel", "LlamaForCausalLM",
+    "LLAMA2_7B", "LLAMA2_13B", "LLAMA3_8B",
+]

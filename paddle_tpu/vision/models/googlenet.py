@@ -1,0 +1,113 @@
+"""GoogLeNet / Inception-v1 (ref: python/paddle/vision/models/googlenet.py
+(U)). Aux classifiers are built but only used in training mode, matching
+the reference's (out, aux1, aux2) return convention."""
+
+from __future__ import annotations
+
+from ...nn.layer.layers import Layer
+from ...nn.layer import (
+    Conv2D, BatchNorm2D, ReLU, MaxPool2D, AdaptiveAvgPool2D, AvgPool2D,
+    Linear, Dropout, Sequential,
+)
+from ...tensor.manipulation import concat, flatten
+
+
+class ConvBNReLU(Layer):
+    def __init__(self, in_ch, out_ch, kernel, stride=1, padding=0):
+        super().__init__()
+        self.conv = Conv2D(in_ch, out_ch, kernel, stride=stride,
+                           padding=padding, bias_attr=False)
+        self.bn = BatchNorm2D(out_ch)
+        self.relu = ReLU()
+
+    def forward(self, x):
+        return self.relu(self.bn(self.conv(x)))
+
+
+class Inception(Layer):
+    def __init__(self, in_ch, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        self.branch1 = ConvBNReLU(in_ch, c1, 1)
+        self.branch2 = Sequential(ConvBNReLU(in_ch, c3r, 1),
+                                  ConvBNReLU(c3r, c3, 3, padding=1))
+        self.branch3 = Sequential(ConvBNReLU(in_ch, c5r, 1),
+                                  ConvBNReLU(c5r, c5, 5, padding=2))
+        self.branch4 = Sequential(MaxPool2D(kernel_size=3, stride=1, padding=1),
+                                  ConvBNReLU(in_ch, proj, 1))
+
+    def forward(self, x):
+        return concat([self.branch1(x), self.branch2(x), self.branch3(x),
+                       self.branch4(x)], axis=1)
+
+
+class InceptionAux(Layer):
+    def __init__(self, in_ch, num_classes):
+        super().__init__()
+        self.avgpool = AvgPool2D(kernel_size=5, stride=3)
+        self.conv = ConvBNReLU(in_ch, 128, 1)
+        self.fc1 = Linear(2048, 1024)
+        self.relu = ReLU()
+        self.dropout = Dropout(0.7)
+        self.fc2 = Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.conv(self.avgpool(x))
+        x = flatten(x, 1)
+        x = self.dropout(self.relu(self.fc1(x)))
+        return self.fc2(x)
+
+
+class GoogLeNet(Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        self.conv1 = ConvBNReLU(3, 64, 7, stride=2, padding=3)
+        self.pool1 = MaxPool2D(kernel_size=3, stride=2, padding=1)
+        self.conv2 = ConvBNReLU(64, 64, 1)
+        self.conv3 = ConvBNReLU(64, 192, 3, padding=1)
+        self.pool2 = MaxPool2D(kernel_size=3, stride=2, padding=1)
+
+        self.ince3a = Inception(192, 64, 96, 128, 16, 32, 32)
+        self.ince3b = Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = MaxPool2D(kernel_size=3, stride=2, padding=1)
+        self.ince4a = Inception(480, 192, 96, 208, 16, 48, 64)
+        self.ince4b = Inception(512, 160, 112, 224, 24, 64, 64)
+        self.ince4c = Inception(512, 128, 128, 256, 24, 64, 64)
+        self.ince4d = Inception(512, 112, 144, 288, 32, 64, 64)
+        self.ince4e = Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = MaxPool2D(kernel_size=3, stride=2, padding=1)
+        self.ince5a = Inception(832, 256, 160, 320, 32, 128, 128)
+        self.ince5b = Inception(832, 384, 192, 384, 48, 128, 128)
+
+        if num_classes > 0:
+            self.aux1 = InceptionAux(512, num_classes)
+            self.aux2 = InceptionAux(528, num_classes)
+            self.dropout = Dropout(0.4)
+            self.fc = Linear(1024, num_classes)
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D((1, 1))
+
+    def forward(self, x):
+        x = self.pool1(self.conv1(x))
+        x = self.pool2(self.conv3(self.conv2(x)))
+        x = self.ince3b(self.ince3a(x))
+        x = self.pool3(x)
+        x = self.ince4a(x)
+        aux1 = self.aux1(x) if self.training and self.num_classes > 0 else None
+        x = self.ince4d(self.ince4c(self.ince4b(x)))
+        aux2 = self.aux2(x) if self.training and self.num_classes > 0 else None
+        x = self.pool4(self.ince4e(x))
+        x = self.ince5b(self.ince5a(x))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(flatten(x, 1)))
+        return (x, aux1, aux2) if self.training and self.num_classes > 0 else x
+
+
+def googlenet(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights require network access")
+    return GoogLeNet(**kwargs)

@@ -1,0 +1,158 @@
+"""Inception-v3 (ref: python/paddle/vision/models/inceptionv3.py (U))."""
+
+from __future__ import annotations
+
+from ...nn.layer.layers import Layer
+from ...nn.layer import (
+    Conv2D, BatchNorm2D, ReLU, MaxPool2D, AvgPool2D, AdaptiveAvgPool2D,
+    Linear, Dropout, Sequential,
+)
+from ...tensor.manipulation import concat, flatten
+
+
+class ConvBNReLU(Layer):
+    def __init__(self, in_ch, out_ch, kernel, stride=1, padding=0):
+        super().__init__()
+        self.conv = Conv2D(in_ch, out_ch, kernel, stride=stride,
+                           padding=padding, bias_attr=False)
+        self.bn = BatchNorm2D(out_ch)
+        self.relu = ReLU()
+
+    def forward(self, x):
+        return self.relu(self.bn(self.conv(x)))
+
+
+class InceptionA(Layer):
+    def __init__(self, in_ch, pool_features):
+        super().__init__()
+        self.b1x1 = ConvBNReLU(in_ch, 64, 1)
+        self.b5x5 = Sequential(ConvBNReLU(in_ch, 48, 1),
+                               ConvBNReLU(48, 64, 5, padding=2))
+        self.b3x3dbl = Sequential(ConvBNReLU(in_ch, 64, 1),
+                                  ConvBNReLU(64, 96, 3, padding=1),
+                                  ConvBNReLU(96, 96, 3, padding=1))
+        self.bpool = Sequential(AvgPool2D(kernel_size=3, stride=1, padding=1),
+                                ConvBNReLU(in_ch, pool_features, 1))
+
+    def forward(self, x):
+        return concat([self.b1x1(x), self.b5x5(x), self.b3x3dbl(x),
+                       self.bpool(x)], axis=1)
+
+
+class InceptionB(Layer):
+    def __init__(self, in_ch):
+        super().__init__()
+        self.b3x3 = ConvBNReLU(in_ch, 384, 3, stride=2)
+        self.b3x3dbl = Sequential(ConvBNReLU(in_ch, 64, 1),
+                                  ConvBNReLU(64, 96, 3, padding=1),
+                                  ConvBNReLU(96, 96, 3, stride=2))
+        self.pool = MaxPool2D(kernel_size=3, stride=2)
+
+    def forward(self, x):
+        return concat([self.b3x3(x), self.b3x3dbl(x), self.pool(x)], axis=1)
+
+
+class InceptionC(Layer):
+    def __init__(self, in_ch, ch7):
+        super().__init__()
+        self.b1x1 = ConvBNReLU(in_ch, 192, 1)
+        self.b7x7 = Sequential(
+            ConvBNReLU(in_ch, ch7, 1),
+            ConvBNReLU(ch7, ch7, (1, 7), padding=(0, 3)),
+            ConvBNReLU(ch7, 192, (7, 1), padding=(3, 0)),
+        )
+        self.b7x7dbl = Sequential(
+            ConvBNReLU(in_ch, ch7, 1),
+            ConvBNReLU(ch7, ch7, (7, 1), padding=(3, 0)),
+            ConvBNReLU(ch7, ch7, (1, 7), padding=(0, 3)),
+            ConvBNReLU(ch7, ch7, (7, 1), padding=(3, 0)),
+            ConvBNReLU(ch7, 192, (1, 7), padding=(0, 3)),
+        )
+        self.bpool = Sequential(AvgPool2D(kernel_size=3, stride=1, padding=1),
+                                ConvBNReLU(in_ch, 192, 1))
+
+    def forward(self, x):
+        return concat([self.b1x1(x), self.b7x7(x), self.b7x7dbl(x),
+                       self.bpool(x)], axis=1)
+
+
+class InceptionD(Layer):
+    def __init__(self, in_ch):
+        super().__init__()
+        self.b3x3 = Sequential(ConvBNReLU(in_ch, 192, 1),
+                               ConvBNReLU(192, 320, 3, stride=2))
+        self.b7x7x3 = Sequential(
+            ConvBNReLU(in_ch, 192, 1),
+            ConvBNReLU(192, 192, (1, 7), padding=(0, 3)),
+            ConvBNReLU(192, 192, (7, 1), padding=(3, 0)),
+            ConvBNReLU(192, 192, 3, stride=2),
+        )
+        self.pool = MaxPool2D(kernel_size=3, stride=2)
+
+    def forward(self, x):
+        return concat([self.b3x3(x), self.b7x7x3(x), self.pool(x)], axis=1)
+
+
+class InceptionE(Layer):
+    def __init__(self, in_ch):
+        super().__init__()
+        self.b1x1 = ConvBNReLU(in_ch, 320, 1)
+        self.b3x3_1 = ConvBNReLU(in_ch, 384, 1)
+        self.b3x3_2a = ConvBNReLU(384, 384, (1, 3), padding=(0, 1))
+        self.b3x3_2b = ConvBNReLU(384, 384, (3, 1), padding=(1, 0))
+        self.b3x3dbl_1 = Sequential(ConvBNReLU(in_ch, 448, 1),
+                                    ConvBNReLU(448, 384, 3, padding=1))
+        self.b3x3dbl_2a = ConvBNReLU(384, 384, (1, 3), padding=(0, 1))
+        self.b3x3dbl_2b = ConvBNReLU(384, 384, (3, 1), padding=(1, 0))
+        self.bpool = Sequential(AvgPool2D(kernel_size=3, stride=1, padding=1),
+                                ConvBNReLU(in_ch, 192, 1))
+
+    def forward(self, x):
+        b3 = self.b3x3_1(x)
+        b3 = concat([self.b3x3_2a(b3), self.b3x3_2b(b3)], axis=1)
+        bd = self.b3x3dbl_1(x)
+        bd = concat([self.b3x3dbl_2a(bd), self.b3x3dbl_2b(bd)], axis=1)
+        return concat([self.b1x1(x), b3, bd, self.bpool(x)], axis=1)
+
+
+class InceptionV3(Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = Sequential(
+            ConvBNReLU(3, 32, 3, stride=2),
+            ConvBNReLU(32, 32, 3),
+            ConvBNReLU(32, 64, 3, padding=1),
+            MaxPool2D(kernel_size=3, stride=2),
+            ConvBNReLU(64, 80, 1),
+            ConvBNReLU(80, 192, 3),
+            MaxPool2D(kernel_size=3, stride=2),
+        )
+        self.blocks = Sequential(
+            InceptionA(192, 32), InceptionA(256, 64), InceptionA(288, 64),
+            InceptionB(288),
+            InceptionC(768, 128), InceptionC(768, 160), InceptionC(768, 160),
+            InceptionC(768, 192),
+            InceptionD(768),
+            InceptionE(1280), InceptionE(2048),
+        )
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.dropout = Dropout(0.5)
+            self.fc = Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(flatten(x, 1)))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights require network access")
+    return InceptionV3(**kwargs)

@@ -1,0 +1,72 @@
+"""MobileNetV1 (ref: python/paddle/vision/models/mobilenetv1.py (U))."""
+
+from __future__ import annotations
+
+from ...nn.layer.layers import Layer
+from ...nn.layer import (
+    Conv2D, BatchNorm2D, ReLU, AdaptiveAvgPool2D, Linear, Sequential,
+)
+from ...tensor.manipulation import flatten
+
+
+class _ConvBNReLU(Layer):
+    def __init__(self, in_ch, out_ch, kernel, stride=1, padding=0, groups=1):
+        super().__init__()
+        self.conv = Conv2D(in_ch, out_ch, kernel, stride=stride,
+                           padding=padding, groups=groups, bias_attr=False)
+        self.bn = BatchNorm2D(out_ch)
+        self.relu = ReLU()
+
+    def forward(self, x):
+        return self.relu(self.bn(self.conv(x)))
+
+
+class _DepthwiseSeparable(Layer):
+    def __init__(self, in_ch, out_ch, stride):
+        super().__init__()
+        self.depthwise = _ConvBNReLU(in_ch, in_ch, 3, stride=stride,
+                                     padding=1, groups=in_ch)
+        self.pointwise = _ConvBNReLU(in_ch, out_ch, 1)
+
+    def forward(self, x):
+        return self.pointwise(self.depthwise(x))
+
+
+class MobileNetV1(Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        def c(ch):
+            return max(int(ch * scale), 8)
+
+        cfg = [  # (out, stride)
+            (64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+            (512, 1), (512, 1), (512, 1), (512, 1), (512, 1),
+            (1024, 2), (1024, 1),
+        ]
+        layers = [_ConvBNReLU(3, c(32), 3, stride=2, padding=1)]
+        in_ch = c(32)
+        for out, stride in cfg:
+            layers.append(_DepthwiseSeparable(in_ch, c(out), stride))
+            in_ch = c(out)
+        self.features = Sequential(*layers)
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = Linear(c(1024), num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.fc(flatten(x, 1))
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights require network access")
+    return MobileNetV1(scale=scale, **kwargs)

@@ -1,0 +1,152 @@
+"""ShuffleNetV2 (ref: python/paddle/vision/models/shufflenetv2.py (U)).
+
+channel_shuffle is a reshape/transpose pair — free on TPU (XLA folds it
+into the surrounding convolution layouts)."""
+
+from __future__ import annotations
+
+from ...nn.layer.layers import Layer
+from ...nn.layer import (
+    Conv2D, BatchNorm2D, ReLU, MaxPool2D, AdaptiveAvgPool2D, Linear,
+    Sequential,
+)
+from ...tensor.manipulation import concat, flatten, reshape, transpose
+
+
+def channel_shuffle(x, groups):
+    b, c, h, w = x.shape
+    x = reshape(x, [b, groups, c // groups, h, w])
+    x = transpose(x, [0, 2, 1, 3, 4])
+    return reshape(x, [b, c, h, w])
+
+
+def _split(x):
+    c = x.shape[1] // 2
+    return x[:, :c], x[:, c:]
+
+
+class InvertedResidual(Layer):
+    def __init__(self, in_ch, out_ch, stride):
+        super().__init__()
+        self.stride = stride
+        branch_ch = out_ch // 2
+        if stride == 1:
+            self.branch2 = Sequential(
+                Conv2D(branch_ch, branch_ch, 1, bias_attr=False),
+                BatchNorm2D(branch_ch), ReLU(),
+                Conv2D(branch_ch, branch_ch, 3, stride=1, padding=1,
+                       groups=branch_ch, bias_attr=False),
+                BatchNorm2D(branch_ch),
+                Conv2D(branch_ch, branch_ch, 1, bias_attr=False),
+                BatchNorm2D(branch_ch), ReLU(),
+            )
+        else:
+            self.branch1 = Sequential(
+                Conv2D(in_ch, in_ch, 3, stride=stride, padding=1,
+                       groups=in_ch, bias_attr=False),
+                BatchNorm2D(in_ch),
+                Conv2D(in_ch, branch_ch, 1, bias_attr=False),
+                BatchNorm2D(branch_ch), ReLU(),
+            )
+            self.branch2 = Sequential(
+                Conv2D(in_ch, branch_ch, 1, bias_attr=False),
+                BatchNorm2D(branch_ch), ReLU(),
+                Conv2D(branch_ch, branch_ch, 3, stride=stride, padding=1,
+                       groups=branch_ch, bias_attr=False),
+                BatchNorm2D(branch_ch),
+                Conv2D(branch_ch, branch_ch, 1, bias_attr=False),
+                BatchNorm2D(branch_ch), ReLU(),
+            )
+
+    def forward(self, x):
+        if self.stride == 1:
+            x1, x2 = _split(x)
+            out = concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = concat([self.branch1(x), self.branch2(x)], axis=1)
+        return channel_shuffle(out, 2)
+
+
+_STAGE_OUT = {
+    "0.25x": (24, 48, 96, 192, 1024),
+    "0.33x": (24, 32, 64, 128, 1024),
+    "0.5x": (24, 48, 96, 192, 1024),
+    "1.0x": (24, 116, 232, 464, 1024),
+    "1.5x": (24, 176, 352, 704, 1024),
+    "2.0x": (24, 244, 488, 976, 2048),
+}
+_STAGE_REPEATS = (4, 8, 4)
+
+
+class ShuffleNetV2(Layer):
+    def __init__(self, scale="1.0x", act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        del act  # relu only (paddle's swish variant maps to scale="swish")
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        chs = _STAGE_OUT[scale]
+
+        self.conv1 = Sequential(
+            Conv2D(3, chs[0], 3, stride=2, padding=1, bias_attr=False),
+            BatchNorm2D(chs[0]), ReLU(),
+        )
+        self.maxpool = MaxPool2D(kernel_size=3, stride=2, padding=1)
+        stages = []
+        in_ch = chs[0]
+        for i, repeats in enumerate(_STAGE_REPEATS):
+            out_ch = chs[i + 1]
+            blocks = [InvertedResidual(in_ch, out_ch, stride=2)]
+            for _ in range(repeats - 1):
+                blocks.append(InvertedResidual(out_ch, out_ch, stride=1))
+            stages.append(Sequential(*blocks))
+            in_ch = out_ch
+        self.stages = Sequential(*stages)
+        self.conv5 = Sequential(
+            Conv2D(in_ch, chs[-1], 1, bias_attr=False),
+            BatchNorm2D(chs[-1]), ReLU(),
+        )
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = Linear(chs[-1], num_classes)
+
+    def forward(self, x):
+        x = self.maxpool(self.conv1(x))
+        x = self.stages(x)
+        x = self.conv5(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.fc(flatten(x, 1))
+        return x
+
+
+def _shufflenet(scale, pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights require network access")
+    return ShuffleNetV2(scale=scale, **kwargs)
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return _shufflenet("0.25x", pretrained, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    return _shufflenet("0.33x", pretrained, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return _shufflenet("0.5x", pretrained, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return _shufflenet("1.0x", pretrained, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return _shufflenet("1.5x", pretrained, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return _shufflenet("2.0x", pretrained, **kwargs)

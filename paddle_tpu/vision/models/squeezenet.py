@@ -1,0 +1,86 @@
+"""SqueezeNet (ref: python/paddle/vision/models/squeezenet.py (U))."""
+
+from __future__ import annotations
+
+from ...nn.layer.layers import Layer
+from ...nn.layer import (
+    Conv2D, ReLU, MaxPool2D, AdaptiveAvgPool2D, Dropout, Sequential,
+)
+from ...tensor.manipulation import concat, flatten
+
+
+class Fire(Layer):
+    def __init__(self, inplanes, squeeze_planes, expand1x1_planes,
+                 expand3x3_planes):
+        super().__init__()
+        self.squeeze = Conv2D(inplanes, squeeze_planes, 1)
+        self.relu = ReLU()
+        self.expand1x1 = Conv2D(squeeze_planes, expand1x1_planes, 1)
+        self.expand3x3 = Conv2D(squeeze_planes, expand3x3_planes, 3, padding=1)
+
+    def forward(self, x):
+        x = self.relu(self.squeeze(x))
+        return concat([self.relu(self.expand1x1(x)),
+                       self.relu(self.expand3x3(x))], axis=1)
+
+
+class SqueezeNet(Layer):
+    def __init__(self, version="1.1", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if version == "1.0":
+            self.features = Sequential(
+                Conv2D(3, 96, 7, stride=2), ReLU(),
+                MaxPool2D(kernel_size=3, stride=2),
+                Fire(96, 16, 64, 64), Fire(128, 16, 64, 64),
+                Fire(128, 32, 128, 128),
+                MaxPool2D(kernel_size=3, stride=2),
+                Fire(256, 32, 128, 128), Fire(256, 48, 192, 192),
+                Fire(384, 48, 192, 192), Fire(384, 64, 256, 256),
+                MaxPool2D(kernel_size=3, stride=2),
+                Fire(512, 64, 256, 256),
+            )
+        elif version == "1.1":
+            self.features = Sequential(
+                Conv2D(3, 64, 3, stride=2), ReLU(),
+                MaxPool2D(kernel_size=3, stride=2),
+                Fire(64, 16, 64, 64), Fire(128, 16, 64, 64),
+                MaxPool2D(kernel_size=3, stride=2),
+                Fire(128, 32, 128, 128), Fire(256, 32, 128, 128),
+                MaxPool2D(kernel_size=3, stride=2),
+                Fire(256, 48, 192, 192), Fire(384, 48, 192, 192),
+                Fire(384, 64, 256, 256), Fire(512, 64, 256, 256),
+            )
+        else:
+            raise ValueError(f"unsupported SqueezeNet version {version}")
+        if num_classes > 0:
+            self.classifier = Sequential(
+                Dropout(0.5),
+                Conv2D(512, num_classes, 1),
+                ReLU(),
+            )
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D((1, 1))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.num_classes > 0:
+            x = self.classifier(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        return flatten(x, 1)
+
+
+def _squeezenet(version, pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights require network access")
+    return SqueezeNet(version, **kwargs)
+
+
+def squeezenet1_0(pretrained=False, **kwargs):
+    return _squeezenet("1.0", pretrained, **kwargs)
+
+
+def squeezenet1_1(pretrained=False, **kwargs):
+    return _squeezenet("1.1", pretrained, **kwargs)

@@ -1,0 +1,106 @@
+"""Elastic manager state machine + LLaMA family surface tests."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.fleet.elastic import (
+    ElasticManager, ElasticStatus, LocalMembershipStore,
+)
+
+
+def _mgr(node_id, np_spec, store):
+    return ElasticManager(node_id=node_id, np=np_spec, store=store,
+                          heartbeat_interval=0.05)
+
+
+class TestElastic:
+    def test_hold_below_min(self):
+        store = LocalMembershipStore()
+        m = _mgr("0", "2:4", store).enter()
+        try:
+            assert m.poll() == ElasticStatus.HOLD
+        finally:
+            m.exit()
+
+    def test_steady_state_completed(self):
+        store = LocalMembershipStore()
+        ms = [_mgr(str(i), "2:4", store).enter() for i in range(2)]
+        try:
+            for m in ms:
+                # snapshot at enter() for the last node already holds both
+                m._world = sorted(store.live_nodes(m.ttl))
+                assert m.poll() == ElasticStatus.COMPLETED
+        finally:
+            for m in ms:
+                m.exit()
+
+    def test_scale_up_triggers_restart(self):
+        store = LocalMembershipStore()
+        m0 = _mgr("0", "2:4", store).enter()
+        m1 = _mgr("1", "2:4", store).enter()
+        m0._world = sorted(store.live_nodes(m0.ttl))
+        try:
+            store.register("2", {})
+            seen = []
+            st = m0.watch(timeout=1.0, on_restart=seen.append)
+            assert st == ElasticStatus.RESTART
+            assert seen == [3]
+        finally:
+            m0.exit(); m1.exit()
+
+    def test_scale_down_via_deregister(self):
+        store = LocalMembershipStore()
+        ms = [_mgr(str(i), "2:4", store).enter() for i in range(3)]
+        ms[0]._world = sorted(store.live_nodes(ms[0].ttl))
+        try:
+            ms[2].exit()
+            assert ms[0].poll() == ElasticStatus.RESTART
+            assert ms[0].world_size() == 2
+        finally:
+            ms[0].exit(); ms[1].exit()
+
+    def test_above_max_extras_exit(self):
+        store = LocalMembershipStore()
+        ms = [_mgr(str(i), "1:2", store).enter() for i in range(3)]
+        try:
+            # highest-sorted node beyond max_np is told to exit
+            assert ms[2].poll() == ElasticStatus.EXIT
+        finally:
+            for m in ms:
+                m.exit()
+
+    def test_file_store(self, tmp_path):
+        from paddle_tpu.distributed.fleet.elastic import FileMembershipStore
+
+        store = FileMembershipStore(str(tmp_path))
+        store.register("a", {"host": "h0"})
+        store.register("b", {})
+        assert set(store.live_nodes(ttl=10)) == {"a", "b"}
+        store.deregister("a")
+        assert set(store.live_nodes(ttl=10)) == {"b"}
+
+
+class TestLlama:
+    def test_gqa_forward_backward(self):
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+        cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          num_key_value_heads=2, max_position_embeddings=64)
+        model = LlamaForCausalLM(cfg)
+        # GQA: kv projections are narrower than q
+        assert model.model.layers[0].self_attn.k_proj.weight.shape[1] == 32
+        ids = paddle.to_tensor(np.arange(32, dtype=np.int32).reshape(1, 32) % 128)
+        loss, logits = model(ids, labels=ids)
+        assert tuple(logits.shape) == (1, 32, 128)
+        loss.backward()
+        g = model.model.layers[0].self_attn.k_proj.weight.grad
+        assert g is not None and np.isfinite(np.asarray(g._data)).all()
+
+    def test_presets(self):
+        from paddle_tpu.models.llama import LLAMA2_7B, LLAMA2_13B, LLAMA3_8B
+
+        assert LLAMA2_13B.hidden_size == 5120
+        assert LLAMA2_7B.num_hidden_layers == 32
+        assert LLAMA3_8B.kv_heads == 8
+        assert LLAMA3_8B.head_dim == 128

@@ -1,0 +1,75 @@
+"""Vision model zoo forward-shape tests (ref test strategy SURVEY.md §4:
+test/legacy_test model tests assert output shapes + train/eval modes)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import models as M
+
+
+def _img(b=1, hw=64):
+    rng = np.random.RandomState(0)
+    return paddle.to_tensor(rng.randn(b, 3, hw, hw).astype(np.float32))
+
+
+@pytest.mark.parametrize("ctor,kwargs,hw", [
+    (M.mobilenet_v1, dict(num_classes=10), 64),
+    (M.mobilenet_v3_small, dict(num_classes=10), 64),
+    (M.densenet121, dict(num_classes=10), 64),
+    (M.squeezenet1_1, dict(num_classes=10), 64),
+    (M.shufflenet_v2_x0_25, dict(num_classes=10), 64),
+    (M.inception_v3, dict(num_classes=10), 75),
+])
+def test_forward_shape(ctor, kwargs, hw):
+    model = ctor(**kwargs)
+    model.eval()
+    out = model(_img(hw=hw))
+    assert tuple(out.shape) == (1, 10)
+    assert np.isfinite(np.asarray(out._data)).all()
+
+
+def test_googlenet_eval():
+    model = M.googlenet(num_classes=10)
+    model.eval()
+    out = model(_img(hw=64))
+    assert tuple(out.shape) == (1, 10)
+
+
+def test_googlenet_aux_head():
+    # aux heads consume the 14x14 stage-4 feature maps at 224 input; testing
+    # them directly on a synthetic map avoids a full 224px forward on CPU
+    from paddle_tpu.vision.models.googlenet import InceptionAux
+
+    aux = InceptionAux(512, 10)
+    aux.train()
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(1, 512, 14, 14).astype(np.float32))
+    out = aux(x)
+    assert tuple(out.shape) == (1, 10)
+
+
+def test_channel_shuffle_roundtrip():
+    from paddle_tpu.vision.models.shufflenetv2 import channel_shuffle
+
+    x = paddle.to_tensor(np.arange(16, dtype=np.float32).reshape(1, 4, 2, 2))
+    y = channel_shuffle(channel_shuffle(x, 2), 2)
+    np.testing.assert_allclose(np.asarray(y._data), np.asarray(x._data))
+
+
+def test_dense_layer_grad_flows():
+    # targeted check that gradient flows through the concat-based dense
+    # connectivity (full densenet121 backward is too slow for CI CPU)
+    from paddle_tpu.vision.models.densenet import _DenseLayer
+
+    layer = _DenseLayer(8, growth_rate=4, bn_size=2, drop_rate=0.0)
+    layer.train()
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(2, 8, 8, 8).astype(np.float32))
+    out = layer(x)
+    assert out.shape[1] == 12  # input channels + growth_rate
+    loss = paddle.mean(out * out)
+    loss.backward()
+    g = layer.conv1.weight.grad
+    assert g is not None
+    assert np.isfinite(np.asarray(g._data)).all()
