@@ -29,7 +29,7 @@ def main():
         cfg = GPTConfig(vocab_size=32000, hidden_size=1536, intermediate_size=4096,
                         num_hidden_layers=12, num_attention_heads=12,
                         max_position_embeddings=2048)
-        batch, seq, steps = 8, 1024, 20
+        batch, seq, steps = 16, 1024, 20
     else:
         cfg = GPTConfig(vocab_size=1024, hidden_size=256, intermediate_size=688,
                         num_hidden_layers=4, num_attention_heads=8,

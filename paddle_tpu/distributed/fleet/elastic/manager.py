@@ -151,6 +151,8 @@ class ElasticManager:
         return self
 
     def exit(self, completed=True):
+        self.final_status = (ElasticStatus.COMPLETED if completed
+                             else ElasticStatus.ERROR)
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=2 * self.interval)

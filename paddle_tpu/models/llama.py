@@ -7,6 +7,7 @@ ecosystem (PaddleNLP `LlamaForCausalLM`) find the same surface here.
 """
 
 from .gpt import (
+    LLAMA2_13B,
     GPTConfig as LlamaConfig,
     GPTAttention as LlamaAttention,
     GPTMLP as LlamaMLP,
@@ -18,11 +19,6 @@ from .gpt import (
 LLAMA2_7B = LlamaConfig(
     vocab_size=32000, hidden_size=4096, intermediate_size=11008,
     num_hidden_layers=32, num_attention_heads=32,
-    max_position_embeddings=4096,
-)
-LLAMA2_13B = LlamaConfig(
-    vocab_size=32000, hidden_size=5120, intermediate_size=13824,
-    num_hidden_layers=40, num_attention_heads=40,
     max_position_embeddings=4096,
 )
 # LLaMA-3-style GQA preset (8 kv heads) — exercises the grouped-query path

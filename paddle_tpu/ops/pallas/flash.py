@@ -43,8 +43,22 @@ def _mask(s, causal, kv_len, i_q, j_k, bq, bk):
 
 
 def _block_sizes(sq, sk, d):
-    bq = min(128, sq)
-    bk = min(128, sk)
+    """Large blocks: TPU grid cells run sequentially on the scalar core, so
+    per-cell overhead (~1µs) dominates with small tiles. VMEM budget
+    (~16MB/core, minus double-buffering) fits 512×512 f32 score tiles with
+    d≤256 comfortably; fall back to smaller tiles for short sequences."""
+    rounded_q = -(-sq // 128) * 128  # pad target: next multiple of 128
+    rounded_k = -(-sk // 128) * 128
+    bq = min(512, rounded_q)
+    bk = min(512, rounded_k)
+    # score tile (bq×bk f32) + p tile + q/k/v/acc blocks, ×2 for pipelining
+    while (2 * bq * bk * 4 + (bq + 2 * bk) * d * 2 * 2 + bq * d * 4) > 8 * 2**20:
+        if bk >= bq and bk > 128:
+            bk //= 2
+        elif bq > 128:
+            bq //= 2
+        else:
+            break
     return bq, bk
 
 

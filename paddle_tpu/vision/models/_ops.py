@@ -1,0 +1,27 @@
+"""Shared building blocks for the vision model zoo."""
+
+from __future__ import annotations
+
+from ...nn.layer.layers import Layer
+from ...nn.layer import Conv2D, BatchNorm2D, ReLU
+
+
+def make_divisible(v, divisor=8, min_value=None):
+    if min_value is None:
+        min_value = divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class ConvBNReLU(Layer):
+    def __init__(self, in_ch, out_ch, kernel, stride=1, padding=0, groups=1):
+        super().__init__()
+        self.conv = Conv2D(in_ch, out_ch, kernel, stride=stride,
+                           padding=padding, groups=groups, bias_attr=False)
+        self.bn = BatchNorm2D(out_ch)
+        self.relu = ReLU()
+
+    def forward(self, x):
+        return self.relu(self.bn(self.conv(x)))

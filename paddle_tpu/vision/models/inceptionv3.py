@@ -4,22 +4,10 @@ from __future__ import annotations
 
 from ...nn.layer.layers import Layer
 from ...nn.layer import (
-    Conv2D, BatchNorm2D, ReLU, MaxPool2D, AvgPool2D, AdaptiveAvgPool2D,
-    Linear, Dropout, Sequential,
+    MaxPool2D, AvgPool2D, AdaptiveAvgPool2D, Linear, Dropout, Sequential,
 )
 from ...tensor.manipulation import concat, flatten
-
-
-class ConvBNReLU(Layer):
-    def __init__(self, in_ch, out_ch, kernel, stride=1, padding=0):
-        super().__init__()
-        self.conv = Conv2D(in_ch, out_ch, kernel, stride=stride,
-                           padding=padding, bias_attr=False)
-        self.bn = BatchNorm2D(out_ch)
-        self.relu = ReLU()
-
-    def forward(self, x):
-        return self.relu(self.bn(self.conv(x)))
+from ._ops import ConvBNReLU
 
 
 class InceptionA(Layer):

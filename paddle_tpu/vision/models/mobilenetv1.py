@@ -3,22 +3,9 @@
 from __future__ import annotations
 
 from ...nn.layer.layers import Layer
-from ...nn.layer import (
-    Conv2D, BatchNorm2D, ReLU, AdaptiveAvgPool2D, Linear, Sequential,
-)
+from ...nn.layer import AdaptiveAvgPool2D, Linear, Sequential
 from ...tensor.manipulation import flatten
-
-
-class _ConvBNReLU(Layer):
-    def __init__(self, in_ch, out_ch, kernel, stride=1, padding=0, groups=1):
-        super().__init__()
-        self.conv = Conv2D(in_ch, out_ch, kernel, stride=stride,
-                           padding=padding, groups=groups, bias_attr=False)
-        self.bn = BatchNorm2D(out_ch)
-        self.relu = ReLU()
-
-    def forward(self, x):
-        return self.relu(self.bn(self.conv(x)))
+from ._ops import ConvBNReLU as _ConvBNReLU
 
 
 class _DepthwiseSeparable(Layer):

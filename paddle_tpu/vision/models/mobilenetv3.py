@@ -9,13 +9,7 @@ from ...nn.layer import (
     Linear, Dropout, Sequential,
 )
 from ...tensor.manipulation import flatten
-
-
-def _make_divisible(v, divisor=8):
-    new_v = max(divisor, int(v + divisor / 2) // divisor * divisor)
-    if new_v < 0.9 * v:
-        new_v += divisor
-    return new_v
+from ._ops import make_divisible as _make_divisible
 
 
 class _ConvBNAct(Layer):

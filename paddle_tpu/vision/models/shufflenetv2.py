@@ -82,7 +82,9 @@ class ShuffleNetV2(Layer):
     def __init__(self, scale="1.0x", act="relu", num_classes=1000,
                  with_pool=True):
         super().__init__()
-        del act  # relu only (paddle's swish variant maps to scale="swish")
+        if act != "relu":
+            raise NotImplementedError(
+                f"ShuffleNetV2 act={act!r} not supported (relu only)")
         self.num_classes = num_classes
         self.with_pool = with_pool
         chs = _STAGE_OUT[scale]
