@@ -141,6 +141,7 @@ class ElasticManager:
         self._stop = threading.Event()
         self._thread = None
         self._world = None  # membership snapshot at enter()
+        self.final_status = None  # set by exit(): COMPLETED or ERROR
 
     # ------------------------------------------------------------- lifecycle
     def enter(self, meta=None):

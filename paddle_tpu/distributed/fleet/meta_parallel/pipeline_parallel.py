@@ -46,10 +46,28 @@ except ImportError:  # older jax layout
     from jax.experimental.shard_map import shard_map
 
 
+@jax.custom_vjp
+def _grad_scale(x, s):
+    return x
+
+
+def _grad_scale_fwd(x, s):
+    return x, s
+
+
+def _grad_scale_bwd(s, g):
+    return (g * s, None)
+
+
+_grad_scale.defvjp(_grad_scale_fwd, _grad_scale_bwd)
+
+
 class PipelineParallel(Layer):
     """ref PipelineParallel (meta_parallel): wraps a PipelineLayer and runs
     the compiled microbatch schedule. Composition with dp is native (batch
-    sharded over 'dp'); pp×mp composition lands with the fleet facade."""
+    sharded over 'dp'); with mp, stage layers built from mpu mp-layers run
+    in explicit shard mode — their params enter shard_map pre-sharded over
+    the 'mp' axis and the layers issue the Megatron collectives inline."""
 
     def __init__(self, layers, hcg=None, strategy=None):
         super().__init__()
@@ -100,11 +118,37 @@ class PipelineParallel(Layer):
         names = list(pp.state_dict())
         remat = pp._recompute_interval and pp._recompute_interval > 0
         dp_live = "dp" in mesh.shape and mesh.shape["dp"] > 1
+        mp_live = "mp" in mesh.shape and mesh.shape["mp"] > 1
+        live_axes = ("pp", "mp") if mp_live else ("pp",)
+
+        # pp×mp composition: mp-layer params (is_distributed) enter shard_map
+        # pre-sharded over 'mp' via their hint; everything else replicated
+        sd0 = pp.state_dict()
+
+        def _param_spec(t):
+            axes = getattr(t, "_sharding_axes", None)
+            if mp_live and getattr(t, "is_distributed", False) and axes:
+                return P(*axes)
+            return P()
+
+        param_specs = tuple(_param_spec(sd0[n]) for n in names)
 
         def spmd(x_mbs, y_mbs, base_key, *params):
             s = lax.axis_index("pp")
 
-            with _tape.no_grad(), collective_ctx.axis_scope("pp"), \
+            if mp_live:
+                # The replicated scalar loss (out_specs P()) seeds each shard
+                # with cotangent 1/N_mesh; the psum-over-pp transpose restores
+                # the pp factor and the replicated-param transpose psums over
+                # 'mp' (identical grads on every mp rank), so replicated
+                # params come out exact — but mp-SHARDED params have no mp
+                # psum and land at 1/mp of the true grad. Restore the factor.
+                mp_size = float(mesh.shape["mp"])
+                params = tuple(
+                    _grad_scale(p, mp_size) if spec != P() else p
+                    for p, spec in zip(params, param_specs))
+
+            with _tape.no_grad(), collective_ctx.axis_scope(*live_axes), \
                     pp.use_state(dict(zip(names, params))):
 
                 def run_items(items, t_in):
@@ -180,7 +224,7 @@ class PipelineParallel(Layer):
         def pure(x_mbs, y_mbs, base_key, *params):
             f = shard_map(
                 spmd, mesh=mesh,
-                in_specs=(batch_spec, batch_spec, P()) + tuple(P() for _ in params),
+                in_specs=(batch_spec, batch_spec, P()) + param_specs,
                 out_specs=P(), check_vma=False)
             return f(x_mbs, y_mbs, base_key, *params)
 
