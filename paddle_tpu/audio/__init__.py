@@ -1,39 +1,11 @@
-"""paddle.audio parity (features subset)."""
+"""paddle.audio parity (ref: python/paddle/audio/ (U)): window/mel/dct
+functional plus Spectrogram/MelSpectrogram/LogMelSpectrogram/MFCC feature
+layers. Dataset/backend IO (load/save, soundfile backends) is out of scope in
+a zero-egress build — features operate on tensors."""
 
-from __future__ import annotations
+from . import functional
+from . import features
+from .features import Spectrogram, MelSpectrogram, LogMelSpectrogram, MFCC
 
-import jax.numpy as jnp
-
-from ..core.op_call import apply
-from ..tensor.creation import _as_t
-
-
-class functional:
-    @staticmethod
-    def create_dct(n_mfcc, n_mels, norm="ortho"):
-        import numpy as np
-
-        from ..core.tensor import Tensor
-
-        n = np.arange(n_mels)
-        k = np.arange(n_mfcc)[:, None]
-        dct = np.cos(np.pi / n_mels * (n + 0.5) * k)
-        if norm == "ortho":
-            dct[0] *= 1.0 / np.sqrt(2)
-            dct *= np.sqrt(2.0 / n_mels)
-        return Tensor(dct.T.astype(np.float32))
-
-    @staticmethod
-    def hz_to_mel(freq, htk=False):
-        import math
-
-        if htk:
-            return 2595.0 * math.log10(1.0 + freq / 700.0)
-        f_min, f_sp = 0.0, 200.0 / 3
-        mels = (freq - f_min) / f_sp
-        min_log_hz = 1000.0
-        if freq >= min_log_hz:
-            min_log_mel = (min_log_hz - f_min) / f_sp
-            logstep = math.log(6.4) / 27.0
-            mels = min_log_mel + math.log(freq / min_log_hz) / logstep
-        return mels
+__all__ = ["functional", "features", "Spectrogram", "MelSpectrogram",
+           "LogMelSpectrogram", "MFCC"]

@@ -1,0 +1,91 @@
+"""paddle.audio.features parity (ref: python/paddle/audio/features/layers.py
+(U)): Spectrogram, MelSpectrogram, LogMelSpectrogram, MFCC as nn.Layers."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.op_call import apply
+from ..nn.layer.layers import Layer
+from ..tensor.creation import _as_t
+from ..tensor.math import matmul
+from . import functional as AF
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 fftbins=True, dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.fft_window = AF.get_window(window, self.win_length,
+                                        fftbins=fftbins, dtype=dtype)
+
+    def forward(self, x):
+        from ..signal import stft
+
+        sp = stft(x, self.n_fft, self.hop_length, self.win_length,
+                  self.fft_window, self.center, self.pad_mode)
+        return apply(lambda s: jnp.abs(s) ** self.power, sp,
+                     _op_name="spectrogram")
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 dtype="float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(n_fft, hop_length, win_length, window,
+                                        power, center, pad_mode, dtype=dtype)
+        self.n_mels = n_mels
+        self.fbank_matrix = AF.compute_fbank_matrix(
+            sr, n_fft, n_mels, f_min, f_max, htk, norm, dtype)
+
+    def forward(self, x):
+        sp = self._spectrogram(x)  # [..., freq, frames]
+        return matmul(self.fbank_matrix, sp)
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 ref_value=1.0, amin=1e-10, top_db=None, dtype="float32"):
+        super().__init__()
+        self._melspectrogram = MelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, dtype)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        mel = self._melspectrogram(x)
+        return AF.power_to_db(mel, self.ref_value, self.amin, self.top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self._log_melspectrogram = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, ref_value, amin,
+            top_db, dtype)
+        self.dct_matrix = AF.create_dct(n_mfcc, n_mels, dtype=dtype)
+
+    def forward(self, x):
+        log_mel = self._log_melspectrogram(x)  # [..., n_mels, frames]
+        from ..tensor.manipulation import swapaxes
+
+        # [n_mels, n_mfcc]^T @ [..., n_mels, frames] -> [..., n_mfcc, frames]
+        return matmul(swapaxes(self.dct_matrix, 0, 1), log_mel)

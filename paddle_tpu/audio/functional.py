@@ -1,0 +1,219 @@
+"""paddle.audio.functional parity (ref: python/paddle/audio/functional/ (U):
+window.py, functional.py — mel/fbank/dct math over jnp)."""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..core.op_call import apply
+from ..tensor.creation import _as_t
+
+
+# ----------------------------------------------------------------- windows
+
+def get_window(window, win_length, fftbins=True, dtype="float64"):
+    """ref window.py get_window: 'hamming', 'hann', 'blackman', 'bohman',
+    'gaussian' (as ('gaussian', std)), 'taylor', 'kaiser' ((name, beta)),
+    'exponential', 'triang', 'tukey', 'bartlett', 'cosine'."""
+    if isinstance(window, tuple):
+        name, *params = window
+    else:
+        name, params = window, []
+    n = win_length
+    # periodic (fftbins) windows are the length-(n+1) symmetric window minus
+    # the last sample
+    m = n + 1 if fftbins else n
+    k = np.arange(m)
+    if name in ("hann", "hanning"):
+        w = 0.5 - 0.5 * np.cos(2 * np.pi * k / (m - 1))
+    elif name == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * np.pi * k / (m - 1))
+    elif name == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * np.pi * k / (m - 1))
+             + 0.08 * np.cos(4 * np.pi * k / (m - 1)))
+    elif name == "bartlett":
+        w = 1.0 - np.abs(2 * k / (m - 1) - 1.0)
+    elif name == "triang":
+        if m % 2 == 0:
+            w = (2 * k + 1) / m
+            w = np.where(k >= m // 2, 2 - w, w)
+        else:
+            w = 2 * (k + 1) / (m + 1)
+            w = np.where(k >= (m + 1) // 2, 2 - w, w)
+    elif name == "cosine":
+        w = np.sin(np.pi * (k + 0.5) / m)
+    elif name == "bohman":
+        x = np.abs(2 * k / (m - 1) - 1.0)
+        w = (1 - x) * np.cos(np.pi * x) + np.sin(np.pi * x) / np.pi
+    elif name == "gaussian":
+        std = params[0] if params else 1.0
+        x = k - (m - 1) / 2.0
+        w = np.exp(-0.5 * (x / std) ** 2)
+    elif name == "kaiser":
+        beta = params[0] if params else 12.0
+        w = np.kaiser(m, beta)
+    elif name == "exponential":
+        # reference convention: ('exponential', center, tau)
+        center = params[0] if len(params) > 0 else None
+        tau = params[1] if len(params) > 1 else 1.0
+        if center is None:
+            center = (m - 1) / 2.0
+        if tau is None:
+            tau = 1.0
+        x = np.abs(k - center)
+        w = np.exp(-x / tau)
+    elif name == "tukey":
+        alpha = params[0] if params else 0.5
+        w = np.ones(m)
+        width = int(alpha * (m - 1) / 2.0)
+        if width > 0:
+            edge = 0.5 * (1 + np.cos(np.pi * (-1 + 2.0 * k[:width + 1] /
+                                              alpha / (m - 1))))
+            w[:width + 1] = edge
+            w[-(width + 1):] = edge[::-1]
+    elif name == "taylor":
+        # 4-term, 30dB sidelobe Taylor window (scipy default parameters)
+        defaults = [4, 30]
+        defaults[:len(params)] = list(params)[:2]
+        nbar, sll = defaults
+        B = 10 ** (sll / 20)
+        A = np.arccosh(B) / np.pi
+        s2 = nbar ** 2 / (A ** 2 + (nbar - 0.5) ** 2)
+        ma = np.arange(1, nbar)
+        Fm = np.zeros(nbar - 1)
+        signs = (-1) ** (ma + 1)
+        m2 = ma ** 2
+        for mi, _ in enumerate(ma):
+            numer = signs[mi] * np.prod(
+                1 - m2[mi] / s2 / (A ** 2 + (ma - 0.5) ** 2))
+            denom = 2 * np.prod(1 - m2[mi] / m2[:mi]) * np.prod(
+                1 - m2[mi] / m2[mi + 1:])
+            Fm[mi] = numer / denom
+        pos = (k - (m - 1) / 2.0) / m
+        w = np.ones(m)
+        for mi, _ in enumerate(ma):
+            w = w + 2 * Fm[mi] * np.cos(2 * np.pi * ma[mi] * pos)
+        w /= w.max()
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    if fftbins:
+        w = w[:-1]
+    return Tensor(jnp.asarray(w, dtype=_np_dtype(dtype)))
+
+
+def _np_dtype(dtype):
+    from ..core.dtype import to_jax_dtype
+
+    return to_jax_dtype(dtype)
+
+
+# --------------------------------------------------------------- mel scale
+
+def hz_to_mel(freq, htk=False):
+    """ref functional.hz_to_mel: Slaney (default) or HTK formula."""
+    scalar = not isinstance(freq, Tensor)
+    f = _as_t(freq)._data if not scalar else np.asarray(freq, np.float64)
+    if htk:
+        mel = 2595.0 * (jnp.log10(1.0 + f / 700.0) if not scalar
+                        else np.log10(1.0 + f / 700.0))
+        return Tensor(mel) if not scalar else float(mel)
+    f_min, f_sp = 0.0, 200.0 / 3
+    mels = (f - f_min) / f_sp
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    if scalar:
+        if f >= min_log_hz:
+            mels = min_log_mel + np.log(f / min_log_hz) / logstep
+        return float(mels)
+    mels = jnp.where(f >= min_log_hz,
+                     min_log_mel + jnp.log(f / min_log_hz) / logstep, mels)
+    return Tensor(mels)
+
+
+def mel_to_hz(mel, htk=False):
+    scalar = not isinstance(mel, Tensor)
+    m = _as_t(mel)._data if not scalar else np.asarray(mel, np.float64)
+    if htk:
+        hz = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+        return float(hz) if scalar else Tensor(hz)
+    f_min, f_sp = 0.0, 200.0 / 3
+    freqs = f_min + f_sp * m
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    if scalar:
+        if m >= min_log_mel:
+            freqs = min_log_hz * np.exp(logstep * (m - min_log_mel))
+        return float(freqs)
+    freqs = jnp.where(m >= min_log_mel,
+                      min_log_hz * jnp.exp(logstep * (m - min_log_mel)), freqs)
+    return Tensor(freqs)
+
+
+def _mel_freqs_np(n_mels, f_min, f_max, htk):
+    """Mel-spaced frequencies in numpy float64 (filterbank construction is
+    host-side one-time math; jax default f32 would lose precision)."""
+    lo = hz_to_mel(f_min, htk=htk)
+    hi = hz_to_mel(f_max, htk=htk)
+    mels = np.linspace(lo, hi, n_mels)
+    return np.array([mel_to_hz(float(m), htk=htk) for m in mels])
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False,
+                    dtype="float32"):
+    return Tensor(jnp.asarray(_mel_freqs_np(n_mels, f_min, f_max, htk),
+                              _np_dtype(dtype)))
+
+
+def fft_frequencies(sr, n_fft, dtype="float32"):
+    return Tensor(jnp.asarray(np.linspace(0.0, sr / 2.0, 1 + n_fft // 2),
+                              _np_dtype(dtype)))
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    """Triangular mel filterbank [n_mels, 1+n_fft//2] (ref
+    compute_fbank_matrix)."""
+    if f_max is None:
+        f_max = sr / 2.0
+    fftfreqs = np.linspace(0.0, sr / 2.0, 1 + n_fft // 2)
+    melfreqs = _mel_freqs_np(n_mels + 2, f_min, f_max, htk)
+    fdiff = np.diff(melfreqs)
+    ramps = melfreqs[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = np.maximum(0.0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (melfreqs[2:n_mels + 2] - melfreqs[:n_mels])
+        weights = weights * enorm[:, None]
+    return Tensor(jnp.asarray(weights, _np_dtype(dtype)))
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """DCT-II matrix [n_mels, n_mfcc] (ref create_dct)."""
+    n = np.arange(n_mels, dtype=np.float64)
+    k = np.arange(n_mfcc, dtype=np.float64)
+    dct = np.cos(math.pi / n_mels * (n[:, None] + 0.5) * k[None, :]) * 2.0
+    if norm == "ortho":
+        dct[:, 0] *= math.sqrt(1.0 / (4 * n_mels))
+        dct[:, 1:] *= math.sqrt(1.0 / (2 * n_mels))
+    return Tensor(jnp.asarray(dct, _np_dtype(dtype)))
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+    """10*log10(S/ref) with clamping (ref power_to_db)."""
+    x = _as_t(spect)
+
+    def f(s):
+        log_spec = 10.0 * jnp.log10(jnp.maximum(amin, s))
+        log_spec = log_spec - 10.0 * jnp.log10(jnp.maximum(amin, ref_value))
+        if top_db is not None:
+            log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+        return log_spec
+
+    return apply(f, x, _op_name="power_to_db")

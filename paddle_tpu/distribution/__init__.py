@@ -169,6 +169,320 @@ class Multinomial(Distribution):
         return Tensor(counts)
 
 
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc).astype(jnp.float32)
+        self.scale = _arr(scale).astype(jnp.float32)
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(
+            self.loc, jnp.broadcast_shapes(self.loc.shape, self.scale.shape)))
+
+    @property
+    def variance(self):
+        return Tensor(2 * jnp.square(self.scale) + jnp.zeros_like(self.loc))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.loc.shape,
+                                                    self.scale.shape)
+        u = jax.random.uniform(random_state.next_key(), shape,
+                               minval=-0.5 + 1e-7, maxval=0.5)
+        return Tensor(self.loc - self.scale * jnp.sign(u)
+                      * jnp.log1p(-2 * jnp.abs(u)))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return Tensor(-jnp.abs(v - self.loc) / self.scale
+                      - jnp.log(2 * self.scale))
+
+    def entropy(self):
+        return Tensor(1 + jnp.log(2 * self.scale) + jnp.zeros_like(self.loc))
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc).astype(jnp.float32)
+        self.scale = _arr(scale).astype(jnp.float32)
+
+    _EULER = 0.5772156649015329
+
+    @property
+    def mean(self):
+        return Tensor(self.loc + self.scale * self._EULER)
+
+    @property
+    def variance(self):
+        return Tensor((math.pi ** 2 / 6) * jnp.square(self.scale)
+                      + jnp.zeros_like(self.loc))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.loc.shape,
+                                                    self.scale.shape)
+        g = jax.random.gumbel(random_state.next_key(), shape)
+        return Tensor(self.loc + self.scale * g)
+
+    def log_prob(self, value):
+        z = (_arr(value) - self.loc) / self.scale
+        return Tensor(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+    def entropy(self):
+        return Tensor(jnp.log(self.scale) + 1 + self._EULER
+                      + jnp.zeros_like(self.loc))
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _arr(rate).astype(jnp.float32)
+
+    @property
+    def mean(self):
+        return Tensor(1.0 / self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(1.0 / jnp.square(self.rate))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.rate.shape
+        e = jax.random.exponential(random_state.next_key(), shape)
+        return Tensor(e / self.rate)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return Tensor(jnp.log(self.rate) - self.rate * v)
+
+    def entropy(self):
+        return Tensor(1.0 - jnp.log(self.rate))
+
+
+class Geometric(Distribution):
+    """P(X=k) = (1-p)^k p, k = 0, 1, ... (failures before first success)."""
+
+    def __init__(self, probs, name=None):
+        self.probs = _arr(probs).astype(jnp.float32)
+
+    @property
+    def mean(self):
+        return Tensor((1 - self.probs) / self.probs)
+
+    @property
+    def variance(self):
+        return Tensor((1 - self.probs) / jnp.square(self.probs))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.probs.shape
+        u = jax.random.uniform(random_state.next_key(), shape,
+                               minval=1e-7, maxval=1.0)
+        return Tensor(jnp.floor(jnp.log(u) / jnp.log1p(-self.probs)))
+
+    def log_prob(self, value):
+        k = _arr(value)
+        return Tensor(k * jnp.log1p(-self.probs) + jnp.log(self.probs))
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc).astype(jnp.float32)
+        self.scale = _arr(scale).astype(jnp.float32)
+
+    @property
+    def mean(self):
+        return Tensor(jnp.exp(self.loc + jnp.square(self.scale) / 2))
+
+    @property
+    def variance(self):
+        s2 = jnp.square(self.scale)
+        return Tensor((jnp.exp(s2) - 1) * jnp.exp(2 * self.loc + s2))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.loc.shape,
+                                                    self.scale.shape)
+        z = jax.random.normal(random_state.next_key(), shape)
+        return Tensor(jnp.exp(self.loc + self.scale * z))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        logv = jnp.log(v)
+        return Tensor(-jnp.square(logv - self.loc)
+                      / (2 * jnp.square(self.scale))
+                      - jnp.log(self.scale) - logv
+                      - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return Tensor(self.loc + 0.5 + 0.5 * math.log(2 * math.pi)
+                      + jnp.log(self.scale))
+
+
+class Poisson(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _arr(rate).astype(jnp.float32)
+
+    @property
+    def mean(self):
+        return Tensor(self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(self.rate)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.rate.shape
+        k = jax.random.poisson(random_state.next_key(), self.rate, shape)
+        return Tensor(k.astype(jnp.float32))
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+
+        k = _arr(value)
+        return Tensor(k * jnp.log(self.rate) - self.rate - gammaln(k + 1))
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc).astype(jnp.float32)
+        self.scale = _arr(scale).astype(jnp.float32)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.loc.shape,
+                                                    self.scale.shape)
+        u = jax.random.uniform(random_state.next_key(), shape,
+                               minval=1e-7, maxval=1 - 1e-7)
+        return Tensor(self.loc + self.scale * jnp.tan(math.pi * (u - 0.5)))
+
+    def log_prob(self, value):
+        z = (_arr(value) - self.loc) / self.scale
+        return Tensor(-jnp.log1p(jnp.square(z)) - math.log(math.pi)
+                      - jnp.log(self.scale))
+
+    def entropy(self):
+        return Tensor(jnp.log(4 * math.pi * self.scale)
+                      + jnp.zeros_like(self.loc))
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df = _arr(df).astype(jnp.float32)
+        self.loc = _arr(loc).astype(jnp.float32)
+        self.scale = _arr(scale).astype(jnp.float32)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + jnp.broadcast_shapes(
+            self.df.shape, self.loc.shape, self.scale.shape)
+        t = jax.random.t(random_state.next_key(), self.df, shape)
+        return Tensor(self.loc + self.scale * t)
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+
+        z = (_arr(value) - self.loc) / self.scale
+        d = self.df
+        return Tensor(gammaln((d + 1) / 2) - gammaln(d / 2)
+                      - 0.5 * jnp.log(d * math.pi) - jnp.log(self.scale)
+                      - (d + 1) / 2 * jnp.log1p(jnp.square(z) / d))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _arr(concentration).astype(jnp.float32)
+
+    @property
+    def mean(self):
+        c = self.concentration
+        return Tensor(c / jnp.sum(c, axis=-1, keepdims=True))
+
+    def sample(self, shape=()):
+        # jax.random.dirichlet wants shape == sample_shape + batch_shape
+        full = tuple(shape) + self.concentration.shape[:-1]
+        out = jax.random.dirichlet(random_state.next_key(),
+                                   self.concentration, full)
+        return Tensor(out)
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+
+        v = _arr(value)
+        c = self.concentration
+        norm = gammaln(jnp.sum(c, axis=-1)) - jnp.sum(gammaln(c), axis=-1)
+        return Tensor(norm + jnp.sum((c - 1) * jnp.log(v), axis=-1))
+
+
+# --------------------------------------------------------------- transforms
+
+class Transform:
+    def forward(self, x):
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _arr(loc).astype(jnp.float32)
+        self.scale = _arr(scale).astype(jnp.float32)
+
+    def forward(self, x):
+        return Tensor(self.loc + self.scale * _arr(x))
+
+    def inverse(self, y):
+        return Tensor((_arr(y) - self.loc) / self.scale)
+
+    def forward_log_det_jacobian(self, x):
+        return Tensor(jnp.broadcast_to(jnp.log(jnp.abs(self.scale)),
+                                       _arr(x).shape))
+
+
+class ExpTransform(Transform):
+    def forward(self, x):
+        return Tensor(jnp.exp(_arr(x)))
+
+    def inverse(self, y):
+        return Tensor(jnp.log(_arr(y)))
+
+    def forward_log_det_jacobian(self, x):
+        return Tensor(_arr(x))
+
+
+class SigmoidTransform(Transform):
+    def forward(self, x):
+        return Tensor(jax.nn.sigmoid(_arr(x)))
+
+    def inverse(self, y):
+        v = _arr(y)
+        return Tensor(jnp.log(v) - jnp.log1p(-v))
+
+    def forward_log_det_jacobian(self, x):
+        v = _arr(x)
+        return Tensor(-jax.nn.softplus(-v) - jax.nn.softplus(v))
+
+
+class TransformedDistribution(Distribution):
+    def __init__(self, base, transforms, name=None):
+        self.base = base
+        self.transforms = (transforms if isinstance(transforms, (list, tuple))
+                           else [transforms])
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        y = _arr(value)
+        log_det = jnp.zeros_like(y)
+        x = Tensor(y)
+        for t in reversed(self.transforms):
+            x_prev = t.inverse(x)
+            log_det = log_det + _arr(t.forward_log_det_jacobian(x_prev))
+            x = x_prev
+        return Tensor(_arr(self.base.log_prob(x)) - log_det)
+
+
 def kl_divergence(p, q):
     if isinstance(p, Normal) and isinstance(q, Normal):
         var_ratio = jnp.square(p.scale / q.scale)
@@ -182,4 +496,12 @@ def kl_divergence(p, q):
         pp = jnp.clip(p.probs, 1e-7, 1 - 1e-7)
         qq = jnp.clip(q.probs, 1e-7, 1 - 1e-7)
         return Tensor(pp * (jnp.log(pp) - jnp.log(qq)) + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qq)))
+    if isinstance(p, Exponential) and isinstance(q, Exponential):
+        r = p.rate / q.rate
+        return Tensor(jnp.log(r) + q.rate / p.rate - 1)
+    if isinstance(p, Laplace) and isinstance(q, Laplace):
+        d = jnp.abs(p.loc - q.loc)
+        r = p.scale / q.scale
+        return Tensor(-jnp.log(r) + d / q.scale
+                      + r * jnp.exp(-d / p.scale) - 1)
     raise NotImplementedError(f"kl_divergence({type(p).__name__}, {type(q).__name__})")

@@ -1,0 +1,105 @@
+"""paddle.signal parity: stft / istft (ref: python/paddle/signal.py (U)).
+
+TPU-native: framing is a gather into [*, n_frames, n_fft] followed by a batched
+rfft — static shapes throughout, so the whole transform jits onto the MXU/VPU
+with XLA picking the FFT codegen.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.op_call import apply
+from .core.tensor import Tensor
+from .tensor.creation import _as_t
+
+
+def _frame(x, frame_length, hop_length):
+    """[..., T] -> [..., n_frames, frame_length] via static gather."""
+    t = x.shape[-1]
+    n_frames = 1 + (t - frame_length) // hop_length
+    starts = jnp.arange(n_frames) * hop_length
+    idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+    return x[..., idx]
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+         pad_mode="reflect", normalized=False, onesided=True, name=None):
+    """Short-time Fourier transform, paddle signature: returns
+    [..., n_fft//2+1 (or n_fft), n_frames] complex."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+
+    xt = _as_t(x)
+    win = None if window is None else _as_t(window)
+
+    def f(a, *w):
+        if center:
+            pad = [(0, 0)] * (a.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+            a = jnp.pad(a, pad, mode=pad_mode)
+        frames = _frame(a, n_fft, hop_length)  # [..., n_frames, n_fft]
+        if w:
+            wv = w[0]
+            if win_length < n_fft:  # center the window inside the fft size
+                lp = (n_fft - win_length) // 2
+                wv = jnp.pad(wv, (lp, n_fft - win_length - lp))
+            frames = frames * wv
+        sp = jnp.fft.rfft(frames, axis=-1) if onesided else jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            sp = sp / jnp.sqrt(jnp.asarray(n_fft, sp.real.dtype))
+        return jnp.swapaxes(sp, -1, -2)  # [..., freq, n_frames]
+
+    args = (xt,) + ((win,) if win is not None else ())
+    return apply(f, *args, _op_name="stft")
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+          normalized=False, onesided=True, length=None, return_complex=False,
+          name=None):
+    """Inverse STFT with overlap-add and window-envelope normalization."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+
+    xt = _as_t(x)
+    win = None if window is None else _as_t(window)
+
+    def f(sp, *w):
+        sp = jnp.swapaxes(sp, -1, -2)  # [..., n_frames, freq]
+        if normalized:
+            sp = sp * jnp.sqrt(jnp.asarray(n_fft, sp.real.dtype))
+        if onesided:
+            frames = jnp.fft.irfft(sp, n=n_fft, axis=-1)
+        else:
+            frames = jnp.fft.ifft(sp, axis=-1)
+            if not return_complex:
+                frames = frames.real
+        if w:
+            wv = w[0]
+            if win_length < n_fft:
+                lp = (n_fft - win_length) // 2
+                wv = jnp.pad(wv, (lp, n_fft - win_length - lp))
+        else:
+            wv = jnp.ones((n_fft,), frames.dtype)
+        frames = frames * wv
+        n_frames = frames.shape[-2]
+        t = n_fft + hop_length * (n_frames - 1)
+        # overlap-add via scatter-add over static indices
+        starts = jnp.arange(n_frames) * hop_length
+        idx = (starts[:, None] + jnp.arange(n_fft)[None, :]).reshape(-1)
+        flat = frames.reshape(frames.shape[:-2] + (-1,))
+        out = jnp.zeros(frames.shape[:-2] + (t,), frames.dtype)
+        out = out.at[..., idx].add(flat)
+        env = jnp.zeros((t,), frames.dtype).at[idx].add(
+            jnp.tile(wv * wv, n_frames))
+        out = out / jnp.maximum(env, 1e-11)
+        if center:
+            out = out[..., n_fft // 2: t - n_fft // 2]
+        if length is not None:
+            if out.shape[-1] < length:  # tail lost to partial-frame trunc
+                pad = [(0, 0)] * (out.ndim - 1) + [(0, length - out.shape[-1])]
+                out = jnp.pad(out, pad)
+            out = out[..., :length]
+        return out
+
+    args = (xt,) + ((win,) if win is not None else ())
+    return apply(f, *args, _op_name="istft")

@@ -1,4 +1,103 @@
-"""paddle.text stub — dataset downloads need network; the TPU build keeps the
-namespace for import compatibility (full NLP models live in paddle_tpu.models)."""
+"""paddle.text parity.
 
-__all__ = []
+Dataset downloads (Imdb/Imikolov/Conll05st/…) need network access — out of
+scope in a zero-egress build (full NLP models live in paddle_tpu.models).
+The in-repo compute op, `viterbi_decode` / `ViterbiDecoder` (ref:
+python/paddle/text/viterbi_decode.py (U)), ships here TPU-native: the
+dynamic-programming recursion is a `lax.scan` over the sequence axis so the
+whole decode jits as one program with static shapes.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.op_call import apply
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from ..tensor.creation import _as_t
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def _viterbi(potentials, trans, lengths, include_bos_eos_tag):
+    """potentials [B, T, N], trans [N, N], lengths [B] -> (scores [B],
+    paths [B, T])."""
+    b, t, n = potentials.shape
+    if include_bos_eos_tag:
+        # tags n-2 / n-1 are BOS / EOS (reference convention): the first
+        # step transitions out of BOS, the last into EOS
+        alpha0 = potentials[:, 0] + trans[n - 2][None, :]
+    else:
+        alpha0 = potentials[:, 0]
+
+    def step(carry, xs):
+        alpha, t_idx = carry
+        emit = xs  # [B, N]
+        # scores[b, i, j] = alpha[b, i] + trans[i, j]
+        scores = alpha[:, :, None] + trans[None, :, :]
+        best_prev = jnp.argmax(scores, axis=1)            # [B, N]
+        alpha_new = jnp.max(scores, axis=1) + emit        # [B, N]
+        # masked steps (past each sequence's length) carry alpha through
+        active = (t_idx < lengths)[:, None]
+        alpha_new = jnp.where(active, alpha_new, alpha)
+        best_prev = jnp.where(active, best_prev,
+                              jnp.arange(n)[None, :])
+        return (alpha_new, t_idx + 1), best_prev
+
+    (alpha, _), backptrs = lax.scan(
+        step, (alpha0, jnp.ones((), jnp.int32)),
+        jnp.moveaxis(potentials[:, 1:], 1, 0))            # [T-1, B, N]
+
+    if include_bos_eos_tag:
+        alpha = alpha + trans[:, n - 1][None, :]
+
+    scores = jnp.max(alpha, axis=-1)
+    last_tag = jnp.argmax(alpha, axis=-1).astype(jnp.int32)  # [B]
+
+    def back(tag, ptr):
+        # ptr[i] maps tag_{i+1} -> tag_i; emit tag_i at position i
+        prev = jnp.take_along_axis(ptr, tag[:, None], axis=1)[:, 0]
+        prev = prev.astype(jnp.int32)
+        return prev, prev
+
+    _, path_rev = lax.scan(back, last_tag, backptrs, reverse=True)
+    paths = jnp.concatenate([jnp.moveaxis(path_rev, 0, 1),
+                             last_tag[:, None]], axis=1)  # [B, T]
+    # mask out positions beyond each length with the last valid tag
+    idx = jnp.arange(t)[None, :]
+    valid = idx < lengths[:, None]
+    last_valid = jnp.take_along_axis(paths, (lengths - 1)[:, None], axis=1)
+    paths = jnp.where(valid, paths, last_valid)
+    return scores, paths
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def _viterbi_jit(p, tr, ln, include_bos_eos_tag):
+    return _viterbi(p, tr, ln.astype(jnp.int32), include_bos_eos_tag)
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """ref paddle.text.viterbi_decode: returns (scores, paths)."""
+    pot = _as_t(potentials)
+    trans = _as_t(transition_params)
+    lens = _as_t(lengths)
+    scores, paths = _viterbi_jit(pot._data, trans._data, lens._data,
+                                 bool(include_bos_eos_tag))
+    return Tensor(scores), Tensor(paths)
+
+
+class ViterbiDecoder(Layer):
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = _as_t(transitions)
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

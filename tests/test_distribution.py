@@ -1,0 +1,117 @@
+"""paddle.distribution tests: log_prob vs scipy, sample moments, transforms."""
+
+import numpy as np
+import pytest
+import scipy.stats as st
+
+import paddle_tpu as paddle
+import paddle_tpu.distribution as D
+
+
+def _t(x):
+    return paddle.to_tensor(np.asarray(x, np.float32))
+
+
+class TestLogProbVsScipy:
+    CASES = [
+        (lambda: D.Normal(_t(0.5), _t(2.0)), st.norm(0.5, 2.0), [-1.0, 0.5, 3.0]),
+        (lambda: D.Laplace(_t(0.0), _t(1.5)), st.laplace(0.0, 1.5), [-2.0, 0.1, 1.0]),
+        (lambda: D.Gumbel(_t(1.0), _t(2.0)), st.gumbel_r(1.0, 2.0), [0.0, 1.0, 4.0]),
+        (lambda: D.Exponential(_t(2.0)), st.expon(scale=0.5), [0.1, 0.5, 2.0]),
+        (lambda: D.LogNormal(_t(0.2), _t(0.7)), st.lognorm(0.7, scale=np.exp(0.2)), [0.5, 1.0, 3.0]),
+        (lambda: D.Cauchy(_t(0.0), _t(1.0)), st.cauchy(0.0, 1.0), [-1.0, 0.0, 2.0]),
+        (lambda: D.StudentT(_t(5.0)), st.t(5.0), [-1.0, 0.0, 2.0]),
+        (lambda: D.Poisson(_t(3.0)), st.poisson(3.0), [0.0, 2.0, 5.0]),
+        (lambda: D.Geometric(_t(0.3)), st.geom(0.3, loc=-1), [0.0, 1.0, 4.0]),
+    ]
+
+    @pytest.mark.parametrize("mk,ref,vals", CASES,
+                             ids=[c[1].dist.name for c in CASES])
+    def test_log_prob(self, mk, ref, vals):
+        d = mk()
+        ours = d.log_prob(_t(vals)).numpy()
+        if hasattr(ref, "logpdf") and ref.dist.name not in ("poisson", "geom"):
+            expect = ref.logpdf(vals)
+        else:
+            expect = ref.logpmf(vals)
+        np.testing.assert_allclose(ours, expect, rtol=1e-4, atol=1e-5)
+
+
+class TestSampleMoments:
+    def test_laplace_moments(self):
+        paddle.seed(0)
+        s = D.Laplace(_t(1.0), _t(2.0)).sample((20000,)).numpy()
+        assert abs(s.mean() - 1.0) < 0.1
+        assert abs(s.var() - 8.0) < 0.6
+
+    def test_dirichlet_sums_to_one(self):
+        paddle.seed(0)
+        d = D.Dirichlet(_t([2.0, 3.0, 5.0]))
+        s = d.sample((512,)).numpy()
+        np.testing.assert_allclose(s.sum(-1), 1.0, atol=1e-5)
+        np.testing.assert_allclose(s.mean(0), [0.2, 0.3, 0.5], atol=0.03)
+        lp = d.log_prob(_t([0.2, 0.3, 0.5])).numpy()
+        np.testing.assert_allclose(lp, st.dirichlet([2.0, 3.0, 5.0]).logpdf([0.2, 0.3, 0.5]), rtol=1e-4)
+
+    def test_poisson_mean(self):
+        paddle.seed(0)
+        s = D.Poisson(_t(4.0)).sample((20000,)).numpy()
+        assert abs(s.mean() - 4.0) < 0.1
+
+
+class TestKL:
+    def test_normal_kl_sanity(self):
+        kl = D.kl_divergence(D.Normal(_t(0.0), _t(1.0)),
+                             D.Normal(_t(0.0), _t(1.0))).numpy()
+        np.testing.assert_allclose(kl, 0.0, atol=1e-6)
+
+    def test_exponential_kl_montecarlo(self):
+        paddle.seed(0)
+        p, q = D.Exponential(_t(2.0)), D.Exponential(_t(0.7))
+        kl = float(D.kl_divergence(p, q).numpy())
+        s = p.sample((40000,))
+        mc = float((p.log_prob(s).numpy() - q.log_prob(s).numpy()).mean())
+        assert abs(kl - mc) < 0.05
+
+    def test_laplace_kl_montecarlo(self):
+        paddle.seed(0)
+        p, q = D.Laplace(_t(0.0), _t(1.0)), D.Laplace(_t(1.0), _t(2.0))
+        kl = float(D.kl_divergence(p, q).numpy())
+        s = p.sample((40000,))
+        mc = float((p.log_prob(s).numpy() - q.log_prob(s).numpy()).mean())
+        assert abs(kl - mc) < 0.05
+
+
+class TestTransforms:
+    def test_lognormal_via_transform(self):
+        base = D.Normal(_t(0.2), _t(0.7))
+        td = D.TransformedDistribution(base, [D.ExpTransform()])
+        direct = D.LogNormal(_t(0.2), _t(0.7))
+        for v in (0.5, 1.0, 2.5):
+            np.testing.assert_allclose(td.log_prob(_t(v)).numpy(),
+                                       direct.log_prob(_t(v)).numpy(),
+                                       rtol=1e-5)
+
+    def test_affine_roundtrip(self):
+        t = D.AffineTransform(_t(1.0), _t(3.0))
+        x = _t([0.5, -1.0])
+        np.testing.assert_allclose(t.inverse(t.forward(x)).numpy(), x.numpy(),
+                                   rtol=1e-6)
+
+    def test_sigmoid_logdet(self):
+        t = D.SigmoidTransform()
+        x = _t([0.0])
+        # d sigmoid/dx at 0 = 0.25 -> log det = log(0.25)
+        np.testing.assert_allclose(t.forward_log_det_jacobian(x).numpy(),
+                                   np.log(0.25), rtol=1e-5)
+
+
+class TestBatchedDirichlet:
+    def test_batched_concentration_sample(self):
+        paddle.seed(0)
+        d = D.Dirichlet(_t(np.ones((2, 3), np.float32)))
+        s = d.sample().numpy()
+        assert s.shape == (2, 3)
+        np.testing.assert_allclose(s.sum(-1), 1.0, atol=1e-5)
+        s2 = d.sample((5,)).numpy()
+        assert s2.shape == (5, 2, 3)

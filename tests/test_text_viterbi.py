@@ -1,0 +1,56 @@
+"""paddle.text.viterbi_decode vs brute-force enumeration."""
+
+import itertools
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.text import ViterbiDecoder, viterbi_decode
+
+
+def _brute(pot, trans, length, bos_eos):
+    t, n = pot.shape
+    real_n = n
+    best, best_path = -1e30, None
+    for path in itertools.product(range(real_n), repeat=length):
+        s = pot[0, path[0]]
+        if bos_eos:
+            s += trans[n - 2, path[0]]
+        for i in range(1, length):
+            s += trans[path[i - 1], path[i]] + pot[i, path[i]]
+        if bos_eos:
+            s += trans[path[length - 1], n - 1]
+        if s > best:
+            best, best_path = s, path
+    return best, list(best_path)
+
+
+class TestViterbi:
+    def test_matches_bruteforce(self):
+        rng = np.random.RandomState(0)
+        t, n = 5, 4
+        pot = rng.randn(2, t, n).astype(np.float32)
+        trans = rng.randn(n, n).astype(np.float32)
+        lengths = np.array([5, 3], np.int64)
+        for bos_eos in (False, True):
+            scores, paths = viterbi_decode(
+                paddle.to_tensor(pot), paddle.to_tensor(trans),
+                paddle.to_tensor(lengths), include_bos_eos_tag=bos_eos)
+            for b in range(2):
+                ref_s, ref_p = _brute(pot[b], trans, int(lengths[b]), bos_eos)
+                assert abs(float(scores.numpy()[b]) - ref_s) < 1e-4
+                assert paths.numpy()[b, :int(lengths[b])].tolist() == ref_p
+
+    def test_decoder_layer(self):
+        rng = np.random.RandomState(1)
+        trans = rng.randn(5, 5).astype(np.float32)
+        dec = ViterbiDecoder(paddle.to_tensor(trans))
+        pot = rng.randn(3, 6, 5).astype(np.float32)
+        lengths = np.array([6, 4, 2], np.int64)
+        scores, paths = dec(paddle.to_tensor(pot), paddle.to_tensor(lengths))
+        assert scores.shape == [3] or tuple(scores.shape) == (3,)
+        assert tuple(paths.shape) == (3, 6)
+        # positions past the length repeat the last valid tag
+        p = paths.numpy()
+        assert (p[1, 4:] == p[1, 3]).all()
+        assert (p[2, 2:] == p[2, 1]).all()
