@@ -33,7 +33,7 @@ _LAZY_SUBMODULES = (
     "nn", "optimizer", "autograd", "amp", "io", "jit", "static", "device",
     "linalg", "fft", "vision", "distributed", "incubate", "profiler", "metric",
     "framework", "hapi", "models", "ops", "utils", "distribution", "sparse",
-    "text", "audio", "onnx", "inference", "signal",
+    "text", "audio", "onnx", "inference", "signal", "quantization",
 )
 
 _LAZY_ATTRS = {

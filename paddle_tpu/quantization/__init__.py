@@ -1,0 +1,202 @@
+"""paddle.quantization parity (ref: python/paddle/quantization/ (U):
+QuantConfig, QAT, PTQ with observer/fake-quant factories).
+
+TPU-native: fake-quant is a straight-through-estimator round expressed with
+`jax.custom_vjp` (clip-gradient STE), so QAT training steps stay one fused
+XLA program. int8 simulation only — actual int8 MXU kernels are an XLA
+lowering concern, not a framework one.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.op_call import apply
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from ..nn.layer.common import Linear
+from ..nn.layer.conv import Conv2D
+from ..tensor.creation import _as_t
+
+
+@jax.custom_vjp
+def _fake_quant_ste(x, scale, qmin, qmax):
+    q = jnp.clip(jnp.round(x / scale), qmin, qmax)
+    return q * scale
+
+
+def _fq_fwd(x, scale, qmin, qmax):
+    return _fake_quant_ste(x, scale, qmin, qmax), (x, scale, qmin, qmax)
+
+
+def _fq_bwd(res, g):
+    x, scale, qmin, qmax = res
+    # STE with clipping: gradient passes through inside the representable
+    # range, zero outside
+    inside = (x / scale >= qmin) & (x / scale <= qmax)
+    return (jnp.where(inside, g, 0.0), None, None, None)
+
+
+_fake_quant_ste.defvjp(_fq_fwd, _fq_bwd)
+
+
+class BaseObserver:
+    """Tracks the quantization scale for one tensor."""
+
+    def __init__(self, quant_bits=8):
+        self.quant_bits = quant_bits
+        self.qmax = float(2 ** (quant_bits - 1) - 1)
+        self.qmin = -self.qmax
+
+    def scale(self, x):
+        raise NotImplementedError
+
+    def fake_quant(self, x):
+        s = jnp.maximum(self.scale(x), 1e-8) / self.qmax
+        return _fake_quant_ste(x, s, self.qmin, self.qmax)
+
+
+class AbsmaxObserver(BaseObserver):
+    """Per-tensor abs-max (ref AbsmaxObserver)."""
+
+    def scale(self, x):
+        return jnp.max(jnp.abs(x))
+
+
+class EMAObserver(BaseObserver):
+    """Moving-average abs-max (ref EMAObserver); state updates eagerly
+    between steps (host-side float), the in-graph scale is the snapshot."""
+
+    def __init__(self, quant_bits=8, moving_rate=0.9):
+        super().__init__(quant_bits)
+        self.moving_rate = moving_rate
+        self._ema = None
+
+    def observe(self, x_value):
+        m = float(jnp.max(jnp.abs(x_value)))
+        self._ema = (m if self._ema is None
+                     else self.moving_rate * self._ema
+                     + (1 - self.moving_rate) * m)
+
+    def scale(self, x):
+        if self._ema is not None:
+            return jnp.asarray(self._ema, jnp.float32)
+        return jnp.max(jnp.abs(x))
+
+
+class FakeQuanterWithAbsMax(AbsmaxObserver):
+    pass
+
+
+class QuantConfig:
+    """ref QuantConfig: maps layers (by type or instance) to quanters."""
+
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation or AbsmaxObserver()
+        self.weight = weight or AbsmaxObserver()
+        self._type_configs = {}
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = layer_type if isinstance(layer_type, (list, tuple)) else [layer_type]
+        for t in types:
+            self._type_configs[t] = (activation or self.activation,
+                                     weight or self.weight)
+
+    def config_for(self, layer):
+        for t, cfg in self._type_configs.items():
+            if isinstance(layer, t):
+                return cfg
+        return (self.activation, self.weight)
+
+
+class QuantedLayer(Layer):
+    """Wraps Linear/Conv2D: fake-quants input activation and weight."""
+
+    def __init__(self, layer, act_observer, weight_observer):
+        super().__init__()
+        self.inner = layer
+        self._act_obs = act_observer
+        self._w_obs = weight_observer
+
+    def forward(self, x):
+        xt = _as_t(x)
+        if hasattr(self._act_obs, "observe") and not isinstance(
+                xt._data, jax.core.Tracer):
+            # eager calibration pass (PTQ): stateful observers see the batch
+            self._act_obs.observe(xt._data)
+        xq = apply(lambda a: self._act_obs.fake_quant(a), xt,
+                   _op_name="fake_quant_act")
+        w = self.inner.weight
+        wq = apply(lambda a: self._w_obs.fake_quant(a), w,
+                   _op_name="fake_quant_weight")
+        # shadow the parameter with the fake-quanted tensor for this call
+        # (instance __dict__ wins over the _parameters registry lookup)
+        object.__setattr__(self.inner, "weight", wq)
+        try:
+            return self.inner(xq)
+        finally:
+            object.__delattr__(self.inner, "weight")
+
+
+_QUANTABLE = (Linear, Conv2D)
+
+
+def _swap_layers(model, config, cls):
+    for name, child in list(model.named_children()):
+        if isinstance(child, _QUANTABLE):
+            act, w = config.config_for(child)
+            import copy
+
+            setattr(model, name, cls(child, copy.deepcopy(act),
+                                     copy.deepcopy(w)))
+        else:
+            _swap_layers(child, config, cls)
+    return model
+
+
+class QAT:
+    """Quantization-aware training (ref paddle.quantization.QAT)."""
+
+    def __init__(self, config=None):
+        self.config = config or QuantConfig()
+
+    def quantize(self, model, inplace=False):
+        if not inplace:
+            import copy
+
+            model = copy.deepcopy(model)
+        return _swap_layers(model, self.config, QuantedLayer)
+
+    def convert(self, model, inplace=False):
+        """Strip fake-quant wrappers, baking quantized weights in."""
+        if not inplace:
+            import copy
+
+            model = copy.deepcopy(model)
+
+        def strip(m):
+            for name, child in list(m.named_children()):
+                if isinstance(child, QuantedLayer):
+                    inner = child.inner
+                    inner.weight.set_value(
+                        Tensor(child._w_obs.fake_quant(inner.weight._data)))
+                    setattr(m, name, inner)
+                else:
+                    strip(child)
+            return m
+
+        return strip(model)
+
+
+class PTQ(QAT):
+    """Post-training quantization: same wrappers, calibration-driven scales
+    (run representative batches through the quantized model, observers see
+    the activations)."""
+    pass
+
+
+__all__ = [
+    "QuantConfig", "QAT", "PTQ", "AbsmaxObserver", "EMAObserver",
+    "FakeQuanterWithAbsMax", "QuantedLayer", "BaseObserver",
+]

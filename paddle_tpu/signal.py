@@ -17,6 +17,10 @@ from .tensor.creation import _as_t
 def _frame(x, frame_length, hop_length):
     """[..., T] -> [..., n_frames, frame_length] via static gather."""
     t = x.shape[-1]
+    if t < frame_length:
+        raise ValueError(
+            f"input length {t} is shorter than frame length {frame_length}; "
+            f"pad the signal or use center=True")
     n_frames = 1 + (t - frame_length) // hop_length
     starts = jnp.arange(n_frames) * hop_length
     idx = starts[:, None] + jnp.arange(frame_length)[None, :]

@@ -29,9 +29,9 @@ def _viterbi(potentials, trans, lengths, include_bos_eos_tag):
     paths [B, T])."""
     b, t, n = potentials.shape
     if include_bos_eos_tag:
-        # tags n-2 / n-1 are BOS / EOS (reference convention): the first
-        # step transitions out of BOS, the last into EOS
-        alpha0 = potentials[:, 0] + trans[n - 2][None, :]
+        # reference convention: the LAST tag (n-1) is the start/BOS tag and
+        # the second-to-last (n-2) is the stop/EOS tag
+        alpha0 = potentials[:, 0] + trans[n - 1][None, :]
     else:
         alpha0 = potentials[:, 0]
 
@@ -54,7 +54,7 @@ def _viterbi(potentials, trans, lengths, include_bos_eos_tag):
         jnp.moveaxis(potentials[:, 1:], 1, 0))            # [T-1, B, N]
 
     if include_bos_eos_tag:
-        alpha = alpha + trans[:, n - 1][None, :]
+        alpha = alpha + trans[:, n - 2][None, :]
 
     scores = jnp.max(alpha, axis=-1)
     last_tag = jnp.argmax(alpha, axis=-1).astype(jnp.int32)  # [B]

@@ -1,6 +1,7 @@
 from . import models
 from . import transforms
 from . import datasets
+from . import ops
 from .models import *  # noqa: F401,F403
 
 

@@ -1,0 +1,265 @@
+"""paddle.vision.ops parity (ref: python/paddle/vision/ops.py (U) backed by
+CUDA kernels in paddle/fluid/operators/detection/ — SURVEY.md §2.1 N27).
+
+TPU-native design: everything is static-shape. NMS runs the greedy suppress
+loop as `lax.fori_loop` over a fixed box budget (XLA-friendly; no dynamic
+output — callers slice by the returned count or use the padded index array).
+roi_align is a gather + bilinear interpolation, vectorized over sampling
+points so it lowers to batched gathers on the VPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.op_call import apply
+from ..core.tensor import Tensor
+from ..tensor.creation import _as_t
+
+
+def box_iou(boxes1, boxes2):
+    """Pairwise IoU [N, M] for boxes in xyxy."""
+
+    def f(a, b):
+        area1 = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+        area2 = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+        lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+        rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+        wh = jnp.maximum(rb - lt, 0.0)
+        inter = wh[..., 0] * wh[..., 1]
+        return inter / jnp.maximum(area1[:, None] + area2[None, :] - inter,
+                                   1e-10)
+
+    return apply(f, _as_t(boxes1), _as_t(boxes2), _op_name="box_iou")
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def _nms_core(boxes, scores, iou_threshold):
+    n = boxes.shape[0]
+    order = jnp.argsort(-scores)
+    boxes_sorted = boxes[order]
+    area = ((boxes_sorted[:, 2] - boxes_sorted[:, 0])
+            * (boxes_sorted[:, 3] - boxes_sorted[:, 1]))
+
+    def body(i, keep):
+        # suppress every later box overlapping box i (if i itself is kept)
+        lt = jnp.maximum(boxes_sorted[i, :2], boxes_sorted[:, :2])
+        rb = jnp.minimum(boxes_sorted[i, 2:], boxes_sorted[:, 2:])
+        wh = jnp.maximum(rb - lt, 0.0)
+        inter = wh[:, 0] * wh[:, 1]
+        iou = inter / jnp.maximum(area[i] + area - inter, 1e-10)
+        later = jnp.arange(n) > i
+        suppress = later & (iou > iou_threshold)
+        return jnp.where(keep[i], keep & ~suppress, keep)
+
+    keep = lax.fori_loop(0, n, body, jnp.ones((n,), bool))
+    return order, keep
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Greedy NMS; returns kept box indices sorted by score (ref nms).
+    With `categories`, NMS is applied per category (batched-class trick:
+    offset boxes by category so cross-class boxes never overlap)."""
+    b = _as_t(boxes)._data
+    n = b.shape[0]
+    s = (_as_t(scores)._data if scores is not None
+         else jnp.arange(n, 0, -1, dtype=jnp.float32))
+    if category_idxs is not None:
+        cidx = _as_t(category_idxs)._data
+        offset = (cidx.astype(b.dtype) * (b.max() + 1.0))[:, None]
+        b = b + offset
+    order, keep = _nms_core(b, s, float(iou_threshold))
+    import numpy as np
+
+    order_np = np.asarray(order)
+    keep_np = np.asarray(keep)
+    # keep is in score-sorted order; map back to original box indices
+    kept = order_np[np.nonzero(keep_np)[0]]
+    if top_k is not None:
+        kept = kept[:top_k]
+    return Tensor(jnp.asarray(kept, jnp.int32))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """RoIAlign (ref roi_align): x [N,C,H,W], boxes [R,4] xyxy in input
+    coords, boxes_num [N] rois per image -> [R, C, out_h, out_w]."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    out_h, out_w = output_size
+    xt = _as_t(x)
+    bt = _as_t(boxes)
+    bn = _as_t(boxes_num)
+
+    def f(feat, rois, rois_num):
+        n, c, h, w = feat.shape
+        r = rois.shape[0]
+        # map each roi to its image index
+        img_idx = jnp.repeat(jnp.arange(n), rois_num, axis=0,
+                             total_repeat_length=r)
+        off = 0.5 if aligned else 0.0
+        x1 = rois[:, 0] * spatial_scale - off
+        y1 = rois[:, 1] * spatial_scale - off
+        x2 = rois[:, 2] * spatial_scale - off
+        y2 = rois[:, 3] * spatial_scale - off
+        roi_w = x2 - x1
+        roi_h = y2 - y1
+        if not aligned:
+            roi_w = jnp.maximum(roi_w, 1.0)
+            roi_h = jnp.maximum(roi_h, 1.0)
+        bin_w = roi_w / out_w
+        bin_h = roi_h / out_h
+        ns = sampling_ratio if sampling_ratio > 0 else 2
+        # sample grid: [R, out, ns] center offsets per bin
+        iy = (jnp.arange(ns) + 0.5) / ns
+        ys = (y1[:, None, None]
+              + (jnp.arange(out_h)[None, :, None] + iy[None, None, :])
+              * bin_h[:, None, None])                     # [R, out_h, ns]
+        xs = (x1[:, None, None]
+              + (jnp.arange(out_w)[None, :, None] + iy[None, None, :])
+              * bin_w[:, None, None])                     # [R, out_w, ns]
+
+        def bilinear(img, yy, xx):
+            # img [C, H, W]; yy/xx [...] -> [C, ...]
+            yy = jnp.clip(yy, 0.0, h - 1.0)
+            xx = jnp.clip(xx, 0.0, w - 1.0)
+            y0 = jnp.floor(yy).astype(jnp.int32)
+            x0 = jnp.floor(xx).astype(jnp.int32)
+            y1_ = jnp.minimum(y0 + 1, h - 1)
+            x1_ = jnp.minimum(x0 + 1, w - 1)
+            wy = yy - y0
+            wx = xx - x0
+            v00 = img[:, y0, x0]
+            v01 = img[:, y0, x1_]
+            v10 = img[:, y1_, x0]
+            v11 = img[:, y1_, x1_]
+            return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+                    + v10 * wy * (1 - wx) + v11 * wy * wx)
+
+        def per_roi(ri):
+            img = feat[img_idx[ri]]
+            yy = ys[ri]  # [out_h, ns]
+            xx = xs[ri]  # [out_w, ns]
+            # full grid [out_h, ns, out_w, ns]
+            ygrid = yy[:, :, None, None]
+            xgrid = xx[None, None, :, :]
+            vals = bilinear(img, jnp.broadcast_to(ygrid, (out_h, ns, out_w, ns)),
+                            jnp.broadcast_to(xgrid, (out_h, ns, out_w, ns)))
+            return vals.reshape(c, out_h, ns, out_w, ns).mean(axis=(2, 4))
+
+        return jax.vmap(per_roi)(jnp.arange(r))
+
+    return apply(f, xt, bt, bn, _op_name="roi_align")
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """RoIPool (max pooling variant) via roi_align sampling at high density
+    with max reduction approximated by dense align — exact max pooling over
+    quantized bins, matching the reference op."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    out_h, out_w = output_size
+    xt = _as_t(x)
+    bt = _as_t(boxes)
+    bn = _as_t(boxes_num)
+
+    def f(feat, rois, rois_num):
+        n, c, h, w = feat.shape
+        r = rois.shape[0]
+        img_idx = jnp.repeat(jnp.arange(n), rois_num, axis=0,
+                             total_repeat_length=r)
+        x1 = jnp.round(rois[:, 0] * spatial_scale).astype(jnp.int32)
+        y1 = jnp.round(rois[:, 1] * spatial_scale).astype(jnp.int32)
+        x2 = jnp.round(rois[:, 2] * spatial_scale).astype(jnp.int32)
+        y2 = jnp.round(rois[:, 3] * spatial_scale).astype(jnp.int32)
+        roi_w = jnp.maximum(x2 - x1 + 1, 1)
+        roi_h = jnp.maximum(y2 - y1 + 1, 1)
+
+        ys_all = jnp.arange(h)
+        xs_all = jnp.arange(w)
+        big_neg = jnp.asarray(-3.4e38, feat.dtype)
+
+        def bin_masks(rel, roi_len, n_bins, size):
+            """[n_bins, size] membership with the reference's overlapping
+            floor/ceil boundaries: bin i covers
+            [floor(i·L/n), ceil((i+1)·L/n))."""
+            i = jnp.arange(n_bins, dtype=jnp.float32)[:, None]
+            start = jnp.floor(i * roi_len / n_bins)
+            end = jnp.ceil((i + 1) * roi_len / n_bins)
+            within = (rel[None, :] >= start) & (rel[None, :] < end)
+            valid = (rel >= 0) & (rel < roi_len)
+            return within & valid[None, :]
+
+        def per_roi(ri):
+            img = feat[img_idx[ri]]  # [C, H, W]
+            ymask = bin_masks(ys_all - y1[ri], roi_h[ri], out_h, h)
+            xmask = bin_masks(xs_all - x1[ri], roi_w[ri], out_w, w)
+            # two-stage max keeps the transient at [C, H, out_w]
+            col = jnp.stack(
+                [jnp.max(jnp.where(xmask[j][None, None, :], img, big_neg),
+                         axis=2) for j in range(out_w)], axis=-1)
+            pooled = jnp.stack(
+                [jnp.max(jnp.where(ymask[i][None, :, None], col, big_neg),
+                         axis=1) for i in range(out_h)], axis=1)
+            any_px = (ymask.any(axis=1)[:, None] & xmask.any(axis=1)[None, :])
+            return jnp.where(any_px[None], pooled, 0.0)
+
+        return jax.vmap(per_roi)(jnp.arange(r))
+
+    return apply(f, xt, bt, bn, _op_name="roi_pool")
+
+
+def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",
+              box_normalized=True, axis=0, name=None):
+    """Encode/decode boxes against priors (ref box_coder op)."""
+    if axis != 0:
+        raise NotImplementedError("box_coder axis=1 layout not supported")
+    pb = _as_t(prior_box)._data
+    pbv = _as_t(prior_box_var)._data if prior_box_var is not None else None
+    if pbv is not None and pbv.ndim == 1:
+        # a single 4-vector of variances applies to every prior
+        pbv = jnp.broadcast_to(pbv[None, :], (pb.shape[0], 4))
+    tb = _as_t(target_box)._data
+    norm = 0.0 if box_normalized else 1.0
+
+    pw = pb[:, 2] - pb[:, 0] + norm
+    ph = pb[:, 3] - pb[:, 1] + norm
+    pcx = pb[:, 0] + pw * 0.5
+    pcy = pb[:, 1] + ph * 0.5
+
+    if code_type == "encode_center_size":
+        tw = tb[:, 2] - tb[:, 0] + norm
+        th = tb[:, 3] - tb[:, 1] + norm
+        tcx = tb[:, 0] + tw * 0.5
+        tcy = tb[:, 1] + th * 0.5
+        dx = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        dy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+        dw = jnp.log(tw[:, None] / pw[None, :])
+        dh = jnp.log(th[:, None] / ph[None, :])
+        out = jnp.stack([dx, dy, dw, dh], axis=-1)
+        if pbv is not None:
+            out = out / pbv[None, :, :]
+        return Tensor(out)
+    elif code_type == "decode_center_size":
+        d = tb  # [N, M, 4] or [M, 4]
+        if d.ndim == 2:
+            d = d[None]
+        if pbv is not None:
+            d = d * pbv[None, :, :]
+        dcx = d[..., 0] * pw + pcx
+        dcy = d[..., 1] * ph + pcy
+        dw = jnp.exp(d[..., 2]) * pw
+        dh = jnp.exp(d[..., 3]) * ph
+        out = jnp.stack([dcx - dw * 0.5, dcy - dh * 0.5,
+                         dcx + dw * 0.5 - norm, dcy + dh * 0.5 - norm],
+                        axis=-1)
+        return Tensor(out[0] if tb.ndim == 2 else out)
+    raise ValueError(f"unknown code_type {code_type}")
+
+
+__all__ = ["box_iou", "nms", "roi_align", "roi_pool", "box_coder"]

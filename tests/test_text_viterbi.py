@@ -15,11 +15,12 @@ def _brute(pot, trans, length, bos_eos):
     for path in itertools.product(range(real_n), repeat=length):
         s = pot[0, path[0]]
         if bos_eos:
-            s += trans[n - 2, path[0]]
+            # reference: last tag = BOS/start, second-to-last = EOS/stop
+            s += trans[n - 1, path[0]]
         for i in range(1, length):
             s += trans[path[i - 1], path[i]] + pot[i, path[i]]
         if bos_eos:
-            s += trans[path[length - 1], n - 1]
+            s += trans[path[length - 1], n - 2]
         if s > best:
             best, best_path = s, path
     return best, list(best_path)

@@ -1,0 +1,199 @@
+"""paddle.vision.ops tests: nms/box_iou/roi_align vs NumPy references."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as V
+
+
+def _np_nms(boxes, scores, thr):
+    order = np.argsort(-scores)
+    keep = []
+    suppressed = np.zeros(len(boxes), bool)
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        for j in order:
+            if suppressed[j] or j == i:
+                continue
+            xx1 = max(boxes[i, 0], boxes[j, 0]); yy1 = max(boxes[i, 1], boxes[j, 1])
+            xx2 = min(boxes[i, 2], boxes[j, 2]); yy2 = min(boxes[i, 3], boxes[j, 3])
+            inter = max(xx2 - xx1, 0) * max(yy2 - yy1, 0)
+            a1 = (boxes[i, 2] - boxes[i, 0]) * (boxes[i, 3] - boxes[i, 1])
+            a2 = (boxes[j, 2] - boxes[j, 0]) * (boxes[j, 3] - boxes[j, 1])
+            if inter / max(a1 + a2 - inter, 1e-10) > thr:
+                suppressed[j] = True
+    return keep
+
+
+class TestNms:
+    def test_matches_reference(self):
+        rng = np.random.RandomState(0)
+        xy = rng.rand(40, 2) * 10
+        wh = rng.rand(40, 2) * 4 + 0.5
+        boxes = np.concatenate([xy, xy + wh], axis=1).astype(np.float32)
+        scores = rng.rand(40).astype(np.float32)
+        ref = _np_nms(boxes, scores, 0.4)
+        out = V.nms(paddle.to_tensor(boxes), 0.4,
+                    scores=paddle.to_tensor(scores)).numpy()
+        assert out.tolist() == ref
+
+    def test_top_k(self):
+        boxes = np.array([[0, 0, 1, 1], [5, 5, 6, 6], [10, 10, 11, 11]],
+                         np.float32)
+        scores = np.array([0.9, 0.8, 0.7], np.float32)
+        out = V.nms(paddle.to_tensor(boxes), 0.5,
+                    scores=paddle.to_tensor(scores), top_k=2).numpy()
+        assert out.tolist() == [0, 1]
+
+    def test_categories(self):
+        # identical overlapping boxes in different categories both survive
+        boxes = np.array([[0, 0, 2, 2], [0, 0, 2, 2]], np.float32)
+        scores = np.array([0.9, 0.8], np.float32)
+        cats = np.array([0, 1], np.int32)
+        out = V.nms(paddle.to_tensor(boxes), 0.5,
+                    scores=paddle.to_tensor(scores),
+                    category_idxs=paddle.to_tensor(cats),
+                    categories=[0, 1]).numpy()
+        assert sorted(out.tolist()) == [0, 1]
+
+
+class TestBoxIou:
+    def test_known_values(self):
+        a = paddle.to_tensor(np.array([[0, 0, 2, 2]], np.float32))
+        b = paddle.to_tensor(np.array([[1, 1, 3, 3], [0, 0, 2, 2],
+                                       [4, 4, 5, 5]], np.float32))
+        iou = V.box_iou(a, b).numpy()
+        np.testing.assert_allclose(iou[0], [1 / 7, 1.0, 0.0], atol=1e-6)
+
+
+class TestRoiAlign:
+    def test_constant_map(self):
+        # constant feature map -> every roi bin averages to the constant
+        x = np.full((1, 3, 16, 16), 2.5, np.float32)
+        boxes = np.array([[2, 2, 10, 10], [0, 0, 15, 15]], np.float32)
+        out = V.roi_align(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                          paddle.to_tensor(np.array([2], np.int32)),
+                          output_size=4).numpy()
+        assert out.shape == (2, 3, 4, 4)
+        np.testing.assert_allclose(out, 2.5, atol=1e-5)
+
+    def test_linear_ramp(self):
+        # f(x,y) = x: averaging a bin gives the bin's center x coordinate
+        w = 16
+        ramp = np.tile(np.arange(w, dtype=np.float32), (w, 1))[None, None]
+        boxes = np.array([[4, 4, 12, 12]], np.float32)
+        out = V.roi_align(paddle.to_tensor(ramp), paddle.to_tensor(boxes),
+                          paddle.to_tensor(np.array([1], np.int32)),
+                          output_size=2, aligned=True).numpy()
+        # aligned: roi [3.5, 11.5), bins of width 4 -> centers 5.5, 9.5
+        np.testing.assert_allclose(out[0, 0, 0], [5.5, 9.5], atol=1e-4)
+
+    def test_roi_pool_max(self):
+        x = np.zeros((1, 1, 8, 8), np.float32)
+        x[0, 0, 2, 3] = 7.0
+        x[0, 0, 6, 6] = 9.0
+        boxes = np.array([[0, 0, 7, 7]], np.float32)
+        out = V.roi_pool(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                         paddle.to_tensor(np.array([1], np.int32)),
+                         output_size=2).numpy()
+        assert out[0, 0, 0, 0] == 7.0  # top-left quadrant max
+        assert out[0, 0, 1, 1] == 9.0  # bottom-right quadrant max
+
+
+class TestBoxCoder:
+    def test_encode_decode_roundtrip(self):
+        rng = np.random.RandomState(1)
+        priors = np.array([[0, 0, 4, 4], [2, 2, 8, 8]], np.float32)
+        targets = np.array([[1, 1, 5, 5], [3, 3, 6, 7]], np.float32)
+        enc = V.box_coder(paddle.to_tensor(priors), None,
+                          paddle.to_tensor(targets)).numpy()
+        dec = V.box_coder(paddle.to_tensor(priors), None,
+                          paddle.to_tensor(enc),
+                          code_type="decode_center_size").numpy()
+        # decoding each target's own code against its prior reproduces it
+        for i in range(2):
+            np.testing.assert_allclose(dec[i, i], targets[i], atol=1e-4)
+
+
+class TestQuantization:
+    def test_fake_quant_roundtrip_and_ste(self):
+        import jax.numpy as jnp
+        from paddle_tpu.quantization import AbsmaxObserver
+
+        obs = AbsmaxObserver(quant_bits=8)
+        x = jnp.asarray(np.linspace(-1, 1, 11, dtype=np.float32))
+        q = obs.fake_quant(x)
+        # max error bounded by half a quantization step
+        step = 1.0 / 127
+        assert float(jnp.abs(q - x).max()) <= step / 2 + 1e-6
+
+    def test_qat_quantize_and_train(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.quantization import QAT, QuantConfig
+
+        paddle.seed(3)
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+        q = QAT(QuantConfig())
+        qmodel = q.quantize(model)
+        names = [type(l).__name__ for l in qmodel.sublayers()]
+        assert names.count("QuantedLayer") == 2
+        x = paddle.to_tensor(np.random.RandomState(0).randn(4, 8).astype(np.float32))
+        out = qmodel(x)
+        loss = paddle.mean(out * out)
+        loss.backward()
+        # STE: quantized weights still receive gradients
+        g = qmodel[0].inner.weight.grad
+        assert g is not None and np.abs(g.numpy()).max() > 0
+
+    def test_convert_bakes_weights(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.quantization import QAT
+
+        paddle.seed(4)
+        model = nn.Sequential(nn.Linear(4, 4))
+        q = QAT()
+        qmodel = q.quantize(model)
+        final = q.convert(qmodel)
+        assert type(final[0]).__name__ == "Linear"
+        w = final[0].weight.numpy()
+        scale = np.abs(w).max() / 127
+        # every weight is an integer multiple of the scale
+        np.testing.assert_allclose(w / scale, np.round(w / scale), atol=1e-3)
+
+
+class TestReviewRegressions:
+    def test_roi_pool_overlapping_bins(self):
+        # roi height 5 pooled to 2 bins: boundaries floor/ceil overlap at
+        # pixel 2, so a max there must appear in BOTH bins
+        x = np.zeros((1, 1, 5, 1), np.float32)
+        x[0, 0] = np.array([[0], [1], [9], [2], [3]], np.float32)
+        boxes = np.array([[0, 0, 0, 4]], np.float32)
+        out = V.roi_pool(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                         paddle.to_tensor(np.array([1], np.int32)),
+                         output_size=(2, 1)).numpy()
+        assert out[0, 0, :, 0].tolist() == [9.0, 9.0]
+
+    def test_box_coder_list_variance(self):
+        priors = np.array([[0, 0, 4, 4]], np.float32)
+        targets = np.array([[1, 1, 5, 5]], np.float32)
+        var = np.array([0.1, 0.1, 0.2, 0.2], np.float32)
+        enc = V.box_coder(paddle.to_tensor(priors), paddle.to_tensor(var),
+                          paddle.to_tensor(targets)).numpy()
+        enc_novar = V.box_coder(paddle.to_tensor(priors), None,
+                                paddle.to_tensor(targets)).numpy()
+        np.testing.assert_allclose(enc[0, 0], enc_novar[0, 0] / var,
+                                   rtol=1e-5)
+
+    def test_ptq_calibration_updates_ema(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.quantization import PTQ, QuantConfig, EMAObserver
+
+        model = nn.Sequential(nn.Linear(4, 4))
+        ptq = PTQ(QuantConfig(activation=EMAObserver()))
+        qmodel = ptq.quantize(model)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32) * 3.0)
+        qmodel(x)
+        assert qmodel[0]._act_obs._ema is not None
+        assert abs(qmodel[0]._act_obs._ema - 3.0) < 1e-5
