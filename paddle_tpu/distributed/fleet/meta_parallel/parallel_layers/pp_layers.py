@@ -134,7 +134,10 @@ class PipelineLayer(Layer):
 
     # ------------------------------------------------------------ segmenting
     def _segment(self, method):
-        n, S = len(self.run_function), self._num_stages
+        # interleaved/VPP: segment into S·V chunks; chunk d executes on rank
+        # d % S (Megatron virtual-pipeline layout, ref pp_layers.py
+        # get_stage_from_index)
+        n, S = len(self.run_function), self._num_stages * self._num_virtual_stages
         if S == 1:
             return [0, n]
         if method.startswith("layer:"):
@@ -164,17 +167,26 @@ class PipelineLayer(Layer):
     def num_stages(self):
         return self._num_stages
 
-    def get_stage_layers(self, stage_id):
-        lo, hi = self.segment_parts[stage_id], self.segment_parts[stage_id + 1]
+    @property
+    def num_virtual_stages(self):
+        return self._num_virtual_stages
+
+    def get_stage_layers(self, chunk_id):
+        """Layers of chunk `chunk_id` (== stage id when V == 1; with VPP,
+        chunk d runs on rank d % num_stages)."""
+        lo, hi = self.segment_parts[chunk_id], self.segment_parts[chunk_id + 1]
         return self.run_function[lo:hi]
 
     def stage_param_names(self, stage_id):
+        """All param names owned by rank `stage_id` (its V chunks)."""
         names = []
-        lo, hi = self.segment_parts[stage_id], self.segment_parts[stage_id + 1]
-        for i in range(lo, hi):
-            prefix = str(i)
-            for n, _ in self._sub_layers[prefix].named_parameters(prefix=prefix):
-                names.append(n)
+        for chunk in range(stage_id, self._num_stages * self._num_virtual_stages,
+                           self._num_stages):
+            lo, hi = self.segment_parts[chunk], self.segment_parts[chunk + 1]
+            for i in range(lo, hi):
+                prefix = str(i)
+                for n, _ in self._sub_layers[prefix].named_parameters(prefix=prefix):
+                    names.append(n)
         return names
 
     # ------------------------------------------------------------ serial ref

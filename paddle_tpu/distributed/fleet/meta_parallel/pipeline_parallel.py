@@ -106,23 +106,24 @@ class PipelineParallel(Layer):
         return contextlib.nullcontext()
 
     # ----------------------------------------------------------- schedule
-    def _pipeline_pure_fn(self, n_micro):
-        """Build pure(x_mbs, y_mbs, key, *params) -> scalar loss, shard_mapped
-        over the hybrid mesh with the tick loop inside."""
-        if n_micro in self._pp_fn_cache:
-            return self._pp_fn_cache[n_micro]
+    def _schedule_env(self):
+        """Setup shared by every schedule builder: mesh axis liveness,
+        per-param shard_map specs (pp×mp composition: mp-layer params with
+        is_distributed enter pre-sharded over 'mp' via their hint, the rest
+        replicated), and the mp cotangent-rescale wrapper.
 
+        On the rescale: the replicated scalar loss (out_specs P()) seeds
+        each shard with cotangent 1/N_mesh; the psum-over-pp transpose
+        restores the pp factor and the replicated-param transpose psums over
+        'mp' (identical grads on every mp rank), so replicated params come
+        out exact — but mp-SHARDED params have no mp psum and land at 1/mp
+        of the true grad, so their cotangent gets scaled back by mp."""
         pp = self._layers
-        S = pp.num_stages
         mesh = self._hcg.mesh
         names = list(pp.state_dict())
-        remat = pp._recompute_interval and pp._recompute_interval > 0
         dp_live = "dp" in mesh.shape and mesh.shape["dp"] > 1
         mp_live = "mp" in mesh.shape and mesh.shape["mp"] > 1
         live_axes = ("pp", "mp") if mp_live else ("pp",)
-
-        # pp×mp composition: mp-layer params (is_distributed) enter shard_map
-        # pre-sharded over 'mp' via their hint; everything else replicated
         sd0 = pp.state_dict()
 
         def _param_spec(t):
@@ -133,28 +134,45 @@ class PipelineParallel(Layer):
 
         param_specs = tuple(_param_spec(sd0[n]) for n in names)
 
+        def rescale_mp(params):
+            if not mp_live:
+                return params
+            mp_size = float(mesh.shape["mp"])
+            return tuple(_grad_scale(p, mp_size) if spec != P() else p
+                         for p, spec in zip(params, param_specs))
+
+        batch_spec = P(None, "dp") if dp_live else P()
+        return (mesh, names, dp_live, mp_live, live_axes, param_specs,
+                rescale_mp, batch_spec)
+
+    @staticmethod
+    def _run_items(items, t_in):
+        for it in items:
+            t_in = it(t_in)
+        return t_in
+
+    def _pipeline_pure_fn(self, n_micro):
+        """Build pure(x_mbs, y_mbs, key, *params) -> scalar loss, shard_mapped
+        over the hybrid mesh with the tick loop inside."""
+        if n_micro in self._pp_fn_cache:
+            return self._pp_fn_cache[n_micro]
+
+        pp = self._layers
+        S = pp.num_stages
+        V = getattr(pp, "num_virtual_stages", 1)
+        if V > 1:
+            return self._pipeline_pure_fn_interleaved(n_micro)
+        remat = pp._recompute_interval and pp._recompute_interval > 0
+        (mesh, names, dp_live, mp_live, live_axes, param_specs,
+         rescale_mp, batch_spec) = self._schedule_env()
+        run_items = self._run_items
+
         def spmd(x_mbs, y_mbs, base_key, *params):
             s = lax.axis_index("pp")
-
-            if mp_live:
-                # The replicated scalar loss (out_specs P()) seeds each shard
-                # with cotangent 1/N_mesh; the psum-over-pp transpose restores
-                # the pp factor and the replicated-param transpose psums over
-                # 'mp' (identical grads on every mp rank), so replicated
-                # params come out exact — but mp-SHARDED params have no mp
-                # psum and land at 1/mp of the true grad. Restore the factor.
-                mp_size = float(mesh.shape["mp"])
-                params = tuple(
-                    _grad_scale(p, mp_size) if spec != P() else p
-                    for p, spec in zip(params, param_specs))
+            params = rescale_mp(params)
 
             with _tape.no_grad(), collective_ctx.axis_scope(*live_axes), \
                     pp.use_state(dict(zip(names, params))):
-
-                def run_items(items, t_in):
-                    for it in items:
-                        t_in = it(t_in)
-                    return t_in
 
                 def make_branch(k):
                     items = pp.get_stage_layers(k)
@@ -219,8 +237,6 @@ class PipelineParallel(Layer):
                 loss = lax.pmean(loss, "dp")
             return loss
 
-        batch_spec = P(None, "dp") if dp_live else P()
-
         def pure(x_mbs, y_mbs, base_key, *params):
             f = shard_map(
                 spmd, mesh=mesh,
@@ -230,6 +246,112 @@ class PipelineParallel(Layer):
 
         self._pp_fn_cache[n_micro] = (pure, names)
         return self._pp_fn_cache[n_micro]
+
+    def _pipeline_pure_fn_interleaved(self, n_micro):
+        """Interleaved / VPP schedule (ref Megatron-style interleaved 1F1B,
+        fleet pipeline_parallel.py with num_virtual_pipeline_stages): the
+        model is cut into S·V chunks, rank r owns chunks {r, r+S, ...}; per
+        tick every rank runs its V chunks (slot j carries sweep j's
+        activation) and the ring ppermutes all V slots at once, with rank 0
+        shifting slot j-1's arrival into slot j (sweep boundary)."""
+        key = ("vpp", n_micro)
+        if key in self._pp_fn_cache:
+            return self._pp_fn_cache[key]
+
+        pp = self._layers
+        S = pp.num_stages
+        V = pp.num_virtual_stages
+        D = S * V
+        if S == 1:
+            raise ValueError("num_virtual_pipeline_stages>1 requires pp>1")
+        remat = pp._recompute_interval and pp._recompute_interval > 0
+        (mesh, names, dp_live, mp_live, live_axes, param_specs,
+         rescale_mp, batch_spec) = self._schedule_env()
+        run_items = self._run_items
+
+        def spmd(x_mbs, y_mbs, base_key, *params):
+            s = lax.axis_index("pp")
+            params = rescale_mp(params)
+
+            with _tape.no_grad(), collective_ctx.axis_scope(*live_axes), \
+                    pp.use_state(dict(zip(names, params))):
+
+                def make_chunk_branch(d):
+                    items = pp.get_stage_layers(d)
+                    is_last = d == D - 1
+
+                    def br(x_mb, hid, y_mb, key):
+                        with random_state.fork_rng(key):
+                            src = Tensor(x_mb) if d == 0 else Tensor(hid)
+                            if is_last:
+                                out = run_items(items, src)
+                                loss = pp.compute_loss(out, Tensor(y_mb))
+                                return hid, jnp.mean(loss._data).astype(jnp.float32)
+                            out = run_items(items, src)
+                            return (out._data.astype(hid.dtype),
+                                    jnp.zeros((), jnp.float32))
+
+                    return jax.checkpoint(br) if remat else br
+
+                # slot j on rank r runs chunk j*S + r
+                branches = [[make_chunk_branch(j * S + r) for r in range(S)]
+                            for j in range(V)]
+
+                def stage0_shape(x_mb, key):
+                    with random_state.fork_rng(key):
+                        out = run_items(pp.get_stage_layers(0), Tensor(x_mb))
+                    return out._data
+
+                probe_key = jax.random.fold_in(base_key, 0)
+                hid_sd = jax.eval_shape(stage0_shape, x_mbs[0], probe_key)
+                hid0 = jnp.zeros((V,) + hid_sd.shape, hid_sd.dtype)
+
+                T = n_micro + D - 1
+                perm = [(i, (i + 1) % S) for i in range(S)]
+
+                def tick(carry, t):
+                    hid, loss_sum = carry          # hid [V, ...hidden]
+                    key_t = jax.random.fold_in(base_key, t)
+                    m0 = jnp.clip(t, 0, n_micro - 1)
+                    mL = jnp.clip(t - (D - 1), 0, n_micro - 1)
+                    x_mb = jnp.take(x_mbs, m0, axis=0)
+                    y_mb = jnp.take(y_mbs, mL, axis=0)
+                    outs = []
+                    loss_t = jnp.zeros((), jnp.float32)
+                    for j in range(V):
+                        h_j, l_j = lax.switch(jnp.minimum(s, S - 1),
+                                              branches[j], x_mb, hid[j],
+                                              y_mb, jax.random.fold_in(key_t, j))
+                        outs.append(h_j)
+                        loss_t = loss_t + l_j
+                    hid_out = jnp.stack(outs)          # [V, ...]
+                    valid = (t >= D - 1) & (t - (D - 1) < n_micro)
+                    loss_sum = loss_sum + jnp.where(valid, loss_t, 0.0)
+                    permuted = lax.ppermute(hid_out, "pp", perm)
+                    # sweep boundary: at rank 0, slot j's next input is what
+                    # rank S-1's slot j-1 just sent (slot 0 consumes x_mb)
+                    shifted = jnp.concatenate(
+                        [jnp.zeros_like(permuted[:1]), permuted[:-1]], axis=0)
+                    hid_next = jnp.where(s == 0, shifted, permuted)
+                    return (hid_next, loss_sum), None
+
+                (_, loss_sum), _ = lax.scan(
+                    tick, (hid0, jnp.zeros((), jnp.float32)), jnp.arange(T))
+
+            loss = lax.psum(loss_sum, "pp") / n_micro
+            if dp_live:
+                loss = lax.pmean(loss, "dp")
+            return loss
+
+        def pure(x_mbs, y_mbs, base_key, *params):
+            f = shard_map(
+                spmd, mesh=mesh,
+                in_specs=(batch_spec, batch_spec, P()) + param_specs,
+                out_specs=P(), check_vma=False)
+            return f(x_mbs, y_mbs, base_key, *params)
+
+        self._pp_fn_cache[key] = (pure, names)
+        return self._pp_fn_cache[key]
 
     def _loss_fn_for(self, n_micro):
         pure, names = self._pipeline_pure_fn(n_micro)

@@ -58,6 +58,14 @@ def _build_and_load():
     lib.pt_collate.argtypes = [ctypes.c_void_p,
                                ctypes.POINTER(ctypes.c_void_p),
                                ctypes.c_size_t, ctypes.c_size_t, ctypes.c_int]
+    lib.pt_pwrite_chunks.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                     ctypes.c_void_p, ctypes.c_uint64,
+                                     ctypes.c_int]
+    lib.pt_pwrite_chunks.restype = ctypes.c_int
+    lib.pt_pread_chunks.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                    ctypes.c_void_p, ctypes.c_uint64,
+                                    ctypes.c_int]
+    lib.pt_pread_chunks.restype = ctypes.c_int
     return lib
 
 
@@ -152,6 +160,38 @@ class StagingBuffer:
 
     def __exit__(self, *exc):
         self.release()
+
+
+# ------------------------------------------------------------ parallel IO
+
+def pwrite(path: str, offset: int, view) -> bool:
+    """Parallel positional write of a contiguous buffer (C-order bytes view).
+    Returns False (caller falls back to Python IO) if no native lib."""
+    lib = get_lib()
+    if lib is None:
+        return False
+    import numpy as np
+
+    arr = np.ascontiguousarray(view).view(np.uint8).reshape(-1)
+    rc = lib.pt_pwrite_chunks(path.encode(), offset,
+                              arr.ctypes.data_as(ctypes.c_void_p),
+                              arr.nbytes, 0)
+    if rc != 0:
+        raise OSError(rc, f"pt_pwrite_chunks({path!r}) failed")
+    return True
+
+
+def pread(path: str, offset: int, out) -> bool:
+    """Parallel positional read into a preallocated contiguous ndarray."""
+    lib = get_lib()
+    if lib is None:
+        return False
+    rc = lib.pt_pread_chunks(path.encode(), offset,
+                             out.ctypes.data_as(ctypes.c_void_p),
+                             out.nbytes, 0)
+    if rc != 0:
+        raise OSError(rc, f"pt_pread_chunks({path!r}) failed")
+    return True
 
 
 # --------------------------------------------------------------- collate

@@ -22,6 +22,7 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <cerrno>
 #include <functional>
 #include <mutex>
 #include <string>
@@ -315,6 +316,83 @@ void pt_collate(void* dst, void** srcs, size_t n, size_t bytes_per,
     for (size_t i = lo; i < hi; ++i)
       std::memcpy(out + i * bytes_per, srcs[i], bytes_per);
   });
+}
+
+}  // extern "C"
+
+// -------------------------------------------------- parallel checkpoint IO
+
+#include <fcntl.h>
+#include <unistd.h>
+
+extern "C" {
+
+// Parallel positional write of one contiguous buffer into path at
+// file_offset. Returns 0 on success, errno otherwise. The file must exist
+// (caller creates/truncates it and writes any header first).
+int pt_pwrite_chunks(const char* path, uint64_t file_offset, const void* buf,
+                     uint64_t nbytes, int nthreads) {
+  int fd = ::open(path, O_WRONLY);
+  if (fd < 0) return errno;
+  const char* src = static_cast<const char*>(buf);
+  std::atomic<int> err{0};
+  const uint64_t kChunk = 16ull << 20;
+  uint64_t n_tasks = (nbytes + kChunk - 1) / kChunk;
+  if (n_tasks <= 1) {
+    uint64_t off = 0;
+    while (off < nbytes) {
+      ssize_t w = ::pwrite(fd, src + off, nbytes - off, file_offset + off);
+      if (w < 0) { err.store(errno); break; }
+      off += (uint64_t)w;
+    }
+  } else {
+    pool(nthreads)->parallel_for(n_tasks, [&](size_t t) {
+      uint64_t lo = t * kChunk;
+      uint64_t hi = std::min(nbytes, lo + kChunk);
+      uint64_t off = lo;
+      while (off < hi) {
+        ssize_t w = ::pwrite(fd, src + off, hi - off, file_offset + off);
+        if (w < 0) { err.store(errno); return; }
+        off += (uint64_t)w;
+      }
+    });
+  }
+  ::close(fd);
+  return err.load();
+}
+
+// Parallel positional read into one contiguous buffer. Returns 0 or errno.
+int pt_pread_chunks(const char* path, uint64_t file_offset, void* buf,
+                    uint64_t nbytes, int nthreads) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return errno;
+  char* dst = static_cast<char*>(buf);
+  std::atomic<int> err{0};
+  const uint64_t kChunk = 16ull << 20;
+  uint64_t n_tasks = (nbytes + kChunk - 1) / kChunk;
+  if (n_tasks <= 1) {
+    uint64_t off = 0;
+    while (off < nbytes) {
+      ssize_t r = ::pread(fd, dst + off, nbytes - off, file_offset + off);
+      if (r < 0) { err.store(errno); break; }
+      if (r == 0) { err.store(EIO); break; }
+      off += (uint64_t)r;
+    }
+  } else {
+    pool(nthreads)->parallel_for(n_tasks, [&](size_t t) {
+      uint64_t lo = t * kChunk;
+      uint64_t hi = std::min(nbytes, lo + kChunk);
+      uint64_t off = lo;
+      while (off < hi) {
+        ssize_t r = ::pread(fd, dst + off, hi - off, file_offset + off);
+        if (r < 0) { err.store(errno); return; }
+        if (r == 0) { err.store(EIO); return; }
+        off += (uint64_t)r;
+      }
+    });
+  }
+  ::close(fd);
+  return err.load();
 }
 
 }  // extern "C"

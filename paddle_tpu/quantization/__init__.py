@@ -191,9 +191,19 @@ class QAT:
 
 class PTQ(QAT):
     """Post-training quantization: same wrappers, calibration-driven scales
-    (run representative batches through the quantized model, observers see
-    the activations)."""
-    pass
+    (run representative batches through the quantized model, stateful
+    observers record the activations). Defaults activations to EMAObserver —
+    a stateless observer would silently degrade to per-batch dynamic
+    quantization."""
+
+    def __init__(self, config=None):
+        if config is None:
+            config = QuantConfig(activation=EMAObserver())
+        elif not hasattr(config.activation, "observe"):
+            raise ValueError(
+                "PTQ needs a stateful activation observer (e.g. EMAObserver);"
+                f" got {type(config.activation).__name__}")
+        super().__init__(config)
 
 
 __all__ = [

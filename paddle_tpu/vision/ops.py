@@ -88,7 +88,13 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
 def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
               sampling_ratio=-1, aligned=True, name=None):
     """RoIAlign (ref roi_align): x [N,C,H,W], boxes [R,4] xyxy in input
-    coords, boxes_num [N] rois per image -> [R, C, out_h, out_w]."""
+    coords, boxes_num [N] rois per image -> [R, C, out_h, out_w].
+
+    Deviation from the reference: sampling_ratio=-1 uses a FIXED 2 samples
+    per bin per axis instead of the reference's adaptive
+    ceil(roi_size/out_size) — adaptive counts are data-dependent and cannot
+    be expressed with XLA static shapes. Pass an explicit sampling_ratio for
+    closer numerical parity on large RoIs."""
     if isinstance(output_size, int):
         output_size = (output_size, output_size)
     out_h, out_w = output_size
@@ -184,7 +190,7 @@ def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
         xs_all = jnp.arange(w)
         big_neg = jnp.asarray(-3.4e38, feat.dtype)
 
-        def bin_masks(rel, roi_len, n_bins, size):
+        def bin_masks(rel, roi_len, n_bins):
             """[n_bins, size] membership with the reference's overlapping
             floor/ceil boundaries: bin i covers
             [floor(i·L/n), ceil((i+1)·L/n))."""
@@ -197,8 +203,8 @@ def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
 
         def per_roi(ri):
             img = feat[img_idx[ri]]  # [C, H, W]
-            ymask = bin_masks(ys_all - y1[ri], roi_h[ri], out_h, h)
-            xmask = bin_masks(xs_all - x1[ri], roi_w[ri], out_w, w)
+            ymask = bin_masks(ys_all - y1[ri], roi_h[ri], out_h)
+            xmask = bin_masks(xs_all - x1[ri], roi_w[ri], out_w)
             # two-stage max keeps the transient at [C, H, out_w]
             col = jnp.stack(
                 [jnp.max(jnp.where(xmask[j][None, None, :], img, big_neg),

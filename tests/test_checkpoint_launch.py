@@ -90,3 +90,46 @@ class TestLauncher:
         assert out.returncode == 0, out.stderr
         logs = os.listdir(tmp_path / "logs")
         assert len(logs) == 2  # failed attempt + recovered attempt
+
+
+class TestNativeContainer:
+    def test_large_roundtrip_uses_container(self, tmp_path):
+        import numpy as np
+
+        p = str(tmp_path / "big.pdparams")
+        obj = {"w": paddle.to_tensor(np.arange(400_000, dtype=np.float32)),
+               "nested": {"b": paddle.to_tensor(np.ones((64, 64), np.float32)),
+                          "step": 7, "name": "x"},
+               "empty": paddle.to_tensor(np.zeros((0,), np.float32))}
+        paddle.save(obj, p)
+        with open(p, "rb") as f:
+            assert f.read(8) == b"PTCKPT01"
+        back = paddle.load(p)
+        np.testing.assert_array_equal(back["w"].numpy(), obj["w"].numpy())
+        np.testing.assert_array_equal(back["nested"]["b"].numpy(),
+                                      obj["nested"]["b"].numpy())
+        assert back["nested"]["step"] == 7
+        assert back["nested"]["name"] == "x"
+        assert back["empty"].numpy().shape == (0,)
+
+    def test_small_stays_pickle(self, tmp_path):
+        import numpy as np
+
+        p = str(tmp_path / "small.pdparams")
+        paddle.save({"w": paddle.to_tensor(np.ones(4, np.float32))}, p)
+        with open(p, "rb") as f:
+            assert f.read(1) == b"\x80"  # pickle protocol marker
+        back = paddle.load(p)
+        np.testing.assert_array_equal(back["w"].numpy(), np.ones(4))
+
+    def test_bf16_roundtrip(self, tmp_path):
+        import numpy as np
+        import jax.numpy as jnp
+        from paddle_tpu.core.tensor import Tensor
+
+        p = str(tmp_path / "bf16.pdparams")
+        t = Tensor(jnp.ones((600, 600), jnp.bfloat16) * 1.5)
+        paddle.save({"w": t}, p)
+        back = paddle.load(p)
+        assert back["w"].numpy().dtype == np.asarray(t._data).dtype
+        assert float(np.asarray(back["w"]._data)[0, 0]) == 1.5

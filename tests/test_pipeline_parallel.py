@@ -245,3 +245,49 @@ class TestPipelineTensorParallel:
             (paddle.to_tensor(x), paddle.to_tensor(y)), opt))
             for _ in range(3)]
         np.testing.assert_allclose(losses, ref, rtol=2e-4, atol=1e-5)
+
+
+class TestInterleavedVPP:
+    """num_virtual_pipeline_stages > 1: chunks interleave round-robin over
+    ranks; losses must still match the serial model exactly."""
+
+    @pytest.mark.parametrize("pp,v,n_micro", [(2, 2, 4), (4, 2, 8)])
+    def test_vpp_matches_serial(self, pp, v, n_micro):
+        def vdescs():
+            return ([LayerDesc(nn.Linear, 8, H)] +
+                    [LayerDesc(Block) for _ in range(2 * pp * v - 2)] +
+                    [LayerDesc(Head)])
+
+        dist.set_hybrid_communicate_group(None)
+        dist.create_hybrid_communicate_group(pp=1)
+        paddle.seed(21)
+        serial_model = PipelineLayer(vdescs(), loss_fn=_mse)
+        ref = _serial_losses(serial_model, n_micro=n_micro)
+
+        dist.set_hybrid_communicate_group(None)
+        hcg = dist.create_hybrid_communicate_group(pp=pp)
+        paddle.seed(21)
+        model = PipelineLayer(vdescs(), loss_fn=_mse,
+                              num_virtual_pipeline_stages=v)
+        assert len(model.segment_parts) == pp * v + 1
+        ppm = PipelineParallel(model, hcg=hcg,
+                               strategy={"accumulate_steps": n_micro})
+        opt = paddle.optimizer.Momentum(learning_rate=0.05,
+                                        parameters=ppm.parameters())
+        x, y = _batch()
+        losses = [float(ppm.train_batch(
+            (paddle.to_tensor(x), paddle.to_tensor(y)), opt))
+            for _ in range(3)]
+        np.testing.assert_allclose(losses, ref, rtol=2e-4, atol=1e-5)
+
+    def test_vpp_chunk_ownership(self):
+        dist.set_hybrid_communicate_group(None)
+        dist.create_hybrid_communicate_group(pp=2)
+        pl = PipelineLayer(_descs(), loss_fn=_mse,
+                           num_virtual_pipeline_stages=2)
+        # 8 items over 4 chunks; rank r owns chunks r and r+2
+        all_names = set(pl.state_dict())
+        s0 = set(pl.stage_param_names(0))
+        s1 = set(pl.stage_param_names(1))
+        assert s0 | s1 == all_names
+        assert not (s0 & s1)
