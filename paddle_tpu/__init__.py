@@ -34,6 +34,7 @@ _LAZY_SUBMODULES = (
     "linalg", "fft", "vision", "distributed", "incubate", "profiler", "metric",
     "framework", "hapi", "models", "ops", "utils", "distribution", "sparse",
     "text", "audio", "onnx", "inference", "signal", "quantization",
+    "regularizer", "version", "sysconfig",
 )
 
 _LAZY_ATTRS = {

@@ -70,6 +70,7 @@ def _interpret_default():
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
                 *, scale, causal, bq, bk, n_k, kv_len):
+    i = pl.program_id(1)
     j = pl.program_id(2)
 
     @pl.when(j == 0)
@@ -78,21 +79,29 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    q = q_ref[0]  # (bq, d)
-    k = k_ref[0]  # (bk, d)
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
-    s = _mask(s, causal, kv_len, pl.program_id(1), j, bq, bk)
+    def body():
+        q = q_ref[0]  # (bq, d)
+        k = k_ref[0]  # (bk, d)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        s = _mask(s, causal, kv_len, i, j, bq, bk)
 
-    m_prev = m_scr[:]                      # (bq, 1)
-    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-    p = jnp.exp(s - m_new)
-    alpha = jnp.exp(m_prev - m_new)
-    l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
-    m_scr[:] = m_new
-    acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-        p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
+        m_prev = m_scr[:]                      # (bq, 1)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        m_scr[:] = m_new
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        # k-blocks entirely above the diagonal contribute nothing — skip
+        # their MXU/VPU work (the DMA still runs; compute dominates)
+        pl.when(j * bk <= (i + 1) * bq - 1)(body)
+    else:
+        body()
 
     @pl.when(j == n_k - 1)
     def _():
@@ -141,25 +150,32 @@ def _flash_fwd(q, k, v, scale, causal, interpret, kv_len=None):
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                dq_scr, *, scale, causal, bq, bk, n_k, kv_len):
+    i = pl.program_id(1)
     j = pl.program_id(2)
 
     @pl.when(j == 0)
     def _():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    q = q_ref[0]
-    k = k_ref[0]
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
-    s = _mask(s, causal, kv_len, pl.program_id(1), j, bq, bk)
+    def body():
+        q = q_ref[0]
+        k = k_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        s = _mask(s, causal, kv_len, i, j, bq, bk)
 
-    p = jnp.exp(s - lse_ref[0])                          # (bq, bk)
-    dp = jax.lax.dot_general(do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-    ds = p * (dp - delta_ref[0]) * scale
-    dq_scr[:] += jax.lax.dot_general(ds.astype(k.dtype), k,
-                                     (((1,), (0,)), ((), ())),
-                                     preferred_element_type=jnp.float32)
+        p = jnp.exp(s - lse_ref[0])                          # (bq, bk)
+        dp = jax.lax.dot_general(do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0]) * scale
+        dq_scr[:] += jax.lax.dot_general(ds.astype(k.dtype), k,
+                                         (((1,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(j * bk <= (i + 1) * bq - 1)(body)
+    else:
+        body()
 
     @pl.when(j == n_k - 1)
     def _():
@@ -169,30 +185,37 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal, bq, bk,
                 n_q, kv_len):
-    i = pl.program_id(2)  # q-block index (innermost: accumulation axis)
+    jb = pl.program_id(1)  # k-block index
+    i = pl.program_id(2)   # q-block index (innermost: accumulation axis)
 
     @pl.when(i == 0)
     def _():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    q = q_ref[0]
-    k = k_ref[0]
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
-    s = _mask(s, causal, kv_len, i, pl.program_id(1), bq, bk)
+    def body():
+        q = q_ref[0]
+        k = k_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        s = _mask(s, causal, kv_len, i, jb, bq, bk)
 
-    p = jnp.exp(s - lse_ref[0])                          # (bq, bk)
-    do = do_ref[0]
-    dv_scr[:] += jax.lax.dot_general(p.astype(do.dtype), do,
-                                     (((0,), (0,)), ((), ())),
-                                     preferred_element_type=jnp.float32)
-    dp = jax.lax.dot_general(do, v_ref[0], (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-    ds = p * (dp - delta_ref[0]) * scale
-    dk_scr[:] += jax.lax.dot_general(ds.astype(q.dtype), q,
-                                     (((0,), (0,)), ((), ())),
-                                     preferred_element_type=jnp.float32)
+        p = jnp.exp(s - lse_ref[0])                          # (bq, bk)
+        do = do_ref[0]
+        dv_scr[:] += jax.lax.dot_general(p.astype(do.dtype), do,
+                                         (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v_ref[0], (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0]) * scale
+        dk_scr[:] += jax.lax.dot_general(ds.astype(q.dtype), q,
+                                         (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(jb * bk <= (i + 1) * bq - 1)(body)
+    else:
+        body()
 
     @pl.when(i == n_q - 1)
     def _():

@@ -27,12 +27,20 @@ class Optimizer:
         self._parameter_list = list(parameters)
         self._learning_rate = learning_rate
         self._grad_clip = grad_clip
+        self._regularizer_fn = None
         if weight_decay is None:
             self._weight_decay = 0.0
         elif isinstance(weight_decay, (int, float)):
             self._weight_decay = float(weight_decay)
-        else:  # L2Decay-style object
+        else:  # paddle.regularizer object (L2Decay coeff path; L1Decay et al
+            # contribute through their gradient-term callable)
             self._weight_decay = float(getattr(weight_decay, "_coeff", getattr(weight_decay, "coeff", 0.0)))
+            from ..regularizer import L2Decay, WeightDecayRegularizer
+
+            if (isinstance(weight_decay, WeightDecayRegularizer)
+                    and not isinstance(weight_decay, L2Decay)):
+                self._weight_decay = 0.0
+                self._regularizer_fn = weight_decay
         self._accumulators = {}  # param id -> dict(state_name -> jnp array)
         self._step_count = 0
         self._param_names = {}
@@ -100,6 +108,14 @@ class Optimizer:
         """AdamW-style decoupled decay skips biases/norms by convention flag."""
         return getattr(p, "no_weight_decay", False)
 
+    def _regularizer_for(self, p):
+        """Gradient-term regularizer for `p`: the ParamAttr-attached one wins
+        over the optimizer-level weight_decay (reference precedence)."""
+        per_param = getattr(p, "regularizer", None)
+        if per_param is not None and callable(per_param):
+            return per_param
+        return self._regularizer_fn
+
     def step(self):
         params_grads = [(p, p.grad) for p in self._parameter_list
                         if p.trainable and p.grad is not None]
@@ -113,7 +129,11 @@ class Optimizer:
                     continue
                 state = self._state_for(p)
                 param_lr = lr * p.optimize_attr.get("learning_rate", 1.0)
-                new_p, new_state = self._update(p._data, g._data, state, param_lr)
+                g_arr = g._data
+                reg = self._regularizer_for(p)
+                if reg is not None and not self._decay_exempt(p):
+                    g_arr = g_arr + reg(p._data)
+                new_p, new_state = self._update(p._data, g_arr, state, param_lr)
                 p._data = new_p
                 self._accumulators[id(p)] = new_state
 

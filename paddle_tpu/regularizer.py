@@ -1,0 +1,40 @@
+"""paddle.regularizer parity (ref: python/paddle/regularizer.py (U)).
+
+L1/L2 weight decay attached via ParamAttr or the optimizer's
+`weight_decay=` argument; the optimizer applies `loss_grad_term(p)` to each
+gradient before the update (decoupled decay stays in AdamW)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class WeightDecayRegularizer:
+    def __call__(self, param_array):
+        """Gradient contribution d(penalty)/d(param)."""
+        raise NotImplementedError
+
+
+class L1Decay(WeightDecayRegularizer):
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def __call__(self, param_array):
+        return self.coeff * jnp.sign(param_array)
+
+    def __repr__(self):
+        return f"L1Decay(coeff={self.coeff})"
+
+
+class L2Decay(WeightDecayRegularizer):
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def __call__(self, param_array):
+        return self.coeff * param_array
+
+    def __repr__(self):
+        return f"L2Decay(coeff={self.coeff})"
+
+
+__all__ = ["WeightDecayRegularizer", "L1Decay", "L2Decay"]

@@ -1,0 +1,11 @@
+"""paddle.sysconfig parity (ref: python/paddle/sysconfig.py (U))."""
+
+import os
+
+
+def get_include():
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), "include")
+
+
+def get_lib():
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), "native")

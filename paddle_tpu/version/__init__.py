@@ -1,0 +1,46 @@
+"""paddle.version parity (ref: generated python/paddle/version/__init__.py)."""
+
+full_version = "0.1.0"
+major = "0"
+minor = "1"
+patch = "0"
+rc = "0"
+cuda_version = "False"   # TPU build: no CUDA
+cudnn_version = "False"
+tensorrt_version = "None"
+xpu_version = "False"
+istaged = False
+commit = "unknown"
+with_pip_cuda_libraries = "OFF"
+
+
+def show():
+    print(f"full_version: {full_version}")
+    print(f"major: {major}")
+    print(f"minor: {minor}")
+    print(f"patch: {patch}")
+    print(f"rc: {rc}")
+    print(f"commit: {commit}")
+    print("tpu: True (jax/XLA backend)")
+
+
+def cuda():
+    return cuda_version
+
+
+def cudnn():
+    return cudnn_version
+
+
+def xpu():
+    return xpu_version
+
+
+def tpu():
+    import jax
+
+    try:
+        devs = jax.devices()
+        return devs[0].device_kind if devs else "none"
+    except Exception:
+        return "none"

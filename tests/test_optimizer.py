@@ -201,3 +201,64 @@ class TestGradScaler:
         scaler.update()
         np.testing.assert_allclose(p.numpy(), [1.0])  # step skipped
         assert float(scaler.get_loss_scaling()) == 4.0  # halved
+
+
+class TestRegularizer:
+    def test_l1_decay_adds_sign_term(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        from paddle_tpu.regularizer import L1Decay, L2Decay
+
+        paddle.seed(0)
+        lin = nn.Linear(4, 4, bias_attr=False)
+        w0 = lin.weight.numpy().copy()
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=lin.parameters(),
+                                   weight_decay=L1Decay(0.5))
+        x = paddle.to_tensor(np.zeros((2, 4), np.float32))
+        loss = paddle.mean(lin(x))  # zero input -> zero data gradient
+        loss.backward()
+        opt.step()
+        # pure L1 step: w -= lr * coeff * sign(w)
+        np.testing.assert_allclose(lin.weight.numpy(),
+                                   w0 - 0.1 * 0.5 * np.sign(w0), atol=1e-6)
+
+    def test_l2_decay_coeff_path(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        from paddle_tpu.regularizer import L2Decay
+
+        paddle.seed(0)
+        lin = nn.Linear(4, 4, bias_attr=False)
+        w0 = lin.weight.numpy().copy()
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=lin.parameters(),
+                                   weight_decay=L2Decay(0.5))
+        x = paddle.to_tensor(np.zeros((2, 4), np.float32))
+        loss = paddle.mean(lin(x))
+        loss.backward()
+        opt.step()
+        np.testing.assert_allclose(lin.weight.numpy(), w0 - 0.1 * 0.5 * w0,
+                                   atol=1e-6)
+
+    def test_param_attr_regularizer_wins(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        from paddle_tpu.framework.param_attr import ParamAttr
+        from paddle_tpu.regularizer import L1Decay
+
+        paddle.seed(0)
+        lin = nn.Linear(4, 4, bias_attr=False,
+                        weight_attr=ParamAttr(regularizer=L1Decay(0.5)))
+        w0 = lin.weight.numpy().copy()
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=lin.parameters())
+        x = paddle.to_tensor(np.zeros((2, 4), np.float32))
+        loss = paddle.mean(lin(x))
+        loss.backward()
+        opt.step()
+        np.testing.assert_allclose(lin.weight.numpy(),
+                                   w0 - 0.1 * 0.5 * np.sign(w0), atol=1e-6)
