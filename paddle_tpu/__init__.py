@@ -34,7 +34,7 @@ _LAZY_SUBMODULES = (
     "linalg", "fft", "vision", "distributed", "incubate", "profiler", "metric",
     "framework", "hapi", "models", "ops", "utils", "distribution", "sparse",
     "text", "audio", "onnx", "inference", "signal", "quantization",
-    "regularizer", "version", "sysconfig",
+    "regularizer", "version", "sysconfig", "geometric",
 )
 
 _LAZY_ATTRS = {

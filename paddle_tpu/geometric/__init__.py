@@ -1,0 +1,123 @@
+"""paddle.geometric parity (ref: python/paddle/geometric/ (U): segment ops +
+message passing backed by CUDA scatter kernels). TPU-native:
+jax.ops.segment_* — XLA lowers them to sorted-scatter, which is the TPU-
+efficient form of the reference's atomics-based kernels."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.op_call import apply
+from ..tensor.creation import _as_t
+
+
+def _seg(fn_name, jfn, x, segment_ids):
+    xt, st = _as_t(x), _as_t(segment_ids)
+
+    def f(a, ids):
+        n = int(jnp.max(ids)) + 1 if not isinstance(
+            ids, jax.core.Tracer) else None
+        if n is None:
+            raise ValueError(f"{fn_name}: segment_ids must be concrete "
+                             f"(static segment count) under jit")
+        out = jfn(a, ids.astype(jnp.int32), num_segments=n)
+        if fn_name in ("segment_max", "segment_min"):
+            # reference fills segments with no members with 0, not ±inf
+            out = jnp.where(jnp.isfinite(out), out, 0.0)
+        return out
+
+    return apply(f, xt, st, _op_name=fn_name)
+
+
+def segment_sum(data, segment_ids, name=None):
+    return _seg("segment_sum", jax.ops.segment_sum, data, segment_ids)
+
+
+def segment_mean(data, segment_ids, name=None):
+    xt, st = _as_t(data), _as_t(segment_ids)
+
+    def f(a, ids):
+        n = int(jnp.max(ids)) + 1
+        ids = ids.astype(jnp.int32)
+        s = jax.ops.segment_sum(a, ids, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones((a.shape[0],), a.dtype), ids,
+                                  num_segments=n)
+        return s / jnp.maximum(cnt, 1.0).reshape((-1,) + (1,) * (a.ndim - 1))
+
+    return apply(f, xt, st, _op_name="segment_mean")
+
+
+def segment_max(data, segment_ids, name=None):
+    return _seg("segment_max", jax.ops.segment_max, data, segment_ids)
+
+
+def segment_min(data, segment_ids, name=None):
+    return _seg("segment_min", jax.ops.segment_min, data, segment_ids)
+
+
+def _scatter_reduce(msgs, dst, reduce_op, n):
+    """Scatter-reduce messages onto n destination rows; empty rows -> 0
+    (reference fill convention)."""
+    dst32 = dst.astype(jnp.int32)
+    if reduce_op == "sum":
+        return jax.ops.segment_sum(msgs, dst32, num_segments=n)
+    if reduce_op == "mean":
+        s = jax.ops.segment_sum(msgs, dst32, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones((msgs.shape[0],), msgs.dtype),
+                                  dst32, num_segments=n)
+        return s / jnp.maximum(cnt, 1.0).reshape(
+            (-1,) + (1,) * (msgs.ndim - 1))
+    if reduce_op == "max":
+        out = jax.ops.segment_max(msgs, dst32, num_segments=n)
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+    if reduce_op == "min":
+        out = jax.ops.segment_min(msgs, dst32, num_segments=n)
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+    raise ValueError(f"reduce_op {reduce_op!r}")
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """ref send_u_recv: gather x[src], scatter-reduce onto dst."""
+    xt = _as_t(x)
+    st = _as_t(src_index)
+    dt = _as_t(dst_index)
+
+    def f(a, src, dst):
+        msgs = a[src.astype(jnp.int32)]
+        return _scatter_reduce(msgs, dst, reduce_op,
+                               int(out_size) if out_size is not None
+                               else a.shape[0])
+
+    return apply(f, xt, st, dt, _op_name="send_u_recv")
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """ref send_ue_recv: combine node features x[src] with edge features y,
+    then scatter-reduce onto dst."""
+    xt, yt = _as_t(x), _as_t(y)
+    st, dt = _as_t(src_index), _as_t(dst_index)
+
+    def f(a, e, src, dst):
+        msgs = a[src.astype(jnp.int32)]
+        if message_op == "add":
+            msgs = msgs + e
+        elif message_op == "sub":
+            msgs = msgs - e
+        elif message_op == "mul":
+            msgs = msgs * e
+        elif message_op == "div":
+            msgs = msgs / e
+        else:
+            raise ValueError(f"message_op {message_op!r}")
+        return _scatter_reduce(msgs, dst, reduce_op,
+                               int(out_size) if out_size is not None
+                               else a.shape[0])
+
+    return apply(f, xt, yt, st, dt, _op_name="send_ue_recv")
+
+
+__all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min",
+           "send_u_recv", "send_ue_recv"]

@@ -102,11 +102,7 @@ class TrainStep:
                                 new_opt_states.append(st)
                                 continue
                             plr = lr * p.optimize_attr.get("learning_rate", 1.0)
-                            g_arr = g._data
-                            reg = (opt._regularizer_for(p)
-                                   if hasattr(opt, "_regularizer_for") else None)
-                            if reg is not None and not opt._decay_exempt(p):
-                                g_arr = g_arr + reg(p._data)
+                            g_arr = opt._regularized_grad(p, g._data)
                             np_, nst = opt._update(p._data, g_arr, st, plr)
                             if scaler is not None:
                                 # skip the step on inf/nan grads

@@ -10,7 +10,9 @@ from .common import (
     linear, dropout, dropout2d, dropout3d, alpha_dropout, pad, zeropad2d,
     embedding, one_hot, cosine_similarity, pixel_shuffle, pixel_unshuffle,
     channel_shuffle, interpolate, upsample, unfold, fold, label_smooth, bilinear,
+    sequence_mask,
 )
+from .vision import grid_sample, affine_grid, temporal_shift
 from .conv import (
     conv1d, conv2d, conv3d, conv1d_transpose, conv2d_transpose, conv3d_transpose,
 )
@@ -28,7 +30,7 @@ from .loss import (
     smooth_l1_loss, binary_cross_entropy, binary_cross_entropy_with_logits,
     kl_div, margin_ranking_loss, cosine_embedding_loss, hinge_embedding_loss,
     triplet_margin_loss, square_error_cost, sigmoid_focal_loss, log_loss,
-    ctc_loss,
+    ctc_loss, margin_cross_entropy,
 )
 from .attention import (
     scaled_dot_product_attention, flash_attention, flash_attn_unpadded, sdp_kernel,

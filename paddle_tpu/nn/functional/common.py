@@ -312,3 +312,28 @@ def bilinear(x1, x2, weight, bias=None, name=None):
     if bias is not None:
         args.append(_as_t(bias))
     return apply(f, *args, _op_name="bilinear")
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """ref F.sequence_mask: lengths -> [..., maxlen] 0/1 mask.
+
+    NOTE: with maxlen=None the mask width is read from the concrete input,
+    so under jit/to_static tracing `maxlen` must be passed explicitly
+    (static output shapes are an XLA requirement)."""
+    import jax.numpy as jnp
+
+    from ...core.dtype import to_jax_dtype
+    from ...tensor.creation import _as_t
+    from ...core.op_call import apply as _apply
+
+    xt = _as_t(x)
+    if maxlen is None:
+        import numpy as np
+
+        maxlen = int(np.asarray(xt._data).max())
+
+    def f(lens):
+        idx = jnp.arange(maxlen)
+        return (idx < lens[..., None]).astype(to_jax_dtype(dtype))
+
+    return _apply(f, xt, _op_name="sequence_mask")

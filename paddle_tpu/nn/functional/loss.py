@@ -281,3 +281,41 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction
         f, _as_t(log_probs), _as_t(labels).detach(), _as_t(input_lengths).detach(),
         _as_t(label_lengths).detach(), _op_name="ctc_loss",
     )
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
+                         scale=64.0, group=None, return_softmax=False,
+                         reduction="mean", name=None):
+    """ref F.margin_cross_entropy (ArcFace/CosFace combined margin):
+    cos(m1*theta + m2) - m3 applied to the target logit, then scaled CE.
+    The reference's class-parallel (group) path maps to vocab-parallel CE
+    under GSPMD; here logits are the full class dim."""
+    import jax
+    import jax.numpy as jnp
+
+    from ...core.op_call import apply as _apply
+    from ...tensor.creation import _as_t
+
+    lt, yt = _as_t(logits), _as_t(label)
+
+    def f(lg, y):
+        # clip strictly inside (-1, 1): d(arccos)/dx is infinite at ±1 and
+        # would NaN the whole gradient row
+        eps = 1e-6
+        cos = jnp.clip(lg, -1.0 + eps, 1.0 - eps)
+        n, c = cos.shape
+        onehot = jax.nn.one_hot(y.astype(jnp.int32), c, dtype=cos.dtype)
+        theta = jnp.arccos(cos)
+        target_cos = jnp.cos(margin1 * theta + margin2) - margin3
+        adj = jnp.where(onehot > 0, target_cos, cos) * scale
+        logp = jax.nn.log_softmax(adj, axis=-1)
+        loss = -jnp.sum(onehot * logp, axis=-1)
+        if reduction == "mean":
+            loss = jnp.mean(loss)
+        elif reduction == "sum":
+            loss = jnp.sum(loss)
+        if return_softmax:
+            return loss, jax.nn.softmax(adj, axis=-1)
+        return loss
+
+    return _apply(f, lt, yt, _op_name="margin_cross_entropy")

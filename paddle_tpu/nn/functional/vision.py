@@ -1,0 +1,131 @@
+"""Vision functionals (ref: python/paddle/nn/functional/vision.py (U):
+grid_sample/affine_grid backed by CUDA kernels; temporal_shift in
+paddle/fluid/operators). TPU-native: pure gather/arithmetic, fully jittable
+with static shapes."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.op_call import apply
+from ...tensor.creation import _as_t
+
+
+def _unnormalize(coord, size, align_corners):
+    if align_corners:
+        return (coord + 1.0) / 2.0 * (size - 1)
+    return ((coord + 1.0) * size - 1.0) / 2.0
+
+
+def _reflect(x, lo, hi):
+    """Reflect coordinates into [lo, hi] (scipy 'reflect' with half-sample
+    offsets folded in by the caller)."""
+    rng = hi - lo
+    if rng <= 0:
+        return jnp.zeros_like(x)
+    x = jnp.abs(x - lo) % (2 * rng)
+    return lo + jnp.where(x > rng, 2 * rng - x, x)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """ref F.grid_sample: x [N,C,H,W], grid [N,Hg,Wg,2] in [-1,1] (xy order)
+    -> [N,C,Hg,Wg]."""
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(f"grid_sample mode {mode!r} not supported")
+    if padding_mode not in ("zeros", "border", "reflection"):
+        raise ValueError(f"grid_sample padding_mode {padding_mode!r}")
+
+    xt, gt = _as_t(x), _as_t(grid)
+
+    def f(img, g):
+        n, c, h, w = img.shape
+        gx = _unnormalize(g[..., 0], w, align_corners)   # [N,Hg,Wg]
+        gy = _unnormalize(g[..., 1], h, align_corners)
+
+        if padding_mode == "reflection":
+            if align_corners:
+                gx = _reflect(gx, 0.0, w - 1.0)
+                gy = _reflect(gy, 0.0, h - 1.0)
+            else:
+                gx = jnp.clip(_reflect(gx, -0.5, w - 0.5), 0, w - 1)
+                gy = jnp.clip(_reflect(gy, -0.5, h - 0.5), 0, h - 1)
+        elif padding_mode == "border":
+            gx = jnp.clip(gx, 0, w - 1)
+            gy = jnp.clip(gy, 0, h - 1)
+
+        def gather(iy, ix):
+            """img[n, :, iy, ix] with out-of-range -> 0; iy/ix [N,Hg,Wg]."""
+            inside = ((iy >= 0) & (iy <= h - 1) & (ix >= 0) & (ix <= w - 1))
+            iyc = jnp.clip(iy, 0, h - 1).astype(jnp.int32)
+            ixc = jnp.clip(ix, 0, w - 1).astype(jnp.int32)
+            out = jax.vmap(lambda im, yy, xx: im[:, yy, xx])(img, iyc, ixc)
+            # out [N, C, Hg, Wg]; mask out-of-range (zeros padding)
+            return out * inside[:, None].astype(img.dtype)
+
+        if mode == "nearest":
+            return gather(jnp.round(gy), jnp.round(gx))
+
+        x0 = jnp.floor(gx)
+        y0 = jnp.floor(gy)
+        x1 = x0 + 1
+        y1 = y0 + 1
+        wx = (gx - x0)[:, None]
+        wy = (gy - y0)[:, None]
+        v00 = gather(y0, x0)
+        v01 = gather(y0, x1)
+        v10 = gather(y1, x0)
+        v11 = gather(y1, x1)
+        return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+                + v10 * wy * (1 - wx) + v11 * wy * wx)
+
+    return apply(f, xt, gt, _op_name="grid_sample")
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """ref F.affine_grid: theta [N,2,3] -> sampling grid [N,H,W,2]."""
+    th = _as_t(theta)
+    if hasattr(out_shape, "numpy"):
+        out_shape = [int(v) for v in out_shape.numpy()]
+    n, c, h, w = [int(v) for v in out_shape]
+
+    def f(t):
+        if align_corners:
+            xs = jnp.linspace(-1.0, 1.0, w)
+            ys = jnp.linspace(-1.0, 1.0, h)
+        else:
+            xs = (jnp.arange(w) * 2 + 1) / w - 1.0
+            ys = (jnp.arange(h) * 2 + 1) / h - 1.0
+        gx, gy = jnp.meshgrid(xs, ys)                    # [H, W]
+        base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # [H,W,3]
+        # [N,H,W,2] = base @ theta^T
+        return jnp.einsum("hwk,njk->nhwj", base, t)
+
+    return apply(f, th, _op_name="affine_grid")
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
+    """ref temporal_shift op (TSM): shift 1/r channels forward in time,
+    1/r backward, rest unchanged. x [N*T, C, H, W]."""
+    xt = _as_t(x)
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError(data_format)
+
+    def f(a):
+        if data_format == "NHWC":
+            a = jnp.transpose(a, (0, 3, 1, 2))
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        a = a.reshape(n, seg_num, c, h, w)
+        fold = int(c * shift_ratio)
+        pad = jnp.pad(a, ((0, 0), (1, 1), (0, 0), (0, 0), (0, 0)))
+        fwd = pad[:, :seg_num, :fold]           # shift left (from t-1)
+        bwd = pad[:, 2:, fold:2 * fold]         # shift right (from t+1)
+        keep = a[:, :, 2 * fold:]
+        out = jnp.concatenate([fwd, bwd, keep], axis=2).reshape(nt, c, h, w)
+        if data_format == "NHWC":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+
+    return apply(f, xt, _op_name="temporal_shift")

@@ -108,13 +108,24 @@ class Optimizer:
         """AdamW-style decoupled decay skips biases/norms by convention flag."""
         return getattr(p, "no_weight_decay", False)
 
-    def _regularizer_for(self, p):
-        """Gradient-term regularizer for `p`: the ParamAttr-attached one wins
-        over the optimizer-level weight_decay (reference precedence)."""
+    def _regularized_grad(self, p, g_arr):
+        """Add the winning gradient-term regularizer to `g_arr` (reference
+        precedence: the ParamAttr-attached regularizer REPLACES the
+        optimizer-level one). Since coupled optimizers apply
+        self._weight_decay inside _update (_apply_l2), a per-param override
+        cancels that term here; AdamW's decoupled decay is a separate
+        mechanism and stays."""
+        if self._decay_exempt(p):
+            return g_arr
         per_param = getattr(p, "regularizer", None)
         if per_param is not None and callable(per_param):
-            return per_param
-        return self._regularizer_fn
+            g_arr = g_arr + per_param(p._data)
+            if self._weight_decay:
+                g_arr = g_arr - self._weight_decay * p._data
+            return g_arr
+        if self._regularizer_fn is not None:
+            g_arr = g_arr + self._regularizer_fn(p._data)
+        return g_arr
 
     def step(self):
         params_grads = [(p, p.grad) for p in self._parameter_list
@@ -129,10 +140,7 @@ class Optimizer:
                     continue
                 state = self._state_for(p)
                 param_lr = lr * p.optimize_attr.get("learning_rate", 1.0)
-                g_arr = g._data
-                reg = self._regularizer_for(p)
-                if reg is not None and not self._decay_exempt(p):
-                    g_arr = g_arr + reg(p._data)
+                g_arr = self._regularized_grad(p, g._data)
                 new_p, new_state = self._update(p._data, g_arr, state, param_lr)
                 p._data = new_p
                 self._accumulators[id(p)] = new_state

@@ -25,7 +25,8 @@ from .math import (
     nanmean, max, min, amax, amin, prod, std, var, logsumexp, cumsum,
     cumprod, cummax, cummin, count_nonzero, diff, trace, add_n, matmul, mm,
     bmm, dot, inner, outer, kron, mv, addmm, cross, allclose, isclose,
-    equal_all, increment, multiplex,
+    equal_all, increment, multiplex, bincount, trapezoid,
+    cumulative_trapezoid, vander,
 )
 from .manipulation import (
     reshape, reshape_, transpose, t, moveaxis, swapaxes, flatten, squeeze,

@@ -538,3 +538,60 @@ def multiplex(inputs, index, name=None):
         return stacked[sel, rows]
 
     return apply(f, idx, *ts, _op_name="multiplex")
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    """ref paddle.bincount. Note: under jit the output length must be
+    static, so the count is taken from the concrete input."""
+    import numpy as np
+
+    xt = _as_t(x)
+    # NB: builtins.max — this module shadows `max` with the paddle reduction
+    n = int(np.asarray(xt._data).max()) + 1 if xt._data.size else 0
+    if int(minlength) > n:
+        n = int(minlength)
+    args = [xt] + ([_as_t(weights)] if weights is not None else [])
+
+    def f(a, *w):
+        return jnp.bincount(a.astype(jnp.int32), w[0] if w else None, length=n)
+
+    return apply(f, *args, _op_name="bincount")
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """ref paddle.trapezoid (trapezoidal rule integration)."""
+    yt = _as_t(y)
+    if x is not None:
+        xt = _as_t(x)
+        return apply(lambda a, b: jnp.trapezoid(a, b, axis=axis), yt, xt,
+                     _op_name="trapezoid")
+    d = 1.0 if dx is None else dx
+    return apply(lambda a: jnp.trapezoid(a, dx=d, axis=axis), yt,
+                 _op_name="trapezoid")
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """ref paddle.cumulative_trapezoid."""
+    yt = _as_t(y)
+
+    def f(a, *b):
+        a = jnp.moveaxis(a, axis, -1)
+        mids = (a[..., 1:] + a[..., :-1]) / 2.0
+        if b:
+            xv = jnp.moveaxis(b[0], axis, -1)
+            steps = xv[..., 1:] - xv[..., :-1]
+        else:
+            steps = 1.0 if dx is None else dx
+        out = jnp.cumsum(mids * steps, axis=-1)
+        return jnp.moveaxis(out, -1, axis)
+
+    args = [yt] + ([_as_t(x)] if x is not None else [])
+    return apply(f, *args, _op_name="cumulative_trapezoid")
+
+
+def vander(x, n=None, increasing=False, name=None):
+    """ref paddle.vander (Vandermonde matrix)."""
+    xt = _as_t(x)
+    cols = n if n is not None else xt.shape[0]
+    return apply(lambda a: jnp.vander(a, cols, increasing=increasing), xt,
+                 _op_name="vander")

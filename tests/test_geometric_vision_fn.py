@@ -1,0 +1,178 @@
+"""paddle.geometric segment ops, grid_sample/affine_grid/temporal_shift,
+sequence_mask, margin CE, and new tensor math vs NumPy/scipy references."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.geometric as G
+import paddle_tpu.nn.functional as F
+
+
+def _t(x):
+    return paddle.to_tensor(np.asarray(x))
+
+
+class TestSegmentOps:
+    def test_segment_sum_mean_max_min(self):
+        data = _t(np.array([[1., 2.], [3., 4.], [5., 6.], [7., 8.]],
+                           np.float32))
+        ids = _t(np.array([0, 0, 1, 1], np.int64))
+        np.testing.assert_allclose(G.segment_sum(data, ids).numpy(),
+                                   [[4, 6], [12, 14]])
+        np.testing.assert_allclose(G.segment_mean(data, ids).numpy(),
+                                   [[2, 3], [6, 7]])
+        np.testing.assert_allclose(G.segment_max(data, ids).numpy(),
+                                   [[3, 4], [7, 8]])
+        np.testing.assert_allclose(G.segment_min(data, ids).numpy(),
+                                   [[1, 2], [5, 6]])
+
+    def test_send_u_recv(self):
+        x = _t(np.array([[1.0], [2.0], [4.0]], np.float32))
+        src = _t(np.array([0, 1, 2, 0], np.int64))
+        dst = _t(np.array([1, 2, 1, 0], np.int64))
+        out = G.send_u_recv(x, src, dst, reduce_op="sum").numpy()
+        np.testing.assert_allclose(out, [[1.0], [5.0], [2.0]])
+
+    def test_send_ue_recv_mul(self):
+        x = _t(np.array([[2.0], [3.0]], np.float32))
+        e = _t(np.array([[10.0], [100.0]], np.float32))
+        src = _t(np.array([0, 1], np.int64))
+        dst = _t(np.array([0, 0], np.int64))
+        out = G.send_ue_recv(x, e, src, dst, message_op="mul",
+                             reduce_op="sum").numpy()
+        np.testing.assert_allclose(out, [[320.0], [0.0]])
+
+
+class TestGridSample:
+    def test_identity_grid(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(1, 2, 5, 7).astype(np.float32)
+        ys, xs = np.meshgrid(np.linspace(-1, 1, 5), np.linspace(-1, 1, 7),
+                             indexing="ij")
+        grid = np.stack([xs, ys], axis=-1)[None].astype(np.float32)
+        out = F.grid_sample(_t(x), _t(grid), align_corners=True).numpy()
+        np.testing.assert_allclose(out, x, atol=1e-5)
+
+    def test_zeros_padding_outside(self):
+        x = np.ones((1, 1, 4, 4), np.float32)
+        grid = np.full((1, 1, 1, 2), 5.0, np.float32)  # far outside
+        out = F.grid_sample(_t(x), _t(grid), padding_mode="zeros").numpy()
+        np.testing.assert_allclose(out, 0.0, atol=1e-6)
+
+    def test_border_padding(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        grid = np.array([[[[-2.0, -2.0]]]], np.float32)  # clamps to (0,0)
+        out = F.grid_sample(_t(x), _t(grid), padding_mode="border").numpy()
+        np.testing.assert_allclose(out[0, 0, 0, 0], 0.0, atol=1e-6)
+
+    def test_nearest_mode(self):
+        x = np.arange(4, dtype=np.float32).reshape(1, 1, 2, 2)
+        grid = np.array([[[[1.0, 1.0]]]], np.float32)  # bottom-right
+        out = F.grid_sample(_t(x), _t(grid), mode="nearest").numpy()
+        assert out[0, 0, 0, 0] == 3.0
+
+    def test_affine_grid_identity(self):
+        theta = np.array([[[1.0, 0, 0], [0, 1.0, 0]]], np.float32)
+        grid = F.affine_grid(_t(theta), [1, 1, 3, 3]).numpy()
+        np.testing.assert_allclose(grid[0, 0, 0], [-1, -1], atol=1e-6)
+        np.testing.assert_allclose(grid[0, 2, 2], [1, 1], atol=1e-6)
+        # composing with grid_sample reproduces the input
+        x = np.random.RandomState(0).randn(1, 1, 3, 3).astype(np.float32)
+        out = F.grid_sample(_t(x), _t(grid), align_corners=True).numpy()
+        np.testing.assert_allclose(out, x, atol=1e-5)
+
+
+class TestTemporalShift:
+    def test_shift_semantics(self):
+        # N=1, T=2, C=4, fold=1: ch0 shifts from future, ch1 from past
+        x = np.zeros((2, 4, 1, 1), np.float32)
+        x[0, :, 0, 0] = [1, 2, 3, 4]
+        x[1, :, 0, 0] = [5, 6, 7, 8]
+        out = F.temporal_shift(_t(x), seg_num=2, shift_ratio=0.25).numpy()
+        # fold 0 (ch0) reads from t-1, fold 1 (ch1) reads from t+1,
+        # remaining channels unchanged; out-of-range reads are zero-padded
+        assert out[0, 0, 0, 0] == 0.0   # t=0 ch0: t-1 doesn't exist
+        assert out[1, 0, 0, 0] == 1.0   # t=1 ch0 <- t=0
+        assert out[0, 1, 0, 0] == 6.0   # t=0 ch1 <- t=1
+        assert out[1, 1, 0, 0] == 0.0   # t=1 ch1: t+1 doesn't exist
+        assert out[0, 2, 0, 0] == 3.0   # untouched channels
+        assert out[1, 3, 0, 0] == 8.0
+
+
+class TestMiscNewOps:
+    def test_sequence_mask(self):
+        m = F.sequence_mask(_t(np.array([2, 0, 3], np.int64)), maxlen=4).numpy()
+        np.testing.assert_array_equal(
+            m, [[1, 1, 0, 0], [0, 0, 0, 0], [1, 1, 1, 0]])
+
+    def test_margin_cross_entropy_reduces_to_ce_at_zero_margin(self):
+        rng = np.random.RandomState(0)
+        cos = np.clip(rng.randn(4, 10) * 0.3, -1, 1).astype(np.float32)
+        y = np.array([1, 5, 2, 9], np.int64)
+        loss = F.margin_cross_entropy(_t(cos), _t(y), margin1=1.0,
+                                      margin2=0.0, margin3=0.0,
+                                      scale=1.0).numpy()
+        import scipy.special as sp
+
+        logp = cos - sp.logsumexp(cos, axis=-1, keepdims=True)
+        ref = -logp[np.arange(4), y].mean()
+        np.testing.assert_allclose(loss, ref, rtol=1e-5)
+
+    def test_bincount_trapezoid_vander(self):
+        b = paddle.bincount(_t(np.array([0, 2, 2, 5], np.int64))).numpy()
+        np.testing.assert_array_equal(b, [1, 0, 2, 0, 0, 1])
+        y = np.array([1.0, 2.0, 3.0], np.float32)
+        np.testing.assert_allclose(paddle.trapezoid(_t(y)).numpy(),
+                                   np.trapezoid(y), rtol=1e-6)
+        ct = paddle.cumulative_trapezoid(_t(y)).numpy()
+        np.testing.assert_allclose(ct, [1.5, 4.0], rtol=1e-6)
+        v = paddle.vander(_t(np.array([2.0, 3.0], np.float32))).numpy()
+        np.testing.assert_allclose(v, np.vander(np.array([2.0, 3.0])),
+                                   rtol=1e-6)
+
+
+class TestReviewRegressions2:
+    def test_param_attr_reg_suppresses_optimizer_l2(self):
+        from paddle_tpu.framework.param_attr import ParamAttr
+        from paddle_tpu.regularizer import L1Decay
+
+        paddle.seed(0)
+        import paddle_tpu.nn as nn
+
+        lin = nn.Linear(4, 4, bias_attr=False,
+                        weight_attr=ParamAttr(regularizer=L1Decay(0.5)))
+        w0 = lin.weight.numpy().copy()
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=lin.parameters(),
+                                   weight_decay=0.3)
+        x = _t(np.zeros((2, 4), np.float32))
+        loss = paddle.mean(lin(x))
+        loss.backward()
+        opt.step()
+        # ONLY the per-param L1 applies; the optimizer L2 must be suppressed
+        np.testing.assert_allclose(lin.weight.numpy(),
+                                   w0 - 0.1 * 0.5 * np.sign(w0), atol=1e-6)
+
+    def test_margin_ce_grad_finite_at_boundary(self):
+        cos = _t(np.array([[1.0, -1.0, 0.5]], np.float32))
+        cos.stop_gradient = False
+        y = _t(np.array([0], np.int64))
+        loss = F.margin_cross_entropy(cos, y, margin2=0.5)
+        loss.backward()
+        assert np.isfinite(cos.grad.numpy()).all()
+
+    def test_segment_max_empty_segment_zero(self):
+        data = _t(np.array([[1.0], [2.0]], np.float32))
+        ids = _t(np.array([0, 2], np.int64))
+        out = G.segment_max(data, ids).numpy()
+        np.testing.assert_allclose(out, [[1.0], [0.0], [2.0]])
+
+    def test_send_ue_recv_max(self):
+        x = _t(np.array([[2.0], [5.0]], np.float32))
+        e = _t(np.array([[1.0], [1.0]], np.float32))
+        src = _t(np.array([0, 1], np.int64))
+        dst = _t(np.array([0, 0], np.int64))
+        out = G.send_ue_recv(x, e, src, dst, message_op="add",
+                             reduce_op="max").numpy()
+        np.testing.assert_allclose(out, [[6.0], [0.0]])
