@@ -105,3 +105,93 @@ class PyLayer(metaclass=PyLayerMeta):
 
 def is_pylayer_op(x):
     return isinstance(x, PyLayer)
+
+
+# ---------------------------------------------------- functional autograd
+# ref: python/paddle/autograd/functional.py (U) — jacobian/hessian/jvp/vjp.
+# TPU-native: direct mappings onto jax's transforms (the reference builds
+# these from repeated backward passes).
+
+def _unwrap(xs):
+    single = isinstance(xs, Tensor)
+    lst = [xs] if single else list(xs)
+    return single, [t._data if isinstance(t, Tensor) else jnp.asarray(t)
+                    for t in lst]
+
+
+def _fn_on_arrays(func, single_in):
+    def f(*arrays):
+        args = [Tensor(a) for a in arrays]
+        out = func(args[0]) if single_in else func(*args)
+        if isinstance(out, (list, tuple)):
+            import jax
+
+            return jax.tree.map(lambda t: t._data, type(out)(out))
+        return out._data
+
+    return f
+
+
+def jacobian(func, xs, create_graph=False, allow_unused=False):
+    """ref paddle.autograd.jacobian — d func / d xs via jax.jacrev."""
+    import jax
+
+    single, arrays = _unwrap(xs)
+    f = _fn_on_arrays(func, single)
+    jac = jax.jacrev(f, argnums=tuple(range(len(arrays))))(*arrays)
+    if single:
+        return jax.tree.map(Tensor, jac[0])
+    return jax.tree.map(Tensor, jac)
+
+
+def hessian(func, xs, create_graph=False, allow_unused=False):
+    """ref paddle.autograd.hessian — d² func / d xs² (scalar output)."""
+    import jax
+
+    single, arrays = _unwrap(xs)
+    f = _fn_on_arrays(func, single)
+    hes = jax.hessian(f, argnums=tuple(range(len(arrays))))(*arrays)
+    if single:
+        return jax.tree.map(Tensor, hes[0][0])
+    return jax.tree.map(Tensor, hes)
+
+
+def jvp(func, xs, v=None):
+    """ref paddle.incubate.autograd.jvp: returns (func(xs), J·v)."""
+    import jax
+
+    single, arrays = _unwrap(xs)
+    f = _fn_on_arrays(func, single)
+    if v is None:
+        tangents = [jnp.ones_like(a) for a in arrays]
+    else:
+        _, tangents = _unwrap(v)
+    out, tangent_out = jax.jvp(lambda *a: f(*a), tuple(arrays),
+                               tuple(tangents))
+    wrap = lambda o: jax.tree.map(Tensor, o)
+    return wrap(out), wrap(tangent_out)
+
+
+def vjp(func, xs, v=None):
+    """ref paddle.incubate.autograd.vjp: returns (func(xs), vᵀ·J)."""
+    import jax
+
+    single, arrays = _unwrap(xs)
+    f = _fn_on_arrays(func, single)
+    out, pullback = jax.vjp(f, *arrays)
+    if v is None:
+        cot = jax.tree.map(jnp.ones_like, out)
+    else:
+        # rebuild the cotangent with out's exact pytree structure
+        _, cots = _unwrap(v)
+        treedef = jax.tree.structure(out)
+        if treedef.num_leaves != len(cots):
+            raise ValueError(
+                f"vjp: v has {len(cots)} leaves but func output has "
+                f"{treedef.num_leaves}")
+        cot = jax.tree.unflatten(treedef, cots)
+    grads = pullback(cot)
+    wrap = lambda o: jax.tree.map(Tensor, o)
+    if single:
+        return wrap(out), wrap(grads[0])
+    return wrap(out), wrap(list(grads))

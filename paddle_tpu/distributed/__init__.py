@@ -20,8 +20,11 @@ from .communication import (
     all_gather,
     all_gather_object,
     all_reduce,
+    all_to_all,
+    all_to_all_single,
     alltoall,
     alltoall_single,
+    gather,
     barrier,
     broadcast,
     destroy_process_group,
@@ -50,7 +53,7 @@ from .recompute import recompute
 
 __all__ = [
     "all_gather", "all_gather_object", "all_reduce", "alltoall",
-    "alltoall_single", "barrier", "broadcast", "destroy_process_group",
+    "alltoall_single", "all_to_all", "all_to_all_single", "gather", "barrier", "broadcast", "destroy_process_group",
     "get_group", "isend", "irecv", "new_group", "recv", "reduce",
     "reduce_scatter", "scatter", "send", "shift", "wait", "ReduceOp",
     "DataParallel", "ParallelEnv", "get_rank", "get_world_size",

@@ -390,3 +390,14 @@ def barrier(group=None):
     """No-op under single-controller SPMD; multi-process sync happens at
     compile/dispatch boundaries (jax.distributed coordination service)."""
     return None
+
+
+# newer-paddle aliases
+all_to_all = alltoall
+all_to_all_single = alltoall_single
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """ref communication/gather.py: under SPMD gather == all_gather (every
+    rank materializes the list; non-root ranks' copies are DCE'd)."""
+    return all_gather(gather_list, tensor, group=group, sync_op=sync_op)

@@ -26,6 +26,11 @@ def _parse(argv):
     p.add_argument("--max_restarts", type=int, default=0,
                    help="watcher: relaunch the script this many times on "
                         "failure (autoresume from user checkpoints)")
+    p.add_argument("--elastic_np", type=str, default=None,
+                   help="elastic mode: 'min:max' node range; membership is "
+                        "tracked via PADDLE_ELASTIC_DIR heartbeats and a "
+                        "scale event relaunches the script (ref fleet "
+                        "elastic, SURVEY.md §5)")
     p.add_argument("--devices", "--gpus", "--tpus", type=str, default=None,
                    help="visible device ids (TPU: informational)")
     p.add_argument("script", type=str, help="training script")
@@ -53,12 +58,74 @@ def _export_env(args):
     return env
 
 
+def _run_elastic(args):
+    """Elastic supervisor: register membership, run the trainer as a
+    subprocess, relaunch on scale events (autoresume from checkpoints)."""
+    from ..fleet.elastic import ElasticManager, ElasticStatus
+
+    mgr = ElasticManager(node_id=str(args.rank), np=args.elastic_np).enter()
+    mgr.signal_handler()
+    failures = 0
+    try:
+        while True:
+            # wait for quorum
+            while mgr.poll() == ElasticStatus.HOLD:
+                time.sleep(mgr.interval)
+            if mgr.poll() == ElasticStatus.EXIT:
+                print("[launch.elastic] above max_np; exiting", file=sys.stderr)
+                return 0
+            world = mgr.world_size()
+            env = dict(os.environ,
+                       PADDLE_TRAINERS_NUM=str(world),
+                       WORLD_SIZE=str(world))
+            proc = subprocess.Popen(
+                [sys.executable, args.script] + list(args.script_args), env=env)
+            # watch for membership change while the trainer runs
+            status = None
+            while proc.poll() is None:
+                status = mgr.poll()
+                if status in (ElasticStatus.RESTART, ElasticStatus.EXIT):
+                    proc.terminate()
+                    try:
+                        proc.wait(timeout=30)
+                    except subprocess.TimeoutExpired:
+                        proc.kill()
+                    break
+                time.sleep(mgr.interval)
+            if status == ElasticStatus.EXIT:
+                return 0
+            if status == ElasticStatus.RESTART:
+                print(f"[launch.elastic] scale event -> world={mgr.world_size()}; "
+                      f"relaunching (autoresume from checkpoint)", file=sys.stderr)
+                continue
+            rc = proc.returncode
+            if rc == 0:
+                return 0
+            failures += 1
+            if failures > args.max_restarts:
+                print(f"[launch.elastic] trainer failed rc={rc}; restarts "
+                      f"exhausted ({args.max_restarts})", file=sys.stderr)
+                return rc
+            print(f"[launch.elastic] trainer failed rc={rc}; waiting for a "
+                  f"membership change before relaunch "
+                  f"({failures}/{args.max_restarts})", file=sys.stderr)
+            # block until membership actually changes (or a node drops out)
+            while mgr.poll() not in (ElasticStatus.RESTART,
+                                     ElasticStatus.EXIT):
+                time.sleep(mgr.interval)
+    finally:
+        mgr.exit()
+
+
 def launch(argv=None):
     args = _parse(argv if argv is not None else sys.argv[1:])
     _export_env(args)
 
     if args.log_dir:
         os.makedirs(args.log_dir, exist_ok=True)
+
+    if args.elastic_np:
+        return _run_elastic(args)
 
     attempt = 0
     while True:

@@ -21,13 +21,23 @@ def _seg(fn_name, jfn, x, segment_ids):
         if n is None:
             raise ValueError(f"{fn_name}: segment_ids must be concrete "
                              f"(static segment count) under jit")
-        out = jfn(a, ids.astype(jnp.int32), num_segments=n)
+        ids32 = ids.astype(jnp.int32)
+        out = jfn(a, ids32, num_segments=n)
         if fn_name in ("segment_max", "segment_min"):
-            # reference fills segments with no members with 0, not ±inf
-            out = jnp.where(jnp.isfinite(out), out, 0.0)
+            out = _fill_empty(out, ids32, n, a)
         return out
 
     return apply(f, xt, st, _op_name=fn_name)
+
+
+def _fill_empty(out, ids32, n, data):
+    """Reference convention: segments with no members read 0 (jax fills
+    them with the dtype's ±max/min sentinel, which for ints is finite, so
+    mask by member count, not isfinite; keep the input dtype)."""
+    cnt = jax.ops.segment_sum(jnp.ones((ids32.shape[0],), jnp.int32), ids32,
+                              num_segments=n)
+    mask = (cnt > 0).reshape((-1,) + (1,) * (data.ndim - 1))
+    return jnp.where(mask, out, jnp.zeros((), out.dtype))
 
 
 def segment_sum(data, segment_ids, name=None):
@@ -70,10 +80,10 @@ def _scatter_reduce(msgs, dst, reduce_op, n):
             (-1,) + (1,) * (msgs.ndim - 1))
     if reduce_op == "max":
         out = jax.ops.segment_max(msgs, dst32, num_segments=n)
-        return jnp.where(jnp.isfinite(out), out, 0.0)
+        return _fill_empty(out, dst32, n, msgs)
     if reduce_op == "min":
         out = jax.ops.segment_min(msgs, dst32, num_segments=n)
-        return jnp.where(jnp.isfinite(out), out, 0.0)
+        return _fill_empty(out, dst32, n, msgs)
     raise ValueError(f"reduce_op {reduce_op!r}")
 
 

@@ -103,7 +103,8 @@ class TrainStep:
                                 continue
                             plr = lr * p.optimize_attr.get("learning_rate", 1.0)
                             g_arr = opt._regularized_grad(p, g._data)
-                            np_, nst = opt._update(p._data, g_arr, st, plr)
+                            np_, nst = opt._update_for(p, p._data, g_arr, st,
+                                                       plr)
                             if scaler is not None:
                                 # skip the step on inf/nan grads
                                 np_ = jnp.where(found_inf, p._data, np_)

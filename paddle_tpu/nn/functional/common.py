@@ -330,7 +330,8 @@ def sequence_mask(x, maxlen=None, dtype="int64", name=None):
     if maxlen is None:
         import numpy as np
 
-        maxlen = int(np.asarray(xt._data).max())
+        lens_np = np.asarray(xt._data)
+        maxlen = int(lens_np.max()) if lens_np.size else 0
 
     def f(lens):
         idx = jnp.arange(maxlen)

@@ -104,6 +104,13 @@ class Optimizer:
     def _update(self, param, grad, state, lr):
         raise NotImplementedError
 
+    def _update_for(self, p, param, grad, state, lr):
+        """Per-parameter update hook: like _update but with access to the
+        Parameter object, so subclasses can apply per-param policy (AdamW's
+        decoupled decay / lr_ratio). Both eager step() and the compiled
+        TrainStep route through this."""
+        return self._update(param, grad, state, lr)
+
     def _decay_exempt(self, p):
         """AdamW-style decoupled decay skips biases/norms by convention flag."""
         return getattr(p, "no_weight_decay", False)
@@ -141,7 +148,8 @@ class Optimizer:
                 state = self._state_for(p)
                 param_lr = lr * p.optimize_attr.get("learning_rate", 1.0)
                 g_arr = self._regularized_grad(p, g._data)
-                new_p, new_state = self._update(p._data, g_arr, state, param_lr)
+                new_p, new_state = self._update_for(p, p._data, g_arr, state,
+                                                    param_lr)
                 p._data = new_p
                 self._accumulators[id(p)] = new_state
 

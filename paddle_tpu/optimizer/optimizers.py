@@ -145,37 +145,21 @@ class AdamW(Adam):
         self._apply_decay_param_fun = apply_decay_param_fun
         self._lr_ratio = lr_ratio
 
-    def step(self):
-        # stash per-param decay decisions before the generic loop
-        self._decay_map = {}
-        for p in self._parameter_list:
-            name = self._param_names[id(p)]
-            use = True
-            if self._apply_decay_param_fun is not None:
-                use = self._apply_decay_param_fun(name)
-            self._decay_map[id(p)] = self._coeff if use else 0.0
-        params_grads = [(p, p.grad) for p in self._parameter_list
-                        if p.trainable and p.grad is not None]
-        if self._grad_clip is not None:
-            params_grads = self._grad_clip(params_grads)
-        self._step_count += 1
-        lr = self.get_lr()
-        from ..core import tape as _tape
+    def _decay_for(self, p):
+        if self._decay_exempt(p):
+            return 0.0
+        if self._apply_decay_param_fun is not None and \
+                not self._apply_decay_param_fun(self._param_names[id(p)]):
+            return 0.0
+        return self._coeff
 
-        with _tape.no_grad():
-            for p, g in params_grads:
-                if g is None:
-                    continue
-                state = self._state_for(p)
-                param_lr = lr * p.optimize_attr.get("learning_rate", 1.0)
-                if self._lr_ratio is not None:
-                    param_lr = param_lr * self._lr_ratio(p)
-                new_p, new_state = self._adam_math(
-                    p._data, g._data, state, param_lr,
-                    decoupled_wd=self._decay_map.get(id(p), self._coeff),
-                )
-                p._data = new_p
-                self._accumulators[id(p)] = new_state
+    def _update_for(self, p, param, grad, state, lr):
+        # decoupled decay + per-param lr ratio ride this hook so the eager
+        # step() and the compiled TrainStep path stay identical
+        if self._lr_ratio is not None:
+            lr = lr * self._lr_ratio(p)
+        return self._adam_math(param, grad, state, lr,
+                               decoupled_wd=self._decay_for(p))
 
 
 class Adamax(Adam):

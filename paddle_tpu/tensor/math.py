@@ -546,8 +546,10 @@ def bincount(x, weights=None, minlength=0, name=None):
     import numpy as np
 
     xt = _as_t(x)
-    # NB: builtins.max — this module shadows `max` with the paddle reduction
-    n = int(np.asarray(xt._data).max()) + 1 if xt._data.size else 0
+    x_np = np.asarray(xt._data)
+    if x_np.size and x_np.min() < 0:
+        raise ValueError("bincount: input must be non-negative")
+    n = int(x_np.max()) + 1 if x_np.size else 0
     if int(minlength) > n:
         n = int(minlength)
     args = [xt] + ([_as_t(weights)] if weights is not None else [])

@@ -160,3 +160,31 @@ class TestBackwardInJit:
         g, l = jitted(w.numpy(), x)
         ref_g = 2 * x.T @ (x @ w.numpy())
         np.testing.assert_allclose(np.asarray(g), ref_g, rtol=1e-4)
+
+
+class TestFunctionalAutograd:
+    def test_jacobian(self):
+        import numpy as np
+        import paddle_tpu.autograd as AG
+
+        x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+        jac = AG.jacobian(lambda t: t * t, x).numpy()
+        np.testing.assert_allclose(jac, np.diag([2.0, 4.0, 6.0]), atol=1e-6)
+
+    def test_hessian(self):
+        import numpy as np
+        import paddle_tpu.autograd as AG
+
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        h = AG.hessian(lambda t: (t * t * t).sum(), x).numpy()
+        np.testing.assert_allclose(h, np.diag([6.0, 12.0]), atol=1e-5)
+
+    def test_jvp_vjp_agree_for_symmetric_jacobian(self):
+        import numpy as np
+        import paddle_tpu.autograd as AG
+
+        x = paddle.to_tensor(np.array([0.5, -1.5], np.float32))
+        v = paddle.to_tensor(np.array([1.0, 1.0], np.float32))
+        _, tang = AG.jvp(lambda t: t * t, x, v)
+        _, cot = AG.vjp(lambda t: t * t, x, v)
+        np.testing.assert_allclose(tang.numpy(), cot.numpy(), atol=1e-6)

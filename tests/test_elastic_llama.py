@@ -104,3 +104,62 @@ class TestLlama:
         assert LLAMA2_7B.num_hidden_layers == 32
         assert LLAMA3_8B.kv_heads == 8
         assert LLAMA3_8B.head_dim == 128
+
+
+class TestElasticLauncher:
+    def test_elastic_completes_and_restarts(self, tmp_path):
+        """Elastic supervisor runs a script to completion; a membership
+        change mid-run triggers relaunch with a new world size."""
+        import os
+        import subprocess
+        import sys
+        import textwrap
+        import threading
+        import time
+
+        script = tmp_path / "train.py"
+        marker = tmp_path / "runs.txt"
+        script.write_text(textwrap.dedent(f"""
+            import os, time
+            with open({str(marker)!r}, "a") as f:
+                f.write(os.environ.get("WORLD_SIZE", "?") + "\\n")
+            time.sleep(6.0)
+        """))
+        elastic_dir = str(tmp_path / "members")
+        env = dict(os.environ, PADDLE_ELASTIC_DIR=elastic_dir,
+                   JAX_PLATFORMS="cpu")
+
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--elastic_np", "1:3", str(script)],
+            env=env, cwd="/root/repo",
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+        # once the first run starts (marker appears), a second node joins
+        # with a live heartbeat -> supervisor must RESTART with world=2
+        stop = threading.Event()
+
+        def add_node():
+            from paddle_tpu.distributed.fleet.elastic import FileMembershipStore
+
+            for _ in range(300):  # wait for the first trainer run
+                if marker.exists() or stop.is_set():
+                    break
+                time.sleep(0.1)
+            store = FileMembershipStore(elastic_dir)
+            store.register("99", {})
+            while not stop.is_set():  # keep the fake node alive
+                store.heartbeat("99")
+                time.sleep(0.3)
+
+        t = threading.Thread(target=add_node, daemon=True)
+        t.start()
+        try:
+            out, _ = proc.communicate(timeout=90)
+        finally:
+            stop.set()
+            t.join(timeout=5)
+            proc.kill()
+        runs = marker.read_text().split()
+        # first attempt saw world=1, the relaunch saw world=2
+        assert "1" in runs and "2" in runs, (runs, out.decode()[-800:])

@@ -176,3 +176,30 @@ class TestReviewRegressions2:
         out = G.send_ue_recv(x, e, src, dst, message_op="add",
                              reduce_op="max").numpy()
         np.testing.assert_allclose(out, [[6.0], [0.0]])
+
+
+class TestReviewRegressions3:
+    def test_segment_max_int_dtype_and_fill(self):
+        data = _t(np.array([[1], [2]], np.int32))
+        ids = _t(np.array([0, 2], np.int64))
+        out = G.segment_max(data, ids).numpy()
+        assert out.dtype == np.int32
+        np.testing.assert_array_equal(out, [[1], [0], [2]])
+
+    def test_bincount_negative_raises(self):
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError, match="non-negative"):
+            paddle.bincount(_t(np.array([-1, 2], np.int64)))
+
+    def test_sequence_mask_empty(self):
+        m = F.sequence_mask(_t(np.array([], np.int64))).numpy()
+        assert m.shape == (0, 0)
+
+    def test_vjp_list_output(self):
+        import paddle_tpu.autograd as AG
+
+        x = _t(np.array([1.0, 2.0], np.float32))
+        v = [_t(np.array([1.0, 1.0], np.float32))]
+        out, g = AG.vjp(lambda t: [t * t], x, v)
+        np.testing.assert_allclose(g.numpy(), [2.0, 4.0], atol=1e-6)

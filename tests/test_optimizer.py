@@ -262,3 +262,43 @@ class TestRegularizer:
         opt.step()
         np.testing.assert_allclose(lin.weight.numpy(),
                                    w0 - 0.1 * 0.5 * np.sign(w0), atol=1e-6)
+
+
+class TestAdamWTrainStepParity:
+    def test_decoupled_decay_applies_in_train_step(self):
+        """AdamW's decoupled weight decay must be identical between eager
+        opt.step() and the compiled TrainStep path (review regression)."""
+        import numpy as np
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+
+        def build():
+            paddle.seed(42)
+            m = nn.Linear(4, 4, bias_attr=False)
+            o = paddle.optimizer.AdamW(learning_rate=0.1,
+                                       parameters=m.parameters(),
+                                       weight_decay=0.5)
+            return m, o
+
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+
+        m1, o1 = build()
+        loss = paddle.mean(m1(x))
+        loss.backward()
+        o1.step()
+
+        m2, o2 = build()
+        step = paddle.jit.TrainStep(m2, lambda m, a: paddle.mean(m(a)), o2)
+        step(x)
+
+        np.testing.assert_allclose(m1.weight.numpy(), m2.weight.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+        # and decay actually happened (differs from no-decay run)
+        m3, _ = build()
+        o3 = paddle.optimizer.AdamW(learning_rate=0.1,
+                                    parameters=m3.parameters(),
+                                    weight_decay=0.0)
+        loss = paddle.mean(m3(x))
+        loss.backward()
+        o3.step()
+        assert not np.allclose(m1.weight.numpy(), m3.weight.numpy())
