@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Benchmark the BASELINE.md model configs on one TPU chip.
+
+Each benchmark compiles the full train step (fwd+bwd+optimizer) as one XLA
+program via paddle.jit.TrainStep and reports best-of-3 windows (the shared
+tunnel throttles ±15%; see BASELINE.md). The flagship GPT/LLaMA config is
+benchmarked by the repo-root bench.py. Run:
+python benchmarks/bench_models.py [resnet50|bert|unet|all]
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _measure(step_fn, sync_out, units_per_step, steps=8, windows=3):
+    step_fn()  # compile
+    step_fn()
+    best = None
+    for _ in range(windows):
+        t0 = time.time()
+        for _ in range(steps):
+            out = step_fn()
+        sync_out(out)
+        dt = time.time() - t0
+        best = dt if best is None else min(best, dt)
+    return units_per_step * steps / best
+
+
+def bench_resnet50():
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.vision.models import resnet50
+
+    paddle.seed(0)
+    model = resnet50(num_classes=1000)  # f32: BN statistics stay f32
+    opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                    parameters=model.parameters())
+    B = 64
+
+    def loss_fn(net, x, y):
+        return nn.functional.cross_entropy(net(x), y)
+
+    step = paddle.jit.TrainStep(model, loss_fn, opt)
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(B, 3, 224, 224).astype(np.float32))
+    y = paddle.to_tensor(rng.randint(0, 1000, (B,)).astype(np.int64))
+    ips = _measure(lambda: step(x, y), lambda o: float(o), B)
+    return {"metric": f"images/sec ResNet-50 f32 train (b{B}, 224px)",
+            "value": round(ips, 1), "unit": "images/s"}
+
+
+def bench_bert():
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.models import BertConfig, BertForMaskedLM
+
+    paddle.seed(0)
+    cfg = BertConfig(vocab_size=30522, hidden_size=768,
+                     num_hidden_layers=12, num_attention_heads=12,
+                     intermediate_size=3072, max_position_embeddings=512)
+    model = BertForMaskedLM(cfg)
+    model.to(dtype="bfloat16")
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters(),
+                                 multi_precision=True)
+    B, S = 32, 128
+
+    def loss_fn(net, ids, labels):
+        out = net(ids, labels=labels)
+        return out[0] if isinstance(out, tuple) else out
+
+    step = paddle.jit.TrainStep(model, loss_fn, opt)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, 30522, (B, S)).astype(np.int32))
+    sps = _measure(lambda: step(ids, ids), lambda o: float(o), B)
+    return {"metric": f"sequences/sec BERT-base MLM bf16 train (b{B}xs{S})",
+            "value": round(sps, 1), "unit": "sequences/s"}
+
+
+def bench_unet():
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.models import UNetConfig, UNet2DConditionModel
+
+    paddle.seed(0)
+    cfg = UNetConfig()  # SD-style defaults from models/unet.py
+    model = UNet2DConditionModel(cfg)
+    model.to(dtype="bfloat16")
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters(),
+                                 multi_precision=True)
+    B = 4
+
+    def loss_fn(net, x, t, ctx, target):
+        pred = net(x, t, ctx)
+        return nn.functional.mse_loss(pred, target)
+
+    step = paddle.jit.TrainStep(model, loss_fn, opt)
+    rng = np.random.RandomState(0)
+    lat = paddle.cast(paddle.to_tensor(
+        rng.randn(B, cfg.in_channels, 32, 32).astype(np.float32)), "bfloat16")
+    t = paddle.to_tensor(rng.randint(0, 1000, (B,)).astype(np.int32))
+    ctx = paddle.cast(paddle.to_tensor(
+        rng.randn(B, 77, cfg.cross_attention_dim).astype(np.float32)),
+        "bfloat16")
+    its = _measure(lambda: step(lat, t, ctx, lat), lambda o: float(o), 1)
+    return {"metric": f"iters/sec SD-UNet bf16 train (b{B}, 32x32 latents)",
+            "value": round(its, 2), "unit": "iters/s"}
+
+
+def main():
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    benches = {"resnet50": bench_resnet50, "bert": bench_bert,
+               "unet": bench_unet}
+    if which != "all" and which not in benches:
+        print(f"unknown benchmark {which!r}; choose from "
+              f"{sorted(benches)} or 'all'", file=sys.stderr)
+        raise SystemExit(2)
+    names = list(benches) if which == "all" else [which]
+    for n in names:
+        try:
+            print(json.dumps(benches[n]()))
+        except Exception as e:  # report, keep going
+            print(json.dumps({"metric": n, "error": f"{type(e).__name__}: {e}"[:300]}))
+
+
+if __name__ == "__main__":
+    main()

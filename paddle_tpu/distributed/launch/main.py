@@ -63,8 +63,21 @@ def _run_elastic(args):
     subprocess, relaunch on scale events (autoresume from checkpoints)."""
     from ..fleet.elastic import ElasticManager, ElasticStatus
 
+    import signal as _signal
+
     mgr = ElasticManager(node_id=str(args.rank), np=args.elastic_np).enter()
-    mgr.signal_handler()
+    current = {"proc": None}
+
+    def _on_term(signum, frame):
+        # deregister AND take the trainer down with us — an orphaned trainer
+        # would keep training against the shrunken membership's checkpoints
+        p = current["proc"]
+        if p is not None and p.poll() is None:
+            p.terminate()
+        mgr.exit(completed=False)
+        raise SystemExit(128 + signum)
+
+    _signal.signal(_signal.SIGTERM, _on_term)
     failures = 0
     try:
         while True:
@@ -78,8 +91,10 @@ def _run_elastic(args):
             env = dict(os.environ,
                        PADDLE_TRAINERS_NUM=str(world),
                        WORLD_SIZE=str(world))
+            started = time.time()
             proc = subprocess.Popen(
                 [sys.executable, args.script] + list(args.script_args), env=env)
+            current["proc"] = proc
             # watch for membership change while the trainer runs
             status = None
             while proc.poll() is None:
@@ -99,20 +114,21 @@ def _run_elastic(args):
                       f"relaunching (autoresume from checkpoint)", file=sys.stderr)
                 continue
             rc = proc.returncode
+            current["proc"] = None
             if rc == 0:
                 return 0
+            if time.time() - started > 10 * mgr.interval:
+                # the previous incident was recovered from — restart budgets
+                # are per-incident, not per-job-lifetime
+                failures = 0
             failures += 1
             if failures > args.max_restarts:
                 print(f"[launch.elastic] trainer failed rc={rc}; restarts "
                       f"exhausted ({args.max_restarts})", file=sys.stderr)
                 return rc
-            print(f"[launch.elastic] trainer failed rc={rc}; waiting for a "
-                  f"membership change before relaunch "
+            print(f"[launch.elastic] trainer failed rc={rc}; relaunch "
                   f"({failures}/{args.max_restarts})", file=sys.stderr)
-            # block until membership actually changes (or a node drops out)
-            while mgr.poll() not in (ElasticStatus.RESTART,
-                                     ElasticStatus.EXIT):
-                time.sleep(mgr.interval)
+            time.sleep(3 * mgr.interval)
     finally:
         mgr.exit()
 

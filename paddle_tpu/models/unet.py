@@ -205,6 +205,9 @@ class UNet2DConditionModel(Layer):
             timestep._data if isinstance(timestep, Tensor) else jnp.asarray(timestep),
             self.time_proj_dim,
         )
+        # sinusoidal embedding is f32; follow the model's compute dtype so a
+        # bf16-cast model stays bf16 end to end
+        temb_raw = temb_raw.astype(self.time_mlp1.weight._data.dtype)
         temb = self.time_mlp2(F.silu(self.time_mlp1(Tensor(temb_raw))))
 
         x = self.conv_in(sample)
