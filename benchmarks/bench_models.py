@@ -20,7 +20,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def _measure(step_fn, sync_out, units_per_step, steps=8, windows=3):
     step_fn()  # compile
-    step_fn()
+    sync_out(step_fn())  # drain warmup before the first timed window
     best = None
     for _ in range(windows):
         t0 = time.time()

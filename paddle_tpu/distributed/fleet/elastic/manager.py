@@ -152,8 +152,10 @@ class ElasticManager:
         return self
 
     def exit(self, completed=True):
-        self.final_status = (ElasticStatus.COMPLETED if completed
-                             else ElasticStatus.ERROR)
+        if self.final_status is None:  # first exit() wins (a SIGTERM
+            # handler's completed=False must survive the finally-block exit)
+            self.final_status = (ElasticStatus.COMPLETED if completed
+                                 else ElasticStatus.ERROR)
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=2 * self.interval)

@@ -58,6 +58,12 @@ def _export_env(args):
     return env
 
 
+# a crashed run only resets the restart budget if it survived this long —
+# longer than any plausible startup + XLA compile, so deterministic
+# post-startup crashes still exhaust max_restarts
+_RECOVERY_SECS = float(os.getenv("PADDLE_ELASTIC_RECOVERY_SECS", "300"))
+
+
 def _run_elastic(args):
     """Elastic supervisor: register membership, run the trainer as a
     subprocess, relaunch on scale events (autoresume from checkpoints)."""
@@ -70,10 +76,15 @@ def _run_elastic(args):
 
     def _on_term(signum, frame):
         # deregister AND take the trainer down with us — an orphaned trainer
-        # would keep training against the shrunken membership's checkpoints
+        # would keep training against the shrunken membership's checkpoints.
+        # terminate -> wait -> kill escalation mirrors the in-loop teardown.
         p = current["proc"]
         if p is not None and p.poll() is None:
             p.terminate()
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
         mgr.exit(completed=False)
         raise SystemExit(128 + signum)
 
@@ -92,9 +103,8 @@ def _run_elastic(args):
                        PADDLE_TRAINERS_NUM=str(world),
                        WORLD_SIZE=str(world))
             started = time.time()
-            proc = subprocess.Popen(
+            current["proc"] = proc = subprocess.Popen(
                 [sys.executable, args.script] + list(args.script_args), env=env)
-            current["proc"] = proc
             # watch for membership change while the trainer runs
             status = None
             while proc.poll() is None:
@@ -117,9 +127,11 @@ def _run_elastic(args):
             current["proc"] = None
             if rc == 0:
                 return 0
-            if time.time() - started > 10 * mgr.interval:
-                # the previous incident was recovered from — restart budgets
-                # are per-incident, not per-job-lifetime
+            if time.time() - started > _RECOVERY_SECS:
+                # ran productively for a while before this crash — treat it
+                # as a NEW incident (restart budgets are per-incident). The
+                # threshold must exceed startup+XLA-compile time or a
+                # deterministic post-startup crash would loop forever.
                 failures = 0
             failures += 1
             if failures > args.max_restarts:

@@ -1,0 +1,119 @@
+"""OpTest harness (ref: test/legacy_test/op_test.py (U), SURVEY.md §4).
+
+The reference's op tests subclass OpTest and call check_output (compare
+against a NumPy reference) and check_grad (numeric finite-difference
+gradient comparison), swept over dtypes with per-dtype tolerances. Same
+pattern here: subclasses define
+
+    def setUp(self):
+        self.op = paddle-callable (Tensors in, Tensor/tuple out)
+        self.inputs = {"x": np.ndarray, ...}      # op kwargs or positional
+        self.ref = numpy reference callable (same signature, ndarrays)
+
+and get check_output() / check_grad() with eager-vs-jit parity included
+(dygraph/static parity analog — the reference runs every op test in both
+executors)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+_TOL = {
+    np.dtype(np.float32): dict(rtol=1e-5, atol=1e-6),
+    np.dtype(np.float64): dict(rtol=1e-7, atol=1e-9),
+    np.dtype(np.float16): dict(rtol=1e-2, atol=1e-3),
+}
+
+
+class OpTest:
+    op = None
+    ref = None
+    inputs: dict = {}
+    grad_inputs: tuple = None  # names to check grads for; default all floats
+
+    # ------------------------------------------------------------ helpers
+    def _tensors(self):
+        return {k: paddle.to_tensor(v) for k, v in self.inputs.items()}
+
+    def _run_op(self, tensors):
+        out = type(self).op(**tensors)
+        return out[0] if isinstance(out, (tuple, list)) else out
+
+    def _tol(self, dtype):
+        return _TOL.get(np.dtype(dtype), dict(rtol=1e-4, atol=1e-5))
+
+    # ------------------------------------------------------------- checks
+    def check_output(self):
+        """Op output == NumPy reference, in eager AND under jit tracing."""
+        tensors = self._tensors()
+        got = self._run_op(tensors).numpy()
+        want = np.asarray(type(self).ref(**self.inputs))
+        # tolerance keyed by the OP's compute dtype (NumPy references often
+        # upcast to f64, which must not tighten the comparison)
+        tol = self._tol(got.dtype)
+        np.testing.assert_allclose(got, want, **tol)
+
+        # jit parity (to_static analog): trace the op, same result
+        import jax
+
+        names = list(tensors)
+
+        def traced(*arrays):
+            ts = {n: paddle.Tensor(a) for n, a in zip(names, arrays)}
+            return self._run_op(ts)._data
+
+        got_jit = np.asarray(jax.jit(traced)(
+            *[tensors[n]._data for n in names]))
+        np.testing.assert_allclose(got_jit, want, **tol)
+
+    def check_grad(self, eps=1e-3, max_relative_error=5e-3):
+        """Autodiff gradient vs central finite differences on a scalar
+        projection sum(op(x) * r) with fixed random r (the reference uses
+        the same scalarization)."""
+        tensors = self._tensors()
+        grad_names = self.grad_inputs or [
+            k for k, v in self.inputs.items()
+            if np.issubdtype(np.asarray(v).dtype, np.floating)]
+
+        rng = np.random.RandomState(7)
+        out0 = self._run_op(tensors)
+        r = rng.randn(*out0.shape).astype(np.asarray(out0.numpy()).dtype) \
+            if out0.shape else np.asarray(1.0, np.float32)
+        r_t = paddle.to_tensor(r)
+
+        # analytic grads
+        for k in grad_names:
+            tensors[k].stop_gradient = False
+        loss = paddle.sum(self._run_op(tensors) * r_t)
+        loss.backward()
+
+        for k in grad_names:
+            analytic = tensors[k].grad.numpy().astype(np.float64)
+            x = np.asarray(self.inputs[k], np.float64)
+            numeric = np.zeros_like(x)
+            flat_x = x.reshape(-1)
+            flat_num = numeric.reshape(-1)
+
+            def scalar_at(xv):
+                ins = dict(self.inputs)
+                ins[k] = xv.astype(self.inputs[k].dtype)
+                out = np.asarray(type(self).ref(**ins), np.float64)
+                return float((out * r.astype(np.float64)).sum())
+
+            for i in range(flat_x.size):
+                orig = flat_x[i]
+                flat_x[i] = orig + eps
+                fp = scalar_at(x)
+                flat_x[i] = orig - eps
+                fm = scalar_at(x)
+                flat_x[i] = orig
+                flat_num[i] = (fp - fm) / (2 * eps)
+
+            denom = np.maximum(np.abs(numeric), 1.0)
+            rel = np.abs(analytic - numeric) / denom
+            assert rel.max() <= max_relative_error, (
+                f"grad wrt {k!r}: max rel err {rel.max():.2e} > "
+                f"{max_relative_error:.2e}\nanalytic={analytic}\n"
+                f"numeric={numeric}")

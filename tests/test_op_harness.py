@@ -1,0 +1,106 @@
+"""Op tests through the OpTest harness (SURVEY.md §4 reference pattern):
+NumPy-reference output check (eager + jit) and numeric-gradient check."""
+
+import numpy as np
+import scipy.special
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from op_test import OpTest
+
+
+def _rand(*shape, seed=0, scale=1.0):
+    return (np.random.RandomState(seed).randn(*shape) * scale).astype(np.float32)
+
+
+class TestMatmulOp(OpTest):
+    op = staticmethod(lambda x, y: paddle.matmul(x, y))
+    ref = staticmethod(lambda x, y: x @ y)
+
+    def setup_method(self, _):
+        self.inputs = {"x": _rand(3, 4), "y": _rand(4, 5, seed=1)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()
+
+
+class TestSoftmaxOp(OpTest):
+    op = staticmethod(lambda x: F.softmax(x, axis=-1))
+    ref = staticmethod(lambda x: scipy.special.softmax(x, axis=-1))
+
+    def setup_method(self, _):
+        self.inputs = {"x": _rand(4, 6)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()
+
+
+class TestGeluOp(OpTest):
+    op = staticmethod(lambda x: F.gelu(x))
+    ref = staticmethod(
+        lambda x: 0.5 * x * (1 + scipy.special.erf(x / np.sqrt(2))))
+
+    def setup_method(self, _):
+        self.inputs = {"x": _rand(3, 5)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()
+
+
+class TestLayerNormOp(OpTest):
+    op = staticmethod(lambda x, w, b: F.layer_norm(x, (8,), weight=w, bias=b))
+
+    @staticmethod
+    def _np_ln(x, w, b):
+        mu = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        return (x - mu) / np.sqrt(var + 1e-5) * w + b
+
+    ref = staticmethod(lambda x, w, b: TestLayerNormOp._np_ln(x, w, b))
+
+    def setup_method(self, _):
+        self.inputs = {"x": _rand(4, 8), "w": _rand(8, seed=2, scale=0.5) + 1.0,
+                       "b": _rand(8, seed=3, scale=0.1)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(max_relative_error=1e-2)
+
+
+class TestLogSumExpOp(OpTest):
+    op = staticmethod(lambda x: paddle.logsumexp(x, axis=-1))
+    ref = staticmethod(lambda x: scipy.special.logsumexp(x, axis=-1))
+
+    def setup_method(self, _):
+        self.inputs = {"x": _rand(5, 7)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()
+
+
+class TestSigmoidOp(OpTest):
+    op = staticmethod(lambda x: F.sigmoid(x))
+    ref = staticmethod(lambda x: scipy.special.expit(x))
+
+    def setup_method(self, _):
+        self.inputs = {"x": _rand(4, 4)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()
