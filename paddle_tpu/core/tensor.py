@@ -129,6 +129,15 @@ class Tensor:
     def cuda(self, *a, **k):
         return self
 
+    def pin_memory(self):
+        return self
+
+    def ndimension(self):
+        return self.ndim
+
+    def element_size(self):
+        return self._data.dtype.itemsize
+
     def to(self, *args, **kwargs):
         for a in args:
             if isinstance(a, (str, jnp.dtype, type(jnp.float32))) and not str(a).startswith(

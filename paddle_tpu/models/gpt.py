@@ -115,12 +115,8 @@ class GPTAttention(Layer):
             new_cache = (k, v)
         else:
             new_cache = None
-        if self.kv_heads != self.num_heads:
-            rep = self.num_heads // self.kv_heads
-            k = M.repeat_interleave(k, rep, axis=2)
-            v = M.repeat_interleave(v, rep, axis=2)
-        # causal with diagonal offset sk-sq: exact for training AND for
-        # cached decode (a 1-token query attends to the whole prefix)
+        # GQA: kv heads stay narrow — the flash kernel shares them across
+        # query groups via its BlockSpec index map; the XLA fallback repeats
         out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
                                              is_causal=True, training=self.training)
         out = self.o_proj(M.reshape(out, [b, s, self.num_heads * self.head_dim]))

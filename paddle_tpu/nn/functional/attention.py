@@ -20,7 +20,10 @@ from ...tensor.creation import _as_t
 
 
 def _sdpa_ref(q, k, v, mask=None, dropout_p=0.0, causal=False, scale=None, key=None):
-    # q,k,v: [B, S, H, D] (paddle flash-attn layout)
+    # q,k,v: [B, S, H, D] (paddle flash-attn layout); GQA via shared helper
+    from ...ops.flash_attention import expand_kv_heads
+
+    k, v = expand_kv_heads(q, k, v)
     d = q.shape[-1]
     s = scale if scale is not None else 1.0 / math.sqrt(d)
     qf = q.astype(jnp.float32)
@@ -65,7 +68,9 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
 
         rng_key = random_state.next_key()
 
-    if attn_mask is None and _use_pallas(tuple(q.shape), q.shape[-1]) and dropout_p == 0.0:
+    if (attn_mask is None and _use_pallas(tuple(q.shape), q.shape[-1])
+            and dropout_p == 0.0 and q.shape[2] % k.shape[2] == 0):
+        # GQA handled natively by the kernel (kv heads shared via index map)
         from ...ops.flash_attention import flash_attention as pallas_flash
 
         return pallas_flash(q, k, v, causal=is_causal)

@@ -21,8 +21,23 @@ from ..core.tensor import Tensor
 from ..tensor.creation import _as_t
 
 
+def expand_kv_heads(q, k, v):
+    """GQA fallback for XLA paths: materialize the kv-head repeat so einsum
+    sees matching head counts (the Pallas kernel shares heads natively)."""
+    if k.shape[2] != q.shape[2]:
+        if q.shape[2] % k.shape[2]:
+            raise ValueError(
+                f"GQA needs q heads {q.shape[2]} divisible by kv heads "
+                f"{k.shape[2]}")
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    return k, v
+
+
 def _xla_flash(q, k, v, causal, scale):
     s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    k, v = expand_kv_heads(q, k, v)
     logits = jnp.einsum("bshd,bthd->bhst", q, k, preferred_element_type=jnp.float32) * s
     if causal:
         sq, sk = logits.shape[-2], logits.shape[-1]

@@ -26,14 +26,17 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
-def _mask(s, causal, kv_len, i_q, j_k, bq, bk):
-    """Causal and/or key-padding mask for one (bq, bk) score tile. kv_len is
-    the TRUE key length (static) — padded key columns never attend."""
+def _mask(s, causal, kv_len, q_len, i_q, j_k, bq, bk):
+    """Causal and/or key-padding mask for one (bq, bk) score tile. kv_len /
+    q_len are the TRUE lengths (static) — padded key columns never attend,
+    and the causal diagonal carries the kv_len - q_len offset so a short
+    query block (cached decode / chunked prefill) attends to the whole
+    prefix, matching the XLA fallback's tril(k=sk-sq)."""
     qi = i_q * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     kj = j_k * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
     keep = None
     if causal:
-        keep = qi >= kj
+        keep = qi + (kv_len - q_len) >= kj
     if kv_len % bk != 0:
         pad_keep = kj < kv_len
         keep = pad_keep if keep is None else (keep & pad_keep)
@@ -69,7 +72,7 @@ def _interpret_default():
 # ------------------------------------------------------------------ forward
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, scale, causal, bq, bk, n_k, kv_len):
+                *, scale, causal, bq, bk, n_k, kv_len, q_len):
     i = pl.program_id(1)
     j = pl.program_id(2)
 
@@ -84,7 +87,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         k = k_ref[0]  # (bk, d)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        s = _mask(s, causal, kv_len, i, j, bq, bk)
+        s = _mask(s, causal, kv_len, q_len, i, j, bq, bk)
 
         m_prev = m_scr[:]                      # (bq, 1)
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -99,7 +102,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
     if causal:
         # k-blocks entirely above the diagonal contribute nothing — skip
         # their MXU/VPU work (the DMA still runs; compute dominates)
-        pl.when(j * bk <= (i + 1) * bq - 1)(body)
+        pl.when(j * bk <= (i + 1) * bq - 1 + (kv_len - q_len))(body)
     else:
         body()
 
@@ -110,22 +113,31 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         lse_ref[0] = m_scr[:] + jnp.log(l)
 
 
-def _flash_fwd(q, k, v, scale, causal, interpret, kv_len=None):
+def _flash_fwd(q, k, v, scale, causal, interpret, kv_len=None, q_per_kv=1,
+               q_len=None):
+    """q [BH, sq, d]; k/v [BH // q_per_kv, sk, d] — grouped-query attention
+    reads each kv head from q_per_kv query heads without materializing the
+    repeat (the reference repeats kv in HBM; here the BlockSpec index map
+    does the sharing)."""
     bh, sq, d = q.shape
     kv_len = k.shape[1] if kv_len is None else kv_len
+    q_len = sq if q_len is None else q_len
     sk = k.shape[1]
     bq, bk = _block_sizes(sq, sk, d)
     n_q, n_k = pl.cdiv(sq, bq), pl.cdiv(sk, bk)
 
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               bq=bq, bk=bk, n_k=n_k, kv_len=kv_len)
+                               bq=bq, bk=bk, n_k=n_k, kv_len=kv_len,
+                               q_len=q_len)
     out, lse = pl.pallas_call(
         kernel,
         grid=(bh, n_q, n_k),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda h, i, j: (h, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda h, i, j: (h, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda h, i, j: (h, j, 0)),
+            pl.BlockSpec((1, bk, d),
+                         lambda h, i, j: (h // q_per_kv, j, 0)),
+            pl.BlockSpec((1, bk, d),
+                         lambda h, i, j: (h // q_per_kv, j, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda h, i, j: (h, i, 0)),
@@ -149,7 +161,7 @@ def _flash_fwd(q, k, v, scale, causal, interpret, kv_len=None):
 # ------------------------------------------------------------------ backward
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_scr, *, scale, causal, bq, bk, n_k, kv_len):
+               dq_scr, *, scale, causal, bq, bk, n_k, kv_len, q_len):
     i = pl.program_id(1)
     j = pl.program_id(2)
 
@@ -162,7 +174,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         k = k_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        s = _mask(s, causal, kv_len, i, j, bq, bk)
+        s = _mask(s, causal, kv_len, q_len, i, j, bq, bk)
 
         p = jnp.exp(s - lse_ref[0])                          # (bq, bk)
         dp = jax.lax.dot_general(do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
@@ -173,7 +185,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                                          preferred_element_type=jnp.float32)
 
     if causal:
-        pl.when(j * bk <= (i + 1) * bq - 1)(body)
+        pl.when(j * bk <= (i + 1) * bq - 1 + (kv_len - q_len))(body)
     else:
         body()
 
@@ -184,11 +196,14 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal, bq, bk,
-                n_q, kv_len):
+                n_q, kv_len, q_len, q_per_kv):
+    # grid (bh_kv, n_k, q_per_kv, n_q): the dk/dv block for one kv head sums
+    # contributions from its q_per_kv query heads (GQA) and all q blocks
     jb = pl.program_id(1)  # k-block index
-    i = pl.program_id(2)   # q-block index (innermost: accumulation axis)
+    r = pl.program_id(2)   # query-head-within-group index
+    i = pl.program_id(3)   # q-block index (innermost: accumulation axis)
 
-    @pl.when(i == 0)
+    @pl.when((r == 0) & (i == 0))
     def _():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
@@ -198,7 +213,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        s = _mask(s, causal, kv_len, i, jb, bq, bk)
+        s = _mask(s, causal, kv_len, q_len, i, jb, bq, bk)
 
         p = jnp.exp(s - lse_ref[0])                          # (bq, bk)
         do = do_ref[0]
@@ -213,19 +228,22 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                                          preferred_element_type=jnp.float32)
 
     if causal:
-        pl.when(jb * bk <= (i + 1) * bq - 1)(body)
+        pl.when(jb * bk <= (i + 1) * bq - 1 + (kv_len - q_len))(body)
     else:
         body()
 
-    @pl.when(i == n_q - 1)
+    @pl.when((r == q_per_kv - 1) & (i == n_q - 1))
     def _():
         dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, out, lse, do, scale, causal, interpret, kv_len=None):
+def _flash_bwd(q, k, v, out, lse, do, scale, causal, interpret, kv_len=None,
+               q_per_kv=1, q_len=None):
     bh, sq, d = q.shape
+    bh_kv = k.shape[0]
     kv_len = k.shape[1] if kv_len is None else kv_len
+    q_len = sq if q_len is None else q_len
     sk = k.shape[1]
     bq, bk = _block_sizes(sq, sk, d)
     n_q, n_k = pl.cdiv(sq, bq), pl.cdiv(sk, bk)
@@ -235,12 +253,13 @@ def _flash_bwd(q, k, v, out, lse, do, scale, causal, interpret, kv_len=None):
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, n_k=n_k, kv_len=kv_len),
+                          bq=bq, bk=bk, n_k=n_k, kv_len=kv_len,
+                          q_len=q_len),
         grid=(bh, n_q, n_k),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda h, i, j: (h, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda h, i, j: (h, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda h, i, j: (h, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda h, i, j: (h // q_per_kv, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda h, i, j: (h // q_per_kv, j, 0)),
             pl.BlockSpec((1, bq, d), lambda h, i, j: (h, i, 0)),
             pl.BlockSpec((1, bq, 1), lambda h, i, j: (h, i, 0)),
             pl.BlockSpec((1, bq, 1), lambda h, i, j: (h, i, 0)),
@@ -251,25 +270,33 @@ def _flash_bwd(q, k, v, out, lse, do, scale, causal, interpret, kv_len=None):
         interpret=interpret,
     )(q, k, v, do, lse, delta)
 
+    # grid (bh_kv, n_k, q_per_kv, n_q): the (hk, jb) output block stays
+    # resident across the two inner dims, so GQA contributions accumulate
+    # contiguously in scratch
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, n_q=n_q, kv_len=kv_len),
-        grid=(bh, n_k, n_q),
+                          bq=bq, bk=bk, n_q=n_q, kv_len=kv_len,
+                          q_len=q_len, q_per_kv=q_per_kv),
+        grid=(bh_kv, n_k, q_per_kv, n_q),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda h, j, i: (h, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda h, j, i: (h, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda h, j, i: (h, j, 0)),
-            pl.BlockSpec((1, bq, d), lambda h, j, i: (h, i, 0)),
-            pl.BlockSpec((1, bq, 1), lambda h, j, i: (h, i, 0)),
-            pl.BlockSpec((1, bq, 1), lambda h, j, i: (h, i, 0)),
+            pl.BlockSpec((1, bq, d),
+                         lambda hk, j, r, i: (hk * q_per_kv + r, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda hk, j, r, i: (hk, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda hk, j, r, i: (hk, j, 0)),
+            pl.BlockSpec((1, bq, d),
+                         lambda hk, j, r, i: (hk * q_per_kv + r, i, 0)),
+            pl.BlockSpec((1, bq, 1),
+                         lambda hk, j, r, i: (hk * q_per_kv + r, i, 0)),
+            pl.BlockSpec((1, bq, 1),
+                         lambda hk, j, r, i: (hk * q_per_kv + r, i, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, bk, d), lambda h, j, i: (h, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda h, j, i: (h, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda hk, j, r, i: (hk, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda hk, j, r, i: (hk, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+            jax.ShapeDtypeStruct((bh_kv, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh_kv, sk, d), v.dtype),
         ],
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
@@ -290,19 +317,23 @@ def _pad_seq(x, block):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def flash_attention_bhsd(q, k, v, scale, causal, interpret):
-    """[B·H, S, D] flash attention. Padded internally to block multiples
-    (padded keys are masked out via an explicit key-length guard)."""
+    """[B·H, S, D] flash attention; k/v may carry fewer heads
+    ([B·Hkv, S, D] with H % Hkv == 0) for native grouped-query attention.
+    Padded internally to block multiples (padded keys are masked out via an
+    explicit key-length guard)."""
     out, _ = _fa_fwd_padded(q, k, v, scale, causal, interpret)
     return out
 
 
 def _fa_fwd_padded(q, k, v, scale, causal, interpret):
     sq, sk = q.shape[1], k.shape[1]
+    q_per_kv = q.shape[0] // k.shape[0]
     bq, bk = _block_sizes(sq, sk, q.shape[2])
     qp, _ = _pad_seq(q, bq)
     kp, _ = _pad_seq(k, bk)
     vp, _ = _pad_seq(v, bk)
-    out, lse = _flash_fwd(qp, kp, vp, scale, causal, interpret, kv_len=sk)
+    out, lse = _flash_fwd(qp, kp, vp, scale, causal, interpret, kv_len=sk,
+                          q_per_kv=q_per_kv, q_len=sq)
     return out[:, :sq], (qp, kp, vp, out, lse)
 
 
@@ -315,7 +346,8 @@ def _fa_vjp_bwd(scale, causal, interpret, saved, g):
     (qp, kp, vp, outp, lse), sq, sk = saved
     gp = jnp.pad(g, ((0, 0), (0, qp.shape[1] - sq), (0, 0)))
     dq, dk, dv = _flash_bwd(qp, kp, vp, outp, lse, gp, scale, causal,
-                            interpret, kv_len=sk)
+                            interpret, kv_len=sk,
+                            q_per_kv=qp.shape[0] // kp.shape[0], q_len=sq)
     return dq[:, :sq], dk[:, :sk], dv[:, :sk]
 
 
@@ -323,13 +355,21 @@ flash_attention_bhsd.defvjp(_fa_vjp_fwd, _fa_vjp_bwd)
 
 
 def flash_attention(q, k, v, causal=False, scale=None, interpret=None):
-    """[B, S, H, D] (reference flash-attn layout) Pallas flash attention."""
+    """[B, S, H, D] (reference flash-attn layout) Pallas flash attention.
+    k/v may have fewer heads (GQA): [B, S, Hkv, D] with H % Hkv == 0."""
     if interpret is None:
         interpret = _interpret_default()
     b, sq, h, d = q.shape
+    hkv = k.shape[2]
+    if h % hkv:
+        raise ValueError(f"GQA needs q heads {h} divisible by kv heads {hkv}")
     if scale is None:
         scale = 1.0 / math.sqrt(d)
-    to_bhsd = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+
+    def to_bhsd(x):
+        hx = x.shape[2]
+        return x.transpose(0, 2, 1, 3).reshape(b * hx, x.shape[1], d)
+
     qf, kf, vf = to_bhsd(q), to_bhsd(k), to_bhsd(v)
     out = flash_attention_bhsd(qf, kf, vf, float(scale), bool(causal),
                                bool(interpret))

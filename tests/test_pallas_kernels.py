@@ -137,3 +137,93 @@ class TestFusedNorms:
         for a, c in zip(g1, g2):
             np.testing.assert_allclose(np.asarray(a), np.asarray(c),
                                        rtol=1e-4, atol=1e-4)
+
+
+class TestFlashGQA:
+    """Grouped-query attention: narrow kv heads shared across query groups
+    via the kernel's BlockSpec index maps (no HBM repeat)."""
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_fwd_matches_repeated(self, causal):
+        rng = np.random.RandomState(0)
+        b, s, h, hkv, d = 2, 96, 8, 2, 32
+        q = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+        k = jnp.asarray(rng.randn(b, s, hkv, d), jnp.float32)
+        v = jnp.asarray(rng.randn(b, s, hkv, d), jnp.float32)
+        out = flash_attention(q, k, v, causal=causal, interpret=True)
+        kr = jnp.repeat(k, h // hkv, axis=2)
+        vr = jnp.repeat(v, h // hkv, axis=2)
+        ref = flash_attention(q, kr, vr, causal=causal, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_grads_match_repeated(self, causal):
+        rng = np.random.RandomState(1)
+        b, s, h, hkv, d = 1, 64, 4, 2, 16
+        q = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+        k = jnp.asarray(rng.randn(b, s, hkv, d), jnp.float32)
+        v = jnp.asarray(rng.randn(b, s, hkv, d), jnp.float32)
+        r = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+
+        def loss_gqa(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=causal,
+                                           interpret=True) * r)
+
+        def loss_rep(q, k, v):
+            kr = jnp.repeat(k, h // hkv, axis=2)
+            vr = jnp.repeat(v, h // hkv, axis=2)
+            return jnp.sum(flash_attention(q, kr, vr, causal=causal,
+                                           interpret=True) * r)
+
+        g1 = jax.grad(loss_gqa, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_rep, argnums=(0, 1, 2))(q, k, v)
+        for a, b_ in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=2e-4, atol=2e-5)
+
+
+class TestFlashCrossLength:
+    """sq != sk with causal=True: the kernel's diagonal offset must match
+    the XLA fallback's tril(k=sk-sq) (chunked prefill / cached decode)."""
+
+    def test_short_query_attends_whole_prefix(self):
+        rng = np.random.RandomState(0)
+        b, h, d = 1, 2, 32
+        sq, sk = 128, 256
+        q = jnp.asarray(rng.randn(b, sq, h, d), jnp.float32)
+        k = jnp.asarray(rng.randn(b, sk, h, d), jnp.float32)
+        v = jnp.asarray(rng.randn(b, sk, h, d), jnp.float32)
+        out = flash_attention(q, k, v, causal=True, interpret=True)
+        # reference with diagonal offset sk-sq
+        s = jnp.einsum("bshd,bthd->bhst", q, k) / np.sqrt(d)
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        ref = jnp.einsum("bhst,bthd->bshd",
+                         jax.nn.softmax(jnp.where(mask, s, -1e30), -1), v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_grad_short_query(self):
+        rng = np.random.RandomState(1)
+        b, h, d, sq, sk = 1, 1, 16, 64, 128
+        q = jnp.asarray(rng.randn(b, sq, h, d), jnp.float32)
+        k = jnp.asarray(rng.randn(b, sk, h, d), jnp.float32)
+        v = jnp.asarray(rng.randn(b, sk, h, d), jnp.float32)
+        r = jnp.asarray(rng.randn(b, sq, h, d), jnp.float32)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=True,
+                                           interpret=True) * r)
+
+        def loss_ref(q, k, v):
+            s = jnp.einsum("bshd,bthd->bhst", q, k) / np.sqrt(d)
+            mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+            o = jnp.einsum("bhst,bthd->bshd",
+                           jax.nn.softmax(jnp.where(mask, s, -1e30), -1), v)
+            return jnp.sum(o * r)
+
+        g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b_ in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=2e-4, atol=2e-5)
