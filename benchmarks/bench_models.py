@@ -5,7 +5,8 @@ Each benchmark compiles the full train step (fwd+bwd+optimizer) as one XLA
 program via paddle.jit.TrainStep and reports best-of-3 windows (the shared
 tunnel throttles ±15%; see BASELINE.md). The flagship GPT/LLaMA config is
 benchmarked by the repo-root bench.py. Run:
-python benchmarks/bench_models.py [resnet50|bert|unet|all]
+python benchmarks/bench_models.py [resnet50|resnet50_f32|bert|unet|all]
+("all" runs the bf16 resnet50 variant; resnet50_f32 reproduces the f32 row)
 """
 
 import json
@@ -32,26 +33,34 @@ def _measure(step_fn, sync_out, units_per_step, steps=8, windows=3):
     return units_per_step * steps / best
 
 
-def bench_resnet50():
+def bench_resnet50(dtype="bfloat16"):
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
     from paddle_tpu.vision.models import resnet50
 
     paddle.seed(0)
-    model = resnet50(num_classes=1000)  # f32: BN statistics stay f32
+    model = resnet50(num_classes=1000)
+    if dtype == "bfloat16":
+        model.to(dtype="bfloat16")
     opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
                                     parameters=model.parameters())
     B = 64
 
     def loss_fn(net, x, y):
-        return nn.functional.cross_entropy(net(x), y)
+        logits = net(x)
+        if dtype == "bfloat16":
+            logits = paddle.cast(logits, "float32")
+        return nn.functional.cross_entropy(logits, y)
 
     step = paddle.jit.TrainStep(model, loss_fn, opt)
     rng = np.random.RandomState(0)
     x = paddle.to_tensor(rng.randn(B, 3, 224, 224).astype(np.float32))
+    if dtype == "bfloat16":
+        x = paddle.cast(x, "bfloat16")
     y = paddle.to_tensor(rng.randint(0, 1000, (B,)).astype(np.int64))
     ips = _measure(lambda: step(x, y), lambda o: float(o), B)
-    return {"metric": f"images/sec ResNet-50 f32 train (b{B}, 224px)",
+    tag = "bf16" if dtype == "bfloat16" else "f32"
+    return {"metric": f"images/sec ResNet-50 {tag} train (b{B}, 224px)",
             "value": round(ips, 1), "unit": "images/s"}
 
 
@@ -116,7 +125,9 @@ def bench_unet():
 
 def main():
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
-    benches = {"resnet50": bench_resnet50, "bert": bench_bert,
+    benches = {"resnet50": bench_resnet50,
+               "resnet50_f32": lambda: bench_resnet50(dtype="float32"),
+               "bert": bench_bert,
                "unet": bench_unet}
     if which != "all" and which not in benches:
         print(f"unknown benchmark {which!r}; choose from "

@@ -107,8 +107,21 @@ class Optimizer:
     def _update_for(self, p, param, grad, state, lr):
         """Per-parameter update hook: like _update but with access to the
         Parameter object, so subclasses can apply per-param policy (AdamW's
-        decoupled decay / lr_ratio). Both eager step() and the compiled
-        TrainStep route through this."""
+        decoupled decay / lr_ratio — override _update_raw). Both eager
+        step() and the compiled TrainStep route through this, and it PINS
+        dtypes: a strong-typed f32 lr (the TrainStep path) must not promote
+        bf16 params or optimizer state (state promotion would also change
+        jit avals and force a full recompile every step)."""
+        import jax
+
+        new_p, new_state = self._update_raw(p, param, grad, state, lr)
+        new_p = new_p.astype(param.dtype)
+        new_state = jax.tree.map(
+            lambda n, o: n.astype(o.dtype) if hasattr(o, "dtype") else n,
+            new_state, state)
+        return new_p, new_state
+
+    def _update_raw(self, p, param, grad, state, lr):
         return self._update(param, grad, state, lr)
 
     def _decay_exempt(self, p):

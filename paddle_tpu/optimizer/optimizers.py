@@ -153,9 +153,10 @@ class AdamW(Adam):
             return 0.0
         return self._coeff
 
-    def _update_for(self, p, param, grad, state, lr):
+    def _update_raw(self, p, param, grad, state, lr):
         # decoupled decay + per-param lr ratio ride this hook so the eager
-        # step() and the compiled TrainStep path stay identical
+        # step() and the compiled TrainStep path stay identical (dtype
+        # pinning happens in the base _update_for)
         if self._lr_ratio is not None:
             lr = lr * self._lr_ratio(p)
         return self._adam_math(param, grad, state, lr,

@@ -302,3 +302,66 @@ class TestAdamWTrainStepParity:
         loss.backward()
         o3.step()
         assert not np.allclose(m1.weight.numpy(), m3.weight.numpy())
+
+
+class TestBf16DtypeStability:
+    def test_momentum_train_step_keeps_bf16(self):
+        """Strong-typed f32 lr must not promote bf16 params across steps
+        (regression: second TrainStep call failed with mixed conv dtypes)."""
+        import numpy as np
+        import jax.numpy as jnp
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+
+        paddle.seed(0)
+        m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        m.to(dtype="bfloat16")
+        opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                        parameters=m.parameters())
+        step = paddle.jit.TrainStep(
+            m, lambda n, a: paddle.mean(paddle.cast(n(a), "float32") ** 2), opt)
+        x = paddle.cast(paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 4).astype(np.float32)),
+            "bfloat16")
+        step(x)
+        step(x)  # regression: used to fail here
+        for p in m.parameters():
+            assert p._data.dtype == jnp.bfloat16, p.name
+
+    def test_update_for_pins_param_and_state_dtype(self):
+        """Drive _update_for directly with a STRONG f32 lr array (what the
+        compiled TrainStep passes): params AND optimizer state must keep
+        their original dtypes — state promotion would change jit avals and
+        force a recompile every step (RMSProp's velocity was the repro)."""
+        import numpy as np
+        import jax.numpy as jnp
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+
+        for make in (
+            lambda ps: paddle.optimizer.Momentum(learning_rate=0.1,
+                                                 momentum=0.9, parameters=ps),
+            lambda ps: paddle.optimizer.RMSProp(learning_rate=0.1,
+                                                parameters=ps),
+            lambda ps: paddle.optimizer.AdamW(learning_rate=0.1,
+                                              parameters=ps,
+                                              weight_decay=0.01),
+        ):
+            paddle.seed(1)
+            lin = nn.Linear(4, 4, bias_attr=False)
+            lin.to(dtype="bfloat16")
+            opt = make(lin.parameters())
+            p = lin.weight
+            st = opt._state_for(p)
+            lr = jnp.asarray(0.1, jnp.float32)  # strong dtype
+            g = jnp.ones_like(p._data)
+            new_p, new_st = opt._update_for(p, p._data, g, st, lr)
+            assert new_p.dtype == jnp.bfloat16, type(opt).__name__
+            import jax
+
+            jax.tree.map(
+                lambda n, o: None if not hasattr(o, "dtype")
+                else (_ for _ in ()).throw(AssertionError(
+                    f"{type(opt).__name__} state {n.dtype} != {o.dtype}"))
+                if n.dtype != o.dtype else None,
+                new_st, st)
