@@ -10,6 +10,7 @@ kernels + multi_tensor paths — XLA fuses ours).
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor, Parameter
@@ -112,8 +113,6 @@ class Optimizer:
         dtypes: a strong-typed f32 lr (the TrainStep path) must not promote
         bf16 params or optimizer state (state promotion would also change
         jit avals and force a full recompile every step)."""
-        import jax
-
         new_p, new_state = self._update_raw(p, param, grad, state, lr)
         new_p = new_p.astype(param.dtype)
         new_state = jax.tree.map(

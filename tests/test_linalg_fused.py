@@ -1,0 +1,113 @@
+"""paddle.linalg vs NumPy references + incubate fused functional parity."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _t(x):
+    return paddle.to_tensor(np.asarray(x))
+
+
+def _spd(n, seed=0):
+    rng = np.random.RandomState(seed)
+    a = rng.randn(n, n).astype(np.float32)
+    return a @ a.T + n * np.eye(n, dtype=np.float32)
+
+
+class TestLinalg:
+    def test_svd_reconstruction(self):
+        rng = np.random.RandomState(0)
+        a = rng.randn(5, 3).astype(np.float32)
+        u, s, vh = paddle.linalg.svd(_t(a), full_matrices=False)
+        rec = u.numpy() @ np.diag(s.numpy()) @ vh.numpy()
+        np.testing.assert_allclose(rec, a, atol=1e-5)
+
+    def test_qr(self):
+        rng = np.random.RandomState(1)
+        a = rng.randn(4, 4).astype(np.float32)
+        q, r = paddle.linalg.qr(_t(a))
+        np.testing.assert_allclose(q.numpy() @ r.numpy(), a, atol=1e-5)
+        np.testing.assert_allclose(q.numpy().T @ q.numpy(), np.eye(4),
+                                   atol=1e-5)
+
+    def test_eigh(self):
+        a = _spd(4)
+        w, v = paddle.linalg.eigh(_t(a))
+        np.testing.assert_allclose(
+            v.numpy() @ np.diag(w.numpy()) @ v.numpy().T, a, atol=1e-3)
+
+    def test_solve_and_det(self):
+        a = _spd(3, seed=2)
+        b = np.array([[1.0], [2.0], [3.0]], np.float32)
+        x = paddle.linalg.solve(_t(a), _t(b))
+        np.testing.assert_allclose(a @ x.numpy(), b, atol=1e-4)
+        np.testing.assert_allclose(paddle.linalg.det(_t(a)).numpy(),
+                                   np.linalg.det(a), rtol=1e-4)
+
+    def test_cholesky_and_inv(self):
+        a = _spd(3, seed=3)
+        l = paddle.linalg.cholesky(_t(a))
+        np.testing.assert_allclose(l.numpy() @ l.numpy().T, a, atol=1e-4)
+        inv = paddle.linalg.inv(_t(a))
+        np.testing.assert_allclose(a @ inv.numpy(), np.eye(3), atol=1e-4)
+
+    def test_lstsq(self):
+        rng = np.random.RandomState(4)
+        a = rng.randn(6, 3).astype(np.float32)
+        b = rng.randn(6, 1).astype(np.float32)
+        sol = paddle.linalg.lstsq(_t(a), _t(b))
+        x = sol[0] if isinstance(sol, (tuple, list)) else sol
+        ref = np.linalg.lstsq(a, b, rcond=None)[0]
+        np.testing.assert_allclose(x.numpy(), ref, atol=1e-4)
+
+    def test_norms(self):
+        rng = np.random.RandomState(5)
+        a = rng.randn(3, 4).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.linalg.norm(_t(a)).numpy(), np.linalg.norm(a), rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.linalg.matrix_rank(_t(a)).numpy(), 3)
+
+
+class TestIncubateFused:
+    def test_fused_rms_norm_matches_ref(self):
+        from paddle_tpu.incubate.nn.functional import fused_rms_norm
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(4, 16).astype(np.float32)
+        w = (rng.rand(16).astype(np.float32) + 0.5)
+        out = fused_rms_norm(_t(x), _t(w), None, epsilon=1e-6)
+        if isinstance(out, (tuple, list)):
+            out = out[0]
+        ref = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6) * w
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_fused_rotary_position_embedding(self):
+        from paddle_tpu.incubate.nn.functional import (
+            fused_rotary_position_embedding,
+        )
+        from paddle_tpu.ops.rope import apply_rotary_emb
+
+        rng = np.random.RandomState(1)
+        q = rng.randn(2, 8, 4, 16).astype(np.float32)
+        k = rng.randn(2, 8, 4, 16).astype(np.float32)
+        out = fused_rotary_position_embedding(_t(q), _t(k))
+        oq = out[0] if isinstance(out, (tuple, list)) else out
+        ref_q = apply_rotary_emb(_t(q))
+        np.testing.assert_allclose(oq.numpy(), ref_q.numpy(), rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_fused_linear_activation(self):
+        from paddle_tpu.incubate.nn.functional import fused_linear_activation
+        import scipy.special as sp
+
+        rng = np.random.RandomState(2)
+        x = rng.randn(3, 8).astype(np.float32)
+        w = rng.randn(8, 4).astype(np.float32)
+        b = rng.randn(4).astype(np.float32)
+        out = fused_linear_activation(_t(x), _t(w), _t(b), activation="gelu")
+        z = x @ w + b
+        ref = 0.5 * z * (1 + sp.erf(z / np.sqrt(2)))
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
