@@ -1,46 +1,111 @@
-"""paddle.sparse parity (minimal; ref: python/paddle/sparse/ (U),
-SURVEY.md §2.1 N26 — low priority on TPU: XLA has no sparse codegen, so COO
-ops are expressed densely via scatter/gather; jax.experimental.sparse (BCOO)
-backs matmul)."""
+"""paddle.sparse parity (ref: python/paddle/sparse/ (U), SURVEY.md §2.1 N26).
+
+TPU-native: COO tensors wrap jax.experimental.sparse.BCOO and stay sparse
+through matmul/add/elementwise — XLA lowers BCOO contractions to
+gather/segment-sum, the TPU-friendly form of the reference's cuSPARSE
+kernels. Dense interop happens only at explicit `.to_dense()` (or when a
+dense-only op touches the tensor through the Tensor fallback)."""
 
 from __future__ import annotations
 
 import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
 
 from ..core.tensor import Tensor
 from ..tensor.creation import _as_t
 
 
 class SparseCooTensor(Tensor):
-    __slots__ = ("indices_", "values_", "dense_shape")
+    """COO sparse tensor. Holds a BCOO; the dense view (used when a plain
+    Tensor op touches it) is built lazily so sparse pipelines never
+    materialize it."""
 
     def __init__(self, indices, values, shape):
-        from jax.experimental import sparse as jsparse
+        idx = _as_t(indices)._data
+        vals = _as_t(values)._data
+        bcoo = jsparse.BCOO((vals, idx.T.astype(jnp.int32)),
+                            shape=tuple(int(s) for s in shape))
+        self.bcoo = bcoo
+        self._dense_cache = None
+        _init_tensor_slots(self)
 
-        self.indices_ = _as_t(indices)
-        self.values_ = _as_t(values)
-        self.dense_shape = list(shape)
-        bcoo = jsparse.BCOO((self.values_._data, self.indices_._data.T), shape=tuple(shape))
-        super().__init__(bcoo.todense())
+    # -------------------------------------------------- lazy dense interop
+    @property
+    def _data(self):
+        if self._dense_cache is None:
+            self._dense_cache = self.bcoo.todense()
+        return self._dense_cache
 
+    @_data.setter
+    def _data(self, v):
+        # a direct dense assignment (in-place mutators, device placement)
+        # must keep the BCOO authoritative too, or sparse ops and the dense
+        # view would silently disagree
+        self._dense_cache = v
+        if v is not None and getattr(self, "bcoo", None) is not None:
+            self.bcoo = jsparse.BCOO.fromdense(v)
+
+    @property
+    def shape(self):
+        return list(self.bcoo.shape)
+
+    @property
+    def dtype(self):
+        return self.bcoo.data.dtype
+
+    # ------------------------------------------------------------ sparse API
     def indices(self):
-        return self.indices_
+        return Tensor(self.bcoo.indices.T)
 
     def values(self):
-        return self.values_
+        return Tensor(self.bcoo.data)
+
+    def nnz(self):
+        return int(self.bcoo.nse)
 
     def to_dense(self):
         return Tensor(self._data)
 
+    def to_sparse_coo(self, sparse_dim=None):
+        return self
 
-def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None, stop_gradient=True):
+    def coalesce(self):
+        return _wrap(self.bcoo.sum_duplicates())
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+
+def _init_tensor_slots(t):
+    """Fill the base Tensor slots without touching _data (lazy property).
+    Mirrors Tensor.__init__ defaults (trainable = not stop_gradient)."""
+    t.grad = None
+    t.stop_gradient = True
+    t._tape_node = None
+    t.name = None
+    t.persistable = False
+    t.trainable = False
+
+
+def _wrap(bcoo):
+    t = SparseCooTensor.__new__(SparseCooTensor)
+    t.bcoo = bcoo
+    t._dense_cache = None
+    _init_tensor_slots(t)
+    return t
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
     if shape is None:
         idx = _as_t(indices).numpy()
         shape = (idx.max(axis=1) + 1).tolist()
     return SparseCooTensor(indices, values, shape)
 
 
-def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None, stop_gradient=True):
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
     import numpy as np
 
     crows_np = _as_t(crows).numpy()
@@ -50,12 +115,107 @@ def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None, stop_g
     return SparseCooTensor(indices, values, shape)
 
 
+def is_same_shape(x, y):
+    return list(x.shape) == list(y.shape)
+
+
 def matmul(x, y, name=None):
+    """Sparse @ dense — BCOO dot_general, no densification of the sparse
+    operand."""
+    if isinstance(x, SparseCooTensor):
+        rhs = (y.bcoo.todense() if isinstance(y, SparseCooTensor)
+               else _as_t(y)._data)
+        n = x.bcoo.ndim
+        # contract x's last dim with rhs's second-to-last (vector rhs: 0)
+        rdim = rhs.ndim - 2 if rhs.ndim >= 2 else 0
+        out = jsparse.bcoo_dot_general(
+            x.bcoo, rhs,
+            dimension_numbers=(((n - 1,), (rdim,)), ((), ())))
+        return Tensor(out)
     from ..tensor.math import matmul as dense_matmul
 
-    return dense_matmul(x.to_dense() if isinstance(x, SparseCooTensor) else x,
-                        y.to_dense() if isinstance(y, SparseCooTensor) else y)
+    if isinstance(y, SparseCooTensor):
+        return dense_matmul(x, y.to_dense())
+    return dense_matmul(x, y)
+
+
+def add(x, y, name=None):
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        if tuple(x.bcoo.shape) != tuple(y.bcoo.shape):
+            raise ValueError(
+                f"sparse add shape mismatch: {x.shape} vs {y.shape}")
+        # concatenate entries then coalesce: exact sparse add, stays sparse
+        data = jnp.concatenate([x.bcoo.data, y.bcoo.data])
+        idx = jnp.concatenate([x.bcoo.indices, y.bcoo.indices])
+        merged = jsparse.BCOO((data, idx), shape=x.bcoo.shape)
+        return _wrap(merged.sum_duplicates())
+    a = x.to_dense() if isinstance(x, SparseCooTensor) else _as_t(x)
+    b = y.to_dense() if isinstance(y, SparseCooTensor) else _as_t(y)
+    from ..tensor.math import add as dense_add
+
+    return dense_add(a, b)
+
+
+def multiply(x, y, name=None):
+    if isinstance(x, SparseCooTensor) and not isinstance(y, SparseCooTensor):
+        yt = _as_t(y)._data
+        if yt.ndim == 0:  # scalar: scale values, stay sparse
+            return _wrap(jsparse.BCOO((x.bcoo.data * yt, x.bcoo.indices),
+                                      shape=x.bcoo.shape))
+    a = x.to_dense() if isinstance(x, SparseCooTensor) else _as_t(x)
+    b = y.to_dense() if isinstance(y, SparseCooTensor) else _as_t(y)
+    from ..tensor.math import multiply as dense_mul
+
+    return dense_mul(a, b)
+
+
+def _unary_on_values(fn):
+    """Zero-preserving unary op applied to the stored values only."""
+
+    def op(x, name=None):
+        if isinstance(x, SparseCooTensor):
+            return _wrap(jsparse.BCOO((fn(x.bcoo.data), x.bcoo.indices),
+                                      shape=x.bcoo.shape))
+        from ..core.op_call import apply
+
+        return apply(fn, _as_t(x))
+
+    return op
+
+
+relu = _unary_on_values(lambda v: jnp.maximum(v, 0))
+abs = _unary_on_values(jnp.abs)
+sin = _unary_on_values(jnp.sin)
+tanh = _unary_on_values(jnp.tanh)
+sqrt = _unary_on_values(jnp.sqrt)
+neg = _unary_on_values(jnp.negative)
+expm1 = _unary_on_values(jnp.expm1)
 
 
 def masked_matmul(x, y, mask, name=None):
-    raise NotImplementedError("masked sparse matmul is not supported on the TPU build")
+    """Dense @ dense sampled at `mask`'s sparsity pattern (ref
+    masked_matmul / SDDMM): compute only the entries the mask keeps."""
+    if not isinstance(mask, SparseCooTensor):
+        raise TypeError("masked_matmul mask must be a SparseCooTensor")
+    a = _as_t(x)._data
+    b = _as_t(y)._data
+    idx = mask.bcoo.indices  # [nse, 2]
+    rows = a[idx[:, 0]]           # [nse, K]
+    cols = b[:, idx[:, 1]].T      # [nse, K]
+    vals = jnp.sum(rows * cols, axis=-1)
+    return _wrap(jsparse.BCOO((vals, idx), shape=mask.bcoo.shape))
+
+
+class nn:
+    """paddle.sparse.nn subset: activations over sparse tensors."""
+
+    class ReLU:
+        def __call__(self, x):
+            return relu(x)
+
+
+__all__ = [
+    "SparseCooTensor", "sparse_coo_tensor", "sparse_csr_tensor",
+    "matmul", "masked_matmul", "add", "multiply", "is_same_shape",
+    "relu", "abs", "sin", "tanh", "sqrt", "neg", "expm1", "nn",
+]

@@ -1,0 +1,126 @@
+"""paddle.sparse: BCOO-backed COO tensors stay sparse through ops."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.sparse as S
+
+
+def _coo():
+    # [[0, 2, 0], [3, 0, 4]]
+    idx = np.array([[0, 1, 1], [1, 0, 2]], np.int64)
+    vals = np.array([2.0, 3.0, 4.0], np.float32)
+    return S.sparse_coo_tensor(paddle.to_tensor(idx), paddle.to_tensor(vals),
+                               [2, 3])
+
+
+class TestSparseCoo:
+    def test_construction_and_dense(self):
+        t = _coo()
+        assert t.nnz() == 3
+        np.testing.assert_allclose(t.to_dense().numpy(),
+                                   [[0, 2, 0], [3, 0, 4]])
+        np.testing.assert_allclose(t.values().numpy(), [2, 3, 4])
+        assert t.indices().numpy().shape == (2, 3)
+
+    def test_csr_construction(self):
+        t = S.sparse_csr_tensor(paddle.to_tensor(np.array([0, 1, 3], np.int64)),
+                                paddle.to_tensor(np.array([1, 0, 2], np.int64)),
+                                paddle.to_tensor(np.array([2.0, 3.0, 4.0],
+                                                          np.float32)),
+                                [2, 3])
+        np.testing.assert_allclose(t.to_dense().numpy(),
+                                   [[0, 2, 0], [3, 0, 4]])
+
+    def test_sparse_matmul_no_densify(self):
+        t = _coo()
+        d = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(3, 2))
+        out = S.matmul(t, d)
+        ref = t.to_dense().numpy() @ d.numpy()
+        np.testing.assert_allclose(out.numpy(), ref, atol=1e-6)
+        # the sparse operand's dense cache was never built by matmul
+        t2 = _coo()
+        S.matmul(t2, d)
+        assert t2._dense_cache is None
+
+    def test_sparse_add(self):
+        a, b = _coo(), _coo()
+        out = S.add(a, b)
+        assert isinstance(out, S.SparseCooTensor)
+        np.testing.assert_allclose(out.to_dense().numpy(),
+                                   2 * a.to_dense().numpy())
+
+    def test_zero_preserving_unary(self):
+        t = _coo()
+        out = S.relu(S.neg(t))
+        assert isinstance(out, S.SparseCooTensor)
+        np.testing.assert_allclose(out.to_dense().numpy(), 0.0)
+        s = S.sin(t)
+        np.testing.assert_allclose(s.values().numpy(),
+                                   np.sin([2.0, 3.0, 4.0]), rtol=1e-6)
+
+    def test_scalar_multiply_stays_sparse(self):
+        t = _coo()
+        out = S.multiply(t, 2.0)
+        assert isinstance(out, S.SparseCooTensor)
+        np.testing.assert_allclose(out.values().numpy(), [4, 6, 8])
+
+    def test_masked_matmul_sddmm(self):
+        rng = np.random.RandomState(0)
+        a = rng.randn(4, 5).astype(np.float32)
+        b = rng.randn(5, 3).astype(np.float32)
+        idx = np.array([[0, 2, 3], [1, 0, 2]], np.int64)
+        mask = S.sparse_coo_tensor(paddle.to_tensor(idx),
+                                   paddle.to_tensor(np.ones(3, np.float32)),
+                                   [4, 3])
+        out = S.masked_matmul(paddle.to_tensor(a), paddle.to_tensor(b), mask)
+        full = a @ b
+        np.testing.assert_allclose(out.values().numpy(),
+                                   full[idx[0], idx[1]], rtol=1e-5)
+
+    def test_coalesce(self):
+        idx = np.array([[0, 0], [1, 1]], np.int64)  # duplicate entry
+        vals = np.array([1.0, 2.0], np.float32)
+        t = S.sparse_coo_tensor(paddle.to_tensor(idx),
+                                paddle.to_tensor(vals), [2, 2])
+        c = t.coalesce()
+        np.testing.assert_allclose(c.to_dense().numpy(), [[0, 3], [0, 0]])
+
+    def test_dense_tensor_interop(self):
+        # plain Tensor ops touch the lazy dense view
+        t = _coo()
+        out = paddle.sum(t)
+        np.testing.assert_allclose(float(out), 9.0)
+
+
+class TestSparseReviewRegressions:
+    def test_inplace_mutation_syncs_bcoo(self):
+        t = _coo()
+        t.add_(1.0)
+        # both views agree post-mutation (zeros became 1.0 too — dense add_)
+        np.testing.assert_allclose(t.to_dense().numpy(),
+                                   [[1, 3, 1], [4, 1, 5]])
+        assert paddle.sum(t).numpy() == t.to_dense().numpy().sum()
+
+    def test_add_shape_mismatch_raises(self):
+        import pytest as _pytest
+
+        idx = np.array([[0], [0]], np.int64)
+        small = S.sparse_coo_tensor(paddle.to_tensor(idx),
+                                    paddle.to_tensor(np.ones(1, np.float32)),
+                                    [1, 1])
+        with _pytest.raises(ValueError, match="shape mismatch"):
+            S.add(_coo(), small)
+
+    def test_batched_sparse_matmul(self):
+        # sparse [2,3] @ dense [3] (vector) and vs dense reference
+        t = _coo()
+        v = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+        out = S.matmul(t, v)
+        np.testing.assert_allclose(out.numpy(),
+                                   t.to_dense().numpy() @ v.numpy(),
+                                   atol=1e-6)
+
+    def test_trainable_invariant(self):
+        t = _coo()
+        assert t.stop_gradient and not t.trainable
