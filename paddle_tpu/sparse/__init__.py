@@ -55,6 +55,8 @@ class SparseCooTensor(Tensor):
 
     # ------------------------------------------------------------ sparse API
     def indices(self):
+        # int32, not the reference's int64: with jax_enable_x64 off the
+        # framework has no int64 arrays at all (int64 inputs truncate)
         return Tensor(self.bcoo.indices.T)
 
     def values(self):
@@ -70,7 +72,8 @@ class SparseCooTensor(Tensor):
         return self
 
     def coalesce(self):
-        return _wrap(self.bcoo.sum_duplicates())
+        # static nse bound: traceable under jit (duplicates become padding)
+        return _wrap(self.bcoo.sum_duplicates(nse=self.bcoo.nse))
 
     def __repr__(self):
         return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
@@ -125,12 +128,16 @@ def matmul(x, y, name=None):
     if isinstance(x, SparseCooTensor):
         rhs = (y.bcoo.todense() if isinstance(y, SparseCooTensor)
                else _as_t(y)._data)
+        if rhs.ndim > 2:
+            # bcoo_dot_general puts lhs free dims before rhs batch dims —
+            # a silently transposed layout; refuse rather than mislead
+            raise NotImplementedError(
+                "sparse matmul supports a 1-D or 2-D dense rhs; "
+                "densify with .to_dense() for batched matmul")
         n = x.bcoo.ndim
-        # contract x's last dim with rhs's second-to-last (vector rhs: 0)
-        rdim = rhs.ndim - 2 if rhs.ndim >= 2 else 0
         out = jsparse.bcoo_dot_general(
             x.bcoo, rhs,
-            dimension_numbers=(((n - 1,), (rdim,)), ((), ())))
+            dimension_numbers=(((n - 1,), (0,)), ((), ())))
         return Tensor(out)
     from ..tensor.math import matmul as dense_matmul
 
@@ -145,10 +152,11 @@ def add(x, y, name=None):
             raise ValueError(
                 f"sparse add shape mismatch: {x.shape} vs {y.shape}")
         # concatenate entries then coalesce: exact sparse add, stays sparse
+        # (static nse bound keeps this traceable under jit)
         data = jnp.concatenate([x.bcoo.data, y.bcoo.data])
         idx = jnp.concatenate([x.bcoo.indices, y.bcoo.indices])
         merged = jsparse.BCOO((data, idx), shape=x.bcoo.shape)
-        return _wrap(merged.sum_duplicates())
+        return _wrap(merged.sum_duplicates(nse=x.bcoo.nse + y.bcoo.nse))
     a = x.to_dense() if isinstance(x, SparseCooTensor) else _as_t(x)
     b = y.to_dense() if isinstance(y, SparseCooTensor) else _as_t(y)
     from ..tensor.math import add as dense_add
@@ -169,27 +177,32 @@ def multiply(x, y, name=None):
     return dense_mul(a, b)
 
 
-def _unary_on_values(fn):
-    """Zero-preserving unary op applied to the stored values only."""
+def _unary_on_values(fn, dense_name):
+    """Zero-preserving unary op applied to the stored values only; dense
+    tensors delegate to the existing paddle op (AMP-aware op names)."""
 
     def op(x, name=None):
         if isinstance(x, SparseCooTensor):
             return _wrap(jsparse.BCOO((fn(x.bcoo.data), x.bcoo.indices),
                                       shape=x.bcoo.shape))
-        from ..core.op_call import apply
+        if dense_name == "relu":
+            from ..nn import functional as F
 
-        return apply(fn, _as_t(x))
+            return F.relu(x)
+        from .. import tensor as dense_ops
+
+        return getattr(dense_ops, dense_name)(x)
 
     return op
 
 
-relu = _unary_on_values(lambda v: jnp.maximum(v, 0))
-abs = _unary_on_values(jnp.abs)
-sin = _unary_on_values(jnp.sin)
-tanh = _unary_on_values(jnp.tanh)
-sqrt = _unary_on_values(jnp.sqrt)
-neg = _unary_on_values(jnp.negative)
-expm1 = _unary_on_values(jnp.expm1)
+relu = _unary_on_values(lambda v: jnp.maximum(v, 0), "relu")
+abs = _unary_on_values(jnp.abs, "abs")
+sin = _unary_on_values(jnp.sin, "sin")
+tanh = _unary_on_values(jnp.tanh, "tanh")
+sqrt = _unary_on_values(jnp.sqrt, "sqrt")
+neg = _unary_on_values(jnp.negative, "neg")
+expm1 = _unary_on_values(jnp.expm1, "expm1")
 
 
 def masked_matmul(x, y, mask, name=None):
@@ -197,6 +210,8 @@ def masked_matmul(x, y, mask, name=None):
     masked_matmul / SDDMM): compute only the entries the mask keeps."""
     if not isinstance(mask, SparseCooTensor):
         raise TypeError("masked_matmul mask must be a SparseCooTensor")
+    if mask.bcoo.ndim != 2:
+        raise TypeError("masked_matmul supports 2-D operands only")
     a = _as_t(x)._data
     b = _as_t(y)._data
     idx = mask.bcoo.indices  # [nse, 2]

@@ -104,3 +104,59 @@ class TestSigmoidOp(OpTest):
 
     def test_grad(self):
         self.check_grad()
+
+
+class TestConv2dOp(OpTest):
+    op = staticmethod(lambda x, w: F.conv2d(x, w, stride=1, padding=1))
+
+    @staticmethod
+    def _np_conv(x, w):
+        n, cin, h, wd = x.shape
+        cout, _, kh, kw = w.shape
+        xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+        out = np.zeros((n, cout, h, wd), x.dtype)
+        for i in range(h):
+            for j in range(wd):
+                patch = xp[:, :, i:i + kh, j:j + kw]
+                out[:, :, i, j] = np.einsum("ncij,ocij->no", patch, w)
+        return out
+
+    ref = staticmethod(lambda x, w: TestConv2dOp._np_conv(x, w))
+
+    def setup_method(self, _):
+        self.inputs = {"x": _rand(2, 3, 5, 5), "w": _rand(4, 3, 3, 3, seed=1)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(max_relative_error=1e-2)
+
+
+class TestLogSoftmaxOp(OpTest):
+    op = staticmethod(lambda x: F.log_softmax(x, axis=-1))
+    ref = staticmethod(
+        lambda x: x - scipy.special.logsumexp(x, axis=-1, keepdims=True))
+
+    def setup_method(self, _):
+        self.inputs = {"x": _rand(4, 9)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()
+
+
+class TestTanhOp(OpTest):
+    op = staticmethod(lambda x: paddle.tanh(x))
+    ref = staticmethod(lambda x: np.tanh(x))  # ufunc arg isn't named 'x'
+
+    def setup_method(self, _):
+        self.inputs = {"x": _rand(3, 7)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()

@@ -124,3 +124,35 @@ class TestSparseReviewRegressions:
     def test_trainable_invariant(self):
         t = _coo()
         assert t.stop_gradient and not t.trainable
+
+    def test_sparse_add_under_jit(self):
+        import jax
+
+        a, b = _coo(), _coo()
+
+        def f(da, ia, db, ib):
+            import paddle_tpu.sparse as SS
+            from jax.experimental import sparse as jsp
+
+            xa = SS._wrap(jsp.BCOO((da, ia), shape=(2, 3)))
+            xb = SS._wrap(jsp.BCOO((db, ib), shape=(2, 3)))
+            return SS.add(xa, xb).bcoo.todense()
+
+        out = jax.jit(f)(a.bcoo.data, a.bcoo.indices,
+                         b.bcoo.data, b.bcoo.indices)
+        np.testing.assert_allclose(np.asarray(out),
+                                   2 * a.to_dense().numpy())
+
+    def test_batched_rhs_rejected(self):
+        import pytest as _pytest
+
+        t = _coo()
+        dense3 = paddle.to_tensor(np.zeros((4, 3, 2), np.float32))
+        with _pytest.raises(NotImplementedError, match="1-D or 2-D"):
+            S.matmul(t, dense3)
+
+    def test_dense_fallback_unary(self):
+        d = paddle.to_tensor(np.array([-1.0, 2.0], np.float32))
+        np.testing.assert_allclose(S.relu(d).numpy(), [0.0, 2.0])
+        np.testing.assert_allclose(S.tanh(d).numpy(), np.tanh([-1.0, 2.0]),
+                                   rtol=1e-6)
