@@ -30,85 +30,186 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..core.op_call import apply
+from ..ops.pallas.flash import (
+    _block_sizes,
+    _flash_bwd,
+    _flash_fwd,
+    _interpret_default,
+    _pad_seq,
+)
 from . import collective_ctx
 
 NEG_INF = -1e30
 
 
-def _block_attn(q, k, v, scale, mode, q_off, k_off):
-    """One [B, Sq, H, D] x [B, Sk, H, D] attention block.
-
-    mode: 0 = full, 1 = causal w/ global offsets, 2 = masked out entirely.
-    Returns (unnormalized-out-factors): softmax numerator out and row lse.
-    """
-    s = jnp.einsum("bshd,bthd->bhst", q, k,
-                   preferred_element_type=jnp.float32) * scale
-    sq, sk = s.shape[-2], s.shape[-1]
-    if mode == 1:
-        qi = q_off + lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
-        kj = k_off + lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
-        s = jnp.where(qi >= kj, s, NEG_INF)
-    m = jnp.max(s, axis=-1, keepdims=True)
-    m = jnp.maximum(m, NEG_INF)  # guard all-masked rows
-    p = jnp.exp(s - m)
-    l = jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
-    out = jnp.einsum("bhst,bthd->bshd", (p / l).astype(v.dtype), v)
-    lse = (m + jnp.log(l))[..., 0]  # [B, H, Sq]
-    # out is the NORMALIZED block output; lse its log-softmax mass, so blocks
-    # combine as out_total = Σ_b out_b·softmax_b(lse)
-    return out.astype(jnp.float32), lse
+def _to_bhsd(x):
+    b, s, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
 
 
-def ring_flash_attention_arrays(q, k, v, causal=False, scale=None,
-                                axis_name="sep"):
-    """[B, S_local, H, D] ring attention inside shard_map over `axis_name`."""
+def _to_bshd(x, b, h):
+    _, s, d = x.shape
+    return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+def _ring_mode(src, idx):
+    """Causal ring schedule: 0 = full (block from an earlier rank), 1 =
+    intra-block causal (own block, the diagonal), 2 = fully masked (later
+    rank). Exactly the selected branch executes (lax.switch)."""
+    return jnp.where(src == idx, 1, jnp.where(src > idx, 2, 0))
+
+
+def _ring_fwd_res(q, k, v, causal, scale, axis_name, interpret):
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
-    if scale is None:
-        scale = 1.0 / math.sqrt(q.shape[-1])
-    s_local = q.shape[1]
+    b, s_local, h, d = q.shape
+    hkv = k.shape[2]
+    if h % hkv:
+        raise ValueError(f"GQA needs q heads {h} divisible by kv heads {hkv}")
+    q_per_kv = h // hkv
     perm = [(i, (i + 1) % n) for i in range(n)]
+
+    bq, bk = _block_sizes(s_local, s_local, d)
+    qp, _ = _pad_seq(_to_bhsd(q), bq)
+    kp, _ = _pad_seq(_to_bhsd(k), bk)
+    vp, _ = _pad_seq(_to_bhsd(v), bk)
+    sp = qp.shape[1]
+    bh = qp.shape[0]
+
+    def attend(is_causal):
+        def f(kk, vv):
+            o, lse = _flash_fwd(qp, kk, vv, scale, is_causal, interpret,
+                                kv_len=s_local, q_per_kv=q_per_kv,
+                                q_len=s_local)
+            return o.astype(jnp.float32), lse
+        return f
+
+    def masked(kk, vv):
+        return (jnp.zeros((bh, sp, d), jnp.float32),
+                jnp.full((bh, sp, 1), NEG_INF, jnp.float32))
 
     def step(carry, t):
         kk, vv, m_run, num, den = carry
         src = (idx - t) % n  # origin rank of the k/v block we hold now
-
-        # block score vs this kv block, with the causal ring schedule
         if causal:
-            # diagonal: intra-block causal; earlier src: full; later: masked
-            out_full, lse_full = _block_attn(q, kk, vv, scale, 0, 0, 0)
-            out_diag, lse_diag = _block_attn(
-                q, kk, vv, scale, 1, 0, 0)
-            is_diag = (src == idx)
-            is_later = src > idx
-            out_b = jnp.where(is_diag, out_diag, out_full)
-            lse_b = jnp.where(is_diag, lse_diag, lse_full)
-            lse_b = jnp.where(is_later, NEG_INF, lse_b)
-            out_b = jnp.where(is_later, 0.0, out_b)
+            out_b, lse_b = lax.switch(
+                _ring_mode(src, idx), [attend(False), attend(True), masked],
+                kk, vv)
         else:
-            out_b, lse_b = _block_attn(q, kk, vv, scale, 0, 0, 0)
+            out_b, lse_b = attend(False)(kk, vv)
 
-        # online log-sum-exp combine: running (m, num, den) over blocks
+        # online log-sum-exp combine of NORMALIZED block outputs:
+        # out_total = Σ_b out_b · softmax_b(lse)
         m_new = jnp.maximum(m_run, lse_b)
         alpha = jnp.exp(m_run - m_new)
         beta = jnp.exp(lse_b - m_new)
-        num = num * alpha[..., None].transpose(0, 2, 1, 3) \
-            + out_b * beta[..., None].transpose(0, 2, 1, 3)
+        num = num * alpha + out_b * beta
         den = den * alpha + beta
-        # rotate kv to the next rank (skip the last, unused, hop)
         kk = lax.ppermute(kk, axis_name, perm)
         vv = lax.ppermute(vv, axis_name, perm)
         return (kk, vv, m_new, num, den), None
 
-    b, _, h, d = q.shape
-    m0 = jnp.full((b, h, s_local), NEG_INF, jnp.float32)
-    num0 = jnp.zeros((b, s_local, h, d), jnp.float32)
-    den0 = jnp.zeros((b, h, s_local), jnp.float32)
-    (_, _, _, num, den), _ = lax.scan(
-        step, (k, v, m0, num0, den0), jnp.arange(n))
+    m0 = jnp.full((bh, sp, 1), NEG_INF, jnp.float32)
+    num0 = jnp.zeros((bh, sp, d), jnp.float32)
+    den0 = jnp.zeros((bh, sp, 1), jnp.float32)
+    (_, _, m_run, num, den), _ = lax.scan(
+        step, (kp, vp, m0, num0, den0), jnp.arange(n))
     den = jnp.maximum(den, 1e-30)
-    out = num / den[..., None].transpose(0, 2, 1, 3)
-    return out.astype(q.dtype)
+    outp = (num / den).astype(q.dtype)          # padded [BH, Sp, D]
+    lsep = m_run + jnp.log(den)                 # global lse [BH, Sp, 1]
+    out = _to_bshd(outp[:, :s_local], b, h)
+    return out, (qp, kp, vp, outp, lsep)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring_core(q, k, v, causal, scale, axis_name, interpret):
+    out, _ = _ring_fwd_res(q, k, v, causal, scale, axis_name, interpret)
+    return out
+
+
+def _ring_core_fwd(q, k, v, causal, scale, axis_name, interpret):
+    return _ring_fwd_res(q, k, v, causal, scale, axis_name, interpret)
+
+
+def _ring_core_bwd(causal, scale, axis_name, interpret, res, g):
+    """Second ring pass: per step, the Pallas flash backward with the GLOBAL
+    lse/delta yields this rank's exact dq contribution plus dk/dv for the
+    visiting block; dk/dv accumulators rotate in lockstep with k/v, so after
+    the full cycle each lands back on its owner."""
+    qp, kp, vp, outp, lsep = res
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    b, s_local, h, d = g.shape
+    hkv_bh = kp.shape[0]
+    q_per_kv = qp.shape[0] // hkv_bh
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    dop = _to_bhsd(g)
+    dop = jnp.pad(dop, ((0, 0), (0, qp.shape[1] - dop.shape[1]), (0, 0)))
+    delta = jnp.sum(dop.astype(jnp.float32) * outp.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+
+    def grad_block(is_causal):
+        def f(kk, vv):
+            return _flash_bwd(qp, kk, vv, outp, lsep, dop, scale, is_causal,
+                              interpret, kv_len=s_local, q_per_kv=q_per_kv,
+                              q_len=s_local, delta=delta)
+        return f
+
+    def grad_masked(kk, vv):
+        return (jnp.zeros(qp.shape, qp.dtype),
+                jnp.zeros(kp.shape, kp.dtype),
+                jnp.zeros(vp.shape, vp.dtype))
+
+    def step(carry, t):
+        kk, vv, dq_acc, dk_acc, dv_acc = carry
+        src = (idx - t) % n
+        if causal:
+            dq_c, dk_c, dv_c = lax.switch(
+                _ring_mode(src, idx),
+                [grad_block(False), grad_block(True), grad_masked], kk, vv)
+        else:
+            dq_c, dk_c, dv_c = grad_block(False)(kk, vv)
+        dq_acc = dq_acc + dq_c.astype(jnp.float32)
+        dk_acc = dk_acc + dk_c.astype(jnp.float32)
+        dv_acc = dv_acc + dv_c.astype(jnp.float32)
+        kk = lax.ppermute(kk, axis_name, perm)
+        vv = lax.ppermute(vv, axis_name, perm)
+        dk_acc = lax.ppermute(dk_acc, axis_name, perm)
+        dv_acc = lax.ppermute(dv_acc, axis_name, perm)
+        return (kk, vv, dq_acc, dk_acc, dv_acc), None
+
+    dq0 = jnp.zeros(qp.shape[:2] + (d,), jnp.float32)
+    dkv0 = jnp.zeros(kp.shape[:2] + (d,), jnp.float32)
+    (_, _, dq, dk, dv), _ = lax.scan(
+        step, (kp, vp, dq0, dkv0, dkv0), jnp.arange(n))
+    dq = _to_bshd(dq[:, :s_local].astype(qp.dtype), b, h)
+    dk = _to_bshd(dk[:, :s_local].astype(kp.dtype), b, hkv_bh // b)
+    dv = _to_bshd(dv[:, :s_local].astype(vp.dtype), b, hkv_bh // b)
+    return dq, dk, dv
+
+
+_ring_core.defvjp(_ring_core_fwd, _ring_core_bwd)
+
+
+def ring_flash_attention_arrays(q, k, v, causal=False, scale=None,
+                                axis_name="sep", interpret=None):
+    """[B, S_local, H, D] ring (context-parallel) attention inside shard_map
+    over `axis_name`, built on the Pallas flash kernel (SURVEY.md §7.6d): each
+    ring step runs blockwise online-softmax flash attention on the resident
+    k/v block — no dense S_local×S_local score tile is ever materialized — and
+    k/v rotate via lax.ppermute so XLA overlaps the ICI hop with compute. The
+    causal schedule picks exactly one branch per step (lax.switch): full
+    attention for blocks from earlier ranks, intra-block causal on the
+    diagonal, skip for later ranks. k/v may carry fewer heads (GQA).
+    Differentiable via a hand-written ring backward (global-lse flash bwd per
+    step with rotating dk/dv accumulators)."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if interpret is None:
+        interpret = _interpret_default()
+    return _ring_core(q, k, v, bool(causal), float(scale), axis_name,
+                      bool(interpret))
 
 
 def ulysses_attention_arrays(q, k, v, causal=False, scale=None,

@@ -239,7 +239,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd(q, k, v, out, lse, do, scale, causal, interpret, kv_len=None,
-               q_per_kv=1, q_len=None):
+               q_per_kv=1, q_len=None, delta=None):
     bh, sq, d = q.shape
     bh_kv = k.shape[0]
     kv_len = k.shape[1] if kv_len is None else kv_len
@@ -248,8 +248,9 @@ def _flash_bwd(q, k, v, out, lse, do, scale, causal, interpret, kv_len=None,
     bq, bk = _block_sizes(sq, sk, d)
     n_q, n_k = pl.cdiv(sq, bq), pl.cdiv(sk, bk)
 
-    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1, keepdims=True)  # (bh, sq, 1)
+    if delta is None:
+        delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                        axis=-1, keepdims=True)  # (bh, sq, 1)
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
