@@ -26,7 +26,7 @@ from .math import (
     cumprod, cummax, cummin, count_nonzero, diff, trace, add_n, matmul, mm,
     bmm, dot, inner, outer, kron, mv, addmm, cross, allclose, isclose,
     equal_all, increment, multiplex, bincount, trapezoid,
-    cumulative_trapezoid, vander,
+    cumulative_trapezoid, vander, logcumsumexp, frexp, renorm,
 )
 from .manipulation import (
     reshape, reshape_, transpose, t, moveaxis, swapaxes, flatten, squeeze,
@@ -37,7 +37,7 @@ from .manipulation import (
     take_along_axis, put_along_axis, take, slice, strided_slice,
     repeat_interleave, unique, unique_consecutive, nonzero, where,
     as_complex, as_real, view, view_as, atleast_1d, atleast_2d, atleast_3d,
-    tensordot, shard_index, cast,
+    tensordot, shard_index, cast, diagonal, unfold, as_strided,
 )
 from .logic import (
     equal, not_equal, greater_than, greater_equal, less_than, less_equal,
@@ -47,9 +47,14 @@ from .logic import (
 )
 from .search import (
     argmax, argmin, argsort, sort, topk, kthvalue, mode, searchsorted,
-    bucketize, median, nanmedian, quantile, histogram, histogramdd,
+    bucketize, median, nanmedian, quantile, nanquantile, histogram,
+    histogramdd,
 )
-from .linalg import norm
+# root-level linalg aliases, matching the reference's paddle.<fn> re-exports
+from .linalg import (
+    norm, pinv, slogdet, matrix_power, matrix_rank, multi_dot, cov, corrcoef,
+    det, inv, cdist, pdist,
+)
 from .random import (
     rand, randn, standard_normal, normal, uniform, randint, randint_like,
     randperm, multinomial, bernoulli, poisson, rand_like, randn_like,

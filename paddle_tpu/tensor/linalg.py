@@ -6,7 +6,10 @@ or polynomial implementations; fine for the API surface, not a perf path.
 
 from __future__ import annotations
 
+import math
+
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.tensor import Tensor
 from ..core.op_call import apply
@@ -187,3 +190,35 @@ def householder_product(x, tau, name=None):
         return q[:, :n]
 
     return apply(f, _as_t(x), _as_t(tau))
+
+
+def _p_reduce(d, p):
+    """Reduce a difference tensor over its last axis to the p-distance."""
+    if p == 0:
+        return jnp.sum((d != 0).astype(d.dtype), axis=-1)
+    if math.isinf(p):
+        return jnp.max(jnp.abs(d), axis=-1)
+    if p == 1:
+        return jnp.sum(jnp.abs(d), axis=-1)
+    if p == 2:
+        return jnp.sqrt(jnp.maximum(jnp.sum(d * d, axis=-1), 1e-30))
+    return jnp.sum(jnp.abs(d) ** p, axis=-1) ** (1.0 / p)
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    """Batched pairwise p-distances: [..., P, M] x [..., R, M] -> [..., P, R].
+    Difference-based (accurate); compute_mode's mm shortcut is an upstream
+    CUDA-perf knob — on TPU XLA fuses the broadcast subtract into the
+    reduction, so one formula serves."""
+    return apply(
+        lambda a, b: _p_reduce(a[..., :, None, :] - b[..., None, :, :], p),
+        _as_t(x), _as_t(y))
+
+
+def pdist(x, p=2.0, name=None):
+    """Condensed pairwise distances of an [N, M] matrix: the strict upper
+    triangle of cdist(x, x), row-major, shape [N*(N-1)/2]."""
+    x = _as_t(x)
+    iu, ju = np.triu_indices(x.shape[0], k=1)
+    return apply(lambda a: _p_reduce(a[iu] - a[ju], p), x)

@@ -438,3 +438,49 @@ def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
 
 def cast(x, dtype):
     return _as_t(x).astype(dtype)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    """Partial diagonal view: the diagonal of the (axis1, axis2) planes is
+    appended as the last dimension (ref: paddle.diagonal semantics)."""
+    return apply(
+        lambda a: jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2),
+        _as_t(x))
+
+
+def unfold(x, axis, size, step, name=None):
+    """Sliding windows along `axis`: dimension axis becomes the window count
+    and a trailing dimension of length `size` is appended (reference
+    Tensor.unfold semantics — a strided view upstream; gather here, which XLA
+    turns back into strided loads)."""
+    x = _as_t(x)
+    ax = axis % len(x.shape)
+    n = x.shape[ax]
+    if size > n:
+        raise ValueError(f"unfold size {size} exceeds dim {n} at axis {axis}")
+    starts = jnp.arange(0, n - size + 1, step)
+
+    def f(a):
+        idx = starts[:, None] + jnp.arange(size)[None, :]
+        out = jnp.take(a, idx, axis=ax)  # [..., n_win, size, ...]
+        return jnp.moveaxis(out, ax + 1, -1)
+
+    return apply(f, x)
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    """Arbitrary strided view over the (row-major) underlying buffer.
+    The reference aliases memory; jax arrays are immutable so this gathers
+    the same element pattern (grads scatter-add back, matching overlapping
+    -window autograd semantics)."""
+    x = _as_t(x)
+
+    def f(a):
+        idx = jnp.asarray(offset, jnp.int32)
+        nd = len(shape)
+        for i, (sh, st) in enumerate(zip(shape, stride)):
+            ar = jnp.arange(sh, dtype=jnp.int32) * st
+            idx = idx + ar.reshape((sh,) + (1,) * (nd - 1 - i))
+        return jnp.take(a.reshape(-1), idx)
+
+    return apply(f, x)

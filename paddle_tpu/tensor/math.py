@@ -597,3 +597,37 @@ def vander(x, n=None, increasing=False, name=None):
     cols = n if n is not None else xt.shape[0]
     return apply(lambda a: jnp.vander(a, cols, increasing=increasing), xt,
                  _op_name="vander")
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    """Numerically stable running logsumexp via an associative logaddexp scan
+    (parallel prefix on TPU — no serial loop)."""
+    def f(a):
+        if dtype is not None:
+            a = a.astype(dtype)
+        if axis is None:
+            return lax.associative_scan(jnp.logaddexp, a.reshape(-1))
+        return lax.associative_scan(jnp.logaddexp, a, axis=axis)
+
+    return apply(f, _as_t(x))
+
+
+def frexp(x, name=None):
+    def f(a):
+        m, e = jnp.frexp(a)
+        return m, e.astype(a.dtype)
+
+    return apply(f, _as_t(x))
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Rescale every slice along `axis` whose p-norm exceeds max_norm down to
+    exactly max_norm (reference renorm semantics, eps 1e-7)."""
+    def f(a):
+        reduce_axes = tuple(i for i in range(a.ndim) if i != (axis % a.ndim))
+        norms = jnp.sum(jnp.abs(a) ** p, axis=reduce_axes, keepdims=True) \
+            ** (1.0 / p)
+        factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        return a * factor.astype(a.dtype)
+
+    return apply(f, _as_t(x))

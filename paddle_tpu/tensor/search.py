@@ -138,3 +138,11 @@ def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None)
     h, edges = np.histogramdd(np.asarray(_as_t(x)._data), bins=bins, range=ranges, density=density,
                               weights=None if weights is None else np.asarray(_as_t(weights)._data))
     return Tensor(h), [Tensor(e) for e in edges]
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    qv = q._data if isinstance(q, Tensor) else q
+    return apply(
+        lambda a: jnp.nanquantile(a, jnp.asarray(qv), axis=axis,
+                                  keepdims=keepdim, method=interpolation),
+        _as_t(x))

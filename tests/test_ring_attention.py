@@ -121,7 +121,14 @@ class TestRingLongSequence:
         the single-device Pallas flash kernel on the full sequence, so this
         checks the ring machinery (rotation, causal schedule, global-lse
         combine) at scale."""
+        prev = dist.get_hybrid_communicate_group()
         dist.set_hybrid_communicate_group(None)
+        try:
+            self._run()
+        finally:
+            dist.set_hybrid_communicate_group(prev)
+
+    def _run(self):
         hcg = dist.create_hybrid_communicate_group(dp=4, sep=2)
         s_local, h, d = 16384, 1, 8
         s_glob = 2 * s_local

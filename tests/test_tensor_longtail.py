@@ -1,0 +1,193 @@
+"""OpTests for the round-2 tensor long tail (VERDICT r1 #5): diagonal,
+unfold, as_strided, logcumsumexp, renorm, frexp, cdist, pdist, nanquantile,
+plus the root-level linalg re-exports."""
+
+import numpy as np
+import scipy.spatial.distance as ssd
+
+import paddle_tpu as paddle
+from op_test import OpTest
+
+
+def _rand(*shape, seed=0, scale=1.0):
+    return (np.random.RandomState(seed).randn(*shape) * scale).astype(np.float32)
+
+
+class TestDiagonalOp(OpTest):
+    op = staticmethod(lambda x: paddle.diagonal(x, offset=1, axis1=1, axis2=2))
+    ref = staticmethod(lambda x: np.diagonal(x, offset=1, axis1=1, axis2=2))
+
+    def setup_method(self, _):
+        self.inputs = {"x": _rand(2, 4, 5)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()
+
+
+class TestUnfoldOp(OpTest):
+    op = staticmethod(lambda x: paddle.unfold(x, axis=1, size=3, step=2))
+
+    @staticmethod
+    def ref(x):
+        w = np.lib.stride_tricks.sliding_window_view(x, 3, axis=1)
+        return w[:, ::2]
+
+    def setup_method(self, _):
+        self.inputs = {"x": _rand(2, 9, 4)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()
+
+
+class TestAsStridedOp(OpTest):
+    # overlapping windows over a flat 12-element buffer
+    op = staticmethod(
+        lambda x: paddle.as_strided(x, shape=[3, 4], stride=[2, 1], offset=1))
+
+    @staticmethod
+    def ref(x):
+        flat = np.ascontiguousarray(x).reshape(-1)
+        it = flat.itemsize
+        return np.lib.stride_tricks.as_strided(
+            flat[1:], shape=(3, 4), strides=(2 * it, 1 * it)).copy()
+
+    def setup_method(self, _):
+        self.inputs = {"x": _rand(3, 4)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        # overlapping views must scatter-ADD grads back
+        self.check_grad()
+
+
+class TestLogcumsumexpOp(OpTest):
+    op = staticmethod(lambda x: paddle.logcumsumexp(x, axis=1))
+    ref = staticmethod(lambda x: np.logaddexp.accumulate(x, axis=1))
+
+    def setup_method(self, _):
+        self.inputs = {"x": _rand(3, 6)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()
+
+
+class TestRenormOp(OpTest):
+    op = staticmethod(lambda x: paddle.renorm(x, p=2.0, axis=0, max_norm=1.5))
+
+    @staticmethod
+    def ref(x):
+        norms = np.sqrt((x ** 2).sum(axis=(1, 2), keepdims=True))
+        factor = np.where(norms > 1.5, 1.5 / (norms + 1e-7), 1.0)
+        return x * factor
+
+    def setup_method(self, _):
+        self.inputs = {"x": _rand(4, 3, 2)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()
+
+
+class TestCdistOp(OpTest):
+    op = staticmethod(lambda x, y: paddle.cdist(x, y, p=2.0))
+    ref = staticmethod(lambda x, y: ssd.cdist(x, y, metric="euclidean"))
+
+    def setup_method(self, _):
+        self.inputs = {"x": _rand(5, 3), "y": _rand(4, 3, seed=1)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()
+
+    def test_p1_and_inf_and_batched(self):
+        x, y = _rand(2, 5, 3, seed=2), _rand(2, 4, 3, seed=3)
+        got = paddle.cdist(paddle.to_tensor(x), paddle.to_tensor(y), p=1.0)
+        want = np.stack([ssd.cdist(x[i], y[i], metric="cityblock")
+                         for i in range(2)])
+        np.testing.assert_allclose(got.numpy(), want, rtol=1e-5, atol=1e-5)
+        got = paddle.cdist(paddle.to_tensor(x), paddle.to_tensor(y),
+                           p=float("inf"))
+        want = np.stack([ssd.cdist(x[i], y[i], metric="chebyshev")
+                         for i in range(2)])
+        np.testing.assert_allclose(got.numpy(), want, rtol=1e-5, atol=1e-5)
+
+
+class TestPdistOp(OpTest):
+    op = staticmethod(lambda x: paddle.pdist(x, p=2.0))
+    ref = staticmethod(lambda x: ssd.pdist(x, metric="euclidean"))
+
+    def setup_method(self, _):
+        self.inputs = {"x": _rand(6, 4)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()
+
+
+class TestFrexpNanquantile:
+    def test_frexp(self):
+        x = _rand(3, 4, scale=10.0)
+        m, e = paddle.frexp(paddle.to_tensor(x))
+        mr, er = np.frexp(x)
+        np.testing.assert_allclose(m.numpy(), mr, rtol=1e-6)
+        np.testing.assert_allclose(e.numpy(), er.astype(np.float32))
+        # recomposition m * 2**e == x
+        np.testing.assert_allclose(m.numpy() * 2.0 ** e.numpy(), x, rtol=1e-6)
+
+    def test_nanquantile(self):
+        x = _rand(4, 5)
+        x[1, 2] = np.nan
+        x[3, 0] = np.nan
+        got = paddle.nanquantile(paddle.to_tensor(x), 0.35, axis=1)
+        want = np.nanquantile(x, 0.35, axis=1)
+        np.testing.assert_allclose(got.numpy(), want, rtol=1e-6, atol=1e-6)
+        got = paddle.nanquantile(paddle.to_tensor(x), [0.25, 0.75],
+                                 keepdim=True)
+        want = np.nanquantile(x, [0.25, 0.75], keepdims=True)
+        np.testing.assert_allclose(got.numpy(), want, rtol=1e-6, atol=1e-6)
+
+
+class TestRootReexports:
+    def test_linalg_aliases_at_root(self):
+        """The reference exposes these at the paddle root (VERDICT r1 #5)."""
+        for name in ("pinv", "slogdet", "matrix_power", "matrix_rank",
+                     "multi_dot", "cov", "corrcoef", "det", "inv",
+                     "cdist", "pdist", "diagonal", "unfold", "as_strided",
+                     "logcumsumexp", "renorm", "frexp", "nanquantile"):
+            assert callable(getattr(paddle, name)), name
+        a = _rand(3, 3)
+        np.testing.assert_allclose(
+            paddle.det(paddle.to_tensor(a)).numpy(),
+            np.linalg.det(a), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(
+            paddle.matrix_power(paddle.to_tensor(a), 2).numpy(),
+            a @ a, rtol=1e-4, atol=1e-4)
+
+    def test_unfold_negative_axis(self):
+        x = _rand(3, 8)
+        got = paddle.unfold(paddle.to_tensor(x), -1, 2, 3).numpy()
+        want = np.lib.stride_tricks.sliding_window_view(x, 2, axis=-1)[:, ::3]
+        np.testing.assert_allclose(got, want)
+
+    def test_tensor_methods(self):
+        x = paddle.to_tensor(_rand(4, 4))
+        assert x.diagonal().shape == [4]
+        assert x.unfold(0, 2, 2).shape == [2, 4, 2]
+        assert x.logcumsumexp(axis=0).shape == [4, 4]
