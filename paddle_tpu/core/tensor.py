@@ -284,7 +284,7 @@ class Parameter(Tensor):
     """A trainable Tensor (stop_gradient=False), registered by nn.Layer."""
 
     __slots__ = ("optimize_attr", "regularizer", "is_distributed",
-                 "_sharding_axes", "sequence_parallel")
+                 "_sharding_axes", "sequence_parallel", "no_weight_decay")
 
     def __init__(self, data, dtype=None, name=None, trainable=True):
         super().__init__(data, dtype=dtype, stop_gradient=not trainable, name=name)
@@ -295,6 +295,7 @@ class Parameter(Tensor):
         self.is_distributed = False
         self._sharding_axes = None  # PartitionSpec-like hint used by auto-parallel
         self.sequence_parallel = False  # grads need an mp-allreduce (SP regions)
+        self.no_weight_decay = False  # AdamW/coupled decay exemption flag
 
     def __repr__(self):
         return f"Parameter(name={self.name}, shape={self.shape}, dtype={self._data.dtype})\n       {self._data}"

@@ -49,6 +49,22 @@ def fused_bias_act(x, bias=None, dequant_scales=None, shift=None, smooth=None,
     return getattr(F, act_method)(x)
 
 
+def fused_linear_cross_entropy(x, weight, label, ignore_index=-100,
+                               transpose_weight=False, chunk_rows=2048,
+                               reduction="mean", name=None):
+    """LM-head matmul + softmax-CE without materialising [N, vocab] logits
+    (chunked scan + rematerialised backward — see ops/fused_ce.py)."""
+    from ...ops.fused_ce import fused_linear_cross_entropy as _impl
+
+    def f(h, w, y):
+        return _impl(h, w, y, ignore_index=ignore_index,
+                     transpose_weight=transpose_weight,
+                     chunk_rows=chunk_rows, reduction=reduction)
+
+    return apply(f, _as_t(x), _as_t(weight), _as_t(label).detach(),
+                 _op_name="fused_linear_cross_entropy")
+
+
 def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5, residual_alpha=1.0,
                      begin_norm_axis=1, bias=None, residual=None, quant_scale=-1, **kw):
     if bias is not None:

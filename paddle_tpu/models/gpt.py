@@ -45,6 +45,9 @@ class GPTConfig:
     initializer_range: float = 0.02
     tie_word_embeddings: bool = False
     use_recompute: bool = False
+    # fused chunked LM-head CE: never materialises [B*S, vocab] f32 logits
+    # (forward(labels=...) then returns (loss, None))
+    fused_lm_loss: bool = False
 
     @property
     def head_dim(self):
@@ -220,6 +223,20 @@ class GPTForCausalLM(Layer):
 
     def forward(self, input_ids, labels=None, attn_mask=None):
         h = self.model(input_ids, attn_mask)
+        if labels is not None and self.config.fused_lm_loss:
+            from ..incubate.nn.functional import fused_linear_cross_entropy
+
+            hidden = M.reshape(h, [-1, self.config.hidden_size])
+            flat_labels = M.reshape(labels, [-1])
+            if self.lm_head is not None:
+                loss = fused_linear_cross_entropy(
+                    hidden, self.lm_head.weight, flat_labels,
+                    ignore_index=-100)
+            else:  # tied embeddings: weight is [vocab, hidden]
+                loss = fused_linear_cross_entropy(
+                    hidden, self.model.embed_tokens.weight, flat_labels,
+                    ignore_index=-100, transpose_weight=True)
+            return loss, None
         logits = self._logits(h)
         if labels is not None:
             loss = F.cross_entropy(

@@ -39,6 +39,11 @@ def _sdpa_ref(q, k, v, mask=None, dropout_p=0.0, causal=False, scale=None, key=N
         else:
             logits = logits + mask.astype(logits.dtype)
     p = jax.nn.softmax(logits, axis=-1)
+    # fully-masked rows (causal q_len > kv_len, or an all-False mask row)
+    # must output 0, matching the flash-attn convention of the Pallas path
+    # — plain softmax would instead spread uniformly and return mean(v)
+    row_has_key = jnp.any(logits > -1e29, axis=-1, keepdims=True)
+    p = jnp.where(row_has_key, p, 0.0)
     if dropout_p > 0.0 and key is not None:
         keep = jax.random.bernoulli(key, 1.0 - dropout_p, p.shape)
         p = jnp.where(keep, p / (1.0 - dropout_p), 0.0)

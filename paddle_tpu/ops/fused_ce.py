@@ -1,0 +1,101 @@
+"""Chunked fused linear + softmax cross-entropy over the vocabulary.
+
+Reference parity: the fused/parallel softmax-CE family
+(c_softmax_with_cross_entropy, fused CE in PaddleNLP's LM heads — SURVEY.md
+§2.1 N14 / §7.4 "sharded/fused softmax-CE"). TPU-native design: the LM-head
+matmul and the CE reduction are evaluated per row-chunk inside a
+`lax.scan`, with `jax.checkpoint` on the chunk body, so the full
+[batch*seq, vocab] f32 logits tensor never exists — neither in the forward
+(only one [chunk, vocab] tile is live at a time) nor as saved residuals for
+the backward (the chunk is recomputed during the gradient pass, and grads
+w.r.t. hidden states / lm-head weight accumulate across scan ticks via the
+scan transpose).
+
+Why this matters on TPU: for the flagship bench (b16 x s1024, V=32k) the
+f32 logits are 16384*32000*4 B = 2.0 GiB of HBM traffic each way; chunking
+caps that at chunk_rows*V*4 (256 MiB at the default 2048 rows) while the
+per-chunk [2048, H] x [H, 32000] matmuls stay large enough to saturate the
+MXU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _pick_chunk_rows(n_rows, requested):
+    """Largest divisor of n_rows that is <= requested (falls back to padding
+    when n_rows is prime-ish and tiny divisors would shrink the matmul)."""
+    c = min(requested, n_rows)
+    while n_rows % c != 0:
+        c -= 1
+    # don't let a pathological divisor (e.g. 1) kill MXU utilisation; the
+    # caller pads instead when the best divisor is under half the request
+    if c < requested // 2 and n_rows > requested:
+        return None
+    return c
+
+
+def fused_linear_cross_entropy(hidden, weight, labels, ignore_index=-100,
+                               transpose_weight=False, chunk_rows=2048,
+                               reduction="mean"):
+    """CE(softmax(hidden @ weight), labels) without materialising the logits.
+
+    hidden: [N, H] (any float dtype; matmul accumulates in f32)
+    weight: [H, V] (or [V, H] with transpose_weight=True, the tied-embedding
+            layout)
+    labels: [N] int; rows whose label == ignore_index contribute 0 loss
+    reduction: 'mean' (over valid rows) | 'sum' | 'none' is not supported —
+            per-row losses would defeat the point of not materialising
+            row-major intermediates at full width, use nn.functional.
+            cross_entropy for that.
+    """
+    if reduction not in ("mean", "sum"):
+        raise ValueError(
+            "fused_linear_cross_entropy supports reduction='mean'|'sum'; "
+            "use nn.functional.cross_entropy for per-row losses")
+    if transpose_weight:
+        h_dim, v_dim = weight.shape[1], weight.shape[0]
+    else:
+        h_dim, v_dim = weight.shape[0], weight.shape[1]
+    n = hidden.shape[0]
+    labels = labels.astype(jnp.int32)
+
+    c = _pick_chunk_rows(n, chunk_rows)
+    if c is None:  # pad to a multiple of chunk_rows with ignored rows
+        c = min(chunk_rows, n)
+        pad = (-n) % c
+        hidden = jnp.pad(hidden, ((0, pad), (0, 0)))
+        labels = jnp.pad(labels, (0, pad), constant_values=ignore_index)
+        n = n + pad
+    n_chunks = n // c
+
+    hs = hidden.reshape(n_chunks, c, h_dim)
+    ys = labels.reshape(n_chunks, c)
+
+    def chunk_body(carry, xy):
+        h_c, y_c = xy
+        if transpose_weight:
+            logits = jnp.dot(h_c, weight.T,
+                             preferred_element_type=jnp.float32)
+        else:
+            logits = jnp.dot(h_c, weight,
+                             preferred_element_type=jnp.float32)
+        # online-softmax-style stable CE on the [c, V] tile
+        m = jnp.max(logits, axis=-1)
+        lse = m + jnp.log(jnp.sum(jnp.exp(logits - m[:, None]), axis=-1))
+        valid = y_c != ignore_index
+        safe = jnp.where(valid, y_c, 0)
+        true_logit = jnp.take_along_axis(logits, safe[:, None], axis=-1)[:, 0]
+        loss_sum = jnp.sum(jnp.where(valid, lse - true_logit, 0.0))
+        cnt = jnp.sum(valid.astype(jnp.float32))
+        tl, tc = carry
+        return (tl + loss_sum, tc + cnt), None
+
+    (total, cnt), _ = lax.scan(jax.checkpoint(chunk_body),
+                               (jnp.float32(0.0), jnp.float32(0.0)), (hs, ys))
+    if reduction == "sum":
+        return total
+    return total / jnp.maximum(cnt, 1.0)

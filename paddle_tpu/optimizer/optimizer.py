@@ -42,6 +42,10 @@ class Optimizer:
                     and not isinstance(weight_decay, L2Decay)):
                 self._weight_decay = 0.0
                 self._regularizer_fn = weight_decay
+        # effective coupled-L2 coefficient for the param currently being
+        # updated (set by _update_for; exemption zeroes it exactly instead
+        # of cancelling the term in a lower precision)
+        self._cur_wd = self._weight_decay
         self._accumulators = {}  # param id -> dict(state_name -> jnp array)
         self._step_count = 0
         self._param_names = {}
@@ -113,6 +117,7 @@ class Optimizer:
         dtypes: a strong-typed f32 lr (the TrainStep path) must not promote
         bf16 params or optimizer state (state promotion would also change
         jit avals and force a full recompile every step)."""
+        self._cur_wd = self._coupled_wd_for(p)
         new_p, new_state = self._update_raw(p, param, grad, state, lr)
         new_p = new_p.astype(param.dtype)
         new_state = jax.tree.map(
@@ -127,20 +132,27 @@ class Optimizer:
         """AdamW-style decoupled decay skips biases/norms by convention flag."""
         return getattr(p, "no_weight_decay", False)
 
+    def _coupled_wd_for(self, p):
+        """Effective optimizer-level coupled-L2 coefficient for this param
+        (reference precedence: a ParamAttr-attached regularizer REPLACES the
+        optimizer-level one; a decay-exempt param gets none at all).
+        Subclass _update math reads self._cur_wd so exemption is exact — no
+        cancel-then-re-add round-trip through the grad dtype."""
+        per_param = getattr(p, "regularizer", None)
+        if (per_param is not None and callable(per_param)) \
+                or self._decay_exempt(p):
+            return 0.0
+        return self._weight_decay
+
     def _regularized_grad(self, p, g_arr):
-        """Add the winning gradient-term regularizer to `g_arr` (reference
-        precedence: the ParamAttr-attached regularizer REPLACES the
-        optimizer-level one). Since coupled optimizers apply
-        self._weight_decay inside _update (_apply_l2), a per-param override
-        cancels that term here; AdamW's decoupled decay is a separate
-        mechanism and stays."""
-        if self._decay_exempt(p):
-            return g_arr
+        """Add the winning gradient-term regularizer to `g_arr`. The coupled
+        optimizer-level L2 is NOT handled here — _coupled_wd_for decides it
+        and _update applies it (in f32 where the subclass math is f32)."""
         per_param = getattr(p, "regularizer", None)
         if per_param is not None and callable(per_param):
-            g_arr = g_arr + per_param(p._data)
-            if self._weight_decay:
-                g_arr = g_arr - self._weight_decay * p._data
+            # explicit user intent wins even on decay-exempt params
+            return g_arr + per_param(p._data)
+        if self._decay_exempt(p):
             return g_arr
         if self._regularizer_fn is not None:
             g_arr = g_arr + self._regularizer_fn(p._data)

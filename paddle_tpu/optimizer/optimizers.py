@@ -19,7 +19,7 @@ class SGD(Optimizer):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
 
     def _update(self, param, grad, state, lr):
-        grad = _apply_l2(grad, param, self._weight_decay)
+        grad = _apply_l2(grad, param, self._cur_wd)
         return param - lr * grad, state
 
 
@@ -34,7 +34,7 @@ class Momentum(Optimizer):
         return {"velocity": jnp.zeros_like(p._data)}
 
     def _update(self, param, grad, state, lr):
-        grad = _apply_l2(grad, param, self._weight_decay)
+        grad = _apply_l2(grad, param, self._cur_wd)
         v = self._momentum * state["velocity"] + grad
         if self._nesterov:
             update = grad + self._momentum * v
@@ -54,7 +54,7 @@ class Adagrad(Optimizer):
         return {"moment": jnp.full_like(p._data, self._init_acc)}
 
     def _update(self, param, grad, state, lr):
-        grad = _apply_l2(grad, param, self._weight_decay)
+        grad = _apply_l2(grad, param, self._cur_wd)
         m = state["moment"] + jnp.square(grad)
         return param - lr * grad / (jnp.sqrt(m) + self._epsilon), {"moment": m}
 
@@ -75,7 +75,7 @@ class RMSProp(Optimizer):
         return st
 
     def _update(self, param, grad, state, lr):
-        grad = _apply_l2(grad, param, self._weight_decay)
+        grad = _apply_l2(grad, param, self._cur_wd)
         ms = self._rho * state["mean_square"] + (1 - self._rho) * jnp.square(grad)
         new_state = dict(state, mean_square=ms)
         if self._centered:
@@ -131,7 +131,7 @@ class Adam(Optimizer):
         return p32.astype(param.dtype), new_state
 
     def _update(self, param, grad, state, lr):
-        return self._adam_math(param, grad, state, lr, coupled_l2=self._weight_decay)
+        return self._adam_math(param, grad, state, lr, coupled_l2=self._cur_wd)
 
 
 class AdamW(Adam):
@@ -172,7 +172,7 @@ class Adamax(Adam):
         }
 
     def _update(self, param, grad, state, lr):
-        g32 = _apply_l2(grad.astype(jnp.float32), param.astype(jnp.float32), self._weight_decay)
+        g32 = _apply_l2(grad.astype(jnp.float32), param.astype(jnp.float32), self._cur_wd)
         b1p = state["beta1_pow"] * self._beta1
         m = self._beta1 * state["moment"] + (1 - self._beta1) * g32
         u = jnp.maximum(self._beta2 * state["inf_norm"], jnp.abs(g32) + 1e-12)
@@ -188,8 +188,16 @@ class Lamb(Optimizer):
         self._beta1 = beta1
         self._beta2 = beta2
         self._epsilon = epsilon
-        self._lamb_wd = lamb_weight_decay
+        # Lamb's decay rides the base coupled-wd machinery so
+        # no_weight_decay / per-param regularizers exempt it like everywhere
+        # else; _cur_wd then carries the effective per-param coefficient
+        self._weight_decay = float(lamb_weight_decay)
         self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _decay_exempt(self, p):
+        if super()._decay_exempt(p):
+            return True
+        return self._exclude_fn is not None and bool(self._exclude_fn(p))
 
     def _init_state(self, p):
         return {
@@ -209,8 +217,7 @@ class Lamb(Optimizer):
         m1_hat = m1 / (1 - b1p)
         m2_hat = m2 / (1 - b2p)
         r = m1_hat / (jnp.sqrt(m2_hat) + self._epsilon)
-        wd = self._lamb_wd
-        update = r + wd * p32
+        update = r + self._cur_wd * p32
         w_norm = jnp.linalg.norm(p32.reshape(-1))
         u_norm = jnp.linalg.norm(update.reshape(-1))
         trust = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)

@@ -43,7 +43,18 @@ class SparseCooTensor(Tensor):
         # view would silently disagree
         self._dense_cache = v
         if v is not None and getattr(self, "bcoo", None) is not None:
-            self.bcoo = jsparse.BCOO.fromdense(v)
+            import jax
+
+            if isinstance(v, jax.core.Tracer):
+                # under jit, nse cannot be derived from concrete values; use
+                # the full-size static bound so the rebuild stays traceable.
+                # NOTE: this allocates dense-sized index/value buffers — a
+                # correct fallback for small tensors, but it defeats sparsity
+                # for large ones; avoid dense in-place assignment to big
+                # SparseCooTensors inside jit
+                self.bcoo = jsparse.BCOO.fromdense(v, nse=int(v.size))
+            else:
+                self.bcoo = jsparse.BCOO.fromdense(v)
 
     @property
     def shape(self):
@@ -165,6 +176,13 @@ def add(x, y, name=None):
 
 
 def multiply(x, y, name=None):
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        if tuple(x.bcoo.shape) != tuple(y.bcoo.shape):
+            raise ValueError(
+                f"sparse multiply shape mismatch: {x.shape} vs {y.shape}")
+        # elementwise product at the index intersection — sparse in,
+        # sparse out (the reference keeps sparse*sparse sparse)
+        return _wrap(jsparse.bcoo_multiply_sparse(x.bcoo, y.bcoo))
     if isinstance(x, SparseCooTensor) and not isinstance(y, SparseCooTensor):
         yt = _as_t(y)._data
         if yt.ndim == 0:  # scalar: scale values, stay sparse

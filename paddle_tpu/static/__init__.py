@@ -57,5 +57,25 @@ def name_scope(prefix=None):
     return contextlib.nullcontext()
 
 
-class amp:  # paddle.static.amp namespace placeholder
-    pass
+class _ShimAttributeError(NotImplementedError, AttributeError):
+    """Raised by namespace shims: informative like the sibling shims'
+    NotImplementedError, but still an AttributeError so hasattr/getattr
+    feature-detection (and dunder protocol lookups, e.g. deepcopy) keep
+    working for code ported from the reference."""
+
+
+class _StaticAmpShim:
+    """paddle.static.amp shim: static-graph AMP program rewriting does not
+    exist on the TPU build — dynamic `paddle_tpu.amp.auto_cast` /
+    `amp.decorate` compose with `jit.to_static` (bf16 policy is applied at
+    trace time, so the compiled program is already mixed-precision)."""
+
+    def __getattr__(self, name):
+        raise _ShimAttributeError(
+            f"paddle.static.amp.{name} rewrites static Programs, which do not "
+            "exist on the TPU build; use paddle_tpu.amp.auto_cast / "
+            "amp.decorate with jit.to_static instead."
+        )
+
+
+amp = _StaticAmpShim()

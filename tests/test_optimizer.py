@@ -263,6 +263,52 @@ class TestRegularizer:
         np.testing.assert_allclose(lin.weight.numpy(),
                                    w0 - 0.1 * 0.5 * np.sign(w0), atol=1e-6)
 
+    def test_exempt_param_cancels_coupled_decay(self):
+        # no_weight_decay param under a coupled optimizer: the
+        # optimizer-level L2 applied inside _update must be cancelled
+        # (ADVICE r1 precedence inversion)
+        import numpy as np
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        from paddle_tpu.regularizer import L2Decay
+
+        paddle.seed(0)
+        lin = nn.Linear(4, 4, bias_attr=False)
+        lin.weight.no_weight_decay = True
+        w0 = lin.weight.numpy().copy()
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=lin.parameters(),
+                                   weight_decay=L2Decay(0.5))
+        x = paddle.to_tensor(np.zeros((2, 4), np.float32))
+        loss = paddle.mean(lin(x))
+        loss.backward()
+        opt.step()
+        # zero data grad + exempt -> weight unchanged
+        np.testing.assert_allclose(lin.weight.numpy(), w0, atol=1e-6)
+
+    def test_exempt_param_still_honors_per_param_regularizer(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        from paddle_tpu.framework.param_attr import ParamAttr
+        from paddle_tpu.regularizer import L1Decay, L2Decay
+
+        paddle.seed(0)
+        lin = nn.Linear(4, 4, bias_attr=False,
+                        weight_attr=ParamAttr(regularizer=L1Decay(0.5)))
+        lin.weight.no_weight_decay = True
+        w0 = lin.weight.numpy().copy()
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=lin.parameters(),
+                                   weight_decay=L2Decay(0.25))
+        x = paddle.to_tensor(np.zeros((2, 4), np.float32))
+        loss = paddle.mean(lin(x))
+        loss.backward()
+        opt.step()
+        # only the explicit per-param L1 applies; coupled L2 cancelled
+        np.testing.assert_allclose(lin.weight.numpy(),
+                                   w0 - 0.1 * 0.5 * np.sign(w0), atol=1e-6)
+
 
 class TestAdamWTrainStepParity:
     def test_decoupled_decay_applies_in_train_step(self):
@@ -365,3 +411,33 @@ class TestBf16DtypeStability:
                     f"{type(opt).__name__} state {n.dtype} != {o.dtype}"))
                 if n.dtype != o.dtype else None,
                 new_st, st)
+
+
+class TestLambExemption:
+    def test_lamb_respects_no_weight_decay_and_exclude_fn(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+
+        def run(flag=False, exclude=None):
+            paddle.seed(0)
+            lin = nn.Linear(4, 4, bias_attr=False)
+            if flag:
+                lin.weight.no_weight_decay = True
+            opt = paddle.optimizer.Lamb(learning_rate=0.1,
+                                        lamb_weight_decay=0.5,
+                                        parameters=lin.parameters(),
+                                        exclude_from_weight_decay_fn=exclude)
+            x = paddle.to_tensor(np.zeros((2, 4), np.float32))
+            loss = paddle.mean(lin(x))
+            loss.backward()
+            opt.step()
+            return lin.weight.numpy()
+
+        paddle.seed(0)
+        lin0 = nn.Linear(4, 4, bias_attr=False)
+        w0 = lin0.weight.numpy().copy()
+        # zero data grad: with decay the weight moves, exempt leaves it put
+        assert np.abs(run() - w0).max() > 1e-4
+        np.testing.assert_allclose(run(flag=True), w0, atol=1e-6)
+        np.testing.assert_allclose(run(exclude=lambda p: True), w0, atol=1e-6)

@@ -227,3 +227,42 @@ class TestFlashCrossLength:
         for a, b_ in zip(g1, g2):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                        rtol=2e-4, atol=2e-5)
+
+
+class TestFullyMaskedRows:
+    """causal with q_len > kv_len leaves leading query rows with zero visible
+    keys. Flash-attn convention: those rows output 0 — the XLA fallback must
+    agree with the Pallas kernel (ADVICE r1 dispatch-divergence fix)."""
+
+    def test_fallback_zeroes_fully_masked_rows(self):
+        from paddle_tpu.nn.functional.attention import _sdpa_ref
+
+        rng = np.random.RandomState(2)
+        b, h, d, sq, sk = 1, 2, 16, 8, 4
+        q = jnp.asarray(rng.randn(b, sq, h, d), jnp.float32)
+        k = jnp.asarray(rng.randn(b, sk, h, d), jnp.float32)
+        v = jnp.asarray(rng.randn(b, sk, h, d), jnp.float32)
+        out = np.asarray(_sdpa_ref(q, k, v, causal=True))
+        # rows i < sq-sk see no keys (tril offset k=sk-sq) -> exactly zero
+        np.testing.assert_allclose(out[:, : sq - sk], 0.0)
+        # visible rows unchanged vs plain softmax reference
+        s = jnp.einsum("bshd,bthd->bhst", q, k) / np.sqrt(d)
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        ref = jnp.einsum("bhst,bthd->bshd",
+                         jax.nn.softmax(jnp.where(mask, s, -1e30), -1), v)
+        np.testing.assert_allclose(out[:, sq - sk:],
+                                   np.asarray(ref)[:, sq - sk:],
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_all_false_mask_row(self):
+        from paddle_tpu.nn.functional.attention import _sdpa_ref
+
+        rng = np.random.RandomState(3)
+        b, h, d, s = 1, 1, 8, 4
+        q = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+        k = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+        v = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+        mask = jnp.ones((b, h, s, s), bool).at[:, :, 0, :].set(False)
+        out = np.asarray(_sdpa_ref(q, k, v, mask=mask))
+        np.testing.assert_allclose(out[:, 0], 0.0)
+        assert np.abs(out[:, 1:]).sum() > 0

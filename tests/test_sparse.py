@@ -156,3 +156,32 @@ class TestSparseReviewRegressions:
         np.testing.assert_allclose(S.relu(d).numpy(), [0.0, 2.0])
         np.testing.assert_allclose(S.tanh(d).numpy(), np.tanh([-1.0, 2.0]),
                                    rtol=1e-6)
+
+    def test_sparse_sparse_multiply_stays_sparse(self):
+        # elementwise sparse*sparse at the index intersection (ADVICE r1)
+        a = _coo()
+        idx = np.array([[0, 1], [1, 1]], np.int64)  # overlaps a at (0,1) only
+        vals = np.array([5.0, 7.0], np.float32)
+        b = S.sparse_coo_tensor(paddle.to_tensor(idx), paddle.to_tensor(vals),
+                                [2, 3])
+        out = S.multiply(a, b)
+        assert isinstance(out, S.SparseCooTensor)
+        ref = a.to_dense().numpy() * b.to_dense().numpy()
+        np.testing.assert_allclose(out.to_dense().numpy(), ref)
+
+    def test_dense_setter_traceable_under_jit(self):
+        # assigning a traced dense value must not crash on concrete-nse
+        # derivation (ADVICE r1): static full-size bound keeps it traceable
+        import jax
+
+        t = _coo()
+
+        def f(dense):
+            tt = S.sparse_coo_tensor(
+                paddle.to_tensor(np.array([[0], [0]], np.int64)),
+                paddle.to_tensor(np.array([1.0], np.float32)), [2, 3])
+            tt._data = dense
+            return tt.bcoo.todense()
+
+        out = jax.jit(f)(t.to_dense()._data)
+        np.testing.assert_allclose(np.asarray(out), t.to_dense().numpy())
