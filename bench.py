@@ -13,6 +13,44 @@ import sys
 import time
 
 
+def kernel_smoke():
+    """Tiny numerics check of the Pallas kernels ON THE REAL CHIP before any
+    timing: a Mosaic-lowering regression (e.g. in the GQA index maps) must
+    fail loudly here rather than silently corrupt the perf numbers
+    (SURVEY.md §4 tolerance discipline; VERDICT r1 item 10)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    b, s, h, kv, d = 1, 256, 4, 2, 128
+    q = jnp.asarray(rng.randn(b, s, h, d), jnp.bfloat16)
+    k = jnp.asarray(rng.randn(b, s, kv, d), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(b, s, kv, d), jnp.bfloat16)
+
+    from paddle_tpu.ops.pallas.flash import flash_attention as pallas_flash
+    from paddle_tpu.ops.flash_attention import _xla_flash
+    for causal in (False, True):
+        out = np.asarray(pallas_flash(q, k, v, causal=causal,
+                                      interpret=False), np.float32)
+        ref = np.asarray(_xla_flash(q, k, v, causal, None), np.float32)
+        err = np.abs(out - ref).max()
+        assert err < 0.1, f"flash kernel mismatch (causal={causal}): {err}"
+
+    from paddle_tpu.ops.pallas.norms import layer_norm, rms_norm
+    x = jnp.asarray(rng.randn(8, 512), jnp.float32)
+    w = jnp.asarray(rng.randn(512), jnp.float32)
+    bias = jnp.asarray(rng.randn(512), jnp.float32)
+    ln = np.asarray(layer_norm(x, w, bias, interpret=False))
+    mu = np.asarray(x, np.float64).mean(-1, keepdims=True)
+    var = np.asarray(x, np.float64).var(-1, keepdims=True)
+    ln_ref = (np.asarray(x) - mu) / np.sqrt(var + 1e-5) * np.asarray(w) + np.asarray(bias)
+    assert np.abs(ln - ln_ref).max() < 1e-3, "layer_norm kernel mismatch"
+    rn = np.asarray(rms_norm(x, w, interpret=False))
+    rn_ref = np.asarray(x) / np.sqrt((np.asarray(x, np.float64) ** 2).mean(-1, keepdims=True) + 1e-6) * np.asarray(w)
+    assert np.abs(rn - rn_ref).max() < 1e-3, "rms_norm kernel mismatch"
+
+
 def main():
     import jax
 
@@ -25,15 +63,18 @@ def main():
 
     on_tpu = backend in ("tpu", "axon")
     if on_tpu:
-        # ~0.5B-param config: big enough for meaningful MFU, fits 16G HBM
+        kernel_smoke()  # numerics gate before timing
+        # ~0.5B-param config: big enough for meaningful MFU, fits 16G HBM;
+        # fused chunked LM-head CE keeps the [B*S, 32k] f32 logits out of HBM
         cfg = GPTConfig(vocab_size=32000, hidden_size=1536, intermediate_size=4096,
                         num_hidden_layers=12, num_attention_heads=12,
-                        max_position_embeddings=2048)
+                        max_position_embeddings=2048, fused_lm_loss=True)
         batch, seq, steps, windows = 16, 1024, 10, 3
+        batch = int(os.environ.get("BENCH_BATCH", batch))
     else:
         cfg = GPTConfig(vocab_size=1024, hidden_size=256, intermediate_size=688,
                         num_hidden_layers=4, num_attention_heads=8,
-                        max_position_embeddings=512)
+                        max_position_embeddings=512, fused_lm_loss=True)
         batch, seq, steps, windows = 2, 128, 3, 1
 
     paddle.seed(0)

@@ -16,6 +16,7 @@ import jax.numpy as jnp
 from ..core.tensor import Tensor
 from ..core.op_call import apply
 from ..core import random_state
+from ..core import tape as _tape
 
 
 def recompute(function, *args, **kwargs):
@@ -53,7 +54,16 @@ def recompute(function, *args, **kwargs):
         random_state._STATE.stream = random_state._KeyStream(base_key)
         random_state._STATE.stream.counter = base_counter
         try:
-            out = function(*rebuilt, **kwargs)
+            # Tape OFF inside the remat'd body: gradients flow through the
+            # OUTER jax.vjp over this traced function. With the tape on,
+            # every inner op would run its own jax.vjp, which expands
+            # custom_vjp ops (e.g. the Pallas flash kernel) into their raw
+            # forward primitives inside this jaxpr — the outer checkpoint
+            # then tries to differentiate bare pallas_call and crashes
+            # (and custom bwd rules would be silently ignored). no_grad
+            # keeps custom_vjp calls intact in the trace.
+            with _tape.no_grad():
+                out = function(*rebuilt, **kwargs)
         finally:
             random_state._STATE.stream = saved
             for p, arr in zip(param_tensors, saved_params):

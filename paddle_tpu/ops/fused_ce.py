@@ -62,6 +62,8 @@ def fused_linear_cross_entropy(hidden, weight, labels, ignore_index=-100,
         h_dim, v_dim = weight.shape[0], weight.shape[1]
     n = hidden.shape[0]
     labels = labels.astype(jnp.int32)
+    if n == 0:  # empty batch: defined result, matching the unfused path
+        return jnp.float32(0.0)
 
     c = _pick_chunk_rows(n, chunk_rows)
     if c is None:  # pad to a multiple of chunk_rows with ignored rows
