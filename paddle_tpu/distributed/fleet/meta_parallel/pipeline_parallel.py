@@ -12,11 +12,23 @@ stage (one `lax.switch` branch — embedding stage consumes the raw
 microbatch, the final stage computes the loss) and hands its activation to
 the next stage with a ring `lax.ppermute`. XLA overlaps the permute with
 compute (the reference needs dedicated comm streams + event sync for this,
-SURVEY.md §2.1 N13). Backward is `jax.grad` through the scan — the reverse
-schedule with exact activation economy chosen by XLA, `jax.checkpoint` per
-stage giving the recompute variant (ref recompute_interval). Warmup/drain
-bubbles are masked ticks, matching GPipe; the steady-state compute/comm
-pattern equals 1F1B's because forward and backward of one scan tick fuse.
+SURVEY.md §2.1 N13). Backward is `jax.grad` through the scan, with
+`jax.checkpoint` per stage giving the recompute variant (ref
+recompute_interval). Warmup/drain bubbles are masked ticks, matching GPipe.
+
+Memory semantics (measured via compiled memory_analysis, see
+tests/test_pipeline_parallel.py::TestPipelineMemory): this is GPipe-shaped,
+NOT true 1F1B — `jax.grad` through the scan retains per-tick residuals, so
+activation memory grows O(accumulate_steps). With recompute_interval>0 the
+per-tick residual is only the tick's BOUNDARY tensors (microbatch input +
+ppermuted hidden + labels; measured ≈1× boundary size per microbatch, ~5×
+smaller than the no-remat variant), so the growth constant is small: for
+transformer stages whose internal activations are 30–60× the boundary
+hidden, remat-GPipe uses LESS activation memory than true 1F1B's
+O(depth × full-activations) whenever accumulate_steps < ~30× depth, at the
+usual one-extra-forward cost. The reference's literal 1F1B schedule
+(pp_utils/p2p_communication.py (U)) bounds in-flight FULL activations by
+pipeline depth instead — better only for long schedules without remat.
 
 Gradient flow across stages needs no reducer: stage params enter replicated
 (in_spec P()), so shard_map's transpose inserts the psum that sums each

@@ -122,7 +122,8 @@ class GroupShardedTrainStep(TrainStep):
         scaler_sh = (rep, rep, rep) if self.scaler is not None else ()
 
         in_sh = (param_sh, buffer_sh, state_sh, rep, rep, scaler_sh)
-        out_sh = (param_sh, buffer_sh, state_sh, rep, scaler_sh)
+        # trailing None: aux outputs (has_aux loss_fns) stay unconstrained
+        out_sh = (param_sh, buffer_sh, state_sh, rep, scaler_sh, None)
         donate = (0, 2) if self.donate else ()
 
         def jit_with_batch(nbatch, batch_ndims):

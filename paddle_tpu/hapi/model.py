@@ -41,13 +41,20 @@ class Model:
             from ..jit.train_step import TrainStep
 
             loss_layer = loss
+            # with metrics, the compiled step also returns the network
+            # outputs (aux) so the jit path reports the same per-batch
+            # metrics as eager (ref Model.fit always updates train metrics);
+            # without metrics, no aux — don't materialize outputs for nothing
+            with_aux = bool(self._metrics)
 
             def loss_fn(net, *batch):
                 *xs, y = batch
                 out = net(*xs)
-                return loss_layer(out, y)
+                l = loss_layer(out, y)
+                return (l, out) if with_aux else l
 
-            self._train_step = TrainStep(self.network, loss_fn, optimizer)
+            self._train_step = TrainStep(self.network, loss_fn, optimizer,
+                                         has_aux=with_aux)
         return self
 
     # -------------- steps --------------
@@ -56,7 +63,11 @@ class Model:
         inputs = _to_list(inputs)
         labels = _to_list(labels)
         if self._train_step is not None and update:
-            loss = self._train_step(*inputs, *labels)
+            if self._train_step.has_aux:
+                loss, outs = self._train_step(*inputs, *labels)
+                self._update_metrics(outs, labels)
+            else:
+                loss = self._train_step(*inputs, *labels)
             self._optimizer._lr_step()
             return [float(loss)]
         outs = self.network(*[_as_tensor(x) for x in inputs])
@@ -66,7 +77,33 @@ class Model:
             self._optimizer.step()
             self._optimizer.clear_grad()
             self._optimizer._lr_step()
+        self._update_metrics(outs, labels)
         return [float(loss)]
+
+    def _update_metrics(self, outs, labels):
+        if not self._metrics:
+            return
+        with _tape.no_grad():
+            lbl = [_as_tensor(y) for y in labels]
+            for m in self._metrics:
+                corr = m.compute(outs, *lbl)
+                # base Metric.compute passes through its args as a tuple
+                # (Precision/Recall); the ref hapi unpacks compute outputs
+                if isinstance(corr, (tuple, list)):
+                    m.update(*corr)
+                else:
+                    m.update(corr)
+
+    def _metric_logs(self, logs):
+        for m in self._metrics:
+            name = m.name()
+            res = m.accumulate()
+            if isinstance(name, list):
+                for n, r in zip(name, res if isinstance(res, list) else [res]):
+                    logs[n] = r
+            else:
+                logs[name] = res
+        return logs
 
     def eval_batch(self, inputs, labels=None):
         self.network.eval()
@@ -80,7 +117,10 @@ class Model:
                 loss_val = float(self._loss(outs, *[_as_tensor(y) for y in labels]))
             for m in self._metrics:
                 corr = m.compute(outs, *[_as_tensor(y) for y in labels])
-                metrics_out.append(m.update(corr))
+                if isinstance(corr, (tuple, list)):
+                    metrics_out.append(m.update(*corr))
+                else:
+                    metrics_out.append(m.update(corr))
         return loss_val, metrics_out
 
     def predict_batch(self, inputs):
@@ -124,6 +164,7 @@ class Model:
                 losses = self.train_batch(ins, lbls)
                 logs = {"loss": losses}
                 logs["lr"] = self._optimizer.get_lr()
+                self._metric_logs(logs)
                 for cb in cbs:
                     cb.on_train_batch_end(step, logs)
                 step_count += 1
@@ -152,14 +193,7 @@ class Model:
         logs = {}
         if losses:
             logs["loss"] = float(np.mean(losses))
-        for m in self._metrics:
-            name = m.name()
-            res = m.accumulate()
-            if isinstance(name, list):
-                for n, r in zip(name, res if isinstance(res, list) else [res]):
-                    logs[n] = r
-            else:
-                logs[name] = res
+        self._metric_logs(logs)
         for cb in cbs:
             cb.on_eval_end(logs)
         return logs

@@ -6,7 +6,14 @@ device_put — on TPU VMs the host is roomy and jax transfers are async, so
 worker *threads* (NumPy releases the GIL) plus a bounded prefetch queue give
 the same overlap without fork/IPC fragility. A native C++ prefetcher can slot
 under `paddle_tpu.utils.hostloader` for decode-heavy pipelines.
-"""
+
+For decode-heavy Python datasets that DON'T release the GIL (jpeg decode,
+tokenization), `use_process_workers=True` switches to spawn-based process
+workers, the analog of the reference's default multiprocess mode: workers
+fetch+collate to NumPy and ship batches back over a queue; the parent wraps
+them into Tensors (device transfer stays in the parent, where the TPU
+client lives). Threads remain the default — on low-core hosts process
+startup dominates."""
 
 from __future__ import annotations
 
@@ -20,26 +27,73 @@ from .dataset import IterableDataset
 from .sampler import BatchSampler
 
 
-def default_collate_fn(batch):
+def _collate_np(batch):
+    """Collate to a NumPy pytree — the single collate policy; the Tensor
+    variant is this plus a leaf wrap. Process workers ship these trees over
+    the queue (Tensors don't cross the process boundary)."""
     sample = batch[0]
     if isinstance(sample, np.ndarray):
-        return Tensor(np.stack(batch))
+        return np.stack(batch)
     if isinstance(sample, Tensor):
-        return Tensor(np.stack([np.asarray(s._data) for s in batch]))
+        return np.stack([np.asarray(s._data) for s in batch])
     if isinstance(sample, (int, np.integer)):
         # int32, not the reference's int64: x64 is disabled jax-side, and
         # int32 indices are what TPU embedding/gather kernels want
-        return Tensor(np.asarray(batch, np.int32))
+        return np.asarray(batch, np.int32)
     if isinstance(sample, float):
-        return Tensor(np.asarray(batch, np.float32))
+        return np.asarray(batch, np.float32)
     if isinstance(sample, (list, tuple)):
         transposed = list(zip(*batch))
-        return type(sample)(default_collate_fn(list(items)) for items in transposed)
+        return type(sample)(_collate_np(list(items)) for items in transposed)
     if isinstance(sample, dict):
-        return {k: default_collate_fn([d[k] for d in batch]) for k in sample}
+        return {k: _collate_np([d[k] for d in batch]) for k in sample}
     if isinstance(sample, str):
         return list(batch)
-    return Tensor(np.asarray(batch))
+    return np.asarray(batch)
+
+
+def _np_to_tensor_tree(x):
+    if isinstance(x, np.ndarray):
+        return Tensor(x)
+    if isinstance(x, (list, tuple)) and not (x and isinstance(x[0], str)):
+        return type(x)(_np_to_tensor_tree(v) for v in x)
+    if isinstance(x, dict):
+        return {k: _np_to_tensor_tree(v) for k, v in x.items()}
+    return x
+
+
+def _tensor_to_np_tree(x):
+    """Inverse of _np_to_tensor_tree: user collate_fns return Tensors, but a
+    spawned child must ship NumPy (the TPU client lives in the parent)."""
+    if isinstance(x, Tensor):
+        return np.asarray(x._data)
+    if isinstance(x, (list, tuple)) and not (x and isinstance(x[0], str)):
+        return type(x)(_tensor_to_np_tree(v) for v in x)
+    if isinstance(x, dict):
+        return {k: _tensor_to_np_tree(v) for k, v in x.items()}
+    return x
+
+
+def _process_worker(dataset, collate_fn, worker_init_fn, worker_id, task_q,
+                    result_q):
+    """Top-level for spawn picklability."""
+    if worker_init_fn is not None:
+        worker_init_fn(worker_id)
+    while True:
+        item = task_q.get()
+        if item is None:
+            return
+        seq, indices = item
+        try:
+            out = _tensor_to_np_tree(collate_fn([dataset[i] for i in indices]))
+        except Exception as e:  # noqa: BLE001 — propagate to the consumer
+            out = RuntimeError(f"DataLoader worker {worker_id} failed: "
+                               f"{type(e).__name__}: {e}")
+        result_q.put((seq, out))
+
+
+def default_collate_fn(batch):
+    return _np_to_tensor_tree(_collate_np(batch))
 
 
 class DataLoader:
@@ -47,12 +101,16 @@ class DataLoader:
                  batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
                  collate_fn=None, num_workers=0, use_buffer_reader=True,
                  prefetch_factor=2, use_shared_memory=True, timeout=0,
-                 worker_init_fn=None, persistent_workers=False):
+                 worker_init_fn=None, persistent_workers=False,
+                 use_process_workers=False):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = max(prefetch_factor, 1)
         self.timeout = timeout
+        self.use_process_workers = use_process_workers
+        self.worker_init_fn = worker_init_fn
+        self._proc_collate = collate_fn or _collate_np
         self._iterable = isinstance(dataset, IterableDataset)
         if self._iterable:
             self.batch_size = batch_size
@@ -163,8 +221,80 @@ class DataLoader:
             for _ in threads:
                 task_q.put(None)
 
+    def _iter_process(self):
+        """Spawn-based process workers (opt-in): fetch+collate to NumPy in
+        children, convert to Tensors in the parent, preserve batch order."""
+        import multiprocessing as mp
+
+        ctx = mp.get_context("spawn")
+        task_q = ctx.Queue()
+        result_q = ctx.Queue()
+        procs = [
+            ctx.Process(
+                target=_process_worker,
+                args=(self.dataset, self._proc_collate, self.worker_init_fn,
+                      wid, task_q, result_q),
+                daemon=True)
+            for wid in range(self.num_workers)
+        ]
+        for p in procs:
+            p.start()
+        index_iter = iter(self.batch_sampler)
+        max_inflight = self.num_workers * self.prefetch_factor
+        results = {}
+        n_submitted = 0
+        n_consumed = 0
+        done_submitting = False
+        try:
+            for _ in range(max_inflight):
+                try:
+                    task_q.put((n_submitted, list(next(index_iter))))
+                    n_submitted += 1
+                except StopIteration:
+                    done_submitting = True
+                    break
+            while n_consumed < n_submitted or not done_submitting:
+                waited = 0.0
+                while n_consumed not in results:
+                    # poll in short slices so a dead worker (segfault/OOM
+                    # kill) raises instead of blocking forever
+                    try:
+                        seq, out = result_q.get(timeout=1.0)
+                        results[seq] = out
+                        continue
+                    except queue.Empty:
+                        waited += 1.0
+                    if not all(p.is_alive() for p in procs):
+                        raise RuntimeError(
+                            "DataLoader process worker died unexpectedly "
+                            f"while batch {n_consumed} was in flight")
+                    if self.timeout and waited >= self.timeout:
+                        raise RuntimeError(
+                            f"DataLoader process worker timed out after "
+                            f"{self.timeout}s waiting for batch {n_consumed}")
+                out = results.pop(n_consumed)
+                n_consumed += 1
+                if isinstance(out, Exception):
+                    raise out
+                if not done_submitting:
+                    try:
+                        task_q.put((n_submitted, list(next(index_iter))))
+                        n_submitted += 1
+                    except StopIteration:
+                        done_submitting = True
+                yield _np_to_tensor_tree(out)
+        finally:
+            for _ in procs:
+                task_q.put(None)
+            for p in procs:
+                p.join(timeout=5)
+                if p.is_alive():
+                    p.terminate()
+
     def __iter__(self):
         if self.num_workers and self.num_workers > 0 and not self._iterable and self.batch_sampler is not None:
+            if self.use_process_workers:
+                return self._iter_process()
             return self._iter_threaded()
         return self._iter_sync()
 

@@ -23,14 +23,18 @@ from ..core import random_state
 
 class TrainStep:
     def __init__(self, model, loss_fn, optimizer, scaler=None, donate=True,
-                 mesh=None, in_shardings=None):
-        """loss_fn(model, *batch_tensors) -> loss Tensor (scalar)."""
+                 mesh=None, in_shardings=None, has_aux=False):
+        """loss_fn(model, *batch_tensors) -> loss Tensor (scalar), or with
+        has_aux=True -> (loss, aux) where aux is a Tensor/tuple of Tensors
+        returned alongside the loss (e.g. network outputs for metric
+        updates — ref Model.fit reports metrics every train batch)."""
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.scaler = scaler if (scaler is not None and scaler.is_enable()) else None
         self.donate = donate
         self.mesh = mesh
+        self.has_aux = has_aux
         self._jitted = None
         self._param_names = None
         self._buffer_names = None
@@ -56,6 +60,7 @@ class TrainStep:
         self._buffer_names = list(buffers.keys())
         name_by_id = {id(p): n for n, p in params.items()}
         loss_fn = self.loss_fn
+        has_aux = self.has_aux
 
         scaler = self.scaler
 
@@ -69,7 +74,14 @@ class TrainStep:
                     live_params = [sd_live[n] for n in self._param_names]
                     for p in live_params:
                         p.grad = None
-                    loss = loss_fn(model, *[Tensor(b) for b in batch])
+                    res = loss_fn(model, *[Tensor(b) for b in batch])
+                    if has_aux:
+                        loss, aux = res
+                        aux_arrays = jax.tree.map(
+                            lambda t: t._data if isinstance(t, Tensor) else t,
+                            aux)
+                    else:
+                        loss, aux_arrays = res, ()
                     found_inf = jnp.zeros((), jnp.bool_)
                     if scaler is None:
                         loss.backward()
@@ -133,7 +145,8 @@ class TrainStep:
                 new_scaler_state = (new_scale,
                                     jnp.where(inc, jnp.zeros_like(good1), good1),
                                     jnp.where(dec, jnp.zeros_like(bad1), bad1))
-            return new_params, new_buffers, new_opt_states, loss._data, new_scaler_state
+            return (new_params, new_buffers, new_opt_states, loss._data,
+                    new_scaler_state, aux_arrays)
 
         return step_fn
 
@@ -156,7 +169,8 @@ class TrainStep:
                             jnp.asarray(self.scaler._bad_steps, jnp.int32))
         else:
             scaler_state = ()
-        new_params, new_buffers, new_opt_states, loss, new_scaler_state = self._jitted(
+        (new_params, new_buffers, new_opt_states, loss, new_scaler_state,
+         aux_arrays) = self._jitted(
             param_arrays, buffer_arrays, opt_states, lr, rng_key, scaler_state,
             *batch_arrays
         )
@@ -170,4 +184,6 @@ class TrainStep:
         for n, st in zip(self._param_names, new_opt_states):
             opt._accumulators[id(sd[n])] = st
         opt._step_count += 1
+        if self.has_aux:
+            return Tensor(loss), jax.tree.map(Tensor, aux_arrays)
         return Tensor(loss)

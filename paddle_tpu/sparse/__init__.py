@@ -22,11 +22,15 @@ class SparseCooTensor(Tensor):
 
     def __init__(self, indices, values, shape):
         idx = _as_t(indices)._data
-        vals = _as_t(values)._data
-        bcoo = jsparse.BCOO((vals, idx.T.astype(jnp.int32)),
+        vals_t = _as_t(values)
+        bcoo = jsparse.BCOO((vals_t._data, idx.T.astype(jnp.int32)),
                             shape=tuple(int(s) for s in shape))
         self.bcoo = bcoo
         self._dense_cache = None
+        # keep the live values Tensor when it's on the tape, so
+        # out.values().sum().backward() differentiates through sparse ops
+        # (a fresh Tensor(bcoo.data) would be disconnected)
+        self._values_t = vals_t if not vals_t.stop_gradient else None
         _init_tensor_slots(self)
 
     # -------------------------------------------------- lazy dense interop
@@ -42,6 +46,7 @@ class SparseCooTensor(Tensor):
         # must keep the BCOO authoritative too, or sparse ops and the dense
         # view would silently disagree
         self._dense_cache = v
+        self._values_t = None  # mutation invalidates the tracked values view
         if v is not None and getattr(self, "bcoo", None) is not None:
             import jax
 
@@ -71,6 +76,8 @@ class SparseCooTensor(Tensor):
         return Tensor(self.bcoo.indices.T)
 
     def values(self):
+        if getattr(self, "_values_t", None) is not None:
+            return self._values_t
         return Tensor(self.bcoo.data)
 
     def nnz(self):
@@ -106,6 +113,7 @@ def _wrap(bcoo):
     t = SparseCooTensor.__new__(SparseCooTensor)
     t.bcoo = bcoo
     t._dense_cache = None
+    t._values_t = None
     _init_tensor_slots(t)
     return t
 
@@ -162,6 +170,37 @@ def add(x, y, name=None):
         if tuple(x.bcoo.shape) != tuple(y.bcoo.shape):
             raise ValueError(
                 f"sparse add shape mismatch: {x.shape} vs {y.shape}")
+        import jax as _jax
+
+        tracked = (not x.values().stop_gradient) or \
+            (not y.values().stop_gradient)
+        if tracked and not isinstance(x.bcoo.indices, _jax.core.Tracer) \
+                and not isinstance(y.bcoo.indices, _jax.core.Tracer):
+            # grad-aware path: merged pattern computed host-side from the
+            # concrete indices, values merged by a differentiable
+            # scatter-add (residual adds in sparse conv nets)
+            import numpy as np
+            from ..core.op_call import apply
+
+            ia = np.asarray(x.bcoo.indices)
+            ib = np.asarray(y.bcoo.indices)
+            alli = np.concatenate([ia, ib])
+            key = np.zeros(len(alli), np.int64)
+            for ax, size in enumerate(x.bcoo.shape[:alli.shape[1]]):
+                key = key * int(size) + alli[:, ax].astype(np.int64)
+            uniq, first, inv = np.unique(key, return_index=True,
+                                         return_inverse=True)
+            out_idx = alli[first]
+            m = len(uniq)
+
+            def f(va, vb):
+                allv = jnp.concatenate([va, vb])
+                return jnp.zeros((m,) + allv.shape[1:], allv.dtype) \
+                    .at[jnp.asarray(inv)].add(allv)
+
+            vals = apply(f, x.values(), y.values(), _op_name="sparse_add")
+            return sparse_coo_tensor(Tensor(jnp.asarray(out_idx.T)), vals,
+                                     list(x.bcoo.shape))
         # concatenate entries then coalesce: exact sparse add, stays sparse
         # (static nse bound keeps this traceable under jit)
         data = jnp.concatenate([x.bcoo.data, y.bcoo.data])
@@ -186,6 +225,13 @@ def multiply(x, y, name=None):
     if isinstance(x, SparseCooTensor) and not isinstance(y, SparseCooTensor):
         yt = _as_t(y)._data
         if yt.ndim == 0:  # scalar: scale values, stay sparse
+            if not x.values().stop_gradient:
+                from ..core.op_call import apply
+
+                vals = apply(lambda v: v * yt, x.values(),
+                             _op_name="sparse_scale")
+                return sparse_coo_tensor(x.indices(), vals,
+                                         list(x.bcoo.shape))
             return _wrap(jsparse.BCOO((x.bcoo.data * yt, x.bcoo.indices),
                                       shape=x.bcoo.shape))
     a = x.to_dense() if isinstance(x, SparseCooTensor) else _as_t(x)
@@ -201,6 +247,14 @@ def _unary_on_values(fn, dense_name):
 
     def op(x, name=None):
         if isinstance(x, SparseCooTensor):
+            if not x.values().stop_gradient:
+                # tape-tracked values (e.g. after sparse conv): route the
+                # value map through apply so gradients keep flowing
+                from ..core.op_call import apply
+
+                vals = apply(fn, x.values(), _op_name=dense_name)
+                return sparse_coo_tensor(x.indices(), vals,
+                                         list(x.bcoo.shape))
             return _wrap(jsparse.BCOO((fn(x.bcoo.data), x.bcoo.indices),
                                       shape=x.bcoo.shape))
         if dense_name == "relu":
@@ -239,13 +293,7 @@ def masked_matmul(x, y, mask, name=None):
     return _wrap(jsparse.BCOO((vals, idx), shape=mask.bcoo.shape))
 
 
-class nn:
-    """paddle.sparse.nn subset: activations over sparse tensors."""
-
-    class ReLU:
-        def __call__(self, x):
-            return relu(x)
-
+from . import nn  # noqa: E402  (layer surface: Conv3D/SubmConv3D/pool/BN)
 
 __all__ = [
     "SparseCooTensor", "sparse_coo_tensor", "sparse_csr_tensor",

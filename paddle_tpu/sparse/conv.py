@@ -1,0 +1,272 @@
+"""Sparse convolution / pooling over COO tensors.
+
+Reference parity: paddle/phi/kernels/sparse/ conv3d + pool kernels and the
+python/paddle/sparse/nn layer surface (SURVEY.md §2.1 N26). The reference
+implements scatter-gather CUDA kernels; the TPU-native design is the
+"rulebook" formulation the spconv family uses, mapped onto XLA primitives:
+
+  1. build, on host from the CONCRETE input coordinates, one
+     (gather_rows, scatter_rows) index pair per kernel offset — sparse
+     geometry is data-dependent, so it lives outside the traced program,
+     exactly like the reference's rulebook construction;
+  2. per offset: gather input rows -> one [n_pairs, Cin] x [Cin, Cout]
+     matmul (MXU) -> segment-sum into output rows (XLA scatter-add).
+
+Gradients flow through gather/matmul/scatter by construction — no
+hand-written backward kernels (the reference needs conv3d_grad CUDA).
+Submanifold convs (SubmConv) keep the input coordinate set; regular convs
+enumerate reachable output sites. Pooling rides the same rulebook with a
+max/mean combine.
+
+Values may be per-point feature rows ([nse, C] with the trailing dim dense),
+matching the reference's SparseCooTensor-with-dense-channels layout.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.op_call import apply
+from ..core.tensor import Tensor
+from ..tensor.creation import _as_t
+
+
+def _tupleize(v, nd):
+    if isinstance(v, (list, tuple)):
+        if len(v) != nd:
+            raise ValueError(f"expected {nd} entries, got {v}")
+        return tuple(int(x) for x in v)
+    return (int(v),) * nd
+
+
+def _concrete_coords(sp):
+    idx = sp.bcoo.indices
+    if isinstance(idx, jax.core.Tracer):
+        raise NotImplementedError(
+            "sparse conv/pool builds its rulebook from concrete coordinates; "
+            "indices must not be traced (weights/values may be). Run the "
+            "geometry-defining part eagerly, as the reference does.")
+    return np.asarray(idx)  # [nse, 1+nd] (batch + spatial)
+
+
+def _out_spatial(in_sp, k, s, p, d):
+    return tuple((i + 2 * pp - dd * (kk - 1) - 1) // ss + 1
+                 for i, kk, ss, pp, dd in zip(in_sp, k, s, p, d))
+
+
+def _ravel(coords, shape):
+    """coords [m, 1+nd] -> unique int64 key per site."""
+    key = coords[:, 0].astype(np.int64)
+    for ax, size in enumerate(shape):
+        key = key * int(size) + coords[:, ax + 1].astype(np.int64)
+    return key
+
+
+def _build_rulebook(coords, spatial, ksize, stride, padding, dilation, subm):
+    """Return (out_coords [m, 1+nd], rules) where rules is a list of
+    (kernel_flat_index, gather_rows, scatter_rows) with non-empty pairs."""
+    nd = len(spatial)
+    offsets = np.stack(np.meshgrid(
+        *[np.arange(k) for k in ksize], indexing="ij"), -1).reshape(-1, nd)
+    stride_a = np.asarray(stride)
+    pad_a = np.asarray(padding)
+    dil_a = np.asarray(dilation)
+
+    if subm:
+        out_spatial = tuple(spatial)
+    else:
+        out_spatial = _out_spatial(spatial, ksize, stride, padding, dilation)
+    out_sp_a = np.asarray(out_spatial)
+
+    # one pass per kernel offset: (gather rows, candidate output coords)
+    per_offset = []  # (kernel_flat_index, gather_rows, out_coords [m_k, 1+nd])
+    for fk, off in enumerate(offsets):
+        num = coords[:, 1:] + pad_a - off * dil_a
+        ok = (num % stride_a == 0).all(1)
+        o = num // stride_a
+        ok &= ((o >= 0) & (o < out_sp_a)).all(1)
+        if ok.any():
+            per_offset.append((fk, np.nonzero(ok)[0],
+                               np.concatenate([coords[ok, :1], o[ok]], 1)))
+
+    if subm:
+        sorted_key = np.sort(_ravel(coords, out_spatial))
+        order = np.argsort(_ravel(coords, out_spatial))
+        out_coords = coords
+    else:
+        if not per_offset:
+            return np.zeros((0, 1 + nd), np.int32), out_spatial, []
+        allc = np.concatenate([oc for _, _, oc in per_offset], 0)
+        uniq, first = np.unique(_ravel(allc, out_spatial), return_index=True)
+        out_coords = allc[first]
+        sorted_key = uniq
+        order = np.arange(len(uniq))
+
+    rules = []
+    for fk, gather, ocs in per_offset:
+        okey = _ravel(ocs, out_spatial)
+        pos = np.searchsorted(sorted_key, okey)
+        if subm:
+            # submanifold: only outputs that are existing input sites
+            valid = (pos < len(sorted_key)) & \
+                (sorted_key[np.clip(pos, 0, len(sorted_key) - 1)] == okey)
+            if not valid.any():
+                continue
+            gather = gather[valid]
+            scatter = order[pos[valid]]
+        else:
+            scatter = pos  # every candidate site exists by construction
+        rules.append((fk, gather.astype(np.int32), scatter.astype(np.int32)))
+    return out_coords.astype(np.int32), out_spatial, rules
+
+
+def _conv_values(values, weight, rules, m):
+    """values [nse, Cin], weight [Kflat, Cin, Cout] -> out values [m, Cout]."""
+    out = jnp.zeros((m, weight.shape[-1]), values.dtype)
+    for fk, gather, scatter in rules:
+        contrib = jnp.take(values, jnp.asarray(gather), axis=0) @ \
+            weight[fk].astype(values.dtype)
+        out = out.at[jnp.asarray(scatter)].add(contrib)
+    return out
+
+
+def _coo_conv(x, weight, bias, ksize, stride, padding, dilation, subm):
+    from . import SparseCooTensor, sparse_coo_tensor
+
+    if not isinstance(x, SparseCooTensor):
+        raise TypeError("sparse conv expects a SparseCooTensor input")
+    nd = len(ksize)
+    shape = tuple(int(s) for s in x.bcoo.shape)
+    if len(shape) != nd + 2:
+        raise ValueError(
+            f"expected input rank {nd + 2} [N, *spatial, C], got {shape}")
+    spatial = shape[1:-1]
+    cin = shape[-1]
+    coords = _concrete_coords(x)
+    if coords.shape[1] != nd + 1:
+        raise ValueError(
+            f"expected {nd + 1} sparse dims (batch + spatial) with dense "
+            f"channels; got {coords.shape[1]} sparse dims — construct the "
+            "input with values of shape [nse, C]")
+    out_coords, out_spatial, rules = _build_rulebook(
+        coords, spatial, ksize, stride, padding, dilation, subm)
+
+    w = _as_t(weight)
+    cout = int(w.shape[-1])
+    wk = w.reshape([-1, cin, cout])
+    m = out_coords.shape[0]
+    args = [x.values(), wk] + ([_as_t(bias)] if bias is not None else [])
+
+    def f(vals, wflat, *b):
+        out = _conv_values(vals, wflat, rules, m)
+        if b:
+            out = out + b[0]
+        return out
+
+    out_vals = apply(f, *args, _op_name="sparse_conv")
+    out_shape = (shape[0],) + tuple(out_spatial) + (cout,)
+    return sparse_coo_tensor(Tensor(jnp.asarray(out_coords.T)), out_vals,
+                             list(out_shape))
+
+
+def _coo_pool(x, ksize, stride, padding, mode):
+    from . import SparseCooTensor, sparse_coo_tensor
+
+    if not isinstance(x, SparseCooTensor):
+        raise TypeError("sparse pool expects a SparseCooTensor input")
+    nd = len(ksize)
+    shape = tuple(int(s) for s in x.bcoo.shape)
+    spatial = shape[1:-1]
+    coords = _concrete_coords(x)
+    dilation = (1,) * nd
+    out_coords, out_spatial, rules = _build_rulebook(
+        coords, spatial, ksize, stride, padding, dilation, subm=False)
+    m = out_coords.shape[0]
+    c = shape[-1]
+
+    def f(vals):
+        if mode == "max":
+            # segment-max over contributing rows; empty segments impossible
+            # (every output site has >= 1 contributor by construction)
+            out = jnp.full((m, c), -jnp.inf, vals.dtype)
+            for _, gather, scatter in rules:
+                out = out.at[jnp.asarray(scatter)].max(
+                    jnp.take(vals, jnp.asarray(gather), axis=0))
+            return out
+        out = jnp.zeros((m, c), vals.dtype)
+        cnt = jnp.zeros((m, 1), vals.dtype)
+        for _, gather, scatter in rules:
+            out = out.at[jnp.asarray(scatter)].add(
+                jnp.take(vals, jnp.asarray(gather), axis=0))
+            cnt = cnt.at[jnp.asarray(scatter)].add(1.0)
+        return out / cnt
+
+    out_vals = apply(f, x.values(), _op_name=f"sparse_{mode}_pool")
+    out_shape = (shape[0],) + tuple(out_spatial) + (c,)
+    return sparse_coo_tensor(Tensor(jnp.asarray(out_coords.T)), out_vals,
+                             list(out_shape))
+
+
+# ---------------------------------------------------------------- functional
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NDHWC", key=None, name=None):
+    """weight: [kD, kH, kW, Cin, Cout] (reference sparse conv layout)."""
+    if groups != 1:
+        raise NotImplementedError("sparse conv groups != 1")
+    w = _as_t(weight)
+    ksize = tuple(int(s) for s in w.shape[:3])
+    return _coo_conv(x, w, bias, ksize, _tupleize(stride, 3),
+                     _tupleize(padding, 3), _tupleize(dilation, 3), subm=False)
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NDHWC", key=None, name=None):
+    if groups != 1:
+        raise NotImplementedError("sparse conv groups != 1")
+    w = _as_t(weight)
+    ksize = tuple(int(s) for s in w.shape[:3])
+    if _tupleize(stride, 3) != (1, 1, 1):
+        raise ValueError("submanifold conv requires stride 1")
+    return _coo_conv(x, w, bias, ksize, (1, 1, 1), _tupleize(padding, 3),
+                     _tupleize(dilation, 3), subm=True)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NHWC", key=None, name=None):
+    """weight: [kH, kW, Cin, Cout]."""
+    if groups != 1:
+        raise NotImplementedError("sparse conv groups != 1")
+    w = _as_t(weight)
+    ksize = tuple(int(s) for s in w.shape[:2])
+    return _coo_conv(x, w, bias, ksize, _tupleize(stride, 2),
+                     _tupleize(padding, 2), _tupleize(dilation, 2), subm=False)
+
+
+def subm_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NHWC", key=None, name=None):
+    if groups != 1:
+        raise NotImplementedError("sparse conv groups != 1")
+    w = _as_t(weight)
+    ksize = tuple(int(s) for s in w.shape[:2])
+    if _tupleize(stride, 2) != (1, 1):
+        raise ValueError("submanifold conv requires stride 1")
+    return _coo_conv(x, w, bias, ksize, (1, 1), _tupleize(padding, 2),
+                     _tupleize(dilation, 2), subm=True)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, data_format="NDHWC",
+               name=None):
+    k = _tupleize(kernel_size, 3)
+    s = _tupleize(stride, 3) if stride is not None else k
+    return _coo_pool(x, k, s, _tupleize(padding, 3), "max")
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, data_format="NDHWC",
+               name=None):
+    k = _tupleize(kernel_size, 3)
+    s = _tupleize(stride, 3) if stride is not None else k
+    return _coo_pool(x, k, s, _tupleize(padding, 3), "avg")

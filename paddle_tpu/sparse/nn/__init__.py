@@ -1,0 +1,129 @@
+"""paddle.sparse.nn parity (ref: python/paddle/sparse/nn/layer/ (U):
+Conv3D/SubmConv3D/MaxPool3D/BatchNorm/ReLU over sparse COO tensors).
+
+Layers hold dense Parameters (weight [*k, Cin, Cout]); the sparse geometry
+work happens in sparse/conv.py's rulebook (see its docstring for the
+TPU-native design)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ...core.tensor import Parameter
+from ...nn.layer.layers import Layer
+from ...nn.initializer import Normal
+from . import functional as F_sp
+from ..conv import _tupleize
+
+
+class _SparseConvNd(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, nd, subm,
+                 stride=1, padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format=None):
+        super().__init__()
+        if groups != 1:
+            raise NotImplementedError("sparse conv groups != 1")
+        self._nd = nd
+        self._subm = subm
+        self._stride = _tupleize(stride, nd)
+        self._padding = _tupleize(padding, nd)
+        self._dilation = _tupleize(dilation, nd)
+        k = _tupleize(kernel_size, nd)
+        fan_in = in_channels * int(np.prod(k))
+        std = 1.0 / max(fan_in, 1) ** 0.5
+        init = weight_attr if callable(weight_attr) else Normal(0.0, std)
+        self.weight = self.create_parameter(
+            shape=list(k) + [in_channels, out_channels], attr=init)
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter(
+                shape=[out_channels], attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        fn = {
+            (2, False): F_sp.conv2d, (2, True): F_sp.subm_conv2d,
+            (3, False): F_sp.conv3d, (3, True): F_sp.subm_conv3d,
+        }[(self._nd, self._subm)]
+        return fn(x, self.weight, self.bias, stride=self._stride,
+                  padding=self._padding, dilation=self._dilation)
+
+
+class Conv3D(_SparseConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, **kw):
+        super().__init__(in_channels, out_channels, kernel_size, nd=3,
+                         subm=False, **kw)
+
+
+class SubmConv3D(_SparseConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, **kw):
+        super().__init__(in_channels, out_channels, kernel_size, nd=3,
+                         subm=True, **kw)
+
+
+class Conv2D(_SparseConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, **kw):
+        super().__init__(in_channels, out_channels, kernel_size, nd=2,
+                         subm=False, **kw)
+
+
+class SubmConv2D(_SparseConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, **kw):
+        super().__init__(in_channels, out_channels, kernel_size, nd=2,
+                         subm=True, **kw)
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, data_format="NDHWC",
+                 name=None):
+        super().__init__()
+        self._k, self._s, self._p = kernel_size, stride, padding
+
+    def forward(self, x):
+        return F_sp.max_pool3d(x, self._k, self._s, self._p)
+
+
+class AvgPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, data_format="NDHWC",
+                 name=None):
+        super().__init__()
+        self._k, self._s, self._p = kernel_size, stride, padding
+
+    def forward(self, x):
+        return F_sp.avg_pool3d(x, self._k, self._s, self._p)
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return F_sp.relu(x)
+
+
+class BatchNorm(Layer):
+    """Per-channel batchnorm over the stored values (the reference's sparse
+    BatchNorm normalizes the [nse, C] value rows)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        from ...nn.layer.norm import BatchNorm1D
+
+        self._bn = BatchNorm1D(num_features, momentum=momentum,
+                               epsilon=epsilon, weight_attr=weight_attr,
+                               bias_attr=bias_attr)
+
+    def forward(self, x):
+        from .. import SparseCooTensor, sparse_coo_tensor
+
+        if not isinstance(x, SparseCooTensor):
+            return self._bn(x)
+        new_vals = self._bn(x.values())
+        return sparse_coo_tensor(x.indices(), new_vals, x.shape)
+
+
+functional = F_sp
+
+__all__ = [
+    "Conv2D", "Conv3D", "SubmConv2D", "SubmConv3D",
+    "MaxPool3D", "AvgPool3D", "ReLU", "BatchNorm", "functional",
+]

@@ -1,0 +1,24 @@
+"""paddle.sparse.nn.functional parity (ref: python/paddle/sparse/nn/
+functional/ (U)): conv/pool entry points over SparseCooTensor plus the
+activation re-exports."""
+
+from ..conv import (
+    conv2d,
+    conv3d,
+    subm_conv2d,
+    subm_conv3d,
+    max_pool3d,
+    avg_pool3d,
+)
+
+
+def relu(x, name=None):
+    from .. import relu as _relu
+
+    return _relu(x)
+
+
+__all__ = [
+    "conv2d", "conv3d", "subm_conv2d", "subm_conv3d",
+    "max_pool3d", "avg_pool3d", "relu",
+]

@@ -1,0 +1,80 @@
+"""Model.fit metric parity: the jit (TrainStep) path must report the same
+per-epoch metrics as eager (VERDICT r1 item 7; ref Model.fit always updates
+metrics on train outputs)."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def _data(n=64, d=8, classes=4, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, d).astype(np.float32)
+    y = rng.randint(0, classes, (n, 1)).astype(np.int64)
+    return [(x[i:i + 8], y[i:i + 8]) for i in range(0, n, 8)]
+
+
+def _run_epoch(jit):
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    model = paddle.Model(net)
+    acc = paddle.metric.Accuracy()
+    model.prepare(paddle.optimizer.SGD(learning_rate=0.0,
+                                       parameters=net.parameters()),
+                  nn.CrossEntropyLoss(), metrics=acc, jit=jit)
+    for xb, yb in _data():
+        model.train_batch([paddle.to_tensor(xb)], [paddle.to_tensor(yb)])
+    return acc.accumulate()
+
+
+class TestFitMetricsParity:
+    def test_jit_matches_eager_accuracy(self):
+        # lr=0 so both paths see identical weights on every batch
+        a_eager = _run_epoch(jit=False)
+        a_jit = _run_epoch(jit=True)
+        assert a_eager == a_jit, (a_eager, a_jit)
+        assert 0.0 <= a_jit <= 1.0
+
+    def test_fit_logs_contain_metric(self):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        model = paddle.Model(net)
+        acc = paddle.metric.Accuracy()
+        model.prepare(paddle.optimizer.SGD(learning_rate=0.01,
+                                           parameters=net.parameters()),
+                      nn.CrossEntropyLoss(), metrics=acc, jit=True)
+        seen = {}
+
+        from paddle_tpu.hapi.callbacks import Callback
+
+        class Grab(Callback):
+            def on_epoch_end(self, epoch, logs=None):
+                seen.update(logs or {})
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(32, 8).astype(np.float32)
+        y = rng.randint(0, 4, (32, 1)).astype(np.int64)
+        model.fit([(x[i:i + 8], y[i:i + 8]) for i in range(0, 32, 8)],
+                  epochs=1, verbose=0, callbacks=[Grab()])
+        assert "acc" in seen, seen
+
+
+class TestTupleComputeMetrics:
+    def test_precision_metric_in_train_batch(self):
+        # base Metric.compute returns its args as a tuple — update must be
+        # called unpacked (review r2 regression)
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 1),
+                            nn.Sigmoid())
+        model = paddle.Model(net)
+        prec = paddle.metric.Precision()
+        model.prepare(paddle.optimizer.SGD(learning_rate=0.01,
+                                           parameters=net.parameters()),
+                      nn.BCELoss(), metrics=prec, jit=True)
+        rng = np.random.RandomState(0)
+        x = rng.randn(16, 8).astype(np.float32)
+        y = rng.randint(0, 2, (16, 1)).astype(np.float32)
+        model.train_batch([paddle.to_tensor(x)], [paddle.to_tensor(y)])
+        val = prec.accumulate()
+        assert 0.0 <= val <= 1.0

@@ -252,3 +252,52 @@ class TestTrainStepScaler:
         step(xinf, y)
         np.testing.assert_array_equal(m.weight.numpy(), w_before)
         assert float(scaler._scale) == 2.0**11  # halved on inf
+
+
+class _PicklableDS:
+    """Module-level (spawn-picklable) dataset for the process-worker test."""
+
+    def __len__(self):
+        return 24
+
+    def __getitem__(self, i):
+        import os
+
+        return np.asarray([i, os.getpid()], np.int64)
+
+
+class TestProcessWorkers:
+    def test_process_loader_matches_sync_and_uses_other_pids(self):
+        import os
+
+        from paddle_tpu.io import DataLoader
+
+        dl = DataLoader(_PicklableDS(), batch_size=4, num_workers=2,
+                        use_process_workers=True, timeout=120)
+        batches = [b.numpy() for b in dl]
+        assert len(batches) == 6
+        ids = np.concatenate([b[:, 0] for b in batches])
+        np.testing.assert_array_equal(ids, np.arange(24))  # order preserved
+        pids = set(np.concatenate([b[:, 1] for b in batches]).tolist())
+        assert os.getpid() not in pids  # fetched in child processes
+        assert len(pids) >= 1
+
+    def test_process_worker_error_propagates(self):
+        from paddle_tpu.io import DataLoader
+
+        dl = DataLoader(_FailingDS(), batch_size=2, num_workers=2,
+                        use_process_workers=True, timeout=120)
+        import pytest as _pytest
+
+        with _pytest.raises(RuntimeError, match="worker .* failed"):
+            list(dl)
+
+
+class _FailingDS:
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, i):
+        if i == 5:
+            raise ValueError("boom")
+        return np.asarray([i], np.float32)
