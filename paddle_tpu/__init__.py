@@ -34,7 +34,7 @@ _LAZY_SUBMODULES = (
     "linalg", "fft", "vision", "distributed", "incubate", "profiler", "metric",
     "framework", "hapi", "models", "ops", "utils", "distribution", "sparse",
     "text", "audio", "onnx", "inference", "signal", "quantization",
-    "regularizer", "version", "sysconfig", "geometric",
+    "regularizer", "version", "sysconfig", "geometric", "hub",
 )
 
 _LAZY_ATTRS = {
@@ -46,6 +46,10 @@ _LAZY_ATTRS = {
     "flops": ("hapi.dynamic_flops", "flops"),
     "DataParallel": ("distributed.parallel", "DataParallel"),
     "LazyGuard": ("nn.initializer.lazy_init", "LazyGuard"),
+    "callbacks": ("hapi", "callbacks"),
+    "iinfo": ("framework.dtype_info", "iinfo"),
+    "finfo": ("framework.dtype_info", "finfo"),
+    "batch": ("io.reader_compat", "batch"),
 }
 
 
@@ -76,6 +80,14 @@ def enable_static():
 
 def in_dynamic_mode():
     return True
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
 
 
 def get_cudnn_version():

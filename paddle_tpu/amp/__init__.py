@@ -1,4 +1,5 @@
 from .auto_cast import auto_cast, amp_guard, decorate, white_list, black_list, is_bf16_supported, is_float16_supported
+is_bfloat16_supported = is_bf16_supported
 from .grad_scaler import GradScaler, AmpScaler, OptimizerState
 from . import debugging
 

@@ -217,6 +217,51 @@ class Tensor:
         self._data = jnp.clip(self._data, min, max)
         return self
 
+    def exp_(self):
+        self._data = jnp.exp(self._data)
+        return self
+
+    def floor_(self):
+        self._data = jnp.floor(self._data)
+        return self
+
+    def round_(self):
+        self._data = jnp.round(self._data)
+        return self
+
+    def sqrt_(self):
+        self._data = jnp.sqrt(self._data)
+        return self
+
+    def rsqrt_(self):
+        self._data = 1.0 / jnp.sqrt(self._data)
+        return self
+
+    def reciprocal_(self):
+        self._data = 1.0 / self._data
+        return self
+
+    def tanh_(self):
+        self._data = jnp.tanh(self._data)
+        return self
+
+    def flatten_(self, start_axis=0, stop_axis=-1):
+        nd = self._data.ndim
+        s, e = start_axis % nd, stop_axis % nd
+        shape = self._data.shape
+        self._data = self._data.reshape(
+            shape[:s] + (-1,) + shape[e + 1:])
+        return self
+
+    def squeeze_(self, axis=None):
+        self._data = (jnp.squeeze(self._data) if axis is None
+                      else jnp.squeeze(self._data, axis))
+        return self
+
+    def unsqueeze_(self, axis):
+        self._data = jnp.expand_dims(self._data, axis)
+        return self
+
     # ---------------- python protocol ----------------
     def __len__(self):
         if self.ndim == 0:

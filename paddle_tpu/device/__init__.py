@@ -93,3 +93,70 @@ class tpu:
             return devs[0].memory_stats() or {}
         except Exception:
             return {}
+
+
+def get_all_device_type():
+    import jax
+
+    return sorted({d.platform for d in jax.devices()})
+
+
+class Stream:
+    """CUDA-stream shim: XLA owns scheduling on TPU; the API exists so
+    reference scripts construct/synchronize streams as no-ops."""
+
+    def __init__(self, device=None, priority=None):
+        self.device = device
+
+    def synchronize(self):
+        import jax
+
+        jax.effects_barrier() if hasattr(jax, "effects_barrier") else None
+
+    def wait_event(self, event):
+        return None
+
+    def wait_stream(self, stream):
+        return None
+
+    def record_event(self, event=None):
+        return event or Event()
+
+
+class Event:
+    def __init__(self, enable_timing=False, blocking=False, interprocess=False):
+        pass
+
+    def record(self, stream=None):
+        return None
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        return None
+
+
+def stream_guard(stream):
+    import contextlib
+
+    return contextlib.nullcontext()
+
+
+def current_stream(device=None):
+    return Stream(device)
+
+
+def set_stream(stream):
+    return stream
+
+
+class _StreamNS:
+    Stream = Stream
+    Event = Event
+    stream_guard = staticmethod(stream_guard)
+    current_stream = staticmethod(current_stream)
+    set_stream = staticmethod(set_stream)
+
+
+stream = _StreamNS()

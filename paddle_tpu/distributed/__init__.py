@@ -19,6 +19,9 @@ from .topology import (
 from .communication import (
     all_gather,
     all_gather_object,
+    broadcast_object_list,
+    scatter_object_list,
+    get_backend,
     all_reduce,
     all_to_all,
     all_to_all_single,
@@ -67,6 +70,9 @@ from . import fleet
 from . import sharding
 from .ring_attention import ring_flash_attention, ulysses_attention
 from . import checkpoint
+from . import launch
+from . import stream
+from .mp_split import split
 from . import auto_parallel
 from .auto_parallel import (
     DistModel,

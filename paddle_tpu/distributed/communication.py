@@ -401,3 +401,34 @@ def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
     """ref communication/gather.py: under SPMD gather == all_gather (every
     rank materializes the list; non-root ranks' copies are DCE'd)."""
     return all_gather(gather_list, tensor, group=group, sync_op=sync_op)
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    """Single-process SPMD: every rank already holds identical Python
+    objects (one controller process drives all devices); multi-host uses
+    jax.experimental.multihost_utils.broadcast_one_to_all."""
+    # one-controller SPMD: every rank reads the same host objects, so the
+    # broadcast is already done; multi-host (one controller per host) would
+    # route through jax.experimental.multihost_utils.broadcast_one_to_all
+    _resolve(group)
+    return None
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    """One-controller SPMD analog of scatter_object_list: rank r's slot is
+    in_object_list[r]; with a single controller every rank sees the full
+    list, so the local slot is selected by rank."""
+    group = _resolve(group)
+    if in_object_list is None:
+        raise ValueError("src rank must provide in_object_list")
+    rank = group.rank if hasattr(group, "rank") else 0
+    out_object_list.clear()
+    out_object_list.append(in_object_list[rank % len(in_object_list)])
+    return None
+
+
+def get_backend(group=None):
+    """The communication backend name: XLA collectives over ICI/DCN (the
+    reference returns 'NCCL'/'GLOO')."""
+    return "XLA"

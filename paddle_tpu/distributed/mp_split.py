@@ -1,0 +1,60 @@
+"""paddle.distributed.split (ref: python/paddle/distributed/collective.py
+split() (U)): shard a linear/embedding computation over the model-parallel
+group. The reference builds the parallel weights and inserts the collectives
+op-by-op; here it constructs the corresponding fleet.meta_parallel layer
+(Column/RowParallelLinear, VocabParallelEmbedding) once per call site and
+applies it — same math, the collectives compile to XLA named-axis ops."""
+
+from __future__ import annotations
+
+_SPLIT_CACHE = {}
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    from .topology import get_hybrid_communicate_group
+
+    hcg = get_hybrid_communicate_group()
+    if hcg is None or hcg.get_model_parallel_world_size() < 2:
+        raise RuntimeError(
+            "paddle.distributed.split needs an initialized model-parallel "
+            "group (fleet.init with mp_degree>1)")
+    mp = hcg.get_model_parallel_world_size()
+    if num_partitions != mp:
+        raise ValueError(
+            f"num_partitions ({num_partitions}) must equal the "
+            f"model-parallel degree ({mp})")
+
+    key = name or f"dist_split_{len(_SPLIT_CACHE)}_{operation}_{size}_{axis}"
+    layer = _SPLIT_CACHE.get(key)
+    if layer is None:
+        from .fleet.meta_parallel import (
+            ColumnParallelLinear,
+            RowParallelLinear,
+            VocabParallelEmbedding,
+        )
+
+        if operation == "linear":
+            in_f, out_f = size
+            if axis == 1:
+                layer = ColumnParallelLinear(
+                    in_f, out_f, weight_attr=weight_attr,
+                    has_bias=bias_attr is not False,
+                    gather_output=gather_out)
+            elif axis == 0:
+                layer = RowParallelLinear(
+                    in_f, out_f, weight_attr=weight_attr,
+                    has_bias=bias_attr is not False,
+                    input_is_parallel=not gather_out)
+            else:
+                raise ValueError("linear split axis must be 0 or 1")
+        elif operation == "embedding":
+            vocab, hidden = size
+            layer = VocabParallelEmbedding(vocab, hidden,
+                                           weight_attr=weight_attr)
+        else:
+            raise ValueError(
+                f"unknown split operation {operation!r}; use "
+                "'linear' or 'embedding'")
+        _SPLIT_CACHE[key] = layer
+    return layer(x)

@@ -1,0 +1,34 @@
+"""paddle.distributed.stream parity (ref: communication/stream/ (U)).
+
+The reference's stream variants run collectives on a caller-chosen CUDA
+stream for manual compute/comm overlap. On TPU, XLA's latency-hiding
+scheduler owns overlap — there are no user streams — so the stream API is
+the plain collective (same signature, `use_calc_stream` accepted and
+ignored), keeping reference scripts working unchanged.
+"""
+
+from .communication import (  # noqa: F401
+    all_reduce, all_gather, reduce_scatter, broadcast, scatter, reduce,
+    alltoall, alltoall_single, send, recv,
+)
+
+
+def _accepting_stream_kw(fn):
+    import functools
+
+    @functools.wraps(fn)
+    def wrapped(*args, use_calc_stream=False, **kw):
+        return fn(*args, **kw)
+
+    return wrapped
+
+
+all_reduce = _accepting_stream_kw(all_reduce)
+all_gather = _accepting_stream_kw(all_gather)
+reduce_scatter = _accepting_stream_kw(reduce_scatter)
+broadcast = _accepting_stream_kw(broadcast)
+scatter = _accepting_stream_kw(scatter)
+reduce = _accepting_stream_kw(reduce)
+alltoall = _accepting_stream_kw(alltoall)
+send = _accepting_stream_kw(send)
+recv = _accepting_stream_kw(recv)

@@ -483,7 +483,23 @@ class TransformedDistribution(Distribution):
         return Tensor(_arr(self.base.log_prob(x)) - log_det)
 
 
+_KL_REGISTRY = {}
+
+
+def register_kl(cls_p, cls_q):
+    """Decorator registering a custom KL rule (ref register_kl)."""
+
+    def decorator(fn):
+        _KL_REGISTRY[(cls_p, cls_q)] = fn
+        return fn
+
+    return decorator
+
+
 def kl_divergence(p, q):
+    for (cp, cq), fn in _KL_REGISTRY.items():
+        if isinstance(p, cp) and isinstance(q, cq):
+            return fn(p, q)
     if isinstance(p, Normal) and isinstance(q, Normal):
         var_ratio = jnp.square(p.scale / q.scale)
         t1 = jnp.square((p.loc - q.loc) / q.scale)
@@ -505,3 +521,118 @@ def kl_divergence(p, q):
         return Tensor(-jnp.log(r) + d / q.scale
                       + r * jnp.exp(-d / p.scale) - 1)
     raise NotImplementedError(f"kl_divergence({type(p).__name__}, {type(q).__name__})")
+
+
+class ExponentialFamily(Distribution):
+    """Base class for exponential-family distributions (ref
+    distribution/exponential_family.py (U)): provides the Bregman-divergence
+    entropy identity for subclasses defining natural parameters."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    def entropy(self):
+        import jax
+
+        nat = self._natural_parameters
+        log_norm = self._log_normalizer(*nat)
+        grads = jax.grad(
+            lambda *n: jnp.sum(self._log_normalizer(*n)), argnums=tuple(
+                range(len(nat))))(*nat)
+        ent = log_norm
+        for n, g in zip(nat, grads):
+            ent = ent - n * g
+        return Tensor(ent)
+
+
+class Binomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = _arr(total_count).astype(jnp.float32)
+        self.probs = _arr(probs).astype(jnp.float32)
+
+    def sample(self, shape=()):
+        n = int(jnp.max(self.total_count))
+        shape_full = tuple(shape) + jnp.broadcast_shapes(
+            self.total_count.shape, self.probs.shape)
+        u = jax.random.uniform(random_state.next_key(),
+                               (n,) + shape_full)
+        draws = (u < self.probs).astype(jnp.float32)
+        mask = jnp.arange(n).reshape((n,) + (1,) * len(shape_full)) \
+            < self.total_count
+        return Tensor(jnp.sum(draws * mask, axis=0))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        n = self.total_count
+        comb = (jax.scipy.special.gammaln(n + 1)
+                - jax.scipy.special.gammaln(v + 1)
+                - jax.scipy.special.gammaln(n - v + 1))
+        return Tensor(comb + v * jnp.log(p) + (n - v) * jnp.log1p(-p))
+
+    @property
+    def mean(self):
+        return Tensor(self.total_count * self.probs)
+
+    @property
+    def variance(self):
+        return Tensor(self.total_count * self.probs * (1 - self.probs))
+
+
+class ContinuousBernoulli(Distribution):
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs = _arr(probs).astype(jnp.float32)
+        self._lims = lims
+
+    def _log_norm_const(self):
+        p = jnp.clip(self.probs, 1e-6, 1 - 1e-6)
+        near_half = (p > self._lims[0]) & (p < self._lims[1])
+        safe = jnp.where(near_half, 0.4, p)
+        c = jnp.log((2 * jnp.arctanh(1 - 2 * safe)) / (1 - 2 * safe))
+        # Taylor expansion around 1/2 (the reference's lims workaround)
+        taylor = jnp.log(2.0) + 4.0 / 3.0 * jnp.square(p - 0.5)
+        return jnp.where(near_half, taylor, c)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        p = jnp.clip(self.probs, 1e-6, 1 - 1e-6)
+        return Tensor(v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+                      + self._log_norm_const())
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.probs.shape
+        u = jax.random.uniform(random_state.next_key(), shape)
+        p = jnp.clip(self.probs, 1e-6, 1 - 1e-6)
+        near_half = jnp.abs(p - 0.5) < 1e-3
+        safe = jnp.where(near_half, 0.4, p)
+        s = (jnp.log1p(u * (2 * safe - 1) / (1 - safe)) /
+             (jnp.log(safe) - jnp.log1p(-safe)))
+        return Tensor(jnp.where(near_half, u, s))
+
+
+class Independent(Distribution):
+    """Reinterprets batch dims of a base distribution as event dims (ref
+    distribution/independent.py (U))."""
+
+    def __init__(self, base, reinterpreted_batch_rank=1):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = _arr(self.base.log_prob(value))
+        axes = tuple(range(-self.rank, 0))
+        return Tensor(jnp.sum(lp, axis=axes))
+
+    def entropy(self):
+        e = _arr(self.base.entropy())
+        return Tensor(jnp.sum(e, axis=tuple(range(-self.rank, 0))))

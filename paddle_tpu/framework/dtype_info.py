@@ -1,0 +1,43 @@
+"""paddle.iinfo / paddle.finfo (ref: pybind dtype-info bindings (U))."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.dtype import to_jax_dtype
+
+
+class iinfo:
+    def __init__(self, dtype):
+        info = np.iinfo(np.dtype(to_jax_dtype(dtype)))
+        self.min = int(info.min)
+        self.max = int(info.max)
+        self.bits = int(info.bits)
+        self.dtype = str(info.dtype)
+
+    def __repr__(self):
+        return f"iinfo(min={self.min}, max={self.max}, bits={self.bits}, dtype={self.dtype})"
+
+
+class finfo:
+    def __init__(self, dtype):
+        import jax.numpy as jnp
+        import ml_dtypes
+
+        jd = to_jax_dtype(dtype)
+        if jd == jnp.bfloat16:
+            info = ml_dtypes.finfo(ml_dtypes.bfloat16)
+        else:
+            info = np.finfo(np.dtype(jd))
+        self.min = float(info.min)
+        self.max = float(info.max)
+        self.eps = float(info.eps)
+        self.tiny = float(getattr(info, "tiny", getattr(info, "smallest_normal", 0.0)))
+        self.smallest_normal = self.tiny
+        self.resolution = float(getattr(info, "resolution", self.eps))
+        self.bits = int(info.bits)
+        self.dtype = str(np.dtype(jd)) if jd != jnp.bfloat16 else "bfloat16"
+
+    def __repr__(self):
+        return (f"finfo(min={self.min}, max={self.max}, eps={self.eps}, "
+                f"bits={self.bits}, dtype={self.dtype})")

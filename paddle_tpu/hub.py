@@ -1,0 +1,61 @@
+"""paddle.hub parity (ref: python/paddle/hapi/hub.py (U): load/list/help over
+github/gitee/local repos exposing an hubconf.py).
+
+Zero-egress build: only `source='local'` works — a directory containing
+`hubconf.py` whose public callables are the hub entry points. Remote sources
+raise with guidance instead of silently hanging on a network that isn't
+there."""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+_HUBCONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir):
+    path = os.path.join(repo_dir, _HUBCONF)
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no {_HUBCONF} in {repo_dir!r}")
+    spec = importlib.util.spec_from_file_location("hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.path.remove(repo_dir)
+    return mod
+
+
+def _require_local(source):
+    if source != "local":
+        raise RuntimeError(
+            f"hub source {source!r} needs network egress, which this build "
+            "does not have; clone the repo and use source='local'")
+
+
+def list(repo_dir, source="local", force_reload=False):  # noqa: A001
+    _require_local(source)
+    mod = _load_hubconf(repo_dir)
+    return [n for n in dir(mod)
+            if callable(getattr(mod, n)) and not n.startswith("_")]
+
+
+def help(repo_dir, model, source="local", force_reload=False):  # noqa: A001
+    _require_local(source)
+    mod = _load_hubconf(repo_dir)
+    fn = getattr(mod, model, None)
+    if fn is None:
+        raise ValueError(f"no entry point {model!r} in {repo_dir}")
+    return fn.__doc__
+
+
+def load(repo_dir, model, source="local", force_reload=False, **kwargs):
+    _require_local(source)
+    mod = _load_hubconf(repo_dir)
+    fn = getattr(mod, model, None)
+    if fn is None:
+        raise ValueError(f"no entry point {model!r} in {repo_dir}")
+    return fn(**kwargs)

@@ -4,3 +4,145 @@ are the Pallas kernels in paddle_tpu.ops plus XLA's automatic fusion."""
 
 from . import nn
 from ..ops.softmax_mask_fuse import softmax_mask_fuse, softmax_mask_fuse_upper_triangle
+
+# graph/segment entry points (the reference exposes these under
+# paddle.incubate; the implementations live in paddle_tpu.geometric)
+from ..geometric import (  # noqa: E402,F401
+    segment_sum, segment_mean, segment_max, segment_min,
+)
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum", out_size=None,
+                    name=None):
+    from ..geometric import send_u_recv
+
+    return send_u_recv(x, src_index, dst_index, reduce_op=pool_type,
+                       out_size=out_size)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """K-hop neighbor sampling over a CSC graph (ref incubate operator).
+    Host-side (geometry is data-dependent, like the reference's CPU/GPU
+    sampler), returns the reindexed subgraph."""
+    import numpy as np
+
+    from ..core.tensor import Tensor
+    from ..tensor.creation import _as_t
+
+    rown = np.asarray(_as_t(row)._data)
+    cp = np.asarray(_as_t(colptr)._data)
+    nodes = np.asarray(_as_t(input_nodes)._data).reshape(-1)
+    rng = np.random.default_rng()  # fresh sample every call, like the ref op
+    layers = [nodes]
+    edges_src, edges_dst = [], []
+    frontier = nodes
+    for k in sample_sizes:
+        nxt = []
+        for v in frontier:
+            neigh = rown[cp[v]:cp[v + 1]]
+            if len(neigh) > k:
+                neigh = rng.choice(neigh, size=k, replace=False)
+            for u in neigh:
+                edges_src.append(u)
+                edges_dst.append(v)
+            nxt.extend(neigh.tolist())
+        frontier = np.unique(np.asarray(nxt, rown.dtype))
+        layers.append(frontier)
+    uniq = np.unique(np.concatenate(layers))
+    remap = {int(u): i for i, u in enumerate(uniq)}
+    src = np.asarray([remap[int(u)] for u in edges_src], np.int32)
+    dst = np.asarray([remap[int(v)] for v in edges_dst], np.int32)
+    return (Tensor(src), Tensor(dst), Tensor(uniq.astype(np.int32)),
+            Tensor(np.arange(len(src), dtype=np.int32)) if return_eids
+            else Tensor(uniq.astype(np.int32)))
+
+
+def identity_loss(x, reduction="none"):
+    """ref incubate.identity_loss: marks x as a loss (optionally reduced)."""
+    from ..tensor.math import mean as _mean, sum as _sum
+
+    if reduction in (0, "sum"):
+        return _sum(x)
+    if reduction in (1, "mean"):
+        return _mean(x)
+    return x
+
+
+class LookAhead:
+    """Lookahead wrapper (ref incubate.LookAhead): k inner steps, then slow
+    weights interpolate toward fast weights."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner = inner_optimizer
+        self.alpha = alpha
+        self.k = int(k)
+        self._slow = None
+        self._steps = 0
+
+    def _params(self):
+        return [p for p in self.inner._parameter_list if p.trainable]
+
+    def step(self):
+        import jax.numpy as jnp
+
+        if self._slow is None:
+            self._slow = [p._data for p in self._params()]
+        self.inner.step()
+        self._steps += 1
+        if self._steps % self.k == 0:
+            for p, slow in zip(self._params(), self._slow):
+                new_slow = slow + self.alpha * (p._data - slow)
+                p._data = new_slow.astype(p._data.dtype)
+            self._slow = [p._data for p in self._params()]
+
+    def clear_grad(self, *a, **k):
+        self.inner.clear_grad(*a, **k)
+
+    clear_gradients = clear_grad
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+class ModelAverage:
+    """EMA of parameters applied at eval (ref incubate.ModelAverage):
+    accumulate during training, apply()/restore() around evaluation."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self._params = [p for p in (parameters or []) if p.trainable]
+        self._acc = [p._data.astype("float32") * 0 for p in self._params]
+        self._n = 0
+        self._backup = None
+
+    def step(self):
+        self._acc = [a + p._data.astype("float32")
+                     for a, p in zip(self._acc, self._params)]
+        self._n += 1
+
+    def apply(self, executor=None, need_restore=True):
+        import contextlib
+
+        self._backup = [p._data for p in self._params]
+        for p, a in zip(self._params, self._acc):
+            p._data = (a / max(self._n, 1)).astype(p._data.dtype)
+        if need_restore:
+            outer = self
+
+            @contextlib.contextmanager
+            def ctx():
+                try:
+                    yield
+                finally:
+                    outer.restore()
+
+            return ctx()
+        return contextlib.nullcontext()
+
+    def restore(self, executor=None):
+        if self._backup is not None:
+            for p, b in zip(self._params, self._backup):
+                p._data = b
+            self._backup = None

@@ -1,0 +1,16 @@
+"""paddle.batch reader compatibility (ref: python/paddle/reader (U) — the
+pre-2.0 generator-based input pipeline that `paddle.batch` wraps)."""
+
+
+def batch(reader, batch_size, drop_last=False):
+    def batched():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batched

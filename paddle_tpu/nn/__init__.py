@@ -1,6 +1,8 @@
 """paddle.nn parity namespace (ref: python/paddle/nn/__init__.py (U))."""
 
 from . import functional
+from . import utils
+from .decode import BeamSearchDecoder, dynamic_decode
 from . import initializer
 from .layer import *  # noqa: F401,F403
 from .layer import Layer

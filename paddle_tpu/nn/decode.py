@@ -1,0 +1,138 @@
+"""Beam-search decoding (ref: python/paddle/nn/decode.py (U):
+BeamSearchDecoder + dynamic_decode).
+
+TPU stance: decode is an eager host loop over jitted cell steps — the
+data-dependent stopping condition lives in Python (the reference's
+dynamic_decode while_op does the same job in-graph); each step's math is
+plain jax ops so XLA compiles/caches the step. Layout batch-major
+[batch, beam, ...], outputs [batch, time, beam] like the reference's
+default output_time_major=False.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import tape as _tape
+from ..tensor.creation import _as_t
+
+
+class BeamSearchDecoder:
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # ---- helpers -----------------------------------------------------
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """[batch, ...] -> [batch*beam, ...] (reference helper)."""
+        a = _as_t(x)._data
+        tiled = jnp.repeat(a[:, None], beam_size, axis=1)
+        return Tensor(tiled.reshape((-1,) + a.shape[1:]))
+
+    def _merge(self, a):  # [batch, beam, ...] -> [batch*beam, ...]
+        return a.reshape((-1,) + a.shape[2:])
+
+    def _split(self, a, batch):  # [batch*beam, ...] -> [batch, beam, ...]
+        return a.reshape((batch, self.beam_size) + a.shape[1:])
+
+    # ---- protocol ----------------------------------------------------
+    def initialize(self, initial_cell_states):
+        states = initial_cell_states
+        leaves = states if isinstance(states, (tuple, list)) else (states,)
+        batch = int(_as_t(leaves[0]).shape[0])
+        tiled = [self.tile_beam_merge_with_batch(s, self.beam_size)._data
+                 for s in leaves]
+        cell_states = (tuple(Tensor(t) for t in tiled)
+                       if isinstance(states, (tuple, list))
+                       else Tensor(tiled[0]))
+        ids = jnp.full((batch, self.beam_size), self.start_token, jnp.int32)
+        log_probs = jnp.where(
+            jnp.arange(self.beam_size)[None, :] == 0, 0.0, -1e9
+        ) * jnp.ones((batch, 1))
+        finished = jnp.zeros((batch, self.beam_size), bool)
+        inputs = self._embed(ids.reshape(-1))
+        return inputs, (cell_states, log_probs, finished), batch
+
+    def _embed(self, flat_ids):
+        if self.embedding_fn is not None:
+            return self.embedding_fn(Tensor(flat_ids))
+        return Tensor(flat_ids)
+
+    def step(self, time, inputs, states, batch):
+        cell_states, log_probs, finished = states
+        out = self.cell(inputs, cell_states)
+        # RNN cells return (output, new_states)
+        cell_out, new_cell_states = out if isinstance(out, tuple) and \
+            len(out) == 2 else (out, cell_states)
+        if self.output_fn is not None:
+            cell_out = self.output_fn(cell_out)
+        logits = _as_t(cell_out)._data  # [batch*beam, vocab]
+        vocab = logits.shape[-1]
+        import jax
+
+        step_lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        step_lp = self._split(step_lp, batch)  # [batch, beam, vocab]
+        # finished beams only extend with end_token at prob 1
+        fin_mask = jnp.full((vocab,), -1e9).at[self.end_token].set(0.0)
+        step_lp = jnp.where(finished[..., None], fin_mask[None, None, :],
+                            step_lp)
+        total = log_probs[..., None] + step_lp  # [batch, beam, vocab]
+        flat = total.reshape(batch, -1)
+        top_lp, top_idx = jax.lax.top_k(flat, self.beam_size)
+        parent = (top_idx // vocab).astype(jnp.int32)   # [batch, beam]
+        token = (top_idx % vocab).astype(jnp.int32)
+        # reorder states by parent beam
+        def reorder(leaf):
+            a = self._split(_as_t(leaf)._data, batch)
+            ga = jnp.take_along_axis(
+                a, parent.reshape(parent.shape + (1,) * (a.ndim - 2)), axis=1)
+            return Tensor(self._merge(ga))
+
+        if isinstance(new_cell_states, (tuple, list)):
+            new_cell_states = tuple(reorder(s) for s in new_cell_states)
+        else:
+            new_cell_states = reorder(new_cell_states)
+        new_finished = jnp.take_along_axis(finished, parent, axis=1) | \
+            (token == self.end_token)
+        next_inputs = self._embed(token.reshape(-1))
+        return (token, parent, top_lp,
+                next_inputs, (new_cell_states, top_lp, new_finished),
+                new_finished)
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=100, output_time_major=False,
+                   impute_finished=False, is_test=False, return_length=False,
+                   **kwargs):
+    """Run the decoder to completion (all beams finished or max steps)."""
+    with _tape.no_grad():
+        inputs, states, batch = decoder.initialize(inits)
+        tokens, parents = [], []
+        seq_len = jnp.zeros((batch, decoder.beam_size), jnp.int32)
+        finished = states[2]
+        for t in range(int(max_step_num)):
+            token, parent, lp, inputs, states, finished = decoder.step(
+                t, inputs, states, batch)
+            tokens.append(token)
+            parents.append(parent)
+            seq_len = seq_len + (~finished).astype(jnp.int32)
+            if bool(finished.all()):
+                break
+        ids = jnp.stack(tokens)      # [time, batch, beam]
+        par = jnp.stack(parents)
+        from .functional.common import gather_tree
+
+        full = gather_tree(Tensor(ids), Tensor(par))._data
+        if not output_time_major:
+            full = jnp.transpose(full, (1, 0, 2))  # [batch, time, beam]
+        out = Tensor(full)
+        if return_length:
+            return out, states[0], Tensor(seq_len)
+        return out, states[0]

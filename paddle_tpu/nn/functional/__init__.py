@@ -10,7 +10,7 @@ from .common import (
     linear, dropout, dropout2d, dropout3d, alpha_dropout, pad, zeropad2d,
     embedding, one_hot, cosine_similarity, pixel_shuffle, pixel_unshuffle,
     channel_shuffle, interpolate, upsample, unfold, fold, label_smooth, bilinear,
-    sequence_mask,
+    sequence_mask, pairwise_distance, gather_tree, sparse_attention,
 )
 from .vision import grid_sample, affine_grid, temporal_shift
 from .conv import (
@@ -24,16 +24,26 @@ from .pooling import (
     max_pool1d, max_pool2d, max_pool3d, avg_pool1d, avg_pool2d, avg_pool3d,
     adaptive_avg_pool1d, adaptive_avg_pool2d, adaptive_avg_pool3d,
     adaptive_max_pool1d, adaptive_max_pool2d, adaptive_max_pool3d,
+    max_unpool1d, max_unpool2d, max_unpool3d,
 )
 from .loss import (
     cross_entropy, softmax_with_cross_entropy, nll_loss, mse_loss, l1_loss,
     smooth_l1_loss, binary_cross_entropy, binary_cross_entropy_with_logits,
     kl_div, margin_ranking_loss, cosine_embedding_loss, hinge_embedding_loss,
     triplet_margin_loss, square_error_cost, sigmoid_focal_loss, log_loss,
-    ctc_loss, margin_cross_entropy,
+    ctc_loss, margin_cross_entropy, gaussian_nll_loss, poisson_nll_loss,
+    soft_margin_loss, multi_label_soft_margin_loss, multi_margin_loss,
+    triplet_margin_with_distance_loss, dice_loss, npair_loss, hsigmoid_loss,
 )
 from .attention import (
     scaled_dot_product_attention, flash_attention, flash_attn_unpadded, sdp_kernel,
 )
 
 from . import flash_attention as flash_attention_module  # noqa: F401
+
+
+def elu_(x, alpha=1.0, name=None):
+    """In-place elu (reference elu_): mutates the Tensor's buffer."""
+    out = elu(x, alpha)
+    x._data = out._data
+    return x

@@ -338,3 +338,69 @@ def sequence_mask(x, maxlen=None, dtype="int64", name=None):
         return (idx < lens[..., None]).astype(to_jax_dtype(dtype))
 
     return _apply(f, xt, _op_name="sequence_mask")
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    def f(a, b):
+        d = a - b + epsilon
+        return jnp.sum(jnp.abs(d) ** p, axis=-1, keepdims=keepdim) ** (1.0 / p)
+
+    return apply(f, _as_t(x), _as_t(y), _op_name="pairwise_distance")
+
+
+def gather_tree(ids, parents):
+    """Beam-search backtrace (ref gather_tree op): ids/parents
+    [max_time, batch, beam] -> full beams gathered from the last step."""
+    def f(idv, par):
+        T = idv.shape[0]
+
+        def step(beams, t):
+            # beams: [batch, beam] beam index selected at time t+1; the
+            # contributing beam at time t is parents[t+1][beams]
+            prev = jnp.take_along_axis(par[t + 1], beams, axis=-1)
+            out = jnp.take_along_axis(idv[t], prev, axis=-1)
+            return prev, out
+
+        import jax as _jax
+
+        init = jnp.broadcast_to(
+            jnp.arange(idv.shape[2]), idv.shape[1:]).astype(par.dtype)
+        last = idv[T - 1]
+        _, rev = _jax.lax.scan(step, init, jnp.arange(T - 2, -1, -1))
+        return jnp.concatenate([jnp.flip(rev, 0), last[None]], 0)
+
+    return apply(f, _as_t(ids).detach(), _as_t(parents).detach(),
+                 _op_name="gather_tree")
+
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    """Block-sparse attention at a CSR-described pattern. TPU stance: the
+    pattern becomes a dense bool mask consumed by the flash/SDPA path — XLA
+    has no CSR attention kernel, and for the pattern sizes the reference op
+    targets (per-row allowed keys) the masked dense path on the MXU is the
+    faster program. Inputs [batch, heads, seq, head_dim] (reference layout)."""
+    from .attention import _sdpa_ref
+
+    q, k, v = _as_t(query), _as_t(key), _as_t(value)
+    offs = _as_t(sparse_csr_offset).numpy()
+    cols = _as_t(sparse_csr_columns).numpy()
+    b, h, s, d = q.shape
+    import numpy as np
+
+    mask = np.zeros((b, h, s, s), bool)
+    for bi in range(b):
+        for hi in range(h):
+            o = offs[bi, hi]
+            c = cols[bi, hi]
+            for r in range(s):
+                mask[bi, hi, r, c[o[r]:o[r + 1]]] = True
+
+    def f(qa, ka, va):
+        qt = jnp.swapaxes(qa, 1, 2)  # -> [b, s, h, d] sdpa layout
+        kt = jnp.swapaxes(ka, 1, 2)
+        vt = jnp.swapaxes(va, 1, 2)
+        out = _sdpa_ref(qt, kt, vt, mask=jnp.asarray(mask))
+        return jnp.swapaxes(out, 1, 2)
+
+    return apply(f, q, k, v, _op_name="sparse_attention")

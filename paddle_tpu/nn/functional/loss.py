@@ -319,3 +319,179 @@ def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
         return loss
 
     return _apply(f, lt, yt, _op_name="margin_cross_entropy")
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    def f(mu, y, var):
+        v = jnp.maximum(var, epsilon)
+        loss = 0.5 * (jnp.log(v) + jnp.square(y - mu) / v)
+        if full:
+            loss = loss + 0.5 * jnp.log(jnp.asarray(2 * jnp.pi, loss.dtype))
+        return _reduce(loss, reduction)
+
+    return apply(f, _as_t(input), _as_t(label), _as_t(variance),
+                 _op_name="gaussian_nll_loss")
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    def f(x, y):
+        if log_input:
+            loss = jnp.exp(x) - y * x
+        else:
+            loss = x - y * jnp.log(x + epsilon)
+        if full:
+            # Stirling approximation term for y > 1
+            stir = y * jnp.log(y) - y + 0.5 * jnp.log(2 * jnp.pi * y)
+            loss = loss + jnp.where(y > 1, stir, 0.0)
+        return _reduce(loss, reduction)
+
+    return apply(f, _as_t(input), _as_t(label), _op_name="poisson_nll_loss")
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    # softplus(-y*x), computed stably (log1p(exp(z)) overflows for z > ~88)
+    return apply(
+        lambda x, y: _reduce(jax.nn.softplus(-y * x), reduction),
+        _as_t(input), _as_t(label), _op_name="soft_margin_loss")
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean",
+                                 name=None):
+    args = [_as_t(input), _as_t(label)]
+    if weight is not None:
+        args.append(_as_t(weight).detach())
+
+    def f(x, y, *w):
+        term = y * jax.nn.log_sigmoid(x) + (1 - y) * jax.nn.log_sigmoid(-x)
+        if w:
+            term = term * w[0]
+        loss = -jnp.mean(term, axis=-1)
+        return _reduce(loss, reduction)
+
+    return apply(f, *args, _op_name="multi_label_soft_margin_loss")
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    args = [_as_t(input), _as_t(label).detach()]
+    if weight is not None:
+        args.append(_as_t(weight).detach())
+
+    def f(x, y, *w):
+        n, c = x.shape
+        y = y.astype(jnp.int32).reshape(-1)
+        true_score = jnp.take_along_axis(x, y[:, None], axis=1)
+        m = jnp.maximum(0.0, margin - true_score + x) ** p
+        if w:
+            m = m * jnp.take(w[0], y)[:, None]
+        m = m.at[jnp.arange(n), y].set(0.0)
+        return _reduce(jnp.sum(m, axis=1) / c, reduction)
+
+    return apply(f, *args, _op_name="multi_margin_loss")
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean", name=None):
+    if distance_function is None:
+        return triplet_margin_loss(input, positive, negative, margin=margin,
+                                   swap=swap, reduction=reduction)
+    dp = distance_function(input, positive)
+    dn = distance_function(input, negative)
+    if swap:
+        dsn = distance_function(positive, negative)
+        from ...tensor.math import minimum as _min
+
+        dn = _min(dn, dsn)
+
+    def f(a, b):
+        return _reduce(jnp.maximum(a - b + margin, 0.0), reduction)
+
+    return apply(f, _as_t(dp), _as_t(dn),
+                 _op_name="triplet_margin_with_distance_loss")
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """ref dice_loss: input [N, ..., C] probabilities, label [N, ..., 1]."""
+    def f(x, y):
+        c = x.shape[-1]
+        y1 = jax.nn.one_hot(y.astype(jnp.int32).squeeze(-1), c, dtype=x.dtype)
+        xf = x.reshape(x.shape[0], -1)
+        yf = y1.reshape(y1.shape[0], -1)
+        inter = jnp.sum(xf * yf, axis=1)
+        union = jnp.sum(xf, axis=1) + jnp.sum(yf, axis=1)
+        return jnp.mean(1.0 - (2 * inter + epsilon) / (union + epsilon))
+
+    return apply(f, _as_t(input), _as_t(label).detach(), _op_name="dice_loss")
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """ref npair_loss (improved deep metric learning)."""
+    def f(a, p, y):
+        y = y.reshape(-1)
+        sim = a @ p.T  # [n, n]
+        same = (y[:, None] == y[None, :]).astype(a.dtype)
+        same = same / jnp.maximum(jnp.sum(same, axis=1, keepdims=True), 1.0)
+        ce = jnp.mean(
+            jax.scipy.special.logsumexp(sim, axis=1) -
+            jnp.sum(sim * same, axis=1))
+        reg = l2_reg * (jnp.mean(jnp.sum(jnp.square(a), 1)) +
+                        jnp.mean(jnp.sum(jnp.square(p), 1))) * 0.25
+        return ce + reg
+
+    return apply(f, _as_t(anchor), _as_t(positive), _as_t(labels).detach(),
+                 _op_name="npair_loss")
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None, path_table=None,
+                  path_code=None, is_sparse=False, name=None):
+    """Hierarchical sigmoid with the default complete-binary-tree coding the
+    reference uses when no custom path table is given."""
+    if path_table is not None or path_code is not None:
+        raise NotImplementedError(
+            "custom path_table/path_code hsigmoid is not supported; use the "
+            "default complete-binary-tree coding")
+    import numpy as np
+
+    n_inner = int(num_classes) - 1  # inner nodes of the complete tree
+    depth = max(int(np.ceil(np.log2(max(num_classes, 2)))), 1)
+
+    # static per-class paths through the tree (host-side, like the
+    # reference's prebuilt coding table)
+    codes = np.zeros((num_classes, depth), np.int32)   # inner-node index
+    signs = np.zeros((num_classes, depth), np.float32)  # +1 left / -1 right
+    mask = np.zeros((num_classes, depth), np.float32)
+    for cls in range(num_classes):
+        node = cls + n_inner  # leaf id in heap order
+        lvl = 0
+        path = []
+        while node > 0 and lvl < depth:
+            parent = (node - 1) // 2
+            left = node == 2 * parent + 1
+            path.append((parent, 1.0 if left else -1.0))
+            node = parent
+            lvl += 1
+        for i, (pn, sgn) in enumerate(reversed(path)):
+            codes[cls, i] = pn
+            signs[cls, i] = sgn
+            mask[cls, i] = 1.0
+
+    args = [_as_t(input), _as_t(label).detach(), _as_t(weight)]
+    if bias is not None:
+        args.append(_as_t(bias))
+
+    def f(x, y, w, *b):
+        y = y.astype(jnp.int32).reshape(-1)
+        pc = jnp.asarray(codes)[y]     # [n, depth]
+        sg = jnp.asarray(signs)[y]
+        mk = jnp.asarray(mask)[y]
+        wn = w[pc]                     # [n, depth, d]
+        logits = jnp.einsum("nd,nkd->nk", x, wn)
+        if b:
+            logits = logits + b[0][pc]
+        loss = -jax.nn.log_sigmoid(sg * logits) * mk
+        return jnp.mean(jnp.sum(loss, axis=1))
+
+    return apply(f, *args, _op_name="hsigmoid_loss")

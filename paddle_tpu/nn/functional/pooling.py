@@ -47,14 +47,23 @@ def _pool(x, kernel, stride, padding, n, data_format, reducer, init, ceil_mode=F
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCL", name=None):
     df = "NWC" if data_format == "NLC" else "NCW"
+    if return_mask:
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 1,
+                                   df == "NWC", ceil_mode)
     return _pool(x, kernel_size, stride, padding, 1, df, lax.max, -jnp.inf, ceil_mode)
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCHW", name=None):
+    if return_mask:
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 2,
+                                   data_format == "NHWC", ceil_mode)
     return _pool(x, kernel_size, stride, padding, 2, data_format, lax.max, -jnp.inf, ceil_mode)
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCDHW", name=None):
+    if return_mask:
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 3,
+                                   data_format == "NDHWC", ceil_mode)
     return _pool(x, kernel_size, stride, padding, 3, data_format, lax.max, -jnp.inf, ceil_mode)
 
 
@@ -123,3 +132,122 @@ def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
 
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
     return _adaptive_pool(x, output_size, 3, "NCDHW", "max")
+
+
+def _max_pool_with_mask(x, kernel, stride, padding, n, channel_last,
+                        ceil_mode=False):
+    """Pooled output + flat argmax indices per (N, C) plane (the reference's
+    return_mask=True contract, consumed by max_unpool*d)."""
+    kernel = _norm_tuple(kernel, n)
+    stride = _norm_tuple(stride if stride is not None else kernel, n)
+    pad = _norm_padding(padding, n)
+    if isinstance(pad, str):
+        raise NotImplementedError("return_mask with string padding")
+    pad_lo = tuple(p[0] for p in pad)
+
+    def f(a):
+        if channel_last:
+            a = jnp.moveaxis(a, -1, 1)  # to NC...
+        spatial = a.shape[2:]
+        def _osz(i):
+            num = spatial[i] + pad[i][0] + pad[i][1] - kernel[i]
+            if ceil_mode:
+                return -(-num // stride[i]) + 1
+            return num // stride[i] + 1
+        out_sp = tuple(_osz(i) for i in range(n))
+        # coords[d]: [out_d, k_d] input coordinate along dim d
+        grids = []
+        for d in range(n):
+            o = jnp.arange(out_sp[d])[:, None] * stride[d] - pad_lo[d]
+            w = jnp.arange(kernel[d])[None, :]
+            grids.append(o + w)
+        # build gather coords with broadcasting: result [out..., k...]
+        coords = []
+        for d in range(n):
+            sh = [1] * (2 * n)
+            sh[d] = out_sp[d]
+            sh[n + d] = kernel[d]
+            coords.append(grids[d].reshape(sh))
+        valid = None
+        flat_idx = None
+        for d in range(n):
+            c = coords[d]
+            v = (c >= 0) & (c < spatial[d])
+            valid = v if valid is None else (valid & v)
+            cc = jnp.clip(c, 0, spatial[d] - 1)
+            flat_idx = cc if flat_idx is None else flat_idx * spatial[d] + cc
+        flat_idx = jnp.broadcast_to(
+            flat_idx, tuple(out_sp) + tuple(kernel)).reshape(-1)
+        valid = jnp.broadcast_to(
+            valid, tuple(out_sp) + tuple(kernel)).reshape(-1)
+        a_flat = a.reshape(a.shape[0], a.shape[1], -1)      # [N, C, prod(sp)]
+        gathered = a_flat[:, :, flat_idx]                   # [N, C, L*K]
+        gathered = jnp.where(valid[None, None, :], gathered, -jnp.inf)
+        L = int(np.prod(out_sp))
+        K = int(np.prod(kernel))
+        windows = gathered.reshape(a.shape[0], a.shape[1], L, K)
+        arg = jnp.argmax(windows, axis=-1)                  # [N, C, L]
+        out = jnp.take_along_axis(windows, arg[..., None], -1)[..., 0]
+        src = flat_idx.reshape(L, K)
+        mask = jnp.take_along_axis(
+            jnp.broadcast_to(src, (a.shape[0], a.shape[1], L, K)),
+            arg[..., None], -1)[..., 0]
+        out = out.reshape(a.shape[:2] + out_sp)
+        mask = mask.reshape(a.shape[:2] + out_sp).astype(jnp.int32)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+            mask = jnp.moveaxis(mask, 1, -1)
+        return out, mask
+
+    return apply(f, _as_t(x), _op_name=f"max_pool{n}d_mask")
+
+
+def _max_unpool(x, indices, kernel, stride, padding, n, output_size,
+                channel_last):
+    kernel = _norm_tuple(kernel, n)
+    stride = _norm_tuple(stride if stride is not None else kernel, n)
+    pad = _norm_padding(padding, n)
+    pad_lo = tuple(p[0] for p in pad) if not isinstance(pad, str) else (0,) * n
+
+    def f(a, idx):
+        if channel_last:
+            a = jnp.moveaxis(a, -1, 1)
+            idx = jnp.moveaxis(idx, -1, 1)
+        in_sp = a.shape[2:]
+        if output_size is not None:
+            out_sp = tuple(int(s) for s in output_size[-n:])
+        else:
+            out_sp = tuple((in_sp[i] - 1) * stride[i] - 2 * pad_lo[i]
+                           + kernel[i] for i in range(n))
+        N, C = a.shape[:2]
+        L = int(np.prod(in_sp))
+        M = int(np.prod(out_sp))
+        flat = jnp.zeros((N * C, M), a.dtype)
+        vals = a.reshape(N * C, L)
+        ids = idx.reshape(N * C, L).astype(jnp.int32)
+        flat = flat.at[jnp.arange(N * C)[:, None], ids].set(vals)
+        out = flat.reshape((N, C) + out_sp)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    return apply(f, _as_t(x), _as_t(indices).detach(),
+                 _op_name=f"max_unpool{n}d")
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, 1,
+                       output_size, data_format in ("NLC",))
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, 2,
+                       output_size, data_format in ("NHWC",))
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, 3,
+                       output_size, data_format in ("NDHWC",))

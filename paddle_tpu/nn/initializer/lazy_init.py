@@ -1,0 +1,15 @@
+"""LazyGuard (ref: python/paddle/nn/initializer/lazy_init.py (U)).
+
+The reference defers parameter materialization until `.initialize()` so huge
+models can be constructed cheaply on one process. On the TPU build parameter
+arrays are committed buffers only when first used by a compiled program (jax
+arrays are lazy until consumed), and sharded construction goes through
+fleet/auto-parallel shardings — so LazyGuard is a compatibility no-op that
+keeps reference construction scripts running unchanged."""
+
+import contextlib
+
+
+class LazyGuard(contextlib.AbstractContextManager):
+    def __exit__(self, *exc):
+        return False

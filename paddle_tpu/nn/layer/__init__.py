@@ -3,7 +3,7 @@ from .common import (
     Identity, Linear, Dropout, Dropout2D, Dropout3D, AlphaDropout, Embedding,
     Flatten, Upsample, UpsamplingNearest2D, UpsamplingBilinear2D, PixelShuffle,
     PixelUnshuffle, ChannelShuffle, Pad1D, Pad2D, Pad3D, ZeroPad2D,
-    CosineSimilarity, Bilinear, Unfold, Fold,
+    CosineSimilarity, Bilinear, Unfold, Fold, Unflatten, PairwiseDistance,
 )
 from .conv import (
     Conv1D, Conv2D, Conv3D, Conv1DTranspose, Conv2DTranspose, Conv3DTranspose,
@@ -17,17 +17,20 @@ from .activation import (
     ReLU, ReLU6, GELU, SiLU, Swish, Sigmoid, Tanh, LeakyReLU, PReLU, RReLU,
     ELU, SELU, CELU, Mish, Hardshrink, Hardsigmoid, Hardswish, Hardtanh,
     Softplus, Softshrink, Softsign, Tanhshrink, ThresholdedReLU, LogSigmoid,
-    Softmax, LogSoftmax, Maxout, GLU,
+    Softmax, LogSoftmax, Maxout, GLU, Softmax2D,
 )
 from .pooling import (
     MaxPool1D, MaxPool2D, MaxPool3D, AvgPool1D, AvgPool2D, AvgPool3D,
     AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D,
     AdaptiveMaxPool1D, AdaptiveMaxPool2D, AdaptiveMaxPool3D,
+    MaxUnPool1D, MaxUnPool2D, MaxUnPool3D,
 )
 from .loss import (
     CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss, BCEWithLogitsLoss,
     SmoothL1Loss, KLDivLoss, MarginRankingLoss, CosineEmbeddingLoss,
-    HingeEmbeddingLoss, TripletMarginLoss, CTCLoss,
+    HingeEmbeddingLoss, TripletMarginLoss, CTCLoss, GaussianNLLLoss,
+    PoissonNLLLoss, SoftMarginLoss, MultiLabelSoftMarginLoss, MultiMarginLoss,
+    TripletMarginWithDistanceLoss, HSigmoidLoss,
 )
 from .container import Sequential, LayerList, LayerDict, ParameterList
 from .transformer import (

@@ -219,3 +219,15 @@ class GLU(Layer):
 
     def forward(self, x):
         return F.glu(x, self._axis)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel axis of NCHW inputs (reference Softmax2D)."""
+
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        if len(x.shape) != 4:
+            raise ValueError("Softmax2D expects a 4-D NCHW tensor")
+        return F.softmax(x, axis=1)

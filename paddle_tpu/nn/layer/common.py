@@ -241,3 +241,24 @@ class Fold(Layer):
 
     def forward(self, x):
         return F.fold(x, self.output_sizes, *self.args)
+
+
+class Unflatten(Layer):
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self._axis = axis
+        self._shape = shape
+
+    def forward(self, x):
+        from ...tensor.manipulation import unflatten
+
+        return unflatten(x, self._axis, self._shape)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self._kw = dict(p=p, epsilon=epsilon, keepdim=keepdim)
+
+    def forward(self, x, y):
+        return F.pairwise_distance(x, y, **self._kw)

@@ -21,19 +21,22 @@ class _Pool(Layer):
 
 class MaxPool1D(_Pool):
     def __init__(self, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, name=None):
-        super().__init__(F.max_pool1d, kernel_size, stride, padding)
+        super().__init__(F.max_pool1d, kernel_size, stride, padding,
+                         return_mask=return_mask)
 
 
 class MaxPool2D(_Pool):
     def __init__(self, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
                  data_format="NCHW", name=None):
-        super().__init__(F.max_pool2d, kernel_size, stride, padding, data_format=data_format)
+        super().__init__(F.max_pool2d, kernel_size, stride, padding,
+                         data_format=data_format, return_mask=return_mask)
 
 
 class MaxPool3D(_Pool):
     def __init__(self, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
                  data_format="NCDHW", name=None):
-        super().__init__(F.max_pool3d, kernel_size, stride, padding)
+        super().__init__(F.max_pool3d, kernel_size, stride, padding,
+                         return_mask=return_mask)
 
 
 class AvgPool1D(_Pool):
@@ -106,3 +109,35 @@ class AdaptiveMaxPool3D(Layer):
 
     def forward(self, x):
         return F.adaptive_max_pool3d(x, self._output_size)
+
+
+class _MaxUnPool(Layer):
+    def __init__(self, fn, kernel_size, stride=None, padding=0, **kw):
+        super().__init__()
+        self._fn = fn
+        self._args = (kernel_size, stride, padding)
+        self._kw = kw
+
+    def forward(self, x, indices):
+        return self._fn(x, indices, *self._args, **self._kw)
+
+
+class MaxUnPool1D(_MaxUnPool):
+    def __init__(self, kernel_size, stride=None, padding=0, data_format="NCL",
+                 output_size=None, name=None):
+        super().__init__(F.max_unpool1d, kernel_size, stride, padding,
+                         data_format=data_format, output_size=output_size)
+
+
+class MaxUnPool2D(_MaxUnPool):
+    def __init__(self, kernel_size, stride=None, padding=0, data_format="NCHW",
+                 output_size=None, name=None):
+        super().__init__(F.max_unpool2d, kernel_size, stride, padding,
+                         data_format=data_format, output_size=output_size)
+
+
+class MaxUnPool3D(_MaxUnPool):
+    def __init__(self, kernel_size, stride=None, padding=0, data_format="NCDHW",
+                 output_size=None, name=None):
+        super().__init__(F.max_unpool3d, kernel_size, stride, padding,
+                         data_format=data_format, output_size=output_size)

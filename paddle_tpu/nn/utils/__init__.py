@@ -1,0 +1,175 @@
+"""paddle.nn.utils parity (ref: python/paddle/nn/utils/ (U): weight_norm,
+spectral_norm hooks, parameters_to_vector, clip_grad_*)."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, Parameter
+from ...core import tape as _tape
+from ...tensor.creation import _as_t
+
+
+def _norm_except(v, dim):
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(jnp.square(v), axis=axes, keepdims=True))
+
+
+class _WeightNormHook:
+    def __init__(self, layer, name, dim):
+        self.name = name
+        self.dim = dim
+        w = getattr(layer, name)
+        dim = dim if dim is not None else 0
+        self.dim = dim
+        g = Parameter(np.asarray(_norm_except(w._data, dim)))
+        v = Parameter(np.asarray(w._data))
+        layer.add_parameter(name + "_g", g)
+        layer.add_parameter(name + "_v", v)
+        # the original weight becomes derived state, not a parameter
+        if name in layer._parameters:
+            del layer._parameters[name]
+
+    def __call__(self, layer, inputs):
+        g = getattr(layer, self.name + "_g")
+        v = getattr(layer, self.name + "_v")
+        from ...core.op_call import apply
+
+        w = apply(
+            lambda gv, vv: gv * vv / jnp.maximum(
+                _norm_except(vv, self.dim), 1e-12),
+            g, v, _op_name="weight_norm")
+        object.__setattr__(layer, self.name, w)
+        return None
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparametrize layer.<name> = g * v / ||v|| (per-slice along `dim`).
+    g and v become the trainable parameters; the weight is recomputed on
+    every forward (inside jit this folds into the step program)."""
+    hook = _WeightNormHook(layer, name, dim)
+    handle = layer.register_forward_pre_hook(hook)
+    layer._weight_norm_handle = handle
+    layer._weight_norm_hook = hook
+    hook(layer, ())  # materialize immediately (ref does too)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    hook = getattr(layer, "_weight_norm_hook", None)
+    if hook is None:
+        raise ValueError("layer has no weight_norm applied")
+    g = getattr(layer, name + "_g")
+    v = getattr(layer, name + "_v")
+    w = Parameter(np.asarray(
+        (g._data * v._data / np.maximum(
+            np.asarray(_norm_except(v._data, hook.dim)), 1e-12))))
+    layer._weight_norm_handle.remove()
+    del layer._parameters[name + "_g"]
+    del layer._parameters[name + "_v"]
+    layer.add_parameter(name, w)
+    del layer._weight_norm_handle
+    del layer._weight_norm_hook
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Spectral normalization via power iteration on each forward (state u/v
+    kept as layer buffers, matching the reference's running estimates)."""
+    w = getattr(layer, name)
+    if dim is None:
+        dim = 0
+    shape = w.shape
+    h = int(shape[dim])
+
+    rng = np.random.RandomState(0)
+    u0 = rng.randn(h).astype(np.float32)
+    layer._sn_u = u0 / max(np.linalg.norm(u0), eps)
+    layer._sn_dim = dim
+    layer._sn_name = name
+    v_param = Parameter(np.asarray(w._data))
+    layer.add_parameter(name + "_orig", v_param)
+    if name in layer._parameters:
+        del layer._parameters[name]
+
+    def hook(lyr, inputs):
+        from ...core.op_call import apply
+
+        worig = getattr(lyr, name + "_orig")
+
+        def f(wv):
+            wm = jnp.moveaxis(wv, dim, 0).reshape(h, -1)
+            u = jnp.asarray(lyr._sn_u)
+            for _ in range(n_power_iterations):
+                v = wm.T @ u
+                v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+                u = wm @ v
+                u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+            sigma = u @ (wm @ v)
+            return wv / sigma
+
+        wn = apply(f, worig, _op_name="spectral_norm")
+        object.__setattr__(lyr, name, wn)
+        return None
+
+    handle = layer.register_forward_pre_hook(hook)
+    layer._spectral_norm_handle = handle
+    hook(layer, ())
+    return layer
+
+
+def parameters_to_vector(parameters, name=None):
+    from ...tensor.manipulation import concat, reshape
+
+    ps = list(parameters)
+    return concat([reshape(p, [-1]) for p in ps], axis=0)
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    vec = _as_t(vec)
+    offset = 0
+    for p in parameters:
+        n = int(np.prod(p.shape)) if p.shape else 1
+        chunk = vec._data[offset:offset + n].reshape(p.shape)
+        p._data = chunk.astype(p._data.dtype)
+        offset += n
+    return list(parameters)
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p.grad for p in parameters if p.grad is not None]
+    if not grads:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack(
+            [jnp.max(jnp.abs(g._data)) for g in grads]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(g._data) ** norm_type) for g in grads])) \
+            ** (1.0 / norm_type)
+    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+        raise RuntimeError("non-finite gradient norm in clip_grad_norm_")
+    scale = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for g in grads:
+        g._data = g._data * scale.astype(g._data.dtype)
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._data = jnp.clip(p.grad._data, -clip_value, clip_value)
+
+
+__all__ = [
+    "weight_norm", "remove_weight_norm", "spectral_norm",
+    "parameters_to_vector", "vector_to_parameters",
+    "clip_grad_norm_", "clip_grad_value_",
+]

@@ -1,0 +1,135 @@
+"""LBFGS (ref: python/paddle/optimizer/lbfgs.py (U)).
+
+Closure-driven quasi-Newton: two-loop recursion over an (s, y) history kept
+host-side, the vector math in jax. The reference's step(closure) contract is
+preserved — closure re-evaluates loss and grads; line_search_fn='strong_wolfe'
+uses a backtracking search satisfying Armijo + curvature."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import tape as _tape
+from .optimizer import Optimizer
+
+
+class LBFGS(Optimizer):
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9, history_size=100,
+                 line_search_fn=None, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._max_iter = max_iter
+        self._max_eval = max_eval or max_iter * 5 // 4
+        self._tol_grad = tolerance_grad
+        self._tol_change = tolerance_change
+        self._history = history_size
+        self._line_search = line_search_fn
+        self._s, self._y = [], []
+        self._prev_flat_grad = None
+
+    # ---- flat vector helpers ----------------------------------------
+    def _params(self):
+        return [p for p in self._parameter_list if p.trainable]
+
+    def _flat(self, arrays):
+        return jnp.concatenate([a.reshape(-1).astype(jnp.float32)
+                                for a in arrays])
+
+    def _flat_params(self):
+        return self._flat([p._data for p in self._params()])
+
+    def _flat_grads(self):
+        return self._flat([p.grad._data if p.grad is not None
+                           else jnp.zeros_like(p._data)
+                           for p in self._params()])
+
+    def _assign(self, flat):
+        off = 0
+        for p in self._params():
+            n = int(np.prod(p.shape)) if p.shape else 1
+            p._data = flat[off:off + n].reshape(p.shape).astype(p._data.dtype)
+            off += n
+
+    def _direction(self, g):
+        """Two-loop recursion over the stored (s, y) pairs."""
+        q = -g
+        alphas = []
+        for s, y in reversed(list(zip(self._s, self._y))):
+            rho = 1.0 / jnp.maximum(jnp.dot(y, s), 1e-10)
+            a = rho * jnp.dot(s, q)
+            alphas.append((a, rho, s, y))
+            q = q - a * y
+        if self._s:
+            s, y = self._s[-1], self._y[-1]
+            q = q * (jnp.dot(s, y) / jnp.maximum(jnp.dot(y, y), 1e-10))
+        for a, rho, s, y in reversed(alphas):
+            b = rho * jnp.dot(y, q)
+            q = q + s * (a - b)
+        return q
+
+    def _eval(self, closure):
+        for p in self._params():
+            p.clear_grad()
+        loss = closure()
+        return float(loss), self._flat_grads()
+
+    def step(self, closure=None):
+        if closure is None:
+            raise ValueError("LBFGS.step requires a closure that recomputes "
+                             "the loss and calls backward()")
+        lr = self.get_lr()
+        loss, g = self._eval(closure)
+        evals = 1
+        for _ in range(self._max_iter):
+            if float(jnp.max(jnp.abs(g))) <= self._tol_grad:
+                break
+            d = self._direction(g)
+            x0 = self._flat_params()
+            gtd = float(jnp.dot(g, d))
+            if gtd > -1e-15:  # not a descent direction: reset history
+                self._s, self._y = [], []
+                d = -g
+                gtd = float(jnp.dot(g, d))
+            t = lr
+            if self._line_search == "strong_wolfe":
+                c1, c2 = 1e-4, 0.9
+                ok = False
+                for _ls in range(20):
+                    self._assign(x0 + t * d)
+                    new_loss, new_g = self._eval(closure)
+                    evals += 1
+                    if new_loss <= loss + c1 * t * gtd and \
+                            abs(float(jnp.dot(new_g, d))) <= -c2 * gtd:
+                        ok = True
+                        break
+                    t *= 0.5
+                    if evals >= self._max_eval:
+                        break
+                if not ok:
+                    self._assign(x0 + t * d)
+                    new_loss, new_g = self._eval(closure)
+                    evals += 1
+            else:
+                self._assign(x0 + t * d)
+                new_loss, new_g = self._eval(closure)
+                evals += 1
+            s = t * d
+            yv = new_g - g
+            if float(jnp.dot(s, yv)) > 1e-10:
+                self._s.append(s)
+                self._y.append(yv)
+                if len(self._s) > self._history:
+                    self._s.pop(0)
+                    self._y.pop(0)
+            if abs(new_loss - loss) < self._tol_change:
+                loss, g = new_loss, new_g
+                break
+            loss, g = new_loss, new_g
+            if evals >= self._max_eval:
+                break
+        self._step_count += 1
+        return Tensor(jnp.asarray(loss))

@@ -224,3 +224,134 @@ class Lamb(Optimizer):
         p32 = p32 - lr * trust * update
         return p32.astype(param.dtype), dict(state, moment1=m1, moment2=m2,
                                              beta1_pow=b1p, beta2_pow=b2p)
+
+
+class Rprop(Optimizer):
+    """Resilient backprop (ref: python/paddle/optimizer/rprop.py (U)):
+    per-element step sizes grown/shrunk by gradient sign agreement."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._lr_min, self._lr_max = learning_rate_range
+        self._eta_neg, self._eta_pos = etas
+
+    def _init_state(self, p):
+        return {
+            "prev_grad": jnp.zeros_like(p._data, dtype=jnp.float32),
+            "step_size": jnp.full_like(p._data, float(self.get_lr()),
+                                       dtype=jnp.float32),
+        }
+
+    def _update(self, param, grad, state, lr):
+        g32 = grad.astype(jnp.float32)
+        sign = jnp.sign(g32 * state["prev_grad"])
+        step = jnp.where(sign > 0, state["step_size"] * self._eta_pos,
+                         jnp.where(sign < 0,
+                                   state["step_size"] * self._eta_neg,
+                                   state["step_size"]))
+        step = jnp.clip(step, self._lr_min, self._lr_max)
+        # on sign flip, skip the update and zero the remembered grad
+        g_eff = jnp.where(sign < 0, 0.0, g32)
+        p32 = param.astype(jnp.float32) - jnp.sign(g_eff) * step
+        return p32.astype(param.dtype), {"prev_grad": g_eff,
+                                         "step_size": step}
+
+
+class ASGD(Optimizer):
+    """Averaged SGD (ref: python/paddle/optimizer/asgd.py (U)): plain SGD
+    steps plus a running average of the iterates."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+
+    def _init_state(self, p):
+        return {
+            "avg": p._data.astype(jnp.float32),
+            "count": jnp.zeros((), jnp.float32),
+        }
+
+    def _update(self, param, grad, state, lr):
+        grad = _apply_l2(grad, param, self._cur_wd)
+        p32 = param.astype(jnp.float32) - lr * grad.astype(jnp.float32)
+        cnt = state["count"] + 1.0
+        avg = state["avg"] + (p32 - state["avg"]) / cnt
+        return p32.astype(param.dtype), {"avg": avg, "count": cnt}
+
+
+class NAdam(Adam):
+    """Adam with Nesterov momentum (ref: python/paddle/optimizer/nadam.py
+    (U))."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, multi_precision=multi_precision)
+        self._psi = momentum_decay
+
+    def _init_state(self, p):
+        st = super()._init_state(p)
+        st["mu_product"] = jnp.ones((), jnp.float32)
+        return st
+
+    def _update(self, param, grad, state, lr):
+        p32 = state.get("master_weight", param).astype(jnp.float32)
+        g32 = grad.astype(jnp.float32)
+        if self._cur_wd:
+            g32 = g32 + self._cur_wd * p32
+        # step count recovered from the beta2 power (exact in f32 range)
+        step = jnp.round(jnp.log(state["beta2_pow"] * self._beta2)
+                         / jnp.log(self._beta2))
+        mu_t = self._beta1 * (1 - 0.5 * 0.96 ** (step * self._psi))
+        mu_t1 = self._beta1 * (1 - 0.5 * 0.96 ** ((step + 1) * self._psi))
+        mu_prod = state["mu_product"] * mu_t
+        m1 = self._beta1 * state["moment1"] + (1 - self._beta1) * g32
+        m2 = self._beta2 * state["moment2"] + (1 - self._beta2) * jnp.square(g32)
+        b2p = state["beta2_pow"] * self._beta2
+        m2_hat = m2 / (1 - b2p)
+        m1_bar = (mu_t1 * m1 / (1 - mu_prod * mu_t1)
+                  + (1 - mu_t) * g32 / (1 - mu_prod))
+        p32 = p32 - lr * m1_bar / (jnp.sqrt(m2_hat) + self._epsilon)
+        new_state = dict(
+            state, moment1=m1, moment2=m2,
+            beta1_pow=state["beta1_pow"] * self._beta1, beta2_pow=b2p,
+            mu_product=mu_prod)
+        if "master_weight" in state:
+            new_state["master_weight"] = p32
+        return p32.astype(param.dtype), new_state
+
+
+class RAdam(Adam):
+    """Rectified Adam (ref: python/paddle/optimizer/radam.py (U))."""
+
+    def _update(self, param, grad, state, lr):
+        p32 = state.get("master_weight", param).astype(jnp.float32)
+        g32 = grad.astype(jnp.float32)
+        if self._cur_wd:
+            g32 = g32 + self._cur_wd * p32
+        b1p = state["beta1_pow"] * self._beta1
+        b2p = state["beta2_pow"] * self._beta2
+        m1 = self._beta1 * state["moment1"] + (1 - self._beta1) * g32
+        m2 = self._beta2 * state["moment2"] + (1 - self._beta2) * jnp.square(g32)
+        step = jnp.round(jnp.log(b2p) / jnp.log(self._beta2))
+        rho_inf = 2.0 / (1 - self._beta2) - 1.0
+        rho_t = rho_inf - 2.0 * step * b2p / (1 - b2p)
+        m1_hat = m1 / (1 - b1p)
+        rect = jnp.sqrt(((rho_t - 4) * (rho_t - 2) * rho_inf)
+                        / jnp.maximum((rho_inf - 4) * (rho_inf - 2) * rho_t,
+                                      1e-12))
+        adaptive = rect * m1_hat / (jnp.sqrt(m2 / (1 - b2p)) + self._epsilon)
+        sgd_like = m1_hat
+        upd = jnp.where(rho_t > 5.0, adaptive, sgd_like)
+        p32 = p32 - lr * upd
+        new_state = dict(state, moment1=m1, moment2=m2, beta1_pow=b1p,
+                         beta2_pow=b2p)
+        if "master_weight" in state:
+            new_state["master_weight"] = p32
+        return p32.astype(param.dtype), new_state

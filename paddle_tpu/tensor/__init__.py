@@ -11,7 +11,8 @@ from ..core.tensor import Tensor, Parameter, to_tensor
 from .creation import (
     zeros, ones, full, empty, zeros_like, ones_like, full_like, empty_like,
     arange, linspace, logspace, eye, meshgrid, tril, triu, diag, diagflat,
-    diag_embed, assign, clone, one_hot, complex, polar,
+    diag_embed, assign, clone, one_hot, complex, polar, tril_indices,
+    triu_indices,
 )
 from .math import (
     add, subtract, multiply, divide, floor_divide, remainder, mod, floor_mod,
@@ -26,7 +27,8 @@ from .math import (
     cumprod, cummax, cummin, count_nonzero, diff, trace, add_n, matmul, mm,
     bmm, dot, inner, outer, kron, mv, addmm, cross, allclose, isclose,
     equal_all, increment, multiplex, bincount, trapezoid,
-    cumulative_trapezoid, vander, logcumsumexp, frexp, renorm,
+    cumulative_trapezoid, vander, logcumsumexp, frexp, renorm, i0e, i1, i1e,
+    polygamma, logit, signbit, positive, dist, inverse, combinations,
 )
 from .manipulation import (
     reshape, reshape_, transpose, t, moveaxis, swapaxes, flatten, squeeze,
@@ -37,7 +39,10 @@ from .manipulation import (
     take_along_axis, put_along_axis, take, slice, strided_slice,
     repeat_interleave, unique, unique_consecutive, nonzero, where,
     as_complex, as_real, view, view_as, atleast_1d, atleast_2d, atleast_3d,
-    tensordot, shard_index, cast, diagonal, unfold, as_strided,
+    tensordot, shard_index, cast, diagonal, unfold, as_strided, fliplr,
+    flipud, tensor_split, hsplit, vsplit, dsplit, hstack, vstack,
+    column_stack, row_stack, unflatten, index_fill, broadcast_shape, tolist,
+    shape, cat, take_along_dim,
 )
 from .logic import (
     equal, not_equal, greater_than, greater_equal, less_than, less_equal,

@@ -159,3 +159,26 @@ def _shape(shape):
 
 def _as_t(x):
     return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64", name=None):
+    """[2, n] indices of the lower triangle (reference layout)."""
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+
+    if col is None:
+        col = row
+    r, c = jnp.tril_indices(int(row), k=offset, m=int(col))
+    return Tensor(jnp.stack([r, c]).astype(jnp.int32))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64", name=None):
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+
+    if col is None:
+        col = row
+    r, c = jnp.triu_indices(int(row), k=offset, m=int(col))
+    return Tensor(jnp.stack([r, c]).astype(jnp.int32))

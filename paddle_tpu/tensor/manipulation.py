@@ -484,3 +484,115 @@ def as_strided(x, shape, stride, offset=0, name=None):
         return jnp.take(a.reshape(-1), idx)
 
     return apply(f, x)
+
+
+def fliplr(x, name=None):
+    return apply(lambda a: jnp.fliplr(a), _as_t(x), _op_name="fliplr")
+
+
+def flipud(x, name=None):
+    return apply(lambda a: jnp.flipud(a), _as_t(x), _op_name="flipud")
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    x = _as_t(x)
+    import numpy as np
+
+    a_len = int(x.shape[axis])
+    if isinstance(num_or_indices, int):
+        # keep empty trailing chunks (reference/np semantics when
+        # num > axis length)
+        sections = np.array_split(np.arange(a_len), num_or_indices)
+        bounds = [0]
+        for s in sections:
+            bounds.append(bounds[-1] + len(s))
+    else:
+        bounds = [0] + [int(i) for i in num_or_indices] + [a_len]
+    outs = []
+    for lo, hi in zip(bounds[:-1], bounds[1:]):
+        outs.append(apply(
+            lambda a, lo=int(lo), hi=int(hi): lax.slice_in_dim(a, lo, hi, axis=axis),
+            x, _op_name="tensor_split"))
+    return outs
+
+
+def hsplit(x, num_or_indices, name=None):
+    x = _as_t(x)
+    axis = 0 if len(x.shape) == 1 else 1
+    return tensor_split(x, num_or_indices, axis=axis)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+def hstack(x, name=None):
+    ts = [_as_t(v) for v in x]
+    axis = 0 if len(ts[0].shape) <= 1 else 1
+    return concat(ts, axis=axis)
+
+
+def vstack(x, name=None):
+    ts = [_as_t(v) for v in x]
+    if len(ts[0].shape) <= 1:
+        ts = [reshape(t_, [1, -1]) for t_ in ts]
+    return concat(ts, axis=0)
+
+
+def column_stack(x, name=None):
+    ts = [_as_t(v) for v in x]
+    ts = [reshape(t_, [-1, 1]) if len(t_.shape) == 1 else t_ for t_ in ts]
+    return concat(ts, axis=1)
+
+
+def row_stack(x, name=None):
+    return vstack(x)
+
+
+def unflatten(x, axis, shape, name=None):
+    x = _as_t(x)
+    axis = axis % len(x.shape)
+    new_shape = (list(x.shape[:axis]) + [int(s) for s in shape]
+                 + list(x.shape[axis + 1:]))
+    return reshape(x, new_shape)
+
+
+def index_fill(x, index, axis, value, name=None):
+    x = _as_t(x)
+    idx = _as_t(index)
+
+    def f(a, i):
+        moved = jnp.moveaxis(a, axis, 0)
+        filled = moved.at[i].set(jnp.asarray(value, a.dtype))
+        return jnp.moveaxis(filled, 0, axis)
+
+    return apply(f, x, idx.detach(), _op_name="index_fill")
+
+
+def broadcast_shape(x_shape, y_shape):
+    import numpy as np
+
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def tolist(x, name=None):
+    import numpy as np
+
+    return np.asarray(_as_t(x)._data).tolist()
+
+
+def shape(x, name=None):
+    """paddle.shape: the shape as a 1-D int32 Tensor (reference returns a
+    tensor, not a list — code feeds it to reshape etc.)."""
+    from ..core.tensor import Tensor
+
+    return Tensor(jnp.asarray([int(s) for s in _as_t(x).shape], jnp.int32))
+
+
+# reference-compatible aliases
+cat = concat
+take_along_dim = take_along_axis

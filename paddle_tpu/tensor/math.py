@@ -631,3 +631,77 @@ def renorm(x, p, axis, max_norm, name=None):
         return a * factor.astype(a.dtype)
 
     return apply(f, _as_t(x))
+
+
+def i0e(x, name=None):
+    return _unary(jax.scipy.special.i0e, x, "i0e")
+
+
+def i1(x, name=None):
+    return _unary(jax.scipy.special.i1, x, "i1")
+
+
+def i1e(x, name=None):
+    return _unary(jax.scipy.special.i1e, x, "i1e")
+
+
+def polygamma(x, n, name=None):
+    def f(a):
+        return jax.scipy.special.polygamma(n, a)
+
+    return apply(f, _as_t(x), _op_name="polygamma")
+
+
+def logit(x, eps=None, name=None):
+    def f(a):
+        if eps is not None:
+            a = jnp.clip(a, eps, 1.0 - eps)
+        return jax.scipy.special.logit(a)
+
+    return apply(f, _as_t(x), _op_name="logit")
+
+
+def signbit(x, name=None):
+    return _unary(jnp.signbit, x, "signbit")
+
+
+def positive(x, name=None):
+    return _as_t(x)
+
+
+def dist(x, y, p=2, name=None):
+    """p-norm of (x - y) (reference paddle.dist)."""
+    def f(a, b):
+        d = (a - b).reshape(-1)
+        if p == 0:
+            return jnp.sum(d != 0).astype(a.dtype)
+        if jnp.isinf(p):
+            return (jnp.max(jnp.abs(d)) if p > 0
+                    else jnp.min(jnp.abs(d))).astype(a.dtype)
+        return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+
+    return apply(f, _as_t(x), _as_t(y), _op_name="dist")
+
+
+def inverse(x, name=None):
+    from .linalg import inv as _inv
+
+    return _inv(x)
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    """All r-combinations of a 1-D tensor's elements (reference parity).
+    Index enumeration happens host-side (shape depends only on len(x))."""
+    import itertools
+
+    import numpy as np
+
+    n = int(_as_t(x).shape[0])
+    it = (itertools.combinations_with_replacement(range(n), r)
+          if with_replacement else itertools.combinations(range(n), r))
+    idx = np.asarray(list(it), np.int32).reshape(-1, r)
+
+    def f(a):
+        return a[jnp.asarray(idx)]
+
+    return apply(f, _as_t(x), _op_name="combinations")

@@ -29,3 +29,35 @@ def deprecated(update_to="", since="", reason="", level=0):
         return fn
 
     return decorator
+
+
+def require_version(min_version, max_version=None):
+    """ref paddle.utils.require_version: check the installed version lies in
+    [min_version, max_version]."""
+    from ..version import full_version
+
+    def _key(v):
+        return tuple(int(x) for x in str(v).split(".")[:3] if x.isdigit())
+
+    cur = _key(full_version)
+    if _key(min_version) > cur:
+        raise RuntimeError(
+            f"installed version {full_version} < required {min_version}")
+    if max_version is not None and _key(max_version) < cur:
+        raise RuntimeError(
+            f"installed version {full_version} > allowed {max_version}")
+    return True
+
+
+def download(url, path=None, md5sum=None, method="get"):
+    """Zero-egress build: resolve from a local cache only (set
+    PPTPU_DATA_HOME); network download raises with guidance."""
+    import os
+
+    cache = os.environ.get("PPTPU_DATA_HOME", os.path.expanduser("~/.cache/paddle_tpu"))
+    fname = os.path.join(cache, os.path.basename(url))
+    if os.path.exists(fname):
+        return fname
+    raise RuntimeError(
+        f"no network egress in this build: place {os.path.basename(url)!r} "
+        f"under {cache} (PPTPU_DATA_HOME) to use it")

@@ -11,3 +11,20 @@ def set_image_backend(backend):
 
 def get_image_backend():
     return "numpy"
+
+
+def image_load(path, backend=None):
+    """Load an image file to an ndarray (zero-egress build: PIL if present,
+    else raw numpy .npy; the reference defaults to PIL/cv2)."""
+    import numpy as np
+
+    if str(path).endswith(".npy"):
+        return np.load(path)
+    try:
+        from PIL import Image
+
+        return Image.open(path)
+    except ImportError as e:
+        raise RuntimeError(
+            "image_load needs PIL (not in this build) for non-.npy files"
+        ) from e
