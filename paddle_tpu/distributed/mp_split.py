@@ -25,7 +25,14 @@ def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
             f"num_partitions ({num_partitions}) must equal the "
             f"model-parallel degree ({mp})")
 
-    key = name or f"dist_split_{len(_SPLIT_CACHE)}_{operation}_{size}_{axis}"
+    if name is None:
+        # cache per call site, so an unnamed split() inside forward reuses
+        # its layer (and its weights) across steps
+        import inspect
+
+        fr = inspect.stack()[1]
+        name = f"{fr.filename}:{fr.lineno}"
+    key = f"{name}_{operation}_{size}_{axis}"
     layer = _SPLIT_CACHE.get(key)
     if layer is None:
         from .fleet.meta_parallel import (

@@ -30,5 +30,6 @@ broadcast = _accepting_stream_kw(broadcast)
 scatter = _accepting_stream_kw(scatter)
 reduce = _accepting_stream_kw(reduce)
 alltoall = _accepting_stream_kw(alltoall)
+alltoall_single = _accepting_stream_kw(alltoall_single)
 send = _accepting_stream_kw(send)
 recv = _accepting_stream_kw(recv)

@@ -7,3 +7,4 @@ from .sampler import (
     SubsetRandomSampler, BatchSampler, DistributedBatchSampler,
 )
 from .dataloader import DataLoader, default_collate_fn, get_worker_info
+from .record import RecordWriter, RecordFile, RecordDataset

@@ -1,0 +1,194 @@
+"""Native record-file sample store (SURVEY.md §2.2 P6 — the reference feeds
+big runs via multiprocess DataLoader workers + pinned memory; the host-native
+TPU analog is an indexed binary record file read by C++ threads with no GIL
+between syscall and numpy view).
+
+Format PTRECD01 (see native.cc): magic + [u64 len + payload]*. Use
+`RecordWriter` to build a file, `RecordDataset` (a paddle.io.Dataset) to
+consume it — compose with DataLoader like any dataset; `read_batch` gives
+the packed parallel-read path the thread workers use.
+
+A pure-Python fallback keeps everything working without the toolchain."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+
+import numpy as np
+
+_MAGIC = b"PTRECD01"
+
+
+class RecordWriter:
+    def __init__(self, path):
+        self._f = open(path, "wb")
+        self._f.write(_MAGIC)
+        self._n = 0
+
+    def write(self, payload):
+        """Append one record (bytes / bytes-like / numpy array's buffer)."""
+        if isinstance(payload, np.ndarray):
+            payload = payload.tobytes()
+        b = bytes(payload)
+        self._f.write(struct.pack("<Q", len(b)))
+        self._f.write(b)
+        self._n += 1
+        return self._n - 1
+
+    def close(self):
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class RecordFile:
+    """Indexed reader over a PTRECD01 file; native parallel reads when the
+    C++ core is available."""
+
+    def __init__(self, path, num_threads=0):
+        self.path = path
+        self._threads = num_threads
+        from ..native import get_lib
+
+        self._lib = get_lib()
+        self._h = None
+        if self._lib is not None:
+            h = self._lib.prec_open(os.fsencode(path))
+            if h > 0:
+                self._h = h
+        if self._h is None:
+            self._index = self._scan(path)
+
+    @staticmethod
+    def _scan(path):
+        idx = []
+        with open(path, "rb") as f:
+            if f.read(8) != _MAGIC:
+                raise ValueError(f"{path!r} is not a PTRECD01 record file")
+            off = 8
+            end = os.fstat(f.fileno()).st_size
+            while off + 8 <= end:
+                f.seek(off)
+                (ln,) = struct.unpack("<Q", f.read(8))
+                off += 8
+                if off + ln > end:
+                    break
+                idx.append((off, ln))
+                off += ln
+        return idx
+
+    def __len__(self):
+        if self._h is not None:
+            return int(self._lib.prec_count(self._h))
+        return len(self._index)
+
+    def size(self, i):
+        if self._h is not None:
+            s = int(self._lib.prec_size(self._h, int(i)))
+            if s < 0:
+                raise IndexError(i)
+            return s
+        return self._index[i][1]
+
+    def read(self, i):
+        """One record as bytes."""
+        if self._h is not None:
+            n = self.size(i)
+            buf = np.empty(n, np.uint8)
+            rc = self._lib.prec_read(
+                self._h, int(i), buf.ctypes.data_as(ctypes.c_void_p))
+            if rc != 0:
+                raise OSError(rc, f"prec_read failed for record {i}")
+            return buf.tobytes()
+        off, ln = self._index[i]
+        with open(self.path, "rb") as f:
+            f.seek(off)
+            return f.read(ln)
+
+    def read_batch(self, indices):
+        """Parallel read of many records into ONE contiguous buffer;
+        returns (buffer, offsets, sizes) — zero-copy views are
+        buffer[offsets[k]:offsets[k]+sizes[k]]."""
+        indices = [int(i) for i in indices]
+        sizes = np.asarray([self.size(i) for i in indices], np.uint64)
+        offsets = np.zeros(len(indices), np.uint64)
+        if len(indices) > 1:
+            offsets[1:] = np.cumsum(sizes[:-1])
+        total = int(sizes.sum())
+        buf = np.empty(total, np.uint8)
+        if self._h is not None and indices:
+            idx_arr = np.asarray(indices, np.int64)
+            rc = self._lib.prec_read_many(
+                self._h,
+                idx_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                len(indices),
+                buf.ctypes.data_as(ctypes.c_void_p),
+                offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+                self._threads)
+            if rc != 0:
+                raise OSError(rc, "prec_read_many failed")
+        else:
+            for k, i in enumerate(indices):
+                o = int(offsets[k])
+                buf[o:o + int(sizes[k])] = np.frombuffer(self.read(i),
+                                                         np.uint8)
+        return buf, offsets, sizes
+
+    def close(self):
+        if self._h is not None:
+            self._lib.prec_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class RecordDataset:
+    """paddle.io-style Dataset over a record file. `decode_fn(bytes) -> sample`
+    defaults to identity; `ndarray_spec=(dtype, shape)` decodes fixed-shape
+    tensors with zero copies."""
+
+    def __init__(self, path, decode_fn=None, ndarray_spec=None,
+                 num_threads=0):
+        self._rf = RecordFile(path, num_threads=num_threads)
+        self._decode = decode_fn
+        self._spec = ndarray_spec
+
+    def __len__(self):
+        return len(self._rf)
+
+    def __getitem__(self, i):
+        raw = self._rf.read(i)
+        if self._spec is not None:
+            dtype, shape = self._spec
+            return np.frombuffer(raw, dtype=dtype).reshape(shape)
+        if self._decode is not None:
+            return self._decode(raw)
+        return raw
+
+    def read_batch(self, indices):
+        """Packed batch via the native parallel path: for fixed-shape
+        ndarray records this returns one [n, *shape] array with a single
+        allocation and no per-sample Python."""
+        buf, offsets, sizes = self._rf.read_batch(indices)
+        if self._spec is not None:
+            dtype, shape = self._spec
+            per = int(np.prod(shape)) * np.dtype(dtype).itemsize
+            if not all(int(s) == per for s in sizes):
+                raise ValueError("records do not match ndarray_spec")
+            return buf.view(dtype).reshape((len(indices),) + tuple(shape))
+        out = []
+        for k in range(len(indices)):
+            o = int(offsets[k])
+            raw = buf[o:o + int(sizes[k])].tobytes()
+            out.append(self._decode(raw) if self._decode else raw)
+        return out

@@ -66,6 +66,21 @@ def _build_and_load():
                                     ctypes.c_void_p, ctypes.c_uint64,
                                     ctypes.c_int]
     lib.pt_pread_chunks.restype = ctypes.c_int
+    lib.prec_open.argtypes = [ctypes.c_char_p]
+    lib.prec_open.restype = ctypes.c_int64
+    lib.prec_count.argtypes = [ctypes.c_int64]
+    lib.prec_count.restype = ctypes.c_int64
+    lib.prec_size.argtypes = [ctypes.c_int64, ctypes.c_int64]
+    lib.prec_size.restype = ctypes.c_int64
+    lib.prec_read.argtypes = [ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p]
+    lib.prec_read.restype = ctypes.c_int
+    lib.prec_read_many.argtypes = [ctypes.c_int64,
+                                   ctypes.POINTER(ctypes.c_int64),
+                                   ctypes.c_int, ctypes.c_void_p,
+                                   ctypes.POINTER(ctypes.c_uint64),
+                                   ctypes.c_int]
+    lib.prec_read_many.restype = ctypes.c_int
+    lib.prec_close.argtypes = [ctypes.c_int64]
     return lib
 
 

@@ -396,3 +396,129 @@ int pt_pread_chunks(const char* path, uint64_t file_offset, void* buf,
 }
 
 }  // extern "C"
+
+// -------------------------------------------------- record file reader
+// GIL-free sample store for the data pipeline (the reference's multiprocess
+// DataLoader + pin_memory path, SURVEY.md §2.2 P6, done the host-native way:
+// indexed binary records, parallel positional reads into pooled staging
+// buffers, zero Python between syscall and numpy view).
+//
+// Format PTRECD01: 8-byte magic, then per record u64 little-endian payload
+// length + payload. The offset index is built once at open by scanning.
+
+namespace {
+
+struct RecordFile {
+  int fd = -1;
+  std::vector<uint64_t> offsets;  // payload start per record
+  std::vector<uint64_t> sizes;
+};
+
+std::mutex g_rec_mu;
+std::unordered_map<int64_t, RecordFile*> g_rec;
+int64_t g_rec_next = 1;
+
+}  // namespace
+
+extern "C" {
+
+// Open + index. Returns handle > 0, or -errno / -EINVAL on bad magic.
+int64_t prec_open(const char* path) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return -(int64_t)errno;
+  char magic[8];
+  if (::pread(fd, magic, 8, 0) != 8 || memcmp(magic, "PTRECD01", 8) != 0) {
+    ::close(fd);
+    return -(int64_t)EINVAL;
+  }
+  off_t end = ::lseek(fd, 0, SEEK_END);
+  auto* rf = new RecordFile();
+  rf->fd = fd;
+  uint64_t off = 8;
+  while ((off_t)off + 8 <= end) {
+    uint64_t len;
+    if (::pread(fd, &len, 8, off) != 8) break;
+    off += 8;
+    if (len > (uint64_t)end - off) break;  // truncated/corrupt tail: drop
+    rf->offsets.push_back(off);
+    rf->sizes.push_back(len);
+    off += len;
+  }
+  std::lock_guard<std::mutex> lk(g_rec_mu);
+  int64_t h = g_rec_next++;
+  g_rec[h] = rf;
+  return h;
+}
+
+int64_t prec_count(int64_t h) {
+  std::lock_guard<std::mutex> lk(g_rec_mu);
+  auto it = g_rec.find(h);
+  return it == g_rec.end() ? -1 : (int64_t)it->second->offsets.size();
+}
+
+int64_t prec_size(int64_t h, int64_t i) {
+  std::lock_guard<std::mutex> lk(g_rec_mu);
+  auto it = g_rec.find(h);
+  if (it == g_rec.end() || i < 0 || (size_t)i >= it->second->sizes.size())
+    return -1;
+  return (int64_t)it->second->sizes[i];
+}
+
+// Read record i into dst (must hold prec_size bytes). 0 on success.
+int prec_read(int64_t h, int64_t i, void* dst) {
+  RecordFile* rf;
+  {
+    std::lock_guard<std::mutex> lk(g_rec_mu);
+    auto it = g_rec.find(h);
+    if (it == g_rec.end()) return EBADF;
+    rf = it->second;
+  }
+  if (i < 0 || (size_t)i >= rf->offsets.size()) return EINVAL;
+  uint64_t off = rf->offsets[i], len = rf->sizes[i], done = 0;
+  char* p = static_cast<char*>(dst);
+  while (done < len) {
+    ssize_t r = ::pread(rf->fd, p + done, len - done, off + done);
+    if (r <= 0) return r < 0 ? errno : EIO;
+    done += (uint64_t)r;
+  }
+  return 0;
+}
+
+// Parallel batch read: records idxs[0..n) land back-to-back in dst at
+// dst_offsets[k] (caller computes the packing). 0 on success.
+int prec_read_many(int64_t h, const int64_t* idxs, int n, void* dst,
+                   const uint64_t* dst_offsets, int nthreads) {
+  RecordFile* rf;
+  {
+    std::lock_guard<std::mutex> lk(g_rec_mu);
+    auto it = g_rec.find(h);
+    if (it == g_rec.end()) return EBADF;
+    rf = it->second;
+  }
+  std::atomic<int> err{0};
+  char* base = static_cast<char*>(dst);
+  pool(nthreads)->parallel_for((size_t)n, [&](size_t k) {
+    int64_t i = idxs[k];
+    if (i < 0 || (size_t)i >= rf->offsets.size()) { err.store(EINVAL); return; }
+    uint64_t off = rf->offsets[i], len = rf->sizes[i], done = 0;
+    char* p = base + dst_offsets[k];
+    while (done < len) {
+      ssize_t r = ::pread(rf->fd, p + done, len - done, off + done);
+      if (r <= 0) { err.store(r < 0 ? errno : EIO); return; }
+      done += (uint64_t)r;
+    }
+  });
+  return err.load();
+}
+
+void prec_close(int64_t h) {
+  std::lock_guard<std::mutex> lk(g_rec_mu);
+  auto it = g_rec.find(h);
+  if (it != g_rec.end()) {
+    ::close(it->second->fd);
+    delete it->second;
+    g_rec.erase(it);
+  }
+}
+
+}  // extern "C"

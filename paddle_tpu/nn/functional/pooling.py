@@ -22,14 +22,24 @@ def _pool(x, kernel, stride, padding, n, data_format, reducer, init, ceil_mode=F
         pad_cfg = pad
 
     def f(a):
+        spatial_pads = pad_cfg
+        if ceil_mode and not isinstance(pad_cfg, str):
+            # extend the high pad so partial windows at the end survive
+            # (reference ceil_mode: out = ceil((L + pl + pr - k)/s) + 1)
+            spatial = a.shape[1:-1] if channel_last else a.shape[2:]
+            spatial_pads = []
+            for i, (pl, pr) in enumerate(pad_cfg):
+                num = spatial[i] + pl + pr - kernel[i]
+                extra = (-num) % stride[i] if num % stride[i] else 0
+                spatial_pads.append((pl, pr + extra))
         if channel_last:
             dims = (1,) + kernel + (1,)
             strides = (1,) + stride + (1,)
-            pads = [(0, 0)] + (pad_cfg if not isinstance(pad_cfg, str) else []) + [(0, 0)]
+            pads = [(0, 0)] + (spatial_pads if not isinstance(spatial_pads, str) else []) + [(0, 0)]
         else:
             dims = (1, 1) + kernel
             strides = (1, 1) + stride
-            pads = [(0, 0), (0, 0)] + (pad_cfg if not isinstance(pad_cfg, str) else [])
+            pads = [(0, 0), (0, 0)] + (spatial_pads if not isinstance(spatial_pads, str) else [])
         if isinstance(pad_cfg, str):
             pads = pad_cfg
         out = lax.reduce_window(a, init, reducer, dims, strides, pads)

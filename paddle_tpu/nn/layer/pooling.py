@@ -22,38 +22,45 @@ class _Pool(Layer):
 class MaxPool1D(_Pool):
     def __init__(self, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, name=None):
         super().__init__(F.max_pool1d, kernel_size, stride, padding,
-                         return_mask=return_mask)
+                         return_mask=return_mask, ceil_mode=ceil_mode)
 
 
 class MaxPool2D(_Pool):
     def __init__(self, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
                  data_format="NCHW", name=None):
         super().__init__(F.max_pool2d, kernel_size, stride, padding,
-                         data_format=data_format, return_mask=return_mask)
+                         data_format=data_format, return_mask=return_mask,
+                         ceil_mode=ceil_mode)
 
 
 class MaxPool3D(_Pool):
     def __init__(self, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
                  data_format="NCDHW", name=None):
         super().__init__(F.max_pool3d, kernel_size, stride, padding,
-                         return_mask=return_mask)
+                         data_format=data_format, return_mask=return_mask,
+                         ceil_mode=ceil_mode)
 
 
 class AvgPool1D(_Pool):
     def __init__(self, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False, name=None):
-        super().__init__(F.avg_pool1d, kernel_size, stride, padding)
+        super().__init__(F.avg_pool1d, kernel_size, stride, padding,
+                         exclusive=exclusive, ceil_mode=ceil_mode)
 
 
 class AvgPool2D(_Pool):
     def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True,
                  divisor_override=None, data_format="NCHW", name=None):
-        super().__init__(F.avg_pool2d, kernel_size, stride, padding, data_format=data_format)
+        super().__init__(F.avg_pool2d, kernel_size, stride, padding,
+                         data_format=data_format, exclusive=exclusive,
+                         ceil_mode=ceil_mode)
 
 
 class AvgPool3D(_Pool):
     def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True,
                  divisor_override=None, data_format="NCDHW", name=None):
-        super().__init__(F.avg_pool3d, kernel_size, stride, padding)
+        super().__init__(F.avg_pool3d, kernel_size, stride, padding,
+                         data_format=data_format, exclusive=exclusive,
+                         ceil_mode=ceil_mode)
 
 
 class AdaptiveAvgPool1D(Layer):

@@ -108,6 +108,13 @@ def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
                 u = wm @ v
                 u = u / jnp.maximum(jnp.linalg.norm(u), eps)
             sigma = u @ (wm @ v)
+            # persist u so power iteration ACCUMULATES across forwards
+            # (the reference's running estimate); only with concrete
+            # values — a traced u would leak a tracer out of the program
+            import jax as _jax
+
+            if not isinstance(u, _jax.core.Tracer):
+                lyr._sn_u = np.asarray(u)
             return wv / sigma
 
         wn = apply(f, worig, _op_name="spectral_norm")

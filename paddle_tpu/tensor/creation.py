@@ -167,10 +167,12 @@ def tril_indices(row, col=None, offset=0, dtype="int64", name=None):
 
     from ..core.tensor import Tensor
 
+    from ..core.dtype import to_jax_dtype
+
     if col is None:
         col = row
     r, c = jnp.tril_indices(int(row), k=offset, m=int(col))
-    return Tensor(jnp.stack([r, c]).astype(jnp.int32))
+    return Tensor(jnp.stack([r, c]).astype(to_jax_dtype(dtype)))
 
 
 def triu_indices(row, col=None, offset=0, dtype="int64", name=None):
@@ -178,7 +180,9 @@ def triu_indices(row, col=None, offset=0, dtype="int64", name=None):
 
     from ..core.tensor import Tensor
 
+    from ..core.dtype import to_jax_dtype
+
     if col is None:
         col = row
     r, c = jnp.triu_indices(int(row), k=offset, m=int(col))
-    return Tensor(jnp.stack([r, c]).astype(jnp.int32))
+    return Tensor(jnp.stack([r, c]).astype(to_jax_dtype(dtype)))

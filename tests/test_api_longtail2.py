@@ -286,3 +286,24 @@ class TestBeamSearch:
         assert out.shape == (3, 1, 2)
         # final beam 0 traces parents: t2 beam0 <- parent0 (t1 beam0 <- parent1)
         np.testing.assert_array_equal(out[:, 0, 0], [5, 6, 1])
+
+
+class TestPoolCeilMode:
+    def test_ceil_mode_matches_torch_semantics(self):
+        # 8x8, k3 s2: floor -> 3x3, ceil -> 4x4 with the partial edge
+        # window (validated against torch.nn.MaxPool2d/AvgPool2d)
+        x = paddle.to_tensor(np.random.RandomState(0).randn(1, 1, 8, 8)
+                             .astype(np.float32))
+        assert nn.MaxPool2D(3, stride=2)(x).shape == [1, 1, 3, 3]
+        out = nn.MaxPool2D(3, stride=2, ceil_mode=True)(x)
+        assert out.shape == [1, 1, 4, 4]
+        np.testing.assert_allclose(out.numpy()[0, 0, 3, 3],
+                                   x.numpy()[0, 0, 6:, 6:].max())
+        outa = nn.AvgPool2D(3, stride=2, ceil_mode=True)(x)
+        assert outa.shape == [1, 1, 4, 4]
+        # exclusive counts: edge window averages only real cells
+        np.testing.assert_allclose(outa.numpy()[0, 0, 3, 3],
+                                   x.numpy()[0, 0, 6:, 6:].mean(), rtol=1e-6)
+        om, mask = F.max_pool2d(x, 3, stride=2, ceil_mode=True,
+                                return_mask=True)
+        np.testing.assert_allclose(om.numpy(), out.numpy())
