@@ -214,6 +214,25 @@ def main():
     # reproduction run stays opt-in
     names = ([n for n in benches if n != "resnet50_f32"]
              if which == "all" else [which])
+    if which == "all":
+        # one fresh process per bench: HBM from a previous model (cached
+        # executables, live donated buffers) must not shrink the next
+        # model's budget — the llama proxy needs nearly the whole chip
+        import subprocess
+
+        me = os.path.abspath(__file__)
+        for n in names:
+            try:
+                r = subprocess.run([sys.executable, me, n],
+                                   capture_output=True, text=True,
+                                   timeout=1800)
+            except subprocess.TimeoutExpired:
+                print(json.dumps({"metric": n, "error": "timeout after 1800s"}))
+                continue
+            out = [l for l in r.stdout.splitlines() if l.startswith("{")]
+            print(out[-1] if out else json.dumps(
+                {"metric": n, "error": r.stderr[-300:]}))
+        return
     for n in names:
         try:
             print(json.dumps(benches[n]()))
