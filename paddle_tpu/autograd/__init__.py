@@ -68,8 +68,19 @@ class PyLayer(metaclass=PyLayerMeta):
 
     @classmethod
     def apply(cls, *args, **kwargs):
-        ctx = PyLayerContext()
+        import jax as _jax
+
         in_tensors = [a for a in args if isinstance(a, Tensor)]
+        if not _tape.tape_enabled() and any(
+                isinstance(t._data, _jax.core.Tracer) for t in in_tensors):
+            # Tape-off tracing context (a rematted/pipelined body whose
+            # gradient comes from an OUTER jax.vjp over the traced program):
+            # the tape vjp below would never run, silently replacing the
+            # custom backward with AD-of-forward. Stage the op as a real
+            # jax.custom_vjp instead so the outer differentiation uses
+            # cls.backward.
+            return cls._apply_staged(*args, **kwargs)
+        ctx = PyLayerContext()
         with _tape.no_grad():
             out = cls.forward(ctx, *args, **kwargs)
         outs = out if isinstance(out, (tuple, list)) else (out,)
@@ -101,6 +112,73 @@ class PyLayer(metaclass=PyLayerMeta):
                 o.stop_gradient = False
             _tape.global_tape().record(diff_inputs, outs, vjp_fn, name=cls.__name__)
         return out if isinstance(out, (tuple, list)) else outs[0]
+
+
+    @classmethod
+    def _apply_staged(cls, *args, **kwargs):
+        """PyLayer as a real jax.custom_vjp (tape-off tracing contexts:
+        recompute bodies, pipeline stages). Tensor-saved state rides the
+        custom_vjp residuals; non-tensor ctx attributes ride a closure (set
+        once per trace in fwd, read in bwd)."""
+        import jax as _jax
+
+        tensor_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+        ctx_box = []
+
+        def rebuild(arrs):
+            full = list(args)
+            for k, i in enumerate(tensor_idx):
+                full[i] = Tensor(arrs[k])
+            return full
+
+        def run_forward(arrs):
+            ctx = PyLayerContext()
+            with _tape.no_grad():
+                out = cls.forward(ctx, *rebuild(arrs), **kwargs)
+            multi = isinstance(out, (tuple, list))
+            outs = tuple(out) if multi else (out,)
+            out_arrays = tuple(
+                o._data if isinstance(o, Tensor) else jnp.asarray(o)
+                for o in outs)
+            return ctx, multi, out_arrays
+
+        @_jax.custom_vjp
+        def fn(*arrs):
+            _, multi, out_arrays = run_forward(arrs)
+            return out_arrays if multi else out_arrays[0]
+
+        def fwd(*arrs):
+            ctx, multi, out_arrays = run_forward(arrs)
+            saved = tuple(t._data if isinstance(t, Tensor) else t
+                          for t in ctx._saved)
+            ctx_box.clear()
+            ctx_box.append((ctx, multi))
+            return (out_arrays if multi else out_arrays[0]), saved
+
+        def bwd(saved, g):
+            ctx, multi = ctx_box[0]
+            ctx._saved = [Tensor(s) if hasattr(s, "dtype") else s
+                          for s in saved]
+            gs = g if multi else (g,)
+            with _tape.no_grad():
+                grads = cls.backward(ctx, *[Tensor(x) for x in gs])
+            if not isinstance(grads, (tuple, list)):
+                grads = (grads,)
+            out = []
+            for k, i in enumerate(tensor_idx):
+                gk = grads[k] if k < len(grads) else None
+                if gk is None:
+                    out.append(jnp.zeros_like(args[i]._data))
+                else:
+                    out.append(gk._data if isinstance(gk, Tensor)
+                               else jnp.asarray(gk))
+            return tuple(out)
+
+        fn.defvjp(fwd, bwd)
+        res = fn(*[args[i]._data for i in tensor_idx])
+        if isinstance(res, tuple):
+            return tuple(Tensor(r) for r in res)
+        return Tensor(res)
 
 
 def is_pylayer_op(x):

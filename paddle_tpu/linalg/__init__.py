@@ -5,4 +5,5 @@ from ..tensor.linalg import (
     inv, pinv, svd, svdvals, qr, eig, eigh, eigvals, eigvalsh, cholesky,
     cholesky_solve, solve, triangular_solve, lstsq, lu, matrix_power,
     matrix_rank, multi_dot, pca_lowrank, corrcoef, cov, householder_product,
+    lu_unpack, matrix_exp, ormqr, svd_lowrank, cdist, pdist,
 )

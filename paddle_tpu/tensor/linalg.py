@@ -222,3 +222,112 @@ def pdist(x, p=2.0, name=None):
     x = _as_t(x)
     iu, ju = np.triu_indices(x.shape[0], k=1)
     return apply(lambda a: _p_reduce(a[iu] - a[ju], p), x)
+
+
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True,
+              name=None):
+    """Unpack lu() output into P, L, U (reference lu_unpack; supports the
+    batched factors this repo's lu() produces)."""
+    import jax
+
+    lu_t = _as_t(lu_data)
+    piv = _as_t(lu_pivots)
+
+    def single(a, p):
+        m, n = a.shape[-2], a.shape[-1]
+        k = min(m, n)
+        L = jnp.tril(a[:, :k], -1) + jnp.eye(m, k, dtype=a.dtype)
+        U = jnp.triu(a[:k, :])
+        # pivots (0-based row swaps, jax lu_factor convention) -> permutation
+        perm = jnp.arange(m)
+
+        def body(i, perm):
+            j = p[i]
+            pi, pj = perm[i], perm[j]
+            perm = perm.at[i].set(pj)
+            return perm.at[j].set(pi)
+
+        from jax import lax
+
+        perm = lax.fori_loop(0, p.shape[-1], body, perm)
+        P = jnp.eye(m, dtype=a.dtype)[perm].T
+        return P, L, U
+
+    def f(a, p):
+        fn = single
+        for _ in range(a.ndim - 2):
+            fn = jax.vmap(fn)
+        return fn(a, p)
+
+    P, L, U = apply(f, lu_t, piv.detach())
+    return (P if unpack_pivots else None,
+            L if unpack_ludata else None,
+            U if unpack_ludata else None)
+
+
+def matrix_exp(x, name=None):
+    from jax.scipy.linalg import expm
+
+    return apply(lambda a: expm(a), _as_t(x))
+
+
+def ormqr(x, tau, y, left=True, transpose=False, name=None):
+    """Multiply y by Q (from the Householder factors x, tau):
+    Q @ y / Q^T @ y / y @ Q / y @ Q^T. Batched factors supported."""
+    import jax
+
+    def single(a, t, b):
+        q = _householder_q(a, t)
+        qm = q.T if transpose else q
+        return qm @ b if left else b @ qm
+
+    def f(a, t, b):
+        fn = single
+        for _ in range(a.ndim - 2):
+            fn = jax.vmap(fn)
+        return fn(a, t, b)
+
+    return apply(f, _as_t(x), _as_t(tau), _as_t(y))
+
+
+def _householder_q(a, tau):
+    m, k = a.shape[-2], tau.shape[-1]
+    q = jnp.eye(m, dtype=a.dtype)
+    for i in range(k):
+        v = jnp.zeros((m,), a.dtype).at[i].set(1.0)
+        v = v.at[i + 1:].set(a[i + 1:, i])
+        h = jnp.eye(m, dtype=a.dtype) - tau[i] * jnp.outer(v, v)
+        q = q @ h
+    return q
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """Randomized truncated SVD (reference svd_lowrank): subspace iteration
+    with a fixed-seed test matrix (deterministic, jit-friendly); M, when
+    given, is subtracted first (the reference's PCA-centering contract).
+    Batched input supported."""
+    x_t = _as_t(x)
+    args = [x_t] + ([_as_t(M)] if M is not None else [])
+
+    def f(a, *m):
+        import jax
+
+        if m:
+            a = a - m[0]
+        mT = lambda t: jnp.swapaxes(t, -1, -2)  # batch-safe transpose
+        n = a.shape[-1]
+        k = min(q, a.shape[-2], n)
+        omega = jax.random.normal(jax.random.key(0), (n, k), a.dtype)
+        # subspace iteration with QR re-orthonormalization each step —
+        # plain power iteration collapses onto the top singular vector
+        # in f32 and loses the rest of the subspace
+        qmat, _ = jnp.linalg.qr(a @ omega)
+        for _ in range(niter):
+            z, _ = jnp.linalg.qr(mT(a) @ qmat)
+            qmat, _ = jnp.linalg.qr(a @ z)
+        b = mT(qmat) @ a
+        u_b, s, vh = jnp.linalg.svd(b, full_matrices=False)
+        return qmat @ u_b, s, mT(vh)
+
+    out = apply(f, *args)
+    return tuple(out)

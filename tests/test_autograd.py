@@ -188,3 +188,58 @@ class TestFunctionalAutograd:
         _, tang = AG.jvp(lambda t: t * t, x, v)
         _, cot = AG.vjp(lambda t: t * t, x, v)
         np.testing.assert_allclose(tang.numpy(), cot.numpy(), atol=1e-6)
+
+
+class TestPyLayerUnderRemat:
+    def test_custom_backward_honored_inside_recompute(self):
+        """Inside a rematted body (tape off, outer jax.vjp) a PyLayer's
+        custom backward must be used — previously AD-of-forward silently
+        replaced it (round-2 staging fix)."""
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from paddle_tpu.autograd import PyLayer
+        from paddle_tpu.distributed.recompute import recompute
+
+        class TripleGrad(PyLayer):
+            # forward is identity, but custom grad multiplies by 3 — AD of
+            # the forward would give 1, so the factor proves the custom
+            # backward ran
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * 1.0
+
+            @staticmethod
+            def backward(ctx, dy):
+                (x,) = ctx.saved_tensor()
+                return dy * 3.0
+
+        def body(x):
+            return TripleGrad.apply(x) * 2.0
+
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        x.stop_gradient = False
+        out = recompute(body, x)
+        out.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [6.0, 6.0])
+
+    def test_eager_path_unchanged(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from paddle_tpu.autograd import PyLayer
+
+        class TripleGrad(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                return x * 1.0
+
+            @staticmethod
+            def backward(ctx, dy):
+                return dy * 3.0
+
+        x = paddle.to_tensor(np.array([1.0], np.float32))
+        x.stop_gradient = False
+        TripleGrad.apply(x).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [3.0])

@@ -111,3 +111,58 @@ class TestIncubateFused:
         z = x @ w + b
         ref = 0.5 * z * (1 + sp.erf(z / np.sqrt(2)))
         np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+class TestLinalgRound2:
+    def test_lu_unpack_roundtrip(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        import paddle_tpu.linalg as L
+
+        a = np.random.RandomState(0).randn(4, 4).astype(np.float32)
+        lu_m, piv = L.lu(paddle.to_tensor(a))
+        P, Lw, U = L.lu_unpack(lu_m, piv)
+        np.testing.assert_allclose(P.numpy() @ Lw.numpy() @ U.numpy(), a,
+                                   atol=1e-5)
+
+    def test_matrix_exp_vs_scipy(self):
+        import numpy as np
+        from scipy.linalg import expm
+
+        import paddle_tpu as paddle
+        import paddle_tpu.linalg as L
+
+        a = np.random.RandomState(1).randn(4, 4).astype(np.float32) * 0.5
+        np.testing.assert_allclose(
+            L.matrix_exp(paddle.to_tensor(a)).numpy(), expm(a),
+            rtol=1e-4, atol=1e-5)
+
+    def test_ormqr_vs_lapack(self):
+        import numpy as np
+        import scipy.linalg as sla
+
+        import paddle_tpu as paddle
+        import paddle_tpu.linalg as L
+
+        rng = np.random.RandomState(2)
+        a = rng.randn(4, 4).astype(np.float32)
+        h, tau = sla.lapack.sgeqrf(a)[:2]
+        y = rng.randn(4, 3).astype(np.float32)
+        out = L.ormqr(paddle.to_tensor(h), paddle.to_tensor(tau),
+                      paddle.to_tensor(y))
+        qfull = sla.lapack.sorgqr(h, tau)[0]
+        np.testing.assert_allclose(out.numpy(), qfull @ y, atol=1e-4)
+
+    def test_svd_lowrank_reconstructs(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        import paddle_tpu.linalg as L
+
+        rng = np.random.RandomState(3)
+        b = (rng.randn(8, 3) @ rng.randn(3, 6)).astype(np.float32)
+        U_, S_, V_ = L.svd_lowrank(paddle.to_tensor(b), q=3, niter=4)
+        rec = U_.numpy() @ np.diag(S_.numpy()) @ V_.numpy().T
+        # randomized f32 subspace iteration: loose tolerance
+        np.testing.assert_allclose(rec, b, atol=1e-2)
