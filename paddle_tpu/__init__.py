@@ -9,8 +9,42 @@ lazy (PEP 562) so `import paddle_tpu` stays light.
 from __future__ import annotations
 
 import importlib
+import os as _os
 
 __version__ = "0.1.0"
+
+
+def _maybe_init_distributed():
+    """Multi-process rendezvous MUST precede any XLA-backend touch, and
+    importing this package touches the backend — so when the launcher's env
+    contract is present (PADDLE_TRAINERS_NUM>1 + endpoints), join the
+    jax.distributed coordination service here, before anything else. Scripts
+    keep the reference shape: `import paddle; dist.init_parallel_env()`."""
+    try:
+        nproc = int(_os.getenv("PADDLE_TRAINERS_NUM",
+                               _os.getenv("WORLD_SIZE", "1")))
+        rank = int(_os.getenv("PADDLE_TRAINER_ID",
+                              _os.getenv("RANK", "0")))
+    except ValueError:
+        return  # malformed contract: stay single-process, don't break import
+    endpoints = _os.getenv("PADDLE_TRAINER_ENDPOINTS", "")
+    if nproc <= 1 or not endpoints:
+        return
+    import jax
+
+    try:
+        jax.distributed.initialize(
+            coordinator_address=endpoints.split(",")[0],
+            num_processes=nproc,
+            process_id=rank,
+        )
+    except RuntimeError as e:
+        msg = str(e).lower()
+        if "already" not in msg and "once" not in msg:
+            raise
+
+
+_maybe_init_distributed()
 
 from .core.tensor import Tensor, Parameter, to_tensor
 from .core.tape import no_grad, enable_grad, is_grad_enabled, set_grad_enabled

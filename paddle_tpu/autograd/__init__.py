@@ -123,18 +123,27 @@ class PyLayer(metaclass=PyLayerMeta):
         import jax as _jax
 
         tensor_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+        # kwargs Tensors must ALSO become explicit custom_vjp inputs — a
+        # tracer closed over from the surrounding rematted body raises
+        # CustomVJPException when the outer vjp differentiates through it
+        kw_tensor_keys = [k for k, v in kwargs.items() if isinstance(v, Tensor)]
+        n_pos = len(tensor_idx)
         ctx_box = []
 
         def rebuild(arrs):
             full = list(args)
             for k, i in enumerate(tensor_idx):
                 full[i] = Tensor(arrs[k])
-            return full
+            kw = dict(kwargs)
+            for j, key in enumerate(kw_tensor_keys):
+                kw[key] = Tensor(arrs[n_pos + j])
+            return full, kw
 
         def run_forward(arrs):
             ctx = PyLayerContext()
+            full, kw = rebuild(arrs)
             with _tape.no_grad():
-                out = cls.forward(ctx, *rebuild(arrs), **kwargs)
+                out = cls.forward(ctx, *full, **kw)
             multi = isinstance(out, (tuple, list))
             outs = tuple(out) if multi else (out,)
             out_arrays = tuple(
@@ -151,6 +160,10 @@ class PyLayer(metaclass=PyLayerMeta):
             ctx, multi, out_arrays = run_forward(arrs)
             saved = tuple(t._data if isinstance(t, Tensor) else t
                           for t in ctx._saved)
+            # residuals carry the saved arrays; keeping the trace-time
+            # Tensors on the boxed ctx would retain tracers past the trace
+            # (bwd rebuilds _saved from residuals)
+            ctx._saved = []
             ctx_box.clear()
             ctx_box.append((ctx, multi))
             return (out_arrays if multi else out_arrays[0]), saved
@@ -172,10 +185,15 @@ class PyLayer(metaclass=PyLayerMeta):
                 else:
                     out.append(gk._data if isinstance(gk, Tensor)
                                else jnp.asarray(gk))
+            # kwargs tensors: backward's positional contract doesn't cover
+            # them (same as the tape path) — zero cotangents
+            for key in kw_tensor_keys:
+                out.append(jnp.zeros_like(kwargs[key]._data))
             return tuple(out)
 
         fn.defvjp(fwd, bwd)
-        res = fn(*[args[i]._data for i in tensor_idx])
+        res = fn(*([args[i]._data for i in tensor_idx]
+                   + [kwargs[k]._data for k in kw_tensor_keys]))
         if isinstance(res, tuple):
             return tuple(Tensor(r) for r in res)
         return Tensor(res)

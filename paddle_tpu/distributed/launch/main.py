@@ -157,8 +157,12 @@ def launch(argv=None):
 
     attempt = 0
     while True:
-        if attempt == 0 and not args.log_dir:
-            # common case: run in-process (no fork) — jax owns the devices
+        if attempt == 0 and not args.log_dir and not args.master:
+            # common case: run in-process (no fork) — jax owns the devices.
+            # Multi-node runs (--master) MUST fork instead: this launcher
+            # process already imported paddle_tpu (touching the XLA
+            # backend), and the coordination-service rendezvous has to
+            # happen before the backend initializes in the training process.
             sys.argv = [args.script] + list(args.script_args)
             runpy.run_path(args.script, run_name="__main__")
             return 0
@@ -167,9 +171,15 @@ def launch(argv=None):
         if args.log_dir:
             log = open(os.path.join(
                 args.log_dir, f"workerlog.{args.rank}.{attempt}"), "w")
+        child_env = dict(os.environ)
+        # the worker must resolve imports from the launch cwd, like the
+        # in-process path does (script dir becomes sys.path[0] otherwise)
+        child_env["PYTHONPATH"] = os.getcwd() + os.pathsep + \
+            child_env.get("PYTHONPATH", "")
         proc = subprocess.run(
             [sys.executable, args.script] + list(args.script_args),
-            stdout=log or None, stderr=subprocess.STDOUT if log else None)
+            stdout=log or None, stderr=subprocess.STDOUT if log else None,
+            env=child_env)
         if log:
             log.close()
         if proc.returncode == 0:

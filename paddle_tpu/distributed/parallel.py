@@ -69,7 +69,14 @@ def init_parallel_env():
 
     import jax
 
-    if env.world_size > 1 and env.trainer_endpoints:
+    already = False
+    try:
+        from jax._src import distributed as _jd
+
+        already = _jd.global_state.client is not None
+    except Exception:
+        pass
+    if env.world_size > 1 and env.trainer_endpoints and not already:
         coordinator = env.trainer_endpoints[0]
         try:
             jax.distributed.initialize(
@@ -78,8 +85,21 @@ def init_parallel_env():
                 process_id=env.rank,
             )
         except RuntimeError as e:
-            if "already" not in str(e).lower():
-                raise  # genuine rendezvous failure (bad coordinator, port...)
+            msg = str(e).lower()
+            # "already initialized"/"called once": the import-time hook in
+            # paddle_tpu/__init__ (_maybe_init_distributed) won the race —
+            # fine. Anything else is a genuine rendezvous failure.
+            if "must be called before" in msg:
+                raise RuntimeError(
+                    "multi-process rendezvous must happen before the XLA "
+                    "backend initializes: export the launcher env contract "
+                    "(PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ENDPOINTS / "
+                    "PADDLE_TRAINER_ID) BEFORE `import paddle_tpu` — the "
+                    "package then joins the coordination service at import "
+                    "time (use python -m paddle_tpu.distributed.launch)"
+                ) from e
+            if "already" not in msg and "once" not in msg:
+                raise
 
     if get_hybrid_communicate_group() is None:
         ndev = jax.device_count()

@@ -182,12 +182,7 @@ def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
 
 def householder_product(x, tau, name=None):
     def f(a, t):
-        m, n = a.shape[-2], a.shape[-1]
-        q = jnp.eye(m, dtype=a.dtype)
-        for i in range(t.shape[-1]):
-            v = jnp.concatenate([jnp.zeros(i, a.dtype), jnp.ones(1, a.dtype), a[i + 1:, i]])
-            q = q @ (jnp.eye(m, dtype=a.dtype) - t[i] * jnp.outer(v, v))
-        return q[:, :n]
+        return _householder_q(a, t)[:, :a.shape[-1]]
 
     return apply(f, _as_t(x), _as_t(tau))
 

@@ -1,0 +1,67 @@
+"""Companion for the multi-process SPMD test (reference test strategy
+pattern A, SURVEY.md §4): launched once per 'host' by
+paddle.distributed.launch; initializes the coordination service through
+init_parallel_env's env contract, then trains data-parallel over the GLOBAL
+8-device mesh (2 processes x 4 virtual CPU devices) and prints the losses."""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+
+
+def main():
+    dist.init_parallel_env()  # jax.distributed.initialize via env contract
+    assert jax.device_count() == 8, jax.device_count()
+    assert len(jax.local_devices()) == 4
+
+    hcg = dist.create_hybrid_communicate_group(sharding=8)
+    from paddle_tpu.distributed.sharding.group_sharded import (
+        GroupShardedTrainStep,
+    )
+
+    paddle.seed(0)  # same init on every process (replicated params)
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+    opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                parameters=model.parameters())
+
+    def loss_fn(net, x, y):
+        return nn.functional.mse_loss(net(x), y)
+
+    step = GroupShardedTrainStep(model, loss_fn, opt, level="os",
+                                 mesh=hcg.mesh)
+
+    # deterministic GLOBAL batch, identical on both processes; jax splits it
+    # over the 8-way sharding axis (4 local shards here, 4 on the peer)
+    from jax.experimental import multihost_utils
+    from jax.sharding import PartitionSpec as P
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(32, 8).astype(np.float32)
+    Y = X.sum(-1, keepdims=True).astype(np.float32)
+    rank = dist.get_rank()
+    n_proc = int(os.environ["PADDLE_TRAINERS_NUM"])
+    lo, hi = rank * 16, (rank + 1) * 16
+    gx = multihost_utils.host_local_array_to_global_array(
+        X[lo:hi], hcg.mesh, P("sharding"))
+    gy = multihost_utils.host_local_array_to_global_array(
+        Y[lo:hi], hcg.mesh, P("sharding"))
+
+    losses = []
+    for _ in range(4):
+        loss = step(paddle.Tensor(gx), paddle.Tensor(gy))
+        losses.append(round(float(loss), 6))
+    print("MP_LOSSES", rank, losses, flush=True)
+
+
+if __name__ == "__main__":
+    main()
