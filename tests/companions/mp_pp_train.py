@@ -1,0 +1,67 @@
+"""Companion: cross-process PIPELINE parallelism — the compiled ppermute
+schedule runs over a 2-process global mesh (pp=4 x dp=2 on 8 devices split
+across the processes), so stage handoffs cross the process boundary through
+gloo. Prints per-rank losses; the driver asserts rank parity + serial
+parity."""
+
+import os
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed.fleet.meta_parallel import (
+    LayerDesc,
+    PipelineLayer,
+    PipelineParallel,
+)
+
+H = 16
+
+
+class Block(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(H, H)
+
+    def forward(self, x):
+        return paddle.tanh(self.fc(x))
+
+
+def main():
+    dist.init_parallel_env()
+    assert jax.device_count() == 8
+    hcg = dist.create_hybrid_communicate_group(dp=2, pp=4)
+
+    paddle.seed(0)
+    pl = PipelineLayer(
+        [LayerDesc(nn.Linear, 8, H)] + [LayerDesc(Block) for _ in range(2)]
+        + [LayerDesc(nn.Linear, H, 4)],
+        loss_fn=lambda o, y: nn.functional.mse_loss(o, y))
+    runner = PipelineParallel(pl, hcg, {"accumulate_steps": 4})
+    opt = paddle.optimizer.Momentum(learning_rate=0.05,
+                                    parameters=pl.parameters())
+
+    from jax.experimental import multihost_utils
+    from jax.sharding import PartitionSpec as P
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(16, 8).astype(np.float32)
+    Y = rng.randn(16, 4).astype(np.float32)
+
+    losses = []
+    for _ in range(3):
+        loss = runner.train_batch(
+            (paddle.to_tensor(X), paddle.to_tensor(Y)), opt)
+        losses.append(round(float(loss), 6))
+    print("MP_PP_LOSSES", dist.get_rank(), losses, flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -55,6 +55,28 @@ print("SERIAL_LOSSES", losses)
     return eval(m.group(1))  # noqa: S307 — our own printed list
 
 
+def _run_two_process(companion, port, marker):
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("PADDLE_", "RANK", "WORLD_SIZE", "MASTER_"))}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nnodes", "2", "--master", f"localhost:{port}",
+             "--rank", str(r), companion],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, cwd=_REPO, env=env)
+        for r in (0, 1)
+    ]
+    losses = {}
+    for p in procs:
+        out, _ = p.communicate(timeout=480)
+        assert p.returncode == 0, out[-2000:]
+        m = re.search(marker + r" (\d) (\[.*\])", out)
+        assert m, out[-1500:]
+        losses[int(m.group(1))] = eval(m.group(2))  # noqa: S307
+    return losses
+
+
 class TestMultiProcessSPMD:
     @pytest.mark.timeout(600)
     def test_two_process_dp_matches_serial(self):
@@ -89,3 +111,59 @@ class TestMultiProcessSPMD:
         np.testing.assert_allclose(losses[0], serial, rtol=1e-4, atol=1e-5)
         # training actually progressed
         assert losses[0][-1] < losses[0][0]
+
+    @pytest.mark.timeout(600)
+    def test_two_process_pipeline_matches_serial(self):
+        """The compiled ppermute pipeline schedule with stage handoffs
+        CROSSING the process boundary (pp=4 x dp=2 over 2 processes)."""
+        companion = os.path.join(os.path.dirname(__file__), "companions",
+                                 "mp_pp_train.py")
+        losses = _run_two_process(companion, 12533, "MP_PP_LOSSES")
+        assert losses[0] == losses[1], losses
+        serial = _serial_pp_losses()
+        np.testing.assert_allclose(losses[0], serial, rtol=1e-4, atol=1e-5)
+        assert losses[0][-1] < losses[0][0]
+
+
+def _serial_pp_losses():
+    code = r"""
+import os
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed.fleet.meta_parallel import (LayerDesc,
+    PipelineLayer, PipelineParallel)
+H = 16
+class Block(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(H, H)
+    def forward(self, x):
+        return paddle.tanh(self.fc(x))
+hcg = dist.create_hybrid_communicate_group(dp=2, pp=4)
+paddle.seed(0)
+pl = PipelineLayer([LayerDesc(nn.Linear, 8, H)] +
+                   [LayerDesc(Block) for _ in range(2)] +
+                   [LayerDesc(nn.Linear, H, 4)],
+                   loss_fn=lambda o, y: nn.functional.mse_loss(o, y))
+runner = PipelineParallel(pl, hcg, {"accumulate_steps": 4})
+opt = paddle.optimizer.Momentum(learning_rate=0.05, parameters=pl.parameters())
+rng = np.random.RandomState(0)
+X = rng.randn(16, 8).astype(np.float32)
+Y = rng.randn(16, 4).astype(np.float32)
+losses = []
+for _ in range(3):
+    losses.append(round(float(runner.train_batch(
+        (paddle.to_tensor(X), paddle.to_tensor(Y)), opt)), 6))
+print("SERIAL_PP", losses)
+"""
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=600,
+                       env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=_REPO)
+    assert r.returncode == 0, r.stderr[-2000:]
+    m = re.search(r"SERIAL_PP (\[.*\])", r.stdout)
+    return eval(m.group(1))  # noqa: S307
