@@ -2,12 +2,16 @@
 pattern A, SURVEY.md §4): launched once per 'host' by
 paddle.distributed.launch; initializes the coordination service through
 init_parallel_env's env contract, then trains data-parallel over the GLOBAL
-8-device mesh (2 processes x 4 virtual CPU devices) and prints the losses."""
+8-device mesh (2 processes x 4 virtual CPU devices) and prints the losses.
+
+MP_SERIAL=1 runs the IDENTICAL program single-process on 8 local devices —
+the serial reference the driver test compares against."""
 
 import os
-import sys
 
-os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+SERIAL = os.environ.get("MP_SERIAL") == "1"
+os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count="
+                           + ("8" if SERIAL else "4"))
 import jax
 
 jax.config.update("jax_platforms", "cpu")
@@ -20,9 +24,10 @@ import paddle_tpu.nn as nn
 
 
 def main():
-    dist.init_parallel_env()  # jax.distributed.initialize via env contract
+    if not SERIAL:
+        dist.init_parallel_env()  # coordination service via env contract
+        assert len(jax.local_devices()) == 4
     assert jax.device_count() == 8, jax.device_count()
-    assert len(jax.local_devices()) == 4
 
     hcg = dist.create_hybrid_communicate_group(sharding=8)
     from paddle_tpu.distributed.sharding.group_sharded import (
@@ -40,17 +45,18 @@ def main():
     step = GroupShardedTrainStep(model, loss_fn, opt, level="os",
                                  mesh=hcg.mesh)
 
-    # deterministic GLOBAL batch, identical on both processes; jax splits it
-    # over the 8-way sharding axis (4 local shards here, 4 on the peer)
+    # deterministic GLOBAL batch; each process feeds its host-local slice
+    # and jax assembles the global sharded array (serial: the whole batch)
     from jax.experimental import multihost_utils
     from jax.sharding import PartitionSpec as P
 
     rng = np.random.RandomState(0)
     X = rng.randn(32, 8).astype(np.float32)
     Y = X.sum(-1, keepdims=True).astype(np.float32)
-    rank = dist.get_rank()
-    n_proc = int(os.environ["PADDLE_TRAINERS_NUM"])
-    lo, hi = rank * 16, (rank + 1) * 16
+    rank = 0 if SERIAL else dist.get_rank()
+    n_proc = 1 if SERIAL else int(os.environ["PADDLE_TRAINERS_NUM"])
+    share = 32 // n_proc
+    lo, hi = rank * share, (rank + 1) * share
     gx = multihost_utils.host_local_array_to_global_array(
         X[lo:hi], hcg.mesh, P("sharding"))
     gy = multihost_utils.host_local_array_to_global_array(

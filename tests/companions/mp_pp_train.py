@@ -1,12 +1,14 @@
 """Companion: cross-process PIPELINE parallelism — the compiled ppermute
 schedule runs over a 2-process global mesh (pp=4 x dp=2 on 8 devices split
 across the processes), so stage handoffs cross the process boundary through
-gloo. Prints per-rank losses; the driver asserts rank parity + serial
-parity."""
+gloo. Prints per-rank losses. MP_SERIAL=1 runs the identical program
+single-process on 8 local devices."""
 
 import os
 
-os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+SERIAL = os.environ.get("MP_SERIAL") == "1"
+os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count="
+                           + ("8" if SERIAL else "4"))
 import jax
 
 jax.config.update("jax_platforms", "cpu")
@@ -35,7 +37,8 @@ class Block(nn.Layer):
 
 
 def main():
-    dist.init_parallel_env()
+    if not SERIAL:
+        dist.init_parallel_env()
     assert jax.device_count() == 8
     hcg = dist.create_hybrid_communicate_group(dp=2, pp=4)
 
@@ -48,9 +51,6 @@ def main():
     opt = paddle.optimizer.Momentum(learning_rate=0.05,
                                     parameters=pl.parameters())
 
-    from jax.experimental import multihost_utils
-    from jax.sharding import PartitionSpec as P
-
     rng = np.random.RandomState(0)
     X = rng.randn(16, 8).astype(np.float32)
     Y = rng.randn(16, 4).astype(np.float32)
@@ -60,7 +60,8 @@ def main():
         loss = runner.train_batch(
             (paddle.to_tensor(X), paddle.to_tensor(Y)), opt)
         losses.append(round(float(loss), 6))
-    print("MP_PP_LOSSES", dist.get_rank(), losses, flush=True)
+    print("MP_PP_LOSSES", 0 if SERIAL else dist.get_rank(), losses,
+          flush=True)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,89 @@
+"""Companion: cross-process TENSOR parallelism — Column/RowParallelLinear
+over an mp=8 axis spanning both processes, so the row-parallel psum and the
+column layer's backward all-reduce cross the process boundary. Trains by
+jax.grad inside shard_map over the global mesh; prints per-rank losses.
+MP_SERIAL=1 runs the identical program single-process on 8 local devices."""
+
+import os
+
+SERIAL = os.environ.get("MP_SERIAL") == "1"
+os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count="
+                           + ("8" if SERIAL else "4"))
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import jax.numpy as jnp
+
+try:
+    from jax import shard_map
+except ImportError:  # older jax layout
+    from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.fleet.meta_parallel import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+)
+
+
+def spec(p):
+    axes = getattr(p, "_sharding_axes", None)
+    return P(*axes) if axes else P()
+
+
+def main():
+    if not SERIAL:
+        dist.init_parallel_env()
+    assert jax.device_count() == 8
+    hcg = dist.create_hybrid_communicate_group(mp=8)
+
+    paddle.seed(0)
+    col = ColumnParallelLinear(8, 16, gather_output=False)
+    row = RowParallelLinear(16, 4, input_is_parallel=True)
+    tensors = ([col.state_dict()[k] for k in col.state_dict()]
+               + [row.state_dict()[k] for k in row.state_dict()])
+    params = [t._data for t in tensors]
+    specs = [spec(t) for t in tensors]
+    nc = len(col.state_dict())
+
+    def loss_of(x, y, *ps):
+        with dist.axis_scope("mp"):
+            with col.use_state(dict(zip(list(col.state_dict()), ps[:nc]))):
+                with row.use_state(dict(zip(list(row.state_dict()),
+                                            ps[nc:]))):
+                    h = col(paddle.Tensor(x))
+                    h = paddle.tanh(h)
+                    o = row(h)
+        return jnp.mean((o._data - y) ** 2)
+
+    def step_body(x, y, *ps):
+        loss, grads = jax.value_and_grad(
+            loss_of, argnums=tuple(range(2, 2 + len(ps))))(x, y, *ps)
+        return (loss,) + grads
+
+    f = shard_map(step_body, mesh=hcg.mesh,
+                  in_specs=(P(), P()) + tuple(specs),
+                  out_specs=(P(),) + tuple(specs), check_vma=False)
+    jf = jax.jit(f)
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(16, 8).astype(np.float32)
+    Y = rng.randn(16, 4).astype(np.float32)
+
+    lr = 0.2
+    losses = []
+    for _ in range(4):
+        out = jf(X, Y, *params)
+        loss, grads = out[0], out[1:]
+        losses.append(round(float(loss), 6))
+        params = [p - lr * g for p, g in zip(params, grads)]
+    print("MP_TP_LOSSES", 0 if SERIAL else dist.get_rank(), losses,
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -2,10 +2,12 @@
 "comm backend", §4 distributed-test pattern A): the launcher spawns two
 REAL processes that rendezvous through the jax.distributed coordination
 service (the TPU build's TCPStore, wired through the reference's
-PADDLE_TRAINER_* env contract at import time) and train data-parallel over
-the combined 8-device mesh with cross-process gloo collectives. Invariant,
-same as the reference's TestDistBase: per-rank losses identical to each
-other AND to the single-process serial run."""
+PADDLE_TRAINER_* env contract at import time) and train over the combined
+8-device mesh with cross-process gloo collectives — data-parallel (ZeRO-1
+step), tensor-parallel (mp=8 spanning both processes) and pipeline-parallel
+(cross-process ppermute handoffs). Invariant, same as the reference's
+TestDistBase: per-rank losses identical to each other AND to the
+single-process serial run of the IDENTICAL companion (MP_SERIAL=1)."""
 
 import os
 import re
@@ -13,157 +15,86 @@ import subprocess
 import sys
 
 import numpy as np
-import pytest
 
-_COMPANION = os.path.join(os.path.dirname(__file__), "companions",
-                          "mp_dp_train.py")
-_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_HERE = os.path.dirname(__file__)
+_REPO = os.path.dirname(os.path.abspath(_HERE))
 
 
-def _serial_losses():
-    """Same model/batch/optimizer on ONE process with 8 virtual devices."""
-    code = r"""
-import os
-os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
-import jax
-jax.config.update("jax_platforms", "cpu")
-import numpy as np
-import paddle_tpu as paddle
-import paddle_tpu.distributed as dist
-import paddle_tpu.nn as nn
-from paddle_tpu.distributed.sharding.group_sharded import GroupShardedTrainStep
+def _companion(name):
+    return os.path.join(_HERE, "companions", name)
 
-hcg = dist.create_hybrid_communicate_group(sharding=8)
-paddle.seed(0)
-model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
-opt = paddle.optimizer.Adam(learning_rate=0.05, parameters=model.parameters())
-step = GroupShardedTrainStep(model, lambda m, x, y: nn.functional.mse_loss(m(x), y),
-                             opt, level="os", mesh=hcg.mesh)
-rng = np.random.RandomState(0)
-X = rng.randn(32, 8).astype(np.float32)
-Y = X.sum(-1, keepdims=True).astype(np.float32)
-losses = []
-for _ in range(4):
-    losses.append(round(float(step(paddle.to_tensor(X), paddle.to_tensor(Y))), 6))
-print("SERIAL_LOSSES", losses)
-"""
-    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
-                       text=True, timeout=600,
-                       env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=_REPO)
-    assert r.returncode == 0, r.stderr[-2000:]
-    m = re.search(r"SERIAL_LOSSES (\[.*\])", r.stdout)
-    return eval(m.group(1))  # noqa: S307 — our own printed list
+
+def _clean_env():
+    return {k: v for k, v in os.environ.items()
+            if not k.startswith(("PADDLE_", "RANK", "WORLD_SIZE", "MASTER_"))}
+
+
+def _parse(marker, out):
+    m = re.search(marker + r" (\d) (\[.*\])", out)
+    assert m, out[-1500:]
+    return int(m.group(1)), eval(m.group(2))  # noqa: S307 — our own output
 
 
 def _run_two_process(companion, port, marker):
-    env = {k: v for k, v in os.environ.items()
-           if not k.startswith(("PADDLE_", "RANK", "WORLD_SIZE", "MASTER_"))}
     procs = [
         subprocess.Popen(
             [sys.executable, "-m", "paddle_tpu.distributed.launch",
              "--nnodes", "2", "--master", f"localhost:{port}",
              "--rank", str(r), companion],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-            text=True, cwd=_REPO, env=env)
+            text=True, cwd=_REPO, env=_clean_env())
         for r in (0, 1)
     ]
     losses = {}
-    for p in procs:
-        out, _ = p.communicate(timeout=480)
-        assert p.returncode == 0, out[-2000:]
-        m = re.search(marker + r" (\d) (\[.*\])", out)
-        assert m, out[-1500:]
-        losses[int(m.group(1))] = eval(m.group(2))  # noqa: S307
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=480)
+            assert p.returncode == 0, out[-2000:]
+            rank, ls = _parse(marker, out)
+            losses[rank] = ls
+    finally:
+        # a failed/timed-out rank must not leave its sibling orphaned on
+        # the rendezvous port
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
     return losses
 
 
-class TestMultiProcessSPMD:
-    @pytest.mark.timeout(600)
-    def test_two_process_dp_matches_serial(self):
-        port = 12513
-        env = {k: v for k, v in os.environ.items()
-               if not k.startswith(("PADDLE_", "RANK", "WORLD_SIZE",
-                                    "MASTER_"))}
-        procs = [
-            subprocess.Popen(
-                [sys.executable, "-m", "paddle_tpu.distributed.launch",
-                 "--nnodes", "2", "--master", f"localhost:{port}",
-                 "--rank", str(r), _COMPANION],
-                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-                text=True, cwd=_REPO, env=env)
-            for r in (0, 1)
-        ]
-        outs = []
-        for p in procs:
-            out, _ = p.communicate(timeout=480)
-            outs.append(out)
-            assert p.returncode == 0, out[-2000:]
-        losses = {}
-        for out in outs:
-            m = re.search(r"MP_LOSSES (\d) (\[.*\])", out)
-            assert m, out[-1500:]
-            losses[int(m.group(1))] = eval(m.group(2))  # noqa: S307
-        assert set(losses) == {0, 1}
-        # both ranks observed the same global loss (real cross-process psum)
-        assert losses[0] == losses[1], losses
-        # and the distributed run equals the serial 8-device run
-        serial = _serial_losses()
-        np.testing.assert_allclose(losses[0], serial, rtol=1e-4, atol=1e-5)
-        # training actually progressed
-        assert losses[0][-1] < losses[0][0]
+def _run_serial(companion, marker):
+    """The SAME companion, single process, 8 local devices (MP_SERIAL=1)."""
+    env = dict(_clean_env(), MP_SERIAL="1", JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run([sys.executable, companion], capture_output=True,
+                       text=True, timeout=600, cwd=_REPO, env=env)
+    assert r.returncode == 0, (r.stdout + r.stderr)[-2000:]
+    _, ls = _parse(marker, r.stdout)
+    return ls
 
-    @pytest.mark.timeout(600)
+
+def _check(companion, port, marker):
+    losses = _run_two_process(_companion(companion), port, marker)
+    assert set(losses) == {0, 1}
+    # both ranks observed the same global loss (real cross-process psum)
+    assert losses[0] == losses[1], losses
+    # and the distributed run equals the serial 8-device run
+    serial = _run_serial(_companion(companion), marker)
+    np.testing.assert_allclose(losses[0], serial, rtol=1e-4, atol=1e-5)
+    # training actually progressed
+    assert losses[0][-1] < losses[0][0]
+
+
+class TestMultiProcessSPMD:
+    def test_two_process_dp_matches_serial(self):
+        _check("mp_dp_train.py", 12513, "MP_LOSSES")
+
+    def test_two_process_tensor_parallel_matches_serial(self):
+        """Column/RowParallelLinear over an mp=8 axis spanning both
+        processes: the row-parallel psum and column-backward all-reduce
+        cross the process boundary."""
+        _check("mp_tp_train.py", 12541, "MP_TP_LOSSES")
+
     def test_two_process_pipeline_matches_serial(self):
         """The compiled ppermute pipeline schedule with stage handoffs
         CROSSING the process boundary (pp=4 x dp=2 over 2 processes)."""
-        companion = os.path.join(os.path.dirname(__file__), "companions",
-                                 "mp_pp_train.py")
-        losses = _run_two_process(companion, 12533, "MP_PP_LOSSES")
-        assert losses[0] == losses[1], losses
-        serial = _serial_pp_losses()
-        np.testing.assert_allclose(losses[0], serial, rtol=1e-4, atol=1e-5)
-        assert losses[0][-1] < losses[0][0]
-
-
-def _serial_pp_losses():
-    code = r"""
-import os
-os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
-import jax
-jax.config.update("jax_platforms", "cpu")
-import numpy as np
-import paddle_tpu as paddle
-import paddle_tpu.distributed as dist
-import paddle_tpu.nn as nn
-from paddle_tpu.distributed.fleet.meta_parallel import (LayerDesc,
-    PipelineLayer, PipelineParallel)
-H = 16
-class Block(nn.Layer):
-    def __init__(self):
-        super().__init__()
-        self.fc = nn.Linear(H, H)
-    def forward(self, x):
-        return paddle.tanh(self.fc(x))
-hcg = dist.create_hybrid_communicate_group(dp=2, pp=4)
-paddle.seed(0)
-pl = PipelineLayer([LayerDesc(nn.Linear, 8, H)] +
-                   [LayerDesc(Block) for _ in range(2)] +
-                   [LayerDesc(nn.Linear, H, 4)],
-                   loss_fn=lambda o, y: nn.functional.mse_loss(o, y))
-runner = PipelineParallel(pl, hcg, {"accumulate_steps": 4})
-opt = paddle.optimizer.Momentum(learning_rate=0.05, parameters=pl.parameters())
-rng = np.random.RandomState(0)
-X = rng.randn(16, 8).astype(np.float32)
-Y = rng.randn(16, 4).astype(np.float32)
-losses = []
-for _ in range(3):
-    losses.append(round(float(runner.train_batch(
-        (paddle.to_tensor(X), paddle.to_tensor(Y)), opt)), 6))
-print("SERIAL_PP", losses)
-"""
-    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
-                       text=True, timeout=600,
-                       env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=_REPO)
-    assert r.returncode == 0, r.stderr[-2000:]
-    m = re.search(r"SERIAL_PP (\[.*\])", r.stdout)
-    return eval(m.group(1))  # noqa: S307
+        _check("mp_pp_train.py", 12533, "MP_PP_LOSSES")
