@@ -403,23 +403,62 @@ def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
     return all_gather(gather_list, tensor, group=group, sync_op=sync_op)
 
 
+def _bcast_object_multiprocess(obj, src_process):
+    """Ship an arbitrary picklable object from one process to all others:
+    pickle → uint8 array → multihost_utils.broadcast_one_to_all (length
+    first, then the payload, so shapes agree on every process)."""
+    import pickle
+
+    import jax
+    import numpy as _np
+    from jax.experimental import multihost_utils as mhu
+
+    is_src = jax.process_index() == src_process
+    if is_src:
+        buf = _np.frombuffer(pickle.dumps(obj), dtype=_np.uint8).copy()
+        n = _np.asarray(buf.shape[0], dtype=_np.int64)
+    else:
+        buf = None
+        n = _np.zeros((), dtype=_np.int64)
+    n = int(mhu.broadcast_one_to_all(n, is_source=is_src))
+    if buf is None:
+        buf = _np.zeros((n,), dtype=_np.uint8)
+    buf = _np.asarray(mhu.broadcast_one_to_all(buf, is_source=is_src))
+    return pickle.loads(buf.tobytes())
+
+
 def broadcast_object_list(object_list, src=0, group=None):
-    """Single-process SPMD: every rank already holds identical Python
-    objects (one controller process drives all devices); multi-host uses
-    jax.experimental.multihost_utils.broadcast_one_to_all."""
-    # one-controller SPMD: every rank reads the same host objects, so the
-    # broadcast is already done; multi-host (one controller per host) would
-    # route through jax.experimental.multihost_utils.broadcast_one_to_all
+    """Object broadcast. Single controller: every rank already reads the
+    same host objects, so this is a no-op. Multi-process: the src process's
+    list is pickled through the coordination service
+    (jax.experimental.multihost_utils.broadcast_one_to_all) so every
+    process ends up with identical objects."""
     _resolve(group)
+    import jax
+
+    if jax.process_count() > 1:
+        object_list[:] = _bcast_object_multiprocess(list(object_list), src)
     return None
 
 
 def scatter_object_list(out_object_list, in_object_list=None, src=0,
                         group=None):
-    """One-controller SPMD analog of scatter_object_list: rank r's slot is
-    in_object_list[r]; with a single controller every rank sees the full
-    list, so the local slot is selected by rank."""
+    """Scatter Python objects: rank r receives in_object_list[r]. Single
+    controller: every rank sees the full list and selects its slot.
+    Multi-process: the full list broadcasts from src (non-src processes
+    pass in_object_list=None, per the reference contract), then each
+    process keeps its own slot."""
     group = _resolve(group)
+    import jax
+
+    if jax.process_count() > 1:
+        full = _bcast_object_multiprocess(in_object_list, src)
+        if not full:
+            raise ValueError("src rank must provide in_object_list")
+        rank = jax.process_index()
+        out_object_list.clear()
+        out_object_list.append(full[rank % len(full)])
+        return None
     if in_object_list is None:
         raise ValueError("src rank must provide in_object_list")
     rank = group.rank if hasattr(group, "rank") else 0

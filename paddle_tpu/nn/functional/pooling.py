@@ -23,15 +23,27 @@ def _pool(x, kernel, stride, padding, n, data_format, reducer, init, ceil_mode=F
 
     def f(a):
         spatial_pads = pad_cfg
-        if ceil_mode and not isinstance(pad_cfg, str):
-            # extend the high pad so partial windows at the end survive
-            # (reference ceil_mode: out = ceil((L + pl + pr - k)/s) + 1)
+        out_sp = None
+        if not isinstance(pad_cfg, str):
+            # Reference ceil_mode: out = ceil((L + pl + pr - k)/s) + 1, then
+            # decrement whenever the last window would start entirely inside
+            # the right padding ((out-1)*s >= L + pl). Pad to exactly the
+            # length those windows need and trim any surplus below.
             spatial = a.shape[1:-1] if channel_last else a.shape[2:]
             spatial_pads = []
+            out_sp = []
             for i, (pl, pr) in enumerate(pad_cfg):
-                num = spatial[i] + pl + pr - kernel[i]
-                extra = (-num) % stride[i] if num % stride[i] else 0
-                spatial_pads.append((pl, pr + extra))
+                L = spatial[i]
+                num = L + pl + pr - kernel[i]
+                if ceil_mode:
+                    osz = -(-num // stride[i]) + 1
+                    if (osz - 1) * stride[i] >= L + pl:
+                        osz -= 1
+                else:
+                    osz = num // stride[i] + 1
+                need_pr = (osz - 1) * stride[i] + kernel[i] - L - pl
+                spatial_pads.append((pl, max(0, need_pr)))
+                out_sp.append(osz)
         if channel_last:
             dims = (1,) + kernel + (1,)
             strides = (1,) + stride + (1,)
@@ -50,6 +62,11 @@ def _pool(x, kernel, stride, padding, n, data_format, reducer, init, ceil_mode=F
                 out = out / counts
             else:
                 out = out / float(np.prod(kernel))
+        if out_sp is not None:
+            for i, osz in enumerate(out_sp):
+                ax = (1 + i) if channel_last else (2 + i)
+                if out.shape[ax] != osz:
+                    out = lax.slice_in_dim(out, 0, osz, axis=ax)
         return out
 
     return apply(f, _as_t(x), _op_name=("avg_pool" if average else "max_pool") + f"{n}d")
@@ -162,7 +179,10 @@ def _max_pool_with_mask(x, kernel, stride, padding, n, channel_last,
         def _osz(i):
             num = spatial[i] + pad[i][0] + pad[i][1] - kernel[i]
             if ceil_mode:
-                return -(-num // stride[i]) + 1
+                osz = -(-num // stride[i]) + 1
+                if (osz - 1) * stride[i] >= spatial[i] + pad[i][0]:
+                    osz -= 1
+                return osz
             return num // stride[i] + 1
         out_sp = tuple(_osz(i) for i in range(n))
         # coords[d]: [out_d, k_d] input coordinate along dim d

@@ -12,7 +12,9 @@ from ...tensor.creation import _as_t
 
 
 def _norm_except(v, dim):
-    axes = tuple(i for i in range(v.ndim) if i != dim)
+    # dim=None: reference semantics are a single norm over EVERY axis
+    # (scalar g), not per-slice along axis 0
+    axes = tuple(i for i in range(v.ndim) if dim is None or i != dim)
     return jnp.sqrt(jnp.sum(jnp.square(v), axis=axes, keepdims=True))
 
 
@@ -21,8 +23,6 @@ class _WeightNormHook:
         self.name = name
         self.dim = dim
         w = getattr(layer, name)
-        dim = dim if dim is not None else 0
-        self.dim = dim
         g = Parameter(np.asarray(_norm_except(w._data, dim)))
         v = Parameter(np.asarray(w._data))
         layer.add_parameter(name + "_g", g)

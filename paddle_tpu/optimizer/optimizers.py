@@ -260,27 +260,39 @@ class Rprop(Optimizer):
 
 
 class ASGD(Optimizer):
-    """Averaged SGD (ref: python/paddle/optimizer/asgd.py (U)): plain SGD
-    steps plus a running average of the iterates."""
+    """Averaged SGD (ref: python/paddle/optimizer/asgd.py (U)): each step
+    applies the mean of the last ``batch_num`` gradients, tracked with a
+    running sum ``d`` plus a circular buffer ``y`` of the contributing
+    gradients (the reference's d/y accumulator scheme)."""
 
     def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
                  weight_decay=None, grad_clip=None, multi_precision=False,
                  name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          name)
+        self._batch_num = max(1, int(batch_num))
 
     def _init_state(self, p):
+        shape = tuple(p._data.shape)
         return {
-            "avg": p._data.astype(jnp.float32),
-            "count": jnp.zeros((), jnp.float32),
+            "d": jnp.zeros(shape, jnp.float32),
+            "y": jnp.zeros((self._batch_num,) + shape, jnp.float32),
+            "step": jnp.zeros((), jnp.float32),
         }
 
     def _update(self, param, grad, state, lr):
-        grad = _apply_l2(grad, param, self._cur_wd)
-        p32 = param.astype(jnp.float32) - lr * grad.astype(jnp.float32)
-        cnt = state["count"] + 1.0
-        avg = state["avg"] + (p32 - state["avg"]) / cnt
-        return p32.astype(param.dtype), {"avg": avg, "count": cnt}
+        from jax import lax
+        g32 = _apply_l2(grad, param, self._cur_wd).astype(jnp.float32)
+        n = state["y"].shape[0]
+        idx = jnp.mod(state["step"], float(n)).astype(jnp.int32)
+        oldest = lax.dynamic_index_in_dim(state["y"], idx, keepdims=False)
+        d = state["d"] - oldest + g32
+        y = lax.dynamic_update_index_in_dim(
+            state["y"], g32[None], idx, axis=0)
+        count = jnp.minimum(state["step"] + 1.0, float(n))
+        p32 = param.astype(jnp.float32) - lr * d / count
+        return p32.astype(param.dtype), {"d": d, "y": y,
+                                         "step": state["step"] + 1.0}
 
 
 class NAdam(Adam):
@@ -298,6 +310,7 @@ class NAdam(Adam):
     def _init_state(self, p):
         st = super()._init_state(p)
         st["mu_product"] = jnp.ones((), jnp.float32)
+        st["step"] = jnp.zeros((), jnp.float32)
         return st
 
     def _update(self, param, grad, state, lr):
@@ -305,9 +318,9 @@ class NAdam(Adam):
         g32 = grad.astype(jnp.float32)
         if self._cur_wd:
             g32 = g32 + self._cur_wd * p32
-        # step count recovered from the beta2 power (exact in f32 range)
-        step = jnp.round(jnp.log(state["beta2_pow"] * self._beta2)
-                         / jnp.log(self._beta2))
+        # explicit f32 step counter: recovering it from beta2_pow underflows
+        # to step=inf once beta2_pow hits f32 zero (~88k steps at beta2=.999)
+        step = state["step"] + 1.0
         mu_t = self._beta1 * (1 - 0.5 * 0.96 ** (step * self._psi))
         mu_t1 = self._beta1 * (1 - 0.5 * 0.96 ** ((step + 1) * self._psi))
         mu_prod = state["mu_product"] * mu_t
@@ -321,7 +334,7 @@ class NAdam(Adam):
         new_state = dict(
             state, moment1=m1, moment2=m2,
             beta1_pow=state["beta1_pow"] * self._beta1, beta2_pow=b2p,
-            mu_product=mu_prod)
+            mu_product=mu_prod, step=step)
         if "master_weight" in state:
             new_state["master_weight"] = p32
         return p32.astype(param.dtype), new_state
@@ -329,6 +342,11 @@ class NAdam(Adam):
 
 class RAdam(Adam):
     """Rectified Adam (ref: python/paddle/optimizer/radam.py (U))."""
+
+    def _init_state(self, p):
+        st = super()._init_state(p)
+        st["step"] = jnp.zeros((), jnp.float32)
+        return st
 
     def _update(self, param, grad, state, lr):
         p32 = state.get("master_weight", param).astype(jnp.float32)
@@ -339,7 +357,10 @@ class RAdam(Adam):
         b2p = state["beta2_pow"] * self._beta2
         m1 = self._beta1 * state["moment1"] + (1 - self._beta1) * g32
         m2 = self._beta2 * state["moment2"] + (1 - self._beta2) * jnp.square(g32)
-        step = jnp.round(jnp.log(b2p) / jnp.log(self._beta2))
+        # explicit f32 step counter (see NAdam): log(b2p) blows up once
+        # beta2_pow underflows, sending rho_t to NaN and silently pinning
+        # the un-rectified branch for the rest of training
+        step = state["step"] + 1.0
         rho_inf = 2.0 / (1 - self._beta2) - 1.0
         rho_t = rho_inf - 2.0 * step * b2p / (1 - b2p)
         m1_hat = m1 / (1 - b1p)
@@ -351,7 +372,7 @@ class RAdam(Adam):
         upd = jnp.where(rho_t > 5.0, adaptive, sgd_like)
         p32 = p32 - lr * upd
         new_state = dict(state, moment1=m1, moment2=m2, beta1_pow=b1p,
-                         beta2_pow=b2p)
+                         beta2_pow=b2p, step=step)
         if "master_weight" in state:
             new_state["master_weight"] = p32
         return p32.astype(param.dtype), new_state

@@ -209,6 +209,20 @@ class TestNnUtils:
         np.testing.assert_allclose(lin.weight.numpy(), w0, rtol=1e-5, atol=1e-6)
         np.testing.assert_allclose(lin(x).numpy(), y1, rtol=1e-5, atol=1e-6)
 
+    def test_weight_norm_dim_none_scalar_g(self):
+        # dim=None: one norm over EVERY axis (scalar g), not per-row
+        from paddle_tpu.nn.utils import weight_norm
+
+        lin = nn.Linear(4, 3)
+        w0 = np.asarray(lin.weight._data).copy()
+        weight_norm(lin, dim=None)
+        g = np.asarray(lin.weight_g._data)
+        assert g.size == 1
+        np.testing.assert_allclose(float(g.reshape(())),
+                                   np.linalg.norm(w0), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(lin.weight._data), w0,
+                                   rtol=1e-5)
+
     def test_weight_norm_grads(self):
         from paddle_tpu.nn.utils import weight_norm
 
@@ -307,3 +321,33 @@ class TestPoolCeilMode:
         om, mask = F.max_pool2d(x, 3, stride=2, ceil_mode=True,
                                 return_mask=True)
         np.testing.assert_allclose(om.numpy(), out.numpy())
+
+    def test_ceil_mode_no_window_in_right_padding(self):
+        # torch/reference rule: decrement the ceil output size whenever the
+        # last window would start entirely inside the right padding.
+        # k2 s2 p1 on 5x5: naive ceil gives 4x4 (with a -inf / 0-count
+        # window); the reference answer is 3x3.
+        import torch
+        import torch.nn.functional as TF
+
+        rng = np.random.RandomState(1)
+        for L, k, s, p in [(5, 2, 2, 1), (5, 3, 2, 1), (6, 4, 3, 2),
+                           (5, 2, 3, 1), (9, 5, 4, 2)]:
+            x = rng.randn(2, 3, L, L).astype(np.float32)
+            tm = TF.max_pool2d(torch.tensor(x), k, s, p,
+                               ceil_mode=True).numpy()
+            om = F.max_pool2d(paddle.to_tensor(x), k, s, p,
+                              ceil_mode=True).numpy()
+            np.testing.assert_allclose(om, tm, err_msg=f"{(L, k, s, p)}")
+            ta = TF.avg_pool2d(torch.tensor(x), k, s, p, ceil_mode=True,
+                               count_include_pad=False).numpy()
+            oa = F.avg_pool2d(paddle.to_tensor(x), k, s, p,
+                              ceil_mode=True, exclusive=True).numpy()
+            np.testing.assert_allclose(oa, ta, rtol=1e-6,
+                                       err_msg=f"{(L, k, s, p)}")
+            tm2, ti = TF.max_pool2d(torch.tensor(x), k, s, p,
+                                    ceil_mode=True, return_indices=True)
+            om2, oi = F.max_pool2d(paddle.to_tensor(x), k, s, p,
+                                   ceil_mode=True, return_mask=True)
+            np.testing.assert_allclose(om2.numpy(), tm2.numpy())
+            np.testing.assert_array_equal(oi.numpy(), ti.numpy())

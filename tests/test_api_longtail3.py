@@ -35,12 +35,43 @@ class TestNewOptimizers:
         assert final < 0.8, final
 
     def test_asgd_average_tracks(self):
-        p = paddle.Parameter(np.array([1.0], np.float32))
-        opt = paddle.optimizer.ASGD(learning_rate=0.0, parameters=[p])
-        p.grad = paddle.to_tensor(np.array([0.0], np.float32))
+        # reference d/y scheme: each step applies the mean of the last
+        # batch_num gradients (circular buffer), count saturating at n
+        p = paddle.Parameter(np.array([0.0], np.float32))
+        opt = paddle.optimizer.ASGD(learning_rate=1.0, batch_num=3,
+                                    parameters=[p])
+        grads = [1.0, 2.0, 3.0, 4.0]
+        expect = 0.0
+        window = []
+        for g in grads:
+            p.grad = paddle.to_tensor(np.array([g], np.float32))
+            opt.step()
+            window = (window + [g])[-3:]
+            expect -= sum(window) / len(window)
+            np.testing.assert_allclose(np.asarray(p._data), [expect],
+                                       rtol=1e-6)
+
+    @pytest.mark.parametrize("cls", ["NAdam", "RAdam"])
+    def test_nadam_radam_survive_late_steps(self, cls):
+        # beta2_pow underflows to f32 zero around step ~88k (beta2=0.999);
+        # the step counter must be explicit state, not recovered from the
+        # log of the power, or RAdam's rho_t becomes NaN forever
+        p = paddle.Parameter(np.array([1.0, -2.0], np.float32))
+        opt = getattr(paddle.optimizer, cls)(learning_rate=0.01,
+                                             parameters=[p])
+        p.grad = paddle.to_tensor(np.array([0.1, -0.1], np.float32))
         opt.step()
         st = opt._accumulators[id(p)]
-        np.testing.assert_allclose(np.asarray(st["avg"]), [1.0])
+        import jax.numpy as jnp
+        st["beta2_pow"] = jnp.zeros((), jnp.float32)   # underflowed
+        st["beta1_pow"] = jnp.zeros((), jnp.float32)
+        st["step"] = jnp.asarray(100000.0, jnp.float32)
+        before = np.asarray(p._data).copy()
+        opt.step()
+        after = np.asarray(p._data)
+        assert np.all(np.isfinite(after))
+        assert not np.allclose(after, before)
+        assert float(st["step"]) == 100000.0  # state dict rebind check
 
     def test_lbfgs_rosenbrock_ish(self):
         paddle.seed(0)
