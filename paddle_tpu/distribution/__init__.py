@@ -636,3 +636,129 @@ class Independent(Distribution):
     def entropy(self):
         e = _arr(self.base.entropy())
         return Tensor(jnp.sum(e, axis=tuple(range(-self.rank, 0))))
+
+
+class MultivariateNormal(Distribution):
+    """Multivariate normal over R^k (ref: distribution/multivariate_normal.py
+    (U)). Parameterized by exactly one of covariance_matrix /
+    precision_matrix / scale_tril; everything routes through the Cholesky
+    factor L (cov = L L^T), which is both the numerically stable and the
+    MXU-friendly form (triangular solves + one matmul per sample)."""
+
+    @staticmethod
+    def _to_tril(mat, kind):
+        if kind == "tril":
+            return mat
+        if kind == "cov":
+            return jnp.linalg.cholesky(mat)
+        # chol(P) = lower factor of the precision; cov factor is recovered
+        # from its inverse: cov = inv(P) = inv_lp^T inv_lp
+        lp = jnp.linalg.cholesky(mat)
+        eye = jnp.eye(lp.shape[-1], dtype=lp.dtype)
+        inv_lp = jax.scipy.linalg.solve_triangular(lp, eye, lower=True)
+        return jnp.linalg.cholesky(jnp.swapaxes(inv_lp, -1, -2) @ inv_lp)
+
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None, name=None):
+        given = [a is not None
+                 for a in (covariance_matrix, precision_matrix, scale_tril)]
+        if sum(given) != 1:
+            raise ValueError(
+                "exactly one of covariance_matrix, precision_matrix, "
+                "scale_tril must be specified")
+        # originals kept as Tensors so rsample can trace through them
+        # (pathwise/reparameterized gradients reach loc and the matrix)
+        self._loc_in = _as_t(loc)
+        if scale_tril is not None:
+            self._mat_in, self._mat_kind = _as_t(scale_tril), "tril"
+        elif covariance_matrix is not None:
+            self._mat_in, self._mat_kind = _as_t(covariance_matrix), "cov"
+        else:
+            self._mat_in, self._mat_kind = _as_t(precision_matrix), "prec"
+        self.loc = _arr(loc).astype(jnp.float32)
+        self.scale_tril = self._to_tril(
+            _arr(self._mat_in).astype(jnp.float32), self._mat_kind)
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(
+            self.loc, jnp.broadcast_shapes(
+                self.loc.shape, self.scale_tril.shape[:-1])))
+
+    @property
+    def covariance_matrix(self):
+        return Tensor(self.scale_tril
+                      @ jnp.swapaxes(self.scale_tril, -1, -2))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(
+            jnp.sum(jnp.square(self.scale_tril), axis=-1),
+            jnp.broadcast_shapes(self.loc.shape,
+                                 self.scale_tril.shape[:-1])))
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        batch = jnp.broadcast_shapes(self.loc.shape[:-1],
+                                     self.scale_tril.shape[:-2])
+        k = self.loc.shape[-1]
+        full = tuple(shape) + batch + (k,)
+        z = jax.random.normal(random_state.next_key(), full)
+        kind = self._mat_kind
+
+        def f(locv, matv):
+            tril = MultivariateNormal._to_tril(
+                matv.astype(jnp.float32), kind)
+            return locv.astype(jnp.float32) \
+                + jnp.squeeze(tril @ z[..., None], -1)
+
+        return apply(f, self._loc_in, self._mat_in, _op_name="mvn_rsample")
+
+    def log_prob(self, value):
+        v = _arr(value).astype(jnp.float32)
+        k = self.loc.shape[-1]
+        diff = v - self.loc
+        # solve L y = diff; M = ||y||^2 is the Mahalanobis distance
+        y = jax.scipy.linalg.solve_triangular(
+            jnp.broadcast_to(
+                self.scale_tril,
+                jnp.broadcast_shapes(self.scale_tril.shape,
+                                     diff.shape[:-1] + (k, k))),
+            diff[..., None], lower=True)[..., 0]
+        m = jnp.sum(jnp.square(y), axis=-1)
+        half_logdet = jnp.sum(jnp.log(
+            jnp.diagonal(self.scale_tril, axis1=-2, axis2=-1)), axis=-1)
+        return Tensor(-0.5 * (m + k * math.log(2 * math.pi)) - half_logdet)
+
+    def entropy(self):
+        k = self.loc.shape[-1]
+        half_logdet = jnp.sum(jnp.log(
+            jnp.diagonal(self.scale_tril, axis1=-2, axis2=-1)), axis=-1)
+        batch = jnp.broadcast_shapes(self.loc.shape[:-1],
+                                     self.scale_tril.shape[:-2])
+        return Tensor(jnp.broadcast_to(
+            0.5 * k * (1 + math.log(2 * math.pi)) + half_logdet, batch))
+
+
+@register_kl(MultivariateNormal, MultivariateNormal)
+def _kl_mvn_mvn(p, q):
+    k = p.loc.shape[-1]
+    lq = q.scale_tril
+    lp = p.scale_tril
+    eye_bcast = jnp.broadcast_shapes(lq.shape, lp.shape)
+    # tr(Sigma_q^-1 Sigma_p) = ||Lq^-1 Lp||_F^2
+    a = jax.scipy.linalg.solve_triangular(
+        jnp.broadcast_to(lq, eye_bcast), jnp.broadcast_to(lp, eye_bcast),
+        lower=True)
+    tr = jnp.sum(jnp.square(a), axis=(-2, -1))
+    diff = q.loc - p.loc
+    y = jax.scipy.linalg.solve_triangular(
+        jnp.broadcast_to(lq, jnp.broadcast_shapes(
+            lq.shape, diff.shape[:-1] + (k, k))),
+        diff[..., None], lower=True)[..., 0]
+    m = jnp.sum(jnp.square(y), axis=-1)
+    hld_p = jnp.sum(jnp.log(jnp.diagonal(lp, axis1=-2, axis2=-1)), axis=-1)
+    hld_q = jnp.sum(jnp.log(jnp.diagonal(lq, axis1=-2, axis2=-1)), axis=-1)
+    return Tensor(0.5 * (tr + m - k) + hld_q - hld_p)

@@ -9,6 +9,7 @@ reference so incubate users can switch without edits.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ...core.op_call import apply
@@ -188,5 +189,46 @@ def masked_multihead_attention(*args, **kwargs):
     raise NotImplementedError("use F.scaled_dot_product_attention with a mask")
 
 
-def fused_ec_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias, act_type="gelu"):
-    raise NotImplementedError("MoE lands with distributed.moe (expert-parallel layer)")
+def swiglu(x, y=None, name=None):
+    """SwiGLU gate (ref: incubate/nn/functional/swiglu.py (U)): silu(x) * y;
+    with y=None, x is split in half along the last axis. One fused XLA
+    kernel — the same composition the LLaMA models here train with."""
+    x = _as_t(x)
+    if y is None:
+        from ...tensor.manipulation import chunk
+
+        x, y = chunk(x, 2, axis=-1)
+    else:
+        y = _as_t(y)
+    return apply(lambda a, b: jax.nn.silu(a) * b, x, y, _op_name="swiglu")
+
+
+def fused_ec_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias,
+                 act_type="gelu"):
+    """Dense expert-computation MoE (ref: incubate fused_ec_moe (U)):
+    out[t] = sum_e softmax(gate[t])_e * FFN_e(x[t]). Every token visits
+    every expert — the einsum batches all expert FFNs into two large MXU
+    matmuls; no scatter/gather kernels needed on TPU."""
+    if act_type not in ("gelu", "relu", "silu"):
+        raise ValueError(
+            f"fused_ec_moe: unsupported act_type {act_type!r} "
+            "(expected 'gelu', 'relu' or 'silu')")
+    x = _as_t(x)
+    gate = _as_t(gate)
+    w0, b0 = _as_t(bmm0_weight), _as_t(bmm0_bias)
+    w1, b1 = _as_t(bmm1_weight), _as_t(bmm1_bias)
+
+    def f(xv, gv, w0v, b0v, w1v, b1v):
+        act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu,
+               "silu": jax.nn.silu}[act_type]
+        # reference bias shape is [e, 1, f]; flatten to [e, f] so it
+        # broadcasts against the expert axis, not sequence
+        b0f = b0v.reshape(b0v.shape[0], b0v.shape[-1])
+        b1f = b1v.reshape(b1v.shape[0], b1v.shape[-1])
+        probs = jax.nn.softmax(gv, axis=-1)             # [b, s, e]
+        h = jnp.einsum("bsd,edf->bsef", xv, w0v) + b0f  # [b, s, e, f]
+        h = act(h)
+        o = jnp.einsum("bsef,efd->bsed", h, w1v) + b1f  # [b, s, e, d]
+        return jnp.einsum("bsed,bse->bsd", o, probs)
+
+    return apply(f, x, gate, w0, b0, w1, b1, _op_name="fused_ec_moe")

@@ -1,5 +1,5 @@
 from .optimizer import Optimizer
-from .optimizers import (SGD, Momentum, Adagrad, RMSProp, Adam, AdamW,
-                         Adamax, Lamb, Rprop, ASGD, NAdam, RAdam)
+from .optimizers import (SGD, Momentum, Adagrad, Adadelta, RMSProp, Adam,
+                         AdamW, Adamax, Lamb, Rprop, ASGD, NAdam, RAdam)
 from .lbfgs import LBFGS
 from . import lr

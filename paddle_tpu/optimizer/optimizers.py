@@ -59,6 +59,43 @@ class Adagrad(Optimizer):
         return param - lr * grad / (jnp.sqrt(m) + self._epsilon), {"moment": m}
 
 
+class Adadelta(Optimizer):
+    """Adadelta (ref: python/paddle/optimizer/adadelta.py (U)): step size
+    from the ratio of running RMS of updates to running RMS of grads."""
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._epsilon = epsilon
+        self._rho = rho
+        self._multi_precision = multi_precision
+
+    def _init_state(self, p):
+        st = {
+            "avg_squared_grad": jnp.zeros(p._data.shape, jnp.float32),
+            "avg_squared_update": jnp.zeros(p._data.shape, jnp.float32),
+        }
+        if self._multi_precision and p._data.dtype != jnp.float32:
+            st["master_weight"] = p._data.astype(jnp.float32)
+        return st
+
+    def _update(self, param, grad, state, lr):
+        g32 = _apply_l2(grad, param, self._cur_wd).astype(jnp.float32)
+        eg = self._rho * state["avg_squared_grad"] \
+            + (1 - self._rho) * jnp.square(g32)
+        upd = -jnp.sqrt((state["avg_squared_update"] + self._epsilon)
+                        / (eg + self._epsilon)) * g32
+        eu = self._rho * state["avg_squared_update"] \
+            + (1 - self._rho) * jnp.square(upd)
+        p32 = state.get("master_weight", param).astype(jnp.float32) + lr * upd
+        new_state = {"avg_squared_grad": eg, "avg_squared_update": eu}
+        if "master_weight" in state:
+            new_state["master_weight"] = p32
+        return p32.astype(param.dtype), new_state
+
+
 class RMSProp(Optimizer):
     def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
                  centered=False, parameters=None, weight_decay=None, grad_clip=None, name=None):

@@ -29,6 +29,7 @@ from .math import (
     equal_all, increment, multiplex, bincount, trapezoid,
     cumulative_trapezoid, vander, logcumsumexp, frexp, renorm, i0e, i1, i1e,
     polygamma, logit, signbit, positive, dist, inverse, combinations,
+    gammaln, gammainc, gammaincc,
 )
 from .manipulation import (
     reshape, reshape_, transpose, t, moveaxis, swapaxes, flatten, squeeze,

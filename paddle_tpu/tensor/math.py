@@ -270,6 +270,20 @@ def digamma(x, name=None):
     return _unary(jax.scipy.special.digamma, x, "digamma")
 
 
+def gammaln(x, name=None):
+    return _unary(jax.scipy.special.gammaln, x, "gammaln")
+
+
+def gammainc(x, y, name=None):
+    """Regularized lower incomplete gamma P(x, y) (ref: paddle.gammainc)."""
+    return _binary(jax.scipy.special.gammainc, x, y, "gammainc")
+
+
+def gammaincc(x, y, name=None):
+    """Regularized upper incomplete gamma Q(x, y) (ref: paddle.gammaincc)."""
+    return _binary(jax.scipy.special.gammaincc, x, y, "gammaincc")
+
+
 def i0(x, name=None):
     return _unary(jnp.i0, x)
 

@@ -22,6 +22,29 @@ class TestRootOps:
                                    sp.polygamma(1, x).astype(np.float32),
                                    rtol=1e-4)
 
+    def test_gamma_family(self):
+        from scipy import special as sp
+
+        x = np.linspace(0.2, 4.0, 9).astype(np.float32)
+        a = np.linspace(0.5, 3.0, 9).astype(np.float32)
+        tx, ta = paddle.to_tensor(x), paddle.to_tensor(a)
+        np.testing.assert_allclose(paddle.gammaln(tx).numpy(),
+                                   sp.gammaln(x), rtol=1e-5)
+        np.testing.assert_allclose(paddle.gammainc(ta, tx).numpy(),
+                                   sp.gammainc(a, x), rtol=1e-5)
+        np.testing.assert_allclose(paddle.gammaincc(ta, tx).numpy(),
+                                   sp.gammaincc(a, x), rtol=1e-5)
+        # P + Q = 1, tensor-method form, and a grad through gammainc (d/dx
+        # of P(a, x) is the gamma pdf)
+        np.testing.assert_allclose(
+            (ta.gammainc(tx) + ta.gammaincc(tx)).numpy(),
+            np.ones_like(x), rtol=1e-6)
+        tx2 = paddle.to_tensor(x)
+        tx2.stop_gradient = False
+        paddle.gammainc(ta, tx2).sum().backward()
+        pdf = np.exp(-x) * x ** (a - 1) / sp.gamma(a)
+        np.testing.assert_allclose(tx2.grad.numpy(), pdf, rtol=1e-4)
+
     def test_logit_signbit_positive(self):
         p = np.array([0.1, 0.5, 0.9], np.float32)
         np.testing.assert_allclose(paddle.logit(paddle.to_tensor(p)).numpy(),

@@ -73,6 +73,76 @@ class TestNewOptimizers:
         assert not np.allclose(after, before)
         assert float(st["step"]) == 100000.0  # state dict rebind check
 
+    def test_adadelta_matches_torch(self):
+        import torch
+
+        w0 = np.array([1.0, -2.0, 0.5], np.float32)
+        p = paddle.Parameter(w0.copy())
+        opt = paddle.optimizer.Adadelta(learning_rate=0.7, rho=0.9,
+                                        epsilon=1e-6, parameters=[p])
+        tp = torch.nn.Parameter(torch.tensor(w0))
+        topt = torch.optim.Adadelta([tp], lr=0.7, rho=0.9, eps=1e-6)
+        rng = np.random.RandomState(0)
+        for _ in range(5):
+            g = rng.randn(3).astype(np.float32)
+            p.grad = paddle.to_tensor(g)
+            opt.step()
+            tp.grad = torch.tensor(g)
+            topt.step()
+            np.testing.assert_allclose(np.asarray(p._data),
+                                       tp.detach().numpy(), rtol=1e-5)
+
+    def test_adadelta_multi_precision_bf16(self):
+        # without a f32 master weight, sub-ulp bf16 updates round away
+        w0 = np.full(4, 100.0, np.float32)
+        p = paddle.Parameter(w0).astype("bfloat16")
+        p = paddle.Parameter(np.asarray(p._data))
+        opt = paddle.optimizer.Adadelta(learning_rate=1.0,
+                                        multi_precision=True,
+                                        parameters=[p])
+        st = None
+        for _ in range(20):
+            p.grad = paddle.to_tensor(np.full(4, 1.0, np.float32)
+                                      ).astype("bfloat16")
+            opt.step()
+            st = opt._accumulators[id(p)]
+        assert "master_weight" in st
+        master = np.asarray(st["master_weight"], np.float32)
+        assert np.all(master < 100.0)  # progress accumulated in f32
+
+    def test_swiglu_and_fused_ec_moe(self):
+        import paddle_tpu.incubate.nn.functional as IF
+
+        rng = np.random.RandomState(1)
+        x = rng.randn(2, 3, 8).astype(np.float32)
+        y = rng.randn(2, 3, 8).astype(np.float32)
+        tx, ty = paddle.to_tensor(x), paddle.to_tensor(y)
+        silu = x / (1 + np.exp(-x))
+        np.testing.assert_allclose(IF.swiglu(tx, ty).numpy(), silu * y,
+                                   rtol=1e-5)
+        # single-arg form splits in half
+        cat = np.concatenate([x, y], axis=-1)
+        np.testing.assert_allclose(IF.swiglu(paddle.to_tensor(cat)).numpy(),
+                                   silu * y, rtol=1e-5)
+        # fused_ec_moe vs a per-expert numpy reference
+        e, d, f = 4, 8, 16
+        gate = rng.randn(2, 3, e).astype(np.float32)
+        w0 = rng.randn(e, d, f).astype(np.float32) * 0.1
+        b0 = rng.randn(e, 1, f).astype(np.float32) * 0.1
+        w1 = rng.randn(e, f, d).astype(np.float32) * 0.1
+        b1 = rng.randn(e, 1, d).astype(np.float32) * 0.1
+        out = IF.fused_ec_moe(tx, paddle.to_tensor(gate),
+                              paddle.to_tensor(w0), paddle.to_tensor(b0),
+                              paddle.to_tensor(w1), paddle.to_tensor(b1),
+                              act_type="relu").numpy()
+        eg = np.exp(gate - gate.max(-1, keepdims=True))
+        probs = eg / eg.sum(-1, keepdims=True)
+        expect = np.zeros_like(x)
+        for ei in range(e):
+            h = np.maximum(x @ w0[ei] + b0[ei][0], 0.0)
+            expect += probs[..., ei:ei + 1] * (h @ w1[ei] + b1[ei][0])
+        np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
     def test_lbfgs_rosenbrock_ish(self):
         paddle.seed(0)
         p = paddle.Parameter(np.array([-1.0, 2.0], np.float32))

@@ -115,3 +115,80 @@ class TestBatchedDirichlet:
         np.testing.assert_allclose(s.sum(-1), 1.0, atol=1e-5)
         s2 = d.sample((5,)).numpy()
         assert s2.shape == (5, 2, 3)
+
+
+class TestMultivariateNormal:
+    def _params(self):
+        rng = np.random.RandomState(3)
+        loc = rng.randn(3).astype(np.float32)
+        a = rng.randn(3, 3).astype(np.float32)
+        cov = a @ a.T + 3.0 * np.eye(3, dtype=np.float32)
+        return loc, cov
+
+    def test_log_prob_vs_scipy(self):
+        loc, cov = self._params()
+        d = D.MultivariateNormal(_t(loc), covariance_matrix=_t(cov))
+        vals = np.random.RandomState(4).randn(5, 3).astype(np.float32)
+        ours = d.log_prob(_t(vals)).numpy()
+        expect = st.multivariate_normal(loc, cov).logpdf(vals)
+        np.testing.assert_allclose(ours, expect, rtol=1e-4, atol=1e-4)
+
+    def test_three_parameterizations_agree(self):
+        loc, cov = self._params()
+        v = _t(np.zeros(3, np.float32))
+        lp_cov = D.MultivariateNormal(_t(loc), covariance_matrix=_t(cov)
+                                      ).log_prob(v).numpy()
+        lp_tril = D.MultivariateNormal(
+            _t(loc), scale_tril=_t(np.linalg.cholesky(cov))
+        ).log_prob(v).numpy()
+        lp_prec = D.MultivariateNormal(
+            _t(loc), precision_matrix=_t(np.linalg.inv(cov))
+        ).log_prob(v).numpy()
+        np.testing.assert_allclose(lp_cov, lp_tril, rtol=1e-5)
+        np.testing.assert_allclose(lp_cov, lp_prec, rtol=1e-3, atol=1e-4)
+        with pytest.raises(ValueError):
+            D.MultivariateNormal(_t(loc))
+
+    def test_entropy_and_moments(self):
+        loc, cov = self._params()
+        d = D.MultivariateNormal(_t(loc), covariance_matrix=_t(cov))
+        np.testing.assert_allclose(d.entropy().numpy(),
+                                   st.multivariate_normal(loc, cov).entropy(),
+                                   rtol=1e-5)
+        paddle.seed(0)
+        s = d.sample((40000,)).numpy()
+        np.testing.assert_allclose(s.mean(0), loc, atol=0.05)
+        np.testing.assert_allclose(np.cov(s.T), cov, atol=0.2)
+        np.testing.assert_allclose(d.variance.numpy(), np.diag(cov),
+                                   rtol=1e-5)
+
+    def test_rsample_pathwise_gradients(self):
+        # rsample must backprop into loc and the covariance parameter
+        loc, cov = self._params()
+        tl = paddle.to_tensor(loc)
+        tc = paddle.to_tensor(cov)
+        tl.stop_gradient = False
+        tc.stop_gradient = False
+        d = D.MultivariateNormal(tl, covariance_matrix=tc)
+        paddle.seed(7)
+        s = d.rsample((16,))
+        (s.sum()).backward()
+        assert tl.grad is not None and tc.grad is not None
+        # d(sum)/d(loc_j) = n_samples exactly
+        np.testing.assert_allclose(tl.grad.numpy(),
+                                   np.full(3, 16.0, np.float32), rtol=1e-5)
+        assert np.any(np.abs(tc.grad.numpy()) > 0)
+
+    def test_kl_closed_form_vs_montecarlo(self):
+        loc, cov = self._params()
+        p = D.MultivariateNormal(_t(loc), covariance_matrix=_t(cov))
+        q = D.MultivariateNormal(_t(loc * 0.5),
+                                 covariance_matrix=_t(cov * 1.5))
+        kl = float(D.kl_divergence(p, q).numpy())
+        paddle.seed(1)
+        s = p.sample((60000,))
+        mc = float((p.log_prob(s).numpy() - q.log_prob(s).numpy()).mean())
+        assert abs(kl - mc) < 0.05 * max(1.0, abs(kl))
+        # self-KL is zero
+        np.testing.assert_allclose(float(D.kl_divergence(p, p).numpy()),
+                                   0.0, atol=1e-5)
