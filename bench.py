@@ -50,6 +50,18 @@ def kernel_smoke():
     rn_ref = np.asarray(x) / np.sqrt((np.asarray(x, np.float64) ** 2).mean(-1, keepdims=True) + 1e-6) * np.asarray(w)
     assert np.abs(rn - rn_ref).max() < 1e-3, "rms_norm kernel mismatch"
 
+    from paddle_tpu.ops.pallas.norms import group_norm
+    xg = jnp.asarray(rng.randn(2, 32, 16, 16), jnp.float32)
+    wg = jnp.asarray(rng.randn(32), jnp.float32)
+    bg = jnp.asarray(rng.randn(32), jnp.float32)
+    gn = np.asarray(group_norm(xg, wg, bg, 8, 1e-5, interpret=False))
+    x64 = np.asarray(xg, np.float64).reshape(2, 8, 4, 16, 16)
+    mu = x64.mean(axis=(2, 3, 4), keepdims=True)
+    var = x64.var(axis=(2, 3, 4), keepdims=True)
+    gn_ref = ((x64 - mu) / np.sqrt(var + 1e-5)).reshape(2, 32, 16, 16) \
+        * np.asarray(wg).reshape(1, 32, 1, 1) + np.asarray(bg).reshape(1, 32, 1, 1)
+    assert np.abs(gn - gn_ref).max() < 1e-3, "group_norm kernel mismatch"
+
 
 def main():
     import jax

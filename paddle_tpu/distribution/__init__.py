@@ -698,7 +698,15 @@ class MultivariateNormal(Distribution):
                                  self.scale_tril.shape[:-1])))
 
     def sample(self, shape=()):
-        return self.rsample(shape)
+        # plain Monte-Carlo draw: detached (no tape node), from the
+        # precomputed raw-array factor — rsample is the pathwise variant
+        batch = jnp.broadcast_shapes(self.loc.shape[:-1],
+                                     self.scale_tril.shape[:-2])
+        k = self.loc.shape[-1]
+        full = tuple(shape) + batch + (k,)
+        z = jax.random.normal(random_state.next_key(), full)
+        return Tensor(self.loc + jnp.squeeze(
+            self.scale_tril @ z[..., None], -1))
 
     def rsample(self, shape=()):
         batch = jnp.broadcast_shapes(self.loc.shape[:-1],

@@ -1,7 +1,8 @@
 """Stable-Diffusion-style conditional UNet (BASELINE.md config 5; the
 reference hosts it in ppdiffusers). Fused-GroupNorm + cross-attention blocks
-— GroupNorm fuses via XLA (Pallas variant in ops/), attention rides the flash
-path. Kept at SD-1.x topology but parameterized so the bench can scale it."""
+— GroupNorm rides the fused Pallas kernel (ops/pallas/norms.py group_norm)
+whenever the sample fits VMEM, attention rides the flash path. Kept at
+SD-1.x topology but parameterized so the bench can scale it."""
 
 from __future__ import annotations
 

@@ -112,6 +112,17 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=Fa
 
 def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None, data_format="NCHW", name=None):
     def f(a, *wb):
+        # fused Pallas path (ref fused GroupNorm kernels, SURVEY §2.1 N4):
+        # TPU, channels-first, both affine params, sample fits VMEM
+        if (jax.default_backend() == "tpu" and data_format.startswith("NC")
+                and weight is not None and bias is not None
+                and wb[0].ndim == 1 and wb[1].ndim == 1):
+            from ...ops.pallas.norms import group_norm as pallas_gn
+            from ...ops.pallas.norms import group_norm_supported
+
+            if group_norm_supported(a.shape, num_groups):
+                return pallas_gn(a, wb[0], wb[1], num_groups, epsilon,
+                                 interpret=False)
         if data_format.startswith("NC"):
             n, c = a.shape[0], a.shape[1]
             spatial = a.shape[2:]

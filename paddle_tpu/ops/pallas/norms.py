@@ -278,3 +278,186 @@ def _rms_vjp_bwd(eps, interpret, saved, g):
 
 
 rms_norm.defvjp(_rms_vjp_fwd, _rms_vjp_bwd)
+
+
+# --------------------------------------------------------------- group_norm
+
+# one VMEM budget governs both the group-block sizing and the routing
+# guard in nn/functional/norm.py (keep them from diverging)
+_GN_VMEM_BUDGET = 256 * 1024  # f32 elements per block (~1MB)
+
+
+def _gn_group_block(g, row):
+    """Largest divisor of g whose [gb, row] f32 block stays under the
+    budget — bounds every VMEM buffer independent of channel count (the
+    UNet up-blocks reach C=2560 after skip concats)."""
+    budget = _GN_VMEM_BUDGET
+    gb = g
+    while gb > 1 and gb * row > budget:
+        d = 2
+        while gb % d and d <= gb:
+            d += 1
+        gb //= d
+    return gb
+
+
+def _gn_fwd_kernel(x_ref, w_ref, b_ref, y_ref, mean_ref, rstd_ref, *, eps):
+    # one row per (sample, group): strictly 2-D blocks — Mosaic's layout
+    # engine rejects the 4-D [G, Cg, HW] form (hard Check in layout.h)
+    x = x_ref[:].astype(jnp.float32)                    # [gb, Cg*HW]
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    xhat = (x - mean) * rstd
+    y = xhat * w_ref[:].astype(jnp.float32) + b_ref[:].astype(jnp.float32)
+    y_ref[:] = y.astype(y_ref.dtype)
+    mean_ref[:] = mean
+    rstd_ref[:] = rstd
+
+
+def _gn_bwd_kernel(x_ref, w_ref, mean_ref, rstd_ref, g_ref,
+                   dx_ref, dwc_ref, dbc_ref):
+    # grid = (G/gb, N): samples innermost, so the (j,)-indexed dwc/dbc
+    # output blocks are revisited consecutively and accumulate in VMEM
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _():
+        dwc_ref[:] = jnp.zeros_like(dwc_ref)
+        dbc_ref[:] = jnp.zeros_like(dbc_ref)
+
+    x = x_ref[:].astype(jnp.float32)                    # [gb, Cg*HW]
+    g = g_ref[:].astype(jnp.float32)
+    w = w_ref[:].astype(jnp.float32)
+    mean = mean_ref[:]
+    rstd = rstd_ref[:]
+    xhat = (x - mean) * rstd
+    gw = g * w
+    m1 = jnp.mean(gw, axis=-1, keepdims=True)
+    m2 = jnp.mean(gw * xhat, axis=-1, keepdims=True)
+    dx = rstd * (gw - m1 - xhat * m2)
+    dx_ref[:] = dx.astype(dx_ref.dtype)
+    # per-column accumulators; the Cg*HW -> Cg reduction finishes in XLA
+    dwc_ref[:] += g * xhat
+    dbc_ref[:] += g
+
+
+def _gn_prep(x, weight, bias, num_groups):
+    n, c = x.shape[0], x.shape[1]
+    cg = c // num_groups
+    hw = 1
+    for s in x.shape[2:]:
+        hw *= s
+    x2 = x.reshape(n * num_groups, cg * hw)
+    wf = weight.astype(jnp.float32)
+    bf = bias.astype(jnp.float32)
+    w2 = jnp.broadcast_to(wf.reshape(num_groups, cg, 1),
+                          (num_groups, cg, hw)).reshape(num_groups, cg * hw)
+    b2 = jnp.broadcast_to(bf.reshape(num_groups, cg, 1),
+                          (num_groups, cg, hw)).reshape(num_groups, cg * hw)
+    return x2, w2, b2, (n, num_groups, cg, hw)
+
+
+def _gn_call_fwd(x2, w2, b2, dims, eps, interpret):
+    n, g, cg, hw = dims
+    row = cg * hw
+    gb = _gn_group_block(g, row)
+    ngb = g // gb
+    return pl.pallas_call(
+        functools.partial(_gn_fwd_kernel, eps=eps),
+        grid=(ngb, n),
+        in_specs=[
+            pl.BlockSpec((gb, row), lambda j, i: (i * ngb + j, 0)),
+            pl.BlockSpec((gb, row), lambda j, i: (j, 0)),
+            pl.BlockSpec((gb, row), lambda j, i: (j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((gb, row), lambda j, i: (i * ngb + j, 0)),
+            pl.BlockSpec((gb, 1), lambda j, i: (i * ngb + j, 0)),
+            pl.BlockSpec((gb, 1), lambda j, i: (i * ngb + j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n * g, row), x2.dtype),
+            jax.ShapeDtypeStruct((n * g, 1), jnp.float32),
+            jax.ShapeDtypeStruct((n * g, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x2, w2, b2)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def group_norm(x, weight, bias, num_groups, eps=1e-5, interpret=None):
+    """Fused GroupNorm over NC* layout (the SD-UNet hot norm; ref: the
+    fused GroupNorm CUDA kernels in phi/kernels/fusion (U), SURVEY §2.1 N4).
+    Grid is (group-blocks, samples): each step normalizes a block of groups
+    for one sample in a single VMEM pass; backward fuses dx with dw/db
+    accumulation into consecutively-revisited output blocks."""
+    y, _ = _gn_fwd(x, weight, bias, num_groups, eps, interpret)
+    return y
+
+
+def _gn_fwd(x, weight, bias, num_groups, eps, interpret):
+    if interpret is None:
+        interpret = _interpret_default()
+    x2, w2, b2, dims = _gn_prep(x, weight, bias, num_groups)
+    y, mean, rstd = _gn_call_fwd(x2, w2, b2, dims, eps, interpret)
+    return y.reshape(x.shape), (x2, weight, mean, rstd, dims, x.shape)
+
+
+def _gn_vjp_fwd(x, weight, bias, num_groups, eps, interpret):
+    y, res = _gn_fwd(x, weight, bias, num_groups, eps, interpret)
+    return y, res
+
+
+def _gn_vjp_bwd(num_groups, eps, interpret, saved, gy):
+    if interpret is None:
+        interpret = _interpret_default()
+    x2, weight, mean, rstd, dims, orig_shape = saved
+    n, g, cg, hw = dims
+    row = cg * hw
+    gb = _gn_group_block(g, row)
+    ngb = g // gb
+    w2 = jnp.broadcast_to(
+        weight.astype(jnp.float32).reshape(g, cg, 1),
+        (g, cg, hw)).reshape(g, row)
+    g2 = gy.reshape(n * g, row)
+    dx, dwc, dbc = pl.pallas_call(
+        _gn_bwd_kernel,
+        grid=(ngb, n),
+        in_specs=[
+            pl.BlockSpec((gb, row), lambda j, i: (i * ngb + j, 0)),
+            pl.BlockSpec((gb, row), lambda j, i: (j, 0)),
+            pl.BlockSpec((gb, 1), lambda j, i: (i * ngb + j, 0)),
+            pl.BlockSpec((gb, 1), lambda j, i: (i * ngb + j, 0)),
+            pl.BlockSpec((gb, row), lambda j, i: (i * ngb + j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((gb, row), lambda j, i: (i * ngb + j, 0)),
+            pl.BlockSpec((gb, row), lambda j, i: (j, 0)),
+            pl.BlockSpec((gb, row), lambda j, i: (j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n * g, row), x2.dtype),
+            jax.ShapeDtypeStruct((g, row), jnp.float32),
+            jax.ShapeDtypeStruct((g, row), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x2, w2, mean, rstd, g2)
+    dw = dwc.reshape(g, cg, hw).sum(-1).reshape(-1).astype(weight.dtype)
+    db = dbc.reshape(g, cg, hw).sum(-1).reshape(-1).astype(weight.dtype)
+    return dx.reshape(orig_shape), dw, db
+
+
+group_norm.defvjp(_gn_vjp_fwd, _gn_vjp_bwd)
+
+
+def group_norm_supported(x_shape, num_groups):
+    """True when channels split evenly into groups and a single group row
+    fits the per-block VMEM budget (group-blocking handles everything
+    above that)."""
+    if len(x_shape) < 3 or x_shape[1] % num_groups:
+        return False
+    row = x_shape[1] // num_groups
+    for s in x_shape[2:]:
+        row *= s
+    return row <= _GN_VMEM_BUDGET

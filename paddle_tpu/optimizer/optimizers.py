@@ -82,7 +82,10 @@ class Adadelta(Optimizer):
         return st
 
     def _update(self, param, grad, state, lr):
-        g32 = _apply_l2(grad, param, self._cur_wd).astype(jnp.float32)
+        # decay against the f32 master weight when present, not the
+        # quantized bf16 param (mirrors Adam's _adam_math)
+        g32 = _apply_l2(grad, state.get("master_weight", param),
+                        self._cur_wd).astype(jnp.float32)
         eg = self._rho * state["avg_squared_grad"] \
             + (1 - self._rho) * jnp.square(g32)
         upd = -jnp.sqrt((state["avg_squared_update"] + self._epsilon)

@@ -266,3 +266,103 @@ class TestFullyMaskedRows:
         out = np.asarray(_sdpa_ref(q, k, v, mask=mask))
         np.testing.assert_allclose(out[:, 0], 0.0)
         assert np.abs(out[:, 1:]).sum() > 0
+
+
+class TestFusedGroupNorm:
+    def _ref(self, x, w, b, G, eps=1e-5):
+        n, c = x.shape[:2]
+        sp = x.shape[2:]
+        g = x.reshape((n, G, c // G) + sp)
+        axes = tuple(range(2, g.ndim))
+        mean = g.mean(axis=axes, keepdims=True)
+        var = jnp.var(g, axis=axes, keepdims=True)
+        out = ((g - mean) * jax.lax.rsqrt(var + eps)).reshape(x.shape)
+        shape = (1, c) + (1,) * len(sp)
+        return out * w.reshape(shape) + b.reshape(shape)
+
+    @pytest.mark.parametrize("shape,G", [((3, 32, 8, 8), 8),
+                                         ((2, 20, 5, 7), 4),
+                                         ((4, 16, 10), 16)])
+    def test_fwd(self, shape, G):
+        from paddle_tpu.ops.pallas.norms import group_norm
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(*shape).astype(np.float32)
+        w = rng.randn(shape[1]).astype(np.float32)
+        b = rng.randn(shape[1]).astype(np.float32)
+        out = group_norm(x, w, b, G, 1e-5, True)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(self._ref(x, w, b, G)),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_bwd_matches_ref_grads(self):
+        from paddle_tpu.ops.pallas.norms import group_norm
+
+        rng = np.random.RandomState(1)
+        x = rng.randn(3, 24, 6, 5).astype(np.float32)
+        w = rng.randn(24).astype(np.float32)
+        b = rng.randn(24).astype(np.float32)
+        g1 = jax.grad(lambda *a: (group_norm(*a, 8, 1e-5, True) ** 2).sum(),
+                      argnums=(0, 1, 2))(x, w, b)
+        g2 = jax.grad(lambda *a: (self._ref(*a, 8) ** 2).sum(),
+                      argnums=(0, 1, 2))(x, w, b)
+        for a, c in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_bwd_numeric_grad(self):
+        # numeric ground truth from a float64 NumPy reference (f32 finite
+        # differences are dominated by rounding noise)
+        from paddle_tpu.ops.pallas.norms import group_norm
+
+        rng = np.random.RandomState(2)
+        x = rng.randn(2, 8, 4, 4).astype(np.float32)
+        w = rng.randn(8).astype(np.float32)
+        b = rng.randn(8).astype(np.float32)
+
+        def ref_loss(xv):
+            xv = xv.astype(np.float64)
+            g4 = xv.reshape(2, 4, 2, 4, 4)
+            mean = g4.mean(axis=(2, 3, 4), keepdims=True)
+            var = g4.var(axis=(2, 3, 4), keepdims=True)
+            out = ((g4 - mean) / np.sqrt(var + 1e-5)).reshape(xv.shape)
+            out = out * w.astype(np.float64).reshape(1, 8, 1, 1) \
+                + b.astype(np.float64).reshape(1, 8, 1, 1)
+            return float((out ** 2).sum())
+
+        g = jax.grad(lambda xv: (group_norm(xv, w, b, 4, 1e-5, True) ** 2
+                                 ).sum())(x)
+        eps = 1e-4
+        for idx in [(0, 0, 0, 0), (1, 3, 2, 1), (0, 7, 3, 3)]:
+            xp = x.astype(np.float64); xp[idx] += eps
+            xm = x.astype(np.float64); xm[idx] -= eps
+            num = (ref_loss(xp) - ref_loss(xm)) / (2 * eps)
+            np.testing.assert_allclose(np.asarray(g)[idx], num,
+                                       rtol=2e-3, atol=1e-4)
+
+    def test_bf16_stats_in_f32(self):
+        from paddle_tpu.ops.pallas.norms import group_norm
+
+        rng = np.random.RandomState(3)
+        x = (rng.randn(2, 16, 8, 8) * 3 + 100).astype(jnp.bfloat16)
+        w = np.ones(16, np.float32)
+        b = np.zeros(16, np.float32)
+        out = np.asarray(group_norm(x, w, b, 4, 1e-5, True)
+                         ).astype(np.float32)
+        ref = np.asarray(self._ref(np.asarray(x, np.float32), w, b, 4))
+        np.testing.assert_allclose(out, ref, rtol=0.05, atol=0.05)
+
+    def test_functional_routes_and_matches(self):
+        # CPU: F.group_norm keeps the jnp path; parity with the kernel
+        import paddle_tpu as paddle
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.ops.pallas.norms import group_norm
+
+        rng = np.random.RandomState(4)
+        x = rng.randn(2, 12, 6, 6).astype(np.float32)
+        w = rng.randn(12).astype(np.float32)
+        b = rng.randn(12).astype(np.float32)
+        f_out = F.group_norm(paddle.to_tensor(x), 4, 1e-5,
+                             paddle.to_tensor(w), paddle.to_tensor(b)).numpy()
+        k_out = np.asarray(group_norm(x, w, b, 4, 1e-5, True))
+        np.testing.assert_allclose(f_out, k_out, rtol=2e-5, atol=2e-5)
