@@ -33,6 +33,61 @@ def _measure(step_fn, sync_out, units_per_step, steps=8, windows=3):
     return units_per_step * steps / best
 
 
+_NOMINAL_PEAK_TF = 197.0  # v5e bf16 peak per chip
+
+
+def _ceiling_tflops():
+    """Measured practical matmul ceiling THROUGH THE TUNNEL, right now: a
+    chain of 8192^3 bf16 matmuls in one program. The r1 measurement was
+    ~92 TF (47% of nominal peak); measuring live keeps utilization
+    numbers honest as tunnel conditions drift."""
+    import jax
+    import jax.numpy as jnp
+
+    if jax.default_backend() not in ("tpu", "axon"):
+        return None
+    n, chain = 8192, 16
+
+    @jax.jit
+    def f(a, b):
+        def body(c, _):
+            return jnp.tanh(c @ b), ()
+        out, _ = jax.lax.scan(body, a, None, length=chain)
+        return out
+
+    a = jnp.ones((n, n), jnp.bfloat16)
+    b = jnp.ones((n, n), jnp.bfloat16)
+    f(a, b).block_until_ready()
+    best = None
+    for _ in range(3):
+        t0 = time.time()
+        f(a, b).block_until_ready()
+        dt = time.time() - t0
+        best = dt if best is None else min(best, dt)
+    return 2 * n ** 3 * chain / best / 1e12
+
+
+def _utilization(result, step, batch, units_per_sec, units_per_step):
+    """Attach the analytic utilization block: FLOPs/step from XLA's cost
+    analysis of the exact compiled program, achieved TFLOP/s, and % of
+    both the nominal 197 TF peak and the live-measured tunnel ceiling
+    (SURVEY §6: MFU is the north-star for every family)."""
+    try:
+        flops_per_step = float(step.cost_analysis(*batch)["flops"])
+    except Exception as e:  # cost analysis unsupported on this backend
+        result["utilization_error"] = f"{type(e).__name__}: {e}"[:120]
+        return result
+    tflops = units_per_sec / units_per_step * flops_per_step / 1e12
+    result["flops_per_step"] = flops_per_step
+    result["achieved_tflops"] = round(tflops, 1)
+    result["pct_nominal_peak"] = round(100 * tflops / _NOMINAL_PEAK_TF, 1)
+    ceiling = _ceiling_tflops()
+    if ceiling:
+        result["ceiling_tflops_now"] = round(ceiling, 1)
+        result["pct_practical_ceiling"] = round(100 * tflops / ceiling, 1)
+    return result
+
+
 def bench_resnet50(dtype="bfloat16"):
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
@@ -60,8 +115,9 @@ def bench_resnet50(dtype="bfloat16"):
     y = paddle.to_tensor(rng.randint(0, 1000, (B,)).astype(np.int64))
     ips = _measure(lambda: step(x, y), lambda o: float(o), B)
     tag = "bf16" if dtype == "bfloat16" else "f32"
-    return {"metric": f"images/sec ResNet-50 {tag} train (b{B}, 224px)",
-            "value": round(ips, 1), "unit": "images/s"}
+    res = {"metric": f"images/sec ResNet-50 {tag} train (b{B}, 224px)",
+           "value": round(ips, 1), "unit": "images/s"}
+    return _utilization(res, step, (x, y), ips, B)
 
 
 def bench_bert():
@@ -88,11 +144,12 @@ def bench_bert():
     rng = np.random.RandomState(0)
     ids = paddle.to_tensor(rng.randint(0, 30522, (B, S)).astype(np.int32))
     sps = _measure(lambda: step(ids, ids), lambda o: float(o), B)
-    return {"metric": f"sequences/sec BERT-base MLM bf16 train (b{B}xs{S})",
-            "value": round(sps, 1), "unit": "sequences/s"}
+    res = {"metric": f"sequences/sec BERT-base MLM bf16 train (b{B}xs{S})",
+           "value": round(sps, 1), "unit": "sequences/s"}
+    return _utilization(res, step, (ids, ids), sps, B)
 
 
-def bench_unet():
+def bench_unet(B=4):
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
     from paddle_tpu.models import UNetConfig, UNet2DConditionModel
@@ -104,8 +161,6 @@ def bench_unet():
     opt = paddle.optimizer.AdamW(learning_rate=1e-4,
                                  parameters=model.parameters(),
                                  multi_precision=True)
-    B = 4
-
     def loss_fn(net, x, t, ctx, target):
         pred = net(x, t, ctx)
         return nn.functional.mse_loss(pred, target)
@@ -119,8 +174,9 @@ def bench_unet():
         rng.randn(B, 77, cfg.cross_attention_dim).astype(np.float32)),
         "bfloat16")
     its = _measure(lambda: step(lat, t, ctx, lat), lambda o: float(o), 1)
-    return {"metric": f"iters/sec SD-UNet bf16 train (b{B}, 32x32 latents)",
-            "value": round(its, 2), "unit": "iters/s"}
+    res = {"metric": f"iters/sec SD-UNet bf16 train (b{B}, 32x32 latents)",
+           "value": round(its, 2), "unit": "iters/s"}
+    return _utilization(res, step, (lat, t, ctx, lat), its, 1)
 
 
 def bench_llama():
@@ -160,10 +216,11 @@ def bench_llama():
 
     peak = 197e12 if jax.default_backend() in ("tpu", "axon") else 1e12
     mfu = tps * 6 * n_params / peak
-    return {"metric": (f"tokens/sec/chip LLaMA-{n_params/1e6:.0f}M GQA "
-                       f"bf16+recompute train (b{B}xs{S})"),
-            "value": round(tps, 1), "unit": "tokens/s",
-            "mfu_6N": round(mfu, 4)}
+    res = {"metric": (f"tokens/sec/chip LLaMA-{n_params/1e6:.0f}M GQA "
+                      f"bf16+recompute train (b{B}xs{S})"),
+           "value": round(tps, 1), "unit": "tokens/s",
+           "mfu_6N": round(mfu, 4)}
+    return _utilization(res, step, (ids, ids), tps, B * S)
 
 
 def bench_ernie_hybrid():
@@ -204,6 +261,7 @@ def main():
                "resnet50_f32": lambda: bench_resnet50(dtype="float32"),
                "bert": bench_bert,
                "unet": bench_unet,
+               "unet_b16": lambda: bench_unet(B=16),
                "llama": bench_llama,
                "ernie_hybrid": bench_ernie_hybrid}
     if which != "all" and which not in benches:
@@ -211,8 +269,8 @@ def main():
               f"{sorted(benches)} or 'all'", file=sys.stderr)
         raise SystemExit(2)
     # "all" runs one variant per model family (bf16 resnet50); the f32
-    # reproduction run stays opt-in
-    names = ([n for n in benches if n != "resnet50_f32"]
+    # reproduction and throughput-optimal unet_b16 runs stay opt-in
+    names = ([n for n in benches if n not in ("resnet50_f32", "unet_b16")]
              if which == "all" else [which])
     if which == "all":
         # one fresh process per bench: HBM from a previous model (cached

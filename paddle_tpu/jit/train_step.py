@@ -150,7 +150,10 @@ class TrainStep:
 
         return step_fn
 
-    def __call__(self, *batch):
+    def _marshal(self, *batch, draw_key=True):
+        """Build the exact positional argument tuple __call__ feeds the
+        jitted step (also used by cost_analysis, which must NOT advance the
+        global RNG stream — pass draw_key=False there)."""
         if self._jitted is None:
             self._ensure_states()
             self._build()
@@ -161,7 +164,8 @@ class TrainStep:
         opt_states = [opt._accumulators[id(sd[n])] if id(sd[n]) in opt._accumulators
                       else opt._state_for(sd[n]) for n in self._param_names]
         lr = jnp.asarray(opt.get_lr(), jnp.float32)
-        rng_key = random_state.next_key()
+        rng_key = (random_state.next_key() if draw_key
+                   else jax.random.PRNGKey(0))
         batch_arrays = [b._data if isinstance(b, Tensor) else jnp.asarray(b) for b in batch]
         if self.scaler is not None:
             scaler_state = (jnp.asarray(self.scaler._scale, jnp.float32),
@@ -169,6 +173,33 @@ class TrainStep:
                             jnp.asarray(self.scaler._bad_steps, jnp.int32))
         else:
             scaler_state = ()
+        return (sd, param_arrays, buffer_arrays, opt_states, lr, rng_key,
+                scaler_state, batch_arrays)
+
+    def cost_analysis(self, *batch):
+        """XLA cost analysis of the step program (flops, bytes accessed,
+        ...). Prefers the lowering-level analysis (no compile); falls back
+        to compiling, which re-runs XLA (the executable cache may or may
+        not absorb it) — acceptable for benchmarking, not for hot paths."""
+        (_, param_arrays, buffer_arrays, opt_states, lr, rng_key,
+         scaler_state, batch_arrays) = self._marshal(*batch, draw_key=False)
+        lowered = self._jitted.lower(param_arrays, buffer_arrays, opt_states,
+                                     lr, rng_key, scaler_state, *batch_arrays)
+        try:
+            cost = lowered.cost_analysis()
+        except Exception:
+            cost = None
+        if not cost:
+            cost = lowered.compile().cost_analysis()
+        # jax returns either a dict or a per-device list of dicts
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        return cost
+
+    def __call__(self, *batch):
+        (sd, param_arrays, buffer_arrays, opt_states, lr, rng_key,
+         scaler_state, batch_arrays) = self._marshal(*batch)
+        opt = self.optimizer
         (new_params, new_buffers, new_opt_states, loss, new_scaler_state,
          aux_arrays) = self._jitted(
             param_arrays, buffer_arrays, opt_states, lr, rng_key, scaler_state,

@@ -57,8 +57,9 @@ def _use_pallas(q_shape, head_dim):
 
         if jax.default_backend() != "tpu":
             return False
-        # flash kernel wants lane-aligned head_dim and long-enough seq
-        return head_dim % 128 == 0 and q_shape[1] >= 128
+        # long-enough seq; non-lane-aligned head dims (<=256) are padded
+        # to 128 lanes by ops.flash_attention (free on the MXU)
+        return head_dim <= 256 and q_shape[1] >= 128
     except Exception:
         return False
 

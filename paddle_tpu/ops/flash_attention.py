@@ -50,10 +50,25 @@ def _xla_flash(q, k, v, causal, scale):
 def flash_attention_arrays(q, k, v, causal=False, scale=None):
     """Array-level entry used by both the Tensor wrapper and jitted models.
 
-    Routes to the Pallas TPU kernel when available, else the XLA path."""
-    if jax.default_backend() == "tpu" and q.shape[-1] <= 256:
+    Routes to the Pallas TPU kernel when available, else the XLA path.
+    Head dims that aren't lane-aligned (the SD-UNet's 40/80/160) are
+    zero-padded to the next multiple of 128: a sub-128 contraction costs a
+    full systolic pass on the MXU anyway, so the padding is compute-free,
+    the zeros contribute nothing to q·k, and the padded v columns slice
+    off — while the kernel keeps the [s, s] score tile out of HBM (the
+    XLA path materializes it)."""
+    d = q.shape[-1]
+    if jax.default_backend() == "tpu" and d <= 256:
         from .pallas.flash import flash_attention as pallas_flash
 
+        if d % 128:
+            dp = -(-d // 128) * 128
+            s = scale if scale is not None else 1.0 / math.sqrt(d)
+            pad = [(0, 0)] * 3 + [(0, dp - d)]
+            out = pallas_flash(jnp.pad(q, pad), jnp.pad(k, pad),
+                               jnp.pad(v, pad), causal=causal, scale=s,
+                               interpret=False)
+            return out[..., :d]
         return pallas_flash(q, k, v, causal=causal, scale=scale,
                             interpret=False)
     return _xla_flash(q, k, v, causal, scale)
