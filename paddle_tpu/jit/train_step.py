@@ -177,20 +177,22 @@ class TrainStep:
                 scaler_state, batch_arrays)
 
     def cost_analysis(self, *batch):
-        """XLA cost analysis of the step program (flops, bytes accessed,
-        ...). Prefers the lowering-level analysis (no compile); falls back
-        to compiling, which re-runs XLA (the executable cache may or may
-        not absorb it) — acceptable for benchmarking, not for hot paths."""
+        """XLA cost analysis of the COMPILED step executable (flops, bytes
+        accessed, ...) — post-optimization counts, so CSE'd/DCE'd work is
+        not credited to utilization numbers. Compiling here re-runs XLA
+        (the executable cache may or may not absorb it) — acceptable for
+        benchmarking, not for hot paths; the pre-optimization
+        lowering-level analysis is only the fallback."""
         (_, param_arrays, buffer_arrays, opt_states, lr, rng_key,
          scaler_state, batch_arrays) = self._marshal(*batch, draw_key=False)
         lowered = self._jitted.lower(param_arrays, buffer_arrays, opt_states,
                                      lr, rng_key, scaler_state, *batch_arrays)
         try:
-            cost = lowered.cost_analysis()
+            cost = lowered.compile().cost_analysis()
         except Exception:
             cost = None
         if not cost:
-            cost = lowered.compile().cost_analysis()
+            cost = lowered.cost_analysis()
         # jax returns either a dict or a per-device list of dicts
         if isinstance(cost, (list, tuple)):
             cost = cost[0]
