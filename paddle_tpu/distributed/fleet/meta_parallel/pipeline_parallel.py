@@ -17,18 +17,25 @@ SURVEY.md §2.1 N13). Backward is `jax.grad` through the scan, with
 recompute_interval). Warmup/drain bubbles are masked ticks, matching GPipe.
 
 Memory semantics (measured via compiled memory_analysis, see
-tests/test_pipeline_parallel.py::TestPipelineMemory): this is GPipe-shaped,
-NOT true 1F1B — `jax.grad` through the scan retains per-tick residuals, so
-activation memory grows O(accumulate_steps). With recompute_interval>0 the
-per-tick residual is only the tick's BOUNDARY tensors (microbatch input +
-ppermuted hidden + labels; measured ≈1× boundary size per microbatch, ~5×
+tests/test_pipeline_parallel.py::TestPipelineMemory): the default schedule
+is GPipe-shaped — `jax.grad` through the scan retains per-tick residuals,
+so activation memory grows O(accumulate_steps). With recompute_interval>0
+the per-tick residual is only the tick's BOUNDARY tensors (microbatch input
++ ppermuted hidden + labels; measured ≈1× boundary size per microbatch, ~5×
 smaller than the no-remat variant), so the growth constant is small: for
 transformer stages whose internal activations are 30–60× the boundary
 hidden, remat-GPipe uses LESS activation memory than true 1F1B's
 O(depth × full-activations) whenever accumulate_steps < ~30× depth, at the
-usual one-extra-forward cost. The reference's literal 1F1B schedule
-(pp_utils/p2p_communication.py (U)) bounds in-flight FULL activations by
-pipeline depth instead — better only for long schedules without remat.
+usual one-extra-forward cost.
+
+For the no-remat / long-schedule regime the reference's literal 1F1B
+schedule (pp_utils/p2p_communication.py (U)) is available as an opt-in:
+`strategy={"pipeline_configs": {"schedule": "1f1b"}}` hand-interleaves
+per-microbatch forward and backward on a deterministic clock with vjp
+residuals in a 2(S-1)+1-slot ring buffer, bounding in-flight FULL
+activations by pipeline depth with no extra forward (see
+_pipeline_pure_fn_1f1b; measured in TestPipeline1F1B — per-extra-microbatch
+growth < 0.2× GPipe's at accumulate_steps=32).
 
 Gradient flow across stages needs no reducer: stage params enter replicated
 (in_spec P()), so shard_map's transpose inserts the psum that sums each
@@ -93,6 +100,7 @@ class PipelineParallel(Layer):
                 strategy if isinstance(strategy, dict) else {})
         self.accumulate_steps = int(cfg.get("accumulate_steps", 1))
         self.micro_batch_size = cfg.get("micro_batch_size", None)
+        self.schedule = cfg.get("schedule", "gpipe")
         self._train_step = None
         self._pp_fn_cache = {}
 
@@ -166,6 +174,9 @@ class PipelineParallel(Layer):
     def _pipeline_pure_fn(self, n_micro):
         """Build pure(x_mbs, y_mbs, key, *params) -> scalar loss, shard_mapped
         over the hybrid mesh with the tick loop inside."""
+        if (getattr(self, "schedule", "gpipe") == "1f1b"
+                and self._layers.num_stages > 1):
+            return self._pipeline_pure_fn_1f1b(n_micro)
         if n_micro in self._pp_fn_cache:
             return self._pp_fn_cache[n_micro]
 
@@ -364,6 +375,436 @@ class PipelineParallel(Layer):
 
         self._pp_fn_cache[key] = (pure, names)
         return self._pp_fn_cache[key]
+
+    # ------------------------------------------------------------- 1F1B
+    def _pipeline_pure_fn_1f1b(self, n_micro):
+        """Literal 1F1B schedule (ref pp_utils/p2p_communication.py (U),
+        SURVEY §2.2 P13): per-microbatch forward and backward are
+        hand-interleaved on a deterministic clock — fwd of microbatch m
+        runs on stage s at tick m+s, its backward at tick m+2(S-1)-s —
+        so in-flight FULL activations are bounded by 2(S-1)+1 slots
+        (O(pipeline depth)), not O(accumulate_steps) as in the jax.grad
+        GPipe schedule. No recompute: each stage's vjp residuals are
+        extracted with jax.closure_convert, byte-packed into a fixed ring
+        buffer, and replayed at the backward tick; parameter gradients
+        accumulate in f32 on the owning stage and psum across 'pp' at the
+        end. The result is exposed through jax.custom_vjp so TrainStep's
+        ordinary jax.grad path consumes the hand-computed gradients."""
+        key_c = ("1f1b", n_micro)
+        if key_c in self._pp_fn_cache:
+            return self._pp_fn_cache[key_c]
+
+        import numpy as np
+
+        pp = self._layers
+        S = pp.num_stages
+        assert S > 1  # S == 1 dispatches to the serial GPipe builder
+        if getattr(pp, "num_virtual_stages", 1) > 1:
+            raise NotImplementedError(
+                "schedule='1f1b' with num_virtual_pipeline_stages>1: use "
+                "the default interleaved schedule")
+        from .parallel_layers.pp_layers import _SharedView
+        if any(isinstance(it, _SharedView) for it in pp.run_function):
+            raise NotImplementedError(
+                "schedule='1f1b' with SharedLayerDesc weight tying: each "
+                "stage's vjp differentiates only stage-owned params, so "
+                "the non-owning stage's tied-weight gradient would be "
+                "silently dropped — use the default gpipe schedule")
+        (mesh, names, dp_live, mp_live, live_axes, param_specs,
+         _rescale_mp, batch_spec) = self._schedule_env()
+        run_items = self._run_items
+        M = n_micro
+        K = 2 * (S - 1) + 1          # residual ring slots: O(depth)
+        sd0 = pp.state_dict()
+        trainable = {n for n in names if not sd0[n].stop_gradient}
+        # param index ranges owned by each stage (only trainable ones get
+        # hand-computed grads; buffers come back as zeros)
+        stage_idx = []
+        for k in range(S):
+            own = [i for i, n in enumerate(names)
+                   if n in trainable and n in set(pp.stage_param_names(k))]
+            stage_idx.append(own)
+        owner_of = {}
+        for k, idxs in enumerate(stage_idx):
+            for i in idxs:
+                owner_of[i] = k
+
+        def spmd(x_mbs, y_mbs, base_key, *params):
+            s = lax.axis_index("pp")
+
+            with _tape.no_grad(), collective_ctx.axis_scope(*live_axes):
+
+                # ---------- per-stage primals over (hid?, sub_params)
+                def stage_prim(k):
+                    items = pp.get_stage_layers(k)
+                    idxs = stage_idx[k]
+
+                    def f(x_in, sub, y_mb, key):
+                        arrays = dict(zip(names, params))
+                        for j, i in enumerate(idxs):
+                            arrays[names[i]] = sub[j]
+                        with random_state.fork_rng(key), \
+                                pp.use_state(arrays):
+                            out = run_items(items, Tensor(x_in))
+                            if k == S - 1:
+                                loss = pp.compute_loss(out, Tensor(y_mb))
+                                return jnp.mean(loss._data).astype(jnp.float32)
+                            return out._data
+                    return f
+
+                prims = [stage_prim(k) for k in range(S)]
+
+                # hidden boundary shape from stage 0 (same for all stages,
+                # as in the GPipe schedule)
+                probe_key = jax.random.fold_in(base_key, 0)
+                sub0 = tuple(params[i] for i in stage_idx[0])
+                hid_sd = jax.eval_shape(
+                    lambda x, sb, ky: prims[0](x, sb, y_mbs[0], ky),
+                    x_mbs[0], sub0, probe_key)
+                hid_shape, hid_dtype = hid_sd.shape, hid_sd.dtype
+
+                # ---------- vjp plumbing per stage
+                def vjp_raw(k, x_in, sub, y_mb, key):
+                    """(out, pullback) over the diff args (hid for k>0,
+                    sub params)."""
+                    if k == 0:
+                        prim = lambda sb: prims[0](x_in, sb, y_mb, key)
+                        return jax.vjp(prim, sub)
+                    prim = lambda xi, sb: prims[k](xi, sb, y_mb, key)
+                    return jax.vjp(prim, x_in, sub)
+
+                def vjp_parts(k, x_in, sub, y_mb, key):
+                    """(out, treedef, leaves, mask). jax.vjp's pullback is
+                    a tree_util.Partial pytree whose leaves are the
+                    residual arrays in jaxpr-determined (deterministic)
+                    order — a far stronger cross-trace contract than
+                    closure_convert's retrace-hoisting, whose const order
+                    drifts on mp graphs. mask[j] >= 0 marks leaves that
+                    are ambient values (stage params, or the stage-0
+                    microbatch input) — tick-invariant or re-indexable,
+                    NOT buffered (buffering them would copy the stage's
+                    full parameters into every ring slot); mask[j] == -2
+                    marks non-array leaves (static, taken from the
+                    rebuild trace)."""
+                    y, pb = vjp_raw(k, x_in, sub, y_mb, key)
+                    leaves, treedef = jax.tree.flatten(pb)
+                    ambient = list(sub) + ([x_in] if k == 0 else [])
+                    mask = []
+                    for c in leaves:
+                        if not hasattr(c, "dtype"):
+                            mask.append(-2)
+                            continue
+                        hit = -1
+                        for ai, a in enumerate(ambient):
+                            if c is a:
+                                hit = ai
+                                break
+                        mask.append(hit)
+                    return y, treedef, leaves, mask
+
+                # static residual layouts from a probe trace (a real
+                # trace, not eval_shape — the ambient mask needs tracer
+                # identity); the probe's dead compute is DCE'd by XLA
+                # probe inputs must be TRACERS (zeros constants would
+                # make input-derived residuals trace-constants there but
+                # hoisted consts in the real branches — layout drift)
+                def tracer_hid():
+                    seed = jnp.ravel(x_mbs)[0].astype(jnp.float32) * 0.0
+                    return jnp.broadcast_to(seed.astype(hid_dtype),
+                                            hid_shape)
+
+                def probe(k):
+                    # closure_convert hoists outer tracers only from a
+                    # NESTED trace; what gets hoisted also depends on HOW
+                    # far up the tracer lives. Mirror the real schedule's
+                    # nesting exactly — vjp inside a cond whose parent
+                    # trace carries (x_mb, y_mb), like the switch branch
+                    # does — so the probe's residual layout matches the
+                    # real branches'. The trace-time mask assertions in
+                    # the branches are the safety net.
+                    sub = tuple(params[i] for i in stage_idx[k])
+                    box = {}
+
+                    def outer(ops):
+                        x_op, y_op = ops
+
+                        def inner(hid_op):
+                            xi = x_op if k == 0 else hid_op
+                            _, _, leaves, mask = vjp_parts(
+                                k, xi, sub, y_op, probe_key)
+                            box["specs"] = [
+                                jax.ShapeDtypeStruct(c.shape, c.dtype)
+                                for j, c in enumerate(leaves)
+                                if mask[j] == -1]
+                            box["mask"] = mask
+                            return jnp.zeros((), jnp.float32)
+
+                        return lax.cond(jnp.bool_(True), inner, inner,
+                                        tracer_hid())
+
+                    lax.cond(jnp.bool_(True), outer, outer,
+                             (x_mbs[0], y_mbs[0]))
+                    return box["specs"], box["mask"]
+
+                probes = [probe(k) for k in range(S)]
+                res_specs = [p[0] for p in probes]
+                res_masks = [p[1] for p in probes]
+
+                def nbytes(sdt):
+                    it = 1 if sdt.dtype == jnp.bool_ else jnp.dtype(sdt.dtype).itemsize
+                    return int(np.prod(sdt.shape)) * it
+
+                R = max(1, max(sum(nbytes(c) for c in res_specs[k])
+                               for k in range(S)))
+                # grad-accumulator layout from the shard_map-LOCAL param
+                # shapes (mp-sharded params are smaller in here than the
+                # host-global sd0 view)
+                sizes = [sum(int(np.prod(params[i].shape))
+                             for i in stage_idx[k]) for k in range(S)]
+                G = max(1, max(sizes))
+
+                def pack_bytes(consts, total):
+                    parts = []
+                    for c in consts:
+                        if c.dtype == jnp.bool_:
+                            c = c.astype(jnp.uint8)
+                        b = jax.lax.bitcast_convert_type(c, jnp.uint8)
+                        parts.append(b.reshape(-1))
+                    flat = (jnp.concatenate(parts) if parts
+                            else jnp.zeros((0,), jnp.uint8))
+                    return jnp.pad(flat, (0, total - flat.shape[0]))
+
+                def unpack_bytes(flat, specs):
+                    out, off = [], 0
+                    for sdt in specs:
+                        shape = tuple(sdt.shape)
+                        if sdt.dtype == jnp.bool_:
+                            n = int(np.prod(shape))
+                            out.append(flat[off:off + n].reshape(shape)
+                                       .astype(jnp.bool_))
+                            off += n
+                            continue
+                        isz = jnp.dtype(sdt.dtype).itemsize
+                        n = int(np.prod(shape)) * isz
+                        b = flat[off:off + n]
+                        b = (b.reshape(shape + (isz,)) if isz > 1
+                             else b.reshape(shape))
+                        out.append(jax.lax.bitcast_convert_type(b, sdt.dtype))
+                        off += n
+                    return out
+
+                def pack_grads(dsub, k):
+                    parts = [d.astype(jnp.float32).reshape(-1) for d in dsub]
+                    flat = (jnp.concatenate(parts) if parts
+                            else jnp.zeros((0,), jnp.float32))
+                    return jnp.pad(flat, (0, G - flat.shape[0]))
+
+                zeros_hid = jnp.zeros(hid_shape, hid_dtype)
+
+                # ---------- one tick of the schedule, per stage branch
+                def tick_branch(k):
+                    idxs = stage_idx[k]
+                    sub = tuple(params[i] for i in idxs)
+
+                    def do_fwd(x_mb, y_mb, key):
+                        x_in = x_mb if k == 0 else None
+
+                        def run(x_in_hid):
+                            xi = x_mb if k == 0 else x_in_hid
+                            if k == S - 1:
+                                # last stage: backward runs in the same
+                                # tick, straight through the raw pullback
+                                y, pb = vjp_raw(k, xi, sub, y_mb, key)
+                                cts = pb(jnp.float32(1.0 / M))
+                                if k == 0:
+                                    dsub, dx = cts[0], zeros_hid
+                                else:
+                                    dx, dsub = cts
+                                return (zeros_hid,
+                                        dx.astype(hid_dtype),
+                                        jnp.zeros((R,), jnp.uint8),
+                                        pack_grads(dsub, k), y)
+                            y, _, leaves, mask = vjp_parts(
+                                k, xi, sub, y_mb, key)
+                            if mask != res_masks[k]:
+                                raise AssertionError(
+                                    f"1f1b stage {k}: residual layout "
+                                    f"drifted between traces: probe="
+                                    f"{res_masks[k]} fwd={mask}")
+                            var = [c for j, c in enumerate(leaves)
+                                   if mask[j] == -1]
+                            return (y.astype(hid_dtype), zeros_hid,
+                                    pack_bytes(var, R),
+                                    jnp.zeros((G,), jnp.float32),
+                                    jnp.zeros((), jnp.float32))
+                        return run
+
+                    def br(x_mb, y_mb, hid_in, ct_in, res_buf, t):
+                        fwd_valid = (t >= k) & (t - k < M)
+                        key_t = jax.random.fold_in(base_key, t)
+
+                        def fwd_go(hid_in):
+                            return do_fwd(x_mb, y_mb, key_t)(hid_in)
+
+                        def fwd_skip(hid_in):
+                            return (zeros_hid, zeros_hid,
+                                    jnp.zeros((R,), jnp.uint8),
+                                    jnp.zeros((G,), jnp.float32),
+                                    jnp.zeros((), jnp.float32))
+
+                        y_out, ct_fwd, res_new, acc1, loss_m = lax.cond(
+                            fwd_valid, fwd_go, fwd_skip, hid_in)
+                        mf = jnp.clip(t - k, 0, M - 1)
+                        res_buf = lax.dynamic_update_index_in_dim(
+                            res_buf,
+                            jnp.where(fwd_valid, res_new,
+                                      lax.dynamic_index_in_dim(
+                                          res_buf, mf % K, keepdims=False)),
+                            mf % K, axis=0)
+
+                        if k == S - 1:
+                            return (y_out, ct_fwd, res_buf, acc1, loss_m)
+
+                        mb = t - (2 * (S - 1) - k)
+                        bwd_valid = (mb >= 0) & (mb < M)
+                        mbc = jnp.clip(mb, 0, M - 1)
+
+                        def bwd_go(ct_in):
+                            slot = lax.dynamic_index_in_dim(
+                                res_buf, mbc % K, keepdims=False)
+                            var = unpack_bytes(slot, res_specs[k])
+                            # rebuild the pullback structure from a dummy
+                            # trace (same jaxpr => same Partial treedef;
+                            # the dummy's leaf VALUES are replaced, so its
+                            # forward compute is DCE'd; the dummy hid must
+                            # be a tracer — see probe)
+                            x_bwd = (jnp.take(x_mbs, mbc, axis=0) if k == 0
+                                     else ct_in * 0)
+                            _, treedef, leaves_d, mask = vjp_parts(
+                                k, x_bwd, sub, y_mb, key_t)
+                            if mask != res_masks[k]:
+                                raise AssertionError(
+                                    f"1f1b stage {k}: residual layout "
+                                    f"drifted between traces: probe="
+                                    f"{res_masks[k]} bwd={mask}")
+                            ambient = list(sub) + ([x_bwd] if k == 0 else [])
+                            leaves, vi = [], 0
+                            for j in range(len(mask)):
+                                if mask[j] >= 0:
+                                    leaves.append(ambient[mask[j]])
+                                elif mask[j] == -2:
+                                    leaves.append(leaves_d[j])
+                                else:
+                                    leaves.append(var[vi].astype(
+                                        leaves_d[j].dtype))
+                                    vi += 1
+                            pb2 = jax.tree.unflatten(treedef, leaves)
+                            cts = pb2(ct_in.astype(hid_dtype))
+                            if k == 0:
+                                return zeros_hid, pack_grads(cts[0], k)
+                            dx, dsub = cts
+                            return dx.astype(hid_dtype), pack_grads(dsub, k)
+
+                        def bwd_skip(ct_in):
+                            return zeros_hid, jnp.zeros((G,), jnp.float32)
+
+                        dx_out, acc2 = lax.cond(bwd_valid, bwd_go, bwd_skip,
+                                                ct_in)
+                        return (y_out, dx_out, res_buf, acc1 + acc2, loss_m)
+
+                    return br
+
+                branches = [tick_branch(k) for k in range(S)]
+                perm_fwd = [(i, (i + 1) % S) for i in range(S)]
+                perm_bwd = [(i, (i - 1) % S) for i in range(S)]
+                T = M + 2 * (S - 1)
+
+                def tick(carry, t):
+                    hid, ct, res_buf, acc, loss_sum = carry
+                    m0 = jnp.clip(t, 0, M - 1)
+                    mL = jnp.clip(t - (S - 1), 0, M - 1)
+                    x_mb = jnp.take(x_mbs, m0, axis=0)
+                    y_mb = jnp.take(y_mbs, mL, axis=0)
+                    y_out, ct_out, res_buf, dacc, loss_m = lax.switch(
+                        jnp.minimum(s, S - 1), branches,
+                        x_mb, y_mb, hid, ct, res_buf, t)
+                    hid_next = lax.ppermute(y_out, "pp", perm_fwd)
+                    ct_next = lax.ppermute(ct_out, "pp", perm_bwd)
+                    return (hid_next, ct_next, res_buf, acc + dacc,
+                            loss_sum + loss_m), None
+
+                carry0 = (zeros_hid, zeros_hid,
+                          jnp.zeros((K, R), jnp.uint8),
+                          jnp.zeros((G,), jnp.float32),
+                          jnp.zeros((), jnp.float32))
+                (_, _, _, acc, loss_sum), _ = lax.scan(
+                    tick, carry0, jnp.arange(T))
+
+            loss = lax.psum(loss_sum, "pp") / M
+            if dp_live:
+                loss = lax.pmean(loss, "dp")
+
+            # unpack per-param grads from the owning stage's accumulator
+            # (offsets over the LOCAL shard shapes, matching pack_grads)
+            offsets = {}
+            for k in range(S):
+                off = 0
+                for i in stage_idx[k]:
+                    offsets[i] = off
+                    off += int(np.prod(params[i].shape))
+            grads = []
+            for i, n in enumerate(names):
+                p = params[i]
+                if i not in owner_of:
+                    grads.append(jnp.zeros_like(p))
+                    continue
+                k = owner_of[i]
+                size = int(np.prod(p.shape))
+                gsl = lax.dynamic_slice(acc, (offsets[i],), (size,))
+                g_i = gsl.reshape(p.shape) * (s == k).astype(jnp.float32)
+                # psum over pp broadcasts the owning stage's grad; over mp
+                # nothing is needed — the mp ops' custom vjps (identity/
+                # allreduce pairs) already make replicated-param grads
+                # identical on every mp rank, and sharded-param grads are
+                # complete per shard
+                g_i = lax.psum(g_i, "pp")
+                if dp_live:
+                    g_i = lax.pmean(g_i, "dp")
+                grads.append(g_i.astype(p.dtype))
+            return loss, tuple(grads)
+
+        def run(x_mbs, y_mbs, base_key, *params):
+            f = shard_map(
+                spmd, mesh=mesh,
+                in_specs=(batch_spec, batch_spec, P()) + param_specs,
+                out_specs=(P(), param_specs), check_vma=False)
+            return f(x_mbs, y_mbs, base_key, *params)
+
+        from jax.dtypes import float0
+
+        def _ct_zero(a):
+            if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating):
+                return jnp.zeros_like(a)
+            return np.zeros(jnp.shape(a), float0)
+
+        @jax.custom_vjp
+        def pure(x_mbs, y_mbs, base_key, *params):
+            return run(x_mbs, y_mbs, base_key, *params)[0]
+
+        def pure_fwd(x_mbs, y_mbs, base_key, *params):
+            loss, grads = run(x_mbs, y_mbs, base_key, *params)
+            return loss, (grads, x_mbs, y_mbs, base_key)
+
+        def pure_bwd(res, g):
+            grads, x_mbs, y_mbs, base_key = res
+            return (_ct_zero(x_mbs), _ct_zero(y_mbs), _ct_zero(base_key)) + \
+                tuple((g * gr.astype(jnp.float32)).astype(gr.dtype)
+                      for gr in grads)
+
+        pure.defvjp(pure_fwd, pure_bwd)
+
+        self._pp_fn_cache[key_c] = (pure, names)
+        return self._pp_fn_cache[key_c]
 
     def _loss_fn_for(self, n_micro):
         pure, names = self._pipeline_pure_fn(n_micro)
