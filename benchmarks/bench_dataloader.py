@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""DataLoader worker-mode throughput: sync vs threads vs spawn processes on
+the two workload archetypes (VERDICT r2 item 6 — measure, don't assume).
+
+GIL-releasing work (NumPy image-ish decode) favors threads: no pickle hop,
+no process startup. GIL-holding work (pure-Python tokenize-ish) is where
+process workers earn their keep. Prints one JSON line per (workload, mode).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+class NumpyHeavyDS:
+    """GIL-releasing: fft+matmul over a 256x256 block per sample."""
+
+    def __len__(self):
+        return 256
+
+    def __getitem__(self, i):
+        rng = np.random.RandomState(i)
+        a = rng.randn(256, 256).astype(np.float32)
+        return np.abs(np.fft.rfft2(a @ a.T)).astype(np.float32)
+
+
+class PythonHeavyDS:
+    """GIL-holding: pure-Python token munging per sample."""
+
+    def __len__(self):
+        return 256
+
+    def __getitem__(self, i):
+        text = ("tok%d " % i) * 4000
+        toks = [hash(w) % 32000 for w in text.split()]
+        out = []
+        for t in toks:
+            out.append((t * 31 + 7) % 32000)
+        return np.asarray(out[:1024], np.int32)
+
+
+def run(ds, mode, workers=4):
+    from paddle_tpu.io import DataLoader
+
+    kw = {}
+    if mode == "threads":
+        kw = dict(num_workers=workers)
+    elif mode == "procs":
+        kw = dict(num_workers=workers, use_process_workers=True, timeout=300)
+    dl = DataLoader(ds, batch_size=16, **kw)
+    list(dl)  # warm (spawn startup, caches)
+    t0 = time.time()
+    n = sum(b.shape[0] if hasattr(b, "shape") else len(b) for b in dl)
+    dt = time.time() - t0
+    return n / dt
+
+
+def main():
+    for name, ds in (("numpy_heavy", NumpyHeavyDS()),
+                     ("python_heavy", PythonHeavyDS())):
+        for mode in ("sync", "threads", "procs"):
+            sps = run(ds, mode)
+            print(json.dumps({"workload": name, "mode": mode,
+                              "samples_per_sec": round(sps, 1)}))
+
+
+if __name__ == "__main__":
+    main()

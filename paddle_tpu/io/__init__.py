@@ -6,5 +6,6 @@ from .sampler import (
     Sampler, SequenceSampler, RandomSampler, WeightedRandomSampler,
     SubsetRandomSampler, BatchSampler, DistributedBatchSampler,
 )
-from .dataloader import DataLoader, default_collate_fn, get_worker_info
+from .dataloader import (DataLoader, WorkerInfo, default_collate_fn,
+                         get_worker_info)
 from .record import RecordWriter, RecordFile, RecordDataset

@@ -1,0 +1,106 @@
+"""Elastic end-to-end companion (SURVEY.md §5 failure detection/elastic):
+data-parallel training over PADDLE_TRAINERS_NUM virtual CPU devices with
+periodic SHARDED checkpoints. When the elastic supervisor relaunches this
+script at a new world size, it resumes from the latest checkpoint — params
+written under the old mesh reshard onto the new one (reshard-on-load,
+distributed/checkpoint). Each step appends {world, step, loss} to
+ELASTIC_LOG so the driving test can watch progress across restarts.
+"""
+
+import json
+import os
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.nn as nn  # noqa: E402
+import paddle_tpu.distributed.checkpoint as dckpt  # noqa: E402
+
+WORLD = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+CKPT = os.environ["ELASTIC_CKPT_DIR"]
+LOG = os.environ["ELASTIC_LOG"]
+POINTER = os.path.join(CKPT, "LATEST")
+
+
+def _log(step, loss):
+    with open(LOG, "a") as f:
+        f.write(json.dumps({"world": WORLD, "step": step,
+                            "loss": float(loss)}) + "\n")
+
+
+def _latest_ckpt():
+    if not os.path.exists(POINTER):
+        return None
+    with open(POINTER) as f:
+        path = f.read().strip()
+    return path if path and os.path.isdir(path) else None
+
+
+def _save(state, step):
+    # write to a fresh dir, then atomically swing the LATEST pointer — a
+    # SIGTERM mid-save must never corrupt the resume point
+    path = os.path.join(CKPT, f"step_{step}")
+    dckpt.save_state_dict(state, path)
+    tmp = POINTER + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(path)
+    os.replace(tmp, POINTER)
+
+
+def main():
+    os.makedirs(CKPT, exist_ok=True)
+    mesh = Mesh(np.array(jax.devices()[:WORLD]), ("dp",))
+    paddle.seed(0)
+    model = nn.Linear(8, 1)
+    opt = paddle.optimizer.SGD(learning_rate=0.05,
+                               parameters=model.parameters())
+    sd = model.state_dict()
+    # params sharded over dp where the leading axis divides (the weight's
+    # 8 rows) — a world-size change makes resume a REAL reshard
+    for n, t in sd.items():
+        spec = P("dp") if (t._data.ndim >= 1
+                           and t._data.shape[0] % WORLD == 0) else P()
+        t._data = jax.device_put(t._data, NamedSharding(mesh, spec))
+
+    state = dict(sd)
+    state["__step__"] = np.zeros((), np.int32)
+    step0 = 0
+    latest = _latest_ckpt()
+    if latest is not None:
+        dckpt.load_state_dict(state, latest)
+        step0 = int(np.asarray(state["__step__"])) + 1
+        for n in sd:
+            sd[n]._data = state[n]._data if hasattr(state[n], "_data") \
+                else sd[n]._data
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 8).astype(np.float32)
+    Y = (X @ rng.randn(8, 1).astype(np.float32))
+
+    for step in range(step0, step0 + 5000):
+        xb = paddle.to_tensor(X)
+        yb = paddle.to_tensor(Y)
+        loss = nn.functional.mse_loss(model(xb), yb)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        _log(step, float(loss))
+        if step % 5 == 4:
+            state["__step__"] = np.asarray(step, np.int32)
+            _save(state, step)
+        time.sleep(0.05)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,186 @@
+"""DataLoader worker semantics (VERDICT r2 item 6): get_worker_info in both
+worker modes, IterableDataset sharded across workers via the WorkerInfo
+contract, and no silent degradation."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import DataLoader, IterableDataset, get_worker_info
+
+
+class _InfoDS:
+    """Map-style dataset recording the WorkerInfo seen per sample."""
+
+    def __len__(self):
+        return 16
+
+    def __getitem__(self, i):
+        info = get_worker_info()
+        assert info is not None, "worker info missing inside worker"
+        return np.asarray([i, info.id, info.num_workers], np.int64)
+
+
+class _ShardedIterable(IterableDataset):
+    """The reference contract: __iter__ shards itself via get_worker_info."""
+
+    def __init__(self, n=32):
+        self.n = n
+
+    def __iter__(self):
+        info = get_worker_info()
+        if info is None:
+            lo, hi, step = 0, self.n, 1
+        else:
+            lo, hi, step = info.id, self.n, info.num_workers
+        for i in range(lo, hi, step):
+            yield np.asarray([i, os.getpid()], np.int64)
+
+
+class TestWorkerInfo:
+    def test_main_process_is_none(self):
+        assert get_worker_info() is None
+
+    def test_thread_workers_see_info(self):
+        dl = DataLoader(_InfoDS(), batch_size=4, num_workers=2)
+        rows = np.concatenate([b.numpy() for b in dl])
+        np.testing.assert_array_equal(np.sort(rows[:, 0]), np.arange(16))
+        assert set(rows[:, 1]) <= {0, 1}
+        assert set(rows[:, 2]) == {2}
+        # and the main process is clean again afterwards
+        assert get_worker_info() is None
+
+    def test_process_workers_see_info(self):
+        dl = DataLoader(_InfoDS(), batch_size=4, num_workers=2,
+                        use_process_workers=True, timeout=120)
+        rows = np.concatenate([b.numpy() for b in dl])
+        np.testing.assert_array_equal(np.sort(rows[:, 0]), np.arange(16))
+        assert set(rows[:, 2]) == {2}
+
+
+class TestIterableSharding:
+    @pytest.mark.parametrize("procs", [False, True])
+    def test_workers_cover_disjoint_shards(self, procs):
+        dl = DataLoader(_ShardedIterable(32), batch_size=4, num_workers=2,
+                        use_process_workers=procs, timeout=120)
+        rows = np.concatenate([b.numpy() for b in dl])
+        ids = np.sort(rows[:, 0])
+        # no duplicates, full coverage: the loader really ran the sharded
+        # iterators instead of silently degrading to synchronous iteration
+        np.testing.assert_array_equal(ids, np.arange(32))
+        if procs:
+            assert os.getpid() not in set(rows[:, 1].tolist())
+
+    def test_partial_tail_batch_per_worker(self):
+        dl = DataLoader(_ShardedIterable(30), batch_size=4, num_workers=2,
+                        drop_last=False)
+        sizes = sorted(b.shape[0] for b in dl)
+        assert sum(sizes) == 30
+        # 15 samples per worker -> 3 full batches + one 3-sample tail each
+        assert sizes[:2] == [3, 3]
+
+    def test_drop_last_drops_worker_tails(self):
+        dl = DataLoader(_ShardedIterable(30), batch_size=4, num_workers=2,
+                        drop_last=True)
+        sizes = [b.shape[0] for b in dl]
+        assert all(sz == 4 for sz in sizes)
+        assert sum(sizes) == 24
+
+    def test_iterable_error_propagates(self):
+        class Bad(IterableDataset):
+            def __iter__(self):
+                yield np.zeros(2, np.float32)
+                raise ValueError("boom")
+
+        dl = DataLoader(Bad(), batch_size=1, num_workers=2)
+        with pytest.raises(ValueError, match="boom"):
+            list(dl)
+
+
+def _bad_init(wid):
+    raise ValueError("init boom")
+
+
+class _UnevenSlowIterable(IterableDataset):
+    """Worker 0 gets nothing; worker 1 produces slowly — the early-finisher
+    must not be misread as a dead worker."""
+
+    def __iter__(self):
+        import time
+
+        info = get_worker_info()
+        if info is not None and info.id == 0:
+            return
+        for i in range(4):
+            time.sleep(0.6)
+            yield np.asarray([i], np.int64)
+
+
+class TestWorkerRobustness:
+    def test_early_finisher_not_flagged_dead(self):
+        dl = DataLoader(_UnevenSlowIterable(), batch_size=2, num_workers=2,
+                        use_process_workers=True, timeout=120)
+        rows = np.concatenate([b.numpy() for b in dl])
+        np.testing.assert_array_equal(np.sort(rows[:, 0]), np.arange(4))
+
+    @pytest.mark.parametrize("procs", [False, True])
+    def test_worker_init_fn_failure_raises_not_hangs(self, procs):
+        dl = DataLoader(_ShardedIterable(8), batch_size=2, num_workers=2,
+                        worker_init_fn=_bad_init, use_process_workers=procs,
+                        timeout=60)
+        with pytest.raises((ValueError, RuntimeError)):
+            list(dl)
+
+    def test_map_style_worker_init_fn_failure_raises(self):
+        dl = DataLoader(_InfoDS(), batch_size=4, num_workers=2,
+                        worker_init_fn=_bad_init, timeout=60)
+        with pytest.raises(ValueError, match="init boom"):
+            list(dl)
+
+    def test_consumer_break_then_fresh_epoch(self):
+        # breaking mid-epoch must not strand workers or poison the next
+        # epoch's iterator
+        dl = DataLoader(_ShardedIterable(64), batch_size=4, num_workers=2)
+        it = iter(dl)
+        next(it)
+        it.close()  # generator early-exit (the `break` path)
+        rows = np.concatenate([b.numpy() for b in dl])
+        np.testing.assert_array_equal(np.sort(rows[:, 0]), np.arange(64))
+
+    def test_threaded_iterable_timeout_honored(self):
+        class Hang(IterableDataset):
+            def __iter__(self):
+                import time
+
+                time.sleep(600)
+                yield np.zeros(1, np.float32)
+
+        dl = DataLoader(Hang(), batch_size=1, num_workers=1, timeout=2)
+        with pytest.raises(RuntimeError, match="timed out"):
+            list(dl)
+
+    def test_thread_workers_can_mutate_their_dataset_copy(self):
+        class MutShard(IterableDataset):
+            def __init__(self, n):
+                self.n = n
+                self.lo = 0
+                self.step = 1
+
+            def __iter__(self):
+                info = get_worker_info()
+                if info is not None:
+                    # the reference's mutate-winfo.dataset idiom
+                    ds = info.dataset
+                    ds.lo = info.id
+                    ds.step = info.num_workers
+                    it = range(ds.lo, ds.n, ds.step)
+                else:
+                    it = range(self.n)
+                for i in it:
+                    yield np.asarray([i], np.int64)
+
+        dl = DataLoader(MutShard(24), batch_size=3, num_workers=2)
+        rows = np.concatenate([b.numpy() for b in dl])
+        np.testing.assert_array_equal(np.sort(rows[:, 0]), np.arange(24))

@@ -1,0 +1,116 @@
+"""Elastic END-TO-END (VERDICT r2 item 5): the composed flow the reference
+pairs together — training with periodic checkpoints, a scale event injected
+through the membership store, the elastic supervisor relaunching at the new
+world size, and training RESUMING from the resharded checkpoint with loss
+still descending — exercised as one pytest on the virtual CPU mesh."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+COMPANION = os.path.join(REPO, "tests", "companions", "elastic_train.py")
+
+
+def _read_log(path):
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass
+    return out
+
+
+def _wait_for(cond, timeout, interval=0.5, desc=""):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        got = cond()
+        if got:
+            return got
+        time.sleep(interval)
+    raise TimeoutError(f"timed out waiting for {desc}")
+
+
+def test_scale_up_relaunch_resume(tmp_path):
+    membership = tmp_path / "membership"
+    ckpt = tmp_path / "ckpt"
+    log = tmp_path / "train.jsonl"
+    membership.mkdir()
+    env = dict(
+        os.environ,
+        PADDLE_ELASTIC_DIR=str(membership),
+        ELASTIC_CKPT_DIR=str(ckpt),
+        ELASTIC_LOG=str(log),
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+    )
+    sup = subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--elastic_np", "1:4", "--rank", "0", "--max_restarts", "3",
+         COMPANION],
+        env=env, cwd=REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    stop_beat = threading.Event()
+    try:
+        # phase 1: world=1 training underway, at least one checkpoint cut
+        _wait_for(lambda: len([e for e in _read_log(str(log))
+                               if e["world"] == 1]) >= 8,
+                  timeout=180, desc="world=1 progress")
+
+        # phase 2: inject a scale event — node '1' joins the membership
+        # store and keeps heartbeating (the test plays the second host)
+        from paddle_tpu.distributed.fleet.elastic.manager import (
+            FileMembershipStore,
+        )
+
+        store = FileMembershipStore(str(membership))
+        store.register("1", {})
+
+        def beat():
+            while not stop_beat.wait(0.4):
+                store.heartbeat("1")
+
+        beater = threading.Thread(target=beat, daemon=True)
+        beater.start()
+
+        # phase 3: supervisor relaunches at world=2; trainer resumes
+        w2 = _wait_for(lambda: [e for e in _read_log(str(log))
+                                if e["world"] == 2][:1],
+                       timeout=180, desc="world=2 relaunch")[0]
+        # resumed from checkpoint, not from scratch
+        assert w2["step"] > 0, w2
+        world1 = [e for e in _read_log(str(log)) if e["world"] == 1]
+        assert w2["step"] >= max(5, world1[-1]["step"] - 10)
+
+        # phase 4: loss continues descending across the restart
+        entries = _wait_for(
+            lambda: (lambda es: es if len(es) >= 10 else None)(
+                [e for e in _read_log(str(log)) if e["world"] == 2]),
+            timeout=120, desc="world=2 progress")
+        first_ever = _read_log(str(log))[0]["loss"]
+        resumed_first = entries[0]["loss"]
+        pre_kill = world1[-1]["loss"]
+        # resume point is near where world=1 left off, far below the start
+        assert resumed_first < 0.7 * first_ever, (resumed_first, first_ever)
+        assert resumed_first < 4 * max(pre_kill, 1e-6) + 1e-3
+        # and still descending
+        assert entries[-1]["loss"] <= resumed_first * 1.05 + 1e-9
+    finally:
+        stop_beat.set()
+        sup.terminate()
+        try:
+            sup.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            sup.kill()
+            sup.wait()
