@@ -1,0 +1,69 @@
+"""Companion: FOUR-process rendezvous (SURVEY.md §4 pattern A at nnodes=4,
+VERDICT r2 item 8) — dp=2 x pp=2 over a 4-device global mesh with ONE
+device per process, so every edge (the dp gradient psum AND the pipeline
+ppermute handoffs) crosses a process boundary. MP_SERIAL=1 runs the
+identical program single-process on 4 local devices."""
+
+import os
+
+SERIAL = os.environ.get("MP_SERIAL") == "1"
+os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count="
+                           + ("4" if SERIAL else "1"))
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed.fleet.meta_parallel import (
+    LayerDesc,
+    PipelineLayer,
+    PipelineParallel,
+)
+
+H = 16
+
+
+class Block(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(H, H)
+
+    def forward(self, x):
+        return paddle.tanh(self.fc(x))
+
+
+def main():
+    if not SERIAL:
+        dist.init_parallel_env()
+        assert len(jax.local_devices()) == 1
+    assert jax.device_count() == 4, jax.device_count()
+    hcg = dist.create_hybrid_communicate_group(dp=2, pp=2)
+
+    paddle.seed(0)
+    pl = PipelineLayer(
+        [LayerDesc(nn.Linear, 8, H)] + [LayerDesc(Block) for _ in range(2)]
+        + [LayerDesc(nn.Linear, H, 4)],
+        loss_fn=lambda o, y: nn.functional.mse_loss(o, y))
+    runner = PipelineParallel(pl, hcg, {"accumulate_steps": 4})
+    opt = paddle.optimizer.Momentum(learning_rate=0.05,
+                                    parameters=pl.parameters())
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(16, 8).astype(np.float32)
+    Y = rng.randn(16, 4).astype(np.float32)
+
+    losses = []
+    for _ in range(3):
+        loss = runner.train_batch(
+            (paddle.to_tensor(X), paddle.to_tensor(Y)), opt)
+        losses.append(round(float(loss), 6))
+    print("MP4_LOSSES", 0 if SERIAL else dist.get_rank(), losses,
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,61 @@
+"""Companion for the failure-path test: 2-process DP training where rank 1
+dies HARD (os._exit, no shutdown handshake — a segfault/preemption stand-in)
+mid-run. The surviving rank keeps issuing cross-process collectives; the
+coordination service must surface the peer loss as an error (taking the pod
+down) instead of hanging, and each launcher must propagate its child's exit
+status."""
+
+import os
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+
+
+def main():
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+    hcg = dist.create_hybrid_communicate_group(sharding=4)
+    from paddle_tpu.distributed.sharding.group_sharded import (
+        GroupShardedTrainStep,
+    )
+    from jax.experimental import multihost_utils
+    from jax.sharding import PartitionSpec as P
+
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+    opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                parameters=model.parameters())
+
+    def loss_fn(net, x, y):
+        return nn.functional.mse_loss(net(x), y)
+
+    step = GroupShardedTrainStep(model, loss_fn, opt, level="os",
+                                 mesh=hcg.mesh)
+    rng = np.random.RandomState(0)
+    X = rng.randn(32, 8).astype(np.float32)
+    Y = X.sum(-1, keepdims=True).astype(np.float32)
+    share = 16
+    lo, hi = rank * share, (rank + 1) * share
+    gx = multihost_utils.host_local_array_to_global_array(
+        X[lo:hi], hcg.mesh, P("sharding"))
+    gy = multihost_utils.host_local_array_to_global_array(
+        Y[lo:hi], hcg.mesh, P("sharding"))
+
+    for i in range(2000):
+        loss = step(paddle.Tensor(gx), paddle.Tensor(gy))
+        float(loss)  # sync every step — the survivor must touch the wire
+        print(f"KILLSTEP {rank} {i}", flush=True)
+        if rank == 1 and i == 3:
+            os._exit(7)  # hard death, no coordination-service goodbye
+
+
+if __name__ == "__main__":
+    main()

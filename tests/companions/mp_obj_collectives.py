@@ -1,0 +1,34 @@
+"""Companion: object collectives ACROSS processes (ADVICE r2 item 5) —
+broadcast_object_list ships rank 0's Python objects to rank 1 through the
+coordination service, and scatter_object_list delivers per-rank slots with
+in_object_list=None on non-src ranks (the reference contract)."""
+
+import os
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import paddle_tpu.distributed as dist
+
+
+def main():
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+
+    objs = [{"vocab": 32000, "rank_tag": "from-rank-0"}, [1, 2, 3]] \
+        if rank == 0 else [None, None]
+    dist.broadcast_object_list(objs, src=0)
+
+    out = []
+    dist.scatter_object_list(
+        out, in_object_list=["slot-a", "slot-b"] if rank == 0 else None,
+        src=0)
+
+    print(f"OBJ_RESULT {rank} "
+          f"{objs[0]['rank_tag']}|{objs[1]}|{out[0]}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
