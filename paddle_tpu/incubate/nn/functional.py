@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ...core.op_call import apply
 from ...core.tensor import Tensor
@@ -178,29 +179,260 @@ def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
     return out
 
 
-def fused_multi_transformer(*args, **kwargs):
-    raise NotImplementedError(
-        "fused_multi_transformer (inference generation loop) lands with the "
-        "serving path; use models.gpt with cache-based decode meanwhile"
-    )
+def masked_multihead_attention(x, cache_kv=None, src_mask=None, bias=None,
+                               sequence_lengths=None, rotary_tensor=None,
+                               beam_cache_offset=None, qkv_out_scale=None,
+                               out_shift=None, out_smooth=None, seq_len=1,
+                               rotary_emb_dims=0, use_neox_rotary_style=False,
+                               compute_dtype="default", out_scale=-1,
+                               quant_round_type=1, quant_max_bound=127.0,
+                               quant_min_bound=-127.0, name=None):
+    """Single-step decode attention over a fixed-capacity KV cache (ref:
+    incubate masked_multihead_attention (U) — the CUDA MMHA kernel behind
+    fused generation). TPU stance: the gather/attend/update runs as one
+    XLA program; quantization arguments are accepted for signature parity
+    (rotary/bias/beam arguments raise — they change the math).
 
-
-def masked_multihead_attention(*args, **kwargs):
-    raise NotImplementedError("use F.scaled_dot_product_attention with a mask")
-
-
-def swiglu(x, y=None, name=None):
-    """SwiGLU gate (ref: incubate/nn/functional/swiglu.py (U)): silu(x) * y;
-    with y=None, x is split in half along the last axis. One fused XLA
-    kernel — the same composition the LLaMA models here train with."""
+    x: [bsz, 3*num_head*head_dim] packed qkv for ONE new token
+    cache_kv: [2, bsz, num_head, max_seq, head_dim]; the step index is
+        sequence_lengths ([bsz] int, tokens already cached) or 0
+    src_mask: optional additive mask broadcastable to
+        [bsz, 1, 1, max_seq] (e.g. -inf at padding)
+    returns (out [bsz, num_head*head_dim], updated cache_kv)
+    """
+    if cache_kv is None:
+        raise ValueError("masked_multihead_attention requires cache_kv")
+    if rotary_tensor is not None or rotary_emb_dims:
+        raise NotImplementedError("masked_multihead_attention: rotary")
+    if bias is not None or beam_cache_offset is not None:
+        raise NotImplementedError(
+            "masked_multihead_attention: bias/beam_cache_offset")
     x = _as_t(x)
-    if y is None:
-        from ...tensor.manipulation import chunk
+    cache = _as_t(cache_kv)
+    args = [x, cache]
+    if src_mask is not None:
+        args.append(_as_t(src_mask).detach())
+    if sequence_lengths is not None:
+        args.append(_as_t(sequence_lengths).detach())
 
-        x, y = chunk(x, 2, axis=-1)
-    else:
-        y = _as_t(y)
-    return apply(lambda a, b: jax.nn.silu(a) * b, x, y, _op_name="swiglu")
+    n_head = cache.shape[2]
+    max_seq = cache.shape[3]
+    head_dim = cache.shape[4]
+
+    def f(xv, cachev, *rest):
+        import math as _math
+
+        ri = 0
+        maskv = None
+        if src_mask is not None:
+            maskv = rest[ri]
+            ri += 1
+        if sequence_lengths is not None:
+            lens = rest[ri].astype(jnp.int32)
+        else:
+            lens = jnp.zeros((xv.shape[0],), jnp.int32)
+        b = xv.shape[0]
+        qkv = xv.reshape(b, 3, n_head, head_dim)
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]   # [b, h, d]
+        # write k/v at each row's step index
+        pos = lens[:, None, None, None]             # [b,1,1,1]
+        idx = jnp.arange(max_seq)[None, None, :, None]
+        write = idx == pos
+        new_k = jnp.where(write, k[:, :, None, :], cachev[0])
+        new_v = jnp.where(write, v[:, :, None, :], cachev[1])
+        # attend: q over positions <= step
+        scores = jnp.einsum("bhd,bhsd->bhs", q.astype(jnp.float32),
+                            new_k.astype(jnp.float32))
+        scores = scores / _math.sqrt(head_dim)
+        valid = jnp.arange(max_seq)[None, None, :] <= lens[:, None, None]
+        scores = jnp.where(valid, scores, -1e30)
+        if maskv is not None:
+            mv = jnp.broadcast_to(maskv.reshape(maskv.shape[0], 1, -1),
+                                  (b, 1, maskv.shape[-1]))[:, :, :max_seq]
+            scores = scores + mv
+        p = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bhs,bhsd->bhd", p, new_v.astype(jnp.float32))
+        out = out.astype(xv.dtype).reshape(b, n_head * head_dim)
+        return out, jnp.stack([new_k, new_v])
+
+    return apply(f, *args, _op_name="masked_multihead_attention")
+
+
+def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
+                            linear_weights, linear_biases, ffn_ln_scales,
+                            ffn_ln_biases, ffn1_weights, ffn1_biases,
+                            ffn2_weights, ffn2_biases, pre_layer_norm=True,
+                            epsilon=1e-5, cache_kvs=None, pre_caches=None,
+                            rotary_embs=None, time_step=None, attn_mask=None,
+                            dropout_rate=0.0, activation="gelu",
+                            training=False, mode="upscale_in_train",
+                            trans_qkvw=True, ring_id=-1, name=None):
+    """Fused multi-layer transformer decoder pass (ref: incubate
+    fused_multi_transformer (U) — the CUDA fused generation stack). One
+    XLA program runs every layer: pre-LN -> packed qkv -> attention
+    (causal prefill, via the flash path when unmasked, WRITING the k/v
+    into cache_kvs when given; or single-step decode against cache_kvs at
+    time_step) -> out proj -> residual -> ffn. Differentiable through the
+    tape (everything routes through apply); rotary/pre_cache arguments
+    raise.
+
+    x: [bsz, seq, dim]; qkv_weights[i]: [3, n_head, head_dim, dim] when
+    trans_qkvw else [dim, 3, n_head, head_dim];
+    cache_kvs[i]: [2, bsz, n_head, max_seq, head_dim].
+    Returns out, or (out, updated cache_kvs) when cache_kvs is given.
+    """
+    if rotary_embs is not None or pre_caches is not None:
+        raise NotImplementedError(
+            "fused_multi_transformer: rotary_embs/pre_caches")
+    n_layers = len(qkv_weights)
+    decode = cache_kvs is not None and time_step is not None
+
+    weight_lists = [ln_scales, ln_biases, qkv_weights, qkv_biases,
+                    linear_weights, linear_biases, ffn_ln_scales,
+                    ffn_ln_biases, ffn1_weights, ffn1_biases,
+                    ffn2_weights, ffn2_biases]
+    # flatten every tensor into apply() args so gradients flow through
+    # the tape; record (list_idx, layer_idx) for reconstruction
+    flat, layout = [], []
+    for li, lst in enumerate(weight_lists):
+        for i in range(n_layers):
+            t = None if lst is None else lst[i]
+            if t is not None:
+                layout.append((li, i))
+                flat.append(_as_t(t))
+    n_caches = len(cache_kvs) if cache_kvs is not None else 0
+    cache_args = [_as_t(c).detach() for c in (cache_kvs or [])]
+    extra = []
+    if decode:
+        extra.append(_as_t(time_step).detach())
+    if attn_mask is not None:
+        extra.append(_as_t(attn_mask).detach())
+
+    def f(xv, *rest):
+        ws = {k: None for k in
+              [(li, i) for li in range(12) for i in range(n_layers)]}
+        for (li, i), t in zip(layout, rest[:len(layout)]):
+            ws[(li, i)] = t
+        off = len(layout)
+        caches = list(rest[off:off + n_caches])
+        off += n_caches
+        ts = None
+        if decode:
+            ts = rest[off].astype(jnp.int32).reshape(())
+            off += 1
+        maskv = rest[off] if attn_mask is not None else None
+
+        def norm(h, scale, bias_):
+            mean = jnp.mean(h, axis=-1, keepdims=True)
+            var = jnp.var(h, axis=-1, keepdims=True)
+            out = (h - mean) * jax.lax.rsqrt(var + epsilon)
+            return out * scale + bias_
+
+        acts = {"gelu": lambda a: jax.nn.gelu(a, approximate=False),
+                "relu": jax.nn.relu, "silu": jax.nn.silu}
+        act = acts[activation]
+
+        h = xv
+        b, s, dim = h.shape
+        qw0 = ws[(2, 0)]
+        if trans_qkvw:
+            n_head, head_dim = qw0.shape[1], qw0.shape[2]
+        else:
+            n_head, head_dim = qw0.shape[2], qw0.shape[3]
+        new_caches = []
+        for i in range(n_layers):
+            residual = h
+            ln_in = norm(h, ws[(0, i)], ws[(1, i)]) if pre_layer_norm else h
+            qw = ws[(2, i)]
+            if trans_qkvw:
+                qkv = jnp.einsum("bsd,thed->bsthe", ln_in, qw)
+            else:
+                qkv = jnp.einsum("bsd,dthe->bsthe", ln_in, qw)
+            if ws[(3, i)] is not None:
+                qkv = qkv + ws[(3, i)].reshape(1, 1, 3, n_head, head_dim)
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [b,s,h,d]
+            if caches:
+                cache = caches[i]
+                max_seq = cache.shape[3]
+                kk = jnp.transpose(k, (0, 2, 1, 3))   # [b,h,s,d]
+                vv = jnp.transpose(v, (0, 2, 1, 3))
+                if decode:
+                    idx = jnp.arange(max_seq)[None, None, :, None]
+                    write = idx == ts
+                    new_k = jnp.where(write, kk, cache[0])
+                    new_v = jnp.where(write, vv, cache[1])
+                else:
+                    # prefill: write positions [0, s) so later decode
+                    # steps attend over the prompt
+                    pad = ((0, 0), (0, 0), (0, max_seq - s), (0, 0))
+                    inmask = (jnp.arange(max_seq) < s)[None, None, :, None]
+                    new_k = jnp.where(inmask, jnp.pad(kk, pad), cache[0])
+                    new_v = jnp.where(inmask, jnp.pad(vv, pad), cache[1])
+                new_caches.append(jnp.stack([new_k, new_v]))
+            if decode:
+                cache_k, cache_v = new_caches[i][0], new_caches[i][1]
+                max_seq = cache_k.shape[2]
+                scores = jnp.einsum(
+                    "bshd,bhtd->bhst", q.astype(jnp.float32),
+                    cache_k.astype(jnp.float32)) / float(np.sqrt(head_dim))
+                valid = jnp.arange(max_seq)[None, None, None, :] <= ts
+                scores = jnp.where(valid, scores, -1e30)
+                if maskv is not None:
+                    scores = scores + maskv[..., :max_seq]
+                pr = jax.nn.softmax(scores, axis=-1)
+                attn = jnp.einsum("bhst,bhtd->bshd", pr,
+                                  cache_v.astype(jnp.float32)
+                                  ).astype(h.dtype)
+            elif maskv is not None:
+                # masked prefill: dense causal scores + additive mask
+                scores = jnp.einsum(
+                    "bshd,bthd->bhst", q.astype(jnp.float32),
+                    k.astype(jnp.float32)) / float(np.sqrt(head_dim))
+                causal = jnp.tril(jnp.ones((s, s), bool))
+                scores = jnp.where(causal[None, None], scores, -1e30)
+                scores = scores + maskv[..., :s]
+                pr = jax.nn.softmax(scores, axis=-1)
+                attn = jnp.einsum("bhst,bthd->bshd", pr,
+                                  v.astype(jnp.float32)).astype(h.dtype)
+            else:
+                from ...ops.flash_attention import flash_attention_arrays
+
+                attn = flash_attention_arrays(q, k, v, causal=True)
+            attn = attn.reshape(b, s, n_head * head_dim)
+            out = attn @ ws[(4, i)]
+            if ws[(5, i)] is not None:
+                out = out + ws[(5, i)]
+            if training and dropout_rate:
+                from ...core import random as random_state
+
+                keep = 1.0 - dropout_rate
+                mask_d = jax.random.bernoulli(
+                    random_state.next_key(), keep, out.shape)
+                out = jnp.where(mask_d, out / keep, 0.0)
+            h = residual + out
+            if not pre_layer_norm:
+                h = norm(h, ws[(0, i)], ws[(1, i)])
+            residual = h
+            ffn_in = norm(h, ws[(6, i)], ws[(7, i)]) \
+                if pre_layer_norm else h
+            f1 = ffn_in @ ws[(8, i)]
+            if ws[(9, i)] is not None:
+                f1 = f1 + ws[(9, i)]
+            f2 = act(f1) @ ws[(10, i)]
+            if ws[(11, i)] is not None:
+                f2 = f2 + ws[(11, i)]
+            h = residual + f2
+            if not pre_layer_norm:
+                h = norm(h, ws[(6, i)], ws[(7, i)])
+        if caches:
+            return (h,) + tuple(new_caches)
+        return h
+
+    res = apply(f, _as_t(x), *flat, *cache_args, *extra,
+                _op_name="fused_multi_transformer")
+    if cache_kvs is not None:
+        return res[0], list(res[1:])
+    return res
 
 
 def fused_ec_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias,

@@ -346,3 +346,174 @@ class TestDtypeInfoHub:
         assert batches == [[0, 1, 2], [3, 4, 5], [6]]
         batches = [b for b in paddle.batch(reader, 3, drop_last=True)()]
         assert batches == [[0, 1, 2], [3, 4, 5]]
+
+
+class TestFusedGeneration:
+    """P25 closure: masked_multihead_attention and fused_multi_transformer
+    are real implementations, checked against the unfused composition."""
+
+    def _mt_params(self, rng, L, dim, n_head, ffn):
+        hd = dim // n_head
+        mk = lambda *sh: paddle.to_tensor(  # noqa: E731
+            (rng.randn(*sh) * 0.05).astype(np.float32))
+        return dict(
+            ln_scales=[mk(dim) + 1 for _ in range(L)],
+            ln_biases=[mk(dim) for _ in range(L)],
+            qkv_weights=[mk(3, n_head, hd, dim) for _ in range(L)],
+            qkv_biases=[mk(3 * n_head * hd) for _ in range(L)],
+            linear_weights=[mk(dim, dim) for _ in range(L)],
+            linear_biases=[mk(dim) for _ in range(L)],
+            ffn_ln_scales=[mk(dim) + 1 for _ in range(L)],
+            ffn_ln_biases=[mk(dim) for _ in range(L)],
+            ffn1_weights=[mk(dim, ffn) for _ in range(L)],
+            ffn1_biases=[mk(ffn) for _ in range(L)],
+            ffn2_weights=[mk(ffn, dim) for _ in range(L)],
+            ffn2_biases=[mk(dim) for _ in range(L)],
+        )
+
+    def _ref_layer(self, h, P, i, n_head):
+        # unfused reference: pre-LN -> causal MHA -> residual -> FFN
+        import paddle_tpu.nn.functional as F
+
+        dim = h.shape[-1]
+        hd = dim // n_head
+        ln = F.layer_norm(h, [dim], P["ln_scales"][i], P["ln_biases"][i])
+        qw = P["qkv_weights"][i].numpy()            # [3, h, d, dim]
+        qkv = np.einsum("bsd,thed->bsthe", ln.numpy(), qw) \
+            + P["qkv_biases"][i].numpy().reshape(1, 1, 3, n_head, hd)
+        q, k, v = (paddle.to_tensor(qkv[:, :, j]) for j in range(3))
+        att = F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                             training=False)
+        b, s = h.shape[0], h.shape[1]
+        att = att.reshape([b, s, dim])
+        out = F.linear(att, P["linear_weights"][i], P["linear_biases"][i])
+        h = h + out
+        ln2 = F.layer_norm(h, [dim], P["ffn_ln_scales"][i],
+                           P["ffn_ln_biases"][i])
+        f1 = F.gelu(F.linear(ln2, P["ffn1_weights"][i], P["ffn1_biases"][i]))
+        return h + F.linear(f1, P["ffn2_weights"][i], P["ffn2_biases"][i])
+
+    def test_fused_multi_transformer_prefill_matches_unfused(self):
+        import paddle_tpu.incubate.nn.functional as IF
+
+        rng = np.random.RandomState(0)
+        L, dim, n_head, ffn = 2, 32, 4, 64
+        P = self._mt_params(rng, L, dim, n_head, ffn)
+        x = paddle.to_tensor(rng.randn(2, 8, dim).astype(np.float32) * 0.3)
+        out = IF.fused_multi_transformer(x, **P)
+        ref = x
+        for i in range(L):
+            ref = self._ref_layer(ref, P, i, n_head)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_fused_multi_transformer_decode_matches_prefill(self):
+        import paddle_tpu.incubate.nn.functional as IF
+
+        rng = np.random.RandomState(1)
+        L, dim, n_head, ffn = 2, 32, 4, 64
+        hd = dim // n_head
+        P = self._mt_params(rng, L, dim, n_head, ffn)
+        seq, max_seq = 6, 16
+        x = paddle.to_tensor(rng.randn(1, seq, dim).astype(np.float32) * 0.3)
+        full = IF.fused_multi_transformer(x, **P)
+        # decode token-by-token against the cache
+        caches = [paddle.to_tensor(np.zeros((2, 1, n_head, max_seq, hd),
+                                            np.float32))
+                  for _ in range(L)]
+        for t in range(seq):
+            step_out, caches = IF.fused_multi_transformer(
+                x[:, t:t + 1], cache_kvs=caches,
+                time_step=paddle.to_tensor(np.asarray(t, np.int32)), **P)
+        np.testing.assert_allclose(step_out.numpy()[:, 0],
+                                   full.numpy()[:, -1],
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_masked_multihead_attention_matches_dense(self):
+        import paddle_tpu.incubate.nn.functional as IF
+        import paddle_tpu.nn.functional as F
+
+        rng = np.random.RandomState(2)
+        b, n_head, hd, max_seq = 2, 4, 8, 12
+        # pre-fill 5 cached positions, then decode position 5
+        hist = rng.randn(b, 5, n_head, hd).astype(np.float32)
+        cache = np.zeros((2, b, n_head, max_seq, hd), np.float32)
+        cache[0, :, :, :5] = np.transpose(hist, (0, 2, 1, 3))
+        cache[1, :, :, :5] = np.transpose(hist, (0, 2, 1, 3)) * 0.5
+        xq = rng.randn(b, 3 * n_head * hd).astype(np.float32)
+        out, new_cache = IF.masked_multihead_attention(
+            paddle.to_tensor(xq), cache_kv=paddle.to_tensor(cache),
+            sequence_lengths=paddle.to_tensor(
+                np.full((b,), 5, np.int32)))
+        qkv = xq.reshape(b, 3, n_head, hd)
+        q = paddle.to_tensor(qkv[:, 0][:, None])    # [b,1,h,d]
+        nk = new_cache.numpy()
+        k = paddle.to_tensor(np.transpose(nk[0, :, :, :6], (0, 2, 1, 3)))
+        v = paddle.to_tensor(np.transpose(nk[1, :, :, :6], (0, 2, 1, 3)))
+        ref = F.scaled_dot_product_attention(q, k, v, training=False)
+        np.testing.assert_allclose(out.numpy(),
+                                   ref.numpy().reshape(b, -1),
+                                   rtol=1e-4, atol=1e-5)
+        # the new token landed at slot 5
+        np.testing.assert_allclose(
+            nk[0, :, :, 5], qkv[:, 1], rtol=1e-6)
+
+    def test_prefill_writes_cache_then_decode(self):
+        # the canonical generation flow: one prefill call with cache_kvs
+        # (no time_step) must WRITE the prompt's k/v, so decode continues
+        import paddle_tpu.incubate.nn.functional as IF
+
+        rng = np.random.RandomState(3)
+        L, dim, n_head, ffn = 2, 32, 4, 64
+        hd = dim // n_head
+        P = self._mt_params(rng, L, dim, n_head, ffn)
+        seq, max_seq = 5, 12
+        x = paddle.to_tensor(rng.randn(1, seq + 1, dim).astype(np.float32)
+                             * 0.3)
+        full = IF.fused_multi_transformer(x, **P)
+        caches = [paddle.to_tensor(np.zeros((2, 1, n_head, max_seq, hd),
+                                            np.float32))
+                  for _ in range(L)]
+        _, caches = IF.fused_multi_transformer(x[:, :seq],
+                                               cache_kvs=caches, **P)
+        step_out, caches = IF.fused_multi_transformer(
+            x[:, seq:], cache_kvs=caches,
+            time_step=paddle.to_tensor(np.asarray(seq, np.int32)), **P)
+        np.testing.assert_allclose(step_out.numpy()[:, 0],
+                                   full.numpy()[:, -1],
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_prefill_attn_mask_honored(self):
+        import paddle_tpu.incubate.nn.functional as IF
+
+        rng = np.random.RandomState(4)
+        L, dim, n_head, ffn = 1, 16, 2, 32
+        P = self._mt_params(rng, L, dim, n_head, ffn)
+        x = rng.randn(1, 6, dim).astype(np.float32) * 0.3
+        # masking the last two positions must equal running on the prefix
+        mask = np.zeros((1, 1, 1, 6), np.float32)
+        mask[..., 4:] = -1e30
+        out_masked = IF.fused_multi_transformer(
+            paddle.to_tensor(x), attn_mask=paddle.to_tensor(mask), **P)
+        out_prefix = IF.fused_multi_transformer(
+            paddle.to_tensor(x[:, :4]), **P)
+        np.testing.assert_allclose(out_masked.numpy()[:, :4],
+                                   out_prefix.numpy(),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_fused_multi_transformer_gradients_flow(self):
+        import paddle_tpu.incubate.nn.functional as IF
+
+        rng = np.random.RandomState(5)
+        L, dim, n_head, ffn = 1, 16, 2, 32
+        P = self._mt_params(rng, L, dim, n_head, ffn)
+        for lst in P.values():
+            for t in lst:
+                t.stop_gradient = False
+        x = paddle.to_tensor(rng.randn(1, 4, dim).astype(np.float32) * 0.3)
+        x.stop_gradient = False
+        out = IF.fused_multi_transformer(x, **P)
+        (out ** 2).sum().backward()
+        assert x.grad is not None and np.abs(x.grad.numpy()).max() > 0
+        qg = P["qkv_weights"][0].grad
+        assert qg is not None and np.abs(qg.numpy()).max() > 0
