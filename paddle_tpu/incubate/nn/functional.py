@@ -179,6 +179,32 @@ def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
     return out
 
 
+def _cache_attend(q, cache_k, cache_v, upto, maskv, max_seq):
+    """Attend q [b,s,h,d] over a fixed-capacity cache [b,h,max_seq,d],
+    valid positions <= upto ([b] or scalar int), optional additive mask
+    (padded with zeros out to max_seq). fp32 softmax. Shared by
+    masked_multihead_attention and fused_multi_transformer's decode
+    branch so the cache semantics cannot drift."""
+    import math as _math
+
+    head_dim = q.shape[-1]
+    scores = jnp.einsum("bshd,bhtd->bhst", q.astype(jnp.float32),
+                        cache_k.astype(jnp.float32))
+    scores = scores / _math.sqrt(head_dim)
+    upto = jnp.asarray(upto)
+    lens_b = upto.reshape(-1, 1, 1, 1) if upto.ndim else upto
+    valid = jnp.arange(max_seq)[None, None, None, :] <= lens_b
+    scores = jnp.where(valid, scores, -1e30)
+    if maskv is not None:
+        m = maskv.reshape(maskv.shape[0], 1, 1, -1)
+        if m.shape[-1] < max_seq:  # upstream masks cover [0, step+1)
+            m = jnp.pad(m, ((0, 0), (0, 0), (0, 0),
+                            (0, max_seq - m.shape[-1])))
+        scores = scores + m[..., :max_seq]
+    p = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhst,bhtd->bshd", p, cache_v.astype(jnp.float32))
+
+
 def masked_multihead_attention(x, cache_kv=None, src_mask=None, bias=None,
                                sequence_lengths=None, rotary_tensor=None,
                                beam_cache_offset=None, qkv_out_scale=None,
@@ -220,8 +246,6 @@ def masked_multihead_attention(x, cache_kv=None, src_mask=None, bias=None,
     head_dim = cache.shape[4]
 
     def f(xv, cachev, *rest):
-        import math as _math
-
         ri = 0
         maskv = None
         if src_mask is not None:
@@ -231,6 +255,11 @@ def masked_multihead_attention(x, cache_kv=None, src_mask=None, bias=None,
             lens = rest[ri].astype(jnp.int32)
         else:
             lens = jnp.zeros((xv.shape[0],), jnp.int32)
+        if not isinstance(lens, jax.core.Tracer) and bool(
+                jnp.any(lens >= max_seq)):
+            raise ValueError(
+                f"masked_multihead_attention: cache full "
+                f"(sequence_lengths >= max_seq {max_seq})")
         b = xv.shape[0]
         qkv = xv.reshape(b, 3, n_head, head_dim)
         q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]   # [b, h, d]
@@ -240,18 +269,7 @@ def masked_multihead_attention(x, cache_kv=None, src_mask=None, bias=None,
         write = idx == pos
         new_k = jnp.where(write, k[:, :, None, :], cachev[0])
         new_v = jnp.where(write, v[:, :, None, :], cachev[1])
-        # attend: q over positions <= step
-        scores = jnp.einsum("bhd,bhsd->bhs", q.astype(jnp.float32),
-                            new_k.astype(jnp.float32))
-        scores = scores / _math.sqrt(head_dim)
-        valid = jnp.arange(max_seq)[None, None, :] <= lens[:, None, None]
-        scores = jnp.where(valid, scores, -1e30)
-        if maskv is not None:
-            mv = jnp.broadcast_to(maskv.reshape(maskv.shape[0], 1, -1),
-                                  (b, 1, maskv.shape[-1]))[:, :, :max_seq]
-            scores = scores + mv
-        p = jax.nn.softmax(scores, axis=-1)
-        out = jnp.einsum("bhs,bhsd->bhd", p, new_v.astype(jnp.float32))
+        out = _cache_attend(q[:, None], new_k, new_v, lens, maskv, max_seq)
         out = out.astype(xv.dtype).reshape(b, n_head * head_dim)
         return out, jnp.stack([new_k, new_v])
 
@@ -332,6 +350,21 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
                 "relu": jax.nn.relu, "silu": jax.nn.silu}
         act = acts[activation]
 
+        def drop(t):
+            # reference semantics at BOTH residual adds: upscale_in_train
+            # scales kept units by 1/keep in training; downscale_in_infer
+            # masks without scaling (the inference-side downscale is a
+            # no-op here since eval applies no dropout at all)
+            if not (training and dropout_rate):
+                return t
+            from ...core import random as random_state
+
+            keep = 1.0 - dropout_rate
+            mask_d = jax.random.bernoulli(
+                random_state.next_key(), keep, t.shape)
+            kept = t / keep if mode == "upscale_in_train" else t
+            return jnp.where(mask_d, kept, 0.0)
+
         h = xv
         b, s, dim = h.shape
         qw0 = ws[(2, 0)]
@@ -371,18 +404,8 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
                 new_caches.append(jnp.stack([new_k, new_v]))
             if decode:
                 cache_k, cache_v = new_caches[i][0], new_caches[i][1]
-                max_seq = cache_k.shape[2]
-                scores = jnp.einsum(
-                    "bshd,bhtd->bhst", q.astype(jnp.float32),
-                    cache_k.astype(jnp.float32)) / float(np.sqrt(head_dim))
-                valid = jnp.arange(max_seq)[None, None, None, :] <= ts
-                scores = jnp.where(valid, scores, -1e30)
-                if maskv is not None:
-                    scores = scores + maskv[..., :max_seq]
-                pr = jax.nn.softmax(scores, axis=-1)
-                attn = jnp.einsum("bhst,bhtd->bshd", pr,
-                                  cache_v.astype(jnp.float32)
-                                  ).astype(h.dtype)
+                attn = _cache_attend(q, cache_k, cache_v, ts, maskv,
+                                     cache_k.shape[2]).astype(h.dtype)
             elif maskv is not None:
                 # masked prefill: dense causal scores + additive mask
                 scores = jnp.einsum(
@@ -402,13 +425,7 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
             out = attn @ ws[(4, i)]
             if ws[(5, i)] is not None:
                 out = out + ws[(5, i)]
-            if training and dropout_rate:
-                from ...core import random as random_state
-
-                keep = 1.0 - dropout_rate
-                mask_d = jax.random.bernoulli(
-                    random_state.next_key(), keep, out.shape)
-                out = jnp.where(mask_d, out / keep, 0.0)
+            out = drop(out)
             h = residual + out
             if not pre_layer_norm:
                 h = norm(h, ws[(0, i)], ws[(1, i)])
@@ -421,7 +438,7 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
             f2 = act(f1) @ ws[(10, i)]
             if ws[(11, i)] is not None:
                 f2 = f2 + ws[(11, i)]
-            h = residual + f2
+            h = residual + drop(f2)
             if not pre_layer_norm:
                 h = norm(h, ws[(6, i)], ws[(7, i)])
         if caches:
@@ -433,6 +450,20 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
     if cache_kvs is not None:
         return res[0], list(res[1:])
     return res
+
+
+def swiglu(x, y=None, name=None):
+    """SwiGLU gate (ref: incubate/nn/functional/swiglu.py (U)): silu(x) * y;
+    with y=None, x is split in half along the last axis. One fused XLA
+    kernel — the same composition the LLaMA models here train with."""
+    x = _as_t(x)
+    if y is None:
+        from ...tensor.manipulation import chunk
+
+        x, y = chunk(x, 2, axis=-1)
+    else:
+        y = _as_t(y)
+    return apply(lambda a, b: jax.nn.silu(a) * b, x, y, _op_name="swiglu")
 
 
 def fused_ec_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias,

@@ -517,3 +517,23 @@ class TestFusedGeneration:
         assert x.grad is not None and np.abs(x.grad.numpy()).max() > 0
         qg = P["qkv_weights"][0].grad
         assert qg is not None and np.abs(qg.numpy()).max() > 0
+
+    def test_mmha_cache_full_raises_and_short_mask_ok(self):
+        import paddle_tpu.incubate.nn.functional as IF
+
+        rng = np.random.RandomState(6)
+        b, n_head, hd, max_seq = 1, 2, 4, 8
+        cache = np.zeros((2, b, n_head, max_seq, hd), np.float32)
+        xq = rng.randn(b, 3 * n_head * hd).astype(np.float32)
+        with pytest.raises(ValueError, match="cache full"):
+            IF.masked_multihead_attention(
+                paddle.to_tensor(xq), cache_kv=paddle.to_tensor(cache),
+                sequence_lengths=paddle.to_tensor(
+                    np.full((b,), max_seq, np.int32)))
+        # upstream contract: src_mask of length step+1 (< max_seq)
+        short_mask = np.zeros((b, 1, 1, 4), np.float32)
+        out, _ = IF.masked_multihead_attention(
+            paddle.to_tensor(xq), cache_kv=paddle.to_tensor(cache),
+            src_mask=paddle.to_tensor(short_mask),
+            sequence_lengths=paddle.to_tensor(np.full((b,), 3, np.int32)))
+        assert np.isfinite(out.numpy()).all()
