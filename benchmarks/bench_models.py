@@ -88,7 +88,7 @@ def _utilization(result, step, batch, units_per_sec, units_per_step):
     return result
 
 
-def bench_resnet50(dtype="bfloat16"):
+def bench_resnet50(dtype="bfloat16", B=64):
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
     from paddle_tpu.vision.models import resnet50
@@ -99,7 +99,6 @@ def bench_resnet50(dtype="bfloat16"):
         model.to(dtype="bfloat16")
     opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
                                     parameters=model.parameters())
-    B = 64
 
     def loss_fn(net, x, y):
         logits = net(x)
@@ -263,6 +262,7 @@ def main():
                "unet": bench_unet,
                "unet_b16": lambda: bench_unet(B=16),
                "bert_b128": lambda: bench_bert(B=128),
+               "resnet50_b256": lambda: bench_resnet50(B=256),
                "llama": bench_llama,
                "ernie_hybrid": bench_ernie_hybrid}
     if which != "all" and which not in benches:
@@ -272,7 +272,8 @@ def main():
     # "all" runs one variant per model family (bf16 resnet50); the f32
     # reproduction and throughput-optimal unet_b16 runs stay opt-in
     names = ([n for n in benches
-              if n not in ("resnet50_f32", "unet_b16", "bert_b128")]
+              if n not in ("resnet50_f32", "unet_b16", "bert_b128",
+                           "resnet50_b256")]
              if which == "all" else [which])
     if which == "all":
         # one fresh process per bench: HBM from a previous model (cached

@@ -196,10 +196,12 @@ def _cache_attend(q, cache_k, cache_v, upto, maskv, max_seq):
     valid = jnp.arange(max_seq)[None, None, None, :] <= lens_b
     scores = jnp.where(valid, scores, -1e30)
     if maskv is not None:
-        m = maskv.reshape(maskv.shape[0], 1, 1, -1)
+        m = maskv
+        while m.ndim < 4:  # [.., L] -> [b?,h?,s?,L] broadcastable
+            m = m[:, None] if m.ndim > 1 else m[None]
         if m.shape[-1] < max_seq:  # upstream masks cover [0, step+1)
-            m = jnp.pad(m, ((0, 0), (0, 0), (0, 0),
-                            (0, max_seq - m.shape[-1])))
+            m = jnp.pad(m, ((0, 0),) * (m.ndim - 1)
+                        + ((0, max_seq - m.shape[-1]),))
         scores = scores + m[..., :max_seq]
     p = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("bhst,bhtd->bshd", p, cache_v.astype(jnp.float32))
@@ -338,6 +340,12 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
         if decode:
             ts = rest[off].astype(jnp.int32).reshape(())
             off += 1
+            if caches and not isinstance(ts, jax.core.Tracer):
+                cap = caches[0].shape[3]
+                if bool(ts >= cap):
+                    raise ValueError(
+                        f"fused_multi_transformer: cache full "
+                        f"(time_step {int(ts)} >= max_seq {cap})")
         maskv = rest[off] if attn_mask is not None else None
 
         def norm(h, scale, bias_):
@@ -352,14 +360,16 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
 
         def drop(t):
             # reference semantics at BOTH residual adds: upscale_in_train
-            # scales kept units by 1/keep in training; downscale_in_infer
-            # masks without scaling (the inference-side downscale is a
-            # no-op here since eval applies no dropout at all)
-            if not (training and dropout_rate):
+            # scales kept units by 1/keep in training and is identity at
+            # eval; downscale_in_infer masks without scaling in training
+            # and multiplies by keep at eval
+            if not dropout_rate:
                 return t
+            keep = 1.0 - dropout_rate
+            if not training:
+                return t * keep if mode == "downscale_in_infer" else t
             from ...core import random as random_state
 
-            keep = 1.0 - dropout_rate
             mask_d = jax.random.bernoulli(
                 random_state.next_key(), keep, t.shape)
             kept = t / keep if mode == "upscale_in_train" else t

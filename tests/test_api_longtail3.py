@@ -537,3 +537,26 @@ class TestFusedGeneration:
             src_mask=paddle.to_tensor(short_mask),
             sequence_lengths=paddle.to_tensor(np.full((b,), 3, np.int32)))
         assert np.isfinite(out.numpy()).all()
+
+    def test_fmt_cache_full_and_downscale_infer(self):
+        import paddle_tpu.incubate.nn.functional as IF
+
+        rng = np.random.RandomState(7)
+        L, dim, n_head, ffn = 1, 16, 2, 32
+        hd = dim // n_head
+        P = self._mt_params(rng, L, dim, n_head, ffn)
+        caches = [paddle.to_tensor(np.zeros((2, 1, n_head, 4, hd),
+                                            np.float32))]
+        x1 = paddle.to_tensor(rng.randn(1, 1, dim).astype(np.float32))
+        with pytest.raises(ValueError, match="cache full"):
+            IF.fused_multi_transformer(
+                x1, cache_kvs=caches,
+                time_step=paddle.to_tensor(np.asarray(4, np.int32)), **P)
+        # downscale_in_infer at eval multiplies residual adds by keep
+        x = paddle.to_tensor(rng.randn(1, 4, dim).astype(np.float32) * 0.3)
+        out_p = IF.fused_multi_transformer(x, dropout_rate=0.3,
+                                           mode="downscale_in_infer",
+                                           training=False, **P)
+        out_0 = IF.fused_multi_transformer(x, dropout_rate=0.0,
+                                           training=False, **P)
+        assert np.abs(out_p.numpy() - out_0.numpy()).max() > 1e-4
