@@ -1,14 +1,29 @@
-"""paddle.inference parity (ref: AnalysisPredictor, SURVEY.md §2.1 N19 —
-declared out of core scope there; this shim serves the API so inference
-scripts can load jit-saved StableHLO artifacts)."""
+"""paddle.inference parity (ref: AnalysisPredictor + the handle-based
+Tensor API, SURVEY.md §2.1 N19 — the TensorRT/IR-optimization engine is
+out of core scope; XLA fills that role). The Predictor here is real: it
+loads a jit-saved StableHLO artifact and serves it through the
+reference's workflow —
+
+    config = Config("model.pdmodel", "model.pdiparams")
+    predictor = create_predictor(config)
+    inp = predictor.get_input_handle(predictor.get_input_names()[0])
+    inp.copy_from_cpu(batch_np)
+    predictor.run()
+    out = predictor.get_output_handle(predictor.get_output_names()[0])
+    result = out.copy_to_cpu()
+"""
 
 from __future__ import annotations
+
+import numpy as np
 
 
 class Config:
     def __init__(self, model_path=None, params_path=None):
         self.model_path = model_path
+        self.params_path = params_path
 
+    # accepted-for-parity toggles: device/IR choices are XLA's business
     def enable_use_gpu(self, *a, **k):
         pass
 
@@ -21,6 +36,39 @@ class Config:
     def enable_memory_optim(self):
         pass
 
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+    def enable_mkldnn(self):
+        pass
+
+    def disable_glog_info(self):
+        pass
+
+
+class InferTensor:
+    """Handle for one predictor input/output (ref: paddle.inference.Tensor):
+    host-side staging with copy_from_cpu/copy_to_cpu."""
+
+    def __init__(self, name):
+        self.name = name
+        self._arr = None
+
+    def copy_from_cpu(self, arr):
+        self._arr = np.ascontiguousarray(arr)
+
+    def copy_to_cpu(self):
+        if self._arr is None:
+            raise RuntimeError(f"tensor {self.name!r} holds no data")
+        return np.asarray(self._arr)
+
+    def reshape(self, shape):
+        if self._arr is not None:
+            self._arr = self._arr.reshape(shape)
+
+    def shape(self):
+        return [] if self._arr is None else list(self._arr.shape)
+
 
 class Predictor:
     def __init__(self, config):
@@ -29,12 +77,61 @@ class Predictor:
         prefix = config.model_path
         if prefix and prefix.endswith(".pdmodel"):
             prefix = prefix[: -len(".pdmodel")]
-        self._layer = jit_load(prefix)
+        self._layer = jit_load(prefix, params_path=config.params_path)
+        names = getattr(self._layer, "_input_names", None) or []
+        self._inputs = {n: InferTensor(n) for n in names}
+        # persistent output handles, known BEFORE the first run (like the
+        # reference): one per exported output aval, updated in place
+        n_out = len(getattr(self._layer._exported, "out_avals", []) or [])
+        self._outputs = {f"output_{i}": InferTensor(f"output_{i}")
+                         for i in range(max(n_out, 1))}
 
-    def run(self, inputs):
-        outs = self._layer(*inputs)
-        return list(outs) if isinstance(outs, (list, tuple)) else [outs]
+    # ---------------- handle API (the reference workflow)
+    def get_input_names(self):
+        return list(self._inputs)
+
+    def get_input_handle(self, name):
+        if name not in self._inputs:
+            raise KeyError(f"unknown input {name!r}; inputs are "
+                           f"{list(self._inputs)}")
+        return self._inputs[name]
+
+    def get_output_names(self):
+        return list(self._outputs)
+
+    def get_output_handle(self, name):
+        if name not in self._outputs:
+            raise KeyError(f"unknown output {name!r}; outputs are "
+                           f"{list(self._outputs)}")
+        return self._outputs[name]
+
+    # ---------------- execution
+    def run(self, inputs=None):
+        """Handle mode: run() after copy_from_cpu on every input handle.
+        Legacy mode: run([np_arrays...]) returns a list of np arrays."""
+        if inputs is not None:
+            outs = self._layer(*inputs)
+            outs = outs if isinstance(outs, (list, tuple)) else [outs]
+            return [np.asarray(o._data) for o in outs]
+        missing = [n for n, h in self._inputs.items() if h._arr is None]
+        if missing:
+            raise RuntimeError(f"inputs not set: {missing}")
+        outs = self._layer(*[self._inputs[n]._arr for n in self._inputs])
+        outs = outs if isinstance(outs, (list, tuple)) else [outs]
+        for i, o in enumerate(outs):
+            name = f"output_{i}"
+            if name not in self._outputs:  # out_avals undercounted
+                self._outputs[name] = InferTensor(name)
+            self._outputs[name]._arr = np.asarray(o._data)  # in place:
+            # previously fetched handles keep observing fresh results
+        return True
 
 
 def create_predictor(config):
     return Predictor(config)
+
+
+# reference module aliases
+Tensor = InferTensor
+PrecisionType = type("PrecisionType", (), {"Float32": 0, "Half": 1,
+                                          "Bfloat16": 2, "Int8": 3})

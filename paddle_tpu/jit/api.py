@@ -272,6 +272,12 @@ def save(layer, path, input_spec=None, **configs):
                 outs = out if isinstance(out, (list, tuple)) else [out]
                 return tuple(o._data for o in outs)
 
+            in_names = [getattr(sp, "name", None) or f"input_{i}"
+                        for i, sp in enumerate(input_spec)]
+            if len(set(in_names)) != len(in_names):
+                raise ValueError(
+                    f"jit.save: input_spec names must be unique, got "
+                    f"{in_names}")
             example_args = [
                 jnp.zeros([1 if (s is None or s < 0) else s for s in spec.shape], spec.dtype)
                 for spec in input_spec
@@ -282,6 +288,7 @@ def save(layer, path, input_spec=None, **configs):
                 blob = {
                     "stablehlo": exported.serialize(),
                     "input_spec": [(list(s.shape), str(np.dtype(s.dtype) if s.dtype != jnp.bfloat16 else "bfloat16")) for s in input_spec],
+                    "input_names": in_names,
                     "state_names": names,
                 }
                 pickle.dump(blob, f)
@@ -292,10 +299,14 @@ def save(layer, path, input_spec=None, **configs):
 class TranslatedLayer(Layer):
     """jit.load result: runs the deserialized StableHLO program."""
 
-    def __init__(self, exported, state_arrays):
+    def __init__(self, exported, state_arrays, input_spec=None,
+                 input_names=None):
         super().__init__()
         self._exported = exported
         self._state_arrays = state_arrays
+        self._input_spec = input_spec or []
+        self._input_names = input_names or [
+            f"input_{i}" for i in range(len(self._input_spec))]
 
     def forward(self, *args):
         arrs = [a._data if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
@@ -310,6 +321,8 @@ def load(path, **configs):
     exported = jax.export.deserialize(blob["stablehlo"])
     from ..framework.io import load as fload
 
-    sd = fload(path + ".pdiparams")
+    sd = fload(configs.get("params_path") or path + ".pdiparams")
     state_arrays = [sd[k]._data for k in blob["state_names"]]
-    return TranslatedLayer(exported, state_arrays)
+    return TranslatedLayer(exported, state_arrays,
+                           input_spec=blob.get("input_spec"),
+                           input_names=blob.get("input_names"))

@@ -301,3 +301,78 @@ class _FailingDS:
         if i == 5:
             raise ValueError("boom")
         return np.asarray([i], np.float32)
+
+
+class TestInferencePredictor:
+    """paddle.inference over jit-saved StableHLO: the reference's
+    handle-based workflow end to end."""
+
+    def test_handle_workflow_roundtrip(self, tmp_path):
+        from paddle_tpu import inference
+        from paddle_tpu.static import InputSpec
+
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 3))
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(4, 8).astype(np.float32))
+        expect = model(x).numpy()
+        prefix = str(tmp_path / "m")
+        paddle.jit.save(model, prefix,
+                        input_spec=[InputSpec([4, 8], "float32", "feats")])
+
+        cfg = inference.Config(prefix + ".pdmodel", prefix + ".pdiparams")
+        pred = inference.create_predictor(cfg)
+        assert pred.get_input_names() == ["feats"]
+        h = pred.get_input_handle("feats")
+        h.copy_from_cpu(x.numpy())
+        # output handles are wireable BEFORE the first run, and persist
+        out_h = pred.get_output_handle(pred.get_output_names()[0])
+        pred.run()
+        np.testing.assert_allclose(out_h.copy_to_cpu(), expect,
+                                   rtol=1e-5, atol=1e-6)
+        # the SAME handle observes the next run's results (serving loop)
+        h.copy_from_cpu(x.numpy() * 2.0)
+        pred.run()
+        expect2 = model(paddle.to_tensor(x.numpy() * 2.0)).numpy()
+        np.testing.assert_allclose(out_h.copy_to_cpu(), expect2,
+                                   rtol=1e-5, atol=1e-6)
+        # legacy list mode still works
+        legacy = pred.run([x.numpy()])
+        np.testing.assert_allclose(legacy[0], expect, rtol=1e-5, atol=1e-6)
+
+    def test_missing_input_raises(self, tmp_path):
+        from paddle_tpu import inference
+        from paddle_tpu.static import InputSpec
+
+        model = nn.Linear(4, 2)
+        prefix = str(tmp_path / "m2")
+        paddle.jit.save(model, prefix,
+                        input_spec=[InputSpec([2, 4], "float32")])
+        pred = inference.create_predictor(inference.Config(prefix))
+        with pytest.raises(RuntimeError, match="inputs not set"):
+            pred.run()
+        with pytest.raises(KeyError):
+            pred.get_input_handle("nope")
+
+    def test_params_path_honored_and_dup_names_rejected(self, tmp_path):
+        import shutil
+
+        from paddle_tpu import inference
+        from paddle_tpu.static import InputSpec
+
+        model = nn.Linear(4, 2)
+        prefix = str(tmp_path / "m3")
+        paddle.jit.save(model, prefix,
+                        input_spec=[InputSpec([2, 4], "float32")])
+        # params living elsewhere (real paddle layout)
+        alt = str(tmp_path / "weights" / "final.pdiparams")
+        (tmp_path / "weights").mkdir()
+        shutil.move(prefix + ".pdiparams", alt)
+        pred = inference.create_predictor(
+            inference.Config(prefix + ".pdmodel", alt))
+        out = pred.run([np.zeros((2, 4), np.float32)])
+        assert out[0].shape == (2, 2)
+        with pytest.raises(ValueError, match="unique"):
+            paddle.jit.save(model, str(tmp_path / "m4"),
+                            input_spec=[InputSpec([2, 4], "float32", "x"),
+                                        InputSpec([2, 4], "float32", "x")])
