@@ -222,6 +222,41 @@ def bench_llama():
     return _utilization(res, step, (ids, ids), tps, B * S)
 
 
+def bench_gpt_longseq(seq=8192, batch=2):
+    """Long-context single-chip row: the flagship GPT at s4096/s8192 with
+    full recompute — Pallas flash keeps attention memory linear in seq
+    (dense softmax OOMs at s4096); tok/s decline vs s1024 tracks
+    attention's quadratic FLOPs share plus the remat re-forward."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=32000, hidden_size=1536,
+                    intermediate_size=4096, num_hidden_layers=12,
+                    num_attention_heads=12, max_position_embeddings=seq,
+                    fused_lm_loss=True, use_recompute=True)
+    model = GPTForCausalLM(cfg)
+    model.to(dtype="bfloat16")
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters(),
+                                 multi_precision=True)
+
+    def loss_fn(net, ids, labels):
+        loss, _ = net(ids, labels=labels)
+        return loss
+
+    step = paddle.jit.TrainStep(model, loss_fn, opt)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rng.randint(0, 32000, (batch, seq)).astype(np.int32))
+    tps = _measure(lambda: step(ids, ids), lambda o: float(o), batch * seq,
+                   steps=6)
+    res = {"metric": (f"tokens/sec/chip GPT-438M bf16+recompute long-seq "
+                      f"train (b{batch}xs{seq})"),
+           "value": round(tps, 1), "unit": "tokens/s"}
+    return _utilization(res, step, (ids, ids), tps, batch * seq)
+
+
 def bench_ernie_hybrid():
     """ERNIE-style HybridParallel composition (BASELINE.json north-star
     family): tp2 x pp2 x dp2 on an 8-device mesh. On a single-chip box this
@@ -263,6 +298,8 @@ def main():
                "unet_b16": lambda: bench_unet(B=16),
                "bert_b128": lambda: bench_bert(B=128),
                "resnet50_b256": lambda: bench_resnet50(B=256),
+               "gpt_s4096": lambda: bench_gpt_longseq(seq=4096, batch=4),
+               "gpt_s8192": bench_gpt_longseq,
                "llama": bench_llama,
                "ernie_hybrid": bench_ernie_hybrid}
     if which != "all" and which not in benches:
@@ -273,7 +310,7 @@ def main():
     # reproduction and throughput-optimal unet_b16 runs stay opt-in
     names = ([n for n in benches
               if n not in ("resnet50_f32", "unet_b16", "bert_b128",
-                           "resnet50_b256")]
+                           "resnet50_b256", "gpt_s4096", "gpt_s8192")]
              if which == "all" else [which])
     if which == "all":
         # one fresh process per bench: HBM from a previous model (cached
