@@ -102,18 +102,25 @@ def __getattr__(name):
 
 
 def disable_static(place=None):
-    """paddle API parity: dygraph is the only eager mode here."""
+    """Leave static-graph mode (back to the dygraph default)."""
+    from .static.graph import disable_static as _ds
+
+    _ds()
     return None
 
 
 def enable_static():
-    raise NotImplementedError(
-        "paddle_tpu is dygraph-first; use paddle_tpu.jit.to_static for compiled execution"
-    )
+    """Enter static-graph mode: static.data placeholders + lazy op
+    recording + Executor.run (see paddle_tpu.static)."""
+    from .static.graph import enable_static as _es
+
+    _es()
 
 
 def in_dynamic_mode():
-    return True
+    from .static.graph import in_static_mode
+
+    return not in_static_mode()
 
 
 def is_compiled_with_xpu():

@@ -27,10 +27,21 @@ def _is_float(dtype) -> bool:
 # hot eager path pays nothing when AMP was never imported.
 _AMP_LOOKUP = None
 
+# Static-graph integration point: paddle.enable_static() installs a handler
+# (static/graph.py) that records ops touching symbolic placeholders into the
+# current Program instead of executing them. None (the default) keeps the
+# eager hot path untouched.
+_STATIC_HANDLER = None
+
 
 def set_amp_lookup(fn):
     global _AMP_LOOKUP
     _AMP_LOOKUP = fn
+
+
+def set_static_handler(fn):
+    global _STATIC_HANDLER
+    _STATIC_HANDLER = fn
 
 
 def _maybe_amp_wrap(fn, op_name):
@@ -62,6 +73,10 @@ def apply(fn, *args, _op_name: str = "", **kwargs):
     pass through untouched (treated as constants).
     """
     fn = _maybe_amp_wrap(fn, _op_name)
+    if _STATIC_HANDLER is not None:
+        staged = _STATIC_HANDLER(fn, args, kwargs, _op_name)
+        if staged is not None:
+            return staged
     tensor_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
     arrays = list(args)
     in_tensors = []

@@ -1,54 +1,36 @@
-"""paddle.static compatibility shim.
+"""paddle.static — a REAL minimal static-graph mode (ref: ProgramDesc +
+Executor/InterpreterCore, SURVEY.md §2.1 N10/N11).
 
-The reference's static graph stack (ProgramDesc/Executor/InterpreterCore —
-SURVEY.md §2.1 N10/N11) is deliberately NOT rebuilt: under XLA the compiled
-program IS the static graph, produced by tracing (`paddle_tpu.jit.to_static`).
-This module keeps the commonly-used entry points alive, mapping them to their
-trace-based equivalents, and raises informative errors for the legacy
-Program-construction API.
+TPU-native stance, upgraded in r3: instead of rebuilding a ProgramDesc
+interpreter, static mode makes the op dispatch LAZY — `static.data`
+placeholders are symbolic, ops touching them record graph nodes (out shapes
+via jax abstract eval, the InferMeta analog), and `Executor.run` compiles
+the fetched subgraph as ONE `jax.jit` program of the feeds. Forward graphs
+only: build / run / save_inference_model (StableHLO, servable by
+paddle.inference) / load_inference_model. Static-mode TRAINING
+(append_backward, optimizer.minimize) remains a declared non-goal — train
+in dygraph and compile with `paddle_tpu.jit.TrainStep` (SURVEY.md §7).
 """
 
 from ..jit.api import InputSpec
 from ..nn import Layer  # re-export convenience
 
+from .graph import (
+    Executor,
+    Program,
+    StaticGraphError,
+    data,
+    default_main_program,
+    default_startup_program,
+    disable_static,
+    enable_static,
+    in_static_mode,
+    load_inference_model,
+    program_guard,
+    save_inference_model,
+)
 
-def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None, **kwargs):
-    raise NotImplementedError(
-        "Static Program serialization is replaced by paddle_tpu.jit.save "
-        "(weights + serialized StableHLO via jax.export)."
-    )
-
-
-def load_inference_model(path_prefix, executor=None, **kwargs):
-    raise NotImplementedError("Use paddle_tpu.jit.load.")
-
-
-class Program:
-    def __init__(self, *a, **k):
-        raise NotImplementedError(
-            "Explicit Program construction does not exist on the TPU build; "
-            "decorate your function with paddle_tpu.jit.to_static instead."
-        )
-
-
-def default_main_program():
-    raise NotImplementedError("No global static program; use jit.to_static.")
-
-
-def default_startup_program():
-    raise NotImplementedError("No global static program; use jit.to_static.")
-
-
-class Executor:
-    def __init__(self, place=None):
-        raise NotImplementedError(
-            "The XLA runtime executes compiled programs directly; use "
-            "jit.to_static / jit.TrainStep instead of Executor.run."
-        )
-
-
-def data(name, shape, dtype="float32", lod_level=0):
-    return InputSpec(shape, dtype, name)
+from . import nn  # noqa: E402
 
 
 def name_scope(prefix=None):
@@ -58,10 +40,8 @@ def name_scope(prefix=None):
 
 
 class _ShimAttributeError(NotImplementedError, AttributeError):
-    """Raised by namespace shims: informative like the sibling shims'
-    NotImplementedError, but still an AttributeError so hasattr/getattr
-    feature-detection (and dunder protocol lookups, e.g. deepcopy) keep
-    working for code ported from the reference."""
+    """Informative like NotImplementedError, but still an AttributeError so
+    hasattr/getattr feature-detection keeps working for ported code."""
 
 
 class _StaticAmpShim:
@@ -72,10 +52,17 @@ class _StaticAmpShim:
 
     def __getattr__(self, name):
         raise _ShimAttributeError(
-            f"paddle.static.amp.{name} rewrites static Programs, which do not "
-            "exist on the TPU build; use paddle_tpu.amp.auto_cast / "
-            "amp.decorate with jit.to_static instead."
-        )
+            f"paddle.static.amp.{name} rewrites static Programs; use "
+            "paddle_tpu.amp.auto_cast / amp.decorate with jit.to_static "
+            "instead.")
 
 
 amp = _StaticAmpShim()
+
+__all__ = [
+    "InputSpec", "Layer", "Executor", "Program", "StaticGraphError",
+    "data", "default_main_program", "default_startup_program",
+    "disable_static", "enable_static", "in_static_mode",
+    "load_inference_model", "program_guard", "save_inference_model", "nn",
+    "name_scope", "amp",
+]

@@ -1,0 +1,360 @@
+"""A REAL (minimal) static-graph mode, TPU-natively (ref: the
+Program/Executor stack, SURVEY.md §2.1 N10/N11 — there the graph is a
+ProgramDesc interpreted by InterpreterCore; here "the jaxpr IS the program"
+is made literal).
+
+Design: every eager op already funnels through `core.op_call.apply`. Under
+`paddle.enable_static()`, `static.data(...)` returns a placeholder Tensor
+whose `_data` is a symbolic shape/dtype carrier; `apply` (via the handler
+installed below) sees a symbolic input and, instead of executing, RECORDS a
+graph node (out shapes from `jax.eval_shape` — the InferMeta analog) and
+returns symbolic outputs. `Executor.run(feed, fetch_list)` evaluates the
+recorded DAG as ONE `jax.jit`-compiled function of the feeds — concrete
+tensors captured along the way (parameters, constants) ride in as closure
+constants, exactly like a frozen inference program.
+
+Scope (documented): forward graphs — build, run, save/load for serving.
+Static-mode training (append_backward / minimize) remains out of scope;
+training is the dygraph + jit.TrainStep path (SURVEY.md §7 design stance).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import op_call as _op_call
+
+
+class StaticGraphError(RuntimeError):
+    pass
+
+
+class _SymArr:
+    """Symbolic value: shape/dtype (for InferMeta-style queries) + the
+    producing graph node. Any attempt to touch concrete data raises."""
+
+    __slots__ = ("aval", "node", "out_idx", "feed_name")
+
+    def __init__(self, aval, node=None, out_idx=0, feed_name=None):
+        self.aval = aval
+        self.node = node
+        self.out_idx = out_idx
+        self.feed_name = feed_name
+
+    @property
+    def shape(self):
+        return self.aval.shape
+
+    @property
+    def dtype(self):
+        return self.aval.dtype
+
+    @property
+    def ndim(self):
+        return len(self.aval.shape)
+
+    @property
+    def size(self):
+        return int(np.prod(self.aval.shape)) if self.aval.shape else 1
+
+    def __getattr__(self, name):
+        raise StaticGraphError(
+            f"'{name}' needs concrete data, but this Tensor is symbolic "
+            "(inside a static Program). Run it through Executor.run, or "
+            "use ops routed through the standard dispatch.")
+
+    def __repr__(self):
+        src = self.feed_name or (self.node.op_name if self.node else "?")
+        return f"SymArr({self.aval.shape}, {self.aval.dtype}, from={src})"
+
+
+class _Node:
+    """One recorded op: fn(*inputs, **kwargs) -> n outputs."""
+
+    __slots__ = ("fn", "inputs", "kwargs", "n_out", "op_name")
+
+    def __init__(self, fn, inputs, kwargs, n_out, op_name):
+        self.fn = fn
+        self.inputs = inputs      # list of _SymArr | concrete jax arrays
+        self.kwargs = kwargs
+        self.n_out = n_out
+        self.op_name = op_name
+
+
+class Program:
+    """Holds the placeholders created under its guard (the graph itself is
+    the web of _Node objects reachable from fetched values)."""
+
+    def __init__(self):
+        self.placeholders = {}   # name -> Tensor (symbolic)
+
+    def global_block(self):
+        return self
+
+    @property
+    def vars(self):
+        return dict(self.placeholders)
+
+    def clone(self, for_test=False):
+        return self
+
+
+_state = {"static": False, "main": Program(), "startup": Program()}
+
+
+def enable_static():
+    _state["static"] = True
+    _op_call.set_static_handler(_static_apply)
+
+
+def disable_static():
+    _state["static"] = False
+    _op_call.set_static_handler(None)
+
+
+def in_static_mode():
+    return _state["static"]
+
+
+def default_main_program():
+    return _state["main"]
+
+
+def default_startup_program():
+    return _state["startup"]
+
+
+class program_guard:
+    def __init__(self, main_program, startup_program=None):
+        self._main = main_program
+        self._startup = startup_program or Program()
+
+    def __enter__(self):
+        self._saved = (_state["main"], _state["startup"])
+        _state["main"], _state["startup"] = self._main, self._startup
+        return self
+
+    def __exit__(self, *exc):
+        _state["main"], _state["startup"] = self._saved
+        return False
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Placeholder (ref static.data): symbolic input of the main program.
+    Leading None/-1 dims become 1 for tracing (dynamic batch is re-traced
+    per concrete feed shape by Executor)."""
+    if not _state["static"]:
+        raise StaticGraphError("static.data requires paddle.enable_static()")
+    norm = tuple(1 if (s is None or s < 0) else int(s) for s in shape)
+    aval = jax.ShapeDtypeStruct(norm, jnp.dtype(dtype))
+    t = Tensor.__new__(Tensor)
+    t._data = _SymArr(aval, feed_name=name)
+    t.grad = None
+    t.stop_gradient = True
+    t._tape_node = None
+    t.name = name
+    t.persistable = False
+    t.trainable = False
+    _state["main"].placeholders[name] = t
+    return t
+
+
+def _is_sym(x):
+    return isinstance(x, Tensor) and isinstance(x._data, _SymArr)
+
+
+def _static_apply(fn, args, kwargs, op_name):
+    """Handler installed into op_call.apply under static mode. Returns None
+    when no symbolic input is involved (pure eager constants); otherwise
+    records a node and returns symbolic output Tensor(s)."""
+    if not any(_is_sym(a) for a in args):
+        return None
+    inputs = []
+    sym_positions = []
+    for i, a in enumerate(args):
+        if _is_sym(a):
+            inputs.append(a._data)
+            sym_positions.append(i)
+        elif isinstance(a, Tensor):
+            inputs.append(a._data)
+        else:
+            inputs.append(a)
+
+    # InferMeta: abstract-evaluate with symbolic avals at sym positions
+    sym_idx = [i for i, x in enumerate(inputs) if isinstance(x, _SymArr)]
+
+    def probe(*sym_vals):
+        full = list(inputs)
+        for j, i in enumerate(sym_idx):
+            full[i] = sym_vals[j]
+        return fn(*full, **kwargs)
+
+    sym_avals = [inputs[i].aval for i in sym_idx]
+    try:
+        out_sds = jax.eval_shape(probe, *sym_avals)
+    except StaticGraphError:
+        raise
+    except Exception as e:
+        raise StaticGraphError(
+            f"op {op_name or getattr(fn, '__name__', 'op')!r} cannot be "
+            f"staged into the static program: {type(e).__name__}: {e}"
+        ) from e
+    multi = isinstance(out_sds, (tuple, list))
+    outs_flat = list(out_sds) if multi else [out_sds]
+    # namedtuples (e.g. linalg results) collapse to plain tuple, matching
+    # the eager path's _out_type
+    container = tuple if hasattr(out_sds, "_fields") else type(out_sds)
+    node = _Node(fn, inputs, kwargs, len(outs_flat),
+                 op_name or getattr(fn, "__name__", "op"))
+    out_tensors = []
+    for i, sds in enumerate(outs_flat):
+        t = Tensor.__new__(Tensor)
+        t._data = _SymArr(jax.ShapeDtypeStruct(sds.shape, sds.dtype),
+                          node=node, out_idx=i)
+        t.grad = None
+        t.stop_gradient = True
+        t._tape_node = None
+        t.name = None
+        t.persistable = False
+        t.trainable = False
+        out_tensors.append(t)
+    if multi:
+        return container(out_tensors)
+    return out_tensors[0]
+
+
+def _evaluate(fetch_syms, feed_values):
+    """Evaluate the DAG for the given fetches. feed_values: name->array.
+    Memoized over nodes; runs under whatever trace calls it (Executor jits
+    it)."""
+    node_memo = {}
+
+    def feed_of(sym):
+        try:
+            return feed_values[sym.feed_name]
+        except KeyError:
+            raise StaticGraphError(
+                f"missing feed for placeholder {sym.feed_name!r}")
+
+    def value_of(sym):
+        """Iterative post-order over producers — a sequential graph deeper
+        than the interpreter recursion limit must still evaluate."""
+        if sym.feed_name is not None:
+            return feed_of(sym)
+        if sym.node is None:
+            raise StaticGraphError("symbolic value with no producer")
+        stack = [sym.node]
+        while stack:
+            n = stack[-1]
+            if id(n) in node_memo:
+                stack.pop()
+                continue
+            pending = [x.node for x in n.inputs
+                       if isinstance(x, _SymArr) and x.feed_name is None
+                       and x.node is not None and id(x.node) not in node_memo]
+            if pending:
+                stack.extend(pending)
+                continue
+            stack.pop()
+            full = []
+            for x in n.inputs:
+                if isinstance(x, _SymArr):
+                    full.append(feed_of(x) if x.feed_name is not None
+                                else node_memo[id(x.node)][x.out_idx])
+                else:
+                    full.append(x)
+            out = n.fn(*full, **n.kwargs)
+            node_memo[id(n)] = list(out) if isinstance(out, (tuple, list)) \
+                else [out]
+        return node_memo[id(sym.node)][sym.out_idx]
+
+    return [value_of(s) for s in fetch_syms]
+
+
+class Executor:
+    """ref static.Executor: compiles + runs the fetched subgraph as ONE
+    XLA program per (feed shapes) signature."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache = {}
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True):
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        syms = []
+        for f in fetch_list:
+            if not _is_sym(f):
+                raise StaticGraphError(
+                    "fetch_list entries must be static-program Tensors")
+            syms.append(f._data)
+        feed_names = sorted(feed)
+        feed_arrays = [jnp.asarray(np.asarray(feed[k])) for k in feed_names]
+        key = (tuple(id(s) for s in syms), tuple(feed_names),
+               tuple((a.shape, str(a.dtype)) for a in feed_arrays))
+        if key not in self._cache:
+            def eval_fn(*arrays):
+                vals = dict(zip(feed_names, arrays))
+                return tuple(_evaluate(syms, vals))
+
+            self._cache[key] = jax.jit(eval_fn)
+        outs = self._cache[key](*feed_arrays)
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        return [Tensor(o) for o in outs]
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         **kwargs):
+    """ref static.save_inference_model: export the fetched subgraph as the
+    same StableHLO artifact jit.save writes — loadable by jit.load AND
+    servable by paddle.inference.create_predictor."""
+    import os
+    import pickle
+
+    if not isinstance(feed_vars, (list, tuple)):
+        feed_vars = [feed_vars]
+    if not isinstance(fetch_vars, (list, tuple)):
+        fetch_vars = [fetch_vars]
+    names = []
+    for v in feed_vars:
+        if not (_is_sym(v) and v._data.feed_name):
+            raise StaticGraphError("feed_vars must be static.data placeholders")
+        names.append(v._data.feed_name)
+    syms = [v._data for v in fetch_vars]
+
+    def infer_fn(state_arrays, *arg_arrays):
+        del state_arrays  # graph constants ride in the closure
+        vals = dict(zip(names, arg_arrays))
+        return tuple(_evaluate(syms, vals))
+
+    example = [jnp.zeros(v._data.aval.shape, v._data.aval.dtype)
+               for v in feed_vars]
+    exported = jax.export.export(jax.jit(infer_fn))([], *example)
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    from ..framework.io import save as fsave
+
+    fsave({}, path_prefix + ".pdiparams")
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        pickle.dump({
+            "stablehlo": exported.serialize(),
+            "input_spec": [(list(v._data.aval.shape),
+                            str(np.dtype(v._data.aval.dtype)))
+                           for v in feed_vars],
+            "input_names": names,
+            "state_names": [],
+        }, f)
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """ref static.load_inference_model -> (program, feed_names,
+    fetch_targets); here the 'program' is the loaded TranslatedLayer."""
+    from ..jit.api import load as jit_load
+
+    layer = jit_load(path_prefix)
+    return layer, list(getattr(layer, "_input_names", [])), layer

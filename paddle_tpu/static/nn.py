@@ -1,0 +1,45 @@
+"""static.nn — the classic static-graph layer helpers (ref: the paddle 1.x
+`fluid.layers`/`static.nn` family). Parameters are created eagerly
+(concrete) and captured by the recorded graph as constants; the data path
+stays symbolic."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Parameter
+from ..nn import functional as F
+
+
+def fc(x, size, num_flatten_dims=1, activation=None, name=None,
+       weight_attr=None, bias_attr=None):
+    """ref static.nn.fc: flatten trailing dims, affine, optional act.
+    Weights draw from the framework RNG (paddle.seed-respecting, distinct
+    per call)."""
+    if weight_attr is not None or bias_attr is not None:
+        raise NotImplementedError(
+            "static.nn.fc: weight_attr/bias_attr initializers are not "
+            "supported; build the model with paddle_tpu.nn layers instead")
+    import jax
+
+    from ..core import random as random_state
+
+    in_dim = int(np.prod(x.shape[num_flatten_dims:]))
+    k = 1.0 / np.sqrt(in_dim)
+    w = Parameter(np.asarray(jax.random.uniform(
+        random_state.next_key(), (in_dim, size), np.float32, -k, k)))
+    b = Parameter(np.zeros((size,), np.float32))
+    if x.ndim > num_flatten_dims + 1:
+        from ..tensor.manipulation import reshape
+
+        x = reshape(x, list(x.shape[:num_flatten_dims]) + [in_dim])
+    out = F.linear(x, w, b)
+    if activation:
+        out = getattr(F, activation)(out)
+    return out
+
+
+def batch_norm(x, **kwargs):
+    raise NotImplementedError(
+        "static.nn.batch_norm: build the model with paddle_tpu.nn layers "
+        "and stage it via static mode or jit.to_static")

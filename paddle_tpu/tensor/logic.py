@@ -99,4 +99,6 @@ def is_tensor(x):
 
 
 def in_dynamic_mode():
-    return True
+    from ..static.graph import in_static_mode
+
+    return not in_static_mode()

@@ -1,0 +1,163 @@
+"""paddle.static: the r3 lazy static-graph mode — build via recorded
+dispatch, execute as one jitted program, serve via the shared StableHLO
+artifact (SURVEY.md §2.1 N10/N11)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.static as static
+
+
+@pytest.fixture
+def static_mode():
+    paddle.enable_static()
+    try:
+        yield
+    finally:
+        paddle.disable_static()
+
+
+class TestStaticGraph:
+    def test_build_run_matches_eager(self, static_mode):
+        with static.program_guard(static.Program()):
+            x = static.data("x", [None, 8], "float32")
+            w = paddle.to_tensor(np.random.RandomState(0)
+                                 .randn(8, 4).astype(np.float32))
+            y = paddle.nn.functional.softmax(paddle.matmul(x, w))
+            exe = static.Executor()
+            feed = np.random.RandomState(1).randn(5, 8).astype(np.float32)
+            out = exe.run(feed={"x": feed}, fetch_list=[y])[0]
+        paddle.disable_static()
+        expect = paddle.nn.functional.softmax(
+            paddle.matmul(paddle.to_tensor(feed), paddle.to_tensor(
+                np.asarray(w._data)))).numpy()
+        np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-6)
+
+    def test_nn_layers_stage_into_graph(self, static_mode):
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 3))
+        with static.program_guard(static.Program()):
+            x = static.data("x", [4, 8], "float32")
+            y = model(x)
+            assert y.shape == [4, 3]          # InferMeta worked
+            exe = static.Executor()
+            feed = np.random.RandomState(2).randn(4, 8).astype(np.float32)
+            got = exe.run(feed={"x": feed}, fetch_list=[y])[0]
+        paddle.disable_static()
+        expect = model(paddle.to_tensor(feed)).numpy()
+        np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-6)
+
+    def test_dynamic_batch_retraces(self, static_mode):
+        x = static.data("xb", [None, 4], "float32")
+        y = (x * 2.0).sum()
+        exe = static.Executor()
+        for bs in (2, 6):
+            out = exe.run(feed={"xb": np.ones((bs, 4), np.float32)},
+                          fetch_list=[y])[0]
+            np.testing.assert_allclose(out, 8.0 * bs)
+
+    def test_static_nn_fc(self, static_mode):
+        x = static.data("xf", [3, 8], "float32")
+        h = static.nn.fc(x, 16, activation="relu")
+        y = static.nn.fc(h, 2)
+        out = static.Executor().run(
+            feed={"xf": np.random.RandomState(3)
+                  .randn(3, 8).astype(np.float32)},
+            fetch_list=[y])[0]
+        assert out.shape == (3, 2) and np.isfinite(out).all()
+
+    def test_missing_feed_and_concrete_touch_raise(self, static_mode):
+        x = static.data("xm", [2, 2], "float32")
+        y = x + 1.0
+        with pytest.raises(static.StaticGraphError, match="missing feed"):
+            static.Executor().run(feed={}, fetch_list=[y])
+        with pytest.raises(static.StaticGraphError):
+            y.numpy()   # symbolic: no concrete data
+
+    def test_eager_unaffected_outside_and_after(self, static_mode):
+        t = paddle.to_tensor(np.ones((2,), np.float32))
+        np.testing.assert_allclose((t + t).numpy(), [2.0, 2.0])
+        assert not paddle.in_dynamic_mode()
+        paddle.disable_static()
+        assert paddle.in_dynamic_mode()
+
+    def test_save_inference_model_serves_via_predictor(self, tmp_path,
+                                                       static_mode):
+        from paddle_tpu import inference
+
+        paddle.seed(0)
+        model = nn.Linear(8, 3)
+        x = static.data("feats", [4, 8], "float32")
+        y = paddle.nn.functional.softmax(model(x))
+        prefix = str(tmp_path / "static_m")
+        static.save_inference_model(prefix, [x], [y])
+        paddle.disable_static()
+
+        pred = inference.create_predictor(inference.Config(prefix))
+        assert pred.get_input_names() == ["feats"]
+        feed = np.random.RandomState(4).randn(4, 8).astype(np.float32)
+        h = pred.get_input_handle("feats")
+        h.copy_from_cpu(feed)
+        pred.run()
+        got = pred.get_output_handle("output_0").copy_to_cpu()
+        expect = paddle.nn.functional.softmax(
+            model(paddle.to_tensor(feed))).numpy()
+        np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-6)
+
+    def test_load_inference_model(self, tmp_path, static_mode):
+        model = nn.Linear(4, 2)
+        x = static.data("inp", [2, 4], "float32")
+        y = model(x)
+        prefix = str(tmp_path / "lim")
+        static.save_inference_model(prefix, [x], [y])
+        paddle.disable_static()
+        layer, feed_names, fetch = static.load_inference_model(prefix)
+        assert feed_names == ["inp"]
+        out = layer(paddle.to_tensor(np.zeros((2, 4), np.float32)))
+        assert out.shape == [2, 2]
+
+    def test_deep_sequential_graph_evaluates(self, static_mode):
+        # deeper than Python's recursion limit: the DAG walk is iterative
+        x = static.data("xd", [2, 2], "float32")
+        y = x
+        for _ in range(1500):
+            y = y + 1.0
+        out = static.Executor().run(
+            feed={"xd": np.zeros((2, 2), np.float32)}, fetch_list=[y])[0]
+        np.testing.assert_allclose(out, np.full((2, 2), 1500.0))
+
+    def test_namedtuple_output_op_stages(self, static_mode):
+        x = static.data("xs", [4, 4], "float32")
+        u, s, vt = paddle.linalg.svd(x)
+        feed = np.random.RandomState(5).randn(4, 4).astype(np.float32)
+        got_s = static.Executor().run(feed={"xs": feed},
+                                      fetch_list=[s])[0]
+        np.testing.assert_allclose(got_s, np.linalg.svd(feed)[1],
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_fc_layers_get_distinct_weights(self, static_mode):
+        paddle.seed(123)
+        x = static.data("xw", [2, 8], "float32")
+        h1 = static.nn.fc(x, 8)
+        h2 = static.nn.fc(h1, 8)
+        out = static.Executor().run(
+            feed={"xw": np.ones((2, 8), np.float32)},
+            fetch_list=[h1, h2])
+        assert not np.allclose(out[0], out[1])
+
+    def test_name_scope_and_amp_shim_survive(self, static_mode):
+        with static.name_scope("block"):
+            pass
+        assert not hasattr(static.amp, "decorate")  # informative AttributeError
+        with pytest.raises(NotImplementedError):
+            static.amp.decorate
+
+    def test_tensor_namespace_in_dynamic_mode_tracks_static(self,
+                                                            static_mode):
+        import paddle_tpu.tensor as T
+
+        assert T.in_dynamic_mode() is False
+        paddle.disable_static()
+        assert T.in_dynamic_mode() is True
