@@ -284,16 +284,27 @@ def save(layer, path, input_spec=None, **configs):
             ]
             state_arrays = [sd[k]._data for k in names]
             exported = jax.export.export(jax.jit(infer_fn))(state_arrays, *example_args)
-            with open(path + ".pdmodel", "wb") as f:
-                blob = {
-                    "stablehlo": exported.serialize(),
-                    "input_spec": [(list(s.shape), str(np.dtype(s.dtype) if s.dtype != jnp.bfloat16 else "bfloat16")) for s in input_spec],
-                    "input_names": in_names,
-                    "state_names": names,
-                }
-                pickle.dump(blob, f)
+            write_artifact(
+                path, exported,
+                [(list(s.shape),
+                  str(np.dtype(s.dtype) if s.dtype != jnp.bfloat16
+                      else "bfloat16")) for s in input_spec],
+                in_names, names)
     else:
         raise TypeError("jit.save expects a Layer")
+
+
+def write_artifact(path, exported, input_spec, input_names, state_names):
+    """The ONE .pdmodel blob schema — shared by jit.save and
+    static.save_inference_model so jit.load / inference.Predictor never
+    see divergent producers."""
+    with open(path + ".pdmodel", "wb") as f:
+        pickle.dump({
+            "stablehlo": exported.serialize(),
+            "input_spec": input_spec,
+            "input_names": input_names,
+            "state_names": state_names,
+        }, f)
 
 
 class TranslatedLayer(Layer):

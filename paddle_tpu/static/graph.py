@@ -33,17 +33,23 @@ class StaticGraphError(RuntimeError):
     pass
 
 
+class SymbolicDataError(StaticGraphError, AttributeError):
+    """Touching concrete data on a symbolic tensor. AttributeError-
+    compatible so hasattr/getattr feature detection keeps working."""
+
+
 class _SymArr:
     """Symbolic value: shape/dtype (for InferMeta-style queries) + the
     producing graph node. Any attempt to touch concrete data raises."""
 
-    __slots__ = ("aval", "node", "out_idx", "feed_name")
+    __slots__ = ("aval", "node", "out_idx", "feed_name", "orig_shape")
 
     def __init__(self, aval, node=None, out_idx=0, feed_name=None):
         self.aval = aval
         self.node = node
         self.out_idx = out_idx
         self.feed_name = feed_name
+        self.orig_shape = None
 
     @property
     def shape(self):
@@ -61,8 +67,43 @@ class _SymArr:
     def size(self):
         return int(np.prod(self.aval.shape)) if self.aval.shape else 1
 
-    def __getattr__(self, name):
+    def _concrete_needed(self, what):
+        # NOT an AttributeError: numpy/python protocol machinery must see
+        # a loud failure, not an absent-method fallback
         raise StaticGraphError(
+            f"{what} needs concrete data, but this Tensor is symbolic "
+            "(inside a static Program). Run it through Executor.run, or "
+            "use ops routed through the standard dispatch.")
+
+    # data-access protocols raise loudly when CALLED (defined explicitly —
+    # were they routed through __getattr__'s AttributeError, numpy et al.
+    # would silently fall back to object arrays)
+    def __array__(self, *a, **k):
+        self._concrete_needed("__array__")
+
+    def __float__(self):
+        self._concrete_needed("__float__")
+
+    def __int__(self):
+        self._concrete_needed("__int__")
+
+    def __bool__(self):
+        self._concrete_needed("__bool__")
+
+    def __index__(self):
+        self._concrete_needed("__index__")
+
+    def __len__(self):
+        self._concrete_needed("__len__")
+
+    def __iter__(self):
+        self._concrete_needed("__iter__")
+
+    def __getattr__(self, name):
+        if name.startswith("__") and name.endswith("__"):
+            # protocol probes (deepcopy/pickle/...) fall back quietly
+            raise AttributeError(name)
+        raise SymbolicDataError(
             f"'{name}' needs concrete data, but this Tensor is symbolic "
             "(inside a static Program). Run it through Executor.run, or "
             "use ops routed through the standard dispatch.")
@@ -153,6 +194,8 @@ def data(name, shape, dtype="float32", lod_level=0):
     aval = jax.ShapeDtypeStruct(norm, jnp.dtype(dtype))
     t = Tensor.__new__(Tensor)
     t._data = _SymArr(aval, feed_name=name)
+    t._data.orig_shape = tuple(None if (s is None or s < 0) else int(s)
+                               for s in shape)
     t.grad = None
     t.stop_gradient = True
     t._tape_node = None
@@ -174,11 +217,9 @@ def _static_apply(fn, args, kwargs, op_name):
     if not any(_is_sym(a) for a in args):
         return None
     inputs = []
-    sym_positions = []
     for i, a in enumerate(args):
         if _is_sym(a):
             inputs.append(a._data)
-            sym_positions.append(i)
         elif isinstance(a, Tensor):
             inputs.append(a._data)
         else:
@@ -333,22 +374,39 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
         vals = dict(zip(names, arg_arrays))
         return tuple(_evaluate(syms, vals))
 
-    example = [jnp.zeros(v._data.aval.shape, v._data.aval.dtype)
-               for v in feed_vars]
+    # dynamic (None/-1) placeholder dims export as SYMBOLIC dims so the
+    # served program accepts any size there (batch polymorphism)
+    spec_shapes = []
+    example = []
+    dynamic = any(v._data.orig_shape and None in v._data.orig_shape
+                  for v in feed_vars)
+    sym_dims = {}
+    for v in feed_vars:
+        orig = v._data.orig_shape or v._data.aval.shape
+        dims = []
+        for ax, d in enumerate(orig):
+            if d is None:
+                key = f"d{len(sym_dims)}"
+                if key not in sym_dims:
+                    (sym_dims[key],) = jax.export.symbolic_shape(key)
+                dims.append(sym_dims[key])
+            else:
+                dims.append(int(d))
+        example.append(jax.ShapeDtypeStruct(tuple(dims), v._data.aval.dtype)
+                       if dynamic else
+                       jnp.zeros(tuple(dims), v._data.aval.dtype))
+        spec_shapes.append([None if d is None else int(d) for d in orig])
     exported = jax.export.export(jax.jit(infer_fn))([], *example)
     os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
     from ..framework.io import save as fsave
+    from ..jit.api import write_artifact
 
     fsave({}, path_prefix + ".pdiparams")
-    with open(path_prefix + ".pdmodel", "wb") as f:
-        pickle.dump({
-            "stablehlo": exported.serialize(),
-            "input_spec": [(list(v._data.aval.shape),
-                            str(np.dtype(v._data.aval.dtype)))
-                           for v in feed_vars],
-            "input_names": names,
-            "state_names": [],
-        }, f)
+    write_artifact(
+        path_prefix, exported,
+        [(shape, str(np.dtype(v._data.aval.dtype)))
+         for shape, v in zip(spec_shapes, feed_vars)],
+        names, [])
 
 
 def load_inference_model(path_prefix, executor=None, **kwargs):

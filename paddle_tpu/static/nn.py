@@ -32,7 +32,10 @@ def fc(x, size, num_flatten_dims=1, activation=None, name=None,
     if x.ndim > num_flatten_dims + 1:
         from ..tensor.manipulation import reshape
 
-        x = reshape(x, list(x.shape[:num_flatten_dims]) + [in_dim])
+        # -1 on the leading (possibly dynamic-batch) dim: the recorded
+        # reshape must not bake in the build-time placeholder size
+        tail = list(x.shape[1:num_flatten_dims]) + [in_dim]
+        x = reshape(x, [-1] + tail)
     out = F.linear(x, w, b)
     if activation:
         out = getattr(F, activation)(out)

@@ -1,0 +1,102 @@
+"""Companion: the LONG-CONTEXT and MoE axes across real processes — ring
+(context-parallel) flash attention over a sep=8 axis spanning two
+rendezvoused processes (k/v blocks ppermute THROUGH the process boundary)
+and an ep=8 MoE all_to_all dispatch crossing it likewise. MP_SERIAL=1 runs
+the identical program single-process on 8 local devices."""
+
+import os
+
+SERIAL = os.environ.get("MP_SERIAL") == "1"
+os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count="
+                           + ("8" if SERIAL else "4"))
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+try:
+    from jax import shard_map
+except ImportError:
+    from jax.experimental.shard_map import shard_map
+
+
+def _feed_global(arr, mesh, spec, axis_len_local, rank):
+    """Global sharded array from per-process slices (serial: whole array)."""
+    if SERIAL:
+        return jnp.asarray(arr)
+    from jax.experimental import multihost_utils
+
+    lo = rank * axis_len_local
+    local = arr[:, lo:lo + axis_len_local]
+    return multihost_utils.host_local_array_to_global_array(
+        local, mesh, spec)
+
+
+def main():
+    if not SERIAL:
+        dist.init_parallel_env()
+    assert jax.device_count() == 8
+    rank = 0 if SERIAL else dist.get_rank()
+    rng = np.random.RandomState(0)
+
+    # ---- ring attention over sep=8: ring hops between devices 3<->4
+    # cross the process boundary in the 2-process run
+    from paddle_tpu.distributed.ring_attention import (
+        ring_flash_attention_arrays,
+    )
+
+    dist.set_hybrid_communicate_group(None)
+    hcg = dist.create_hybrid_communicate_group(sep=8)
+    qkv = rng.randn(2, 16 * 8, 4, 16).astype(np.float32)
+    gq = _feed_global(qkv, hcg.mesh, P(None, "sep"), 16 * 4, rank)
+    ring = shard_map(
+        lambda a, b, c: ring_flash_attention_arrays(a, b, c, causal=True),
+        mesh=hcg.mesh, in_specs=(P(None, "sep"),) * 3,
+        out_specs=P(None, "sep"), check_vma=False)
+    out = ring(gq, gq, gq)
+    ring_norm = round(float(jax.jit(
+        lambda o: jnp.linalg.norm(o.astype(jnp.float32)))(out)), 4)
+
+    # ---- MoE ep=8 (expert axis = 'dp', as the reference's moe_group):
+    # all_to_all expert dispatch crosses the process boundary
+    from paddle_tpu.incubate.distributed.models.moe import MoELayer
+
+    dist.set_hybrid_communicate_group(None)
+    hcg2 = dist.create_hybrid_communicate_group(dp=8)
+    paddle.seed(2)
+    moe = MoELayer(16, 32, 8, gate="gshard", capacity_factor=8.0,
+                   axis_name="dp")
+    mnames = list(moe.state_dict())
+    mparams = [moe.state_dict()[k]._data for k in mnames]
+    tokens = rng.randn(4 * 8, 16).astype(np.float32)
+    if SERIAL:
+        gt = jnp.asarray(tokens)
+    else:
+        from jax.experimental import multihost_utils
+
+        gt = multihost_utils.host_local_array_to_global_array(
+            tokens[rank * 16:(rank + 1) * 16], hcg2.mesh, P("dp"))
+
+    def moe_body(xa, *ps):
+        with dist.axis_scope("dp"):
+            with moe.use_state(dict(zip(mnames, ps))):
+                return moe(paddle.Tensor(xa))._data
+
+    moe_f = shard_map(moe_body, mesh=hcg2.mesh,
+                      in_specs=(P("dp"),) + tuple(P() for _ in mparams),
+                      out_specs=P("dp"), check_vma=False)
+    mout = moe_f(gt, *mparams)
+    moe_norm = round(float(jax.jit(
+        lambda o: jnp.linalg.norm(o.astype(jnp.float32)))(mout)), 4)
+
+    print(f"SEP_EP_RESULT {rank} [{ring_norm}, {moe_norm}]", flush=True)
+
+
+if __name__ == "__main__":
+    main()

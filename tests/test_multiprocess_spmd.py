@@ -152,3 +152,17 @@ class TestMultiProcessSPMD:
         assert set(got) == {0, 1}, got
         assert got[0] == "from-rank-0|[1, 2, 3]|slot-a", got
         assert got[1] == "from-rank-0|[1, 2, 3]|slot-b", got
+
+    def test_sep_ring_and_moe_ep_cross_process(self):
+        """Long-context + MoE across the process boundary (the two axes
+        the 2-process suite didn't cover): sep=8 ring attention (k/v
+        ppermute hops cross processes) and ep=8 MoE all_to_all, identical
+        results on both ranks and equal to the serial 8-device run."""
+        losses = _run_multi_process(_companion("mp_sep_ep_train.py"),
+                                    12611, "SEP_EP_RESULT", 2)
+        assert set(losses) == {0, 1}
+        assert losses[0] == losses[1], losses
+        serial = _run_serial(_companion("mp_sep_ep_train.py"),
+                             "SEP_EP_RESULT")
+        np.testing.assert_allclose(losses[0], serial, rtol=1e-4)
+        assert all(v > 0 for v in losses[0])

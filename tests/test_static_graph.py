@@ -161,3 +161,39 @@ class TestStaticGraph:
         assert T.in_dynamic_mode() is False
         paddle.disable_static()
         assert T.in_dynamic_mode() is True
+
+    def test_fc_dynamic_batch_with_flatten(self, static_mode):
+        x = static.data("xfd", [None, 2, 3], "float32")
+        y = static.nn.fc(x, 4)
+        exe = static.Executor()
+        for bs in (2, 5):
+            out = exe.run(feed={"xfd": np.ones((bs, 2, 3), np.float32)},
+                          fetch_list=[y])[0]
+            assert out.shape == (bs, 4)
+
+    def test_save_dynamic_batch_serves_any_size(self, tmp_path,
+                                                static_mode):
+        from paddle_tpu import inference
+
+        paddle.seed(1)
+        model = nn.Linear(4, 2)
+        x = static.data("dynb", [None, 4], "float32")
+        y = model(x)
+        prefix = str(tmp_path / "dyn")
+        static.save_inference_model(prefix, [x], [y])
+        paddle.disable_static()
+        pred = inference.create_predictor(inference.Config(prefix))
+        for bs in (1, 3, 7):
+            out = pred.run([np.ones((bs, 4), np.float32)])[0]
+            assert out.shape == (bs, 2)
+
+    def test_symbolic_tensor_protocols(self, static_mode):
+        import copy
+
+        x = static.data("xp", [2, 2], "float32")
+        y = x * 3.0
+        copy.deepcopy(x)                     # protocol probe falls back
+        with pytest.raises(static.StaticGraphError):
+            np.asarray(y.numpy())            # loud, not object-array
+        with pytest.raises(static.StaticGraphError):
+            float(y._data)
