@@ -448,22 +448,40 @@ def scatter_object_list(out_object_list, in_object_list=None, src=0,
     Multi-process: the full list broadcasts from src (non-src processes
     pass in_object_list=None, per the reference contract), then each
     process keeps its own slot."""
+    explicit_group = group
     group = _resolve(group)
     import jax
 
     if jax.process_count() > 1:
+        # Object collectives are PROCESS-granular (the default group is the
+        # device-level dp group and does not apply here); an explicitly
+        # passed subgroup would silently be ignored, so refuse it.
+        if explicit_group is not None and getattr(
+                explicit_group, "nranks", 1) not in (1, jax.process_count()):
+            raise NotImplementedError(
+                "scatter_object_list: subgroup object scatter across "
+                "processes is not supported; pass group=None (world)")
         full = _bcast_object_multiprocess(in_object_list, src)
         if not full:
             raise ValueError("src rank must provide in_object_list")
+        if len(full) != jax.process_count():
+            raise ValueError(
+                f"scatter_object_list: len(in_object_list) ({len(full)}) "
+                f"must equal world size ({jax.process_count()})")
         rank = jax.process_index()
         out_object_list.clear()
-        out_object_list.append(full[rank % len(full)])
+        out_object_list.append(full[rank])
         return None
     if in_object_list is None:
         raise ValueError("src rank must provide in_object_list")
+    world = group.nranks if hasattr(group, "nranks") else 1
+    if len(in_object_list) != world:
+        raise ValueError(
+            f"scatter_object_list: len(in_object_list) "
+            f"({len(in_object_list)}) must equal group size ({world})")
     rank = group.rank if hasattr(group, "rank") else 0
     out_object_list.clear()
-    out_object_list.append(in_object_list[rank % len(in_object_list)])
+    out_object_list.append(in_object_list[rank])
     return None
 
 

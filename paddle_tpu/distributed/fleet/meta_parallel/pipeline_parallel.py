@@ -98,6 +98,11 @@ class PipelineParallel(Layer):
         if strategy is not None:
             cfg = getattr(strategy, "pipeline_configs", None) or (
                 strategy if isinstance(strategy, dict) else {})
+            # Accept the documented nested form {"pipeline_configs": {...}}
+            # for plain-dict strategies too (ref DistributedStrategy shape).
+            if isinstance(cfg, dict) and isinstance(
+                    cfg.get("pipeline_configs"), dict):
+                cfg = cfg["pipeline_configs"]
         self.accumulate_steps = int(cfg.get("accumulate_steps", 1))
         self.micro_batch_size = cfg.get("micro_batch_size", None)
         self.schedule = cfg.get("schedule", "gpipe")

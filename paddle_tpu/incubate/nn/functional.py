@@ -262,6 +262,12 @@ def masked_multihead_attention(x, cache_kv=None, src_mask=None, bias=None,
             raise ValueError(
                 f"masked_multihead_attention: cache full "
                 f"(sequence_lengths >= max_seq {max_seq})")
+        # Under jit the eager guard above can't fire; a full cache would
+        # otherwise silently drop the new token's K/V. Poison the affected
+        # ROW with NaN instead so the failure is loud (propagates, and
+        # trips jax_debug_nans / FLAGS check_nan_inf when enabled) while
+        # still-valid sequences in the batch stay intact.
+        overflow = (lens >= max_seq)[:, None]
         b = xv.shape[0]
         qkv = xv.reshape(b, 3, n_head, head_dim)
         q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]   # [b, h, d]
@@ -273,6 +279,7 @@ def masked_multihead_attention(x, cache_kv=None, src_mask=None, bias=None,
         new_v = jnp.where(write, v[:, :, None, :], cachev[1])
         out = _cache_attend(q[:, None], new_k, new_v, lens, maskv, max_seq)
         out = out.astype(xv.dtype).reshape(b, n_head * head_dim)
+        out = jnp.where(overflow, jnp.asarray(jnp.nan, out.dtype), out)
         return out, jnp.stack([new_k, new_v])
 
     return apply(f, *args, _op_name="masked_multihead_attention")
@@ -337,15 +344,21 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
         caches = list(rest[off:off + n_caches])
         off += n_caches
         ts = None
+        overflow = jnp.asarray(False)
         if decode:
             ts = rest[off].astype(jnp.int32).reshape(())
             off += 1
-            if caches and not isinstance(ts, jax.core.Tracer):
+            if caches:
                 cap = caches[0].shape[3]
-                if bool(ts >= cap):
-                    raise ValueError(
-                        f"fused_multi_transformer: cache full "
-                        f"(time_step {int(ts)} >= max_seq {cap})")
+                if not isinstance(ts, jax.core.Tracer):
+                    if bool(ts >= cap):
+                        raise ValueError(
+                            f"fused_multi_transformer: cache full "
+                            f"(time_step {int(ts)} >= max_seq {cap})")
+                # jit path: the eager guard can't fire, so a full cache
+                # poisons the output with NaN (loud under jax_debug_nans /
+                # FLAGS check_nan_inf) instead of silently dropping K/V.
+                overflow = ts >= cap
         maskv = rest[off] if attn_mask is not None else None
 
         def norm(h, scale, bias_):
@@ -451,6 +464,7 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
             h = residual + drop(f2)
             if not pre_layer_norm:
                 h = norm(h, ws[(6, i)], ws[(7, i)])
+        h = jnp.where(overflow, jnp.asarray(jnp.nan, h.dtype), h)
         if caches:
             return (h,) + tuple(new_caches)
         return h

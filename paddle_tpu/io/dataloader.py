@@ -487,18 +487,32 @@ class DataLoader:
             p.start()
         done = set()
         waited = 0.0
+        dead_polls = 0
         try:
             while len(done) < self.num_workers:
                 try:
                     kind, payload = result_q.get(timeout=1.0)
                     waited = 0.0
+                    dead_polls = 0
                 except queue.Empty:
                     waited += 1.0
                     # a worker that exited WITHOUT delivering its 'done'
-                    # died; workers already done are allowed to be gone
+                    # died; workers already done are allowed to be gone.
+                    # A cleanly-exited (exitcode 0) worker's final batches
+                    # and 'done' sentinel can still sit in the feeder pipe
+                    # while the queue transiently reports empty — only
+                    # treat exitcode 0 as death after several consecutive
+                    # empty polls give the feeder time to flush.
                     dead = [i for i, p in enumerate(procs)
                             if i not in done and not p.is_alive()]
-                    if dead and result_q.empty():
+                    crashed = [i for i in dead if procs[i].exitcode]
+                    if crashed and result_q.empty():
+                        raise RuntimeError(
+                            f"DataLoader process worker {crashed[0]} died "
+                            "unexpectedly "
+                            f"(exitcode {procs[crashed[0]].exitcode})")
+                    dead_polls = dead_polls + 1 if dead else 0
+                    if dead and dead_polls >= 3 and result_q.empty():
                         raise RuntimeError(
                             f"DataLoader process worker {dead[0]} died "
                             "unexpectedly")
