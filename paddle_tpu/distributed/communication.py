@@ -453,14 +453,16 @@ def scatter_object_list(out_object_list, in_object_list=None, src=0,
     import jax
 
     if jax.process_count() > 1:
-        # Object collectives are PROCESS-granular (the default group is the
-        # device-level dp group and does not apply here); an explicitly
-        # passed subgroup would silently be ignored, so refuse it.
+        # Object collectives are PROCESS-granular; explicitly passed groups
+        # are DEVICE-granular and cannot be honored here (they'd silently
+        # be ignored), so refuse any non-trivial one — only group=None
+        # (world, one slot per process) is supported.
         if explicit_group is not None and getattr(
-                explicit_group, "nranks", 1) not in (1, jax.process_count()):
+                explicit_group, "nranks", 1) != 1:
             raise NotImplementedError(
-                "scatter_object_list: subgroup object scatter across "
-                "processes is not supported; pass group=None (world)")
+                "scatter_object_list: object collectives are process-"
+                "granular; device-level groups are not supported across "
+                "processes — pass group=None (world)")
         full = _bcast_object_multiprocess(in_object_list, src)
         if not full:
             raise ValueError("src rank must provide in_object_list")

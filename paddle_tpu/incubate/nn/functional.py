@@ -207,6 +207,46 @@ def _cache_attend(q, cache_k, cache_v, upto, maskv, max_seq):
     return jnp.einsum("bhst,bhtd->bshd", p, cache_v.astype(jnp.float32))
 
 
+def _rope_full_table(x, cos, sin, neox):
+    """Rotate x [..., d] by FULL-head-dim cos/sin tables broadcastable to
+    x's shape (the reference's fused kernels take cos/sin already expanded
+    to head_dim — neox duplicates half-tables, GPT-J interleaves). Shared
+    by masked_multihead_attention and fused_multi_transformer so the inline
+    rope cannot drift from the standalone fused_rope op (ops/rope.py)."""
+    xf = x.astype(jnp.float32)
+    if neox:
+        d = x.shape[-1]
+        x1, x2 = xf[..., : d // 2], xf[..., d // 2:]
+        rot = jnp.concatenate([-x2, x1], axis=-1)
+    else:
+        x1, x2 = xf[..., 0::2], xf[..., 1::2]
+        rot = jnp.stack([-x2, x1], axis=-1).reshape(xf.shape)
+    return (xf * cos.astype(jnp.float32)
+            + rot * sin.astype(jnp.float32)).astype(x.dtype)
+
+
+def _rope_tables_at(rt, positions, head_dim):
+    """Slice per-position cos/sin from a packed rotary tensor
+    [2, b, 1, max_seq, head_dim] (index 0 = cos, 1 = sin; axis 2 may be
+    absent). positions: [b] int — each row's write position. Returns
+    (cos, sin) shaped [b, 1, head_dim] (head axis broadcast)."""
+    rt = jnp.asarray(rt)
+    if rt.ndim == 5:  # [2, b, 1, S, d]
+        rt = rt[:, :, 0]
+    # rt now [2, b, S, d]
+    if rt.shape[-1] != head_dim:
+        raise ValueError(
+            f"rotary table last dim {rt.shape[-1]} != head_dim {head_dim} "
+            "(tables must be FULL head_dim cos/sin)")
+    if rt.shape[2] == 1:
+        cs = rt[:, :, 0]                          # single-step tables
+    else:
+        pos = jnp.asarray(positions).reshape(-1, 1, 1)
+        cs = jnp.take_along_axis(rt, pos[None].astype(jnp.int32),
+                                 axis=2)[:, :, 0]
+    return cs[0][:, None, :], cs[1][:, None, :]
+
+
 def masked_multihead_attention(x, cache_kv=None, src_mask=None, bias=None,
                                sequence_lengths=None, rotary_tensor=None,
                                beam_cache_offset=None, qkv_out_scale=None,
@@ -218,20 +258,31 @@ def masked_multihead_attention(x, cache_kv=None, src_mask=None, bias=None,
     """Single-step decode attention over a fixed-capacity KV cache (ref:
     incubate masked_multihead_attention (U) — the CUDA MMHA kernel behind
     fused generation). TPU stance: the gather/attend/update runs as one
-    XLA program; quantization arguments are accepted for signature parity
-    (rotary/bias/beam arguments raise — they change the math).
+    XLA program; quantization arguments are accepted for signature parity;
+    rotary is applied inline (see rotary_tensor below); bias/beam
+    arguments raise.
 
     x: [bsz, 3*num_head*head_dim] packed qkv for ONE new token
     cache_kv: [2, bsz, num_head, max_seq, head_dim]; the step index is
         sequence_lengths ([bsz] int, tokens already cached) or 0
     src_mask: optional additive mask broadcastable to
         [bsz, 1, 1, max_seq] (e.g. -inf at padding)
+    rotary_tensor: packed cos/sin tables [2, bsz, 1, max_seq, head_dim]
+        (index 0 = cos, 1 = sin, FULL head_dim — the reference kernel's
+        inline-rope contract); each row's table is read at its write
+        position (sequence_lengths) and applied to q and k before the
+        cache write. Requires rotary_emb_dims == 1;
+        use_neox_rotary_style picks rotate-half vs interleaved pairs.
     returns (out [bsz, num_head*head_dim], updated cache_kv)
     """
     if cache_kv is None:
         raise ValueError("masked_multihead_attention requires cache_kv")
-    if rotary_tensor is not None or rotary_emb_dims:
-        raise NotImplementedError("masked_multihead_attention: rotary")
+    if rotary_emb_dims not in (0, 1):
+        raise NotImplementedError(
+            "masked_multihead_attention: rotary_emb_dims must be 0 or 1 "
+            "(2-section rope not supported)")
+    if rotary_tensor is not None and rotary_emb_dims == 0:
+        rotary_emb_dims = 1
     if bias is not None or beam_cache_offset is not None:
         raise NotImplementedError(
             "masked_multihead_attention: bias/beam_cache_offset")
@@ -242,6 +293,8 @@ def masked_multihead_attention(x, cache_kv=None, src_mask=None, bias=None,
         args.append(_as_t(src_mask).detach())
     if sequence_lengths is not None:
         args.append(_as_t(sequence_lengths).detach())
+    if rotary_tensor is not None:
+        args.append(_as_t(rotary_tensor).detach())
 
     n_head = cache.shape[2]
     max_seq = cache.shape[3]
@@ -255,8 +308,10 @@ def masked_multihead_attention(x, cache_kv=None, src_mask=None, bias=None,
             ri += 1
         if sequence_lengths is not None:
             lens = rest[ri].astype(jnp.int32)
+            ri += 1
         else:
             lens = jnp.zeros((xv.shape[0],), jnp.int32)
+        rot = rest[ri] if rotary_tensor is not None else None
         if not isinstance(lens, jax.core.Tracer) and bool(
                 jnp.any(lens >= max_seq)):
             raise ValueError(
@@ -271,6 +326,10 @@ def masked_multihead_attention(x, cache_kv=None, src_mask=None, bias=None,
         b = xv.shape[0]
         qkv = xv.reshape(b, 3, n_head, head_dim)
         q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]   # [b, h, d]
+        if rot is not None:
+            cosv, sinv = _rope_tables_at(rot, lens, head_dim)  # [b,1,d]
+            q = _rope_full_table(q, cosv, sinv, use_neox_rotary_style)
+            k = _rope_full_table(k, cosv, sinv, use_neox_rotary_style)
         # write k/v at each row's step index
         pos = lens[:, None, None, None]             # [b,1,1,1]
         idx = jnp.arange(max_seq)[None, None, :, None]
@@ -293,24 +352,33 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
                             rotary_embs=None, time_step=None, attn_mask=None,
                             dropout_rate=0.0, activation="gelu",
                             training=False, mode="upscale_in_train",
-                            trans_qkvw=True, ring_id=-1, name=None):
+                            trans_qkvw=True, ring_id=-1,
+                            rotary_emb_dims=0, use_neox_rotary_style=False,
+                            name=None):
     """Fused multi-layer transformer decoder pass (ref: incubate
     fused_multi_transformer (U) — the CUDA fused generation stack). One
     XLA program runs every layer: pre-LN -> packed qkv -> attention
     (causal prefill, via the flash path when unmasked, WRITING the k/v
     into cache_kvs when given; or single-step decode against cache_kvs at
     time_step) -> out proj -> residual -> ffn. Differentiable through the
-    tape (everything routes through apply); rotary/pre_cache arguments
-    raise.
+    tape (everything routes through apply); pre_caches raises.
 
     x: [bsz, seq, dim]; qkv_weights[i]: [3, n_head, head_dim, dim] when
     trans_qkvw else [dim, 3, n_head, head_dim];
     cache_kvs[i]: [2, bsz, n_head, max_seq, head_dim].
+    rotary_embs: packed cos/sin tables [2, bsz, 1, max_seq, head_dim]
+    (index 0 = cos, 1 = sin, FULL head_dim — the reference fused kernel's
+    inline-rope contract (U)); applied to q and k in EVERY layer before
+    the cache write/attend, at positions [0, seq) in prefill and at
+    time_step in decode. use_neox_rotary_style picks rotate-half vs
+    interleaved pairs; rotary_emb_dims must be 0 or 1.
     Returns out, or (out, updated cache_kvs) when cache_kvs is given.
     """
-    if rotary_embs is not None or pre_caches is not None:
+    if pre_caches is not None:
+        raise NotImplementedError("fused_multi_transformer: pre_caches")
+    if rotary_emb_dims not in (0, 1):
         raise NotImplementedError(
-            "fused_multi_transformer: rotary_embs/pre_caches")
+            "fused_multi_transformer: rotary_emb_dims must be 0 or 1")
     n_layers = len(qkv_weights)
     decode = cache_kvs is not None and time_step is not None
 
@@ -334,6 +402,8 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
         extra.append(_as_t(time_step).detach())
     if attn_mask is not None:
         extra.append(_as_t(attn_mask).detach())
+    if rotary_embs is not None:
+        extra.append(_as_t(rotary_embs).detach())
 
     def f(xv, *rest):
         ws = {k: None for k in
@@ -359,7 +429,15 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
                 # poisons the output with NaN (loud under jax_debug_nans /
                 # FLAGS check_nan_inf) instead of silently dropping K/V.
                 overflow = ts >= cap
-        maskv = rest[off] if attn_mask is not None else None
+        maskv = None
+        if attn_mask is not None:
+            maskv = rest[off]
+            off += 1
+        rotv = None
+        if rotary_embs is not None:
+            rotv = jnp.asarray(rest[off])
+            if rotv.ndim == 5:            # [2, b, 1, S, d] -> [2, b, S, d]
+                rotv = rotv[:, :, 0]
 
         def norm(h, scale, bias_):
             mean = jnp.mean(h, axis=-1, keepdims=True)
@@ -407,6 +485,16 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
             if ws[(3, i)] is not None:
                 qkv = qkv + ws[(3, i)].reshape(1, 1, 3, n_head, head_dim)
             q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [b,s,h,d]
+            if rotv is not None:
+                if decode:
+                    pos = jnp.broadcast_to(ts[None], (b,))
+                    cosv, sinv = _rope_tables_at(rotv, pos, head_dim)
+                    cosv, sinv = cosv[:, None], sinv[:, None]  # [b,1,1,d]
+                else:
+                    cosv = rotv[0][:, :s, None, :]             # [b,s,1,d]
+                    sinv = rotv[1][:, :s, None, :]
+                q = _rope_full_table(q, cosv, sinv, use_neox_rotary_style)
+                k = _rope_full_table(k, cosv, sinv, use_neox_rotary_style)
             if caches:
                 cache = caches[i]
                 max_seq = cache.shape[3]

@@ -1,6 +1,7 @@
 """Round-2 tier-2 surface: optimizers (Rprop/ASGD/NAdam/RAdam/LBFGS), vision
 transforms, distributions, incubate wrappers, dtype info, hub."""
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -482,6 +483,154 @@ class TestFusedGeneration:
         np.testing.assert_allclose(step_out.numpy()[:, 0],
                                    full.numpy()[:, -1],
                                    rtol=2e-4, atol=2e-4)
+
+    @staticmethod
+    def _rope_tables(b, max_seq, hd, neox=True):
+        """Packed [2, b, 1, max_seq, hd] cos/sin FULL-dim tables matching
+        ops/rope.rope_arrays' half-table convention."""
+        inv = 1.0 / (10000.0 ** (np.arange(0, hd, 2, np.float64) / hd))
+        fr = np.outer(np.arange(max_seq, dtype=np.float64), inv)  # [S, d/2]
+        if neox:
+            cos = np.concatenate([np.cos(fr), np.cos(fr)], -1)
+            sin = np.concatenate([np.sin(fr), np.sin(fr)], -1)
+        else:
+            cos = np.repeat(np.cos(fr), 2, -1)
+            sin = np.repeat(np.sin(fr), 2, -1)
+        t = np.stack([cos, sin]).astype(np.float32)     # [2, S, d]
+        return np.broadcast_to(t[:, None, None], (2, b, 1, max_seq, hd))
+
+    def test_fused_multi_transformer_rotary_matches_eager_rope(self):
+        # prefill with inline rope == unfused composition with the
+        # standalone fused_rope op applied to q/k (LLaMA-block math)
+        import paddle_tpu.incubate.nn.functional as IF
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.ops.rope import rope_arrays
+
+        rng = np.random.RandomState(7)
+        L, dim, n_head, ffn = 2, 32, 4, 64
+        hd = dim // n_head
+        P = self._mt_params(rng, L, dim, n_head, ffn)
+        b, s = 2, 8
+        x = paddle.to_tensor(rng.randn(b, s, dim).astype(np.float32) * 0.3)
+        rot = self._rope_tables(b, s, hd)
+        out = IF.fused_multi_transformer(
+            x, rotary_embs=paddle.to_tensor(rot),
+            use_neox_rotary_style=True, **P)
+
+        h = x
+        for i in range(L):
+            ln = F.layer_norm(h, [dim], P["ln_scales"][i], P["ln_biases"][i])
+            qw = P["qkv_weights"][i].numpy()
+            qkv = np.einsum("bsd,thed->bsthe", ln.numpy(), qw) \
+                + P["qkv_biases"][i].numpy().reshape(1, 1, 3, n_head, hd)
+            q = rope_arrays(jnp.asarray(qkv[:, :, 0]), neox=True)
+            k = rope_arrays(jnp.asarray(qkv[:, :, 1]), neox=True)
+            att = F.scaled_dot_product_attention(
+                paddle.to_tensor(np.asarray(q)),
+                paddle.to_tensor(np.asarray(k)),
+                paddle.to_tensor(qkv[:, :, 2]), is_causal=True,
+                training=False).reshape([b, s, dim])
+            h = h + F.linear(att, P["linear_weights"][i],
+                             P["linear_biases"][i])
+            ln2 = F.layer_norm(h, [dim], P["ffn_ln_scales"][i],
+                               P["ffn_ln_biases"][i])
+            f1 = F.gelu(F.linear(ln2, P["ffn1_weights"][i],
+                                 P["ffn1_biases"][i]))
+            h = h + F.linear(f1, P["ffn2_weights"][i], P["ffn2_biases"][i])
+        np.testing.assert_allclose(out.numpy(), h.numpy(),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_rotary_generation_decode_matches_recompute(self):
+        # the VERDICT done-criterion: a rope model generating greedily via
+        # prefill->cached-decode produces IDENTICAL tokens to full
+        # recompute at every step (both styles)
+        import paddle_tpu.incubate.nn.functional as IF
+
+        for neox in (True, False):
+            rng = np.random.RandomState(8)
+            L, dim, n_head, ffn = 2, 32, 4, 64
+            hd = dim // n_head
+            P = self._mt_params(rng, L, dim, n_head, ffn)
+            vocab = 17
+            emb = rng.randn(vocab, dim).astype(np.float32) * 0.3
+            head = rng.randn(dim, vocab).astype(np.float32)
+            prompt = [3, 1, 4, 1, 5]
+            max_seq = 16
+            rot = paddle.to_tensor(
+                self._rope_tables(1, max_seq, hd, neox=neox))
+            kw = dict(rotary_embs=rot, use_neox_rotary_style=neox)
+
+            def logits_full(ids):
+                x = paddle.to_tensor(emb[np.asarray(ids)][None])
+                out = IF.fused_multi_transformer(x, **kw, **P)
+                return out.numpy()[0, -1] @ head
+
+            # eager reference: full recompute each step
+            ref_ids = list(prompt)
+            for _ in range(4):
+                ref_ids.append(int(np.argmax(logits_full(ref_ids))))
+
+            # fused path: prefill writes cache, then single-token decode
+            caches = [paddle.to_tensor(
+                np.zeros((2, 1, n_head, max_seq, hd), np.float32))
+                for _ in range(L)]
+            x0 = paddle.to_tensor(emb[np.asarray(prompt)][None])
+            out, caches = IF.fused_multi_transformer(
+                x0, cache_kvs=caches, **kw, **P)
+            ids = list(prompt)
+            ids.append(int(np.argmax(out.numpy()[0, -1] @ head)))
+            for t in range(len(prompt), len(prompt) + 3):
+                xt = paddle.to_tensor(emb[np.asarray([ids[-1]])][None])
+                out, caches = IF.fused_multi_transformer(
+                    xt, cache_kvs=caches,
+                    time_step=paddle.to_tensor(np.asarray(t, np.int32)),
+                    **kw, **P)
+                ids.append(int(np.argmax(out.numpy()[0, -1] @ head)))
+            assert ids == ref_ids, (neox, ids, ref_ids)
+
+    def test_masked_multihead_attention_rotary(self):
+        # single-step decode with inline rope at each row's position ==
+        # manual rope (standalone op, per-row position_ids) + attend
+        import paddle_tpu.incubate.nn.functional as IF
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.ops.rope import rope_arrays
+
+        rng = np.random.RandomState(9)
+        b, n_head, hd, max_seq = 2, 2, 8, 12
+        lens = np.array([5, 2], np.int32)
+        cache = np.zeros((2, b, n_head, max_seq, hd), np.float32)
+        for r in range(b):
+            hist = rng.randn(lens[r], n_head, hd).astype(np.float32)
+            cache[0, r, :, :lens[r]] = np.transpose(hist, (1, 0, 2))
+            cache[1, r, :, :lens[r]] = np.transpose(hist, (1, 0, 2)) * 0.5
+        xq = rng.randn(b, 3 * n_head * hd).astype(np.float32)
+        rot = self._rope_tables(b, max_seq, hd)
+        out, new_cache = IF.masked_multihead_attention(
+            paddle.to_tensor(xq), cache_kv=paddle.to_tensor(cache),
+            sequence_lengths=paddle.to_tensor(lens),
+            rotary_tensor=paddle.to_tensor(rot),
+            use_neox_rotary_style=True)
+
+        qkv = xq.reshape(b, 3, n_head, hd)
+        pos = jnp.asarray(lens)[:, None]            # [b, 1]
+        q = np.asarray(rope_arrays(jnp.asarray(qkv[:, 0][:, None]),
+                                   position_ids=pos, neox=True))
+        k = np.asarray(rope_arrays(jnp.asarray(qkv[:, 1][:, None]),
+                                   position_ids=pos, neox=True))
+        nk = new_cache.numpy()
+        for r in range(b):
+            # rope'd new k landed at slot lens[r]
+            np.testing.assert_allclose(nk[0, r, :, lens[r]], k[r, 0],
+                                       rtol=1e-5, atol=1e-5)
+            kr = paddle.to_tensor(np.transpose(
+                nk[0, r:r + 1, :, :lens[r] + 1], (0, 2, 1, 3)))
+            vr = paddle.to_tensor(np.transpose(
+                nk[1, r:r + 1, :, :lens[r] + 1], (0, 2, 1, 3)))
+            ref = F.scaled_dot_product_attention(
+                paddle.to_tensor(q[r:r + 1]), kr, vr, training=False)
+            np.testing.assert_allclose(out.numpy()[r],
+                                       ref.numpy().reshape(-1),
+                                       rtol=1e-4, atol=1e-5)
 
     def test_prefill_attn_mask_honored(self):
         import paddle_tpu.incubate.nn.functional as IF
