@@ -455,10 +455,14 @@ def scatter_object_list(out_object_list, in_object_list=None, src=0,
     if jax.process_count() > 1:
         # Object collectives are PROCESS-granular; explicitly passed groups
         # are DEVICE-granular and cannot be honored here (they'd silently
-        # be ignored), so refuse any non-trivial one — only group=None
-        # (world, one slot per process) is supported.
-        if explicit_group is not None and getattr(
-                explicit_group, "nranks", 1) != 1:
+        # be ignored), so refuse any non-trivial one. Exception: with one
+        # device per process the granularities coincide, so a group
+        # spanning every process IS unambiguously the world group.
+        n = getattr(explicit_group, "nranks", 1) \
+            if explicit_group is not None else 1
+        world_spanning = (n == jax.process_count()
+                          and jax.local_device_count() == 1)
+        if n != 1 and not world_spanning:
             raise NotImplementedError(
                 "scatter_object_list: object collectives are process-"
                 "granular; device-level groups are not supported across "

@@ -104,6 +104,7 @@ class PipelineLayer(Layer):
 
         # materialize descs; SharedLayerDesc instances dedupe by key
         shared = {}
+        self._shared_owner_prefix = {}  # id(inner) -> registered name prefix
         items = []
         for d in layers:
             if isinstance(d, SharedLayerDesc):
@@ -117,6 +118,11 @@ class PipelineLayer(Layer):
                     if d.forward_func is not None:
                         # first occurrence must still own the params
                         items[-1].add_sublayer("shared", inner)
+                        self._shared_owner_prefix[id(inner)] = \
+                            f"{len(items) - 1}.shared"
+                    else:
+                        self._shared_owner_prefix[id(inner)] = \
+                            str(len(items) - 1)
             elif isinstance(d, LayerDesc):
                 items.append(d.build_layer())
             elif isinstance(d, Layer):
@@ -187,6 +193,27 @@ class PipelineLayer(Layer):
                 prefix = str(i)
                 for n, _ in self._sub_layers[prefix].named_parameters(prefix=prefix):
                     names.append(n)
+        return names
+
+    def chunk_param_names(self, chunk_id):
+        """Param names READ by chunk `chunk_id`: its own items' params plus
+        the owner-registered params of any _SharedView (tied weights used
+        here but owned by the first occurrence's chunk). The 1F1B schedule
+        differentiates each chunk w.r.t. exactly this set, so tied-weight
+        gradients from every using chunk are computed and summed (ref
+        shared-weight allreduce, fleet pipeline_parallel.py (U))."""
+        lo, hi = self.segment_parts[chunk_id], self.segment_parts[chunk_id + 1]
+        names = []
+        for i in range(lo, hi):
+            it = self.run_function[i]
+            if isinstance(it, _SharedView) and not it._sub_layers:
+                inner = it._inner_ref[0]
+                prefix = self._shared_owner_prefix[id(inner)]
+                names.extend(n for n, _ in
+                             inner.named_parameters(prefix=prefix))
+            else:
+                names.extend(n for n, _ in self._sub_layers[str(i)]
+                             .named_parameters(prefix=str(i)))
         return names
 
     # ------------------------------------------------------------ serial ref

@@ -32,10 +32,13 @@ For the no-remat / long-schedule regime the reference's literal 1F1B
 schedule (pp_utils/p2p_communication.py (U)) is available as an opt-in:
 `strategy={"pipeline_configs": {"schedule": "1f1b"}}` hand-interleaves
 per-microbatch forward and backward on a deterministic clock with vjp
-residuals in a 2(S-1)+1-slot ring buffer, bounding in-flight FULL
+residuals in per-slot depth-bounded ring buffers, bounding in-flight FULL
 activations by pipeline depth with no extra forward (see
 _pipeline_pure_fn_1f1b; measured in TestPipeline1F1B — per-extra-microbatch
-growth < 0.2× GPipe's at accumulate_steps=32).
+growth < 0.2× GPipe's at accumulate_steps=32). It composes with
+SharedLayerDesc weight tying (every using chunk differentiates the tied
+weight; contributions psum across 'pp') and with
+num_virtual_pipeline_stages>1 (Megatron interleaved chunk layout).
 
 Gradient flow across stages needs no reducer: stage params enter replicated
 (in_spec P()), so shard_map's transpose inserts the psum that sums each
@@ -385,16 +388,22 @@ class PipelineParallel(Layer):
     def _pipeline_pure_fn_1f1b(self, n_micro):
         """Literal 1F1B schedule (ref pp_utils/p2p_communication.py (U),
         SURVEY §2.2 P13): per-microbatch forward and backward are
-        hand-interleaved on a deterministic clock — fwd of microbatch m
-        runs on stage s at tick m+s, its backward at tick m+2(S-1)-s —
-        so in-flight FULL activations are bounded by 2(S-1)+1 slots
-        (O(pipeline depth)), not O(accumulate_steps) as in the jax.grad
-        GPipe schedule. No recompute: each stage's vjp residuals are
-        extracted with jax.closure_convert, byte-packed into a fixed ring
-        buffer, and replayed at the backward tick; parameter gradients
-        accumulate in f32 on the owning stage and psum across 'pp' at the
-        end. The result is exposed through jax.custom_vjp so TrainStep's
-        ordinary jax.grad path consumes the hand-computed gradients."""
+        hand-interleaved on a deterministic clock — with D = S·V chunks
+        (V = num_virtual_pipeline_stages, Megatron interleaved layout:
+        chunk d runs on rank d % S as virtual slot d // S), fwd of
+        microbatch m runs for chunk d at tick m+d, its backward at tick
+        m+2(D-1)-d — so in-flight FULL activations are bounded by
+        O(depth·V) ring slots, not O(accumulate_steps) as in the jax.grad
+        GPipe schedule. No recompute: each chunk's vjp residuals are
+        byte-packed into per-slot fixed ring buffers and replayed at the
+        backward tick; parameter gradients accumulate in f32 on each
+        USING chunk and psum across 'pp' at the end — so SharedLayerDesc
+        weight tying works: every chunk that reads a tied weight (owner or
+        _SharedView) differentiates it and the contributions sum, matching
+        the reference's shared-weight allreduce semantics
+        (fleet/meta_parallel/pipeline_parallel.py (U)). The result is
+        exposed through jax.custom_vjp so TrainStep's ordinary jax.grad
+        path consumes the hand-computed gradients."""
         key_c = ("1f1b", n_micro)
         if key_c in self._pp_fn_cache:
             return self._pp_fn_cache[key_c]
@@ -404,45 +413,39 @@ class PipelineParallel(Layer):
         pp = self._layers
         S = pp.num_stages
         assert S > 1  # S == 1 dispatches to the serial GPipe builder
-        if getattr(pp, "num_virtual_stages", 1) > 1:
-            raise NotImplementedError(
-                "schedule='1f1b' with num_virtual_pipeline_stages>1: use "
-                "the default interleaved schedule")
-        from .parallel_layers.pp_layers import _SharedView
-        if any(isinstance(it, _SharedView) for it in pp.run_function):
-            raise NotImplementedError(
-                "schedule='1f1b' with SharedLayerDesc weight tying: each "
-                "stage's vjp differentiates only stage-owned params, so "
-                "the non-owning stage's tied-weight gradient would be "
-                "silently dropped — use the default gpipe schedule")
+        V = getattr(pp, "num_virtual_stages", 1)
+        D = S * V
         (mesh, names, dp_live, mp_live, live_axes, param_specs,
          _rescale_mp, batch_spec) = self._schedule_env()
         run_items = self._run_items
         M = n_micro
-        K = 2 * (S - 1) + 1          # residual ring slots: O(depth)
+        # per-slot residual ring: chunk d's residual lives from its fwd
+        # tick m+d to its bwd tick m+2(D-1)-d, so slot j (chunks j·S+r)
+        # needs at most 2(D-1-j·S)+1 concurrent microbatches (r=0 worst)
+        K_slot = [max(1, 2 * (D - 1 - j * S) + 1) for j in range(V)]
         sd0 = pp.state_dict()
         trainable = {n for n in names if not sd0[n].stop_gradient}
-        # param index ranges owned by each stage (only trainable ones get
-        # hand-computed grads; buffers come back as zeros)
-        stage_idx = []
-        for k in range(S):
-            own = [i for i, n in enumerate(names)
-                   if n in trainable and n in set(pp.stage_param_names(k))]
-            stage_idx.append(own)
-        owner_of = {}
-        for k, idxs in enumerate(stage_idx):
+        # param indices READ by each chunk (owned + tied-in via
+        # _SharedView); only trainable ones get hand-computed grads
+        chunk_idx = []
+        for d in range(D):
+            reads = set(pp.chunk_param_names(d))
+            chunk_idx.append([i for i, n in enumerate(names)
+                              if n in trainable and n in reads])
+        users_of = {}
+        for d, idxs in enumerate(chunk_idx):
             for i in idxs:
-                owner_of[i] = k
+                users_of.setdefault(i, []).append(d)
 
         def spmd(x_mbs, y_mbs, base_key, *params):
             s = lax.axis_index("pp")
 
             with _tape.no_grad(), collective_ctx.axis_scope(*live_axes):
 
-                # ---------- per-stage primals over (hid?, sub_params)
-                def stage_prim(k):
-                    items = pp.get_stage_layers(k)
-                    idxs = stage_idx[k]
+                # ---------- per-chunk primals over (hid?, sub_params)
+                def chunk_prim(d):
+                    items = pp.get_stage_layers(d)
+                    idxs = chunk_idx[d]
 
                     def f(x_in, sub, y_mb, key):
                         arrays = dict(zip(names, params))
@@ -451,24 +454,24 @@ class PipelineParallel(Layer):
                         with random_state.fork_rng(key), \
                                 pp.use_state(arrays):
                             out = run_items(items, Tensor(x_in))
-                            if k == S - 1:
+                            if d == D - 1:
                                 loss = pp.compute_loss(out, Tensor(y_mb))
                                 return jnp.mean(loss._data).astype(jnp.float32)
                             return out._data
                     return f
 
-                prims = [stage_prim(k) for k in range(S)]
+                prims = [chunk_prim(d) for d in range(D)]
 
-                # hidden boundary shape from stage 0 (same for all stages,
-                # as in the GPipe schedule)
+                # hidden boundary shape from chunk 0 (same for all chunk
+                # boundaries, as in the GPipe schedule)
                 probe_key = jax.random.fold_in(base_key, 0)
-                sub0 = tuple(params[i] for i in stage_idx[0])
+                sub0 = tuple(params[i] for i in chunk_idx[0])
                 hid_sd = jax.eval_shape(
                     lambda x, sb, ky: prims[0](x, sb, y_mbs[0], ky),
                     x_mbs[0], sub0, probe_key)
                 hid_shape, hid_dtype = hid_sd.shape, hid_sd.dtype
 
-                # ---------- vjp plumbing per stage
+                # ---------- vjp plumbing per chunk
                 def vjp_raw(k, x_in, sub, y_mb, key):
                     """(out, pullback) over the diff args (hid for k>0,
                     sub params)."""
@@ -527,7 +530,7 @@ class PipelineParallel(Layer):
                     # does — so the probe's residual layout matches the
                     # real branches'. The trace-time mask assertions in
                     # the branches are the safety net.
-                    sub = tuple(params[i] for i in stage_idx[k])
+                    sub = tuple(params[i] for i in chunk_idx[k])
                     box = {}
 
                     def outer(ops):
@@ -551,7 +554,7 @@ class PipelineParallel(Layer):
                              (x_mbs[0], y_mbs[0]))
                     return box["specs"], box["mask"]
 
-                probes = [probe(k) for k in range(S)]
+                probes = [probe(k) for k in range(D)]
                 res_specs = [p[0] for p in probes]
                 res_masks = [p[1] for p in probes]
 
@@ -560,12 +563,12 @@ class PipelineParallel(Layer):
                     return int(np.prod(sdt.shape)) * it
 
                 R = max(1, max(sum(nbytes(c) for c in res_specs[k])
-                               for k in range(S)))
+                               for k in range(D)))
                 # grad-accumulator layout from the shard_map-LOCAL param
                 # shapes (mp-sharded params are smaller in here than the
                 # host-global sd0 view)
                 sizes = [sum(int(np.prod(params[i].shape))
-                             for i in stage_idx[k]) for k in range(S)]
+                             for i in chunk_idx[k]) for k in range(D)]
                 G = max(1, max(sizes))
 
                 def pack_bytes(consts, total):
@@ -606,141 +609,181 @@ class PipelineParallel(Layer):
 
                 zeros_hid = jnp.zeros(hid_shape, hid_dtype)
 
-                # ---------- one tick of the schedule, per stage branch
-                def tick_branch(k):
-                    idxs = stage_idx[k]
-                    sub = tuple(params[i] for i in idxs)
-
-                    def do_fwd(x_mb, y_mb, key):
-                        x_in = x_mb if k == 0 else None
-
+                # ---------- one tick of the schedule, per-RANK branch
+                # (rank r runs its V chunks {r, r+S, ...} every tick)
+                def rank_branch(r):
+                    def fwd_for(d, sub, x_mb, y_mb, key_d):
                         def run(x_in_hid):
-                            xi = x_mb if k == 0 else x_in_hid
-                            if k == S - 1:
-                                # last stage: backward runs in the same
+                            xi = x_mb if d == 0 else x_in_hid
+                            if d == D - 1:
+                                # loss chunk: backward runs in the same
                                 # tick, straight through the raw pullback
-                                y, pb = vjp_raw(k, xi, sub, y_mb, key)
+                                y, pb = vjp_raw(d, xi, sub, y_mb, key_d)
                                 cts = pb(jnp.float32(1.0 / M))
-                                if k == 0:
-                                    dsub, dx = cts[0], zeros_hid
-                                else:
-                                    dx, dsub = cts
+                                dx, dsub = cts
                                 return (zeros_hid,
                                         dx.astype(hid_dtype),
                                         jnp.zeros((R,), jnp.uint8),
-                                        pack_grads(dsub, k), y)
+                                        pack_grads(dsub, d), y)
                             y, _, leaves, mask = vjp_parts(
-                                k, xi, sub, y_mb, key)
-                            if mask != res_masks[k]:
+                                d, xi, sub, y_mb, key_d)
+                            if mask != res_masks[d]:
                                 raise AssertionError(
-                                    f"1f1b stage {k}: residual layout "
+                                    f"1f1b chunk {d}: residual layout "
                                     f"drifted between traces: probe="
-                                    f"{res_masks[k]} fwd={mask}")
-                            var = [c for j, c in enumerate(leaves)
-                                   if mask[j] == -1]
+                                    f"{res_masks[d]} fwd={mask}")
+                            specs = [jax.ShapeDtypeStruct(c.shape, c.dtype)
+                                     for jj, c in enumerate(leaves)
+                                     if mask[jj] == -1]
+                            if specs != res_specs[d]:
+                                raise AssertionError(
+                                    f"1f1b chunk {d}: residual SPECS "
+                                    f"drifted between traces: probe="
+                                    f"{res_specs[d]} fwd={specs}")
+                            var = [c for jj, c in enumerate(leaves)
+                                   if mask[jj] == -1]
                             return (y.astype(hid_dtype), zeros_hid,
                                     pack_bytes(var, R),
                                     jnp.zeros((G,), jnp.float32),
                                     jnp.zeros((), jnp.float32))
                         return run
 
-                    def br(x_mb, y_mb, hid_in, ct_in, res_buf, t):
-                        fwd_valid = (t >= k) & (t - k < M)
-                        key_t = jax.random.fold_in(base_key, t)
+                    def br(x_mb, y_mb, hid, ct, res_bufs, t):
+                        outs, ct_outs, accs = [], [], []
+                        new_bufs = list(res_bufs)
+                        loss_t = jnp.zeros((), jnp.float32)
+                        for j in range(V):
+                            d = j * S + r
+                            Kj = K_slot[j]
+                            sub = tuple(params[i] for i in chunk_idx[d])
+                            key_d = jax.random.fold_in(
+                                jax.random.fold_in(base_key, t), d)
+                            fwd_valid = (t >= d) & (t - d < M)
+                            mf = jnp.clip(t - d, 0, M - 1)
 
-                        def fwd_go(hid_in):
-                            return do_fwd(x_mb, y_mb, key_t)(hid_in)
+                            def fwd_skip(hid_in):
+                                return (zeros_hid, zeros_hid,
+                                        jnp.zeros((R,), jnp.uint8),
+                                        jnp.zeros((G,), jnp.float32),
+                                        jnp.zeros((), jnp.float32))
 
-                        def fwd_skip(hid_in):
-                            return (zeros_hid, zeros_hid,
-                                    jnp.zeros((R,), jnp.uint8),
-                                    jnp.zeros((G,), jnp.float32),
-                                    jnp.zeros((), jnp.float32))
+                            y_out, ct_fused, res_new, acc1, loss_m = \
+                                lax.cond(fwd_valid,
+                                         fwd_for(d, sub, x_mb, y_mb, key_d),
+                                         fwd_skip, hid[j])
+                            buf = new_bufs[j]
+                            buf = lax.dynamic_update_index_in_dim(
+                                buf,
+                                jnp.where(fwd_valid, res_new,
+                                          lax.dynamic_index_in_dim(
+                                              buf, mf % Kj, keepdims=False)),
+                                mf % Kj, axis=0)
+                            new_bufs[j] = buf
 
-                        y_out, ct_fwd, res_new, acc1, loss_m = lax.cond(
-                            fwd_valid, fwd_go, fwd_skip, hid_in)
-                        mf = jnp.clip(t - k, 0, M - 1)
-                        res_buf = lax.dynamic_update_index_in_dim(
-                            res_buf,
-                            jnp.where(fwd_valid, res_new,
-                                      lax.dynamic_index_in_dim(
-                                          res_buf, mf % K, keepdims=False)),
-                            mf % K, axis=0)
+                            if d == D - 1:
+                                outs.append(y_out)
+                                ct_outs.append(ct_fused)
+                                accs.append(acc1)
+                                loss_t = loss_t + loss_m
+                                continue
 
-                        if k == S - 1:
-                            return (y_out, ct_fwd, res_buf, acc1, loss_m)
+                            mb = t - (2 * (D - 1) - d)
+                            bwd_valid = (mb >= 0) & (mb < M)
+                            mbc = jnp.clip(mb, 0, M - 1)
 
-                        mb = t - (2 * (S - 1) - k)
-                        bwd_valid = (mb >= 0) & (mb < M)
-                        mbc = jnp.clip(mb, 0, M - 1)
+                            def bwd_go(ct_in, d=d, sub=sub, buf=buf,
+                                       mbc=mbc, key_d=key_d):
+                                slot = lax.dynamic_index_in_dim(
+                                    buf, mbc % K_slot[d // S],
+                                    keepdims=False)
+                                var = unpack_bytes(slot, res_specs[d])
+                                # rebuild the pullback structure from a
+                                # dummy trace (same jaxpr => same Partial
+                                # treedef; the dummy's leaf VALUES are
+                                # replaced, so its forward compute is
+                                # DCE'd; the dummy hid must be a tracer —
+                                # see probe)
+                                x_bwd = (jnp.take(x_mbs, mbc, axis=0)
+                                         if d == 0 else ct_in * 0)
+                                _, treedef, leaves_d, mask = vjp_parts(
+                                    d, x_bwd, sub, y_mb, key_d)
+                                if mask != res_masks[d]:
+                                    raise AssertionError(
+                                        f"1f1b chunk {d}: residual layout "
+                                        f"drifted between traces: probe="
+                                        f"{res_masks[d]} bwd={mask}")
+                                ambient = list(sub) + (
+                                    [x_bwd] if d == 0 else [])
+                                leaves, vi = [], 0
+                                for jj in range(len(mask)):
+                                    if mask[jj] >= 0:
+                                        leaves.append(ambient[mask[jj]])
+                                    elif mask[jj] == -2:
+                                        leaves.append(leaves_d[jj])
+                                    else:
+                                        leaves.append(var[vi].astype(
+                                            leaves_d[jj].dtype))
+                                        vi += 1
+                                pb2 = jax.tree.unflatten(treedef, leaves)
+                                cts = pb2(ct_in.astype(hid_dtype))
+                                if d == 0:
+                                    return zeros_hid, pack_grads(cts[0], d)
+                                dx, dsub = cts
+                                return (dx.astype(hid_dtype),
+                                        pack_grads(dsub, d))
 
-                        def bwd_go(ct_in):
-                            slot = lax.dynamic_index_in_dim(
-                                res_buf, mbc % K, keepdims=False)
-                            var = unpack_bytes(slot, res_specs[k])
-                            # rebuild the pullback structure from a dummy
-                            # trace (same jaxpr => same Partial treedef;
-                            # the dummy's leaf VALUES are replaced, so its
-                            # forward compute is DCE'd; the dummy hid must
-                            # be a tracer — see probe)
-                            x_bwd = (jnp.take(x_mbs, mbc, axis=0) if k == 0
-                                     else ct_in * 0)
-                            _, treedef, leaves_d, mask = vjp_parts(
-                                k, x_bwd, sub, y_mb, key_t)
-                            if mask != res_masks[k]:
-                                raise AssertionError(
-                                    f"1f1b stage {k}: residual layout "
-                                    f"drifted between traces: probe="
-                                    f"{res_masks[k]} bwd={mask}")
-                            ambient = list(sub) + ([x_bwd] if k == 0 else [])
-                            leaves, vi = [], 0
-                            for j in range(len(mask)):
-                                if mask[j] >= 0:
-                                    leaves.append(ambient[mask[j]])
-                                elif mask[j] == -2:
-                                    leaves.append(leaves_d[j])
-                                else:
-                                    leaves.append(var[vi].astype(
-                                        leaves_d[j].dtype))
-                                    vi += 1
-                            pb2 = jax.tree.unflatten(treedef, leaves)
-                            cts = pb2(ct_in.astype(hid_dtype))
-                            if k == 0:
-                                return zeros_hid, pack_grads(cts[0], k)
-                            dx, dsub = cts
-                            return dx.astype(hid_dtype), pack_grads(dsub, k)
+                            def bwd_skip(ct_in):
+                                return zeros_hid, jnp.zeros((G,),
+                                                            jnp.float32)
 
-                        def bwd_skip(ct_in):
-                            return zeros_hid, jnp.zeros((G,), jnp.float32)
-
-                        dx_out, acc2 = lax.cond(bwd_valid, bwd_go, bwd_skip,
-                                                ct_in)
-                        return (y_out, dx_out, res_buf, acc1 + acc2, loss_m)
+                            dx_out, acc2 = lax.cond(bwd_valid, bwd_go,
+                                                    bwd_skip, ct[j])
+                            outs.append(y_out)
+                            ct_outs.append(dx_out)
+                            accs.append(acc1 + acc2)
+                        return (jnp.stack(outs), jnp.stack(ct_outs),
+                                tuple(new_bufs), jnp.stack(accs), loss_t)
 
                     return br
 
-                branches = [tick_branch(k) for k in range(S)]
+                branches = [rank_branch(r) for r in range(S)]
                 perm_fwd = [(i, (i + 1) % S) for i in range(S)]
                 perm_bwd = [(i, (i - 1) % S) for i in range(S)]
-                T = M + 2 * (S - 1)
+                T = M + 2 * (D - 1)
 
                 def tick(carry, t):
-                    hid, ct, res_buf, acc, loss_sum = carry
+                    hid, ct, res_bufs, acc, loss_sum = carry
                     m0 = jnp.clip(t, 0, M - 1)
-                    mL = jnp.clip(t - (S - 1), 0, M - 1)
+                    mL = jnp.clip(t - (D - 1), 0, M - 1)
                     x_mb = jnp.take(x_mbs, m0, axis=0)
                     y_mb = jnp.take(y_mbs, mL, axis=0)
-                    y_out, ct_out, res_buf, dacc, loss_m = lax.switch(
+                    y_out, ct_out, res_bufs, dacc, loss_m = lax.switch(
                         jnp.minimum(s, S - 1), branches,
-                        x_mb, y_mb, hid, ct, res_buf, t)
-                    hid_next = lax.ppermute(y_out, "pp", perm_fwd)
-                    ct_next = lax.ppermute(ct_out, "pp", perm_bwd)
-                    return (hid_next, ct_next, res_buf, acc + dacc,
+                        x_mb, y_mb, hid, ct, res_bufs, t)
+                    hid_p = lax.ppermute(y_out, "pp", perm_fwd)
+                    ct_p = lax.ppermute(ct_out, "pp", perm_bwd)
+                    if V > 1:
+                        # sweep boundaries (Megatron layout): rank 0's
+                        # slot j is fed by rank S-1's slot j-1 (slot 0
+                        # consumes the raw microbatch); rank S-1's ct
+                        # slot j is fed by rank 0's slot j+1 (the loss
+                        # chunk, slot V-1, seeds its own cotangent)
+                        hid_shift = jnp.concatenate(
+                            [jnp.zeros_like(hid_p[:1]), hid_p[:-1]], axis=0)
+                        hid_next = jnp.where(s == 0, hid_shift, hid_p)
+                        ct_shift = jnp.concatenate(
+                            [ct_p[1:], jnp.zeros_like(ct_p[:1])], axis=0)
+                        ct_next = jnp.where(s == S - 1, ct_shift, ct_p)
+                    else:
+                        hid_next, ct_next = hid_p, ct_p
+                    return (hid_next, ct_next, res_bufs, acc + dacc,
                             loss_sum + loss_m), None
 
-                carry0 = (zeros_hid, zeros_hid,
-                          jnp.zeros((K, R), jnp.uint8),
-                          jnp.zeros((G,), jnp.float32),
+                carry0 = (jnp.zeros((V,) + hid_shape, hid_dtype),
+                          jnp.zeros((V,) + hid_shape, hid_dtype),
+                          tuple(jnp.zeros((K_slot[j], R), jnp.uint8)
+                                for j in range(V)),
+                          jnp.zeros((V, G), jnp.float32),
                           jnp.zeros((), jnp.float32))
                 (_, _, _, acc, loss_sum), _ = lax.scan(
                     tick, carry0, jnp.arange(T))
@@ -749,29 +792,36 @@ class PipelineParallel(Layer):
             if dp_live:
                 loss = lax.pmean(loss, "dp")
 
-            # unpack per-param grads from the owning stage's accumulator
-            # (offsets over the LOCAL shard shapes, matching pack_grads)
-            offsets = {}
-            for k in range(S):
+            # unpack per-param grads from every USING chunk's accumulator
+            # (offsets over the LOCAL shard shapes, matching pack_grads);
+            # tied params sum their contributions across chunks — the
+            # reference's shared-weight grad sync
+            offsets = [dict() for _ in range(D)]
+            for d in range(D):
                 off = 0
-                for i in stage_idx[k]:
-                    offsets[i] = off
+                for i in chunk_idx[d]:
+                    offsets[d][i] = off
                     off += int(np.prod(params[i].shape))
             grads = []
             for i, n in enumerate(names):
                 p = params[i]
-                if i not in owner_of:
+                users = users_of.get(i)
+                if not users:
                     grads.append(jnp.zeros_like(p))
                     continue
-                k = owner_of[i]
                 size = int(np.prod(p.shape))
-                gsl = lax.dynamic_slice(acc, (offsets[i],), (size,))
-                g_i = gsl.reshape(p.shape) * (s == k).astype(jnp.float32)
-                # psum over pp broadcasts the owning stage's grad; over mp
-                # nothing is needed — the mp ops' custom vjps (identity/
-                # allreduce pairs) already make replicated-param grads
-                # identical on every mp rank, and sharded-param grads are
-                # complete per shard
+                g_i = jnp.zeros(p.shape, jnp.float32)
+                for d in users:
+                    jslot, r = divmod(d, S)
+                    gsl = lax.dynamic_slice(
+                        acc[jslot], (offsets[d][i],), (size,))
+                    g_i = g_i + gsl.reshape(p.shape) * \
+                        (s == r).astype(jnp.float32)
+                # psum over pp sums the using chunks' grads (zeros on
+                # non-user ranks); over mp nothing is needed — the mp
+                # ops' custom vjps (identity/allreduce pairs) already
+                # make replicated-param grads identical on every mp rank,
+                # and sharded-param grads are complete per shard
                 g_i = lax.psum(g_i, "pp")
                 if dp_live:
                     g_i = lax.pmean(g_i, "dp")

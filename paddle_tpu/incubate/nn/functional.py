@@ -491,6 +491,12 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
                     cosv, sinv = _rope_tables_at(rotv, pos, head_dim)
                     cosv, sinv = cosv[:, None], sinv[:, None]  # [b,1,1,d]
                 else:
+                    if rotv.shape[2] < s:
+                        raise ValueError(
+                            f"fused_multi_transformer: rotary table covers "
+                            f"{rotv.shape[2]} positions < prefill length "
+                            f"{s} (a seq-1 decode table would silently "
+                            "broadcast position 0 over every token)")
                     cosv = rotv[0][:, :s, None, :]             # [b,s,1,d]
                     sinv = rotv[1][:, :s, None, :]
                 q = _rope_full_table(q, cosv, sinv, use_neox_rotary_style)

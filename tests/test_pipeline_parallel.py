@@ -473,20 +473,112 @@ class TestPipeline1F1B:
             (paddle.to_tensor(x), paddle.to_tensor(y)), opt))
         assert np.isfinite(loss)
 
-    def test_shared_weights_rejected(self):
+    @staticmethod
+    def _tied_descs():
+        """GPT-style tying: the embedding Linear(8,H) on stage 0 is reused
+        as the output head (x @ W.T: H->8) on the LAST stage."""
+        return ([SharedLayerDesc("emb", nn.Linear, 8, H)] +
+                [LayerDesc(Block) for _ in range(4)] +
+                [SharedLayerDesc(
+                    "emb", nn.Linear, 8, H,
+                    forward_func=lambda lyr, x: paddle.matmul(
+                        x, lyr.weight, transpose_y=True))])
+
+    def _run_tied(self, schedule, v=1, n_micro=4, pp=2):
+        dist.set_hybrid_communicate_group(None)
+        hcg = dist.create_hybrid_communicate_group(pp=pp)
+        paddle.seed(5)
+        model = PipelineLayer(self._tied_descs(), loss_fn=_mse,
+                              num_virtual_pipeline_stages=v)
+        runner = PipelineParallel(model, hcg, {"accumulate_steps": n_micro,
+                                               "schedule": schedule})
+        opt = paddle.optimizer.Momentum(learning_rate=0.05,
+                                        parameters=model.parameters())
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(16, 8).astype(np.float32))
+        y = paddle.to_tensor(rng.randn(16, 8).astype(np.float32))
+        return [float(runner.train_batch((x, y), opt)) for _ in range(3)]
+
+    def test_tied_weights_match_gpipe(self):
+        """VERDICT r3 item 2: tie_word_embeddings-style models train under
+        schedule='1f1b' with loss parity vs gpipe (whose whole-graph
+        autodiff handles tying natively and is serial-parity-tested). If
+        the non-owning stage's tied-weight grad contribution were dropped,
+        the trajectories would diverge from step 2 on."""
+        ref = self._run_tied("gpipe")
+        got = self._run_tied("1f1b")
+        assert ref[0] != ref[1]  # training actually moves
+        np.testing.assert_allclose(got, ref, rtol=2e-5, atol=1e-6)
+
+    @pytest.mark.parametrize("pp,v,n_micro", [(2, 2, 4), (2, 2, 8)])
+    def test_vpp_1f1b_matches_serial(self, pp, v, n_micro):
+        """VERDICT r3 item 2: the 1f1b clock extends to virtual stages
+        (Megatron interleaved layout) — parity with the serial model."""
+        def vdescs():
+            return ([LayerDesc(nn.Linear, 8, H)] +
+                    [LayerDesc(Block) for _ in range(2 * pp * v - 2)] +
+                    [LayerDesc(Head)])
+
+        dist.set_hybrid_communicate_group(None)
+        dist.create_hybrid_communicate_group(pp=1)
+        paddle.seed(21)
+        serial_model = PipelineLayer(vdescs(), loss_fn=_mse)
+        ref = _serial_losses(serial_model, n_micro=n_micro)
+
+        dist.set_hybrid_communicate_group(None)
+        hcg = dist.create_hybrid_communicate_group(pp=pp)
+        paddle.seed(21)
+        model = PipelineLayer(vdescs(), loss_fn=_mse,
+                              num_virtual_pipeline_stages=v)
+        runner = PipelineParallel(model, hcg, {"accumulate_steps": n_micro,
+                                               "schedule": "1f1b"})
+        opt = paddle.optimizer.Momentum(learning_rate=0.05,
+                                        parameters=model.parameters())
+        x, y = _batch()
+        losses = [float(runner.train_batch(
+            (paddle.to_tensor(x), paddle.to_tensor(y)), opt))
+            for _ in range(3)]
+        np.testing.assert_allclose(losses, ref, rtol=2e-5, atol=1e-6)
+
+    def test_vpp_1f1b_tied_weights(self):
+        """1f1b x VPP x tying all at once: chunk 0 (rank 0) and the last
+        chunk (rank 1, virtual slot 1) share the embedding."""
+        ref = self._run_tied("gpipe", v=2, n_micro=4)
+        got = self._run_tied("1f1b", v=2, n_micro=4)
+        np.testing.assert_allclose(got, ref, rtol=2e-5, atol=1e-6)
+
+    def test_residual_structure_drift_fails_loudly(self):
+        """VERDICT r3 item 9: a layer whose traced structure DIFFERS
+        between the probe trace and the schedule trace must raise the
+        trace-time layout diagnostic, not silently corrupt the ring."""
+
+        class Shifty(nn.Layer):
+            # structure changes on the 3rd trace: eval_shape (1), probe
+            # (2), then the forward branch (3) sees an extra residual
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(H, H)
+                self.traces = 0
+
+            def forward(self, x):
+                self.traces += 1
+                out = paddle.tanh(self.fc(x))
+                if self.traces >= 3:
+                    out = out + paddle.exp(x * 0.001) * 0.01
+                return out
+
         dist.set_hybrid_communicate_group(None)
         hcg = dist.create_hybrid_communicate_group(pp=2)
         paddle.seed(5)
-        descs = ([SharedLayerDesc("emb", nn.Linear, 8, H)] +
-                 [LayerDesc(Block) for _ in range(4)] +
-                 [SharedLayerDesc("emb", nn.Linear, 8, H,
-                                  forward_func=lambda lyr, x: x)])
-        model = PipelineLayer(descs, loss_fn=_mse)
+        model = PipelineLayer(
+            [LayerDesc(nn.Linear, 8, H), LayerDesc(Shifty)] +
+            [LayerDesc(Block) for _ in range(2)] + [LayerDesc(Head)],
+            loss_fn=_mse)
         runner = PipelineParallel(model, hcg, {"accumulate_steps": 4,
                                                "schedule": "1f1b"})
         opt = paddle.optimizer.Momentum(learning_rate=0.05,
                                         parameters=model.parameters())
         x, y = _batch()
-        with pytest.raises(NotImplementedError, match="SharedLayerDesc"):
+        with pytest.raises(Exception, match="drifted between traces"):
             runner.train_batch((paddle.to_tensor(x), paddle.to_tensor(y)),
                                opt)
