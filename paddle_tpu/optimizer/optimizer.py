@@ -24,8 +24,15 @@ class Optimizer:
         from .lr import LRScheduler
 
         if parameters is None:
-            raise ValueError("parameters must be provided (dygraph mode)")
-        self._parameter_list = list(parameters)
+            from ..static.graph import in_static_mode
+
+            if not in_static_mode():
+                raise ValueError("parameters must be provided (dygraph mode)")
+            # static mode (ref): parameters are discovered from the loss's
+            # recorded DAG when minimize() is called
+            self._parameter_list = None
+        else:
+            self._parameter_list = list(parameters)
         self._learning_rate = learning_rate
         self._grad_clip = grad_clip
         self._regularizer_fn = None
@@ -49,7 +56,7 @@ class Optimizer:
         self._accumulators = {}  # param id -> dict(state_name -> jnp array)
         self._step_count = 0
         self._param_names = {}
-        for i, p in enumerate(self._parameter_list):
+        for i, p in enumerate(self._parameter_list or []):
             self._param_names[id(p)] = p.name or f"param_{i}"
 
     # -------- lr --------
@@ -83,7 +90,7 @@ class Optimizer:
 
         if isinstance(self._learning_rate, LRScheduler):
             out["LR_Scheduler"] = self._learning_rate.state_dict()
-        for p in self._parameter_list:
+        for p in self._plist():
             name = self._param_names[id(p)]
             for k, v in self._accumulators.get(id(p), {}).items():
                 out[f"{name}.{k}"] = Tensor(v) if not isinstance(v, Tensor) else v
@@ -96,7 +103,7 @@ class Optimizer:
         if isinstance(self._learning_rate, LRScheduler) and state.get("LR_Scheduler"):
             self._learning_rate.set_state_dict(state["LR_Scheduler"])
         self._step_count = int(state.get("global_step", 0))
-        for p in self._parameter_list:
+        for p in self._plist():
             name = self._param_names[id(p)]
             st = self._state_for(p)
             for k in list(st):
@@ -158,8 +165,15 @@ class Optimizer:
             g_arr = g_arr + self._regularizer_fn(p._data)
         return g_arr
 
+    def _plist(self):
+        if self._parameter_list is None:
+            raise RuntimeError(
+                "optimizer has no parameters yet: it was built without a "
+                "parameter list (static mode) — call minimize(loss) first")
+        return self._parameter_list
+
     def step(self):
-        params_grads = [(p, p.grad) for p in self._parameter_list
+        params_grads = [(p, p.grad) for p in self._plist()
                         if p.trainable and p.grad is not None]
         if self._grad_clip is not None:
             params_grads = self._grad_clip(params_grads)
@@ -178,12 +192,19 @@ class Optimizer:
                 self._accumulators[id(p)] = new_state
 
     def clear_grad(self, set_to_zero=False):
-        for p in self._parameter_list:
+        for p in self._plist():
             p.clear_grad()
 
     clear_gradients = clear_grad
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        from ..static.graph import _is_sym, register_minimize
+
+        if _is_sym(loss):
+            # static mode (ref Optimizer.minimize over the Program):
+            # register the train op; Executor.run applies the update
+            return register_minimize(self, loss, parameters=parameters,
+                                     no_grad_set=no_grad_set)
         loss.backward()
         self.step()
         return None, None

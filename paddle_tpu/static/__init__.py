@@ -5,11 +5,15 @@ TPU-native stance, upgraded in r3: instead of rebuilding a ProgramDesc
 interpreter, static mode makes the op dispatch LAZY — `static.data`
 placeholders are symbolic, ops touching them record graph nodes (out shapes
 via jax abstract eval, the InferMeta analog), and `Executor.run` compiles
-the fetched subgraph as ONE `jax.jit` program of the feeds. Forward graphs
-only: build / run / save_inference_model (StableHLO, servable by
-paddle.inference) / load_inference_model. Static-mode TRAINING
-(append_backward, optimizer.minimize) remains a declared non-goal — train
-in dygraph and compile with `paddle_tpu.jit.TrainStep` (SURVEY.md §7).
+the fetched subgraph as ONE `jax.jit` program of the feeds: build / run /
+save_inference_model (StableHLO, servable by paddle.inference) /
+load_inference_model. Static-mode TRAINING (r4): `append_backward` and
+`Optimizer.minimize` differentiate the recorded DAG with jax.value_and_grad
+(parameters promoted from closure constants to traced inputs) and apply the
+optimizer's functional update inside the same compiled program — the
+reference's `exe.run(startup); exe.run(main, feed, [loss])` loop trains.
+The static meta-optimizer stack (P20) is still out of scope; the serious
+training path remains dygraph + `paddle_tpu.jit.TrainStep` (SURVEY.md §7).
 """
 
 from ..jit.api import InputSpec
@@ -19,6 +23,7 @@ from .graph import (
     Executor,
     Program,
     StaticGraphError,
+    append_backward,
     data,
     default_main_program,
     default_startup_program,
@@ -61,6 +66,7 @@ amp = _StaticAmpShim()
 
 __all__ = [
     "InputSpec", "Layer", "Executor", "Program", "StaticGraphError",
+    "append_backward",
     "data", "default_main_program", "default_startup_program",
     "disable_static", "enable_static", "in_static_mode",
     "load_inference_model", "program_guard", "save_inference_model", "nn",

@@ -13,9 +13,18 @@ recorded DAG as ONE `jax.jit`-compiled function of the feeds — concrete
 tensors captured along the way (parameters, constants) ride in as closure
 constants, exactly like a frozen inference program.
 
-Scope (documented): forward graphs — build, run, save/load for serving.
-Static-mode training (append_backward / minimize) remains out of scope;
-training is the dygraph + jit.TrainStep path (SURVEY.md §7 design stance).
+Scope: forward graphs — build, run, save/load for serving — PLUS minimal
+static-mode training (SURVEY.md §2.2 P7, ref static.append_backward +
+Optimizer.minimize over the Program): `opt.minimize(loss)` registers a
+train op on the main Program; `Executor.run` then promotes the parameters
+captured in the loss's DAG from closure constants to traced inputs,
+differentiates the recorded graph with `jax.value_and_grad` through
+`_evaluate`, applies the optimizer's functional update (`_update_for`,
+the same math jit.TrainStep compiles), and writes the new arrays back
+into the live Parameter tensors — the reference's canonical
+`exe.run(startup); exe.run(main, feed, [loss])` loop trains. The heavier
+static meta-optimizer stack (P20) stays out of scope; serious training
+is the dygraph + jit.TrainStep path (SURVEY.md §7 design stance).
 """
 
 from __future__ import annotations
@@ -42,7 +51,8 @@ class _SymArr:
     """Symbolic value: shape/dtype (for InferMeta-style queries) + the
     producing graph node. Any attempt to touch concrete data raises."""
 
-    __slots__ = ("aval", "node", "out_idx", "feed_name", "orig_shape")
+    __slots__ = ("aval", "node", "out_idx", "feed_name", "orig_shape",
+                 "program")
 
     def __init__(self, aval, node=None, out_idx=0, feed_name=None):
         self.aval = aval
@@ -50,6 +60,7 @@ class _SymArr:
         self.out_idx = out_idx
         self.feed_name = feed_name
         self.orig_shape = None
+        self.program = None   # owning Program (set on feed placeholders)
 
     @property
     def shape(self):
@@ -113,6 +124,18 @@ class _SymArr:
         return f"SymArr({self.aval.shape}, {self.aval.dtype}, from={src})"
 
 
+class _ParamRef:
+    """A trainable Parameter captured into the recorded graph. Kept as a
+    live reference (not a frozen array) so (a) Executor.run always reads
+    the CURRENT value and (b) the training path can promote it to a traced
+    input and write the updated array back."""
+
+    __slots__ = ("t",)
+
+    def __init__(self, t):
+        self.t = t
+
+
 class _Node:
     """One recorded op: fn(*inputs, **kwargs) -> n outputs."""
 
@@ -120,7 +143,7 @@ class _Node:
 
     def __init__(self, fn, inputs, kwargs, n_out, op_name):
         self.fn = fn
-        self.inputs = inputs      # list of _SymArr | concrete jax arrays
+        self.inputs = inputs      # list of _SymArr | _ParamRef | jax arrays
         self.kwargs = kwargs
         self.n_out = n_out
         self.op_name = op_name
@@ -132,6 +155,7 @@ class Program:
 
     def __init__(self):
         self.placeholders = {}   # name -> Tensor (symbolic)
+        self._train_op = None    # (loss Tensor, optimizer) set by minimize
 
     def global_block(self):
         return self
@@ -141,6 +165,11 @@ class Program:
         return dict(self.placeholders)
 
     def clone(self, for_test=False):
+        if for_test and self._train_op is not None:
+            # ref Program.clone(for_test=True): strip training ops
+            c = Program()
+            c.placeholders = dict(self.placeholders)
+            return c
         return self
 
 
@@ -196,6 +225,7 @@ def data(name, shape, dtype="float32", lod_level=0):
     t._data = _SymArr(aval, feed_name=name)
     t._data.orig_shape = tuple(None if (s is None or s < 0) else int(s)
                                for s in shape)
+    t._data.program = _state["main"]
     t.grad = None
     t.stop_gradient = True
     t._tape_node = None
@@ -221,7 +251,13 @@ def _static_apply(fn, args, kwargs, op_name):
         if _is_sym(a):
             inputs.append(a._data)
         elif isinstance(a, Tensor):
-            inputs.append(a._data)
+            # trainable params stay LIVE references so the training path
+            # can promote them to traced inputs (and plain re-runs see
+            # updated values); frozen tensors ride as closure constants
+            if not getattr(a, "stop_gradient", True):
+                inputs.append(_ParamRef(a))
+            else:
+                inputs.append(a._data)
         else:
             inputs.append(a)
 
@@ -229,7 +265,8 @@ def _static_apply(fn, args, kwargs, op_name):
     sym_idx = [i for i, x in enumerate(inputs) if isinstance(x, _SymArr)]
 
     def probe(*sym_vals):
-        full = list(inputs)
+        full = [x.t._data if isinstance(x, _ParamRef) else x
+                for x in inputs]
         for j, i in enumerate(sym_idx):
             full[i] = sym_vals[j]
         return fn(*full, **kwargs)
@@ -268,11 +305,18 @@ def _static_apply(fn, args, kwargs, op_name):
     return out_tensors[0]
 
 
-def _evaluate(fetch_syms, feed_values):
-    """Evaluate the DAG for the given fetches. feed_values: name->array.
-    Memoized over nodes; runs under whatever trace calls it (Executor jits
-    it)."""
+def _evaluate(fetch_syms, feed_values, param_values=None):
+    """Evaluate the DAG for the given fetches. feed_values: name->array;
+    param_values (optional): id(param Tensor) -> traced array, promoting
+    captured parameters from closure constants to function inputs (the
+    training path differentiates through this). Memoized over nodes; runs
+    under whatever trace calls it (Executor jits it)."""
     node_memo = {}
+    param_values = param_values or {}
+
+    def param_of(ref):
+        v = param_values.get(id(ref.t))
+        return ref.t._data if v is None else v
 
     def feed_of(sym):
         try:
@@ -306,6 +350,8 @@ def _evaluate(fetch_syms, feed_values):
                 if isinstance(x, _SymArr):
                     full.append(feed_of(x) if x.feed_name is not None
                                 else node_memo[id(x.node)][x.out_idx])
+                elif isinstance(x, _ParamRef):
+                    full.append(param_of(x))
                 else:
                     full.append(x)
             out = n.fn(*full, **n.kwargs)
@@ -316,9 +362,127 @@ def _evaluate(fetch_syms, feed_values):
     return [value_of(s) for s in fetch_syms]
 
 
+def _collect_params(syms):
+    """Deterministic post-order walk of the DAG under `syms`, returning the
+    unique trainable Parameter tensors captured as _ParamRef inputs (the
+    static analog of the dygraph parameter_list)."""
+    seen_nodes, params, seen_p = set(), [], set()
+    stack = [s.node for s in syms if s.node is not None]
+    while stack:
+        n = stack.pop()
+        if id(n) in seen_nodes:
+            continue
+        seen_nodes.add(id(n))
+        for x in n.inputs:
+            if isinstance(x, _ParamRef):
+                if id(x.t) not in seen_p:
+                    seen_p.add(id(x.t))
+                    params.append(x.t)
+            elif isinstance(x, _SymArr) and x.node is not None:
+                stack.append(x.node)
+    return params
+
+
+def _owning_program(syms):
+    """The Program whose placeholders feed this DAG (so minimize attaches
+    the train op to the program the loss was RECORDED under, not whatever
+    program guard is active at minimize() time)."""
+    seen = set()
+    stack = [s.node for s in syms if s.node is not None]
+    while stack:
+        n = stack.pop()
+        if id(n) in seen:
+            continue
+        seen.add(id(n))
+        for x in n.inputs:
+            if isinstance(x, _SymArr):
+                if x.feed_name is not None and x.program is not None:
+                    return x.program
+                if x.node is not None:
+                    stack.append(x.node)
+    return _state["main"]
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """ref static.append_backward: register the loss's backward on the
+    main program and return [(param, grad)] pairs. The grad entries are
+    fetchable symbolic Tensors — Executor.run computes them with ONE
+    jax.value_and_grad over the recorded DAG (shared with the forward
+    fetches). parameter_list restricts to the given params; no_grad_set
+    (param tensors or their names) excludes params from training."""
+    if not _is_sym(loss):
+        raise StaticGraphError("append_backward expects a static loss Tensor")
+    if tuple(loss._data.aval.shape) not in ((), (1,)):
+        raise StaticGraphError(
+            f"append_backward: loss must be a scalar, got shape "
+            f"{loss._data.aval.shape}")
+    params = list(parameter_list) if parameter_list \
+        else _collect_params([loss._data])
+    if no_grad_set:
+        frozen_ids = {id(t) for t in no_grad_set if isinstance(t, Tensor)}
+        frozen_names = {t for t in no_grad_set if isinstance(t, str)}
+        params = [p for p in params
+                  if id(p) not in frozen_ids
+                  and (p.name or "") not in frozen_names]
+        if not params:
+            raise StaticGraphError(
+                "append_backward: no_grad_set excludes every parameter")
+    pairs = []
+    for p in params:
+        g = Tensor.__new__(Tensor)
+        g._data = _GradSym(jax.ShapeDtypeStruct(p._data.shape, p._data.dtype),
+                           loss_sym=loss._data, param=p)
+        g.grad = None
+        g.stop_gradient = True
+        g._tape_node = None
+        g.name = None
+        g.persistable = False
+        g.trainable = False
+        pairs.append((p, g))
+    return pairs
+
+
+class _GradSym(_SymArr):
+    """d(loss)/d(param) over the recorded DAG — resolvable only by
+    Executor.run (which batches all grads into one value_and_grad)."""
+
+    __slots__ = ("loss_sym", "param")
+
+    def __init__(self, aval, loss_sym=None, param=None):
+        super().__init__(aval)
+        self.loss_sym = loss_sym
+        self.param = param
+
+
+def register_minimize(optimizer, loss, parameters=None, no_grad_set=None):
+    """Optimizer.minimize under static mode: remember (loss, optimizer) on
+    the program the loss was recorded under; Executor.run applies the
+    update whenever it runs that program. Returns (None, params_grads)
+    per the reference API."""
+    if not _is_sym(loss):
+        raise StaticGraphError("minimize expects a static loss Tensor")
+    pairs = append_backward(loss, parameter_list=parameters,
+                            no_grad_set=no_grad_set)
+    params = [p for p, _ in pairs]
+    if not params:
+        raise StaticGraphError(
+            "minimize: no trainable parameters reachable from the loss "
+            "(were layers built under paddle.enable_static()?)")
+    if optimizer._parameter_list is None:
+        optimizer._parameter_list = params
+        for i, p in enumerate(params):
+            optimizer._param_names[id(p)] = p.name or f"param_{i}"
+    _owning_program([loss._data])._train_op = (loss, optimizer)
+    return None, pairs
+
+
 class Executor:
     """ref static.Executor: compiles + runs the fetched subgraph as ONE
-    XLA program per (feed shapes) signature."""
+    XLA program per (feed shapes) signature. When the program carries a
+    train op (Optimizer.minimize) or the fetches include append_backward
+    grads, the compiled program is jax.value_and_grad through the DAG
+    with the parameters promoted to traced (and updated) inputs."""
 
     def __init__(self, place=None):
         self.place = place
@@ -326,6 +490,7 @@ class Executor:
 
     def run(self, program=None, feed=None, fetch_list=None,
             return_numpy=True):
+        prog = program if program is not None else _state["main"]
         feed = feed or {}
         fetch_list = fetch_list or []
         syms = []
@@ -336,15 +501,112 @@ class Executor:
             syms.append(f._data)
         feed_names = sorted(feed)
         feed_arrays = [jnp.asarray(np.asarray(feed[k])) for k in feed_names]
+        train_op = getattr(prog, "_train_op", None)
+        grad_syms = [s for s in syms if isinstance(s, _GradSym)]
+        if train_op is not None or grad_syms:
+            return self._run_train(prog, train_op, syms, grad_syms,
+                                   feed_names, feed_arrays, return_numpy)
         key = (tuple(id(s) for s in syms), tuple(feed_names),
                tuple((a.shape, str(a.dtype)) for a in feed_arrays))
         if key not in self._cache:
-            def eval_fn(*arrays):
-                vals = dict(zip(feed_names, arrays))
-                return tuple(_evaluate(syms, vals))
+            # parameters enter as traced inputs (not closure constants) so
+            # a cached executable always sees their CURRENT values —
+            # required once minimize() updates them between runs
+            params = _collect_params(syms)
 
-            self._cache[key] = jax.jit(eval_fn)
-        outs = self._cache[key](*feed_arrays)
+            def eval_fn(param_arrays, *arrays):
+                vals = dict(zip(feed_names, arrays))
+                pv = {id(p): a for p, a in zip(params, param_arrays)}
+                return tuple(_evaluate(syms, vals, pv))
+
+            self._cache[key] = (jax.jit(eval_fn), params)
+        fn, params = self._cache[key]
+        outs = fn([p._data for p in params], *feed_arrays)
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        return [Tensor(o) for o in outs]
+
+    def _run_train(self, prog, train_op, syms, grad_syms, feed_names,
+                   feed_arrays, return_numpy):
+        """One optimizer step (and/or grad computation) over the recorded
+        DAG: ONE compiled program runs forward, backward and update."""
+        if train_op is not None:
+            loss_t, opt = train_op
+            loss_sym = loss_t._data
+        else:
+            opt = None
+            loss_sym = grad_syms[0].loss_sym
+        for g in grad_syms:
+            if g.loss_sym is not loss_sym:
+                raise StaticGraphError(
+                    "fetching gradients of two different losses in one "
+                    "run is not supported")
+        params = (list(opt._parameter_list) if opt is not None
+                  else _collect_params([loss_sym]))
+        if opt is not None:
+            for p in params:
+                opt._state_for(p)
+        fwd_syms = [s for s in syms if not isinstance(s, _GradSym)]
+        key = ("train", id(prog), id(loss_sym), id(opt),
+               tuple(id(s) for s in syms), tuple(feed_names),
+               tuple((a.shape, str(a.dtype)) for a in feed_arrays))
+        if key not in self._cache:
+            def train_fn(param_arrays, opt_states, lr, *arrays):
+                vals = dict(zip(feed_names, arrays))
+
+                def loss_and_fetches(pas):
+                    pv = {id(p): a for p, a in zip(params, pas)}
+                    outs = _evaluate([loss_sym] + fwd_syms, vals, pv)
+                    return outs[0], outs[1:]
+
+                (_, fwd_vals), grads = jax.value_and_grad(
+                    loss_and_fetches, has_aux=True)(tuple(param_arrays))
+                if opt is None:
+                    return fwd_vals, grads, param_arrays, opt_states
+                from ..core.tensor import Tensor as _T
+
+                pairs = [(p, _T(g)) for p, g in zip(params, grads)]
+                if opt._grad_clip is not None:
+                    pairs = opt._grad_clip(pairs)
+                g_by_id = {id(p): g._data for p, g in pairs}
+                new_params, new_states = [], []
+                for p, a, st in zip(params, param_arrays, opt_states):
+                    g_arr = opt._regularized_grad(
+                        p, g_by_id[id(p)].astype(a.dtype))
+                    plr = lr * getattr(p, "optimize_attr",
+                                       {}).get("learning_rate", 1.0)
+                    np_, nst = opt._update_for(p, a, g_arr, st, plr)
+                    new_params.append(np_)
+                    new_states.append(nst)
+                return fwd_vals, grads, new_params, new_states
+
+            self._cache[key] = jax.jit(train_fn)
+        param_arrays = [p._data for p in params]
+        opt_states = ([opt._accumulators[id(p)] for p in params]
+                      if opt is not None else [])
+        lr = (jnp.asarray(opt.get_lr(), jnp.float32) if opt is not None
+              else jnp.zeros((), jnp.float32))
+        fwd_vals, grads, new_params, new_states = self._cache[key](
+            param_arrays, opt_states, lr, *feed_arrays)
+        if opt is not None:
+            for p, arr in zip(params, new_params):
+                p._data = arr
+            for p, st in zip(params, new_states):
+                opt._accumulators[id(p)] = st
+            opt._step_count += 1
+        grad_by_pid = {id(p): g for p, g in zip(params, grads)}
+        outs, fi = [], 0
+        for s in syms:
+            if isinstance(s, _GradSym):
+                try:
+                    outs.append(grad_by_pid[id(s.param)])
+                except KeyError:
+                    raise StaticGraphError(
+                        "fetched grad is for a parameter not reachable "
+                        "from the loss")
+            else:
+                outs.append(fwd_vals[fi])
+                fi += 1
         if return_numpy:
             return [np.asarray(o) for o in outs]
         return [Tensor(o) for o in outs]

@@ -197,3 +197,126 @@ class TestStaticGraph:
             np.asarray(y.numpy())            # loud, not object-array
         with pytest.raises(static.StaticGraphError):
             float(y._data)
+
+
+class TestStaticTraining:
+    """r4 (VERDICT r3 item 4): minimal static-mode training — the
+    reference's canonical `exe.run(startup); exe.run(main, feed, [loss])`
+    loop, with parameters promoted from closure constants to traced
+    inputs and jax.value_and_grad through the recorded DAG."""
+
+    def _problem(self):
+        rng = np.random.RandomState(0)
+        X = rng.randn(64, 8).astype(np.float32)
+        Y = (X @ rng.randn(8, 1).astype(np.float32)
+             + 0.1 * rng.randn(64, 1).astype(np.float32))
+        return X, Y
+
+    def _eager_losses(self, opt_ctor, w0, b0, X, Y, steps):
+        model = nn.Linear(8, 1)
+        model.weight._data = w0
+        model.bias._data = b0
+        opt = opt_ctor(model.parameters())
+        losses = []
+        for _ in range(steps):
+            loss = nn.functional.mse_loss(
+                model(paddle.to_tensor(X)), paddle.to_tensor(Y))
+            losses.append(float(loss))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        return losses
+
+    @pytest.mark.parametrize("which", ["sgd", "adam"])
+    def test_minimize_matches_eager(self, which, static_mode):
+        X, Y = self._problem()
+        ctor = {"sgd": lambda ps=None: paddle.optimizer.SGD(
+                    learning_rate=0.05, parameters=ps),
+                "adam": lambda ps=None: paddle.optimizer.Adam(
+                    learning_rate=0.05, parameters=ps)}[which]
+        with static.program_guard(static.Program()):
+            x = static.data("x", [None, 8], "float32")
+            y = static.data("y", [None, 1], "float32")
+            pred = static.nn.fc(x, 1)
+            loss = paddle.mean((pred - y) ** 2)
+            opt = ctor()                      # parameters=None: static mode
+            _, params_grads = opt.minimize(loss)
+            w0 = params_grads[0][0]._data     # snapshot init for eager ref
+            b0 = params_grads[1][0]._data
+            exe = static.Executor()
+            exe.run(static.default_startup_program())
+            losses = []
+            for _ in range(15):
+                (lv,) = exe.run(static.default_main_program(),
+                                feed={"x": X, "y": Y}, fetch_list=[loss])
+                losses.append(float(lv))
+        paddle.disable_static()
+        ref = self._eager_losses(lambda ps: ctor(ps), w0, b0, X, Y, 15)
+        assert losses[-1] < 0.5 * losses[0]   # it actually trains
+        np.testing.assert_allclose(losses, ref, rtol=2e-5, atol=1e-6)
+
+    def test_append_backward_grads_numerically_correct(self, static_mode):
+        X, Y = self._problem()
+        with static.program_guard(static.Program()):
+            x = static.data("x", [None, 8], "float32")
+            y = static.data("y", [None, 1], "float32")
+            pred = static.nn.fc(x, 1)
+            loss = paddle.mean((pred - y) ** 2)
+            pairs = static.append_backward(loss)
+            assert len(pairs) == 2            # weight + bias
+            (w, gw), (b, gb) = pairs
+            exe = static.Executor()
+            gwv, gbv = exe.run(feed={"x": X, "y": Y}, fetch_list=[gw, gb])
+            # manual grads of mean((Xw+b - Y)^2)
+            r = X @ np.asarray(w._data) + np.asarray(b._data) - Y
+            np.testing.assert_allclose(gwv, 2 * X.T @ r / len(X),
+                                       rtol=1e-4, atol=1e-5)
+            np.testing.assert_allclose(gbv, 2 * r.mean(0), rtol=1e-4,
+                                       atol=1e-5)
+
+    def test_clone_for_test_strips_train_op(self, static_mode):
+        X, Y = self._problem()
+        with static.program_guard(static.Program()):
+            x = static.data("x", [None, 8], "float32")
+            y = static.data("y", [None, 1], "float32")
+            pred = static.nn.fc(x, 1)
+            loss = paddle.mean((pred - y) ** 2)
+            opt = paddle.optimizer.SGD(learning_rate=0.1)
+            opt.minimize(loss)
+            main = static.default_main_program()
+            test_prog = main.clone(for_test=True)
+            exe = static.Executor()
+            before = exe.run(test_prog, feed={"x": X, "y": Y},
+                             fetch_list=[loss])[0]
+            for _ in range(10):
+                exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+            # eval on the test clone must NOT step the optimizer, but must
+            # see the trained parameters (live, not frozen at first run)
+            after = exe.run(test_prog, feed={"x": X, "y": Y},
+                            fetch_list=[loss])[0]
+            again = exe.run(test_prog, feed={"x": X, "y": Y},
+                            fetch_list=[loss])[0]
+        assert float(after) < float(before)
+        np.testing.assert_allclose(float(after), float(again), rtol=1e-6)
+
+    def test_grad_clip_and_lr_schedule_apply(self, static_mode):
+        X, Y = self._problem()
+        with static.program_guard(static.Program()):
+            x = static.data("x", [None, 8], "float32")
+            y = static.data("y", [None, 1], "float32")
+            pred = static.nn.fc(x, 1)
+            loss = paddle.mean((pred - y) ** 2)
+            sched = paddle.optimizer.lr.StepDecay(learning_rate=0.1,
+                                                  step_size=2, gamma=0.5)
+            opt = paddle.optimizer.SGD(
+                learning_rate=sched,
+                grad_clip=nn.ClipGradByGlobalNorm(0.01))
+            opt.minimize(loss)
+            exe = static.Executor()
+            losses = []
+            for _ in range(6):
+                (lv,) = exe.run(feed={"x": X, "y": Y}, fetch_list=[loss])
+                losses.append(float(lv))
+                sched.step()
+            # tiny clip norm -> slow but monotone-ish descent, no blowup
+            assert losses[-1] < losses[0]
