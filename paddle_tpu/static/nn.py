@@ -46,3 +46,170 @@ def batch_norm(x, **kwargs):
     raise NotImplementedError(
         "static.nn.batch_norm: build the model with paddle_tpu.nn layers "
         "and stage it via static mode or jit.to_static")
+
+
+# --------------------------------------------------------------------------
+# Control-flow staging (ref static.nn.cond/while_loop/case/switch_case —
+# the dy2static ControlFlow ops, SURVEY.md §2.1 N27 / §2.2 P8). TPU-native
+# stance: cond builds BOTH branches (exactly like the reference's static
+# ConditionalBlock recording) and the outputs are selected by the traced
+# predicate — XLA-friendly, differentiable, and valid in eager mode, under
+# jit/to_static, and inside static Program recording. while_loop lowers to
+# lax.while_loop (forward-only, like compiled loops everywhere on TPU).
+
+
+def _flatten_rets(res):
+    """Flatten a branch return (Tensor | nested tuple/list of Tensors |
+    None) into (leaves, rebuild)."""
+    from ..core.tensor import Tensor as _T
+    from ..tensor.creation import _as_t
+
+    if res is None:
+        return [], lambda leaves: None
+    if isinstance(res, (tuple, list)):
+        ctor = type(res)
+        subs = [_flatten_rets(r) for r in res]
+        sizes = []
+        leaves = []
+        for ls, _ in subs:
+            sizes.append(len(ls))
+            leaves.extend(ls)
+
+        def rebuild(vals):
+            out, off = [], 0
+            for (ls, rb), n in zip(subs, sizes):
+                out.append(rb(vals[off:off + n]))
+                off += n
+            return ctor(out)
+
+        return leaves, rebuild
+    t = _as_t(res)
+    return [t], lambda vals: vals[0]
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+    """ref static.nn.cond: run `true_fn()` where pred holds, `false_fn()`
+    otherwise. Both branch graphs are built (the reference records both
+    ConditionalBlocks too); the outputs are selected by the predicate, so
+    the op stages under jit, records into a static Program, and
+    backpropagates through the taken branch (the untaken branch's
+    cotangent is zero)."""
+    import jax.numpy as jnp
+
+    from ..core.op_call import apply
+    from ..tensor.creation import _as_t
+
+    if true_fn is None or false_fn is None:
+        raise ValueError("cond requires both true_fn and false_fn")
+    t_res = true_fn()
+    f_res = false_fn()
+    t_leaves, rebuild = _flatten_rets(t_res)
+    f_leaves, _ = _flatten_rets(f_res)
+    if len(t_leaves) != len(f_leaves):
+        raise ValueError(
+            f"cond branches return different structures: "
+            f"{len(t_leaves)} vs {len(f_leaves)} tensors")
+    pred_t = _as_t(pred)
+    outs = []
+    for a, b in zip(t_leaves, f_leaves):
+        if tuple(a.shape) != tuple(b.shape):
+            raise ValueError(
+                f"cond branch outputs must have matching shapes, got "
+                f"{tuple(a.shape)} vs {tuple(b.shape)}")
+        outs.append(apply(
+            lambda p, x, y: jnp.where(p.reshape(()).astype(bool), x, y),
+            pred_t, a, b, _op_name="cond"))
+    return rebuild(outs)
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """ref static.nn.case: first predicate that holds wins (chained
+    cond selects)."""
+    if not pred_fn_pairs:
+        raise ValueError("case requires at least one (pred, fn) pair")
+    if default is None:
+        *rest, (last_p, last_fn) = list(pred_fn_pairs)
+        default = last_fn
+    else:
+        rest = list(pred_fn_pairs)
+    out = default()
+    for p, fn in reversed(rest):
+        out = cond(p, fn, (lambda o: lambda: o)(out))
+    return out
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """ref static.nn.switch_case: select a branch by integer index.
+    branch_fns: dict {index: fn} or list of (index, fn) / fns."""
+    from ..tensor.creation import _as_t
+
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    else:
+        items = sorted(
+            (i, f) if not isinstance(f, (tuple, list)) else tuple(f)
+            for i, f in enumerate(branch_fns))
+    idx = _as_t(branch_index)
+    if default is None:
+        # ref contract: out-of-range indices dispatch to the MAX-index fn
+        default = items[-1][1]
+    out = default()
+    for i, fn in reversed(items):
+        out = cond(idx == i, fn, (lambda o: lambda: o)(out))
+    return out
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    """ref static.nn.while_loop: `while cond(*vars): vars = body(*vars)`
+    compiled as ONE lax.while_loop — data-dependent trip counts stage
+    under jit and into static Programs (no Python-level unrolling).
+    Forward-only (XLA while has no reverse-mode); closures may capture
+    parameters/constants, but symbolic (placeholder-derived) tensors must
+    be passed through loop_vars."""
+    from jax import lax
+
+    from ..core import tape as _tape
+    from ..core.op_call import apply
+    from ..core.tensor import Tensor as _T
+    from ..tensor.creation import _as_t
+
+    if not isinstance(loop_vars, (tuple, list)) or not loop_vars:
+        raise ValueError("while_loop expects a non-empty list of loop_vars")
+    ctor = type(loop_vars)
+    tensors = [_as_t(v) for v in loop_vars]
+    cond_fn, body_fn = cond, body
+
+    def f(*arrs):
+        def c(carry):
+            with _tape.no_grad():
+                r = cond_fn(*[_T(a) for a in carry])
+            return _as_t(r)._data.reshape(()).astype(bool)
+
+        def b(carry):
+            with _tape.no_grad():
+                out = body_fn(*[_T(a) for a in carry])
+            if not isinstance(out, (tuple, list)):
+                out = (out,)
+            if len(out) != len(carry):
+                raise ValueError(
+                    f"while_loop body returned {len(out)} values for "
+                    f"{len(carry)} loop_vars")
+            res = []
+            for o, a in zip(out, carry):
+                oa = _as_t(o)._data
+                if oa.shape != a.shape or oa.dtype != a.dtype:
+                    raise ValueError(
+                        f"while_loop body changed a loop var from "
+                        f"{a.shape}/{a.dtype} to {oa.shape}/{oa.dtype} "
+                        "(loop-carried values must keep shape and dtype)")
+                res.append(oa)
+            return tuple(res)
+
+        return lax.while_loop(c, b, tuple(arrs))
+
+    outs = apply(f, *tensors, _op_name="while_loop")
+    if not isinstance(outs, (tuple, list)):
+        outs = (outs,)
+    for o in outs:
+        o.stop_gradient = True  # forward-only: XLA while has no vjp
+    return ctor(outs)

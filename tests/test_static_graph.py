@@ -320,3 +320,179 @@ class TestStaticTraining:
                 sched.step()
             # tiny clip norm -> slow but monotone-ish descent, no blowup
             assert losses[-1] < losses[0]
+
+
+class TestControlFlowStaging:
+    """r4 (VERDICT r3 item 5): static.nn.cond / while_loop / case /
+    switch_case work in eager mode, under jit.to_static, and inside
+    static Program recording."""
+
+    def test_cond_eager_and_jit(self):
+        def branchy(x):
+            return static.nn.cond(
+                paddle.mean(x) > 0,
+                lambda: x * 2.0,
+                lambda: x - 1.0)
+
+        xp = np.array([1.0, 2.0], np.float32)
+        xn = np.array([-1.0, -2.0], np.float32)
+        np.testing.assert_allclose(
+            branchy(paddle.to_tensor(xp)).numpy(), xp * 2)
+        np.testing.assert_allclose(
+            branchy(paddle.to_tensor(xn)).numpy(), xn - 1)
+        jb = paddle.jit.to_static(branchy)
+        np.testing.assert_allclose(jb(paddle.to_tensor(xp)).numpy(), xp * 2)
+        np.testing.assert_allclose(jb(paddle.to_tensor(xn)).numpy(), xn - 1)
+
+    def test_cond_gradients_flow_through_taken_branch(self):
+        x = paddle.to_tensor(np.array([3.0], np.float32))
+        x.stop_gradient = False
+        out = static.nn.cond(x.sum() > 0, lambda: x * 5.0, lambda: x * 7.0)
+        out.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+    def test_cond_structures_and_mismatch(self):
+        x = paddle.to_tensor(np.ones((2,), np.float32))
+        a, b = static.nn.cond(x.sum() > 0,
+                              lambda: (x, x * 2), lambda: (x * 3, x * 4))
+        np.testing.assert_allclose(b.numpy(), [2, 2])
+        with pytest.raises(ValueError, match="different structures"):
+            static.nn.cond(x.sum() > 0, lambda: (x, x), lambda: x)
+
+    def test_while_loop_eager_and_jit(self):
+        def count_to(limit):
+            i = paddle.to_tensor(np.asarray(0, np.int32))
+            s = paddle.to_tensor(np.asarray(0, np.int32))
+            i, s = static.nn.while_loop(
+                lambda i, s: i < limit,
+                lambda i, s: (i + 1, s + i),
+                [i, s])
+            return s
+
+        assert int(count_to(paddle.to_tensor(np.asarray(5, np.int32)))) == 10
+        jc = paddle.jit.to_static(count_to)
+        # data-dependent trip count under ONE traced program
+        assert int(jc(paddle.to_tensor(np.asarray(5, np.int32)))) == 10
+        assert int(jc(paddle.to_tensor(np.asarray(7, np.int32)))) == 21
+
+    def test_case_and_switch_case(self):
+        x = paddle.to_tensor(np.asarray(2.0, np.float32))
+        out = static.nn.case(
+            [(x < 1, lambda: x * 10), (x < 3, lambda: x * 100)],
+            default=lambda: x * 1000)
+        np.testing.assert_allclose(float(out), 200.0)
+        out2 = static.nn.switch_case(
+            paddle.to_tensor(np.asarray(1, np.int32)),
+            {0: lambda: x * 1, 1: lambda: x * 2, 2: lambda: x * 3})
+        np.testing.assert_allclose(float(out2), 4.0)
+
+    def test_cond_stages_into_static_program(self, static_mode):
+        with static.program_guard(static.Program()):
+            x = static.data("cf_x", [4], "float32")
+            out = static.nn.cond(paddle.mean(x) > 0,
+                                 lambda: x * 2.0, lambda: x - 1.0)
+            exe = static.Executor()
+            xp = np.array([1, 2, 3, 4], np.float32)
+            xn = -xp
+            (o1,) = exe.run(feed={"cf_x": xp}, fetch_list=[out])
+            (o2,) = exe.run(feed={"cf_x": xn}, fetch_list=[out])
+        np.testing.assert_allclose(o1, xp * 2)
+        np.testing.assert_allclose(o2, xn - 1)
+
+    def test_while_loop_stages_into_static_program(self, static_mode):
+        with static.program_guard(static.Program()):
+            n = static.data("cf_n", [], "int32")
+            i = paddle.to_tensor(np.asarray(0, np.int32))
+            s = paddle.to_tensor(np.asarray(0, np.int32))
+            # symbolic outer value rides through loop_vars, per the doc
+            _, s_out, _ = static.nn.while_loop(
+                lambda i, s, lim: i < lim,
+                lambda i, s, lim: (i + 1, s + i, lim),
+                [i, s, n])
+            exe = static.Executor()
+            (sv,) = exe.run(feed={"cf_n": np.asarray(6, np.int32)},
+                            fetch_list=[s_out])
+        assert int(sv) == 15
+
+    def test_branchy_model_trains_eagerly(self):
+        # a data-dependent-branch model end to end (the VERDICT's "branchy
+        # model" criterion): gate picks a head by the sample mean
+        lin = nn.Linear(4, 4)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=lin.parameters())
+        rng = np.random.RandomState(0)
+        X = rng.randn(8, 4).astype(np.float32)
+        losses = []
+        for _ in range(10):
+            h = lin(paddle.to_tensor(X))
+            out = static.nn.cond(paddle.mean(h) > 0,
+                                 lambda: paddle.tanh(h), lambda: h * 0.5)
+            loss = (out ** 2).mean()
+            losses.append(float(loss))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert losses[-1] < losses[0]
+
+
+class TestExecutorStructuralCache:
+    """r4 (VERDICT r3 item 8): the Executor keys compiled programs on a
+    STRUCTURAL hash of the fetched subgraph, not fetch-tensor identity."""
+
+    def _build_and_run(self, exe, scale, feed):
+        with static.program_guard(static.Program()):
+            x = static.data("sc_x", [None, 4], "float32")
+            w = paddle.to_tensor(
+                np.arange(8, dtype=np.float32).reshape(4, 2) * scale)
+            out = paddle.nn.functional.softmax(paddle.matmul(x, w))
+            return exe.run(feed={"sc_x": feed}, fetch_list=[out])[0]
+
+    def test_rebuilt_program_hits_cache(self):
+        paddle.enable_static()
+        try:
+            exe = static.Executor()
+            feed = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+            r1 = self._build_and_run(exe, 1.0, feed)
+            n1 = len(exe._cache)
+            r2 = self._build_and_run(exe, 1.0, feed)   # rebuilt, identical
+            assert len(exe._cache) == n1               # ONE compiled entry
+            np.testing.assert_allclose(r1, r2, rtol=1e-6)
+            # same structure, different CONSTANT content -> new entry and
+            # (crucially) different results — content is program identity
+            r3 = self._build_and_run(exe, 2.0, feed)
+            assert len(exe._cache) == n1 + 1
+            assert not np.allclose(r1, r3)
+        finally:
+            paddle.disable_static()
+
+    def test_trained_params_ride_positionally_on_cache_hit(self):
+        # two structurally identical programs with DIFFERENT param values:
+        # the shared executable must produce each program's own result
+        paddle.enable_static()
+        try:
+            exe = static.Executor()
+            feed = np.ones((2, 4), np.float32)
+            outs = []
+            for seed in (1, 2):
+                with static.program_guard(static.Program()):
+                    paddle.seed(seed)
+                    x = static.data("pp_x", [None, 4], "float32")
+                    y = static.nn.fc(x, 3)
+                    outs.append(exe.run(feed={"pp_x": feed},
+                                        fetch_list=[y])[0])
+            assert len(exe._cache) == 1
+            assert not np.allclose(outs[0], outs[1])
+        finally:
+            paddle.disable_static()
+
+    def test_lru_bound(self):
+        paddle.enable_static()
+        try:
+            exe = static.Executor()
+            exe.CACHE_SIZE = 3
+            feed = np.ones((1, 4), np.float32)
+            for scale in (1.0, 2.0, 3.0, 4.0, 5.0):
+                self._build_and_run(exe, scale, feed)
+            assert len(exe._cache) <= 3
+        finally:
+            paddle.disable_static()
