@@ -23,7 +23,32 @@ import jax
 
 from ...core.tensor import Tensor
 
-__all__ = ["save_state_dict", "load_state_dict"]
+__all__ = ["save_state_dict", "load_state_dict", "wait_all_saves"]
+
+# async saves in flight: orbax checkpointers whose write threads are still
+# running (each holds its own thread; barriered before a new save to the
+# same path, and drainable via wait_all_saves / atexit)
+_pending = {}
+
+
+def _drain(path=None):
+    items = (list(_pending.items()) if path is None
+             else [(path, _pending[path])] if path in _pending else [])
+    for p, ck in items:
+        ck.wait_until_finished()
+        ck.close()
+        _pending.pop(p, None)
+
+
+def wait_all_saves():
+    """Block until every in-flight async checkpoint save has committed
+    (ref: the async save barrier on exit/next-save)."""
+    _drain()
+
+
+import atexit as _atexit
+
+_atexit.register(wait_all_saves)
 
 
 def _arrays(state_dict):
@@ -35,15 +60,28 @@ def _arrays(state_dict):
 
 def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
                     unique_id=None, async_save=False):
-    """Save a (possibly sharded) state_dict to `path` (a directory)."""
+    """Save a (possibly sharded) state_dict to `path` (a directory).
+
+    async_save=True (ref save_state_dict(..., async_save) (U)): the call
+    returns as soon as the arrays are snapshotted — orbax's async
+    checkpointer commits on a background thread while training proceeds.
+    The write is barriered before any subsequent save to the same path,
+    by wait_all_saves(), and at interpreter exit."""
     arrays = _arrays(state_dict)
     try:
         import orbax.checkpoint as ocp
 
         path = os.path.abspath(path)
+        # a previous in-flight save to this path must commit first (the
+        # reference serializes successive async saves the same way)
+        _drain(path)
         ckptr = ocp.StandardCheckpointer()
         ckptr.save(os.path.join(path, "state"), arrays, force=True)
+        if async_save:
+            _pending[path] = ckptr
+            return
         ckptr.wait_until_finished()
+        ckptr.close()
         return
     except ModuleNotFoundError:
         pass
@@ -59,6 +97,7 @@ def load_state_dict(state_dict, path, process_group=None,
     targets = {k: v for k, v in state_dict.items()}
     arrays = _arrays(state_dict)
     loaded = None
+    _drain(os.path.abspath(path))   # an in-flight async save must commit
     orbax_dir = os.path.join(os.path.abspath(path), "state")
     if os.path.isdir(orbax_dir):
         import orbax.checkpoint as ocp

@@ -81,10 +81,14 @@ class Predictor:
         names = getattr(self._layer, "_input_names", None) or []
         self._inputs = {n: InferTensor(n) for n in names}
         # persistent output handles, known BEFORE the first run (like the
-        # reference): one per exported output aval, updated in place
-        n_out = len(getattr(self._layer._exported, "out_avals", []) or [])
-        self._outputs = {f"output_{i}": InferTensor(f"output_{i}")
-                         for i in range(max(n_out, 1))}
+        # reference), under the REAL fetch names persisted in the artifact
+        # (save_inference_model round-trips fetch-var names; jit.save
+        # defaults to output_{i})
+        out_names = getattr(self._layer, "_output_names", None) or [
+            f"output_{i}"
+            for i in range(len(self._layer._exported.out_avals))]
+        self._output_order = list(out_names)
+        self._outputs = {n: InferTensor(n) for n in out_names}
 
     # ---------------- handle API (the reference workflow)
     def get_input_names(self):
@@ -118,10 +122,11 @@ class Predictor:
             raise RuntimeError(f"inputs not set: {missing}")
         outs = self._layer(*[self._inputs[n]._arr for n in self._inputs])
         outs = outs if isinstance(outs, (list, tuple)) else [outs]
-        for i, o in enumerate(outs):
-            name = f"output_{i}"
-            if name not in self._outputs:  # out_avals undercounted
-                self._outputs[name] = InferTensor(name)
+        if len(outs) != len(self._output_order):
+            raise RuntimeError(
+                f"program returned {len(outs)} outputs but the artifact "
+                f"declares {len(self._output_order)}")
+        for name, o in zip(self._output_order, outs):
             self._outputs[name]._arr = np.asarray(o._data)  # in place:
             # previously fetched handles keep observing fresh results
         return True

@@ -836,11 +836,20 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
     from ..jit.api import write_artifact
 
     fsave({}, path_prefix + ".pdiparams")
+    out_names, used = [], set()
+    for i, v in enumerate(fetch_vars):
+        n = getattr(v, "name", None) or f"output_{i}"
+        k = 0
+        while n in used:                  # names must be unique handles
+            k += 1
+            n = f"{n}_{k}"
+        used.add(n)
+        out_names.append(n)
     write_artifact(
         path_prefix, exported,
         [(shape, str(np.dtype(v._data.aval.dtype)))
          for shape, v in zip(spec_shapes, feed_vars)],
-        names, [])
+        names, [], output_names=out_names)
 
 
 def load_inference_model(path_prefix, executor=None, **kwargs):

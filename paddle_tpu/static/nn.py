@@ -61,7 +61,6 @@ def batch_norm(x, **kwargs):
 def _flatten_rets(res):
     """Flatten a branch return (Tensor | nested tuple/list of Tensors |
     None) into (leaves, rebuild)."""
-    from ..core.tensor import Tensor as _T
     from ..tensor.creation import _as_t
 
     if res is None:
@@ -89,18 +88,32 @@ def _flatten_rets(res):
 
 def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
     """ref static.nn.cond: run `true_fn()` where pred holds, `false_fn()`
-    otherwise. Both branch graphs are built (the reference records both
-    ConditionalBlocks too); the outputs are selected by the predicate, so
-    the op stages under jit, records into a static Program, and
-    backpropagates through the taken branch (the untaken branch's
-    cotangent is zero)."""
+    otherwise. In eager mode (concrete predicate) exactly ONE branch
+    executes — the reference's dygraph semantics, with exact gradients.
+    Under jit tracing / static recording both branch graphs are built
+    (the reference records both ConditionalBlocks too) and the outputs
+    are selected by the traced predicate; the untaken branch's cotangent
+    is zeroed AT THE SELECT, but its ops still see a zero cotangent, so a
+    branch guarding against non-differentiable points (e.g. sqrt at 0)
+    can still propagate NaN under tracing — the standard XLA select
+    trade-off. Route such guards through the predicate's values instead
+    (mask the INPUT, not the output)."""
+    import jax
     import jax.numpy as jnp
 
     from ..core.op_call import apply
+    from ..core.tensor import Tensor
     from ..tensor.creation import _as_t
+    from .graph import _SymArr
 
     if true_fn is None or false_fn is None:
         raise ValueError("cond requires both true_fn and false_fn")
+    pred_t = _as_t(pred)
+    pd = pred_t._data
+    if not isinstance(pd, (_SymArr, jax.core.Tracer)):
+        # eager: execute only the taken branch (exact reference dygraph
+        # semantics; no untaken-branch gradient artifacts)
+        return true_fn() if bool(np.asarray(pd).reshape(())) else false_fn()
     t_res = true_fn()
     f_res = false_fn()
     t_leaves, rebuild = _flatten_rets(t_res)
@@ -109,13 +122,17 @@ def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
         raise ValueError(
             f"cond branches return different structures: "
             f"{len(t_leaves)} vs {len(f_leaves)} tensors")
-    pred_t = _as_t(pred)
     outs = []
     for a, b in zip(t_leaves, f_leaves):
         if tuple(a.shape) != tuple(b.shape):
             raise ValueError(
                 f"cond branch outputs must have matching shapes, got "
                 f"{tuple(a.shape)} vs {tuple(b.shape)}")
+        if str(a.dtype) != str(b.dtype):
+            raise ValueError(
+                f"cond branch outputs must have matching dtypes, got "
+                f"{a.dtype} vs {b.dtype} (the select would silently "
+                "promote; cast one branch explicitly)")
         outs.append(apply(
             lambda p, x, y: jnp.where(p.reshape(()).astype(bool), x, y),
             pred_t, a, b, _op_name="cond"))

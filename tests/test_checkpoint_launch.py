@@ -54,6 +54,45 @@ class TestDistributedCheckpoint:
         with pytest.raises((KeyError, Exception)):
             load_state_dict(m2.state_dict(), str(tmp_path / "ck"))
 
+    def test_async_save_overlaps_training(self, tmp_path):
+        """r4 (VERDICT r3 item 6): async_save=True returns after the
+        snapshot; training steps mutate params while the write is in
+        flight; the committed checkpoint holds the SNAPSHOT values."""
+        from paddle_tpu.distributed.checkpoint import wait_all_saves
+
+        paddle.seed(4)
+        m = nn.Linear(64, 64)
+        opt = paddle.optimizer.SGD(learning_rate=0.5,
+                                   parameters=m.parameters())
+        w_snap = np.asarray(m.weight._data).copy()
+        save_state_dict(m.state_dict(), str(tmp_path / "ck"),
+                        async_save=True)
+        # training proceeds while the save is in flight
+        X = np.random.RandomState(0).randn(32, 64).astype(np.float32)
+        for _ in range(3):
+            loss = (m(paddle.to_tensor(X)) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert not np.allclose(np.asarray(m.weight._data), w_snap)
+        wait_all_saves()
+        paddle.seed(5)
+        m2 = nn.Linear(64, 64)
+        load_state_dict(m2.state_dict(), str(tmp_path / "ck"))
+        # the checkpoint is the SNAPSHOT, not the post-training weights
+        np.testing.assert_allclose(np.asarray(m2.weight._data), w_snap)
+
+    def test_async_save_successive_saves_serialize(self, tmp_path):
+        m = nn.Linear(8, 8)
+        for i in range(3):
+            m.weight._data = m.weight._data * 0 + float(i)
+            save_state_dict(m.state_dict(), str(tmp_path / "ck"),
+                            async_save=True)
+        # load drains the in-flight save; last write wins
+        m2 = nn.Linear(8, 8)
+        load_state_dict(m2.state_dict(), str(tmp_path / "ck"))
+        np.testing.assert_allclose(np.asarray(m2.weight._data), 2.0)
+
 
 class TestLauncher:
     def test_env_contract_and_run(self, tmp_path):

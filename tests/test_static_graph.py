@@ -356,8 +356,12 @@ class TestControlFlowStaging:
         a, b = static.nn.cond(x.sum() > 0,
                               lambda: (x, x * 2), lambda: (x * 3, x * 4))
         np.testing.assert_allclose(b.numpy(), [2, 2])
-        with pytest.raises(ValueError, match="different structures"):
-            static.nn.cond(x.sum() > 0, lambda: (x, x), lambda: x)
+        # structure mismatch surfaces when both branches are built, i.e.
+        # under tracing (eager executes only the taken branch)
+        with pytest.raises(Exception, match="different structures"):
+            paddle.jit.to_static(
+                lambda t: static.nn.cond(t.sum() > 0,
+                                         lambda: (t, t), lambda: t))(x)
 
     def test_while_loop_eager_and_jit(self):
         def count_to(limit):
@@ -496,3 +500,70 @@ class TestExecutorStructuralCache:
             assert len(exe._cache) <= 3
         finally:
             paddle.disable_static()
+
+
+class TestArtifactOutputNames:
+    """r4 (VERDICT r3 item 7): fetch names + out avals persist in the
+    .pdmodel artifact; the Predictor exposes the REAL names."""
+
+    def test_names_roundtrip_through_predictor(self, tmp_path, static_mode):
+        import paddle_tpu.inference as inference
+
+        with static.program_guard(static.Program()):
+            x = static.data("feat", [None, 4], "float32")
+            w = paddle.to_tensor(np.eye(4, 3, dtype=np.float32))
+            logits = paddle.matmul(x, w)
+            logits.name = "logits"
+            probs = paddle.nn.functional.softmax(logits)
+            probs.name = "probs"
+            prefix = str(tmp_path / "named")
+            static.save_inference_model(prefix, [x], [logits, probs])
+        paddle.disable_static()
+        pred = inference.create_predictor(inference.Config(prefix))
+        assert pred.get_output_names() == ["logits", "probs"]
+        inp = pred.get_input_handle("feat")
+        inp.copy_from_cpu(np.ones((2, 4), np.float32))
+        pred.run()
+        lg = pred.get_output_handle("logits").copy_to_cpu()
+        pb = pred.get_output_handle("probs").copy_to_cpu()
+        assert lg.shape == (2, 3) and pb.shape == (2, 3)
+        np.testing.assert_allclose(pb.sum(-1), 1.0, rtol=1e-5)
+        with pytest.raises(KeyError):
+            pred.get_output_handle("output_0")
+
+    def test_unnamed_fetches_default_and_jit_save_unaffected(
+            self, tmp_path, static_mode):
+        import paddle_tpu.inference as inference
+
+        with static.program_guard(static.Program()):
+            x = static.data("u_x", [None, 4], "float32")
+            y = x * 2.0
+            prefix = str(tmp_path / "unnamed")
+            static.save_inference_model(prefix, [x], [y])
+        paddle.disable_static()
+        pred = inference.create_predictor(inference.Config(prefix))
+        assert pred.get_output_names() == ["output_0"]
+        out = pred.run([np.ones((3, 4), np.float32)])[0]
+        np.testing.assert_allclose(out, 2.0)
+
+
+class TestCondGradSafety:
+    def test_eager_cond_executes_one_branch_no_nan(self):
+        # the classic where-grad trap: sqrt at 0 in the UNTAKEN branch
+        # must not poison gradients in eager mode (one branch executes)
+        x = paddle.to_tensor(np.array([0.0], np.float32))
+        x.stop_gradient = False
+        out = static.nn.cond(x.sum() > 0,
+                             lambda: paddle.sqrt(x), lambda: x * 2.0)
+        out.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+    def test_cond_dtype_mismatch_raises_under_tracing(self):
+        def f(x):
+            return static.nn.cond(
+                x.sum() > 0,
+                lambda: x.astype("int32"), lambda: x * 1.0)
+
+        with pytest.raises(Exception, match="matching dtypes"):
+            paddle.jit.to_static(f)(
+                paddle.to_tensor(np.ones(2, np.float32)))
