@@ -67,18 +67,34 @@ def _ceiling_tflops():
     return 2 * n ** 3 * chain / best / 1e12
 
 
-def _utilization(result, step, batch, units_per_sec, units_per_step):
+def _flash_flops(b, heads, sq, skv, d, causal=False, remat=False):
+    """Hand-counted FLOPs of one Pallas flash-attention call, fwd + bwd
+    (VERDICT r3 weak #3: XLA's cost_analysis cannot see inside
+    pallas_call, so flash-heavy models undercount utilization). fwd =
+    QK^T + PV = 4·b·h·sq·skv·d (halved when causal block-skip applies);
+    bwd ≈ 2.5× fwd (score recompute + dq + dk/dv kernels); remat runs
+    the fwd once more inside the backward."""
+    f = 4.0 * b * heads * sq * skv * d * (0.5 if causal else 1.0)
+    return f * (3.5 + (1.0 if remat else 0.0))
+
+
+def _utilization(result, step, batch, units_per_sec, units_per_step,
+                 pallas_flops=0.0):
     """Attach the analytic utilization block: FLOPs/step from XLA's cost
-    analysis of the exact compiled program, achieved TFLOP/s, and % of
-    both the nominal 197 TF peak and the live-measured tunnel ceiling
-    (SURVEY §6: MFU is the north-star for every family)."""
+    analysis of the exact compiled program PLUS the hand-counted Pallas
+    kernel FLOPs (cost_analysis is blind inside pallas_call), achieved
+    TFLOP/s, and % of both the nominal 197 TF peak and the live-measured
+    tunnel ceiling (SURVEY §6: MFU is the north-star for every family)."""
     try:
-        flops_per_step = float(step.cost_analysis(*batch)["flops"])
+        flops_xla = float(step.cost_analysis(*batch)["flops"])
     except Exception as e:  # cost analysis unsupported on this backend
         result["utilization_error"] = f"{type(e).__name__}: {e}"[:120]
         return result
+    flops_per_step = flops_xla + pallas_flops
     tflops = units_per_sec / units_per_step * flops_per_step / 1e12
     result["flops_per_step"] = flops_per_step
+    if pallas_flops:
+        result["pallas_flops_per_step_est"] = round(pallas_flops)
     result["achieved_tflops"] = round(tflops, 1)
     result["pct_nominal_peak"] = round(100 * tflops / _NOMINAL_PEAK_TF, 1)
     ceiling = _ceiling_tflops()
@@ -145,7 +161,8 @@ def bench_bert(B=32):
     sps = _measure(lambda: step(ids, ids), lambda o: float(o), B)
     res = {"metric": f"sequences/sec BERT-base MLM bf16 train (b{B}xs{S})",
            "value": round(sps, 1), "unit": "sequences/s"}
-    return _utilization(res, step, (ids, ids), sps, B)
+    pallas = 12 * _flash_flops(B, 12, S, S, 64)   # 12 bidirectional layers
+    return _utilization(res, step, (ids, ids), sps, B, pallas_flops=pallas)
 
 
 def bench_unet(B=4):
@@ -175,7 +192,37 @@ def bench_unet(B=4):
     its = _measure(lambda: step(lat, t, ctx, lat), lambda o: float(o), 1)
     res = {"metric": f"iters/sec SD-UNet bf16 train (b{B}, 32x32 latents)",
            "value": round(its, 2), "unit": "iters/s"}
-    return _utilization(res, step, (lat, t, ctx, lat), its, 1)
+    return _utilization(res, step, (lat, t, ctx, lat), its, 1,
+                        pallas_flops=_unet_attn_flops(cfg, B))
+
+
+def _unet_attn_flops(cfg, B):
+    """Per-step attention FLOPs of the SD-UNet's transformer blocks (self
+    + cross per block), from the same topology the model builds: attn on
+    down levels 0..n-2, the mid block, and up levels 1..n-1; spatial res
+    halves after each non-final down level and doubles after each
+    non-final up level (32x32 latents)."""
+    heads = cfg.attention_head_dim
+    chs = cfg.block_out_channels
+
+    def pair(dim, res):
+        s = res * res
+        d = dim // heads
+        return (_flash_flops(B, heads, s, s, d)          # self
+                + _flash_flops(B, heads, s, 77, d))      # cross (ctx=77)
+
+    total, res = 0.0, 32
+    for i, c in enumerate(chs):
+        if i < len(chs) - 1:
+            total += cfg.layers_per_block * pair(c, res)
+            res //= 2
+    total += pair(chs[-1], res)                          # mid
+    for i, c in enumerate(reversed(chs)):
+        if i > 0:
+            total += (cfg.layers_per_block + 1) * pair(c, res)
+        if i < len(chs) - 1:
+            res *= 2
+    return total
 
 
 def bench_llama():
@@ -219,7 +266,9 @@ def bench_llama():
                       f"bf16+recompute train (b{B}xs{S})"),
            "value": round(tps, 1), "unit": "tokens/s",
            "mfu_6N": round(mfu, 4)}
-    return _utilization(res, step, (ids, ids), tps, B * S)
+    pallas = 16 * _flash_flops(B, 16, S, S, 128, causal=True, remat=True)
+    return _utilization(res, step, (ids, ids), tps, B * S,
+                        pallas_flops=pallas)
 
 
 def bench_gpt_longseq(seq=8192, batch=2):
@@ -254,7 +303,10 @@ def bench_gpt_longseq(seq=8192, batch=2):
     res = {"metric": (f"tokens/sec/chip GPT-438M bf16+recompute long-seq "
                       f"train (b{batch}xs{seq})"),
            "value": round(tps, 1), "unit": "tokens/s"}
-    return _utilization(res, step, (ids, ids), tps, batch * seq)
+    pallas = 12 * _flash_flops(batch, 12, seq, seq, 128, causal=True,
+                               remat=True)
+    return _utilization(res, step, (ids, ids), tps, batch * seq,
+                        pallas_flops=pallas)
 
 
 def bench_ernie_hybrid():

@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""Per-op-class device-time attribution for the bench families (VERDICT r3
+item 1): capture a jax.profiler device trace of the exact compiled train
+step each family benches, then aggregate HLO self-time by op category via
+xprof's hlo_stats converter.
+
+    python benchmarks/profile_families.py resnet50|bert|unet [--trace-dir D]
+
+Prints a JSON report: total device time/step, per-category time share,
+top-15 individual ops with source attribution, and the compute/HBM-bound
+split. The committed reports live in benchmarks/profiles/.
+"""
+
+import collections
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import time
+
+
+def _capture(family, trace_dir):
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import jax
+
+    paddle.seed(0)
+    if family == "resnet50":
+        from paddle_tpu.vision.models import resnet50
+
+        model = resnet50(num_classes=1000)
+        model.to(dtype="bfloat16")
+        opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                        parameters=model.parameters())
+
+        def loss_fn(net, x, y):
+            return nn.functional.cross_entropy(
+                paddle.cast(net(x), "float32"), y)
+
+        rng = np.random.RandomState(0)
+        batch = (paddle.cast(paddle.to_tensor(
+            rng.randn(64, 3, 224, 224).astype(np.float32)), "bfloat16"),
+            paddle.to_tensor(rng.randint(0, 1000, (64,)).astype(np.int64)))
+    elif family == "bert":
+        from paddle_tpu.models import BertConfig, BertForMaskedLM
+
+        cfg = BertConfig(vocab_size=30522, hidden_size=768,
+                         num_hidden_layers=12, num_attention_heads=12,
+                         intermediate_size=3072,
+                         max_position_embeddings=512)
+        model = BertForMaskedLM(cfg)
+        model.to(dtype="bfloat16")
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=model.parameters(),
+                                     multi_precision=True)
+
+        def loss_fn(net, ids, labels):
+            out = net(ids, labels=labels)
+            return out[0] if isinstance(out, tuple) else out
+
+        rng = np.random.RandomState(0)
+        ids = paddle.to_tensor(
+            rng.randint(0, 30522, (32, 128)).astype(np.int32))
+        batch = (ids, ids)
+    elif family == "unet":
+        from paddle_tpu.models import UNetConfig, UNet2DConditionModel
+
+        cfg = UNetConfig()
+        model = UNet2DConditionModel(cfg)
+        model.to(dtype="bfloat16")
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=model.parameters(),
+                                     multi_precision=True)
+
+        def loss_fn(net, x, t, ctx, target):
+            return nn.functional.mse_loss(net(x, t, ctx), target)
+
+        rng = np.random.RandomState(0)
+        lat = paddle.cast(paddle.to_tensor(
+            rng.randn(4, cfg.in_channels, 32, 32).astype(np.float32)),
+            "bfloat16")
+        batch = (lat,
+                 paddle.to_tensor(rng.randint(0, 1000, (4,)).astype(np.int32)),
+                 paddle.cast(paddle.to_tensor(
+                     rng.randn(4, 77, cfg.cross_attention_dim)
+                     .astype(np.float32)), "bfloat16"),
+                 lat)
+    else:
+        raise SystemExit(f"unknown family {family}")
+
+    step = paddle.jit.TrainStep(model, loss_fn, opt)
+    float(step(*batch))
+    float(step(*batch))
+    os.system(f"rm -rf {trace_dir}")
+    n_steps = 5
+    jax.profiler.start_trace(trace_dir)
+    for _ in range(n_steps):
+        out = step(*batch)
+    float(out)
+    jax.profiler.stop_trace()
+    return n_steps
+
+
+def _source_of(row):
+    info = row.get("source_info") or ""
+    if "title='" in info:
+        first = info.split("title='", 1)[1].split("\n", 1)[0]
+        return first.replace("/root/repo/", "")
+    return ""
+
+
+def analyze(trace_dir, n_steps):
+    from xprof.convert import raw_to_tool_data as r
+
+    (path,) = glob.glob(trace_dir + "/**/*.xplane.pb", recursive=True)
+    data, _ = r.xspace_to_tool_data([path], "hlo_stats", {})
+    j = json.loads(data)
+    cols = [c["id"] for c in j["cols"]]
+    rows = [dict(zip(cols, [c.get("v") for c in row["c"]]))
+            for row in j["rows"]]
+
+    total_us = sum(r_["total_self_time"] for r_ in rows)
+    by_cat = collections.defaultdict(lambda: [0.0, 0.0, 0.0])  # us, hbm, n
+    for r_ in rows:
+        cat = r_["category"]
+        by_cat[cat][0] += r_["total_self_time"]
+        if r_.get("bound_by") == "HBM":
+            by_cat[cat][1] += r_["total_self_time"]
+        by_cat[cat][2] += r_.get("occurrences", 0)
+
+    cats = [{"category": c, "us_per_step": round(v[0] / n_steps, 1),
+             "pct": round(100 * v[0] / total_us, 1),
+             "hbm_bound_pct": round(100 * v[1] / max(v[0], 1e-9), 0),
+             "ops_per_step": int(v[2] / n_steps)}
+            for c, v in sorted(by_cat.items(), key=lambda kv: -kv[1][0])]
+    top = [{"op": r_["hlo_op_name"], "category": r_["category"],
+            "us_per_step": round(r_["total_self_time"] / n_steps, 1),
+            "pct": round(r_["total_self_time_percent"], 2),
+            "bound_by": r_.get("bound_by"),
+            "flop_rate_gflops": round(r_.get("model_flop_rate") or 0, 1),
+            "hbm_gbps": round(r_.get("hbm_bw") or 0, 1),
+            "source": _source_of(r_)}
+           for r_ in rows[:15]]
+    hbm_us = sum(r_["total_self_time"] for r_ in rows
+                 if r_.get("bound_by") == "HBM")
+    return {"device_us_per_step": round(total_us / n_steps, 1),
+            "hbm_bound_pct_of_time": round(100 * hbm_us / total_us, 1),
+            "by_category": cats[:14], "top_ops": top}
+
+
+def main():
+    family = sys.argv[1]
+    trace_dir = f"/tmp/prof_{family}"
+    if "--trace-dir" in sys.argv:
+        trace_dir = sys.argv[sys.argv.index("--trace-dir") + 1]
+    if "--analyze-only" not in sys.argv:
+        n = _capture(family, trace_dir)
+    else:
+        n = 5
+    rep = analyze(trace_dir, n)
+    rep["family"] = family
+    print(json.dumps(rep, indent=1))
+
+
+if __name__ == "__main__":
+    main()

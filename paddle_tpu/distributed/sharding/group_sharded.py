@@ -68,7 +68,10 @@ class GroupShardedTrainStep(TrainStep):
 
     def __init__(self, model, loss_fn, optimizer, level="p_g_os", scaler=None,
                  mesh=None, offload=False, axis="sharding", donate=True):
-        super().__init__(model, loss_fn, optimizer, scaler=scaler, donate=donate)
+        # auto_layout=False: this subclass jits with its OWN mesh shardings
+        # per batch arity — the inherited AUTO-layout path would bypass them
+        super().__init__(model, loss_fn, optimizer, scaler=scaler,
+                         donate=donate, auto_layout=False)
         if level not in _LEVELS:
             raise ValueError(f"level must be one of {list(_LEVELS)}, got {level!r}")
         self.level = level

@@ -23,11 +23,21 @@ from ..core import random_state
 
 class TrainStep:
     def __init__(self, model, loss_fn, optimizer, scaler=None, donate=True,
-                 mesh=None, in_shardings=None, has_aux=False):
+                 mesh=None, in_shardings=None, has_aux=False,
+                 auto_layout=None):
         """loss_fn(model, *batch_tensors) -> loss Tensor (scalar), or with
         has_aux=True -> (loss, aux) where aux is a Tensor/tuple of Tensors
         returned alongside the loss (e.g. network outputs for metric
-        updates — ref Model.fit reports metrics every train batch)."""
+        updates — ref Model.fit reports metrics every train batch).
+
+        auto_layout (default: on for single-device steps): compile with
+        compiler-CHOSEN input layouts (jax.experimental.layout AUTO) and
+        re-lay the params/optimizer states out once to match. Without it,
+        XLA must layout-copy big weights between the conv-preferred and
+        the default parameter layout EVERY step (donated aliasing pins
+        entry layout == exit layout): the r4 SD-UNet trace showed 40
+        ms/step — 40% of device time — of f32 master-weight layout flips
+        (benchmarks/profiles/unet_b4_r4.json)."""
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -35,7 +45,25 @@ class TrainStep:
         self.donate = donate
         self.mesh = mesh
         self.has_aux = has_aux
+        import os as _os
+
+        env = _os.environ.get("PADDLE_TPU_AUTO_LAYOUT")
+        if auto_layout is None and env is not None:
+            auto_layout = env not in ("0", "false", "off")
+        self.auto_layout = (auto_layout if auto_layout is not None
+                            else mesh is None and in_shardings is None)
+        benv = _os.environ.get("PADDLE_TPU_UPDATE_BARRIER")
+        # None = decide at build time from model size (see _build): the
+        # barrier un-fuses dW matmuls from the optimizer update — a big
+        # win for compute-dense models (BERT +17% on-chip) but a loss for
+        # huge-parameter models whose grads then materialize to HBM
+        # (860M-param SD-UNet −9%)
+        self.update_barrier = (benv not in ("0", "false", "off")
+                               if benv is not None else None)
         self._jitted = None
+        self._compiled_cache = {}
+        self._layout_owner = None   # cache entry whose AUTO layouts the
+        # state arrays currently hold (see _run_auto)
         self._param_names = None
         self._buffer_names = None
 
@@ -45,8 +73,97 @@ class TrainStep:
             self.optimizer._state_for(p)
 
     def _build(self):
+        if self.update_barrier is None:
+            param_bytes = sum(
+                p._data.size * p._data.dtype.itemsize
+                for p in self.optimizer._parameter_list
+                if hasattr(p, "_data"))
+            self.update_barrier = param_bytes <= 512 * 1024 * 1024
+        if self.auto_layout:
+            # AUTO layouts lower from bare avals (no shardings): only safe
+            # when every param lives on ONE device — a DistModel/pipeline
+            # step whose params carry multi-device NamedShardings would be
+            # silently gathered onto one chip
+            for p in self.optimizer._parameter_list:
+                sh = getattr(getattr(p, "_data", None), "sharding", None)
+                if sh is not None and len(sh.device_set) > 1:
+                    self.auto_layout = False
+                    break
         self._jitted = jax.jit(self._make_step_fn(),
                                donate_argnums=(0, 2) if self.donate else ())
+
+    def _run_auto(self, *args):
+        """AUTO-layout execution: jit with compiler-CHOSEN layouts for the
+        params/buffers/opt-state args only (batch/lr/rng keep the default
+        layout — relaying a fresh host batch out every step cost ResNet
+        ~5%), compile per arg signature, query the chosen input formats,
+        and device_put any mismatched state leaf ONCE — donated aliasing
+        keeps every later step zero-copy."""
+        from jax.experimental.layout import Format, Layout
+
+        flat, treedef = jax.tree.flatten(args)
+        # only the batch part of the signature can vary between calls
+        # (state shapes are fixed per TrainStep); keying on it alone keeps
+        # the per-step key O(batch) instead of O(params)
+        bflat, btree = jax.tree.flatten(args[6:])
+        key = (len(flat), btree, tuple((a.shape, a.dtype) for a in bflat))
+        ent = self._compiled_cache.get(key)
+        if ent is None:
+            auto = Format(Layout.AUTO)
+            specs = (auto, auto, auto) + (None,) * (len(args) - 3)
+            # buffers (arg 1) are donated here too: their exit layouts
+            # must alias their AUTO entry layouts for the trusted-skip
+            # below to hold for >=2-D buffers
+            jitted = jax.jit(self._make_step_fn(),
+                             donate_argnums=(0, 1, 2) if self.donate else (),
+                             in_shardings=specs,
+                             out_shardings=Format(Layout.AUTO))
+            # AUTO-layout lowering requires abstract avals (concrete
+            # arrays carry layouts that would contradict AUTO)
+            sds = jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(jnp.shape(a),
+                                               jnp.asarray(a).dtype), args)
+            compiled = jitted.lower(*sds).compile()
+            fmt_flat, fmt_tree = jax.tree.flatten(compiled.input_formats[0])
+            if fmt_tree != treedef:  # defensive: structures must agree
+                raise RuntimeError("input_formats structure mismatch")
+            # leaves of args 0/1/2 (params, buffers, opt states) are
+            # rebound from the step's outputs, so their relayout may
+            # DONATE the source buffer (no transient double copy of the
+            # model+optimizer); lr/rng/batch buffers are caller-owned
+            own = set()
+            off = 0
+            for i, a in enumerate(args):
+                n = len(jax.tree.flatten(a)[0])
+                if i in (0, 1, 2):
+                    own.update(range(off, off + n))
+                off += n
+            ent = self._compiled_cache[key] = (compiled, fmt_flat, own)
+        compiled, fmt_flat, own = ent
+        # after the first successful call under THIS entry the own (state)
+        # leaves come back from the step's outputs already in the chosen
+        # layouts (donated aliasing) — checking ~2k Formats per step cost
+        # ~15 ms of Python on the 860M-param UNet, so trust the aliasing
+        # and only verify the few caller-owned leaves (batch/lr/rng). The
+        # trust is keyed to ONE entry at a time: switching batch shapes
+        # relayouts the state into the new entry's formats, so any other
+        # entry must re-verify from scratch.
+        trusted = self._layout_owner == key
+        moved = [a if (trusted and i in own)
+                 or getattr(a, "format", None) == f
+                 else jax.device_put(a, f, donate=(i in own))
+                 for i, (a, f) in enumerate(zip(flat, fmt_flat))]
+        try:
+            out = compiled(*jax.tree.unflatten(treedef, moved))
+        except Exception:
+            if trusted:
+                # a state leaf was rebound externally (load_state_dict
+                # mid-training): redo the full relayout once
+                self._layout_owner = None
+                return self._run_auto(*args)
+            raise
+        self._layout_owner = key
+        return out
 
     def _make_step_fn(self):
         """Construct the pure step function (params/buffers/opt-state pytrees
@@ -102,6 +219,16 @@ class TrainStep:
                     params_grads = [(p, p.grad) for p in live_params if p.grad is not None]
                     if opt._grad_clip is not None:
                         params_grads = opt._grad_clip(params_grads)
+                    if self.update_barrier and params_grads:
+                        # keep the dW matmuls OUT of the optimizer-update
+                        # fusions: fused (dW + AdamW) ops ran at ~18
+                        # TFLOP/s on the r4 BERT trace vs ~60+ for the
+                        # bare matmul — the epilogue's 4 full-size f32
+                        # outputs wreck the MXU pipeline
+                        barr = jax.lax.optimization_barrier(
+                            [g._data for _, g in params_grads])
+                        for (_, g), na in zip(params_grads, barr):
+                            g._data = na
                     grad_by_id = {id(p): g for p, g in params_grads}
                     new_params = []
                     new_opt_states = []
@@ -157,7 +284,12 @@ class TrainStep:
         if self._jitted is None:
             self._ensure_states()
             self._build()
-        sd = self.model.state_dict()
+        # the state Tensor OBJECTS are stable across steps (__call__
+        # rebinds their ._data in place) — walking the module tree per
+        # step cost ~10 ms of Python on an 860M-param model
+        sd = getattr(self, "_sd_cache", None)
+        if sd is None:
+            sd = self._sd_cache = self.model.state_dict()
         param_arrays = [sd[n]._data for n in self._param_names]
         buffer_arrays = [sd[n]._data for n in self._buffer_names]
         opt = self.optimizer
@@ -202,8 +334,9 @@ class TrainStep:
         (sd, param_arrays, buffer_arrays, opt_states, lr, rng_key,
          scaler_state, batch_arrays) = self._marshal(*batch)
         opt = self.optimizer
+        run = self._run_auto if self.auto_layout else self._jitted
         (new_params, new_buffers, new_opt_states, loss, new_scaler_state,
-         aux_arrays) = self._jitted(
+         aux_arrays) = run(
             param_arrays, buffer_arrays, opt_states, lr, rng_key, scaler_state,
             *batch_arrays
         )

@@ -1,8 +1,16 @@
 """Stable-Diffusion-style conditional UNet (BASELINE.md config 5; the
-reference hosts it in ppdiffusers). Fused-GroupNorm + cross-attention blocks
-— GroupNorm rides the fused Pallas kernel (ops/pallas/norms.py group_norm)
-whenever the sample fits VMEM, attention rides the flash path. Kept at
-SD-1.x topology but parameterized so the bench can scale it."""
+reference hosts it in ppdiffusers). Kept at SD-1.x topology but
+parameterized so the bench can scale it.
+
+TPU-first layout (r4): the model runs CHANNELS-LAST (NHWC) internally —
+the r4 device trace (benchmarks/profiles/unet_b4_r4.json) showed the
+NCHW variant spending 50% of device time in data-formatting ops (2387
+transposes/step, 80% HBM-bound) because every TransformerBlock2D hop
+between conv [B,C,H,W] and attention [B,HW,C] materializes a physical
+transpose. With C already minor, those hops are free reshapes. The
+weight layout (OIHW, paddle convention) and the state_dict are
+unchanged; `channels_last=False` restores the reference layout
+bit-for-bit (parity-tested in tests/test_models.py)."""
 
 from __future__ import annotations
 
@@ -31,6 +39,7 @@ class UNetConfig:
     attention_head_dim: int = 8
     norm_num_groups: int = 32
     sample_size: int = 64
+    channels_last: bool = True
 
 
 def timestep_embedding(t, dim, max_period=10000):
@@ -41,18 +50,22 @@ def timestep_embedding(t, dim, max_period=10000):
 
 
 class ResnetBlock2D(Layer):
-    def __init__(self, in_c, out_c, temb_c, groups=32):
+    def __init__(self, in_c, out_c, temb_c, groups=32, data_format="NCHW"):
         super().__init__()
-        self.norm1 = GroupNorm(min(groups, in_c), in_c)
-        self.conv1 = Conv2D(in_c, out_c, 3, padding=1)
+        self._df = data_format
+        self.norm1 = GroupNorm(min(groups, in_c), in_c, data_format=data_format)
+        self.conv1 = Conv2D(in_c, out_c, 3, padding=1, data_format=data_format)
         self.time_emb_proj = Linear(temb_c, out_c)
-        self.norm2 = GroupNorm(min(groups, out_c), out_c)
-        self.conv2 = Conv2D(out_c, out_c, 3, padding=1)
-        self.shortcut = Conv2D(in_c, out_c, 1) if in_c != out_c else None
+        self.norm2 = GroupNorm(min(groups, out_c), out_c, data_format=data_format)
+        self.conv2 = Conv2D(out_c, out_c, 3, padding=1, data_format=data_format)
+        self.shortcut = Conv2D(in_c, out_c, 1, data_format=data_format) \
+            if in_c != out_c else None
 
     def forward(self, x, temb):
         h = self.conv1(F.silu(self.norm1(x)))
-        h = h + M.reshape(self.time_emb_proj(F.silu(temb)), [temb.shape[0], -1, 1, 1])
+        tshape = ([temb.shape[0], 1, 1, -1] if self._df == "NHWC"
+                  else [temb.shape[0], -1, 1, 1])
+        h = h + M.reshape(self.time_emb_proj(F.silu(temb)), tshape)
         h = self.conv2(F.silu(self.norm2(h)))
         sc = self.shortcut(x) if self.shortcut is not None else x
         return h + sc
@@ -80,10 +93,12 @@ class CrossAttention(Layer):
 
 
 class TransformerBlock2D(Layer):
-    def __init__(self, dim, context_dim, heads, groups=32):
+    def __init__(self, dim, context_dim, heads, groups=32,
+                 data_format="NCHW"):
         super().__init__()
-        self.norm_in = GroupNorm(min(groups, dim), dim)
-        self.proj_in = Conv2D(dim, dim, 1)
+        self._df = data_format
+        self.norm_in = GroupNorm(min(groups, dim), dim, data_format=data_format)
+        self.proj_in = Conv2D(dim, dim, 1, data_format=data_format)
         self.norm1 = LayerNorm(dim)
         self.attn1 = CrossAttention(dim, dim, heads)
         self.norm2 = LayerNorm(dim)
@@ -91,36 +106,48 @@ class TransformerBlock2D(Layer):
         self.norm3 = LayerNorm(dim)
         self.ff1 = Linear(dim, dim * 4)
         self.ff2 = Linear(dim * 4, dim)
-        self.proj_out = Conv2D(dim, dim, 1)
+        self.proj_out = Conv2D(dim, dim, 1, data_format=data_format)
 
     def forward(self, x, context):
-        b, c, h, w = x.shape
         residual = x
         y = self.proj_in(self.norm_in(x))
-        y = M.reshape(M.transpose(y, [0, 2, 3, 1]), [b, h * w, c])
+        if self._df == "NHWC":
+            # channels already minor: [B,H,W,C] <-> [B,HW,C] is a free
+            # reshape — the whole point of the channels-last layout
+            b, h, w, c = x.shape
+            y = M.reshape(y, [b, h * w, c])
+        else:
+            b, c, h, w = x.shape
+            y = M.reshape(M.transpose(y, [0, 2, 3, 1]), [b, h * w, c])
         y = y + self.attn1(self.norm1(y))
         y = y + self.attn2(self.norm2(y), context)
         y = y + self.ff2(F.gelu(self.ff1(self.norm3(y))))
-        y = M.transpose(M.reshape(y, [b, h, w, c]), [0, 3, 1, 2])
+        if self._df == "NHWC":
+            y = M.reshape(y, [b, h, w, c])
+        else:
+            y = M.transpose(M.reshape(y, [b, h, w, c]), [0, 3, 1, 2])
         return self.proj_out(y) + residual
 
 
 class Downsample2D(Layer):
-    def __init__(self, c):
+    def __init__(self, c, data_format="NCHW"):
         super().__init__()
-        self.conv = Conv2D(c, c, 3, stride=2, padding=1)
+        self.conv = Conv2D(c, c, 3, stride=2, padding=1,
+                           data_format=data_format)
 
     def forward(self, x):
         return self.conv(x)
 
 
 class Upsample2D(Layer):
-    def __init__(self, c):
+    def __init__(self, c, data_format="NCHW"):
         super().__init__()
-        self.conv = Conv2D(c, c, 3, padding=1)
+        self._df = data_format
+        self.conv = Conv2D(c, c, 3, padding=1, data_format=data_format)
 
     def forward(self, x):
-        x = F.interpolate(x, scale_factor=2, mode="nearest")
+        x = F.interpolate(x, scale_factor=2, mode="nearest",
+                          data_format=self._df)
         return self.conv(x)
 
 
@@ -129,9 +156,12 @@ class UNet2DConditionModel(Layer):
         super().__init__()
         c = config or UNetConfig()
         self.config = c
+        df = "NHWC" if getattr(c, "channels_last", False) else "NCHW"
+        self._df = df
         ch = c.block_out_channels
         temb_c = ch[0] * 4
-        self.conv_in = Conv2D(c.in_channels, ch[0], 3, padding=1)
+        self.conv_in = Conv2D(c.in_channels, ch[0], 3, padding=1,
+                              data_format=df)
         self.time_proj_dim = ch[0]
         self.time_mlp1 = Linear(ch[0], temb_c)
         self.time_mlp2 = Linear(temb_c, temb_c)
@@ -147,20 +177,26 @@ class UNet2DConditionModel(Layer):
         for i, out_c in enumerate(ch):
             use_attn = i < len(ch) - 1  # SD: attn on all but the last down block
             for j in range(c.layers_per_block):
-                self.down_resnets.append(ResnetBlock2D(in_c, out_c, temb_c, c.norm_num_groups))
+                self.down_resnets.append(ResnetBlock2D(
+                    in_c, out_c, temb_c, c.norm_num_groups, data_format=df))
                 self.down_attns.append(
-                    TransformerBlock2D(out_c, c.cross_attention_dim, heads, c.norm_num_groups)
+                    TransformerBlock2D(out_c, c.cross_attention_dim, heads,
+                                       c.norm_num_groups, data_format=df)
                     if use_attn else _Identity()
                 )
                 self._down_plan.append(use_attn)
                 in_c = out_c
             if i < len(ch) - 1:
-                self.downsamplers.append(Downsample2D(out_c))
+                self.downsamplers.append(Downsample2D(out_c, data_format=df))
 
         # mid
-        self.mid_res1 = ResnetBlock2D(ch[-1], ch[-1], temb_c, c.norm_num_groups)
-        self.mid_attn = TransformerBlock2D(ch[-1], c.cross_attention_dim, heads, c.norm_num_groups)
-        self.mid_res2 = ResnetBlock2D(ch[-1], ch[-1], temb_c, c.norm_num_groups)
+        self.mid_res1 = ResnetBlock2D(ch[-1], ch[-1], temb_c,
+                                      c.norm_num_groups, data_format=df)
+        self.mid_attn = TransformerBlock2D(ch[-1], c.cross_attention_dim,
+                                           heads, c.norm_num_groups,
+                                           data_format=df)
+        self.mid_res2 = ResnetBlock2D(ch[-1], ch[-1], temb_c,
+                                      c.norm_num_groups, data_format=df)
 
         # up
         self.up_resnets = LayerList()
@@ -174,18 +210,23 @@ class UNet2DConditionModel(Layer):
             skip_ch_list = self._skip_channels(ch, i, c.layers_per_block)
             for j in range(c.layers_per_block + 1):
                 skip_c = skip_ch_list[j]
-                self.up_resnets.append(ResnetBlock2D(prev_c + skip_c, out_c, temb_c, c.norm_num_groups))
+                self.up_resnets.append(ResnetBlock2D(
+                    prev_c + skip_c, out_c, temb_c, c.norm_num_groups,
+                    data_format=df))
                 self.up_attns.append(
-                    TransformerBlock2D(out_c, c.cross_attention_dim, heads, c.norm_num_groups)
+                    TransformerBlock2D(out_c, c.cross_attention_dim, heads,
+                                       c.norm_num_groups, data_format=df)
                     if use_attn else _Identity()
                 )
                 self._up_plan.append(use_attn)
                 prev_c = out_c
             if i < len(rev) - 1:
-                self.upsamplers.append(Upsample2D(out_c))
+                self.upsamplers.append(Upsample2D(out_c, data_format=df))
 
-        self.conv_norm_out = GroupNorm(c.norm_num_groups, ch[0])
-        self.conv_out = Conv2D(ch[0], c.out_channels, 3, padding=1)
+        self.conv_norm_out = GroupNorm(c.norm_num_groups, ch[0],
+                                       data_format=df)
+        self.conv_out = Conv2D(ch[0], c.out_channels, 3, padding=1,
+                               data_format=df)
 
     @staticmethod
     def _skip_channels(ch, up_idx, layers_per_block):
@@ -211,6 +252,10 @@ class UNet2DConditionModel(Layer):
         temb_raw = temb_raw.astype(self.time_mlp1.weight._data.dtype)
         temb = self.time_mlp2(F.silu(self.time_mlp1(Tensor(temb_raw))))
 
+        if self._df == "NHWC":
+            # one boundary transpose each way; everything inside is
+            # channels-last so conv<->attention hops are free reshapes
+            sample = M.transpose(sample, [0, 2, 3, 1])
         x = self.conv_in(sample)
         skips = [x]
         ri = 0
@@ -237,7 +282,8 @@ class UNet2DConditionModel(Layer):
         for i in range(len(ch)):
             for j in range(self.config.layers_per_block + 1):
                 skip = skips.pop()
-                x = M.concat([x, skip], axis=1)
+                x = M.concat([x, skip],
+                             axis=-1 if self._df == "NHWC" else 1)
                 x = self.up_resnets[ri](x, temb)
                 if self._up_plan[ri]:
                     x = self.up_attns[ri](x, encoder_hidden_states)
@@ -247,7 +293,10 @@ class UNet2DConditionModel(Layer):
                 ui += 1
 
         x = F.silu(self.conv_norm_out(x))
-        return self.conv_out(x)
+        x = self.conv_out(x)
+        if self._df == "NHWC":
+            x = M.transpose(x, [0, 3, 1, 2])
+        return x
 
 
 class _Identity(Layer):

@@ -838,11 +838,11 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
     fsave({}, path_prefix + ".pdiparams")
     out_names, used = [], set()
     for i, v in enumerate(fetch_vars):
-        n = getattr(v, "name", None) or f"output_{i}"
-        k = 0
+        base = getattr(v, "name", None) or f"output_{i}"
+        n, k = base, 0
         while n in used:                  # names must be unique handles
             k += 1
-            n = f"{n}_{k}"
+            n = f"{base}_{k}"
         used.add(n)
         out_names.append(n)
     write_artifact(
