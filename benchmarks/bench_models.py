@@ -208,6 +208,11 @@ def _unet_attn_flops(cfg, B):
     def pair(dim, res):
         s = res * res
         d = dim // heads
+        if s < 128:
+            # short rows take the XLA sdpa fallback (attention.py
+            # _use_pallas: q seq >= 128) — cost_analysis already counts
+            # those FLOPs; adding them here would double-count
+            return 0.0
         return (_flash_flops(B, heads, s, s, d)          # self
                 + _flash_flops(B, heads, s, 77, d))      # cross (ctx=77)
 

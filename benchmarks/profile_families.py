@@ -94,7 +94,9 @@ def _capture(family, trace_dir):
     step = paddle.jit.TrainStep(model, loss_fn, opt)
     float(step(*batch))
     float(step(*batch))
-    os.system(f"rm -rf {trace_dir}")
+    import shutil
+
+    shutil.rmtree(trace_dir, ignore_errors=True)
     n_steps = 5
     jax.profiler.start_trace(trace_dir)
     for _ in range(n_steps):

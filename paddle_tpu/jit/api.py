@@ -278,12 +278,10 @@ def save(layer, path, input_spec=None, **configs):
                 raise ValueError(
                     f"jit.save: input_spec names must be unique, got "
                     f"{in_names}")
-            example_args = [
-                jnp.zeros([1 if (s is None or s < 0) else s for s in spec.shape], spec.dtype)
-                for spec in input_spec
-            ]
             state_arrays = [sd[k]._data for k in names]
-            exported = jax.export.export(jax.jit(infer_fn))(state_arrays, *example_args)
+            exported = export_with_dynamic_dims(
+                jax.jit(infer_fn), [state_arrays],
+                [(tuple(spec.shape), spec.dtype) for spec in input_spec])
             write_artifact(
                 path, exported,
                 [(list(s.shape),
@@ -292,6 +290,43 @@ def save(layer, path, input_spec=None, **configs):
                 in_names, names)
     else:
         raise TypeError("jit.save expects a Layer")
+
+
+def export_with_dynamic_dims(jit_fn, leading_args, specs):
+    """jax.export with dynamic (None/-1) spec dims as SYMBOLIC dims so the
+    served program accepts any size there (batch polymorphism). Shared by
+    jit.save and static.save_inference_model. specs: [(shape, dtype)]
+    where shape entries are int | None | -1. Symbols start fully
+    independent; if shape-polymorphic tracing cannot relate them (e.g.
+    two inputs whose batch dims must be equal: a + b), retry with ONE
+    symbol per axis index — the common shared-batch contract."""
+    def build(share_by_axis):
+        sym = {}
+        example, dynamic = [], False
+        for shape, dtype in specs:
+            dims = []
+            for ax, s in enumerate(shape):
+                if s is None or (isinstance(s, int) and s < 0):
+                    dynamic = True
+                    key = ax if share_by_axis else len(sym)
+                    if key not in sym:
+                        (sym[key],) = jax.export.symbolic_shape(
+                            f"d{len(sym)}")
+                    dims.append(sym[key])
+                else:
+                    dims.append(int(s))
+            example.append(jax.ShapeDtypeStruct(tuple(dims), dtype))
+        return example, dynamic
+
+    example, dynamic = build(False)
+    if not dynamic:
+        concrete = [jnp.zeros(tuple(s.shape), s.dtype) for s in example]
+        return jax.export.export(jit_fn)(*leading_args, *concrete)
+    try:
+        return jax.export.export(jit_fn)(*leading_args, *example)
+    except Exception:
+        example, _ = build(True)
+        return jax.export.export(jit_fn)(*leading_args, *example)
 
 
 def write_artifact(path, exported, input_spec, input_names, state_names,

@@ -155,10 +155,14 @@ class TrainStep:
                  for i, (a, f) in enumerate(zip(flat, fmt_flat))]
         try:
             out = compiled(*jax.tree.unflatten(treedef, moved))
-        except Exception:
-            if trusted:
-                # a state leaf was rebound externally (load_state_dict
-                # mid-training): redo the full relayout once
+        except ValueError as e:
+            # ONLY argument-layout mismatches are retryable (raised at
+            # arg-processing time, BEFORE execution/donation — a state
+            # leaf was rebound externally, e.g. load_state_dict
+            # mid-training). Genuine runtime failures (OOM, asserts) may
+            # have consumed donated buffers; retrying would bury the real
+            # error under "Array has been deleted".
+            if trusted and "layout" in str(e).lower():
                 self._layout_owner = None
                 return self._run_auto(*args)
             raise

@@ -1,8 +1,44 @@
-"""paddle.onnx stub: on the TPU build the export interchange format is
-StableHLO via paddle_tpu.jit.save (jax.export), not ONNX."""
+"""paddle.onnx (ref: paddle.onnx.export -> paddle2onnx (U)). The TPU
+build's model-interchange format is StableHLO, not ONNX: the `onnx`
+package does not exist in this environment and XLA consumes StableHLO
+natively, so `export` here produces the SAME portable artifact
+`paddle_tpu.jit.save` writes (serialized StableHLO + weights), loadable
+by `paddle_tpu.jit.load` and servable by `paddle_tpu.inference`
+Predictors. The function works — models exported through this API round
+-trip through the inference stack — but the on-disk format is
+`<path>.pdmodel` (StableHLO), NOT an `.onnx` protobuf; a consumer that
+needs true ONNX must run paddle2onnx against the reference framework.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+__all__ = ["export"]
 
 
 def export(layer, path, input_spec=None, opset_version=9, **configs):
-    raise NotImplementedError(
-        "ONNX export is replaced by StableHLO export: use paddle_tpu.jit.save"
-    )
+    """Export `layer` as a portable serving artifact (StableHLO).
+
+    Signature-compatible with the reference `paddle.onnx.export`:
+    `opset_version` and extra configs are accepted and ignored (they
+    parameterize the ONNX opset, which does not apply to StableHLO).
+    `path` follows the reference convention of a prefix WITHOUT the
+    format suffix; the artifact lands at `<path>.pdmodel` +
+    `<path>.pdiparams`. Returns the path prefix.
+    """
+    if input_spec is None:
+        raise ValueError(
+            "paddle.onnx.export requires input_spec (the reference "
+            "requires it for dynamic-graph export too)")
+    if path.endswith(".onnx"):
+        path = path[: -len(".onnx")]
+    warnings.warn(
+        "paddle_tpu.onnx.export writes a StableHLO artifact "
+        f"('{path}.pdmodel'), not an ONNX protobuf — StableHLO is this "
+        "build's interchange format (loadable via jit.load, servable "
+        "via paddle_tpu.inference).", stacklevel=2)
+    from ..jit.api import save as jit_save
+
+    jit_save(layer, path, input_spec=input_spec)
+    return path

@@ -809,31 +809,19 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
         return tuple(_evaluate(syms, vals))
 
     # dynamic (None/-1) placeholder dims export as SYMBOLIC dims so the
-    # served program accepts any size there (batch polymorphism)
+    # served program accepts any size there (batch polymorphism) — shared
+    # helper with jit.save (independent symbols, shared-per-axis retry)
+    from ..jit.api import export_with_dynamic_dims, write_artifact
+
     spec_shapes = []
-    example = []
-    dynamic = any(v._data.orig_shape and None in v._data.orig_shape
-                  for v in feed_vars)
-    sym_dims = {}
+    specs = []
     for v in feed_vars:
         orig = v._data.orig_shape or v._data.aval.shape
-        dims = []
-        for ax, d in enumerate(orig):
-            if d is None:
-                key = f"d{len(sym_dims)}"
-                if key not in sym_dims:
-                    (sym_dims[key],) = jax.export.symbolic_shape(key)
-                dims.append(sym_dims[key])
-            else:
-                dims.append(int(d))
-        example.append(jax.ShapeDtypeStruct(tuple(dims), v._data.aval.dtype)
-                       if dynamic else
-                       jnp.zeros(tuple(dims), v._data.aval.dtype))
+        specs.append((tuple(orig), v._data.aval.dtype))
         spec_shapes.append([None if d is None else int(d) for d in orig])
-    exported = jax.export.export(jax.jit(infer_fn))([], *example)
+    exported = export_with_dynamic_dims(jax.jit(infer_fn), [[]], specs)
     os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
     from ..framework.io import save as fsave
-    from ..jit.api import write_artifact
 
     fsave({}, path_prefix + ".pdiparams")
     out_names, used = [], set()

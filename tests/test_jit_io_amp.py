@@ -376,3 +376,78 @@ class TestInferencePredictor:
             paddle.jit.save(model, str(tmp_path / "m4"),
                             input_spec=[InputSpec([2, 4], "float32", "x"),
                                         InputSpec([2, 4], "float32", "x")])
+
+
+class TestOnnxExportAdapter:
+    """r4: paddle.onnx.export is a functional adapter — it writes the
+    StableHLO serving artifact (with a loud format warning) instead of
+    raising; jit.save now exports None dims batch-polymorphically."""
+
+    def test_export_serves_any_batch(self, tmp_path):
+        import warnings
+
+        import paddle_tpu.onnx as ponnx
+        import paddle_tpu.inference as inference
+        from paddle_tpu.static import InputSpec
+
+        paddle.seed(0)
+        m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            p = ponnx.export(m, str(tmp_path / "model.onnx"),
+                             input_spec=[InputSpec([None, 4], "float32")])
+            assert any("StableHLO" in str(x.message) for x in w)
+        pred = inference.create_predictor(inference.Config(p))
+        for bs in (1, 3, 7):
+            out = pred.run([np.ones((bs, 4), np.float32)])[0]
+            assert out.shape == (bs, 2)
+        ref = m(paddle.to_tensor(np.ones((3, 4), np.float32))).numpy()
+        np.testing.assert_allclose(pred.run([np.ones((3, 4), np.float32)])[0],
+                                   ref, rtol=1e-5)
+
+    def test_export_requires_input_spec(self, tmp_path):
+        import paddle_tpu.onnx as ponnx
+
+        with pytest.raises(ValueError, match="input_spec"):
+            ponnx.export(nn.Linear(2, 2), str(tmp_path / "m"))
+
+    def test_jit_save_polymorphic_roundtrip(self, tmp_path):
+        from paddle_tpu.static import InputSpec
+
+        paddle.seed(1)
+        m = nn.Linear(6, 3)
+        paddle.jit.save(m, str(tmp_path / "poly"),
+                        input_spec=[InputSpec([None, 6], "float32")])
+        layer = paddle.jit.load(str(tmp_path / "poly"))
+        for bs in (2, 5):
+            x = np.random.RandomState(bs).randn(bs, 6).astype(np.float32)
+            np.testing.assert_allclose(
+                layer(paddle.to_tensor(x)).numpy(),
+                m(paddle.to_tensor(x)).numpy(), rtol=1e-5)
+
+    def test_jit_save_polymorphic_shared_batch_two_inputs(self, tmp_path):
+        # two inputs whose batch dims must be EQUAL (a + b): independent
+        # symbols can't be related, so export retries with per-axis
+        # shared symbols
+        from paddle_tpu.static import InputSpec
+
+        class TwoIn(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 2)
+
+            def forward(self, a, b):
+                return self.fc(a + b)
+
+        paddle.seed(2)
+        m = TwoIn()
+        paddle.jit.save(m, str(tmp_path / "two"),
+                        input_spec=[InputSpec([None, 4], "float32"),
+                                    InputSpec([None, 4], "float32")])
+        layer = paddle.jit.load(str(tmp_path / "two"))
+        for bs in (2, 6):
+            a = np.random.RandomState(bs).randn(bs, 4).astype(np.float32)
+            np.testing.assert_allclose(
+                layer(paddle.to_tensor(a), paddle.to_tensor(a)).numpy(),
+                m(paddle.to_tensor(a), paddle.to_tensor(a)).numpy(),
+                rtol=1e-5)
