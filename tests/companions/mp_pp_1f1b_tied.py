@@ -1,0 +1,76 @@
+"""Companion: cross-process literal 1F1B schedule WITH tied embeddings and
+virtual pipeline stages (r4) — pp=4 x v=2 over a 2-process global mesh, so
+both the activation/cotangent ring hops AND the tied-weight gradient psum
+cross the process boundary. Prints per-rank losses. MP_SERIAL=1 runs the
+identical program single-process on 8 local devices."""
+
+import os
+
+SERIAL = os.environ.get("MP_SERIAL") == "1"
+os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count="
+                           + ("8" if SERIAL else "4"))
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed.fleet.meta_parallel import (
+    LayerDesc,
+    PipelineLayer,
+    PipelineParallel,
+    SharedLayerDesc,
+)
+
+H = 16
+
+
+class Block(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(H, H)
+
+    def forward(self, x):
+        return paddle.tanh(self.fc(x))
+
+
+def main():
+    if not SERIAL:
+        dist.init_parallel_env()
+    assert jax.device_count() == 8
+    hcg = dist.create_hybrid_communicate_group(dp=2, pp=4)
+
+    paddle.seed(0)
+    pp, v = 4, 2
+    pl = PipelineLayer(
+        [SharedLayerDesc("emb", nn.Linear, 8, H)]
+        + [LayerDesc(Block) for _ in range(2 * pp * v - 2)]
+        + [SharedLayerDesc(
+            "emb", nn.Linear, 8, H,
+            forward_func=lambda lyr, x: paddle.matmul(
+                x, lyr.weight, transpose_y=True))],
+        loss_fn=lambda o, y: nn.functional.mse_loss(o, y),
+        num_virtual_pipeline_stages=v)
+    runner = PipelineParallel(pl, hcg, {"accumulate_steps": 4,
+                                        "schedule": "1f1b"})
+    opt = paddle.optimizer.Momentum(learning_rate=0.05,
+                                    parameters=pl.parameters())
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(16, 8).astype(np.float32)
+    Y = rng.randn(16, 8).astype(np.float32)
+
+    losses = []
+    for _ in range(3):
+        loss = runner.train_batch(
+            (paddle.to_tensor(X), paddle.to_tensor(Y)), opt)
+        losses.append(round(float(loss), 6))
+    print("MP_1F1B_TIED_LOSSES", 0 if SERIAL else dist.get_rank(), losses,
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -116,6 +116,13 @@ class TestMultiProcessSPMD:
         CROSSING the process boundary (pp=4 x dp=2 over 2 processes)."""
         _check("mp_pp_train.py", 12533, "MP_PP_LOSSES")
 
+    def test_two_process_1f1b_tied_vpp_matches_serial(self):
+        """r4: the literal 1F1B schedule with tied embeddings AND virtual
+        stages (pp=4 x v=2 x dp=2 over 2 processes) — the per-slot
+        activation/cotangent rings and the tied-weight grad psum all
+        cross the process boundary."""
+        _check("mp_pp_1f1b_tied.py", 12623, "MP_1F1B_TIED_LOSSES")
+
     def test_four_process_dp_pp_matches_serial(self):
         """nnodes=4 rendezvous (VERDICT r2 item 8): dp=2 x pp=2 with ONE
         device per process — every collective edge crosses a process
