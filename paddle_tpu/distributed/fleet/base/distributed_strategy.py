@@ -54,6 +54,16 @@ _RECOMPUTE_DEFAULTS = {
     "enable_offload": False,
 }
 
+_GRADIENT_MERGE_DEFAULTS = {
+    "k_steps": 1,
+    "avg": True,
+}
+
+_LAMB_DEFAULTS = {
+    "lamb_weight_decay": 0.01,
+    "exclude_from_weight_decay": [],
+}
+
 
 class DistributedStrategy:
     def __init__(self):
@@ -71,6 +81,8 @@ class DistributedStrategy:
         self._sharding_configs = dict(_SHARDING_DEFAULTS)
         self._pipeline_configs = dict(_PIPELINE_DEFAULTS)
         self._recompute_configs = dict(_RECOMPUTE_DEFAULTS)
+        self._gradient_merge_configs = dict(_GRADIENT_MERGE_DEFAULTS)
+        self._lamb_configs = dict(_LAMB_DEFAULTS)
 
     # -- config dicts keep reference update-in-place semantics ------------
     @property
@@ -116,6 +128,22 @@ class DistributedStrategy:
     def recompute_configs(self, cfg):
         self._recompute_configs.update(cfg)
 
+    @property
+    def gradient_merge_configs(self):
+        return self._gradient_merge_configs
+
+    @gradient_merge_configs.setter
+    def gradient_merge_configs(self, cfg):
+        self._gradient_merge_configs.update(cfg)
+
+    @property
+    def lamb_configs(self):
+        return self._lamb_configs
+
+    @lamb_configs.setter
+    def lamb_configs(self, cfg):
+        self._lamb_configs.update(cfg)
+
     # -- helpers ----------------------------------------------------------
     def hybrid_degrees(self, n_devices):
         """Resolve degrees, absorbing remaining devices into dp_degree=-1."""
@@ -143,6 +171,10 @@ class DistributedStrategy:
             "sharding_configs": copy.deepcopy(self._sharding_configs),
             "pipeline_configs": copy.deepcopy(self._pipeline_configs),
             "recompute_configs": copy.deepcopy(self._recompute_configs),
+            "gradient_merge": self.gradient_merge,
+            "gradient_merge_configs": copy.deepcopy(self._gradient_merge_configs),
+            "lamb": self.lamb,
+            "lamb_configs": copy.deepcopy(self._lamb_configs),
         }
 
     def __repr__(self):

@@ -75,6 +75,17 @@ class _Fleet:
         return DataParallel(model)
 
     def distributed_optimizer(self, optimizer, strategy=None):
+        from ...static.graph import in_static_mode
+
+        if in_static_mode():
+            # static mode (ref: each strategy flag selects a meta-optimizer
+            # that rewrites the program before Executor.run — P20)
+            from .meta_optimizers.static_meta_optimizer import (
+                StaticMetaOptimizer,
+            )
+
+            return StaticMetaOptimizer(optimizer,
+                                       strategy or self._strategy)
         from .meta_optimizers.dygraph_optimizer.hybrid_parallel_optimizer import (
             HybridParallelOptimizer,
         )

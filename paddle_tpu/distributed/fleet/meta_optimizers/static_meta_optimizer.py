@@ -1,0 +1,238 @@
+"""Static-graph meta-optimizers (ref: fleet/meta_optimizers/*.py (U),
+SURVEY.md §2.2 P20 — there, each meta-optimizer REWRITES the static
+ProgramDesc before Executor.run: AMPOptimizer inserts cast ops + loss
+scaling, RecomputeOptimizer marks checkpoint segments for the backward,
+GradientMergeOptimizer wraps the update in a k-step accumulation,
+LambOptimizer swaps the update rule).
+
+TPU-native design: the recorded DAG (static/graph.py) plays the role of
+the ProgramDesc, and the rewrites are applied at `minimize()` time by ONE
+wrapper returned from `fleet.distributed_optimizer` under
+`paddle.enable_static()`:
+
+- **amp** — in-place cast rewrite of the recorded nodes: white-listed ops
+  (matmul/conv/...) compute in the amp dtype, black-listed ops
+  (softmax/norms/...) in f32 — the same O1 split `amp.auto_cast` applies
+  eagerly, but performed as a program transformation. fp16 additionally
+  gets dynamic loss scaling compiled INTO the train program
+  (Executor._run_train: scaled loss, unscaled grads, skip-update on
+  non-finite, grow/shrink bookkeeping). bf16 (TPU default) needs none.
+- **recompute** — `recompute_configs["checkpoints"]` (static Tensors) are
+  attached to the owning Program; the executor evaluates each
+  inter-checkpoint segment under `jax.checkpoint`, so the backward holds
+  only checkpoint values, not segment residuals.
+- **gradient_merge** — grads accumulate across `k_steps` runs inside the
+  compiled program; the parameter/optimizer update applies every k-th run
+  (`avg=True` divides by k — exact big-batch equivalence for mean losses).
+- **lamb** — the inner optimizer is replaced by Lamb with
+  `lamb_configs["lamb_weight_decay"]` and the name-substring
+  `exclude_from_weight_decay` list.
+
+Strategies that are mesh-placement concerns on TPU (sharding, dp/mp/pp)
+are NOT program rewrites here — GSPMD + the fleet wrappers own them
+(SURVEY.md §7 design stance); localsgd/dgc stay out of scope.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _iter_nodes(root_syms):
+    from ....static.graph import _SymArr
+
+    seen, stack = set(), [s.node for s in root_syms if s.node is not None]
+    while stack:
+        n = stack.pop()
+        if id(n) in seen:
+            continue
+        seen.add(id(n))
+        yield n
+        for x in n.inputs:
+            if isinstance(x, _SymArr) and x.node is not None:
+                stack.append(x.node)
+
+
+def _amp_cast_fn(fn, jd):
+    """Wrap a recorded node fn so floating array inputs are cast to `jd`
+    before compute — the static analog of op_call._maybe_amp_wrap."""
+
+    def wrapped(*arrays, **kw):
+        cast = [
+            a.astype(jd)
+            if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)
+            and a.dtype != jd else a
+            for a in arrays
+        ]
+        return fn(*cast, **kw)
+
+    wrapped._amp_static = jd
+    wrapped.__name__ = getattr(fn, "__name__", "op")
+    return wrapped
+
+
+def amp_rewrite(loss, dtype, level="O1", custom_white=(), custom_black=()):
+    """In-place white/black-list cast rewrite of every node reachable from
+    `loss` (the training subgraph — the static analog of the reference's
+    AMP pass over the main program's ops). Idempotent per (node, dtype)."""
+    from ....amp.auto_cast import BLACK_LIST, WHITE_LIST
+    from ....static.graph import StaticGraphError, _is_sym
+
+    if not _is_sym(loss):
+        raise StaticGraphError("amp rewrite expects a static loss Tensor")
+    white = set(WHITE_LIST) | set(custom_white)
+    black = (set(BLACK_LIST) | set(custom_black)) - set(custom_white)
+    n_rewritten = 0
+    for node in _iter_nodes([loss._data]):
+        name = node.op_name or ""
+        if not name:
+            continue  # unnamed helpers are never auto-cast (amp parity)
+        if name in black:
+            jd = jnp.float32
+        elif level == "O2" or name in white:
+            jd = jnp.dtype(dtype)
+        else:
+            continue
+        if getattr(node.fn, "_amp_static", None) == jd:
+            continue
+        node.fn = _amp_cast_fn(node.fn, jd)
+        n_rewritten += 1
+    return n_rewritten
+
+
+class StaticMetaOptimizer:
+    """The optimizer `fleet.distributed_optimizer` returns under static
+    mode. Presents the exact Optimizer surface Executor._run_train drives
+    (update math, accumulators, clip, lr) by delegating to the possibly-
+    swapped inner optimizer, plus the meta attributes the executor
+    consults (`_static_amp_scaler`, `_gm_k`, `_gm_avg`)."""
+
+    def __init__(self, optimizer, strategy):
+        from ..base.distributed_strategy import DistributedStrategy
+
+        self.__dict__["_inner"] = optimizer
+        self.__dict__["_strategy"] = strategy or DistributedStrategy()
+        self.__dict__["_static_amp_scaler"] = None
+        self.__dict__["_gm_k"] = 1
+        self.__dict__["_gm_avg"] = True
+        self.__dict__["_gm_buffers"] = None
+        self.__dict__["_gm_count"] = 0
+
+    # -- surface the executor mutates: route to the inner optimizer.
+    # __getattr__ delegates every read not found locally (incl.
+    # _parameter_list/_step_count/_accumulators), and __setattr__ routes
+    # every write that isn't a meta attribute to the inner optimizer — so
+    # register_minimize/Executor mutate the REAL optimizer's state.
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_inner"], name)
+
+    def __setattr__(self, name, value):
+        if name in self.__dict__ or name in (
+                "_static_amp_scaler", "_gm_k", "_gm_avg", "_gm_buffers",
+                "_gm_count"):
+            self.__dict__[name] = value
+        else:
+            setattr(self.__dict__["_inner"], name, value)
+
+    @property
+    def inner_opt(self):
+        return self._inner
+
+    # ------------------------------------------------------------ minimize
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        from ....static.graph import (StaticGraphError, _is_sym,
+                                      _owning_program, register_minimize)
+
+        if not _is_sym(loss):
+            raise StaticGraphError(
+                "StaticMetaOptimizer.minimize expects a static loss Tensor "
+                "(build the model under paddle.enable_static())")
+        strat = self._strategy
+
+        if getattr(strat, "lamb", False):
+            self.__dict__["_inner"] = self._as_lamb()
+
+        if getattr(strat, "amp", False):
+            cfg = strat.amp_configs
+            use_bf16 = bool(cfg.get("use_bf16", True))
+            dtype = jnp.bfloat16 if use_bf16 else jnp.float16
+            level = "O2" if cfg.get("use_pure_fp16") else "O1"
+            amp_rewrite(loss, dtype, level,
+                        custom_white=cfg.get("custom_white_list") or (),
+                        custom_black=cfg.get("custom_black_list") or ())
+            if not use_bf16:
+                # fp16 trains behind dynamic loss scaling, compiled into
+                # the train program by Executor._run_train
+                self._static_amp_scaler = {
+                    "cfg": dict(cfg),
+                    "state": {
+                        "scale": jnp.asarray(
+                            float(cfg.get("init_loss_scaling", 32768.0)),
+                            jnp.float32),
+                        "good": jnp.asarray(0, jnp.int32),
+                        "bad": jnp.asarray(0, jnp.int32),
+                    },
+                }
+
+        if getattr(strat, "gradient_merge", False):
+            gm = strat.gradient_merge_configs
+            self._gm_k = max(1, int(gm.get("k_steps", 1)))
+            self._gm_avg = bool(gm.get("avg", True))
+            self._gm_buffers = None
+            self._gm_count = 0
+
+        result = register_minimize(self, loss, parameters=parameters,
+                                   no_grad_set=no_grad_set)
+
+        if getattr(strat, "recompute", False):
+            cks = strat.recompute_configs.get("checkpoints") or []
+            syms = []
+            for t in cks:
+                data = getattr(t, "_data", t)
+                if not hasattr(data, "aval"):
+                    raise StaticGraphError(
+                        "recompute_configs['checkpoints'] must be static "
+                        "Tensors from the recorded program")
+                syms.append(data)
+            _owning_program([loss._data])._recompute_checkpoints = syms
+        return result
+
+    def _as_lamb(self):
+        from ....optimizer.optimizers import Lamb
+
+        inner = self._inner
+        if isinstance(inner, Lamb):
+            return inner
+        cfg = self._strategy.lamb_configs
+        excl = [s for s in (cfg.get("exclude_from_weight_decay") or [])]
+        fn = (lambda p: any(s in (p.name or "") for s in excl)) \
+            if excl else None
+        # an Adam-family inner optimizer keeps its betas/epsilon across the
+        # swap (reference LambOptimizer inherits the inner hyperparams)
+        return Lamb(
+            learning_rate=inner._learning_rate,
+            lamb_weight_decay=float(cfg.get("lamb_weight_decay", 0.01)),
+            beta1=float(getattr(inner, "_beta1", 0.9)),
+            beta2=float(getattr(inner, "_beta2", 0.999)),
+            epsilon=float(getattr(inner, "_epsilon", 1e-6)),
+            parameters=inner._parameter_list,
+            grad_clip=inner._grad_clip,
+            exclude_from_weight_decay_fn=fn,
+        )
+
+    # dygraph-surface passthroughs (so scripts probing the wrapper work)
+    def step(self):
+        return self._inner.step()
+
+    def clear_grad(self, *a, **k):
+        return self._inner.clear_grad(*a, **k)
+
+    clear_gradients = clear_grad
+
+    @property
+    def loss_scaling(self):
+        """Current dynamic loss scale (fp16 amp), reference-parity probe."""
+        s = self._static_amp_scaler
+        return float(s["state"]["scale"]) if s else 1.0

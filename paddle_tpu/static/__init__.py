@@ -12,8 +12,12 @@ load_inference_model. Static-mode TRAINING (r4): `append_backward` and
 (parameters promoted from closure constants to traced inputs) and apply the
 optimizer's functional update inside the same compiled program — the
 reference's `exe.run(startup); exe.run(main, feed, [loss])` loop trains.
-The static meta-optimizer stack (P20) is still out of scope; the serious
-training path remains dygraph + `paddle_tpu.jit.TrainStep` (SURVEY.md §7).
+Static meta-optimizers (P20, r4): `fleet.distributed_optimizer` under
+static mode returns a program-rewriting wrapper (amp cast rewrite + fp16
+dynamic loss scaling, recompute over declared checkpoints, k-step gradient
+merge, Lamb swap — fleet/meta_optimizers/static_meta_optimizer.py). The
+serious training path remains dygraph + `paddle_tpu.jit.TrainStep`
+(SURVEY.md §7).
 """
 
 from ..jit.api import InputSpec

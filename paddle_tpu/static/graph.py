@@ -22,9 +22,11 @@ differentiates the recorded graph with `jax.value_and_grad` through
 `_evaluate`, applies the optimizer's functional update (`_update_for`,
 the same math jit.TrainStep compiles), and writes the new arrays back
 into the live Parameter tensors — the reference's canonical
-`exe.run(startup); exe.run(main, feed, [loss])` loop trains. The heavier
-static meta-optimizer stack (P20) stays out of scope; serious training
-is the dygraph + jit.TrainStep path (SURVEY.md §7 design stance).
+`exe.run(startup); exe.run(main, feed, [loss])` loop trains. The static
+meta-optimizer stack (P20) plugs in here too: _run_train honors the
+recompute/loss-scaling/gradient-merge hooks installed by
+fleet.StaticMetaOptimizer.minimize (see that module). Serious training
+remains the dygraph + jit.TrainStep path (SURVEY.md §7 design stance).
 """
 
 from __future__ import annotations
@@ -305,13 +307,14 @@ def _static_apply(fn, args, kwargs, op_name):
     return out_tensors[0]
 
 
-def _evaluate(fetch_syms, feed_values, param_values=None):
-    """Evaluate the DAG for the given fetches. feed_values: name->array;
-    param_values (optional): id(param Tensor) -> traced array, promoting
-    captured parameters from closure constants to function inputs (the
-    training path differentiates through this). Memoized over nodes; runs
-    under whatever trace calls it (Executor jits it)."""
-    node_memo = {}
+def _run_dag(target_nodes, feed_values, param_values=None, seed=None):
+    """Iterative post-order evaluation of the recorded DAG up to (and
+    including) every node in `target_nodes`. Returns the node memo
+    (id(node) -> [outputs]). `seed` pre-populates the memo — the recompute
+    meta-optimizer seeds checkpoint nodes with carried values so the
+    segment between checkpoints re-evaluates under `jax.checkpoint`
+    instead of saving residuals (SURVEY.md §2.2 P20)."""
+    node_memo = dict(seed) if seed else {}
     param_values = param_values or {}
 
     def param_of(ref):
@@ -325,14 +328,12 @@ def _evaluate(fetch_syms, feed_values, param_values=None):
             raise StaticGraphError(
                 f"missing feed for placeholder {sym.feed_name!r}")
 
-    def value_of(sym):
-        """Iterative post-order over producers — a sequential graph deeper
-        than the interpreter recursion limit must still evaluate."""
-        if sym.feed_name is not None:
-            return feed_of(sym)
-        if sym.node is None:
-            raise StaticGraphError("symbolic value with no producer")
-        stack = [sym.node]
+    for tgt in target_nodes:
+        if tgt is None or id(tgt) in node_memo:
+            continue
+        # iterative post-order over producers — a sequential graph deeper
+        # than the interpreter recursion limit must still evaluate
+        stack = [tgt]
         while stack:
             n = stack[-1]
             if id(n) in node_memo:
@@ -357,9 +358,52 @@ def _evaluate(fetch_syms, feed_values, param_values=None):
             out = n.fn(*full, **n.kwargs)
             node_memo[id(n)] = list(out) if isinstance(out, (tuple, list)) \
                 else [out]
-        return node_memo[id(sym.node)][sym.out_idx]
+    return node_memo
 
-    return [value_of(s) for s in fetch_syms]
+
+def _evaluate(fetch_syms, feed_values, param_values=None, seed=None):
+    """Evaluate the DAG for the given fetches. feed_values: name->array;
+    param_values (optional): id(param Tensor) -> traced array, promoting
+    captured parameters from closure constants to function inputs (the
+    training path differentiates through this). Memoized over nodes; runs
+    under whatever trace calls it (Executor jits it)."""
+    for s in fetch_syms:
+        if s.feed_name is None and s.node is None:
+            raise StaticGraphError("symbolic value with no producer")
+    memo = _run_dag(
+        [s.node for s in fetch_syms if s.feed_name is None],
+        feed_values, param_values, seed)
+    out = []
+    for s in fetch_syms:
+        if s.feed_name is not None:
+            try:
+                out.append(feed_values[s.feed_name])
+            except KeyError:
+                raise StaticGraphError(
+                    f"missing feed for placeholder {s.feed_name!r}")
+        else:
+            out.append(memo[id(s.node)][s.out_idx])
+    return out
+
+
+def _topo_positions(root_node):
+    """id(node) -> dense post-order index for every node reachable from
+    `root_node` (dependencies before dependents)."""
+    order, stack = {}, [root_node]
+    while stack:
+        n = stack[-1]
+        if id(n) in order:
+            stack.pop()
+            continue
+        pending = [x.node for x in n.inputs
+                   if isinstance(x, _SymArr) and x.node is not None
+                   and id(x.node) not in order]
+        if pending:
+            stack.extend(pending)
+            continue
+        stack.pop()
+        order[id(n)] = len(order)
+    return order
 
 
 def _collect_params(syms):
@@ -607,6 +651,27 @@ def register_minimize(optimizer, loss, parameters=None, no_grad_set=None):
     return None, pairs
 
 
+def _scaler_next(state, finite, cfg):
+    """Dynamic loss-scale bookkeeping (ref OptimizerWithMixedPrecision /
+    update_loss_scaling op semantics): grow the scale after
+    `incr_every_n_steps` consecutive finite steps, shrink it after
+    `decr_every_n_nan_or_inf` consecutive non-finite steps."""
+    if not cfg.get("use_dynamic_loss_scaling", True):
+        return state
+    found = ~finite
+    good = jnp.where(found, 0, state["good"] + 1)
+    bad = jnp.where(found, state["bad"] + 1, 0)
+    grow = good >= int(cfg.get("incr_every_n_steps", 1000))
+    shrink = bad >= int(cfg.get("decr_every_n_nan_or_inf", 2))
+    scale = jnp.where(
+        shrink, state["scale"] * float(cfg.get("decr_ratio", 0.5)),
+        jnp.where(grow, state["scale"] * float(cfg.get("incr_ratio", 2.0)),
+                  state["scale"]))
+    return {"scale": scale,
+            "good": jnp.where(grow, 0, good),
+            "bad": jnp.where(shrink, 0, bad)}
+
+
 class Executor:
     """ref static.Executor: compiles + runs the fetched subgraph as ONE
     XLA program per (graph structure, feed shapes) signature — the key is
@@ -623,6 +688,7 @@ class Executor:
     def __init__(self, place=None):
         self.place = place
         self._cache = _collections.OrderedDict()
+        self._ck_cache = _collections.OrderedDict()
         # identity front cache: same live fetch-tensor objects -> skip the
         # O(nodes) signature walk on the hot serving path (fetch identity
         # implies graph identity while the syms — pinned here — are alive)
@@ -697,7 +763,19 @@ class Executor:
     def _run_train(self, prog, train_op, syms, grad_syms, feed_names,
                    feed_arrays, return_numpy):
         """One optimizer step (and/or grad computation) over the recorded
-        DAG: ONE compiled program runs forward, backward and update."""
+        DAG: ONE compiled program runs forward, backward and update.
+
+        Static meta-optimizer hooks (SURVEY.md §2.2 P20; set by
+        fleet.StaticMetaOptimizer.minimize):
+        - `prog._recompute_checkpoints`: list of _SymArr — the backward
+          rematerializes each inter-checkpoint segment (`jax.checkpoint`)
+          instead of saving its residuals.
+        - `opt._static_amp_scaler`: fp16 dynamic loss scaling — loss is
+          scaled inside the compiled program, grads unscaled, non-finite
+          steps skip the update and shrink the scale.
+        - `opt._gm_k` / `opt._gm_avg`: gradient merge — grads accumulate
+          across k runs; the update applies on every k-th.
+        """
         if train_op is not None:
             loss_t, opt = train_op
             loss_sym = loss_t._data
@@ -715,29 +793,134 @@ class Executor:
             for p in params:
                 opt._state_for(p)
         fwd_syms = [s for s in syms if not isinstance(s, _GradSym)]
+
+        # ---- meta-optimizer configuration (defaults = plain training) ----
+        ck_syms = list(getattr(prog, "_recompute_checkpoints", ()) or ())
+        ck_nodes = []
+        if ck_syms and loss_sym.node is not None:
+            # memoized per (program, loss, checkpoint set): the O(nodes)
+            # topo walk must not run on every step of a cached train loop
+            ck_key = (id(prog), id(loss_sym),
+                      tuple(id(s) for s in ck_syms))
+            ent = self._ck_cache.get(ck_key)
+            if ent is not None:
+                ck_nodes = ent[0]
+            else:
+                order = _topo_positions(loss_sym.node)
+                seen_ck = set()
+                for s in ck_syms:
+                    if s.feed_name is not None:
+                        continue  # feeds are always live — nothing to save
+                    if s.node is None or id(s.node) not in order:
+                        raise StaticGraphError(
+                            "recompute checkpoint is not reachable from "
+                            "the loss of this program")
+                    if id(s.node) not in seen_ck:
+                        seen_ck.add(id(s.node))
+                        ck_nodes.append(s.node)
+                ck_nodes.sort(key=lambda n: order[id(n)])
+                # pin the keyed objects so a recycled id can't alias
+                self._ck_cache[ck_key] = (ck_nodes,
+                                          (prog, loss_sym, ck_syms))
+                if len(self._ck_cache) > self.CACHE_SIZE:
+                    self._ck_cache.popitem(last=False)
+        scaler = (getattr(opt, "_static_amp_scaler", None)
+                  if opt is not None else None)
+        gm_k = int(getattr(opt, "_gm_k", 1) or 1) if opt is not None else 1
+        gm_avg = bool(getattr(opt, "_gm_avg", True))
+        if gm_k > 1:
+            if getattr(opt, "_gm_buffers", None) is None:
+                opt._gm_buffers = [jnp.zeros_like(p._data) for p in params]
+                # with fp16 scaling, non-finite micro-steps don't
+                # accumulate — the merged average divides by the number
+                # of steps that actually landed, not by k
+                opt._gm_nacc = jnp.zeros((), jnp.int32)
+                opt._gm_count = 0
+            apply_update = (opt._gm_count + 1) % gm_k == 0
+        else:
+            apply_update = True
+
         # the train executable is bound to the optimizer object (its
         # accumulators key on these exact param tensors), so identity —
         # not structure — is the right key here
-        key = ("train", id(prog), id(loss_sym), id(opt),
+        key = ("train", id(prog), id(loss_sym), id(opt), apply_update,
+               gm_k, scaler is not None,
+               tuple(id(n) for n in ck_nodes),
                tuple(id(s) for s in syms), tuple(feed_names),
                tuple((a.shape, str(a.dtype)) for a in feed_arrays))
         cached = self._cache_get(key)
         if cached is None:
-            def train_fn(param_arrays, opt_states, lr, *arrays):
+            def train_fn(param_arrays, opt_states, lr, scaler_state, acc,
+                         nacc, *arrays):
                 vals = dict(zip(feed_names, arrays))
+                scale = scaler_state.get("scale")
 
                 def loss_and_fetches(pas):
                     pv = {id(p): a for p, a in zip(params, pas)}
-                    outs = _evaluate([loss_sym] + fwd_syms, vals, pv)
-                    return outs[0], outs[1:]
+                    seed = {}
+                    if ck_nodes:
+                        # recompute: evaluate each checkpoint's node under
+                        # jax.checkpoint from (params, feeds, earlier
+                        # checkpoints) — only checkpoint values are saved
+                        # for the backward; segment internals rematerialize
+                        seeded_ids = []
+                        for n in ck_nodes:
+                            prev_vals = [seed[i] for i in seeded_ids]
+
+                            def seg(pas_, prev_, _n=n,
+                                    _ids=tuple(seeded_ids)):
+                                pv_ = {id(p): a
+                                       for p, a in zip(params, pas_)}
+                                sm = dict(zip(_ids, prev_))
+                                memo = _run_dag([_n], vals, pv_, seed=sm)
+                                return memo[id(_n)]
+
+                            seed[id(n)] = jax.checkpoint(seg)(pas, prev_vals)
+                            seeded_ids.append(id(n))
+                    outs = _evaluate([loss_sym] + fwd_syms, vals, pv,
+                                     seed=seed or None)
+                    loss = outs[0]
+                    if scale is not None:
+                        loss = loss * scale.astype(loss.dtype)
+                    return loss, outs[1:]
 
                 (_, fwd_vals), grads = jax.value_and_grad(
                     loss_and_fetches, has_aux=True)(tuple(param_arrays))
-                if opt is None:
-                    return fwd_vals, grads, param_arrays, opt_states
+                finite = jnp.asarray(True)
+                new_scaler_state = scaler_state
+                if scale is not None:
+                    inv = 1.0 / scale
+                    grads = tuple(
+                        (g.astype(jnp.float32) * inv).astype(g.dtype)
+                        for g in grads)
+                    for g in grads:
+                        finite &= jnp.all(
+                            jnp.isfinite(g.astype(jnp.float32)))
+                    new_scaler_state = _scaler_next(
+                        scaler_state, finite, scaler["cfg"])
+                if gm_k > 1:
+                    safe = [jnp.where(finite, g, jnp.zeros_like(g))
+                            for g in grads] if scale is not None else grads
+                    new_acc = [a + g for a, g in zip(acc, safe)]
+                    new_nacc = nacc + jnp.where(finite, 1, 0).astype(
+                        jnp.int32)
+                else:
+                    new_acc, new_nacc = acc, nacc
+                if opt is None or not apply_update:
+                    return (fwd_vals, grads, param_arrays, opt_states,
+                            new_scaler_state, new_acc, new_nacc)
                 from ..core.tensor import Tensor as _T
 
-                pairs = [(p, _T(g)) for p, g in zip(params, grads)]
+                if gm_k > 1:
+                    denom = (jnp.maximum(new_nacc, 1).astype(jnp.float32)
+                             if gm_avg else jnp.asarray(1.0, jnp.float32))
+                    eff = [a / denom.astype(a.dtype) for a in new_acc]
+                    out_acc = [jnp.zeros_like(a) for a in new_acc]
+                    out_nacc = jnp.zeros((), jnp.int32)
+                else:
+                    eff = list(grads)
+                    out_acc, out_nacc = new_acc, new_nacc
+                pairs = [(p, _T(g)) for p, g in zip(params, eff)]
                 if opt._grad_clip is not None:
                     pairs = opt._grad_clip(pairs)
                 g_by_id = {id(p): g._data for p, g in pairs}
@@ -750,7 +933,19 @@ class Executor:
                     np_, nst = opt._update_for(p, a, g_arr, st, plr)
                     new_params.append(np_)
                     new_states.append(nst)
-                return fwd_vals, grads, new_params, new_states
+                if scale is not None:
+                    # a non-finite step must not touch params or optimizer
+                    # state (reference skip-update semantics): for gm_k==1
+                    # that's THIS step's finiteness; for merge, skip only
+                    # if NO micro-step accumulated anything
+                    keep = finite if gm_k == 1 else new_nacc > 0
+                    new_params = [jnp.where(keep, n, o) for n, o
+                                  in zip(new_params, param_arrays)]
+                    new_states = jax.tree.map(
+                        lambda n, o: jnp.where(keep, n, o),
+                        new_states, opt_states)
+                return (fwd_vals, grads, new_params, new_states,
+                        new_scaler_state, out_acc, out_nacc)
 
             cached = self._cache_put(key, jax.jit(train_fn))
         param_arrays = [p._data for p in params]
@@ -758,9 +953,20 @@ class Executor:
                       if opt is not None else [])
         lr = (jnp.asarray(opt.get_lr(), jnp.float32) if opt is not None
               else jnp.zeros((), jnp.float32))
-        fwd_vals, grads, new_params, new_states = cached(
-            param_arrays, opt_states, lr, *feed_arrays)
-        if opt is not None:
+        scaler_state = dict(scaler["state"]) if scaler is not None else {}
+        acc = list(opt._gm_buffers) if gm_k > 1 else []
+        nacc = (opt._gm_nacc if gm_k > 1
+                else jnp.zeros((), jnp.int32))
+        (fwd_vals, grads, new_params, new_states, new_scaler_state,
+         new_acc, new_nacc) = cached(param_arrays, opt_states, lr,
+                                     scaler_state, acc, nacc, *feed_arrays)
+        if scaler is not None:
+            scaler["state"] = dict(new_scaler_state)
+        if gm_k > 1:
+            opt._gm_buffers = list(new_acc)
+            opt._gm_nacc = new_nacc
+            opt._gm_count += 1
+        if opt is not None and apply_update:
             for p, arr in zip(params, new_params):
                 p._data = arr
             for p, st in zip(params, new_states):

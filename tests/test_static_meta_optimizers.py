@@ -1,0 +1,310 @@
+"""Static meta-optimizers (SURVEY.md §2.2 P20): fleet.distributed_optimizer
+under paddle.enable_static() returns a program-rewriting wrapper — amp cast
+rewrite (+ fp16 dynamic loss scaling), recompute over declared checkpoints,
+k-step gradient merge, and the Lamb swap — the TPU-native analog of the
+reference's fleet/meta_optimizers ProgramDesc passes."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.static as static
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet.meta_optimizers.static_meta_optimizer import (
+    StaticMetaOptimizer,
+)
+
+
+@pytest.fixture
+def static_mode():
+    paddle.enable_static()
+    try:
+        yield
+    finally:
+        paddle.disable_static()
+
+
+def _problem(n=64, d=8):
+    rng = np.random.RandomState(0)
+    X = rng.randn(n, d).astype(np.float32)
+    Y = (X @ rng.randn(d, 1).astype(np.float32)
+         + 0.1 * rng.randn(n, 1).astype(np.float32))
+    return X, Y
+
+
+def _mlp_program(hidden=16, seed=0):
+    """Build x -> fc -> relu -> fc -> mse inside the CURRENT program guard;
+    returns (x, y, hidden_act, loss)."""
+    paddle.seed(seed)
+    x = static.data("x", [None, 8], "float32")
+    y = static.data("y", [None, 1], "float32")
+    h = paddle.nn.functional.relu(static.nn.fc(x, hidden))
+    pred = static.nn.fc(h, 1)
+    loss = paddle.mean((pred - y) ** 2)
+    return x, y, h, loss
+
+
+class TestStaticAMP:
+    def test_bf16_rewrite_casts_white_ops_and_trains(self, static_mode):
+        X, Y = _problem()
+        strat = fleet.DistributedStrategy()
+        strat.amp = True                      # bf16 default: no loss scaling
+        with static.program_guard(static.Program()):
+            x, y, h, loss = _mlp_program()
+            opt = fleet.distributed_optimizer(
+                paddle.optimizer.SGD(learning_rate=0.05), strategy=strat)
+            assert isinstance(opt, StaticMetaOptimizer)
+            opt.minimize(loss)
+            exe = static.Executor()
+            losses, hv = [], None
+            for _ in range(15):
+                lv, hv = exe.run(feed={"x": X, "y": Y},
+                                 fetch_list=[loss, h], return_numpy=False)
+                losses.append(float(lv.numpy()))
+        # the white-listed matmul now computes (and emits) bf16 — proof the
+        # REWRITE happened, not an eager autocast scope
+        assert str(hv.dtype) in ("paddle.bfloat16", "bfloat16") \
+            or "bfloat16" in str(hv.dtype)
+        # the black-listed mean keeps the loss in f32
+        assert np.asarray(losses).dtype == np.float64  # floats from f32
+        assert losses[-1] < 0.5 * losses[0]
+
+    def test_fp16_dynamic_loss_scaling_skips_and_recovers(self, static_mode):
+        X, Y = _problem()
+        strat = fleet.DistributedStrategy()
+        strat.amp = True
+        strat.amp_configs = {
+            "use_bf16": False,                # fp16: scaling is load-bearing
+            "init_loss_scaling": 1e9,         # overflows fp16 cotangents
+            "decr_every_n_nan_or_inf": 1,
+            "incr_every_n_steps": 1000,
+        }
+        with static.program_guard(static.Program()):
+            x, y, h, loss = _mlp_program()
+            opt = fleet.distributed_optimizer(
+                paddle.optimizer.SGD(learning_rate=0.05), strategy=strat)
+            _, pairs = opt.minimize(loss)
+            w = pairs[0][0]
+            w_before = np.asarray(w._data).copy()
+            exe = static.Executor()
+            assert opt.loss_scaling == pytest.approx(1e9)
+            exe.run(feed={"x": X, "y": Y}, fetch_list=[loss])
+            # overflow step: scale halves, parameters untouched
+            assert opt.loss_scaling == pytest.approx(5e8)
+            np.testing.assert_array_equal(np.asarray(w._data), w_before)
+            losses = []
+            for _ in range(30):               # scale decays until finite
+                (lv,) = exe.run(feed={"x": X, "y": Y}, fetch_list=[loss])
+                losses.append(float(lv))
+            assert opt.loss_scaling < 1e5     # shrank out of overflow
+            assert not np.array_equal(np.asarray(w._data), w_before)
+            assert losses[-1] < 0.5 * losses[0]   # trains after recovery
+
+    def test_fp16_scale_grows_after_good_steps(self, static_mode):
+        X, Y = _problem()
+        strat = fleet.DistributedStrategy()
+        strat.amp = True
+        strat.amp_configs = {
+            "use_bf16": False,
+            "init_loss_scaling": 1024.0,
+            "incr_every_n_steps": 3,
+            "incr_ratio": 2.0,
+        }
+        with static.program_guard(static.Program()):
+            x, y, h, loss = _mlp_program()
+            opt = fleet.distributed_optimizer(
+                paddle.optimizer.SGD(learning_rate=0.01), strategy=strat)
+            opt.minimize(loss)
+            exe = static.Executor()
+            for _ in range(3):
+                exe.run(feed={"x": X, "y": Y}, fetch_list=[loss])
+            assert opt.loss_scaling == pytest.approx(2048.0)
+
+
+class TestStaticRecompute:
+    def test_checkpointed_losses_match_plain(self, static_mode):
+        X, Y = _problem()
+
+        def run(with_recompute):
+            with static.program_guard(static.Program()):
+                paddle.seed(7)
+                x = static.data("x", [None, 8], "float32")
+                y = static.data("y", [None, 1], "float32")
+                h1 = paddle.nn.functional.relu(static.nn.fc(x, 16))
+                h2 = paddle.nn.functional.relu(static.nn.fc(h1, 16))
+                pred = static.nn.fc(h2, 1)
+                loss = paddle.mean((pred - y) ** 2)
+                strat = fleet.DistributedStrategy()
+                if with_recompute:
+                    strat.recompute = True
+                    strat.recompute_configs = {"checkpoints": [h1, h2]}
+                opt = fleet.distributed_optimizer(
+                    paddle.optimizer.Adam(learning_rate=0.02),
+                    strategy=strat)
+                opt.minimize(loss)
+                if with_recompute:
+                    ck = static.default_main_program()._recompute_checkpoints
+                    assert len(ck) == 2
+                exe = static.Executor()
+                out = []
+                for _ in range(8):
+                    (lv,) = exe.run(feed={"x": X, "y": Y},
+                                    fetch_list=[loss])
+                    out.append(float(lv))
+                return out
+
+        plain = run(False)
+        ckpt = run(True)
+        assert ckpt[-1] < 0.5 * ckpt[0]
+        np.testing.assert_allclose(ckpt, plain, rtol=2e-5, atol=1e-6)
+
+    def test_unreachable_checkpoint_raises(self, static_mode):
+        X, Y = _problem()
+        with static.program_guard(static.Program()):
+            x, y, h, loss = _mlp_program()
+            stray = static.data("stray", [4, 4], "float32")
+            other = stray * 2.0               # not an ancestor of loss
+            strat = fleet.DistributedStrategy()
+            strat.recompute = True
+            strat.recompute_configs = {"checkpoints": [other]}
+            opt = fleet.distributed_optimizer(
+                paddle.optimizer.SGD(learning_rate=0.1), strategy=strat)
+            opt.minimize(loss)
+            exe = static.Executor()
+            with pytest.raises(static.StaticGraphError,
+                               match="not reachable"):
+                exe.run(feed={"x": X, "y": Y}, fetch_list=[loss])
+
+
+class TestGradientMerge:
+    def test_k2_avg_equals_full_batch_step(self, static_mode):
+        X, Y = _problem(n=64)
+        A, B = (X[:32], Y[:32]), (X[32:], Y[32:])
+        with static.program_guard(static.Program()):
+            paddle.seed(3)
+            x = static.data("x", [None, 8], "float32")
+            y = static.data("y", [None, 1], "float32")
+            pred = static.nn.fc(x, 1)
+            loss = paddle.mean((pred - y) ** 2)
+            strat = fleet.DistributedStrategy()
+            strat.gradient_merge = True
+            strat.gradient_merge_configs = {"k_steps": 2, "avg": True}
+            opt = fleet.distributed_optimizer(
+                paddle.optimizer.SGD(learning_rate=0.1), strategy=strat)
+            _, pairs = opt.minimize(loss)
+            w, b = pairs[0][0], pairs[1][0]
+            w0, b0 = np.asarray(w._data).copy(), np.asarray(b._data).copy()
+            exe = static.Executor()
+            exe.run(feed={"x": A[0], "y": A[1]}, fetch_list=[loss])
+            # first micro-step: accumulated only, no update
+            np.testing.assert_array_equal(np.asarray(w._data), w0)
+            exe.run(feed={"x": B[0], "y": B[1]}, fetch_list=[loss])
+            w2, b2 = np.asarray(w._data), np.asarray(b._data)
+        assert not np.array_equal(w2, w0)
+        # avg of the two half-batch grads == full-batch grad (mean loss),
+        # so one merged update == one full-batch SGD step
+        paddle.disable_static()
+        r = X @ w0 + b0 - Y
+        gw = 2 * X.T @ r / len(X)
+        gb = 2 * r.mean(0)
+        np.testing.assert_allclose(w2, w0 - 0.1 * gw, rtol=2e-5, atol=1e-6)
+        np.testing.assert_allclose(b2, b0 - 0.1 * gb, rtol=2e-5, atol=1e-6)
+
+    def test_merge_with_fp16_divides_by_landed_steps(self, static_mode):
+        """A non-finite micro-step must not bias the merged average: the
+        divisor is the number of micro-steps that actually accumulated."""
+        X, Y = _problem(n=64)
+        A, B = (X[:32], Y[:32]), (X[32:], Y[32:])
+        strat = fleet.DistributedStrategy()
+        strat.amp = True
+        strat.amp_configs = {
+            "use_bf16": False,
+            "init_loss_scaling": 1e9,     # micro-step 1 overflows fp16
+            "decr_every_n_nan_or_inf": 1,
+            "decr_ratio": 1e-6,           # ...and drops to 1e3: step 2 lands
+        }
+        strat.gradient_merge = True
+        strat.gradient_merge_configs = {"k_steps": 2, "avg": True}
+        with static.program_guard(static.Program()):
+            paddle.seed(5)
+            x = static.data("x", [None, 8], "float32")
+            y = static.data("y", [None, 1], "float32")
+            pred = static.nn.fc(x, 1)
+            loss = paddle.mean((pred - y) ** 2)
+            opt = fleet.distributed_optimizer(
+                paddle.optimizer.SGD(learning_rate=0.1), strategy=strat)
+            _, pairs = opt.minimize(loss)
+            w, b = pairs[0][0], pairs[1][0]
+            w0, b0 = np.asarray(w._data).copy(), np.asarray(b._data).copy()
+            exe = static.Executor()
+            exe.run(feed={"x": A[0], "y": A[1]}, fetch_list=[loss])
+            exe.run(feed={"x": B[0], "y": B[1]}, fetch_list=[loss])
+            w2 = np.asarray(w._data)
+        paddle.disable_static()
+        # only micro-batch B landed: the update must be ONE SGD step on
+        # B's grad alone (divided by 1, not by k=2). fp16 matmuls in the
+        # forward loosen the tolerance.
+        r = B[0] @ w0 + b0 - B[1]
+        gw = 2 * B[0].T @ r / len(B[0])
+        np.testing.assert_allclose(w2, w0 - 0.1 * gw, rtol=5e-3, atol=5e-4)
+
+    def test_merge_composes_with_amp_bf16(self, static_mode):
+        X, Y = _problem()
+        strat = fleet.DistributedStrategy()
+        strat.amp = True
+        strat.gradient_merge = True
+        strat.gradient_merge_configs = {"k_steps": 2, "avg": True}
+        with static.program_guard(static.Program()):
+            x, y, h, loss = _mlp_program()
+            opt = fleet.distributed_optimizer(
+                paddle.optimizer.SGD(learning_rate=0.05), strategy=strat)
+            opt.minimize(loss)
+            exe = static.Executor()
+            losses = []
+            for _ in range(20):
+                (lv,) = exe.run(feed={"x": X, "y": Y}, fetch_list=[loss])
+                losses.append(float(lv))
+        assert losses[-1] < 0.5 * losses[0]
+
+
+class TestLambSwap:
+    def test_strategy_lamb_swaps_and_matches_eager(self, static_mode):
+        from paddle_tpu.optimizer.optimizers import Lamb
+
+        X, Y = _problem()
+        strat = fleet.DistributedStrategy()
+        strat.lamb = True
+        strat.lamb_configs = {"lamb_weight_decay": 0.02}
+        with static.program_guard(static.Program()):
+            paddle.seed(11)
+            x = static.data("x", [None, 8], "float32")
+            y = static.data("y", [None, 1], "float32")
+            pred = static.nn.fc(x, 1)
+            loss = paddle.mean((pred - y) ** 2)
+            opt = fleet.distributed_optimizer(
+                paddle.optimizer.Adam(learning_rate=0.05), strategy=strat)
+            _, pairs = opt.minimize(loss)
+            assert isinstance(opt.inner_opt, Lamb)
+            w0 = pairs[0][0]._data
+            b0 = pairs[1][0]._data
+            exe = static.Executor()
+            losses = []
+            for _ in range(10):
+                (lv,) = exe.run(feed={"x": X, "y": Y}, fetch_list=[loss])
+                losses.append(float(lv))
+        paddle.disable_static()
+        model = nn.Linear(8, 1)
+        model.weight._data = w0
+        model.bias._data = b0
+        ref_opt = Lamb(learning_rate=0.05, lamb_weight_decay=0.02,
+                       parameters=model.parameters())
+        ref = []
+        for _ in range(10):
+            lv = nn.functional.mse_loss(model(paddle.to_tensor(X)),
+                                        paddle.to_tensor(Y))
+            ref.append(float(lv))
+            lv.backward()
+            ref_opt.step()
+            ref_opt.clear_grad()
+        np.testing.assert_allclose(losses, ref, rtol=2e-5, atol=1e-6)
