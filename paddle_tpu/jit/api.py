@@ -64,6 +64,13 @@ class StaticFunction:
 
     def __init__(self, function, layer=None, input_spec=None, full_graph=True):
         self._fn = function
+        if full_graph:
+            # dy2static-lite (ref dy2static AST transform, SURVEY.md §2.2
+            # P8): if/while over traced tensors stage via lax cond/while;
+            # falls back to the original fn when nothing converts
+            from .dy2static import convert_to_static
+
+            self._fn = convert_to_static(function)
         self._layer = layer
         self._input_spec = input_spec
         self._cache = {}
@@ -113,18 +120,61 @@ class StaticFunction:
             self._cache[key] = self._build(tree_args, tree_kwargs)
         jitted, out_tree_box, state_names = self._cache[key]
 
-        flat = _flatten_args(args, kwargs)
+        flat, flat_tensors = _flatten_pairs(args, kwargs)
         rng_key = random_state.next_key()
         if self._layer is not None:
             sd = self._layer.state_dict()
-            state_arrays = [sd[k]._data for k in state_names]
+            state_tensors = [sd[k] for k in state_names]
         else:
-            state_arrays = []
+            state_tensors = []
+        state_arrays = [t._data for t in state_tensors]
+
+        # ---- grad-aware path (paddle parity: a to_static model trains
+        # with eager loss.backward()): the WHOLE jitted forward records as
+        # ONE tape node — jax.vjp through the jit call gives the pullback,
+        # so grads flow to the layer's parameters and to differentiable
+        # inputs exactly as in the unjitted forward.
+        from ..core import tape as _tape
+        from ..core.op_call import _is_float, apply as _apply
+
+        diff_state_idx = [i for i, t in enumerate(state_tensors)
+                         if not t.stop_gradient
+                         and _is_float(t._data.dtype)]
+        diff_arg_idx = [i for i, t in enumerate(flat_tensors)
+                        if t is not None and not t.stop_gradient
+                        and _is_float(t._data.dtype)]
+        if _tape.tape_enabled() and (diff_state_idx or diff_arg_idx):
+            n_s = len(diff_state_idx)
+
+            def call_fn(*arrays):
+                st = list(state_arrays)
+                fl = list(flat)
+                for j, i in enumerate(diff_state_idx):
+                    st[i] = arrays[j]
+                for j, i in enumerate(diff_arg_idx):
+                    fl[i] = arrays[n_s + j]
+                outs, new_state = jitted(rng_key, st, *fl)
+                return tuple(outs) + tuple(new_state)
+
+            call_fn.__name__ = "to_static_" + getattr(self._fn, "__name__",
+                                                      "fn")
+            diff_tensors = ([state_tensors[i] for i in diff_state_idx]
+                            + [flat_tensors[i] for i in diff_arg_idx])
+            res = _apply(call_fn, *diff_tensors, _op_name=call_fn.__name__)
+            if not isinstance(res, tuple):
+                res = (res,)
+            n_out = len(res) - len(state_names)
+            out_tensors = list(res[:n_out])
+            for t, new in zip(state_tensors, res[n_out:]):
+                if t.stop_gradient:
+                    # buffers (BN stats, ...) update in place; params keep
+                    # their arrays (the forward doesn't change them)
+                    t._data = new._data
+            return _unflatten_tree(out_tree_box["tree"], out_tensors)
+
         outs, new_state = jitted(rng_key, state_arrays, *flat)
-        if self._layer is not None:
-            sd = self._layer.state_dict()
-            for k, arr in zip(state_names, new_state):
-                sd[k]._data = arr
+        for t, arr in zip(state_tensors, new_state):
+            t._data = arr
         out_tensors = [Tensor(o) for o in outs]
         return _unflatten_tree(out_tree_box["tree"], out_tensors)
 
@@ -152,14 +202,19 @@ def _make_tree(args, kwargs):
     return [conv(a) for a in args], {k: conv(v) for k, v in kwargs.items()}
 
 
-def _flatten_args(args, kwargs):
-    flat = []
+def _flatten_pairs(args, kwargs):
+    """ONE walk producing aligned (arrays, tensor-objects-or-None) lists —
+    the grad-aware call path maps indices between them, so they must never
+    diverge by leaf kind."""
+    arrays, tensors = [], []
 
     def walk(a):
         if isinstance(a, Tensor):
-            flat.append(a._data)
+            arrays.append(a._data)
+            tensors.append(a)
         elif isinstance(a, np.ndarray):
-            flat.append(jnp.asarray(a))
+            arrays.append(jnp.asarray(a))
+            tensors.append(None)
         elif isinstance(a, (list, tuple)):
             for x in a:
                 walk(x)
@@ -168,7 +223,17 @@ def _flatten_args(args, kwargs):
         walk(a)
     for k in sorted(kwargs):
         walk(kwargs[k])
-    return flat
+    return arrays, tensors
+
+
+def _flatten_args(args, kwargs):
+    return _flatten_pairs(args, kwargs)[0]
+
+
+def _flatten_arg_tensors(args, kwargs):
+    """Tensor OBJECTS aligned with _flatten_args (None for non-Tensor
+    leaves) — the grad-aware call path needs them as vjp targets."""
+    return _flatten_pairs(args, kwargs)[1]
 
 
 def _unflatten_args(tree_args, tree_kwargs, flat):
@@ -228,10 +293,13 @@ def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
 
     def decorate(obj):
         if isinstance(obj, Layer):
-            static = StaticFunction(obj.forward, layer=obj, input_spec=input_spec)
+            static = StaticFunction(obj.forward, layer=obj,
+                                    input_spec=input_spec,
+                                    full_graph=full_graph)
             obj.forward = static
             return obj
-        return StaticFunction(obj, layer=None, input_spec=input_spec)
+        return StaticFunction(obj, layer=None, input_spec=input_spec,
+                              full_graph=full_graph)
 
     if function is not None:
         return decorate(function)

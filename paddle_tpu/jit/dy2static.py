@@ -1,0 +1,597 @@
+"""dy2static-lite: AST conversion of Python `if`/`while` over traced
+tensors (ref: python/paddle/jit/dy2static/ (U), SURVEY.md §2.2 P8 — the
+reference rewrites dygraph Python control flow into ConditionalBlock /
+While ops so `to_static` can compile data-dependent branches).
+
+TPU-native stance: `to_static` is jax tracing, so control flow over
+CONCRETE Python values needs no conversion at all (the trace simply
+unrolls/specializes, and re-traces per input signature). What tracing
+cannot do is a branch or loop whose predicate is a traced tensor — that is
+exactly what `static.nn.cond` / `static.nn.while_loop` (lax select +
+lax.while_loop) stage. This module closes the gap the reference closes
+with its AST transformer, scoped the same way:
+
+- every `if`/`while` statement is rewritten into a call to a runtime
+  dispatch helper (`convert_ifelse` / `convert_while`);
+- at RUN time the helper inspects the predicate: a plain Python/concrete
+  value keeps exact Python semantics (one branch runs, loops run
+  eagerly/unroll under trace); a traced or symbolic tensor stages;
+- variables assigned in a branch/loop body become explicit carries —
+  rebound from a tuple on entry, returned on exit — so the rewrite never
+  needs `nonlocal` and AugAssign keeps working;
+- names possibly unbound before the statement are carried as an `UNDEF`
+  sentinel: a temp defined inside the branch/loop body works, a genuine
+  read-before-assignment raises a NameError naming the variable.
+
+Deliberately NOT converted (the statement stays plain Python, which keeps
+working for concrete predicates and raises jax's concretization error for
+traced ones): `if`/`while` containing `return`, or `break`/`continue`
+targeting an enclosing loop, or `del`/`global`/`nonlocal`; `while/else`;
+functions whose source is unavailable. Conversion applies to the
+decorated function only (not transitively through calls) — decorate
+helpers with `paddle.jit.to_static` too, or call `static.nn.cond`
+directly.
+"""
+
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+import types
+
+__all__ = ["convert_to_static", "convert_ifelse", "convert_while",
+           "UndefinedVar", "UNDEF"]
+
+
+class UndefinedVar:
+    """Sentinel carried for names not yet bound when a converted statement
+    runs. Any actual USE raises — matching the NameError the untransformed
+    code would have raised, just later and with context."""
+
+    __slots__ = ("name",)
+
+    def __init__(self, name="<var>"):
+        self.name = name
+
+    def _boom(self):
+        raise NameError(
+            f"variable {self.name!r} is read on a path through converted "
+            "control flow where it was never assigned (dy2static carries "
+            "it as undefined); assign it before the if/while")
+
+    def __getattr__(self, item):
+        self._boom()
+
+    def __call__(self, *a, **k):
+        self._boom()
+
+    def __bool__(self):
+        self._boom()
+
+    def __iter__(self):
+        self._boom()
+
+    def __repr__(self):
+        return f"UndefinedVar({self.name!r})"
+
+
+# operator dunders are looked up on the TYPE (bypassing __getattr__), so a
+# sentinel used in arithmetic/indexing/comparison must trip explicitly
+def _undef_op(name):
+    def op(self, *a, **k):
+        self._boom()
+    op.__name__ = name
+    return op
+
+
+for _dunder in ("__add__", "__radd__", "__sub__", "__rsub__", "__mul__",
+                "__rmul__", "__truediv__", "__rtruediv__", "__floordiv__",
+                "__rfloordiv__", "__mod__", "__rmod__", "__pow__",
+                "__rpow__", "__matmul__", "__rmatmul__", "__neg__",
+                "__pos__", "__abs__", "__lt__", "__le__", "__gt__",
+                "__ge__", "__getitem__", "__setitem__", "__len__",
+                "__float__", "__int__", "__index__", "__contains__"):
+    setattr(UndefinedVar, _dunder, _undef_op(_dunder))
+
+
+UNDEF = UndefinedVar()
+
+
+def _is_traced(x):
+    """True when `x` cannot be bool()-ed: a jax tracer, or a Tensor whose
+    value is a tracer / a static-graph symbol."""
+    import jax
+
+    from ..core.tensor import Tensor
+
+    if isinstance(x, Tensor):
+        x = x._data
+    if isinstance(x, jax.core.Tracer):
+        return True
+    return type(x).__name__ in ("_SymArr", "_GradSym")
+
+
+def _to_carry(x, name):
+    """A loop carry entering the staged path must be an array value."""
+    from ..core.tensor import Tensor
+    from ..tensor.creation import to_tensor
+
+    if isinstance(x, Tensor):
+        return x
+    if isinstance(x, (bool, int, float, complex)) or hasattr(x, "dtype"):
+        return to_tensor(x)
+    raise TypeError(
+        f"variable {name!r} of type {type(x).__name__} cannot be carried "
+        "through staged control flow (only tensors and numbers can); hoist "
+        "it out of the if/while or keep the predicate concrete")
+
+
+def convert_ifelse(pred, true_fn, false_fn, vals, names):
+    """Runtime dispatch for a converted `if`: concrete predicate keeps
+    exact Python semantics (one branch runs); traced predicate builds both
+    branches and stages a select per assigned variable."""
+    from ..core.tensor import Tensor
+
+    if isinstance(pred, UndefinedVar):
+        pred._boom()
+    if not _is_traced(pred):
+        if isinstance(pred, Tensor):
+            pred = bool(pred)
+        return true_fn(vals) if pred else false_fn(vals)
+
+    from ..static.nn import cond as static_cond
+
+    if not names:
+        # a branch that binds no names can only act through side effects
+        # (list.append, dict/attr mutation) — under a traced predicate
+        # BOTH branches would execute unconditionally, so wrong results
+        # would silently replace the loud pre-conversion error
+        raise TypeError(
+            "a converted `if` over a traced tensor predicate assigns no "
+            "variables — its body works only by side effects, which "
+            "cannot be staged (both branches trace). Assign the result "
+            "to a variable, or call paddle.static.nn.cond directly.")
+    # tracing: both branches run (the reference records both
+    # ConditionalBlocks too); outputs merge by a staged select
+    t_out = true_fn(vals)
+    f_out = false_fn(vals)
+    sel_idx, t_sel, f_sel = [], [], []
+    merged = [None] * len(names)
+    for i, (tv, fv, name) in enumerate(zip(t_out, f_out, names)):
+        t_undef = isinstance(tv, UndefinedVar)
+        f_undef = isinstance(fv, UndefinedVar)
+        if t_undef and f_undef:
+            merged[i] = UndefinedVar(name)      # stays undefined, loudly
+        elif t_undef or f_undef:
+            # defined on one path only: usable downstream on neither
+            # (staged code runs once) — bind the loud sentinel
+            merged[i] = UndefinedVar(name)
+        elif tv is fv:
+            merged[i] = tv                      # untouched by both
+        else:
+            sel_idx.append(i)
+            t_sel.append(tv)
+            f_sel.append(fv)
+    if sel_idx:
+        # the branch lambdas return tuples, so cond rebuilds a tuple of
+        # the same arity (including arity 1)
+        picked = static_cond(pred, lambda: tuple(t_sel),
+                             lambda: tuple(f_sel))
+        for i, v in zip(sel_idx, picked):
+            merged[i] = v
+    return tuple(merged)
+
+
+def convert_while(cond_fn, body_fn, vals, names):
+    """Runtime dispatch for a converted `while`: a concrete first
+    predicate runs the plain Python loop (which unrolls under trace — jax
+    semantics for concrete trip counts); a traced predicate stages ONE
+    lax.while_loop over the defined carries. Names unbound before the
+    loop are NOT carried across iterations: a temp assigned-then-used
+    within one body iteration works, a genuine cross-iteration read
+    raises a NameError naming the variable."""
+    first = cond_fn(vals)
+    if isinstance(first, UndefinedVar):
+        first._boom()
+    if not _is_traced(first):
+        from ..core.tensor import Tensor
+
+        def as_bool(p):
+            return bool(p) if isinstance(p, Tensor) else p
+
+        p = as_bool(first)
+        while p:
+            vals = body_fn(vals)
+            nxt = cond_fn(vals)
+            if _is_traced(nxt):
+                raise TypeError(
+                    "while predicate became a traced tensor after the "
+                    "first iteration; make it traced from the start (so "
+                    "the loop stages) or keep it concrete throughout")
+            p = as_bool(nxt)
+        return vals
+
+    from ..static.nn import while_loop as static_while
+
+    keep = [i for i, v in enumerate(vals)
+            if not isinstance(v, UndefinedVar)]
+    if not keep:
+        raise TypeError(
+            "a converted `while` over a traced tensor predicate carries "
+            "no defined variables — initialize the loop state before the "
+            "loop (lax.while_loop needs loop-carried values), or call "
+            "paddle.static.nn.while_loop directly.")
+    carried = [_to_carry(vals[i], names[i]) for i in keep]
+
+    def full(vs):
+        out = list(vals)
+        for i, v in zip(keep, vs):
+            out[i] = v
+        for i in range(len(out)):
+            if isinstance(out[i], UndefinedVar):
+                out[i] = UndefinedVar(names[i])
+        return tuple(out)
+
+    def body_w(*vs):
+        res = body_fn(full(vs))
+        out = []
+        for i in keep:
+            v = res[i]
+            if isinstance(v, UndefinedVar):
+                v._boom()
+            out.append(v)
+        return out
+
+    outs = static_while(lambda *vs: cond_fn(full(vs)), body_w, carried)
+    if len(carried) == 1 and not isinstance(outs, (tuple, list)):
+        outs = [outs]
+    final = list(vals)
+    for i, v in zip(keep, outs):
+        final[i] = v
+    for i in range(len(final)):
+        if isinstance(final[i], UndefinedVar):
+            final[i] = UndefinedVar(names[i])
+    return tuple(final)
+
+
+# --------------------------------------------------------------------------
+# AST transformation
+
+
+_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+
+
+def _assigned_names(stmts):
+    """Names bound by the statement list, in first-assignment order.
+    Mutations through subscripts/attributes are not bindings; nested
+    function/class bodies and comprehensions have their own scope."""
+    out, seen = [], set()
+
+    def add(name):
+        if not name.startswith("__jst") and name not in seen:
+            seen.add(name)
+            out.append(name)
+
+    def target_names(t):
+        if isinstance(t, ast.Name):
+            add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                target_names(e)
+        elif isinstance(t, ast.Starred):
+            target_names(t.value)
+
+    def walk(body):
+        for node in body:
+            if isinstance(node, _SCOPES):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    add(node.name)
+                continue
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    target_names(t)
+            elif isinstance(node, ast.AugAssign):
+                target_names(node.target)
+            elif isinstance(node, ast.AnnAssign):
+                if node.value is not None:
+                    target_names(node.target)
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                target_names(node.target)
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    if item.optional_vars is not None:
+                        target_names(item.optional_vars)
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    add((a.asname or a.name).split(".")[0])
+            elif isinstance(node, ast.ImportFrom):
+                for a in node.names:
+                    add(a.asname or a.name)
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.NamedExpr) \
+                        and isinstance(sub.target, ast.Name):
+                    add(sub.target.id)
+            for attr in ("body", "orelse", "finalbody"):
+                child = getattr(node, attr, None)
+                if child:
+                    walk(child)
+            for h in getattr(node, "handlers", ()) or ():
+                walk(h.body)
+
+    walk(list(stmts))
+    return out
+
+
+def _contains(stmts, kinds, skip_loops=False):
+    """Any node of `kinds` in the statement list, not counting nested
+    function/class scopes; with skip_loops, nested for/while bodies are
+    skipped too (their break/continue belong to them)."""
+    for node in stmts:
+        if isinstance(node, _SCOPES):
+            continue
+        if isinstance(node, kinds):
+            return True
+        if skip_loops and isinstance(node, (ast.For, ast.AsyncFor,
+                                            ast.While)):
+            children = list(node.orelse)      # loop else runs after the loop
+        else:
+            children = []
+            for a in ("body", "orelse", "finalbody"):
+                children += getattr(node, a, None) or []
+            for h in getattr(node, "handlers", ()) or ():
+                children += h.body
+        if children and _contains(children, kinds, skip_loops):
+            return True
+    return False
+
+
+def _convertible(node):
+    for body in (node.body, getattr(node, "orelse", [])):
+        if not body:
+            continue
+        if _contains(body, (ast.Return, ast.Delete, ast.Global,
+                            ast.Nonlocal)):
+            return False
+        if _contains(body, (ast.Break, ast.Continue), skip_loops=True):
+            return False
+    return True
+
+
+_HELPER = "__jst"
+_VALS = "__jst_vals"
+
+
+def _load(name):
+    return ast.Name(id=name, ctx=ast.Load())
+
+
+def _store(name):
+    return ast.Name(id=name, ctx=ast.Store())
+
+
+def _names_tuple(names, ctx):
+    return ast.Tuple(elts=[ast.Name(id=n, ctx=ctx()) for n in names],
+                     ctx=ctx())
+
+
+def _one_arg():
+    return ast.arguments(posonlyargs=[], args=[ast.arg(arg=_VALS)],
+                         vararg=None, kwonlyargs=[], kw_defaults=[],
+                         kwarg=None, defaults=[])
+
+
+def _fn_def(name, body_stmts, carry_names, tail):
+    """def <name>(__jst_vals): (a,b)=__jst_vals; <body>; <tail>"""
+    body = []
+    if carry_names:
+        body.append(ast.Assign(targets=[_names_tuple(carry_names,
+                                                     ast.Store)],
+                               value=_load(_VALS)))
+    body += body_stmts or [ast.Pass()]
+    body.append(tail)
+    return ast.FunctionDef(name=name, args=_one_arg(), body=body,
+                           decorator_list=[], returns=None, type_params=[])
+
+
+def _carries_return(names):
+    return ast.Return(value=ast.Tuple(elts=[_load(n) for n in names],
+                                      ctx=ast.Load()))
+
+
+def _guarded_reads(names, prefix):
+    """try: __jst_vN_i = a / except NameError: ... = __jst.UNDEF — reads
+    the current value of each carry without tripping on unbound locals."""
+    stmts = []
+    undef = ast.Attribute(value=_load(_HELPER), attr="UNDEF",
+                          ctx=ast.Load())
+    for i, n in enumerate(names):
+        stmts.append(ast.Try(
+            body=[ast.Assign(targets=[_store(f"{prefix}{i}")],
+                             value=_load(n))],
+            handlers=[ast.ExceptHandler(
+                type=ast.Tuple(elts=[_load("NameError"),
+                                     _load("UnboundLocalError")],
+                               ctx=ast.Load()),
+                name=None,
+                body=[ast.Assign(targets=[_store(f"{prefix}{i}")],
+                                 value=undef)])],
+            orelse=[], finalbody=[]))
+    return stmts
+
+
+class _Dy2StaticTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self.counter = 0
+        self.converted_any = False
+
+    # nested scopes keep their own control flow untouched by THIS pass
+    def visit_FunctionDef(self, node):
+        return node
+
+    def visit_AsyncFunctionDef(self, node):
+        return node
+
+    def visit_ClassDef(self, node):
+        return node
+
+    def visit_Lambda(self, node):
+        return node
+
+    def _emit(self, names, defs, helper, k):
+        prefix = f"__jst_v{k}_"
+        stmts = list(defs)
+        stmts += _guarded_reads(names, prefix)
+        call = ast.Call(
+            func=ast.Attribute(value=_load(_HELPER), attr=helper,
+                               ctx=ast.Load()),
+            args=[ast.Tuple(elts=[_load(f"{prefix}{i}")
+                                  for i in range(len(names))],
+                            ctx=ast.Load()),
+                  ast.Tuple(elts=[ast.Constant(value=n) for n in names],
+                            ctx=ast.Load())],
+            keywords=[])
+        return stmts, call
+
+    def visit_If(self, node):
+        node = self.generic_visit(node)
+        if not _convertible(node):
+            return node
+        k = self.counter = self.counter + 1
+        names = _assigned_names(node.body + node.orelse)
+        tname, fname = f"__jst_t{k}", f"__jst_f{k}"
+        defs = [
+            _fn_def(tname, node.body, names, _carries_return(names)),
+            _fn_def(fname, node.orelse, names, _carries_return(names)),
+        ]
+        stmts, call = self._emit(names, defs, "convert_ifelse", k)
+        call.args = [node.test, _load(tname), _load(fname)] + call.args
+        if names:
+            stmts.append(ast.Assign(
+                targets=[_names_tuple(names, ast.Store)], value=call))
+        else:
+            stmts.append(ast.Expr(value=call))
+        self.converted_any = True
+        return [ast.copy_location(s, node) for s in stmts]
+
+    def visit_While(self, node):
+        node = self.generic_visit(node)
+        if node.orelse or not _convertible(node):
+            return node  # while/else stays Python
+        k = self.counter = self.counter + 1
+        names = _assigned_names(node.body)
+        cname, bname = f"__jst_c{k}", f"__jst_b{k}"
+        cond_def = ast.FunctionDef(
+            name=cname, args=_one_arg(),
+            body=([ast.Assign(targets=[_names_tuple(names, ast.Store)],
+                              value=_load(_VALS))] if names else [])
+            + [ast.Return(value=node.test)],
+            decorator_list=[], returns=None, type_params=[])
+        defs = [cond_def,
+                _fn_def(bname, node.body, names, _carries_return(names))]
+        stmts, call = self._emit(names, defs, "convert_while", k)
+        call.args = [_load(cname), _load(bname)] + call.args
+        if names:
+            stmts.append(ast.Assign(
+                targets=[_names_tuple(names, ast.Store)], value=call))
+        else:
+            stmts.append(ast.Expr(value=call))
+        self.converted_any = True
+        return [ast.copy_location(s, node) for s in stmts]
+
+
+_CONVERT_CACHE = {}
+
+
+def convert_to_static(fn):
+    """Return `fn` with its `if`/`while` statements rewritten to runtime
+    control-flow dispatch, or `fn` unchanged when there is nothing to
+    convert or the source is unavailable. Never raises: to_static must
+    keep working on functions this lite converter can't parse. Bound
+    methods convert through their underlying function and rebind."""
+    if isinstance(fn, types.MethodType):
+        conv = convert_to_static(fn.__func__)
+        if conv is fn.__func__:
+            return fn
+        return types.MethodType(conv, fn.__self__)
+    if getattr(fn, "_not_to_static", False):
+        return fn
+    code = getattr(fn, "__code__", None)
+    # closure-bearing functions are NEVER cached: the conversion snapshots
+    # cell contents into its namespace, and sibling closures share one
+    # code object — a cache hit would serve the first sibling's values
+    cacheable = code is not None and not fn.__closure__
+    if cacheable and id(code) in _CONVERT_CACHE:
+        ent = _CONVERT_CACHE[id(code)]
+        if ent[0] is code:              # id-recycling guard
+            return ent[1] or fn
+    converted = _convert_uncached(fn)
+    if cacheable:
+        _CONVERT_CACHE[id(code)] = (code, converted)
+    return converted or fn
+
+
+def _convert_uncached(fn):
+    if not inspect.isfunction(fn):
+        return None
+    if "__class__" in fn.__code__.co_freevars:
+        # zero-arg super() needs the compiler-provided __class__ cell,
+        # which a module-level recompile cannot reproduce — leave such
+        # methods unconverted (concrete predicates keep working; traced
+        # ones get the standard concretization error)
+        return None
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return None
+    fdef = tree.body[0]
+    if not isinstance(fdef, ast.FunctionDef):
+        return None
+    if not any(isinstance(n, (ast.If, ast.While)) for n in ast.walk(fdef)):
+        return None
+    fdef.decorator_list = []       # re-applying the decorator would recurse
+    tf = _Dy2StaticTransformer()
+    # transform only the TOP function's statements; visit() on the module
+    # would treat the def itself as a nested scope
+    fdef.body = [s for stmt in fdef.body
+                 for s in _as_list(tf.visit(stmt))]
+    if not tf.converted_any:
+        return None
+    ast.fix_missing_locations(tree)
+    try:
+        code = compile(tree, f"<dy2static {fn.__name__}>", "exec")
+    except (SyntaxError, ValueError):
+        return None
+    import sys
+
+    namespace = dict(fn.__globals__)
+    namespace[_HELPER] = sys.modules[__name__]
+    if fn.__closure__:
+        # closure cells are snapshotted into the namespace (late rebinding
+        # of enclosing locals is lost — documented lite-scope trade-off)
+        for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+            try:
+                namespace[name] = cell.cell_contents
+            except ValueError:          # empty cell (e.g. recursive def)
+                pass
+    try:
+        exec(code, namespace)
+    except Exception:
+        return None
+    new_fn = namespace.get(fn.__name__)
+    if not inspect.isfunction(new_fn):
+        return None
+    new_fn.__defaults__ = fn.__defaults__
+    new_fn.__kwdefaults__ = fn.__kwdefaults__
+    functools.update_wrapper(new_fn, fn)
+    new_fn.__dy2static_converted__ = True
+    return new_fn
+
+
+def _as_list(x):
+    if x is None:
+        return []
+    return x if isinstance(x, list) else [x]

@@ -1,0 +1,320 @@
+"""dy2static-lite (SURVEY.md §2.2 P8): AST conversion of Python if/while
+over traced tensors into staged lax control flow under paddle.jit.to_static
+— concrete predicates keep exact Python semantics, traced predicates stage
+through static.nn.cond / while_loop."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.jit.dy2static import convert_to_static
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a, np.float32))
+
+
+class TestEagerSemantics:
+    def test_branches_and_python_if_preserved(self):
+        def f(x, flag=True):
+            if paddle.sum(x) > 0:
+                t = x + 1.0
+                y = t * 2.0
+            else:
+                y = x - 1.0
+            if flag:
+                y = y + 10.0
+            return y
+
+        conv = convert_to_static(f)
+        assert conv.__dy2static_converted__
+        xp = np.array([1.0, 2.0], np.float32)
+        xn = np.array([-3.0, -3.0], np.float32)
+        np.testing.assert_allclose(conv(_t(xp)).numpy(), (xp + 1) * 2 + 10)
+        np.testing.assert_allclose(conv(_t(xn)).numpy(), xn - 1 + 10)
+        np.testing.assert_allclose(conv(_t(xp), flag=False).numpy(),
+                                   (xp + 1) * 2)
+
+    def test_python_while_still_runs(self):
+        def f(n):
+            i, s = 0, 0
+            while i < n:               # pure python: untouched semantics
+                s += i
+                i += 1
+            return s
+
+        conv = convert_to_static(f)
+        assert conv(5) == 10
+
+    def test_eager_runs_exactly_one_branch(self):
+        calls = []
+
+        def probe(tag, v):
+            calls.append(tag)
+            return v
+
+        def f(x):
+            if paddle.sum(x) > 0:
+                y = probe("true", x * 2.0)
+            else:
+                y = probe("false", x * 3.0)
+            return y
+
+        conv = convert_to_static(f)
+        conv(_t([1.0]))
+        assert calls == ["true"]       # dygraph parity: one branch only
+
+    def test_elif_chain(self):
+        def f(x):
+            if paddle.sum(x) > 10.0:
+                y = x * 1.0
+            elif paddle.sum(x) > 0.0:
+                y = x * 2.0
+            else:
+                y = x * 3.0
+            return y
+
+        conv = convert_to_static(f)
+        np.testing.assert_allclose(conv(_t([20.0])).numpy(), [20.0])
+        np.testing.assert_allclose(conv(_t([2.0])).numpy(), [4.0])
+        np.testing.assert_allclose(conv(_t([-2.0])).numpy(), [-6.0])
+
+
+class TestStagedUnderJit:
+    def test_if_stages_one_compiled_fn_serves_both_branches(self):
+        def f(x):
+            if paddle.sum(x) > 0:
+                y = x * 2.0
+            else:
+                y = x - 1.0
+            return y
+
+        sf = paddle.jit.to_static(f)
+        xp = np.array([1.0, 2.0], np.float32)
+        xn = np.array([-1.0, -2.0], np.float32)
+        np.testing.assert_allclose(sf(_t(xp)).numpy(), xp * 2)
+        np.testing.assert_allclose(sf(_t(xn)).numpy(), xn - 1)
+        # same shapes -> ONE cache entry serving both predicate values:
+        # the branch is staged, not trace-specialized
+        assert len(sf._cache) == 1
+
+    def test_data_dependent_while(self):
+        def steps_to_100(x):
+            s = paddle.zeros([])
+            i = paddle.zeros([])
+            while s < 100.0:
+                s = s + x
+                i = i + 1.0
+            return i
+
+        sf = paddle.jit.to_static(steps_to_100)
+        assert float(sf(_t(7.0)).numpy()) == 15.0
+        assert float(sf(_t(50.0)).numpy()) == 2.0
+        assert len(sf._cache) == 1
+
+    def test_nested_if(self):
+        def f(x):
+            if paddle.sum(x) > 0:
+                if paddle.max(x) > 5.0:
+                    y = x * 10.0
+                else:
+                    y = x * 2.0
+            else:
+                y = x - 1.0
+            return y
+
+        sf = paddle.jit.to_static(f)
+        np.testing.assert_allclose(sf(_t([7.0])).numpy(), [70.0])
+        np.testing.assert_allclose(sf(_t([1.0])).numpy(), [2.0])
+        np.testing.assert_allclose(sf(_t([-1.0])).numpy(), [-2.0])
+
+    def test_layer_forward_converts(self):
+        class Gate(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = nn.Linear(4, 4)
+
+            def forward(self, x):
+                h = self.lin(x)
+                if paddle.mean(h) > 0:
+                    out = paddle.nn.functional.relu(h)
+                else:
+                    out = h * 0.1
+                return out
+
+        paddle.seed(0)
+        layer = Gate()
+        x = _t(np.random.RandomState(0).randn(2, 4))
+        eager = layer(x).numpy()
+        paddle.jit.to_static(layer)
+        got = layer(x).numpy()
+        np.testing.assert_allclose(got, eager, rtol=1e-5, atol=1e-6)
+
+    def test_mixed_python_and_tensor_predicates(self):
+        def f(x, mode="double"):
+            if mode == "double":       # python: specializes per trace
+                y = x * 2.0
+            else:
+                y = x * 3.0
+            if paddle.sum(y) > 100.0:  # tensor: stages
+                y = y / 10.0
+            return y
+
+        sf = paddle.jit.to_static(f)
+        np.testing.assert_allclose(sf(_t([1.0])).numpy(), [2.0])
+        np.testing.assert_allclose(sf(_t([100.0])).numpy(), [20.0])
+        np.testing.assert_allclose(sf(_t([1.0]), mode="triple").numpy(),
+                                   [3.0])
+
+
+class TestLiteScopeEdges:
+    def test_return_inside_if_falls_back(self):
+        def f(x):
+            if paddle.sum(x) > 0:
+                return x * 2.0
+            return x - 1.0
+
+        conv = convert_to_static(f)
+        # not converted (return in branch) — eager still exact
+        np.testing.assert_allclose(conv(_t([2.0])).numpy(), [4.0])
+        np.testing.assert_allclose(conv(_t([-2.0])).numpy(), [-3.0])
+        # under jit the standard concretization error names the problem
+        with pytest.raises(Exception, match="[Tt]race|concrete"):
+            paddle.jit.to_static(f)(_t([2.0]))
+
+    def test_one_path_temp_raises_on_downstream_use(self):
+        def f(x):
+            if paddle.sum(x) > 0:
+                t = x * 2.0
+            else:
+                y = x - 1.0
+                t2 = y
+            return t * 1.0     # defined on the true path only
+
+        sf = paddle.jit.to_static(f)
+        with pytest.raises(NameError, match="'t'"):
+            sf(_t([1.0]))
+
+    def test_loop_carried_undefined_raises_with_name(self):
+        def f(x):
+            i = paddle.zeros([])
+            while i < 3.0:
+                acc = acc + x                      # noqa: F821
+                i = i + 1.0
+            return acc
+
+        sf = paddle.jit.to_static(f)
+        with pytest.raises(NameError, match="acc"):
+            sf(_t(1.0))
+
+    def test_body_local_temp_is_fine(self):
+        def f(x):
+            i = paddle.zeros([])
+            s = paddle.zeros([])
+            while i < 4.0:
+                tmp = x * 2.0          # defined-and-used within one pass
+                s = s + tmp
+                i = i + 1.0
+            return s
+
+        sf = paddle.jit.to_static(f)
+        assert float(sf(_t(3.0)).numpy()) == 24.0
+
+    def test_zero_arg_super_method_not_converted(self):
+        """Module-level recompile can't rebuild the __class__ cell, so
+        methods using zero-arg super() stay unconverted (and keep working
+        for concrete predicates)."""
+
+        class Base(nn.Layer):
+            def forward(self, x):
+                return x + 1.0
+
+        class Child(Base):
+            def forward(self, x, double=True):
+                if double:                      # concrete predicate
+                    x = x * 2.0
+                return super().forward(x)
+
+        layer = Child()
+        paddle.jit.to_static(layer)
+        np.testing.assert_allclose(layer(_t([3.0])).numpy(), [7.0])
+
+    def test_side_effect_only_branch_raises_under_trace(self):
+        """A names-less branch acts only by side effects — under a traced
+        predicate that must be a LOUD error, not a silent both-branches
+        execution."""
+        log = []
+
+        def f(x):
+            if paddle.sum(x) > 0:
+                log.append("taken")
+            return x * 1.0
+
+        conv = convert_to_static(f)
+        conv(_t([1.0]))                        # concrete: python semantics
+        assert log == ["taken"]
+        with pytest.raises(Exception, match="side effect|assigns no"):
+            paddle.jit.to_static(f)(_t([-1.0]))
+
+    def test_side_effect_only_if(self):
+        def f(x):
+            out = x * 1.0
+            if paddle.sum(x) > 0:
+                out = out + 1.0
+            return out
+
+        sf = paddle.jit.to_static(f)
+        np.testing.assert_allclose(sf(_t([1.0])).numpy(), [2.0])
+        np.testing.assert_allclose(sf(_t([-1.0])).numpy(), [-1.0])
+
+
+class TestClosureSiblings:
+    def test_sibling_closures_keep_their_own_cells(self):
+        """Closures from one factory share a code object; each must
+        convert with ITS OWN captured values (regression: the conversion
+        cache used to serve the first sibling's snapshot)."""
+
+        def make(scale):
+            def f(x):
+                if paddle.sum(x) > 0:
+                    y = x * scale
+                else:
+                    y = x - scale
+                return y
+            return convert_to_static(f)
+
+        c1, c2 = make(1.0), make(10.0)
+        np.testing.assert_allclose(c1(_t([2.0])).numpy(), [2.0])
+        np.testing.assert_allclose(c2(_t([2.0])).numpy(), [20.0])
+        np.testing.assert_allclose(c2(_t([-2.0])).numpy(), [-12.0])
+
+
+class TestStaticProgramRecording:
+    def test_converted_fn_stages_into_static_program(self):
+        import paddle_tpu.static as static
+
+        def f(x):
+            if paddle.sum(x) > 0:
+                y = x * 2.0
+            else:
+                y = x - 1.0
+            return y
+
+        conv = convert_to_static(f)
+        paddle.enable_static()
+        try:
+            with static.program_guard(static.Program()):
+                x = static.data("x", [None, 2], "float32")
+                y = conv(x)
+                exe = static.Executor()
+                pos = exe.run(feed={"x": np.array([[1.0, 2.0]],
+                                                  np.float32)},
+                              fetch_list=[y])[0]
+                neg = exe.run(feed={"x": np.array([[-1.0, -2.0]],
+                                                  np.float32)},
+                              fetch_list=[y])[0]
+        finally:
+            paddle.disable_static()
+        np.testing.assert_allclose(pos, [[2.0, 4.0]])
+        np.testing.assert_allclose(neg, [[-2.0, -3.0]])

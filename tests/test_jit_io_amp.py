@@ -85,6 +85,68 @@ class TestTrainStep:
             step(paddle.to_tensor(X), paddle.to_tensor(Y))
         np.testing.assert_allclose(m1.weight.numpy(), m2.weight.numpy(), rtol=1e-4, atol=1e-5)
 
+    def test_to_static_model_trains_with_eager_backward(self):
+        """Paddle parity: `model = to_static(model); loss.backward();
+        opt.step()` — the jitted forward records as ONE tape node whose
+        vjp flows grads to the parameters."""
+        X = np.random.RandomState(0).rand(32, 4).astype(np.float32)
+        Y = X.sum(-1, keepdims=True)
+
+        def build():
+            paddle.seed(7)
+            m = nn.Linear(4, 1)
+            o = paddle.optimizer.SGD(learning_rate=0.1,
+                                     parameters=m.parameters())
+            return m, o
+
+        m1, o1 = build()                       # eager reference
+        for _ in range(5):
+            loss = F.mse_loss(m1(paddle.to_tensor(X)), paddle.to_tensor(Y))
+            loss.backward()
+            o1.step()
+            o1.clear_grad()
+        m2, o2 = build()
+        paddle.jit.to_static(m2)               # jitted forward, eager loop
+        for _ in range(5):
+            loss = F.mse_loss(m2(paddle.to_tensor(X)), paddle.to_tensor(Y))
+            loss.backward()
+            o2.step()
+            o2.clear_grad()
+        np.testing.assert_allclose(m1.weight.numpy(), m2.weight.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+        # grads also flow to differentiable INPUTS through the jit node
+        x = paddle.to_tensor(X)
+        x.stop_gradient = False
+        m2(x).sum().backward()
+        assert x.grad is not None and x.grad.shape == [32, 4]
+
+    def test_many_matches_sequential_steps(self):
+        """many(K): one scanned program == K sequential __call__s (same
+        updates, K× fewer dispatches — the tunnel-latency amortizer)."""
+        rng = np.random.RandomState(1)
+        batches = [(paddle.to_tensor(rng.rand(16, 4).astype(np.float32)),
+                    paddle.to_tensor(rng.rand(16, 1).astype(np.float32)))
+                   for _ in range(4)]
+
+        def build():
+            paddle.seed(11)
+            m = nn.Linear(4, 1)
+            o = paddle.optimizer.Adam(learning_rate=0.05,
+                                      parameters=m.parameters())
+            return m, o
+
+        m1, o1 = build()
+        step1 = paddle.jit.TrainStep(m1, lambda net, x, y: F.mse_loss(net(x), y), o1)
+        seq_losses = [float(step1(*b)) for b in batches]
+        m2, o2 = build()
+        step2 = paddle.jit.TrainStep(m2, lambda net, x, y: F.mse_loss(net(x), y), o2)
+        many_losses = step2.many(batches).numpy()
+        np.testing.assert_allclose(many_losses, seq_losses, rtol=1e-5,
+                                   atol=1e-6)
+        np.testing.assert_allclose(m1.weight.numpy(), m2.weight.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+        assert o2._step_count == 4
+
     def test_grad_clip_inside_step(self):
         m = nn.Linear(4, 1)
         o = paddle.optimizer.SGD(learning_rate=1.0, parameters=m.parameters(),
