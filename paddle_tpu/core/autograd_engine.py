@@ -24,15 +24,22 @@ def _zeros_like_meta(shape, dtype):
     return np.zeros(shape, jax.dtypes.float0)
 
 
-def backward(loss: Tensor, grad_tensor=None, retain_graph: bool = False, targets=None):
+def backward(loss: Tensor, grad_tensor=None, retain_graph: bool = False,
+             targets=None, create_graph: bool = False):
     """Reverse walk from `loss`. `targets` (used by paddle.grad) is an optional
     set of tensor ids for which gradients must be materialized even when the
-    tensor is an intermediate rather than a leaf."""
+    tensor is an intermediate rather than a leaf. With create_graph=True the
+    walk RECORDS itself: each node's vjp is re-derived as a taped op of
+    (original inputs, cotangents), so the produced gradients are themselves
+    differentiable (higher-order autograd — ref eager backward's
+    create_graph, SURVEY.md §2.1 N8)."""
     if loss.stop_gradient:
         raise RuntimeError(
             "Tensor.backward() on a tensor with stop_gradient=True — nothing to differentiate."
         )
     targets = targets or {}
+    if create_graph:
+        return _backward_tensors(loss, grad_tensor, targets)
     tape = _tape.global_tape()
     start = loss._tape_node
     if start is None:
@@ -117,6 +124,122 @@ def backward(loss: Tensor, grad_tensor=None, retain_graph: bool = False, targets
         tape.nodes = kept
 
 
+def _make_replay_bw(node):
+    """Lift a node's backward into a re-recordable op: given the node's
+    original diff inputs followed by the output cotangents, re-linearize
+    the forward (node.replay) at those inputs and pull the cotangents
+    back. Routed through op_call.apply, this records a tape node whose own
+    vjp gives second-order gradients."""
+    from .op_call import _match_vma
+
+    replay = node.replay
+    k = len(node.inputs)
+
+    def bw(*vals):
+        prim = vals[:k]
+        cots = list(vals[k:])
+        out_data, vjp = jax.vjp(replay, *prim)
+        flat = (list(out_data) if isinstance(out_data, (tuple, list))
+                else [out_data])
+        cts = [_match_vma(c, jax.typeof(o)) for c, o in zip(cots, flat)]
+        res = vjp(cts[0]) if len(flat) == 1 else vjp(tuple(cts))
+        # apply()'s convention: single outputs are bare, not 1-tuples
+        # (_VjpAdapter keys its cotangent structure on that)
+        return res[0] if len(res) == 1 else tuple(res)
+
+    bw.__name__ = "grad_" + (node.name or "op")
+    return bw
+
+
+def _backward_tensors(loss: Tensor, grad_tensor, targets):
+    """The create_graph walk: cotangents are live Tensors and every vjp
+    application is itself a recorded op, so the resulting .grad tensors
+    carry a tape history (differentiable). Implies retain_graph."""
+    from . import op_call as _op_call
+
+    tape = _tape.global_tape()
+    start = loss._tape_node
+
+    if grad_tensor is None:
+        seed = Tensor(jnp.ones(loss._data.shape, loss._data.dtype),
+                      stop_gradient=True)
+    else:
+        seed = (grad_tensor if isinstance(grad_tensor, Tensor)
+                else Tensor(jnp.asarray(grad_tensor)))
+
+    def accum_target(t, g):
+        t.grad = g if t.grad is None else t.grad + g
+
+    if start is None:
+        if id(loss) in targets:
+            accum_target(targets[id(loss)], seed)
+        return
+    if id(loss) in targets:
+        accum_target(targets[id(loss)], seed)
+
+    cot = {id(loss): seed}
+    leaf_accum = {}
+    nodes = [n for n in tape.nodes if n.idx <= start.idx]
+    for node in reversed(nodes):
+        if not any(oid in cot for oid in node.out_ids):
+            continue
+        if node.replay is None:
+            raise NotImplementedError(
+                f"create_graph=True through op {node.name!r}: this node "
+                "has a custom backward (PyLayer) with no replayable "
+                "forward, so its gradient cannot be differentiated again. "
+                "Express the op with standard tensor ops, or use "
+                "paddle.autograd.hessian/jvp (jax-transform based).")
+        cots = []
+        for oid, (shape, dtype) in zip(node.out_ids, node.out_meta):
+            c = cot.pop(oid, None)
+            if c is None:
+                c = _zeros_like_meta(shape, dtype)
+                if not isinstance(c, np.ndarray):   # float arrays -> Tensor
+                    c = Tensor(c, stop_gradient=True)
+            else:
+                for hook in _GRAD_HOOKS.get(oid, ()):
+                    r = hook(c)
+                    if r is not None:
+                        c = r if isinstance(r, Tensor) \
+                            else Tensor(jnp.asarray(r))
+                if oid in targets and oid != id(loss):
+                    accum_target(targets[oid], c)
+            cots.append(c)
+        bw = _make_replay_bw(node)
+        in_cots = _op_call.apply(bw, *(list(node.inputs) + cots),
+                                 _op_name=bw.__name__)
+        if not isinstance(in_cots, (tuple, list)):
+            in_cots = (in_cots,)
+        for t, g in zip(node.inputs, in_cots):
+            if g is None:
+                continue
+            gd = getattr(g, "_data", g)
+            if hasattr(gd, "dtype") and gd.dtype == jax.dtypes.float0:
+                continue
+            if not isinstance(g, Tensor):
+                g = Tensor(jnp.asarray(g))
+            tid = id(t)
+            if t._tape_node is not None and t._tape_node.idx < node.idx:
+                cot[tid] = cot[tid] + g if tid in cot else g
+            elif not t.stop_gradient:
+                if tid in leaf_accum:
+                    leaf_accum[tid] = (t, leaf_accum[tid][1] + g)
+                else:
+                    leaf_accum[tid] = (t, g)
+
+    for tid, (t, g) in leaf_accum.items():
+        for hook in _GRAD_HOOKS.get(tid, ()):
+            r = hook(g)
+            if r is not None:
+                g = r if isinstance(r, Tensor) else Tensor(jnp.asarray(r))
+        if t.grad is None:
+            t.grad = g
+        else:
+            t.grad = t.grad + g
+    # create_graph implies the graph stays (second backward needs it)
+
+
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=False, allow_unused=False):
     """paddle.grad parity (ref: python/paddle/autograd/ (U)) — functional form."""
     if isinstance(outputs, Tensor):
@@ -133,8 +256,9 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=Fal
     try:
         for i, o in enumerate(outputs):
             g = grad_outputs[i] if grad_outputs is not None else None
-            backward(o, grad_tensor=g, retain_graph=True if retain_graph is None else retain_graph,
-                     targets=targets)
+            backward(o, grad_tensor=g,
+                     retain_graph=True if retain_graph is None else retain_graph,
+                     targets=targets, create_graph=create_graph)
         results = []
         for t in inputs:
             if t.grad is None:

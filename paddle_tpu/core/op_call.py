@@ -44,6 +44,19 @@ def set_static_handler(fn):
     _STATIC_HANDLER = fn
 
 
+def amp_cast_arrays(arrays, jd):
+    """The one AMP cast rule (shared by the eager autocast wrapper and the
+    static meta-optimizer's program rewrite): real floats only — complex
+    inputs must never be truncated to a real half dtype, and integers pass
+    through untouched."""
+    return [
+        a.astype(jd)
+        if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating) and a.dtype != jd
+        else a
+        for a in arrays
+    ]
+
+
 def _maybe_amp_wrap(fn, op_name):
     if _AMP_LOOKUP is None:
         return fn
@@ -52,15 +65,7 @@ def _maybe_amp_wrap(fn, op_name):
         return fn
 
     def wrapped(*arrays, **kw):
-        # real floats only: complex inputs must never be truncated to a real
-        # half dtype, and integers pass through untouched
-        cast = [
-            a.astype(jd)
-            if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating) and a.dtype != jd
-            else a
-            for a in arrays
-        ]
-        return fn(*cast, **kw)
+        return fn(*amp_cast_arrays(arrays, jd), **kw)
 
     return wrapped
 
@@ -115,6 +120,7 @@ def apply(fn, *args, _op_name: str = "", **kwargs):
             out_tensors,
             _VjpAdapter(vjp_fn, [jax.typeof(o) for o in outs]),
             name=_op_name or getattr(fn, "__name__", "op"),
+            replay=primal,
         )
     return _unflatten_out(out_tensors, structure)
 

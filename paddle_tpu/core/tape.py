@@ -24,10 +24,11 @@ class TapeNode:
 
     __slots__ = (
         "inputs", "out_ids", "out_meta", "vjp_fn", "n_outputs", "idx", "name",
-        "alive_outputs",
+        "alive_outputs", "replay",
     )
 
-    def __init__(self, inputs, out_ids, out_meta, vjp_fn, n_outputs, idx, name=""):
+    def __init__(self, inputs, out_ids, out_meta, vjp_fn, n_outputs, idx,
+                 name="", replay=None):
         self.inputs = inputs        # list[Tensor] (held strongly until the node is freed)
         self.out_ids = out_ids      # list[int] ids of output Tensors
         self.out_meta = out_meta    # list[(shape, dtype)] per output, for zero cotangents
@@ -36,6 +37,11 @@ class TapeNode:
         self.idx = idx              # monotonically increasing creation index
         self.name = name
         self.alive_outputs = n_outputs
+        # replay(diff_arrays) -> primal out: re-linearization hook for
+        # higher-order autograd — backward(create_graph=True) re-derives
+        # this node's vjp AS A RECORDED OP of (inputs, cotangents), so the
+        # produced gradients are themselves differentiable
+        self.replay = replay
 
     def _output_died(self):
         self.alive_outputs -= 1
@@ -57,7 +63,7 @@ class Tape:
         self.nodes = []
         self._counter = 0
 
-    def record(self, inputs, outputs, vjp_fn, name=""):
+    def record(self, inputs, outputs, vjp_fn, name="", replay=None):
         node = TapeNode(
             inputs=list(inputs),
             out_ids=[id(o) for o in outputs],
@@ -66,6 +72,7 @@ class Tape:
             n_outputs=len(outputs),
             idx=self._counter,
             name=name,
+            replay=replay,
         )
         self._counter += 1
         self.nodes.append(node)

@@ -2,6 +2,7 @@
 
 from . import unique_name
 from . import cpp_extension
+from . import dlpack
 
 
 def try_import(module_name, err_msg=None):

@@ -1,0 +1,48 @@
+"""paddle.utils.dlpack (ref: python/paddle/utils/dlpack.py (U)):
+zero-copy tensor interchange via the DLPack protocol. TPU-native: jax
+arrays implement `__dlpack__`/`__dlpack_device__`, so export is the
+array's own capsule and import is `jnp.from_dlpack` — CPU-side interop
+with torch/numpy is zero-copy; device arrays transfer through the
+producer's stream semantics."""
+
+from __future__ import annotations
+
+from ..core.tensor import Tensor
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x):
+    """Export a Tensor for DLPack consumers. Returns the underlying array,
+    which carries `__dlpack__`/`__dlpack_device__` — the modern protocol
+    form every consumer (torch/numpy/jax `from_dlpack`) accepts, without
+    the consumed-once hazard of a bare capsule."""
+    if isinstance(x, Tensor):
+        x = x._data
+    return x
+
+
+class _CapsuleShim:
+    """Adapter for LEGACY bare capsules (e.g. torch.utils.dlpack.to_dlpack
+    output): presents the protocol surface jax's from_dlpack requires. A
+    capsule names no device, so this assumes kDLCPU — which is where
+    legacy-capsule producers in this environment (cpu torch) live."""
+
+    def __init__(self, capsule):
+        self._capsule = capsule
+
+    def __dlpack__(self, **kwargs):
+        return self._capsule
+
+    def __dlpack_device__(self):
+        return (1, 0)  # (kDLCPU, device 0)
+
+
+def from_dlpack(dlpack):
+    """Import a DLPack-protocol object (torch tensor, numpy array, jax
+    array, ...) or a legacy CPU capsule as a paddle Tensor."""
+    import jax.numpy as jnp
+
+    if not hasattr(dlpack, "__dlpack__"):
+        dlpack = _CapsuleShim(dlpack)
+    return Tensor(jnp.from_dlpack(dlpack))

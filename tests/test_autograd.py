@@ -243,3 +243,92 @@ class TestPyLayerUnderRemat:
         x.stop_gradient = False
         TripleGrad.apply(x).backward()
         np.testing.assert_allclose(x.grad.numpy(), [3.0])
+
+
+class TestHigherOrderGrad:
+    """create_graph=True (SURVEY.md §2.1 N8): the backward walk records
+    itself — each node's vjp re-derived as a taped op of (inputs,
+    cotangents) — so grads of grads work to any order."""
+
+    def test_second_and_third_derivative(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+
+        x = paddle.to_tensor(np.array(2.0, np.float32), stop_gradient=False)
+        y = x * x * x
+        (g,) = paddle.grad([y], [x], create_graph=True)
+        (g2,) = paddle.grad([g], [x], create_graph=True)
+        (g3,) = paddle.grad([g2], [x])
+        assert float(g) == 12.0 and float(g2) == 12.0 and float(g3) == 6.0
+
+    def test_gradient_penalty_backward(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+
+        w = paddle.to_tensor(np.array([1.0, 2.0], np.float32),
+                             stop_gradient=False)
+        z = (w * w).sum()
+        (gw,) = paddle.grad([z], [w], create_graph=True)
+        assert not gw.stop_gradient          # grads carry a graph
+        gp = (gw * gw).sum()                 # ||2w||^2 -> d/dw = 8w
+        gp.backward()
+        np.testing.assert_allclose(w.grad.numpy(), [8.0, 16.0])
+
+    def test_elementwise_hessian_diag_matches_jax(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+
+        xv = np.array([0.3, 1.7, -2.1], np.float32)
+        t = paddle.to_tensor(xv, stop_gradient=False)
+        out = (paddle.sin(t) * paddle.exp(t)).sum()
+        (g1,) = paddle.grad([out], [t], create_graph=True)
+        (g2,) = paddle.grad([g1.sum()], [t])
+        expect = 2 * np.cos(xv) * np.exp(xv)   # (sin·exp)'' = 2cos·exp
+        np.testing.assert_allclose(g2.numpy(), expect, rtol=1e-5)
+
+    def test_pylayer_raises_informatively(self):
+        import numpy as np
+        import pytest
+
+        import paddle_tpu as paddle
+        from paddle_tpu.autograd import PyLayer
+
+        class Double(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                return x * 2.0
+
+            @staticmethod
+            def backward(ctx, dy):
+                return dy * 2.0
+
+        x = paddle.to_tensor(np.array(1.0, np.float32), stop_gradient=False)
+        y = Double.apply(x)
+        with pytest.raises(NotImplementedError, match="PyLayer"):
+            paddle.grad([y], [x], create_graph=True)
+
+
+class TestDlpack:
+    def test_roundtrip_and_torch_interop(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from paddle_tpu.utils.dlpack import from_dlpack, to_dlpack
+
+        x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+        back = from_dlpack(to_dlpack(x))
+        np.testing.assert_array_equal(back.numpy(), x.numpy())
+        try:
+            import torch
+        except ImportError:
+            return
+        tt = torch.utils.dlpack.from_dlpack(to_dlpack(x))
+        np.testing.assert_array_equal(tt.numpy(), x.numpy())
+        ours = from_dlpack(torch.arange(4, dtype=torch.float32))
+        np.testing.assert_array_equal(ours.numpy(), [0, 1, 2, 3])
+        legacy = from_dlpack(torch.utils.dlpack.to_dlpack(
+            torch.ones(3, dtype=torch.float32)))
+        np.testing.assert_array_equal(legacy.numpy(), [1, 1, 1])
