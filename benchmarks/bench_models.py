@@ -33,6 +33,15 @@ def _measure(step_fn, sync_out, units_per_step, steps=8, windows=3):
     return units_per_step * steps / best
 
 
+def _measure_scan(step, batches, units_per_dispatch, scan_k):
+    """Measure a K-steps-per-dispatch run (TrainStep.many): same per-step
+    math as __call__, K× fewer host round-trips. Syncing on the summed
+    loss vector drains the whole pack."""
+    return _measure(lambda: step.many(batches),
+                    lambda o: float(o.numpy().sum()), units_per_dispatch,
+                    steps=max(2, 8 // scan_k))
+
+
 _NOMINAL_PEAK_TF = 197.0  # v5e bf16 peak per chip
 
 
@@ -104,7 +113,7 @@ def _utilization(result, step, batch, units_per_sec, units_per_step,
     return result
 
 
-def bench_resnet50(dtype="bfloat16", B=64):
+def bench_resnet50(dtype="bfloat16", B=64, scan_k=0):
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
     from paddle_tpu.vision.models import resnet50
@@ -128,14 +137,21 @@ def bench_resnet50(dtype="bfloat16", B=64):
     if dtype == "bfloat16":
         x = paddle.cast(x, "bfloat16")
     y = paddle.to_tensor(rng.randint(0, 1000, (B,)).astype(np.int64))
-    ips = _measure(lambda: step(x, y), lambda o: float(o), B)
+    if scan_k:
+        # isolates tunnel-dispatch latency from device throughput (r4
+        # trace: device-side 2269 img/s at b64)
+        ips = _measure_scan(step, [(x, y)] * scan_k, B * scan_k, scan_k)
+    else:
+        ips = _measure(lambda: step(x, y), lambda o: float(o), B)
     tag = "bf16" if dtype == "bfloat16" else "f32"
-    res = {"metric": f"images/sec ResNet-50 {tag} train (b{B}, 224px)",
+    scan_tag = f", scan{scan_k}" if scan_k else ""
+    res = {"metric":
+           f"images/sec ResNet-50 {tag} train (b{B}, 224px{scan_tag})",
            "value": round(ips, 1), "unit": "images/s"}
     return _utilization(res, step, (x, y), ips, B)
 
 
-def bench_bert(B=32):
+def bench_bert(B=32, scan_k=0):
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
     from paddle_tpu.models import BertConfig, BertForMaskedLM
@@ -158,14 +174,20 @@ def bench_bert(B=32):
     step = paddle.jit.TrainStep(model, loss_fn, opt)
     rng = np.random.RandomState(0)
     ids = paddle.to_tensor(rng.randint(0, 30522, (B, S)).astype(np.int32))
-    sps = _measure(lambda: step(ids, ids), lambda o: float(o), B)
-    res = {"metric": f"sequences/sec BERT-base MLM bf16 train (b{B}xs{S})",
+    if scan_k:
+        sps = _measure_scan(step, [(ids, ids)] * scan_k, B * scan_k,
+                            scan_k)
+    else:
+        sps = _measure(lambda: step(ids, ids), lambda o: float(o), B)
+    scan_tag = f", scan{scan_k}" if scan_k else ""
+    res = {"metric":
+           f"sequences/sec BERT-base MLM bf16 train (b{B}xs{S}{scan_tag})",
            "value": round(sps, 1), "unit": "sequences/s"}
     pallas = 12 * _flash_flops(B, 12, S, S, 64)   # 12 bidirectional layers
     return _utilization(res, step, (ids, ids), sps, B, pallas_flops=pallas)
 
 
-def bench_unet(B=4):
+def bench_unet(B=4, scan_k=0):
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
     from paddle_tpu.models import UNetConfig, UNet2DConditionModel
@@ -189,8 +211,14 @@ def bench_unet(B=4):
     ctx = paddle.cast(paddle.to_tensor(
         rng.randn(B, 77, cfg.cross_attention_dim).astype(np.float32)),
         "bfloat16")
-    its = _measure(lambda: step(lat, t, ctx, lat), lambda o: float(o), 1)
-    res = {"metric": f"iters/sec SD-UNet bf16 train (b{B}, 32x32 latents)",
+    if scan_k:
+        its = _measure_scan(step, [(lat, t, ctx, lat)] * scan_k, scan_k,
+                            scan_k)
+    else:
+        its = _measure(lambda: step(lat, t, ctx, lat), lambda o: float(o), 1)
+    scan_tag = f", scan{scan_k}" if scan_k else ""
+    res = {"metric":
+           f"iters/sec SD-UNet bf16 train (b{B}, 32x32 latents{scan_tag})",
            "value": round(its, 2), "unit": "iters/s"}
     return _utilization(res, step, (lat, t, ctx, lat), its, 1,
                         pallas_flops=_unet_attn_flops(cfg, B))
@@ -355,6 +383,9 @@ def main():
                "unet_b16": lambda: bench_unet(B=16),
                "bert_b128": lambda: bench_bert(B=128),
                "resnet50_b256": lambda: bench_resnet50(B=256),
+               "resnet50_scan8": lambda: bench_resnet50(scan_k=8),
+               "bert_scan8": lambda: bench_bert(scan_k=8),
+               "unet_scan8": lambda: bench_unet(scan_k=8),
                "gpt_s4096": lambda: bench_gpt_longseq(seq=4096, batch=4),
                "gpt_s8192": bench_gpt_longseq,
                "llama": bench_llama,
@@ -367,7 +398,8 @@ def main():
     # reproduction and throughput-optimal unet_b16 runs stay opt-in
     names = ([n for n in benches
               if n not in ("resnet50_f32", "unet_b16", "bert_b128",
-                           "resnet50_b256", "gpt_s4096", "gpt_s8192")]
+                           "resnet50_b256", "resnet50_scan8", "bert_scan8",
+                           "unet_scan8", "gpt_s4096", "gpt_s8192")]
              if which == "all" else [which])
     if which == "all":
         # one fresh process per bench: HBM from a previous model (cached

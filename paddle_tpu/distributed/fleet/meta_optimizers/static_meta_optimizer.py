@@ -56,16 +56,12 @@ def _iter_nodes(root_syms):
 
 def _amp_cast_fn(fn, jd):
     """Wrap a recorded node fn so floating array inputs are cast to `jd`
-    before compute — the static analog of op_call._maybe_amp_wrap."""
+    before compute — the static analog of op_call._maybe_amp_wrap, using
+    the same shared cast rule."""
+    from ....core.op_call import amp_cast_arrays
 
     def wrapped(*arrays, **kw):
-        cast = [
-            a.astype(jd)
-            if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)
-            and a.dtype != jd else a
-            for a in arrays
-        ]
-        return fn(*cast, **kw)
+        return fn(*amp_cast_arrays(arrays, jd), **kw)
 
     wrapped._amp_static = jd
     wrapped.__name__ = getattr(fn, "__name__", "op")
@@ -117,6 +113,7 @@ class StaticMetaOptimizer:
         self.__dict__["_gm_k"] = 1
         self.__dict__["_gm_avg"] = True
         self.__dict__["_gm_buffers"] = None
+        self.__dict__["_gm_nacc"] = None
         self.__dict__["_gm_count"] = 0
 
     # -- surface the executor mutates: route to the inner optimizer.
@@ -130,7 +127,7 @@ class StaticMetaOptimizer:
     def __setattr__(self, name, value):
         if name in self.__dict__ or name in (
                 "_static_amp_scaler", "_gm_k", "_gm_avg", "_gm_buffers",
-                "_gm_count"):
+                "_gm_nacc", "_gm_count"):
             self.__dict__[name] = value
         else:
             setattr(self.__dict__["_inner"], name, value)

@@ -334,6 +334,74 @@ class TrainStep:
             cost = cost[0]
         return cost
 
+    def many(self, batches):
+        """Run K optimizer steps as ONE compiled program (`lax.scan` over
+        the single-step fn): identical math to K sequential __call__s —
+        K parameter/optimizer updates, per-step RNG keys — but one host
+        dispatch, which matters when dispatch latency (not compute) bounds
+        wall-clock (the r4 ResNet trace: device-side 2,269 img/s vs ~1,700
+        measured through the tunnel). `batches` is a list of K equal-shape
+        batch tuples. LR is read ONCE for the whole pack (an LRScheduler
+        stepped between many() calls behaves like a per-K-steps schedule).
+        Returns the K per-step losses as one Tensor [K]."""
+        if not batches:
+            raise ValueError("many() expects at least one batch")
+        if self.has_aux:
+            raise ValueError("many() does not support has_aux steps (the "
+                             "per-step aux would be K-stacked; run "
+                             "__call__ per step instead)")
+        first = batches[0] if isinstance(batches[0], (tuple, list)) \
+            else (batches[0],)
+        k = len(batches)
+        (sd, param_arrays, buffer_arrays, opt_states, lr, _, scaler_state,
+         _) = self._marshal(*first, draw_key=False)
+        tuples = [b if isinstance(b, (tuple, list)) else (b,)
+                  for b in batches]
+        stacked = [
+            jnp.stack([(b[i]._data if isinstance(b[i], Tensor)
+                        else jnp.asarray(b[i])) for b in tuples])
+            for i in range(len(first))
+        ]
+        rng_keys = jax.random.split(random_state.next_key(), k)
+        ckey = ("many", k, tuple((a.shape, str(a.dtype)) for a in stacked))
+        jitted = self._compiled_cache.get(ckey)
+        if jitted is None:
+            step_fn = self._make_step_fn()
+
+            def many_fn(pa, ba, os_, lr_, keys, ss, *stk):
+                def body(carry, xs):
+                    pa_, ba_, os2, ss2 = carry
+                    key = xs[0]
+                    batch = xs[1:]
+                    np_, nb, nos, loss, nss, _aux = step_fn(
+                        list(pa_), list(ba_), list(os2), lr_, key, ss2,
+                        *batch)
+                    return (tuple(np_), tuple(nb), tuple(nos), nss), loss
+
+                (pa2, ba2, os2, ss2), losses = jax.lax.scan(
+                    body, (tuple(pa), tuple(ba), tuple(os_), ss),
+                    (keys,) + stk)
+                return list(pa2), list(ba2), list(os2), losses, ss2
+
+            jitted = jax.jit(
+                many_fn, donate_argnums=(0, 1, 2) if self.donate else ())
+            self._compiled_cache[ckey] = jitted
+        new_params, new_buffers, new_opt_states, losses, new_scaler_state \
+            = jitted(param_arrays, buffer_arrays, opt_states, lr, rng_keys,
+                     scaler_state, *stacked)
+        if self.scaler is not None:
+            (self.scaler._scale, self.scaler._good_steps,
+             self.scaler._bad_steps) = new_scaler_state
+        opt = self.optimizer
+        for n, arr in zip(self._param_names, new_params):
+            sd[n]._data = arr
+        for n, arr in zip(self._buffer_names, new_buffers):
+            sd[n]._data = arr
+        for n, st in zip(self._param_names, new_opt_states):
+            opt._accumulators[id(sd[n])] = st
+        opt._step_count += k
+        return Tensor(losses)
+
     def __call__(self, *batch):
         (sd, param_arrays, buffer_arrays, opt_states, lr, rng_key,
          scaler_state, batch_arrays) = self._marshal(*batch)

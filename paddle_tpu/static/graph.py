@@ -842,9 +842,14 @@ class Executor:
 
         # the train executable is bound to the optimizer object (its
         # accumulators key on these exact param tensors), so identity —
-        # not structure — is the right key here
+        # not structure — is the right key here; every meta config baked
+        # into the closure (gm_avg, scaler thresholds) must also key it,
+        # or re-minimizing with changed configs would reuse stale code
+        scaler_key = (tuple(sorted((k, str(v))
+                                   for k, v in scaler["cfg"].items()))
+                      if scaler is not None else None)
         key = ("train", id(prog), id(loss_sym), id(opt), apply_update,
-               gm_k, scaler is not None,
+               gm_k, gm_avg, scaler_key,
                tuple(id(n) for n in ck_nodes),
                tuple(id(s) for s in syms), tuple(feed_names),
                tuple((a.shape, str(a.dtype)) for a in feed_arrays))
