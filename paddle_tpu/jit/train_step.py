@@ -92,13 +92,15 @@ class TrainStep:
         self._jitted = jax.jit(self._make_step_fn(),
                                donate_argnums=(0, 2) if self.donate else ())
 
-    def _run_auto(self, *args):
+    def _run_auto(self, *args, _fn_factory=None, _key_tag=()):
         """AUTO-layout execution: jit with compiler-CHOSEN layouts for the
         params/buffers/opt-state args only (batch/lr/rng keep the default
         layout — relaying a fresh host batch out every step cost ResNet
         ~5%), compile per arg signature, query the chosen input formats,
         and device_put any mismatched state leaf ONCE — donated aliasing
-        keeps every later step zero-copy."""
+        keeps every later step zero-copy. `_fn_factory`/`_key_tag` let
+        many() run its scanned K-step program through the same treatment
+        (args keep the (params, buffers, opt_states, ...) leading trio)."""
         from jax.experimental.layout import Format, Layout
 
         flat, treedef = jax.tree.flatten(args)
@@ -106,7 +108,8 @@ class TrainStep:
         # (state shapes are fixed per TrainStep); keying on it alone keeps
         # the per-step key O(batch) instead of O(params)
         bflat, btree = jax.tree.flatten(args[6:])
-        key = (len(flat), btree, tuple((a.shape, a.dtype) for a in bflat))
+        key = (_key_tag, len(flat), btree,
+               tuple((a.shape, a.dtype) for a in bflat))
         ent = self._compiled_cache.get(key)
         if ent is None:
             auto = Format(Layout.AUTO)
@@ -114,7 +117,7 @@ class TrainStep:
             # buffers (arg 1) are donated here too: their exit layouts
             # must alias their AUTO entry layouts for the trusted-skip
             # below to hold for >=2-D buffers
-            jitted = jax.jit(self._make_step_fn(),
+            jitted = jax.jit((_fn_factory or self._make_step_fn)(),
                              donate_argnums=(0, 1, 2) if self.donate else (),
                              in_shardings=specs,
                              out_shardings=Format(Layout.AUTO))
@@ -164,7 +167,8 @@ class TrainStep:
             # error under "Array has been deleted".
             if trusted and "layout" in str(e).lower():
                 self._layout_owner = None
-                return self._run_auto(*args)
+                return self._run_auto(*args, _fn_factory=_fn_factory,
+                                      _key_tag=_key_tag)
             raise
         self._layout_owner = key
         return out
@@ -337,12 +341,16 @@ class TrainStep:
     def many(self, batches):
         """Run K optimizer steps as ONE compiled program (`lax.scan` over
         the single-step fn): identical math to K sequential __call__s —
-        K parameter/optimizer updates, per-step RNG keys — but one host
-        dispatch, which matters when dispatch latency (not compute) bounds
-        wall-clock (the r4 ResNet trace: device-side 2,269 img/s vs ~1,700
-        measured through the tunnel). `batches` is a list of K equal-shape
-        batch tuples. LR is read ONCE for the whole pack (an LRScheduler
-        stepped between many() calls behaves like a per-K-steps schedule).
+        K parameter/optimizer updates, each with its own RNG key — but one
+        host dispatch, which matters when dispatch latency (not compute)
+        bounds wall-clock (the r4 ResNet trace: device-side 2,269 img/s vs
+        ~1,700 measured through the tunnel). `batches` is a list of K
+        equal-shape batch tuples. LR is read ONCE for the whole pack (an
+        LRScheduler stepped between many() calls behaves like a
+        per-K-steps schedule), and the K keys come from ONE split of the
+        global stream — statistically equivalent to, but not bitwise the
+        same as, the K successive draws sequential __call__s make
+        (dropout masks differ; RNG-free steps match exactly).
         Returns the K per-step losses as one Tensor [K]."""
         if not batches:
             raise ValueError("many() expects at least one batch")
@@ -350,22 +358,28 @@ class TrainStep:
             raise ValueError("many() does not support has_aux steps (the "
                              "per-step aux would be K-stacked; run "
                              "__call__ per step instead)")
-        first = batches[0] if isinstance(batches[0], (tuple, list)) \
-            else (batches[0],)
+        if type(self) is not TrainStep:
+            # a subclass (GroupShardedTrainStep) builds its own sharded
+            # dispatch in _build/_place_states, which this scan would
+            # silently bypass — params would compile UNSHARDED
+            raise NotImplementedError(
+                f"many() supports the single-device TrainStep; "
+                f"{type(self).__name__} must run one step per call")
         k = len(batches)
+        # marshal STATE only (no batch: its arrays would be converted
+        # here and discarded, a wasted H2D copy on the latency path)
         (sd, param_arrays, buffer_arrays, opt_states, lr, _, scaler_state,
-         _) = self._marshal(*first, draw_key=False)
+         _) = self._marshal(draw_key=False)
         tuples = [b if isinstance(b, (tuple, list)) else (b,)
                   for b in batches]
         stacked = [
             jnp.stack([(b[i]._data if isinstance(b[i], Tensor)
                         else jnp.asarray(b[i])) for b in tuples])
-            for i in range(len(first))
+            for i in range(len(tuples[0]))
         ]
         rng_keys = jax.random.split(random_state.next_key(), k)
-        ckey = ("many", k, tuple((a.shape, str(a.dtype)) for a in stacked))
-        jitted = self._compiled_cache.get(ckey)
-        if jitted is None:
+
+        def make_many_fn():
             step_fn = self._make_step_fn()
 
             def many_fn(pa, ba, os_, lr_, keys, ss, *stk):
@@ -383,12 +397,29 @@ class TrainStep:
                     (keys,) + stk)
                 return list(pa2), list(ba2), list(os2), losses, ss2
 
-            jitted = jax.jit(
-                many_fn, donate_argnums=(0, 1, 2) if self.donate else ())
-            self._compiled_cache[ckey] = jitted
-        new_params, new_buffers, new_opt_states, losses, new_scaler_state \
-            = jitted(param_arrays, buffer_arrays, opt_states, lr, rng_keys,
-                     scaler_state, *stacked)
+            return many_fn
+
+        run_args = (param_arrays, buffer_arrays, opt_states, lr, rng_keys,
+                    scaler_state) + tuple(stacked)
+        if self.auto_layout:
+            # big-parameter models (SD-UNet) NEED the AUTO-layout
+            # treatment inside the scan too — plain jit re-pins the
+            # donated entry layouts and re-introduces the per-step
+            # master-weight layout flips the r4 trace diagnosed
+            (new_params, new_buffers, new_opt_states, losses,
+             new_scaler_state) = self._run_auto(
+                *run_args, _fn_factory=make_many_fn, _key_tag=("many", k))
+        else:
+            ckey = ("many", k,
+                    tuple((a.shape, str(a.dtype)) for a in stacked))
+            jitted = self._compiled_cache.get(ckey)
+            if jitted is None:
+                jitted = jax.jit(
+                    make_many_fn(),
+                    donate_argnums=(0, 1, 2) if self.donate else ())
+                self._compiled_cache[ckey] = jitted
+            (new_params, new_buffers, new_opt_states, losses,
+             new_scaler_state) = jitted(*run_args)
         if self.scaler is not None:
             (self.scaler._scale, self.scaler._good_steps,
              self.scaler._bad_steps) = new_scaler_state
