@@ -233,3 +233,16 @@ class StaticMetaOptimizer:
         """Current dynamic loss scale (fp16 amp), reference-parity probe."""
         s = self._static_amp_scaler
         return float(s["state"]["scale"]) if s else 1.0
+
+    def get_loss_scaling(self):
+        """ref OptimizerWithMixedPrecision.get_loss_scaling."""
+        return self.loss_scaling
+
+    def amp_init(self, place=None, scope=None, test_program=None,
+                 use_fp16_test=False):
+        """ref OptimizerWithMixedPrecision.amp_init: casts fp32 params for
+        pure-fp16 execution. Here the cast rewrite already feeds every
+        white-listed op the amp dtype at run time (parameters stay f32
+        master weights), so initialization is a no-op kept for script
+        parity."""
+        return None

@@ -340,7 +340,8 @@ class TrainStep:
 
     def many(self, batches):
         """Run K optimizer steps as ONE compiled program (`lax.scan` over
-        the single-step fn): identical math to K sequential __call__s —
+        the single-step fn): the same UPDATE math as K sequential
+        __call__s (bitwise for RNG-free steps; see the RNG caveat below) —
         K parameter/optimizer updates, each with its own RNG key — but one
         host dispatch, which matters when dispatch latency (not compute)
         bounds wall-clock (the r4 ResNet trace: device-side 2,269 img/s vs
@@ -358,13 +359,18 @@ class TrainStep:
             raise ValueError("many() does not support has_aux steps (the "
                              "per-step aux would be K-stacked; run "
                              "__call__ per step instead)")
-        if type(self) is not TrainStep:
-            # a subclass (GroupShardedTrainStep) builds its own sharded
-            # dispatch in _build/_place_states, which this scan would
-            # silently bypass — params would compile UNSHARDED
+        cls = type(self)
+        if (cls._build is not TrainStep._build
+                or cls._make_step_fn is not TrainStep._make_step_fn
+                or cls._run_auto is not TrainStep._run_auto):
+            # a subclass that overrides dispatch (GroupShardedTrainStep's
+            # sharded _build/_place_states) would be silently bypassed by
+            # this scan — params would compile UNSHARDED; benign
+            # subclasses that keep the dispatch methods inherit many()
             raise NotImplementedError(
-                f"many() supports the single-device TrainStep; "
-                f"{type(self).__name__} must run one step per call")
+                f"many() supports TrainStep's own dispatch; "
+                f"{cls.__name__} overrides it and must run one step per "
+                "call")
         k = len(batches)
         # marshal STATE only (no batch: its arrays would be converted
         # here and discarded, a wasted H2D copy on the latency path)

@@ -40,6 +40,7 @@ from .graph import (
 )
 
 from . import nn  # noqa: E402
+from . import amp  # noqa: E402
 
 
 def name_scope(prefix=None):
@@ -47,26 +48,6 @@ def name_scope(prefix=None):
 
     return contextlib.nullcontext()
 
-
-class _ShimAttributeError(NotImplementedError, AttributeError):
-    """Informative like NotImplementedError, but still an AttributeError so
-    hasattr/getattr feature-detection keeps working for ported code."""
-
-
-class _StaticAmpShim:
-    """paddle.static.amp shim: static-graph AMP program rewriting does not
-    exist on the TPU build — dynamic `paddle_tpu.amp.auto_cast` /
-    `amp.decorate` compose with `jit.to_static` (bf16 policy is applied at
-    trace time, so the compiled program is already mixed-precision)."""
-
-    def __getattr__(self, name):
-        raise _ShimAttributeError(
-            f"paddle.static.amp.{name} rewrites static Programs; use "
-            "paddle_tpu.amp.auto_cast / amp.decorate with jit.to_static "
-            "instead.")
-
-
-amp = _StaticAmpShim()
 
 __all__ = [
     "InputSpec", "Layer", "Executor", "Program", "StaticGraphError",

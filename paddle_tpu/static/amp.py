@@ -1,0 +1,77 @@
+"""paddle.static.amp (ref: python/paddle/static/amp/decorator.py (U) —
+`decorate(optimizer)` returns an OptimizerWithMixedPrecision whose
+minimize() rewrites the program with casts and dynamic loss scaling).
+
+TPU-native: the rewrite machinery is the static meta-optimizer
+(fleet/meta_optimizers/static_meta_optimizer.py); this module is the
+reference's non-fleet entry point to the same pass. fp16 gets dynamic
+loss scaling compiled into the train program; bf16 (TPU default half
+type, pass dtype='bfloat16') needs none."""
+
+from __future__ import annotations
+
+__all__ = ["decorate", "AutoMixedPrecisionLists", "CustomOpLists"]
+
+
+class AutoMixedPrecisionLists:
+    """ref AutoMixedPrecisionLists: custom white/black op-name lists merged
+    over the framework defaults (amp/auto_cast.py WHITE_LIST/BLACK_LIST)."""
+
+    def __init__(self, custom_white_list=None, custom_black_list=None,
+                 custom_black_varnames=None, dtype="float16"):
+        self.custom_white_list = set(custom_white_list or ())
+        self.custom_black_list = set(custom_black_list or ())
+        if custom_black_varnames:
+            raise NotImplementedError(
+                "custom_black_varnames (per-variable amp exclusion) is not "
+                "supported; use custom_black_list with op names")
+        self.dtype = dtype
+
+
+CustomOpLists = AutoMixedPrecisionLists
+
+
+def decorate(optimizer, amp_lists=None, init_loss_scaling=2.0 ** 15,
+             incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
+             incr_ratio=2.0, decr_ratio=0.8,
+             use_dynamic_loss_scaling=True, use_pure_fp16=False,
+             use_fp16_guard=None, dtype=None, level="O1",
+             master_weight=None):
+    """ref static.amp.decorate: wrap `optimizer` so minimize() applies the
+    mixed-precision program rewrite. Returns the static meta-optimizer
+    with ONLY the amp strategy enabled — composes with
+    fleet.distributed_optimizer strategies if used there instead.
+
+    dtype resolution: the explicit `dtype` argument wins; otherwise
+    `amp_lists.dtype`; default float16 (the reference default).
+    `use_fp16_guard` (block-scoped fp16 regions) and `master_weight` are
+    accepted for signature parity but moot by design here: the cast
+    rewrite is op-list-scoped, and Adam-family optimizers always keep f32
+    master state (the multi_precision path)."""
+    from ..distributed.fleet.base.distributed_strategy import (
+        DistributedStrategy,
+    )
+    from ..distributed.fleet.meta_optimizers.static_meta_optimizer import (
+        StaticMetaOptimizer,
+    )
+
+    if dtype is None:
+        dtype = getattr(amp_lists, "dtype", None) or "float16"
+    strategy = DistributedStrategy()
+    strategy.amp = True
+    strategy.amp_configs = {
+        "use_bf16": str(dtype) in ("bfloat16", "uint16", "paddle.bfloat16"),
+        "init_loss_scaling": float(init_loss_scaling),
+        "incr_every_n_steps": int(incr_every_n_steps),
+        "decr_every_n_nan_or_inf": int(decr_every_n_nan_or_inf),
+        "incr_ratio": float(incr_ratio),
+        "decr_ratio": float(decr_ratio),
+        "use_dynamic_loss_scaling": bool(use_dynamic_loss_scaling),
+        "use_pure_fp16": bool(use_pure_fp16 or level == "O2"),
+        "custom_white_list": sorted(
+            getattr(amp_lists, "custom_white_list", ()) or ()),
+        "custom_black_list": sorted(
+            getattr(amp_lists, "custom_black_list", ()) or ()),
+    }
+    wrapped = StaticMetaOptimizer(optimizer, strategy)
+    return wrapped

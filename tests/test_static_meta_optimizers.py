@@ -122,6 +122,45 @@ class TestStaticAMP:
             assert opt.loss_scaling == pytest.approx(2048.0)
 
 
+class TestStaticAmpDecorate:
+    def test_reference_decorate_workflow(self, static_mode):
+        """paddle.static.amp.decorate(optimizer) — the reference's
+        non-fleet AMP entry point — routes through the same program
+        rewrite + loss-scaling machinery."""
+        X, Y = _problem()
+        with static.program_guard(static.Program()):
+            x, y, h, loss = _mlp_program()
+            opt = static.amp.decorate(
+                paddle.optimizer.Adam(learning_rate=0.02),
+                amp_lists=static.amp.AutoMixedPrecisionLists(
+                    custom_black_list=["relu"]),
+                init_loss_scaling=1024.0)
+            assert isinstance(opt, StaticMetaOptimizer)
+            opt.minimize(loss)
+            opt.amp_init(None)                 # parity no-op
+            exe = static.Executor()
+            losses = []
+            for _ in range(20):
+                (lv,) = exe.run(feed={"x": X, "y": Y}, fetch_list=[loss])
+                losses.append(float(lv))
+        assert losses[-1] < 0.5 * losses[0]
+        assert opt.get_loss_scaling() == pytest.approx(1024.0)
+
+    def test_bf16_dtype_skips_loss_scaling(self, static_mode):
+        X, Y = _problem()
+        with static.program_guard(static.Program()):
+            x, y, h, loss = _mlp_program()
+            opt = static.amp.decorate(
+                paddle.optimizer.SGD(learning_rate=0.05), dtype="bfloat16")
+            opt.minimize(loss)
+            assert opt._static_amp_scaler is None   # bf16 needs none
+            exe = static.Executor()
+            (lv0,) = exe.run(feed={"x": X, "y": Y}, fetch_list=[loss])
+            for _ in range(10):
+                (lv,) = exe.run(feed={"x": X, "y": Y}, fetch_list=[loss])
+        assert float(lv) < float(lv0)
+
+
 class TestStaticRecompute:
     def test_checkpointed_losses_match_plain(self, static_mode):
         X, Y = _problem()
