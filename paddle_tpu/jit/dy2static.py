@@ -42,7 +42,7 @@ import textwrap
 import types
 
 __all__ = ["convert_to_static", "convert_ifelse", "convert_while",
-           "UndefinedVar", "UNDEF"]
+           "convert_for_range", "UndefinedVar", "UNDEF"]
 
 
 class UndefinedVar:
@@ -254,6 +254,115 @@ def convert_while(cond_fn, body_fn, vals, names):
         if isinstance(final[i], UndefinedVar):
             final[i] = UndefinedVar(names[i])
     return tuple(final)
+
+
+def convert_for_range(range_args, body_fn, vals, names,
+                      target_name="<target>", target_prior=UNDEF):
+    """Runtime dispatch for a converted `for <target> in range(...)`:
+    concrete bounds run the plain Python loop (unrolls under trace); a
+    traced bound stages ONE lax while_loop with the trip count computed
+    on-device. body_fn((i, vals)) -> vals. Returns (final_i, vals) —
+    after an EMPTY range the target keeps its prior binding
+    (`target_prior`, Python semantics; after a staged empty range that
+    only works when the prior value is a tensor/number — otherwise the
+    target pins to `start`). Carries follow convert_while's rules
+    (undefined names drop out of the carry; cross-iteration reads raise
+    by name)."""
+    if len(range_args) == 1:
+        start, stop, step = 0, range_args[0], 1
+    elif len(range_args) == 2:
+        start, stop = range_args
+        step = 1
+    else:
+        start, stop, step = range_args
+
+    from ..core.tensor import Tensor
+
+    if isinstance(step, (int, Tensor)) and not _is_traced(step) \
+            and int(step) == 0:
+        raise ValueError("range() arg 3 must not be zero")
+
+    if not any(_is_traced(v) for v in (start, stop, step)):
+        as_py = [int(v) if isinstance(v, Tensor) else v
+                 for v in (start, stop, step)]
+        # empty range keeps the prior binding; an unbound prior stays the
+        # loud sentinel, renamed so the eventual NameError names the var
+        i = (UndefinedVar(target_name)
+             if isinstance(target_prior, UndefinedVar) else target_prior)
+        for i in range(*as_py):
+            vals = body_fn((i, vals))
+        return i, vals
+
+    import jax.numpy as jnp
+
+    from ..static.nn import while_loop as static_while
+    from ..tensor.creation import to_tensor
+
+    def arr(v):
+        return v._data if isinstance(v, Tensor) else jnp.asarray(v)
+
+    start_a, stop_a, step_a = arr(start), arr(stop), arr(step)
+    # integer sign-aware ceil-div: a float32 round-trip loses exactness
+    # at |bounds| >= 2^24 (one lost iteration at 16777217)
+    n_pos = (stop_a - start_a + step_a - 1) // step_a
+    n_neg = (start_a - stop_a - step_a - 1) // (-step_a)
+    n_iters = jnp.maximum(
+        0, jnp.where(step_a > 0, n_pos, n_neg)).astype(jnp.int32)
+
+    keep = [i for i, v in enumerate(vals)
+            if not isinstance(v, UndefinedVar)]
+
+    def full(vs):
+        out = list(vals)
+        for i, v in zip(keep, vs):
+            out[i] = v
+        for i in range(len(out)):
+            if isinstance(out[i], UndefinedVar):
+                out[i] = UndefinedVar(names[i])
+        return tuple(out)
+
+    def cond_w(k, i, *vs):
+        from ..core.tensor import Tensor
+
+        kd = k._data if isinstance(k, Tensor) else k
+        return Tensor(kd < n_iters)
+
+    def body_w(k, i, *vs):
+        res = body_fn((i, full(vs)))
+        out = []
+        for j in keep:
+            v = res[j]
+            if isinstance(v, UndefinedVar):
+                v._boom()
+            out.append(v)
+        return [k + 1, i + to_tensor(step_a)] + out
+
+    carried = [_to_carry(vals[i], names[i]) for i in keep]
+    outs = static_while(cond_w, body_w,
+                        [to_tensor(jnp.zeros((), jnp.int32)),
+                         to_tensor(start_a)] + carried)
+    final_i = outs[1] - to_tensor(step_a)  # last iterated value...
+    # ...except for an empty range, where Python keeps the target's prior
+    # binding — honored when the prior is array-valued; otherwise the
+    # staged code pins it to `start` deterministically
+    from ..core.op_call import apply as _apply
+
+    if isinstance(target_prior, (Tensor, int, float)) \
+            and not isinstance(target_prior, bool):
+        empty_val = arr(target_prior).astype(start_a.dtype)
+    else:
+        empty_val = start_a
+    final_i = _apply(
+        lambda n, fi, st: jnp.where(n > 0, fi, st),
+        to_tensor(n_iters), final_i, to_tensor(empty_val),
+        _op_name="for_range_final")
+    final = list(vals)
+    for i, v in zip(keep, outs[2:]):
+        final[i] = v
+    for i in range(len(final)):
+        if isinstance(final[i], UndefinedVar):
+            final[i] = UndefinedVar(names[i])
+    return final_i, tuple(final)
 
 
 # --------------------------------------------------------------------------
@@ -476,6 +585,56 @@ class _Dy2StaticTransformer(ast.NodeTransformer):
         self.converted_any = True
         return [ast.copy_location(s, node) for s in stmts]
 
+    def visit_For(self, node):
+        node = self.generic_visit(node)
+        it = node.iter
+        if (node.orelse or not isinstance(it, ast.Call)
+                or not isinstance(it.func, ast.Name)
+                or it.func.id != "range" or it.keywords
+                or not (1 <= len(it.args) <= 3)
+                or any(isinstance(a, ast.Starred) for a in it.args)
+                or not isinstance(node.target, ast.Name)
+                or not _convertible(node)):
+            return node  # non-range / for-else / break-carrying stays Python
+        target = node.target.id
+        if target in _assigned_names(node.body):
+            # a body that REBINDS the loop target has Python semantics the
+            # threaded-target rewrite can't reproduce — leave it alone
+            return node
+        k = self.counter = self.counter + 1
+        names = _assigned_names(node.body)
+        bname, inner = f"__jst_fb{k}", f"__jst_inner{k}"
+        body = [ast.Assign(
+            targets=[ast.Tuple(elts=[_store(target), _store(inner)],
+                               ctx=ast.Store())],
+            value=_load(_VALS))]
+        if names:
+            body.append(ast.Assign(
+                targets=[_names_tuple(names, ast.Store)],
+                value=_load(inner)))
+        body += node.body
+        body.append(_carries_return(names))
+        body_def = ast.FunctionDef(name=bname, args=_one_arg(), body=body,
+                                   decorator_list=[], returns=None,
+                                   type_params=[])
+        prior = f"__jst_v{k}_prior"
+        stmts, call = self._emit(names, [body_def], "convert_for_range", k)
+        stmts += _guarded_reads([target], prior)       # -> __jst_vK_prior0
+        call.args = [ast.Tuple(elts=list(it.args), ctx=ast.Load()),
+                     _load(bname)] + call.args \
+            + [ast.Constant(value=target), _load(prior + "0")]
+        out = f"__jst_out{k}"
+        stmts.append(ast.Assign(
+            targets=[ast.Tuple(elts=[_store(target), _store(out)],
+                               ctx=ast.Store())],
+            value=call))
+        if names:
+            stmts.append(ast.Assign(
+                targets=[_names_tuple(names, ast.Store)],
+                value=_load(out)))
+        self.converted_any = True
+        return [ast.copy_location(s, node) for s in stmts]
+
     def visit_While(self, node):
         node = self.generic_visit(node)
         if node.orelse or not _convertible(node):
@@ -550,7 +709,8 @@ def _convert_uncached(fn):
     fdef = tree.body[0]
     if not isinstance(fdef, ast.FunctionDef):
         return None
-    if not any(isinstance(n, (ast.If, ast.While)) for n in ast.walk(fdef)):
+    if not any(isinstance(n, (ast.If, ast.While, ast.For))
+               for n in ast.walk(fdef)):
         return None
     fdef.decorator_list = []       # re-applying the decorator would recurse
     tf = _Dy2StaticTransformer()

@@ -168,6 +168,113 @@ class TestStagedUnderJit:
                                    [3.0])
 
 
+class TestForRange:
+    def test_concrete_range_unrolls_with_target_after_loop(self):
+        def g(x):
+            total = paddle.zeros([])
+            for i in range(2, 8, 3):
+                total = total + x * i
+            return total, i
+
+        conv = convert_to_static(g)
+        assert conv.__dy2static_converted__
+        t, last = conv(_t(1.0))
+        assert float(t.numpy()) == 7.0 and last == 5
+
+    def test_traced_bound_stages_one_program(self):
+        def f(x, n):
+            s = paddle.zeros([])
+            for i in range(n):
+                s = s + x * (i + 1.0)
+            return s
+
+        sf = paddle.jit.to_static(f)
+        assert float(sf(_t(2.0), paddle.to_tensor(4)).numpy()) == 20.0
+        assert float(sf(_t(2.0), paddle.to_tensor(2)).numpy()) == 6.0
+        assert float(sf(_t(2.0), paddle.to_tensor(0)).numpy()) == 0.0
+        assert len(sf._cache) == 1     # staged, not unrolled per n
+
+    def test_greedy_decode_style_loop(self):
+        """The dy2static canonical case: a decode loop whose length is a
+        traced tensor."""
+        def decode(logits_scale, steps):
+            tok = paddle.zeros([])
+            acc = paddle.zeros([])
+            for i in range(steps):
+                tok = tok * 0.5 + logits_scale
+                acc = acc + tok
+            return acc
+
+        sf = paddle.jit.to_static(decode)
+        def ref(scale, n):
+            tok = acc = 0.0
+            for _ in range(n):
+                tok = tok * 0.5 + scale
+                acc += tok
+            return acc
+        np.testing.assert_allclose(
+            float(sf(_t(1.0), paddle.to_tensor(5)).numpy()), ref(1.0, 5),
+            rtol=1e-6)
+
+    def test_empty_concrete_range_leaves_target_undefined(self):
+        def f(x):
+            for i in range(0):
+                x = x + 1.0
+            return i * 1.0             # unbound in Python -> loud here
+
+        conv = convert_to_static(f)
+        with pytest.raises(NameError, match="'i'"):
+            conv(_t(1.0))
+
+    def test_empty_range_keeps_prior_target_binding(self):
+        def f(x):
+            i = 5
+            for i in range(0):
+                x = x + 1.0
+            return i * 1.0             # Python: prior binding survives
+
+        assert convert_to_static(f)(_t(1.0)) == 5.0
+
+    def test_body_rebinding_target_falls_back_to_python(self):
+        def f(x):
+            for i in range(3):
+                i = i * 10             # body rebinds the target
+            return i
+
+        assert convert_to_static(f)(_t(1.0)) == 20
+
+    def test_zero_step_raises_like_python(self):
+        def f(x, n):
+            s = paddle.zeros([])
+            for i in range(0, n, 0):
+                s = s + x
+            return s
+
+        with pytest.raises(ValueError, match="must not be zero"):
+            paddle.jit.to_static(f)(_t(1.0), paddle.to_tensor(5))
+
+    def test_break_in_for_falls_back(self):
+        def f(x, n=5):
+            total = 0.0
+            for i in range(n):
+                if i == 3:
+                    break
+                total = total + float(x.numpy()) * 1.0
+            return total
+
+        conv = convert_to_static(f)
+        assert conv(_t(2.0)) == 6.0    # python semantics preserved
+
+    def test_non_range_iterables_untouched(self):
+        def f(items):
+            out = 0.0
+            for v in items:
+                out = out + v
+            return out
+
+        assert convert_to_static(f)([1.0, 2.0, 3.0]) == 6.0
+
+
 class TestLiteScopeEdges:
     def test_return_inside_if_falls_back(self):
         def f(x):
