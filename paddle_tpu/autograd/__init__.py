@@ -229,12 +229,20 @@ def _fn_on_arrays(func, single_in):
 
 
 def jacobian(func, xs, create_graph=False, allow_unused=False):
-    """ref paddle.autograd.jacobian — d func / d xs via jax.jacrev."""
+    """ref paddle.autograd.jacobian — d func / d xs via jax.jacrev. For a
+    tuple-returning func, returns a tuple of per-output jacobians (each
+    with the per-xs structure)."""
     import jax
 
     single, arrays = _unwrap(xs)
     f = _fn_on_arrays(func, single)
+    multi_out = isinstance(jax.eval_shape(f, *arrays), (tuple, list))
     jac = jax.jacrev(f, argnums=tuple(range(len(arrays))))(*arrays)
+    if multi_out:
+        # jacrev mirrors the OUTPUT structure; each output leaf carries
+        # the per-argnum tuple — drop the arg tuple only for single xs
+        return tuple(jax.tree.map(Tensor, j[0] if single else j)
+                     for j in jac)
     if single:
         return jax.tree.map(Tensor, jac[0])
     return jax.tree.map(Tensor, jac)

@@ -2,6 +2,7 @@
 nn/functional/ (U), SURVEY.md §2.2 P25). On TPU the "fused" implementations
 are the Pallas kernels in paddle_tpu.ops plus XLA's automatic fusion."""
 
+from . import autograd
 from . import nn
 from ..ops.softmax_mask_fuse import softmax_mask_fuse, softmax_mask_fuse_upper_triangle
 

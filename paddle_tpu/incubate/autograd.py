@@ -1,0 +1,69 @@
+"""paddle.incubate.autograd (ref: python/paddle/incubate/autograd/ (U) —
+the functional-differentiation namespace: jvp/vjp and the Jacobian/
+Hessian objects). TPU-native: thin objects over jax.jacrev/jax.hessian;
+the jvp/vjp functionals are shared with paddle.autograd.
+
+Lite scope, loud edges: the Jacobian/Hessian OBJECTS cover the common
+single-tensor-xs, single-output case with full matrix slicing; multi-xs
+block structure, multi-output funcs and is_batched raise
+NotImplementedError pointing at `paddle.autograd.jacobian/hessian`
+(which return the full block structures)."""
+
+from __future__ import annotations
+
+from ..autograd import hessian as _hessian_fn
+from ..autograd import jacobian as _jacobian_fn
+from ..autograd import jvp, vjp  # noqa: F401  (re-exports)
+
+__all__ = ["jvp", "vjp", "Jacobian", "Hessian", "jacobian", "hessian"]
+
+jacobian = _jacobian_fn
+hessian = _hessian_fn
+
+
+def _reject(kind, cond, what):
+    if cond:
+        raise NotImplementedError(
+            f"{kind}: {what} is not supported by this lite object; use "
+            "paddle.autograd.jacobian/hessian for the full block "
+            "structure")
+
+
+class Jacobian:
+    """ref incubate.autograd.Jacobian: J = Jacobian(func, x); J[...]
+    slices the (out_size, in_size)-structured jacobian with full
+    numpy-style indexing (one xs tensor, one output tensor)."""
+
+    def __init__(self, func, xs, is_batched=False):
+        _reject("Jacobian", is_batched, "is_batched=True")
+        _reject("Jacobian", isinstance(xs, (list, tuple)),
+                "multiple xs tensors")
+        out = _jacobian_fn(func, xs)
+        _reject("Jacobian", isinstance(out, (tuple, list)),
+                "a multi-output func")
+        self._mat = out
+
+    @property
+    def shape(self):
+        return self._mat.shape
+
+    def __getitem__(self, idx):
+        return self._mat[idx]
+
+
+class Hessian:
+    """ref incubate.autograd.Hessian over a scalar-output func (one xs
+    tensor); full numpy-style slicing of the (in, in) matrix."""
+
+    def __init__(self, func, xs, is_batched=False):
+        _reject("Hessian", is_batched, "is_batched=True")
+        _reject("Hessian", isinstance(xs, (list, tuple)),
+                "multiple xs tensors")
+        self._mat = _hessian_fn(func, xs)
+
+    @property
+    def shape(self):
+        return self._mat.shape
+
+    def __getitem__(self, idx):
+        return self._mat[idx]

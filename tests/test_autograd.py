@@ -332,3 +332,39 @@ class TestDlpack:
         legacy = from_dlpack(torch.utils.dlpack.to_dlpack(
             torch.ones(3, dtype=torch.float32)))
         np.testing.assert_array_equal(legacy.numpy(), [1, 1, 1])
+
+
+class TestIncubateAutograd:
+    def test_jacobian_hessian_objects_and_functionals(self):
+        ia = paddle.incubate.autograd
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        J = ia.Jacobian(lambda t: t * t, x)
+        np.testing.assert_allclose(J[:].numpy(), np.diag([2.0, 4.0]))
+        np.testing.assert_allclose(J[0].numpy(), [2.0, 0.0])
+        np.testing.assert_allclose(J[0:2, 1].numpy(), [0.0, 4.0])
+        assert tuple(J.shape) == (2, 2)
+        H = ia.Hessian(lambda t: (t ** 3).sum(), x)
+        np.testing.assert_allclose(H[:].numpy(), np.diag([6.0, 12.0]))
+        _, jv = ia.jvp(lambda t: t * t, x)
+        np.testing.assert_allclose(jv.numpy(), [2.0, 4.0])
+        _, vj = ia.vjp(lambda t: t * t, x)
+        np.testing.assert_allclose(vj.numpy(), [2.0, 4.0])
+
+    def test_lite_scope_edges_raise(self):
+        ia = paddle.incubate.autograd
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        with pytest.raises(NotImplementedError, match="is_batched"):
+            ia.Jacobian(lambda t: t * t, x, is_batched=True)
+        with pytest.raises(NotImplementedError, match="multiple xs"):
+            ia.Jacobian(lambda a, b: a * b, [x, x])
+        with pytest.raises(NotImplementedError, match="multi-output"):
+            ia.Jacobian(lambda t: (t * t, t * 3), x)
+        with pytest.raises(NotImplementedError, match="multiple xs"):
+            ia.Hessian(lambda a, b: (a * b).sum(), [x, x])
+
+    def test_multi_output_jacobian_functional(self):
+        # paddle.autograd.jacobian no longer silently drops outputs
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        j1, j2 = paddle.autograd.jacobian(lambda t: (t * t, 3 * t), x)
+        np.testing.assert_allclose(j1.numpy(), np.diag([2.0, 4.0]))
+        np.testing.assert_allclose(j2.numpy(), np.diag([3.0, 3.0]))
