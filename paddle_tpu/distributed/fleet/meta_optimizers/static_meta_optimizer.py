@@ -110,6 +110,7 @@ class StaticMetaOptimizer:
         self.__dict__["_inner"] = optimizer
         self.__dict__["_strategy"] = strategy or DistributedStrategy()
         self.__dict__["_static_amp_scaler"] = None
+        self.__dict__["_static_dp_mesh"] = None
         self.__dict__["_gm_k"] = 1
         self.__dict__["_gm_avg"] = True
         self.__dict__["_gm_buffers"] = None
@@ -182,6 +183,17 @@ class StaticMetaOptimizer:
 
         result = register_minimize(self, loss, parameters=parameters,
                                    no_grad_set=no_grad_set)
+
+        # static DATA-PARALLEL training (the reference's historical fleet
+        # static path: transpiled program + grad allreduce): when the
+        # hybrid mesh has a dp axis, the executor compiles the train
+        # program with feeds sharded over it and params replicated —
+        # GSPMD inserts the gradient all-reduce (SURVEY.md §3.3/§3.5)
+        from ...topology import get_hybrid_communicate_group
+
+        hcg = get_hybrid_communicate_group()
+        if hcg is not None and hcg.get_data_parallel_world_size() > 1:
+            self._static_dp_mesh = hcg.mesh
 
         if getattr(strat, "recompute", False):
             cks = strat.recompute_configs.get("checkpoints") or []

@@ -35,13 +35,20 @@ class Jacobian:
     numpy-style indexing (one xs tensor, one output tensor)."""
 
     def __init__(self, func, xs, is_batched=False):
+        import jax
+
+        from ..autograd import _fn_on_arrays, _unwrap
+
         _reject("Jacobian", is_batched, "is_batched=True")
         _reject("Jacobian", isinstance(xs, (list, tuple)),
                 "multiple xs tensors")
-        out = _jacobian_fn(func, xs)
-        _reject("Jacobian", isinstance(out, (tuple, list)),
+        # reject multi-output BEFORE paying for the differentiation
+        _, arrays = _unwrap(xs)
+        f = _fn_on_arrays(func, True)
+        _reject("Jacobian",
+                isinstance(jax.eval_shape(f, *arrays), (tuple, list)),
                 "a multi-output func")
-        self._mat = out
+        self._mat = _jacobian_fn(func, xs)
 
     @property
     def shape(self):

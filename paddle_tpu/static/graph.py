@@ -826,6 +826,8 @@ class Executor:
                     self._ck_cache.popitem(last=False)
         scaler = (getattr(opt, "_static_amp_scaler", None)
                   if opt is not None else None)
+        dp_mesh = (getattr(opt, "_static_dp_mesh", None)
+                   if opt is not None else None)
         gm_k = int(getattr(opt, "_gm_k", 1) or 1) if opt is not None else 1
         gm_avg = bool(getattr(opt, "_gm_avg", True))
         if gm_k > 1:
@@ -850,6 +852,7 @@ class Executor:
                       if scaler is not None else None)
         key = ("train", id(prog), id(loss_sym), id(opt), apply_update,
                gm_k, gm_avg, scaler_key,
+               id(dp_mesh) if dp_mesh is not None else None,
                tuple(id(n) for n in ck_nodes),
                tuple(id(s) for s in syms), tuple(feed_names),
                tuple((a.shape, str(a.dtype)) for a in feed_arrays))
@@ -952,7 +955,43 @@ class Executor:
                 return (fwd_vals, grads, new_params, new_states,
                         new_scaler_state, out_acc, out_nacc)
 
-            cached = self._cache_put(key, jax.jit(train_fn))
+            if dp_mesh is not None:
+                # static DATA-PARALLEL training: feeds shard over the dp
+                # axis, params/optimizer state stay replicated — GSPMD
+                # inserts the gradient all-reduce the reference's
+                # transpiled program carried as explicit c_allreduce ops
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                repl = NamedSharding(dp_mesh, PartitionSpec())
+                dp = int(dp_mesh.shape["dp"])
+                feed_sh = []
+                for name, a in zip(feed_names, feed_arrays):
+                    ph = prog.placeholders.get(name)
+                    orig = getattr(getattr(ph, "_data", None),
+                                   "orig_shape", None)
+                    # only BATCH feeds shard over dp — identified by a
+                    # dynamic (None/-1) declared leading dim; fixed-shape
+                    # auxiliaries (class weights, masks) replicate
+                    batch_like = (a.ndim >= 1 and orig is not None
+                                  and len(orig) >= 1 and orig[0] is None)
+                    if not batch_like:
+                        feed_sh.append(repl)
+                    elif a.shape[0] % dp == 0:
+                        feed_sh.append(
+                            NamedSharding(dp_mesh, PartitionSpec("dp")))
+                    else:
+                        raise StaticGraphError(
+                            f"static dp training: batch feed {name!r} "
+                            f"leading dim {a.shape[0]} is not divisible "
+                            f"by dp={dp}")
+                # leading args: params, opt_states, lr, scaler_state,
+                # acc, nacc — all replicated
+                cached = self._cache_put(key, jax.jit(
+                    train_fn,
+                    in_shardings=(repl,) * 6 + tuple(feed_sh),
+                    out_shardings=repl))
+            else:
+                cached = self._cache_put(key, jax.jit(train_fn))
         param_arrays = [p._data for p in params]
         opt_states = ([opt._accumulators[id(p)] for p in params]
                       if opt is not None else [])
