@@ -651,6 +651,18 @@ def register_minimize(optimizer, loss, parameters=None, no_grad_set=None):
     return None, pairs
 
 
+def _dp_global(a, mesh, n_devices, spec):
+    """Assemble a host-local value into a global array over `mesh` with
+    `spec` (multi-process static-dp); pass through values that are
+    already global on all of the mesh's devices."""
+    if isinstance(a, jax.Array) and len(a.devices()) == n_devices:
+        return a
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.host_local_array_to_global_array(
+        np.asarray(a), mesh, spec)
+
+
 def _scaler_next(state, finite, cfg):
     """Dynamic loss-scale bookkeeping (ref OptimizerWithMixedPrecision /
     update_loss_scaling op semantics): grow the scale after
@@ -731,7 +743,11 @@ class Executor:
                     "fetch_list entries must be static-program Tensors")
             syms.append(f._data)
         feed_names = sorted(feed)
-        feed_arrays = [jnp.asarray(np.asarray(feed[k])) for k in feed_names]
+        # feeds stay HOST arrays until the compiled program consumes them
+        # (jit transfers per its in_shardings) — committing to a device
+        # here would force multi-process dp to round-trip them back
+        # through the host for global assembly
+        feed_arrays = [np.asarray(feed[k]) for k in feed_names]
         train_op = getattr(prog, "_train_op", None)
         grad_syms = [s for s in syms if isinstance(s, _GradSym)]
         if train_op is not None or grad_syms:
@@ -828,6 +844,52 @@ class Executor:
                   if opt is not None else None)
         dp_mesh = (getattr(opt, "_static_dp_mesh", None)
                    if opt is not None else None)
+        dp_batch_like, dp_multi, _dp_nd = None, False, 0
+        if dp_mesh is not None:
+            dp = int(dp_mesh.shape["dp"])
+            _dp_nd = dp_mesh.devices.size
+            dp_batch_like = []
+            for name, a in zip(feed_names, feed_arrays):
+                ph = prog.placeholders.get(name)
+                orig = getattr(getattr(ph, "_data", None),
+                               "orig_shape", None)
+                # only BATCH feeds shard over dp — identified by a
+                # dynamic (None/-1) declared leading dim; fixed-shape
+                # auxiliaries (class weights, masks) replicate
+                dp_batch_like.append(
+                    a.ndim >= 1 and orig is not None
+                    and len(orig) >= 1 and orig[0] is None)
+            dp_multi = any(d.process_index != jax.process_index()
+                           for d in dp_mesh.devices.flat)
+            if dp_multi:
+                # multi-process: each trainer feeds ITS OWN batch shard
+                # (the reference's per-trainer dp feeding); assemble the
+                # global arrays the SPMD program consumes
+                from jax.sharding import PartitionSpec as _PS
+
+                local_n = max(1, sum(
+                    1 for d in dp_mesh.devices.flat
+                    if d.process_index == jax.process_index()))
+                for name, a, bl in zip(feed_names, feed_arrays,
+                                       dp_batch_like):
+                    if bl and a.shape[0] % local_n:
+                        raise StaticGraphError(
+                            f"static dp training: this process's batch "
+                            f"shard for feed {name!r} has leading dim "
+                            f"{a.shape[0]}, not divisible by its "
+                            f"{local_n} local dp devices")
+                feed_arrays = [
+                    _dp_global(a, dp_mesh, _dp_nd,
+                               _PS("dp") if bl else _PS())
+                    for a, bl in zip(feed_arrays, dp_batch_like)]
+            else:
+                for name, a, bl in zip(feed_names, feed_arrays,
+                                       dp_batch_like):
+                    if bl and a.shape[0] % dp:
+                        raise StaticGraphError(
+                            f"static dp training: batch feed {name!r} "
+                            f"leading dim {a.shape[0]} is not divisible "
+                            f"by dp={dp}")
         gm_k = int(getattr(opt, "_gm_k", 1) or 1) if opt is not None else 1
         gm_avg = bool(getattr(opt, "_gm_avg", True))
         if gm_k > 1:
@@ -963,27 +1025,9 @@ class Executor:
                 from jax.sharding import NamedSharding, PartitionSpec
 
                 repl = NamedSharding(dp_mesh, PartitionSpec())
-                dp = int(dp_mesh.shape["dp"])
-                feed_sh = []
-                for name, a in zip(feed_names, feed_arrays):
-                    ph = prog.placeholders.get(name)
-                    orig = getattr(getattr(ph, "_data", None),
-                                   "orig_shape", None)
-                    # only BATCH feeds shard over dp — identified by a
-                    # dynamic (None/-1) declared leading dim; fixed-shape
-                    # auxiliaries (class weights, masks) replicate
-                    batch_like = (a.ndim >= 1 and orig is not None
-                                  and len(orig) >= 1 and orig[0] is None)
-                    if not batch_like:
-                        feed_sh.append(repl)
-                    elif a.shape[0] % dp == 0:
-                        feed_sh.append(
-                            NamedSharding(dp_mesh, PartitionSpec("dp")))
-                    else:
-                        raise StaticGraphError(
-                            f"static dp training: batch feed {name!r} "
-                            f"leading dim {a.shape[0]} is not divisible "
-                            f"by dp={dp}")
+                feed_sh = [
+                    NamedSharding(dp_mesh, PartitionSpec("dp")) if bl
+                    else repl for bl in dp_batch_like]
                 # leading args: params, opt_states, lr, scaler_state,
                 # acc, nacc — all replicated
                 cached = self._cache_put(key, jax.jit(
@@ -1001,6 +1045,34 @@ class Executor:
         acc = list(opt._gm_buffers) if gm_k > 1 else []
         nacc = (opt._gm_nacc if gm_k > 1
                 else jnp.zeros((), jnp.int32))
+        if dp_multi:
+            # first call: per-process state arrays (identical across
+            # processes by seeded construction) become global replicated
+            # arrays; later calls see the jit outputs, already global.
+            # The converted arrays are STASHED BACK so non-apply
+            # gradient-merge micro-steps don't re-round-trip the whole
+            # model through host memory every step.
+            from jax.sharding import PartitionSpec as _PS
+
+            def g(a):
+                return _dp_global(a, dp_mesh, _dp_nd, _PS())
+
+            param_arrays = [g(a) for a in param_arrays]
+            opt_states = jax.tree.map(g, opt_states)
+            lr = g(lr)
+            scaler_state = jax.tree.map(g, scaler_state)
+            acc = [g(a) for a in acc]
+            nacc = g(nacc)
+            for p, ga in zip(params, param_arrays):
+                p._data = ga
+            if opt is not None:
+                for p, st in zip(params, opt_states):
+                    opt._accumulators[id(p)] = st
+                if gm_k > 1:
+                    opt._gm_buffers = list(acc)
+                    opt._gm_nacc = nacc
+            if scaler is not None:
+                scaler["state"] = dict(scaler_state)
         (fwd_vals, grads, new_params, new_states, new_scaler_state,
          new_acc, new_nacc) = cached(param_arrays, opt_states, lr,
                                      scaler_state, acc, nacc, *feed_arrays)

@@ -1,0 +1,64 @@
+"""Companion: STATIC-GRAPH data-parallel training across two real
+processes (the reference's fleet static path, SURVEY.md §3.3/§3.5):
+each trainer builds the same recorded-DAG program (seeded identically),
+feeds ITS OWN batch shard to Executor.run, and the executor assembles
+the global sharded feed — GSPMD's grad allreduce keeps the replicated
+parameters identical across processes. MP_SERIAL=1 runs the identical
+program single-process on the full batch."""
+
+import os
+
+SERIAL = os.environ.get("MP_SERIAL") == "1"
+os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count="
+                           + ("8" if SERIAL else "4"))
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.static as static
+from paddle_tpu.distributed import fleet
+
+
+def main():
+    if not SERIAL:
+        dist.init_parallel_env()
+        assert len(jax.local_devices()) == 4
+    assert jax.device_count() == 8, jax.device_count()
+    dist.create_hybrid_communicate_group(dp=8)
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(32, 8).astype(np.float32)
+    Y = X.sum(-1, keepdims=True).astype(np.float32)
+    rank = 0 if SERIAL else dist.get_rank()
+    n_proc = 1 if SERIAL else int(os.environ["PADDLE_TRAINERS_NUM"])
+    share = 32 // n_proc
+    lo, hi = rank * share, (rank + 1) * share
+
+    paddle.enable_static()
+    with static.program_guard(static.Program()):
+        paddle.seed(0)          # same init on every process
+        x = static.data("x", [None, 8], "float32")
+        y = static.data("y", [None, 1], "float32")
+        h = paddle.nn.functional.relu(static.nn.fc(x, 16))
+        loss = paddle.mean((static.nn.fc(h, 1) - y) ** 2)
+        opt = fleet.distributed_optimizer(
+            paddle.optimizer.Adam(learning_rate=0.05),
+            strategy=fleet.DistributedStrategy())
+        opt.minimize(loss)
+        assert opt._static_dp_mesh is not None
+        exe = static.Executor()
+        losses = []
+        for _ in range(4):
+            (lv,) = exe.run(feed={"x": X[lo:hi], "y": Y[lo:hi]},
+                            fetch_list=[loss])
+            losses.append(round(float(lv), 6))
+    paddle.disable_static()
+    print("MP_LOSSES", rank, losses, flush=True)
+
+
+if __name__ == "__main__":
+    main()

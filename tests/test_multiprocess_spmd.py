@@ -123,6 +123,13 @@ class TestMultiProcessSPMD:
         cross the process boundary."""
         _check("mp_pp_1f1b_tied.py", 12623, "MP_1F1B_TIED_LOSSES")
 
+    def test_two_process_static_dp_matches_serial(self):
+        """late r4: STATIC-GRAPH dp training across processes — each
+        trainer feeds its own batch shard to Executor.run (reference
+        per-trainer dp feeding); the executor assembles the global
+        sharded feed and GSPMD's grad allreduce crosses the boundary."""
+        _check("mp_static_dp_train.py", 12651, "MP_LOSSES")
+
     def test_four_process_dp_pp_matches_serial(self):
         """nnodes=4 rendezvous (VERDICT r2 item 8): dp=2 x pp=2 with ONE
         device per process — every collective edge crosses a process
