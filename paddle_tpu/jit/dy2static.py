@@ -42,7 +42,9 @@ import textwrap
 import types
 
 __all__ = ["convert_to_static", "convert_ifelse", "convert_while",
-           "convert_for_range", "UndefinedVar", "UNDEF"]
+           "convert_for_range", "convert_logical_and",
+           "convert_logical_or", "convert_logical_not",
+           "UndefinedVar", "UNDEF"]
 
 
 class UndefinedVar:
@@ -254,6 +256,50 @@ def convert_while(cond_fn, body_fn, vals, names):
         if isinstance(final[i], UndefinedVar):
             final[i] = UndefinedVar(names[i])
     return tuple(final)
+
+
+def convert_logical_and(lx, ly):
+    """`a and b` inside a converted statement's predicate (ref
+    convert_logical_and): Python short-circuit semantics for concrete
+    values; traced values evaluate BOTH sides and stage logical_and
+    (the reference's behavior — no short-circuit once staged)."""
+    x = lx()
+    if isinstance(x, UndefinedVar):
+        x._boom()
+    if not _is_traced(x):
+        if not x:
+            return x
+        return ly()
+    y = ly()
+    from ..tensor.logic import logical_and
+
+    return logical_and(_to_carry(x, "<and-lhs>").astype("bool"),
+                       _to_carry(y, "<and-rhs>").astype("bool"))
+
+
+def convert_logical_or(lx, ly):
+    x = lx()
+    if isinstance(x, UndefinedVar):
+        x._boom()
+    if not _is_traced(x):
+        if x:
+            return x
+        return ly()
+    y = ly()
+    from ..tensor.logic import logical_or
+
+    return logical_or(_to_carry(x, "<or-lhs>").astype("bool"),
+                      _to_carry(y, "<or-rhs>").astype("bool"))
+
+
+def convert_logical_not(x):
+    if isinstance(x, UndefinedVar):
+        x._boom()
+    if not _is_traced(x):
+        return not x
+    from ..tensor.logic import logical_not
+
+    return logical_not(_to_carry(x, "<not-operand>").astype("bool"))
 
 
 def convert_for_range(range_args, body_fn, vals, names,
@@ -531,6 +577,71 @@ def _guarded_reads(names, prefix):
     return stmts
 
 
+def _lam(expr):
+    return ast.Lambda(
+        args=ast.arguments(posonlyargs=[], args=[], vararg=None,
+                           kwonlyargs=[], kw_defaults=[], kwarg=None,
+                           defaults=[]),
+        body=expr)
+
+
+def _helper_call(name, args):
+    return ast.Call(
+        func=ast.Attribute(value=_load(_HELPER), attr=name,
+                           ctx=ast.Load()),
+        args=args, keywords=[])
+
+
+class _PredicateTransformer(ast.NodeTransformer):
+    """Rewrites `and`/`or`/`not` and chained comparisons INSIDE a
+    converted statement's test expression into lazy helper calls, so
+    traced operands stage (logical_and/or/not) instead of tripping
+    Python's bool() — the reference's convert_logical_* rewrite.
+    Short-circuit behavior is preserved for concrete values; a CHAINED
+    comparison's middle operands may evaluate twice (lite scope). Apply
+    via `transform`, which skips tests containing walrus bindings (the
+    lambda wrap would capture `:=` in its own scope, hiding the name
+    from the branch body)."""
+
+    @classmethod
+    def transform(cls, test):
+        if any(isinstance(s, ast.NamedExpr) for s in ast.walk(test)):
+            return test
+        return cls().visit(test)
+
+    def visit_Lambda(self, node):
+        return node
+
+    def visit_BoolOp(self, node):
+        node = self.generic_visit(node)
+        name = ("convert_logical_and" if isinstance(node.op, ast.And)
+                else "convert_logical_or")
+        out = node.values[-1]
+        for v in reversed(node.values[:-1]):
+            out = _helper_call(name, [_lam(v), _lam(out)])
+        return out
+
+    def visit_UnaryOp(self, node):
+        node = self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return _helper_call("convert_logical_not", [node.operand])
+        return node
+
+    def visit_Compare(self, node):
+        node = self.generic_visit(node)
+        if len(node.ops) == 1:
+            return node
+        left, pairs = node.left, []
+        for op, comp in zip(node.ops, node.comparators):
+            pairs.append(ast.Compare(left=left, ops=[op],
+                                     comparators=[comp]))
+            left = comp
+        out = pairs[-1]
+        for p in reversed(pairs[:-1]):
+            out = _helper_call("convert_logical_and", [_lam(p), _lam(out)])
+        return out
+
+
 class _Dy2StaticTransformer(ast.NodeTransformer):
     def __init__(self):
         self.counter = 0
@@ -568,6 +679,7 @@ class _Dy2StaticTransformer(ast.NodeTransformer):
         node = self.generic_visit(node)
         if not _convertible(node):
             return node
+        node.test = _PredicateTransformer.transform(node.test)
         k = self.counter = self.counter + 1
         names = _assigned_names(node.body + node.orelse)
         tname, fname = f"__jst_t{k}", f"__jst_f{k}"
@@ -639,6 +751,7 @@ class _Dy2StaticTransformer(ast.NodeTransformer):
         node = self.generic_visit(node)
         if node.orelse or not _convertible(node):
             return node  # while/else stays Python
+        node.test = _PredicateTransformer.transform(node.test)
         k = self.counter = self.counter + 1
         names = _assigned_names(node.body)
         cname, bname = f"__jst_c{k}", f"__jst_b{k}"

@@ -168,6 +168,81 @@ class TestStagedUnderJit:
                                    [3.0])
 
 
+class TestBooleanPredicates:
+    """and/or/not and chained comparisons inside converted predicates
+    rewrite to logical_and/or/not (ref convert_logical_*): traced
+    operands stage, concrete values keep short-circuit semantics."""
+
+    def test_and_or_not_stage_one_program(self):
+        def f(x, y):
+            if paddle.sum(x) > 0 and paddle.sum(y) > 0:
+                out = x + y
+            elif paddle.sum(x) > 0 or not (paddle.sum(y) > -10.0):
+                out = x - y
+            else:
+                out = x * 0.0
+            return out
+
+        def ref(xv, yv):
+            if xv.sum() > 0 and yv.sum() > 0:
+                return xv + yv
+            if xv.sum() > 0 or not (yv.sum() > -10.0):
+                return xv - yv
+            return xv * 0.0
+
+        sf = paddle.jit.to_static(f)
+        for xv, yv in ([1.0, 2.0], [3.0, 4.0]), ([1.0, 2.0], [-9.0, -9.0]), \
+                ([-1.0, -2.0], [-20.0, -20.0]), ([-1.0, -2.0], [1.0, 1.0]):
+            xa = np.array(xv, np.float32)
+            ya = np.array(yv, np.float32)
+            np.testing.assert_allclose(sf(_t(xa), _t(ya)).numpy(),
+                                       ref(xa, ya), rtol=1e-6)
+        assert len(sf._cache) == 1
+
+    def test_chained_comparison_in_while(self):
+        def g(x):
+            i = paddle.zeros([])
+            s = paddle.zeros([])
+            while 0.0 <= i < 4.0:
+                s = s + x
+                i = i + 1.0
+            return s
+
+        sg = paddle.jit.to_static(g)
+        assert float(sg(_t(2.0)).numpy()) == 8.0
+
+    def test_walrus_in_predicate_keeps_python_semantics(self):
+        """A `:=` binding in the test must stay visible to the branch
+        body (regression: the lambda wrap once hid it)."""
+        def f(x, flag=True):
+            if flag and (n := 5) > 0:
+                y = x + n
+            else:
+                y = x
+            return y
+
+        conv = convert_to_static(f)
+        np.testing.assert_allclose(conv(_t(1.0)).numpy(), 6.0)
+
+    def test_concrete_short_circuit_preserved(self):
+        calls = []
+
+        def probe():
+            calls.append(1)
+            return True
+
+        def h(x, flag=False):
+            if flag and probe():
+                y = x + 1.0
+            else:
+                y = x
+            return y
+
+        conv = convert_to_static(h)
+        conv(_t(1.0))
+        assert calls == []             # rhs never evaluated
+
+
 class TestForRange:
     def test_concrete_range_unrolls_with_target_after_loop(self):
         def g(x):
