@@ -114,7 +114,8 @@ def _kernel_checks(perturb=None):
     # kernel path — global-lse flash bwd with rotating accumulators — runs
     # on real silicon; multi-device parity is covered on the CPU mesh)
     from jax.sharding import Mesh
-    from jax.experimental.shard_map import shard_map
+    from paddle_tpu.distributed.shard_map_compat import (
+        NO_CHECK as sm_kw, shard_map)
     from jax.sharding import PartitionSpec as P
     from paddle_tpu.distributed.ring_attention import ring_flash_attention_arrays
     mesh = Mesh(np.array(jax.devices()[:1]), ("sep",))
@@ -125,7 +126,7 @@ def _kernel_checks(perturb=None):
             lambda a, b, c: ring_flash_attention_arrays(
                 a, b, c, causal=True, axis_name="sep", interpret=interp),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-            check_rep=False)
+            **sm_kw)
         return (f(q, k, v).astype(jnp.float32) ** 2).sum()
 
     ring_val_and_grads = jax.value_and_grad(ring_loss, argnums=(0, 1, 2))(q, k, v)

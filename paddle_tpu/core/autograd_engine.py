@@ -207,8 +207,25 @@ def _backward_tensors(loss: Tensor, grad_tensor, targets):
                     accum_target(targets[oid], c)
             cots.append(c)
         bw = _make_replay_bw(node)
-        in_cots = _op_call.apply(bw, *(list(node.inputs) + cots),
-                                 _op_name=bw.__name__)
+        # replay must linearize at the FORWARD-time arrays: an input whose
+        # ._data was rebound between forward and backward (in-place style)
+        # is temporarily restored around the recorded bw apply, so the
+        # linearization point matches the create_graph=False saved vjp
+        # (advisor r4). Tracer-valued data stays — under an outer trace
+        # the symbolic flow is the correct value.
+        swapped = []
+        if node.in_data is not None:
+            for t, s in zip(node.inputs, node.in_data):
+                if t._data is not s \
+                        and not isinstance(t._data, jax.core.Tracer):
+                    swapped.append((t, t._data))
+                    t._data = s
+        try:
+            in_cots = _op_call.apply(bw, *(list(node.inputs) + cots),
+                                     _op_name=bw.__name__)
+        finally:
+            for t, d in swapped:
+                t._data = d
         if not isinstance(in_cots, (tuple, list)):
             in_cots = (in_cots,)
         for t, g in zip(node.inputs, in_cots):

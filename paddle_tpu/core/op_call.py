@@ -110,7 +110,8 @@ def apply(fn, *args, _op_name: str = "", **kwargs):
             full[i] = diff_arrays[j]
         return fn(*full, **kwargs)
 
-    out_data, vjp_fn = jax.vjp(primal, *(args[i]._data for i in diff_idx))
+    diff_data = [args[i]._data for i in diff_idx]
+    out_data, vjp_fn = jax.vjp(primal, *diff_data)
     outs, structure = _flatten_out(out_data)
     out_tensors = [Tensor(o, stop_gradient=not _is_float(o.dtype)) for o in outs]
     diff_tensors = [args[i] for i in diff_idx]
@@ -121,6 +122,7 @@ def apply(fn, *args, _op_name: str = "", **kwargs):
             _VjpAdapter(vjp_fn, [jax.typeof(o) for o in outs]),
             name=_op_name or getattr(fn, "__name__", "op"),
             replay=primal,
+            in_data=diff_data,
         )
     return _unflatten_out(out_tensors, structure)
 

@@ -24,11 +24,11 @@ class TapeNode:
 
     __slots__ = (
         "inputs", "out_ids", "out_meta", "vjp_fn", "n_outputs", "idx", "name",
-        "alive_outputs", "replay",
+        "alive_outputs", "replay", "in_data",
     )
 
     def __init__(self, inputs, out_ids, out_meta, vjp_fn, n_outputs, idx,
-                 name="", replay=None):
+                 name="", replay=None, in_data=None):
         self.inputs = inputs        # list[Tensor] (held strongly until the node is freed)
         self.out_ids = out_ids      # list[int] ids of output Tensors
         self.out_meta = out_meta    # list[(shape, dtype)] per output, for zero cotangents
@@ -42,6 +42,11 @@ class TapeNode:
         # this node's vjp AS A RECORDED OP of (inputs, cotangents), so the
         # produced gradients are themselves differentiable
         self.replay = replay
+        # forward-time input arrays: replay must linearize at THESE, not at
+        # whatever the input Tensors' ._data holds at backward time (an
+        # in-place-style rebind between forward and backward would silently
+        # shift the linearization point — advisor r4)
+        self.in_data = in_data
 
     def _output_died(self):
         self.alive_outputs -= 1
@@ -63,7 +68,8 @@ class Tape:
         self.nodes = []
         self._counter = 0
 
-    def record(self, inputs, outputs, vjp_fn, name="", replay=None):
+    def record(self, inputs, outputs, vjp_fn, name="", replay=None,
+               in_data=None):
         node = TapeNode(
             inputs=list(inputs),
             out_ids=[id(o) for o in outputs],
@@ -73,6 +79,7 @@ class Tape:
             idx=self._counter,
             name=name,
             replay=replay,
+            in_data=in_data,
         )
         self._counter += 1
         self.nodes.append(node)

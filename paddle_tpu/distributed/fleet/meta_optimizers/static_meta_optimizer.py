@@ -64,6 +64,11 @@ def _amp_cast_fn(fn, jd):
         return fn(*amp_cast_arrays(arrays, jd), **kw)
 
     wrapped._amp_static = jd
+    # keep the ORIGINAL fn reachable so a re-rewrite with a different
+    # dtype rewraps that, instead of stacking casts where the stale inner
+    # one runs last and silently wins (advisor r4); a dedicated attribute
+    # avoids colliding with functools.wraps' __wrapped__ on op fns
+    wrapped._amp_orig = getattr(fn, "_amp_orig", fn)
     wrapped.__name__ = getattr(fn, "__name__", "op")
     return wrapped
 
@@ -92,7 +97,9 @@ def amp_rewrite(loss, dtype, level="O1", custom_white=(), custom_black=()):
             continue
         if getattr(node.fn, "_amp_static", None) == jd:
             continue
-        node.fn = _amp_cast_fn(node.fn, jd)
+        # rewrap the original fn, not the wrapper: re-minimizing with a
+        # different amp dtype must REPLACE the cast, not stack a second
+        node.fn = _amp_cast_fn(getattr(node.fn, "_amp_orig", node.fn), jd)
         n_rewritten += 1
     return n_rewritten
 

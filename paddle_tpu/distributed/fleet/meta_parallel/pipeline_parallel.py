@@ -62,10 +62,7 @@ from ... import collective_ctx
 from ...topology import get_hybrid_communicate_group
 from .parallel_layers.pp_layers import PipelineLayer
 
-try:
-    from jax import shard_map
-except ImportError:  # older jax layout
-    from jax.experimental.shard_map import shard_map
+from ...shard_map_compat import NO_CHECK as _SM_NO_CHECK, shard_map
 
 
 @jax.custom_vjp
@@ -272,7 +269,7 @@ class PipelineParallel(Layer):
             f = shard_map(
                 spmd, mesh=mesh,
                 in_specs=(batch_spec, batch_spec, P()) + param_specs,
-                out_specs=P(), check_vma=False)
+                out_specs=P(), **_SM_NO_CHECK)
             return f(x_mbs, y_mbs, base_key, *params)
 
         self._pp_fn_cache[n_micro] = (pure, names)
@@ -378,7 +375,7 @@ class PipelineParallel(Layer):
             f = shard_map(
                 spmd, mesh=mesh,
                 in_specs=(batch_spec, batch_spec, P()) + param_specs,
-                out_specs=P(), check_vma=False)
+                out_specs=P(), **_SM_NO_CHECK)
             return f(x_mbs, y_mbs, base_key, *params)
 
         self._pp_fn_cache[key] = (pure, names)
@@ -832,7 +829,7 @@ class PipelineParallel(Layer):
             f = shard_map(
                 spmd, mesh=mesh,
                 in_specs=(batch_spec, batch_spec, P()) + param_specs,
-                out_specs=(P(), param_specs), check_vma=False)
+                out_specs=(P(), param_specs), **_SM_NO_CHECK)
             return f(x_mbs, y_mbs, base_key, *params)
 
         from jax.dtypes import float0

@@ -1,0 +1,20 @@
+"""One shared jax.shard_map compatibility shim.
+
+jax 0.8 moved shard_map out of jax.experimental and renamed the
+replication-check kwarg (check_rep -> check_vma). Every caller that wants
+to keep working across that boundary imports the pair from here instead of
+re-implementing the try/except — the kwarg MUST match the import taken
+(the legacy API rejects check_vma and vice versa).
+"""
+
+try:
+    from jax import shard_map
+
+    #: kwargs disabling the output-replication check, matching the import
+    NO_CHECK = {"check_vma": False}
+except ImportError:  # older jax layout (and its older kwarg name)
+    from jax.experimental.shard_map import shard_map
+
+    NO_CHECK = {"check_rep": False}
+
+__all__ = ["shard_map", "NO_CHECK"]

@@ -100,15 +100,24 @@ class StaticFunction:
                 else:
                     out = fn(*args, **kwargs)
                     new_state = []
+            # trace-time mutation detection: a state entry the forward
+            # leaves alone is the SAME tracer object it was handed — only
+            # genuinely rewritten entries need writing back at call time
+            mutated = tuple(i for i, (n, s)
+                            in enumerate(zip(new_state, state_arrays))
+                            if n is not s)
             out_flat, out_tree = _flatten_out(out)
-            return tuple(o._data if isinstance(o, Tensor) else o for o in out_flat), tuple(new_state), out_tree
+            return (tuple(o._data if isinstance(o, Tensor) else o for o in out_flat),
+                    tuple(new_state), out_tree, mutated)
 
-        # out_tree is trace-time static; capture via cell
+        # out_tree / mutation set are trace-time static; capture via cell
         out_tree_box = {}
 
         def jittable(rng_key, state_arrays, *flat_arrays):
-            outs, new_state, out_tree = array_fn(rng_key, state_arrays, *flat_arrays)
+            outs, new_state, out_tree, mutated = array_fn(
+                rng_key, state_arrays, *flat_arrays)
             out_tree_box["tree"] = out_tree
+            out_tree_box["mutated"] = mutated
             return outs, new_state
 
         return jax.jit(jittable), out_tree_box, state_names
@@ -165,10 +174,14 @@ class StaticFunction:
                 res = (res,)
             n_out = len(res) - len(state_names)
             out_tensors = list(res[:n_out])
-            for t, new in zip(state_tensors, res[n_out:]):
-                if t.stop_gradient:
-                    # buffers (BN stats, ...) update in place; params keep
-                    # their arrays (the forward doesn't change them)
+            mutated = set(out_tree_box.get("mutated", ()))
+            for si, (t, new) in enumerate(zip(state_tensors, res[n_out:])):
+                if t.stop_gradient or si in mutated:
+                    # buffers (BN stats, ...) update in place; params write
+                    # back ONLY when the traced forward actually rewrote
+                    # them (advisor r4: dropping a param mutation here
+                    # diverged from the no-grad path). Grads still flow
+                    # w.r.t. the forward-time values.
                     t._data = new._data
             return _unflatten_tree(out_tree_box["tree"], out_tensors)
 

@@ -834,27 +834,63 @@ def _convert_uncached(fn):
     if not tf.converted_any:
         return None
     ast.fix_missing_locations(tree)
+    # closure cells: rebuild real cells by wrapping the converted def in a
+    # factory whose parameters are the (bound) freevars — values snapshot
+    # at conversion time (documented lite-scope trade-off), but the names
+    # never leak into module globals. Empty cells (e.g. recursive defs)
+    # stay out of the factory so those names fall through to live globals.
+    cell_vals = {}
+    if fn.__closure__:
+        for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+            try:
+                cell_vals[name] = cell.cell_contents
+            except ValueError:          # empty cell (e.g. recursive def)
+                pass
+    factory_name = f"__jst_factory_{fn.__name__}"
+    use_factory = bool(cell_vals) or fn.__name__ in fn.__code__.co_freevars
+    if use_factory:
+        # the def itself rebinds fn.__name__ in the factory scope, so a
+        # SELF-RECURSIVE nested function (own name = empty cell at
+        # decoration time, excluded from the args) resolves to the
+        # converted function — like the pre-factory exec namespace did
+        factory = ast.FunctionDef(
+            name=factory_name,
+            args=ast.arguments(
+                posonlyargs=[],
+                args=[ast.arg(arg=n) for n in cell_vals],
+                vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+                defaults=[]),
+            body=[fdef, ast.Return(value=_load(fdef.name))],
+            decorator_list=[], returns=None, type_params=[])
+        tree.body[0] = factory
+        ast.fix_missing_locations(tree)
     try:
         code = compile(tree, f"<dy2static {fn.__name__}>", "exec")
     except (SyntaxError, ValueError):
         return None
     import sys
 
-    namespace = dict(fn.__globals__)
-    namespace[_HELPER] = sys.modules[__name__]
-    if fn.__closure__:
-        # closure cells are snapshotted into the namespace (late rebinding
-        # of enclosing locals is lost — documented lite-scope trade-off)
-        for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
-            try:
-                namespace[name] = cell.cell_contents
-            except ValueError:          # empty cell (e.g. recursive def)
-                pass
+    helper_mod = sys.modules[__name__]
+    # exec with globals = the ORIGINAL fn.__globals__ (so the converted
+    # function sees later-defined / rebound module globals live — it must
+    # behave like the unconverted function) and a separate locals dict so
+    # the def itself never clobbers the module's own bindings. Only the
+    # collision-proof `__jst` helper name is injected into live globals;
+    # if the module somehow defines `__jst` itself, fall back to an
+    # isolated snapshot copy rather than clobbering it.
+    glb = fn.__globals__
+    if _HELPER in glb and glb[_HELPER] is not helper_mod:
+        glb = dict(fn.__globals__)
+    glb[_HELPER] = helper_mod
+    local_ns = {}
     try:
-        exec(code, namespace)
+        exec(code, glb, local_ns)
+        if use_factory:
+            new_fn = local_ns[factory_name](**cell_vals)
+        else:
+            new_fn = local_ns.get(fn.__name__)
     except Exception:
         return None
-    new_fn = namespace.get(fn.__name__)
     if not inspect.isfunction(new_fn):
         return None
     new_fn.__defaults__ = fn.__defaults__

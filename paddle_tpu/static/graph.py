@@ -651,6 +651,19 @@ def register_minimize(optimizer, loss, parameters=None, no_grad_set=None):
     return None, pairs
 
 
+def _dp_local_count(mesh):
+    """Number of distinct DP-axis coordinates this process owns in a
+    (possibly hybrid) mesh. A process's batch shard splits over the dp
+    axis ONLY — counting all its devices would demand the wrong divisor
+    on a dp×mp mesh (advisor r4)."""
+    dp_ax = list(mesh.axis_names).index("dp")
+    by_dp = np.moveaxis(mesh.devices, dp_ax, 0)
+    return max(1, sum(
+        1 for i in range(by_dp.shape[0])
+        if any(d.process_index == jax.process_index()
+               for d in np.atleast_1d(by_dp[i]).flat)))
+
+
 def _dp_global(a, mesh, n_devices, spec):
     """Assemble a host-local value into a global array over `mesh` with
     `spec` (multi-process static-dp); pass through values that are
@@ -867,9 +880,7 @@ class Executor:
                 # global arrays the SPMD program consumes
                 from jax.sharding import PartitionSpec as _PS
 
-                local_n = max(1, sum(
-                    1 for d in dp_mesh.devices.flat
-                    if d.process_index == jax.process_index()))
+                local_n = _dp_local_count(dp_mesh)
                 for name, a, bl in zip(feed_names, feed_arrays,
                                        dp_batch_like):
                     if bl and a.shape[0] % local_n:

@@ -20,10 +20,8 @@ from jax.sharding import PartitionSpec as P
 import paddle_tpu as paddle
 import paddle_tpu.distributed as dist
 
-try:
-    from jax import shard_map
-except ImportError:
-    from jax.experimental.shard_map import shard_map
+from paddle_tpu.distributed.shard_map_compat import (
+    NO_CHECK as _SM_NO_CHECK, shard_map)
 
 
 def _feed_global(arr, mesh, spec, axis_len_local, rank):
@@ -58,7 +56,7 @@ def main():
     ring = shard_map(
         lambda a, b, c: ring_flash_attention_arrays(a, b, c, causal=True),
         mesh=hcg.mesh, in_specs=(P(None, "sep"),) * 3,
-        out_specs=P(None, "sep"), check_vma=False)
+        out_specs=P(None, "sep"), **_SM_NO_CHECK)
     out = ring(gq, gq, gq)
     ring_norm = round(float(jax.jit(
         lambda o: jnp.linalg.norm(o.astype(jnp.float32)))(out)), 4)
@@ -90,7 +88,7 @@ def main():
 
     moe_f = shard_map(moe_body, mesh=hcg2.mesh,
                       in_specs=(P("dp"),) + tuple(P() for _ in mparams),
-                      out_specs=P("dp"), check_vma=False)
+                      out_specs=P("dp"), **_SM_NO_CHECK)
     mout = moe_f(gt, *mparams)
     moe_norm = round(float(jax.jit(
         lambda o: jnp.linalg.norm(o.astype(jnp.float32)))(mout)), 4)

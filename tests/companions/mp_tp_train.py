@@ -16,10 +16,8 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np
 import jax.numpy as jnp
 
-try:
-    from jax import shard_map
-except ImportError:  # older jax layout
-    from jax.experimental.shard_map import shard_map
+from paddle_tpu.distributed.shard_map_compat import (
+    NO_CHECK as _SM_NO_CHECK, shard_map)
 from jax.sharding import PartitionSpec as P
 
 import paddle_tpu as paddle
@@ -67,7 +65,7 @@ def main():
 
     f = shard_map(step_body, mesh=hcg.mesh,
                   in_specs=(P(), P()) + tuple(specs),
-                  out_specs=(P(),) + tuple(specs), check_vma=False)
+                  out_specs=(P(),) + tuple(specs), **_SM_NO_CHECK)
     jf = jax.jit(f)
 
     rng = np.random.RandomState(0)

@@ -289,6 +289,20 @@ class TestHigherOrderGrad:
         expect = 2 * np.cos(xv) * np.exp(xv)   # (sin·exp)'' = 2cos·exp
         np.testing.assert_allclose(g2.numpy(), expect, rtol=1e-5)
 
+    def test_replay_linearizes_at_forward_time_values(self):
+        """create_graph replay must linearize at the FORWARD-time arrays:
+        rebinding an input's ._data between forward and backward (in-place
+        style) must not shift the derivative (advisor r4)."""
+        import numpy as np
+
+        import paddle_tpu as paddle
+
+        x = paddle.to_tensor(np.array(2.0, np.float32), stop_gradient=False)
+        y = x * x                         # dy/dx at x=2 -> 4
+        x.set_value(np.array(100.0, np.float32))
+        (g,) = paddle.grad([y], [x], create_graph=True)
+        assert float(g) == 4.0            # matches the create_graph=False path
+
     def test_pylayer_raises_informatively(self):
         import numpy as np
         import pytest

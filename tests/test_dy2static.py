@@ -500,3 +500,80 @@ class TestStaticProgramRecording:
             paddle.disable_static()
         np.testing.assert_allclose(pos, [[2.0, 4.0]])
         np.testing.assert_allclose(neg, [[-2.0, -3.0]])
+
+
+class TestLiveGlobals:
+    """Converted functions must see their module's globals LIVE (advisor
+    r4 high finding: exec into a snapshot copy made helpers defined after
+    decoration raise NameError, and rebinds were silently ignored)."""
+
+    def test_helper_defined_after_conversion(self):
+        g = globals()
+        assert "_defined_later_helper" not in g
+
+        def f(x):
+            if paddle.sum(x) > 0:
+                y = _defined_later_helper(x)
+            else:
+                y = x
+            return y
+
+        conv = convert_to_static(f)
+        assert conv.__dy2static_converted__
+        try:
+            g["_defined_later_helper"] = lambda t: t * 3.0
+            np.testing.assert_allclose(conv(_t([2.0])).numpy(), [6.0])
+        finally:
+            g.pop("_defined_later_helper", None)
+
+    def test_global_rebind_is_seen(self):
+        g = globals()
+        g["_rebindable_helper"] = lambda t: t + 1.0
+
+        def f(x):
+            if paddle.sum(x) > 0:
+                y = _rebindable_helper(x)
+            else:
+                y = x
+            return y
+
+        conv = convert_to_static(f)
+        try:
+            np.testing.assert_allclose(conv(_t([1.0])).numpy(), [2.0])
+            g["_rebindable_helper"] = lambda t: t + 100.0
+            np.testing.assert_allclose(conv(_t([1.0])).numpy(), [101.0])
+        finally:
+            g.pop("_rebindable_helper", None)
+
+    def test_module_namespace_not_polluted(self):
+        def f(x):
+            if paddle.sum(x) > 0:
+                y = x * 2.0
+            else:
+                y = x
+            return y
+
+        before = set(globals())
+        conv = convert_to_static(f)
+        conv(_t([1.0]))
+        leaked = set(globals()) - before - {"__jst"}
+        assert not leaked, f"conversion leaked globals: {leaked}"
+        # the exec'd def must not overwrite a module-level name
+        assert "f" not in globals()
+
+    def test_nested_self_recursive_function(self):
+        """A nested converted function that calls itself must resolve its
+        own name to the CONVERTED function (review r5: the exec-into-
+        locals change briefly broke this with a NameError)."""
+
+        def outer():
+            def g(x, n):
+                y = x
+                if n > 0:
+                    y = g(x * 2.0, n - 1)
+                return y
+            return convert_to_static(g)
+
+        conv = outer()
+        assert conv.__dy2static_converted__
+        np.testing.assert_allclose(conv(_t([1.0]), 2).numpy(), [4.0])

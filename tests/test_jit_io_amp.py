@@ -513,3 +513,49 @@ class TestOnnxExportAdapter:
                 layer(paddle.to_tensor(a), paddle.to_tensor(a)).numpy(),
                 m(paddle.to_tensor(a), paddle.to_tensor(a)).numpy(),
                 rtol=1e-5)
+
+
+class TestToStaticParamMutation:
+    def test_param_mutation_survives_grad_path(self):
+        """A traced forward that rewrites a parameter must have the update
+        applied on BOTH call paths — the no-grad one and the tape-enabled
+        one used during training (advisor r4: the grad path silently
+        dropped it)."""
+
+        class EmaLayer(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = nn.Linear(4, 4)
+                self.ema = self.create_parameter(
+                    [4, 4], default_initializer=nn.initializer.Constant(0.0))
+
+            def forward(self, x):
+                # parameter rewritten inside the forward (EMA-style)
+                self.ema.set_value(self.ema * 0.5 + self.lin.weight * 0.5)
+                return self.lin(x).sum()
+
+        paddle.seed(0)
+        m = EmaLayer()
+        sm = paddle.jit.to_static(m)
+        x = paddle.randn([2, 4])
+
+        # tape enabled + a differentiable input → the grad-aware path
+        loss = sm(x)
+        after_one = m.ema.numpy().copy()
+        assert np.abs(after_one).max() > 1e-6, \
+            "param mutation dropped on the grad-aware to_static path"
+        expect = after_one * 0.5 + m.lin.weight.numpy() * 0.5
+        loss2 = sm(x)
+        np.testing.assert_allclose(m.ema.numpy(), expect, rtol=1e-5)
+        # grads still flow to the ordinary parameters
+        loss2.backward()
+        assert m.lin.weight.grad is not None
+
+    def test_untouched_params_not_churned(self):
+        """States the forward does not touch keep their exact arrays on
+        the grad path (the writeback is trace-time mutation-gated)."""
+        lin = nn.Linear(4, 2)
+        sm = paddle.jit.to_static(lin)
+        w_arr = lin.weight._data
+        sm(paddle.randn([3, 4]))
+        assert lin.weight._data is w_arr

@@ -454,3 +454,59 @@ class TestLambSwap:
             ref_opt.step()
             ref_opt.clear_grad()
         np.testing.assert_allclose(losses, ref, rtol=2e-5, atol=1e-6)
+
+
+class TestAmpRewriteIdempotence:
+    def test_re_rewrite_with_new_dtype_replaces_cast(self, static_mode):
+        """Re-minimizing the same program under a DIFFERENT amp dtype must
+        replace the cast wrapper, not stack a second one where the stale
+        inner cast runs last and wins (advisor r4)."""
+        from paddle_tpu.distributed.fleet.meta_optimizers.static_meta_optimizer import (
+            amp_rewrite,
+        )
+        import jax.numpy as jnp
+
+        X, Y = _problem()
+        with static.program_guard(static.Program()):
+            x, y, h, loss = _mlp_program()
+            n1 = amp_rewrite(loss, "bfloat16")
+            assert n1 > 0
+            # same dtype again: true idempotence, nothing rewritten
+            assert amp_rewrite(loss, "bfloat16") == 0
+            # white-listed ops re-cast to fp16; black-listed keep their
+            # (identical) f32 cast and are skipped — so 0 < n2 <= n1
+            n2 = amp_rewrite(loss, "float16")
+            assert 0 < n2 <= n1
+            # every surviving wrapper is ONE level deep over the original
+            from paddle_tpu.distributed.fleet.meta_optimizers.static_meta_optimizer import (
+                _iter_nodes,
+            )
+            for node in _iter_nodes([loss._data]):
+                fn = node.fn
+                if getattr(fn, "_amp_static", None) is not None:
+                    assert fn._amp_static in (jnp.float16, jnp.float32)
+                    inner = fn._amp_orig
+                    assert getattr(inner, "_amp_static", None) is None
+            exe = static.Executor()
+            hv = exe.run(feed={"x": X, "y": Y}, fetch_list=[h],
+                         return_numpy=False)[0]
+        assert "float16" in str(hv.dtype) and "bfloat16" not in str(hv.dtype)
+
+
+class TestDpLocalCount:
+    def test_hybrid_mesh_counts_dp_axis_only(self):
+        """On a dp×mp mesh the per-process batch divisor is the number of
+        dp coordinates the process owns, NOT its total device count
+        (advisor r4: a dp4×mp2 mesh demanded divisibility by 8)."""
+        import jax
+        from jax.sharding import Mesh
+        from paddle_tpu.static.graph import _dp_local_count
+
+        devs = np.array(jax.devices()[:8])
+        assert devs.size == 8  # conftest forces the 8-device CPU mesh
+        mesh = Mesh(devs.reshape(4, 2), ("dp", "mp"))
+        assert _dp_local_count(mesh) == 4
+        mesh2 = Mesh(devs.reshape(2, 4), ("mp", "dp"))  # dp not leading
+        assert _dp_local_count(mesh2) == 4
+        mesh3 = Mesh(devs.reshape(8), ("dp",))
+        assert _dp_local_count(mesh3) == 8
