@@ -877,6 +877,39 @@ class PipelineParallel(Layer):
                 f"batch dim {arr.shape[0]} not divisible by accumulate_steps {n}")
         return Tensor(arr.reshape((n, arr.shape[0] // n) + arr.shape[1:]))
 
+    def _place_state_on_mesh(self, optimizer):
+        """Pre-place every model/optimizer state array onto the hybrid
+        mesh — mp-distributed params to their `_sharding_axes` spec, the
+        rest replicated — BEFORE the first compiled step. Host-created
+        single-device params would otherwise enter step 1 with shardings
+        that cannot alias the step's mesh-wide outputs: XLA silently
+        copies every donated state buffer (a model-sized transient HBM
+        spike at scale) and the sharding flip forces a second compile at
+        step 2 (VERDICT r4: dryrun donation warnings)."""
+        from jax.sharding import NamedSharding
+
+        mesh = self._hcg.mesh
+        mp_live = "mp" in mesh.shape and mesh.shape["mp"] > 1
+
+        def target(t):
+            axes = getattr(t, "_sharding_axes", None)
+            if mp_live and getattr(t, "is_distributed", False) and axes:
+                return NamedSharding(mesh, P(*axes))
+            return NamedSharding(mesh, P())
+
+        for t in self._layers.state_dict().values():
+            sh = getattr(t._data, "sharding", None)
+            want = target(t)
+            if sh != want:
+                t._data = jax.device_put(t._data, want)
+            if optimizer is not None:
+                st = optimizer._accumulators.get(id(t))
+                if st is not None:
+                    optimizer._accumulators[id(t)] = jax.tree.map(
+                        lambda a: jax.device_put(a, want)
+                        if jnp.ndim(a) and getattr(a, "sharding", None)
+                        != want else a, st)
+
     # ----------------------------------------------------------- API
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
         """ref train_batch: one full fwd+bwd+step over accumulate_steps
@@ -886,6 +919,7 @@ class PipelineParallel(Layer):
         if self._train_step is None:
             from ....jit.train_step import TrainStep
 
+            self._place_state_on_mesh(optimizer)
             self._train_step = TrainStep(
                 self._layers, self._loss_fn_for(self.accumulate_steps),
                 optimizer, scaler=scaler)

@@ -847,7 +847,14 @@ def _convert_uncached(fn):
             except ValueError:          # empty cell (e.g. recursive def)
                 pass
     factory_name = f"__jst_factory_{fn.__name__}"
-    use_factory = bool(cell_vals) or fn.__name__ in fn.__code__.co_freevars
+    # the factory is also needed whenever the body references the
+    # function's OWN name (self-recursion) — nested (freevar) or
+    # module-level (global load): the def inside the factory rebinds the
+    # name in factory scope, so the recursive call hits the CONVERTED
+    # function, as the old snapshot-namespace exec did
+    use_factory = (bool(cell_vals)
+                   or fn.__name__ in fn.__code__.co_freevars
+                   or fn.__name__ in fn.__code__.co_names)
     if use_factory:
         # the def itself rebinds fn.__name__ in the factory scope, so a
         # SELF-RECURSIVE nested function (own name = empty cell at

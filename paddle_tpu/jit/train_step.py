@@ -89,8 +89,54 @@ class TrainStep:
                 if sh is not None and len(sh.device_set) > 1:
                     self.auto_layout = False
                     break
-        self._jitted = jax.jit(self._make_step_fn(),
+        step_fn = self._make_step_fn()
+        # donated state buffers must exit with their ENTRY shardings or XLA
+        # silently copies instead of aliasing ("Some donated buffers were
+        # not usable" in the r4 dryrun tail — wasted HBM at scale): pin the
+        # state outputs to the current state shardings when multi-device
+        step_fn = self._constrain_state_outputs(step_fn)
+        self._jitted = jax.jit(step_fn,
                                donate_argnums=(0, 2) if self.donate else ())
+
+    _NOSH = object()          # "leave this leaf unconstrained" sentinel
+
+    def _constrain_state_outputs(self, step_fn):
+        from jax.sharding import NamedSharding
+
+        sd = self.model.state_dict()
+        opt = self.optimizer
+        nosh = TrainStep._NOSH
+
+        def sh_of(a):
+            s = getattr(a, "sharding", None)
+            return (s if isinstance(s, NamedSharding)
+                    and len(s.device_set) > 1 else nosh)
+
+        p_sh = [sh_of(sd[n]._data) for n in self._param_names]
+        b_sh = [sh_of(sd[n]._data) for n in self._buffer_names]
+        # params outside optimizer._parameter_list have no accumulator yet
+        # (same fallback _marshal uses)
+        o_sh = [jax.tree.map(
+                    sh_of, opt._accumulators.get(id(sd[n]))
+                    if id(sd[n]) in opt._accumulators
+                    else opt._state_for(sd[n]))
+                for n in self._param_names]
+        if all(s is nosh for s in p_sh + b_sh) and all(
+                s is nosh for st in o_sh for s in jax.tree.leaves(st)):
+            return step_fn          # single-device state: nothing to pin
+
+        def cst(a, s):
+            return a if s is nosh else jax.lax.with_sharding_constraint(a, s)
+
+        def constrained(pa, ba, os_, lr, key, ss, *batch):
+            np_, nb, nos, loss, nss, aux = step_fn(pa, ba, os_, lr, key,
+                                                   ss, *batch)
+            np_ = [cst(a, s) for a, s in zip(np_, p_sh)]
+            nb = [cst(a, s) for a, s in zip(nb, b_sh)]
+            nos = [jax.tree.map(cst, st, s) for st, s in zip(nos, o_sh)]
+            return np_, nb, nos, loss, nss, aux
+
+        return constrained
 
     def _run_auto(self, *args, _fn_factory=None, _key_tag=()):
         """AUTO-layout execution: jit with compiler-CHOSEN layouts for the
@@ -386,7 +432,7 @@ class TrainStep:
         rng_keys = jax.random.split(random_state.next_key(), k)
 
         def make_many_fn():
-            step_fn = self._make_step_fn()
+            step_fn = self._constrain_state_outputs(self._make_step_fn())
 
             def many_fn(pa, ba, os_, lr_, keys, ss, *stk):
                 def body(carry, xs):

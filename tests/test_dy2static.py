@@ -577,3 +577,24 @@ class TestLiveGlobals:
         conv = outer()
         assert conv.__dy2static_converted__
         np.testing.assert_allclose(conv(_t([1.0]), 2).numpy(), [4.0])
+
+    def test_module_level_self_recursion(self, tmp_path):
+        """A module-level converted function calling itself must hit the
+        CONVERTED function even when the module global still names the
+        original (review r5)."""
+        import importlib.util
+        mod_file = tmp_path / "selfrec_mod.py"
+        mod_file.write_text(
+            "import paddle_tpu as paddle\n"
+            "def mf(x, n):\n"
+            "    y = x\n"
+            "    if n > 0:\n"
+            "        y = mf(x * 2.0, n - 1)\n"
+            "    return y\n")
+        spec = importlib.util.spec_from_file_location("selfrec_mod",
+                                                      mod_file)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        conv = convert_to_static(mod.mf)
+        assert conv.__dy2static_converted__
+        np.testing.assert_allclose(conv(_t([1.0]), 2).numpy(), [4.0])

@@ -559,3 +559,26 @@ class TestToStaticParamMutation:
         w_arr = lin.weight._data
         sm(paddle.randn([3, 4]))
         assert lin.weight._data is w_arr
+
+    def test_optimizer_over_param_subset(self):
+        """TrainStep with an optimizer managing only SOME trainable params
+        must still build (review r5: the sharding-constraint pass did an
+        unguarded accumulator lookup)."""
+        class TwoPart(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.a = nn.Linear(4, 4)
+                self.b = nn.Linear(4, 1)
+
+            def forward(self, x):
+                return self.b(self.a(x))
+
+        paddle.seed(0)
+        m = TwoPart()
+        opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                    parameters=m.b.parameters())
+        step = paddle.jit.TrainStep(
+            m, lambda net, x, y: ((net(x) - y) ** 2).mean(), opt)
+        x = paddle.randn([8, 4]); y = paddle.randn([8, 1])
+        l1 = float(step(x, y)); l2 = float(step(x, y))
+        assert np.isfinite(l1) and np.isfinite(l2)
