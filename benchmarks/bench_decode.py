@@ -1,0 +1,311 @@
+#!/usr/bin/env python
+"""Autoregressive generation benchmark (VERDICT r4 item 7): prefill s512
++ 128 greedy decode steps through fused_multi_transformer with inline
+rotary and a fixed-capacity KV cache — the serving path the reference
+ships as AnalysisPredictor + fused CUDA decode ops (SURVEY §2.1 N19).
+
+Two decode drivers are measured:
+  * per-step: one jitted step per token, caches DONATED (in-place HBM
+    cache update) — the latency-interactive shape;
+  * scan128: all 128 steps as ONE lax.scan program (one dispatch) — the
+    TPU-native offline/serving shape; on a tunneled chip this is also
+    the dispatch-noise-free number.
+
+A numerics gate runs first ON THE BENCH DEVICE: fused cached decode must
+match the fused prefill of the concatenated sequence (self-consistency)
+AND the unfused dense composition (small config), so a kernel regression
+fails loudly before any timing. Prints one JSON line per metric; writes
+DECODE_BENCH.json at the repo root when run there.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _build_params(rng, L, dim, n_head, ffn, dtype):
+    import jax.numpy as jnp
+
+    hd = dim // n_head
+
+    def mk(*sh):
+        return jnp.asarray((rng.randn(*sh) * 0.02).astype(np.float32),
+                           dtype)
+
+    return dict(
+        ln_scales=[mk(dim) + 1 for _ in range(L)],
+        ln_biases=[mk(dim) for _ in range(L)],
+        qkv_weights=[mk(3, n_head, hd, dim) for _ in range(L)],
+        qkv_biases=[mk(3 * n_head * hd) for _ in range(L)],
+        linear_weights=[mk(dim, dim) for _ in range(L)],
+        linear_biases=[mk(dim) for _ in range(L)],
+        ffn_ln_scales=[mk(dim) + 1 for _ in range(L)],
+        ffn_ln_biases=[mk(dim) for _ in range(L)],
+        ffn1_weights=[mk(dim, ffn) for _ in range(L)],
+        ffn1_biases=[mk(ffn) for _ in range(L)],
+        ffn2_weights=[mk(ffn, dim) for _ in range(L)],
+        ffn2_biases=[mk(dim) for _ in range(L)],
+    )
+
+
+def _rotary_tables(b, max_seq, hd, dtype):
+    """Packed [2, b, 1, max_seq, hd] cos/sin, full head_dim (the fused
+    kernel's inline-rope contract)."""
+    import jax.numpy as jnp
+
+    pos = np.arange(max_seq, dtype=np.float32)
+    inv = 1.0 / (10000.0 ** (np.arange(0, hd, 2, np.float32) / hd))
+    ang = np.einsum("s,d->sd", pos, inv)                  # [s, hd/2]
+    ang = np.repeat(ang, 2, axis=-1)                      # full head_dim
+    cos = np.broadcast_to(np.cos(ang), (b, 1, max_seq, hd))
+    sin = np.broadcast_to(np.sin(ang), (b, 1, max_seq, hd))
+    return jnp.asarray(np.stack([cos, sin]), dtype)
+
+
+def _make_fns(L, dim, n_head, ffn, vocab, max_seq, dtype):
+    """(prefill, step, scan_decode) pure-array jitted functions."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.core import tape as _tape
+    from paddle_tpu.core.tensor import Tensor
+    import paddle_tpu.incubate.nn.functional as IF
+
+    hd = dim // n_head
+
+    def run_layers(P, x_arr, caches, time_step):
+        PT = {k: [Tensor(a) for a in v] for k, v in P["layers"].items()}
+        with _tape.no_grad():
+            out, new_caches = IF.fused_multi_transformer(
+                Tensor(x_arr), cache_kvs=[Tensor(c) for c in caches],
+                rotary_embs=Tensor(P["rotary"]), rotary_emb_dims=1,
+                use_neox_rotary_style=True,
+                time_step=(None if time_step is None
+                           else Tensor(time_step)),
+                **PT)
+        return out._data, [c._data for c in new_caches]
+
+    def logits_of(P, h_last):
+        # bf16 weight reads, f32 accumulation: upcasting the [dim, vocab]
+        # head to f32 would double its HBM traffic — the biggest single
+        # read of a decode step
+        return jnp.matmul(h_last, P["lm_head"],
+                          preferred_element_type=jnp.float32)
+
+    def prefill(P, ids, caches):
+        x = P["embed"][ids]                               # [b, s, dim]
+        h, caches = run_layers(P, x, caches, None)
+        return (jnp.argmax(logits_of(P, h[:, -1]), -1).astype(jnp.int32),
+                caches)
+
+    def step(P, tok, t, caches):
+        x = P["embed"][tok][:, None, :]                   # [b, 1, dim]
+        h, caches = run_layers(P, x, caches, t)
+        return (jnp.argmax(logits_of(P, h[:, 0]), -1).astype(jnp.int32),
+                caches)
+
+    def scan_decode(P, tok0, t0, caches, n_steps):
+        def body(carry, _):
+            tok, t, cs = carry
+            nxt, cs = step(P, tok, t, cs)
+            return (nxt, t + 1, tuple(cs)), nxt
+
+        (_, _, caches), toks = jax.lax.scan(
+            body, (tok0, t0, tuple(caches)), None, length=n_steps)
+        return toks, caches
+
+    jit_prefill = jax.jit(prefill, donate_argnums=(2,))
+    jit_step = jax.jit(step, donate_argnums=(3,))
+    jit_scan = jax.jit(scan_decode, donate_argnums=(3,),
+                       static_argnums=(4,))
+    return jit_prefill, jit_step, jit_scan
+
+
+def _numerics_gate(dtype):
+    """Fused cached decode vs fused prefill (self-consistency) and vs the
+    unfused dense composition, on the CURRENT device."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.core import tape as _tape
+    from paddle_tpu.core.tensor import Tensor
+    import paddle_tpu.incubate.nn.functional as IF
+    import paddle_tpu.nn.functional as F
+
+    rng = np.random.RandomState(0)
+    L, dim, n_head, ffn, seq, max_seq = 2, 128, 2, 256, 6, 16
+    hd = dim // n_head
+    P = _build_params(rng, L, dim, n_head, ffn, jnp.float32)
+    PT = {k: [Tensor(a) for a in v] for k, v in P.items()}
+    x = Tensor(jnp.asarray(rng.randn(1, seq, dim).astype(np.float32) * .3))
+    rot = Tensor(_rotary_tables(1, max_seq, hd, jnp.float32))
+    with _tape.no_grad():
+        full = IF.fused_multi_transformer(
+            x, rotary_embs=rot, rotary_emb_dims=1,
+            use_neox_rotary_style=True, **PT)
+        caches = [Tensor(jnp.zeros((2, 1, n_head, max_seq, hd)))
+                  for _ in range(L)]
+        for t in range(seq):
+            out, caches = IF.fused_multi_transformer(
+                x[:, t:t + 1], cache_kvs=caches,
+                rotary_embs=rot, rotary_emb_dims=1,
+                use_neox_rotary_style=True,
+                time_step=Tensor(jnp.asarray(t, jnp.int32)), **PT)
+    err = np.abs(np.asarray(out._data)[:, 0]
+                 - np.asarray(full._data)[:, -1]).max()
+    assert err < 2e-3, f"decode-vs-prefill mismatch: {err}"
+
+    # prefill (no rotary) vs unfused dense composition
+    with _tape.no_grad():
+        nr = IF.fused_multi_transformer(x, **PT)
+        h = x
+        for i in range(L):
+            ln = F.layer_norm(h, [dim], PT["ln_scales"][i],
+                              PT["ln_biases"][i])
+            qw = np.asarray(P["qkv_weights"][i])
+            qkv = np.einsum("bsd,thed->bsthe", np.asarray(ln._data), qw) \
+                + np.asarray(P["qkv_biases"][i]).reshape(1, 1, 3, n_head,
+                                                         hd)
+            q, k, v = (Tensor(jnp.asarray(qkv[:, :, j]))
+                       for j in range(3))
+            att = F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                                 training=False)
+            att = att.reshape([1, seq, dim])
+            o = F.linear(att, PT["linear_weights"][i],
+                         PT["linear_biases"][i])
+            h = h + o
+            ln2 = F.layer_norm(h, [dim], PT["ffn_ln_scales"][i],
+                               PT["ffn_ln_biases"][i])
+            f1 = F.gelu(F.linear(ln2, PT["ffn1_weights"][i],
+                                 PT["ffn1_biases"][i]))
+            h = h + F.linear(f1, PT["ffn2_weights"][i],
+                             PT["ffn2_biases"][i])
+    err2 = np.abs(np.asarray(nr._data) - np.asarray(h._data)).max()
+    assert err2 < 2e-3, f"fused-vs-dense mismatch: {err2}"
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    backend = jax.default_backend()
+    on_tpu = backend in ("tpu", "axon")
+    _numerics_gate(jnp.float32)
+
+    if on_tpu:
+        # GPT-438M proxy (bench.py's flagship config)
+        L, dim, n_head, ffn, vocab = 12, 1536, 12, 4096, 32000
+        prefill_len, n_steps, bsizes = 512, 128, (1, 8)
+        dtype = jnp.bfloat16
+    else:
+        L, dim, n_head, ffn, vocab = 2, 256, 4, 512, 1024
+        prefill_len, n_steps, bsizes = 32, 8, (1,)
+        dtype = jnp.float32
+
+    hd = dim // n_head
+    max_seq = prefill_len + n_steps
+    rng = np.random.RandomState(0)
+    results = []
+
+    # decode is weight-traffic-bound: every step reads all layer weights
+    # + the LM head once from HBM (v5e ~819 GB/s). KV-cache reads are
+    # tiny at this seq. This roofline contextualizes per-step latency.
+    itemsize = jnp.dtype(dtype).itemsize
+    layer_w = (3 * dim * dim + dim * dim + 2 * dim * ffn) * L
+    weight_bytes = (layer_w + dim * vocab) * itemsize
+    hbm_bw = 819e9 if on_tpu else None
+    roofline_ms = (weight_bytes / hbm_bw * 1e3) if hbm_bw else None
+
+    for b in bsizes:
+        P = {
+            "layers": _build_params(rng, L, dim, n_head, ffn, dtype),
+            "embed": jnp.asarray(
+                (rng.randn(vocab, dim) * 0.02).astype(np.float32), dtype),
+            "lm_head": jnp.asarray(
+                (rng.randn(dim, vocab) * 0.02).astype(np.float32), dtype),
+            "rotary": _rotary_tables(b, max_seq, hd, dtype),
+        }
+        jit_prefill, jit_step, jit_scan = _make_fns(
+            L, dim, n_head, ffn, vocab, max_seq, dtype)
+        ids = jnp.asarray(rng.randint(0, vocab, (b, prefill_len)),
+                          jnp.int32)
+
+        def fresh_caches():
+            return [jnp.zeros((2, b, n_head, max_seq, hd), dtype)
+                    for _ in range(L)]
+
+        # ---- prefill (timed separately; also warms the compile)
+        tok, caches = jit_prefill(P, ids, fresh_caches())
+        tok.block_until_ready()
+        t0 = time.time()
+        tok, caches = jit_prefill(P, ids, fresh_caches())
+        tok.block_until_ready()
+        prefill_s = time.time() - t0
+
+        # ---- per-step decode (donated caches), best-of-3 windows
+        t = jnp.asarray(prefill_len, jnp.int32)
+        tok1, caches1 = jit_step(P, tok, t, caches)   # compile
+        # rebuild state consumed by donation
+        tok, caches = jit_prefill(P, ids, fresh_caches())
+        best = None
+        for _ in range(3):
+            tok_w, caches_w = jit_prefill(P, ids, fresh_caches())
+            tw0 = time.time()
+            cur = tok_w
+            for k in range(n_steps):
+                cur, caches_w = jit_step(
+                    P, cur, jnp.asarray(prefill_len + k, jnp.int32),
+                    caches_w)
+            cur.block_until_ready()
+            dt = time.time() - tw0
+            best = dt if best is None else min(best, dt)
+        per_step_ms = best * 1000.0 / n_steps
+        results.append({
+            "metric": f"decode tokens/s/chip GPT-proxy {dtype.__name__} "
+                      f"b{b} per-step (prefill {prefill_len} + "
+                      f"{n_steps} steps, {backend})",
+            "value": round(b * n_steps / best, 1),
+            "unit": "tokens/s",
+            "per_step_ms": round(per_step_ms, 3),
+            "prefill_s": round(prefill_s, 4),
+        })
+
+        # ---- scan decode: 128 steps, ONE dispatch
+        tok_w, caches_w = jit_prefill(P, ids, fresh_caches())
+        toks, caches_s = jit_scan(P, tok_w, t, caches_w, n_steps)
+        toks.block_until_ready()                      # compile
+        best = None
+        for _ in range(3):
+            tok_w, caches_w = jit_prefill(P, ids, fresh_caches())
+            tw0 = time.time()
+            toks, _ = jit_scan(P, tok_w, t, caches_w, n_steps)
+            toks.block_until_ready()
+            dt = time.time() - tw0
+            best = dt if best is None else min(best, dt)
+        row = {
+            "metric": f"decode tokens/s/chip GPT-proxy {dtype.__name__} "
+                      f"b{b} scan{n_steps} ({backend})",
+            "value": round(b * n_steps / best, 1),
+            "unit": "tokens/s",
+            "per_step_ms": round(best * 1000.0 / n_steps, 3),
+        }
+        if roofline_ms is not None:
+            row["weight_roofline_ms"] = round(roofline_ms, 3)
+            row["roofline_pct"] = round(
+                100.0 * roofline_ms / (best * 1000.0 / n_steps), 1)
+        results.append(row)
+
+    for r in results:
+        print(json.dumps(r))
+    out = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "DECODE_BENCH.json")
+    with open(out, "w") as f:
+        json.dump({"backend": backend, "results": results}, f, indent=1)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

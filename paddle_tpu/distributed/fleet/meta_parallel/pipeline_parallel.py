@@ -903,12 +903,29 @@ class PipelineParallel(Layer):
             if sh != want:
                 t._data = jax.device_put(t._data, want)
             if optimizer is not None:
-                st = optimizer._accumulators.get(id(t))
+                # materialize the accumulator NOW (get-or-create) so its
+                # fresh leaves — including 0-d beta-pow scalars created
+                # without reference to the param — get placed as well: a
+                # single stray SingleDeviceSharding input flips the
+                # step-2 jit signature and forces the recompile this
+                # pre-placement exists to prevent
+                st = (optimizer._state_for(t)
+                      if not t.stop_gradient else None)
                 if st is not None:
+                    repl = NamedSharding(mesh, P())
+
+                    def place(a, _want=want, _repl=repl):
+                        if not isinstance(a, jax.Array):
+                            return a
+                        # low-rank leaves (beta-pow scalars) can't take
+                        # the param's spec — replicate them
+                        w = (_want if a.ndim >= len(_want.spec)
+                             else _repl)
+                        return (jax.device_put(a, w)
+                                if a.sharding != w else a)
+
                     optimizer._accumulators[id(t)] = jax.tree.map(
-                        lambda a: jax.device_put(a, want)
-                        if jnp.ndim(a) and getattr(a, "sharding", None)
-                        != want else a, st)
+                        place, st)
 
     # ----------------------------------------------------------- API
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):

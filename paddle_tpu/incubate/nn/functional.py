@@ -330,16 +330,18 @@ def masked_multihead_attention(x, cache_kv=None, src_mask=None, bias=None,
             cosv, sinv = _rope_tables_at(rot, lens, head_dim)  # [b,1,d]
             q = _rope_full_table(q, cosv, sinv, use_neox_rotary_style)
             k = _rope_full_table(k, cosv, sinv, use_neox_rotary_style)
-        # write k/v at each row's step index
-        pos = lens[:, None, None, None]             # [b,1,1,1]
-        idx = jnp.arange(max_seq)[None, None, :, None]
-        write = idx == pos
-        new_k = jnp.where(write, k[:, :, None, :], cachev[0])
-        new_v = jnp.where(write, v[:, :, None, :], cachev[1])
-        out = _cache_attend(q[:, None], new_k, new_v, lens, maskv, max_seq)
+        # write k/v at each row's step index: a single scatter touching
+        # one position per row — a where() over the full cache would
+        # read+write the whole KV cache every step and defeat donated
+        # in-place aliasing (r5 decode trace)
+        bidx = jnp.arange(b)
+        upd = jnp.stack([k, v], axis=1).astype(cachev.dtype)  # [b,2,h,d]
+        new_cache = cachev.at[:, bidx, :, lens].set(upd)
+        out = _cache_attend(q[:, None], new_cache[0], new_cache[1], lens,
+                            maskv, max_seq)
         out = out.astype(xv.dtype).reshape(b, n_head * head_dim)
         out = jnp.where(overflow, jnp.asarray(jnp.nan, out.dtype), out)
-        return out, jnp.stack([new_k, new_v])
+        return out, new_cache
 
     return apply(f, *args, _op_name="masked_multihead_attention")
 
@@ -507,10 +509,23 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
                 kk = jnp.transpose(k, (0, 2, 1, 3))   # [b,h,s,d]
                 vv = jnp.transpose(v, (0, 2, 1, 3))
                 if decode:
-                    idx = jnp.arange(max_seq)[None, None, :, None]
-                    write = idx == ts
-                    new_k = jnp.where(write, kk, cache[0])
-                    new_v = jnp.where(write, vv, cache[1])
+                    # single-position dynamic_update_slice: a where() over
+                    # the full cache would READ+WRITE the whole KV cache
+                    # per layer per step (the r5 decode trace showed 27%
+                    # of step time in exactly those copies) and defeat
+                    # donated in-place aliasing. DUS clamps out-of-range
+                    # starts, so an overflowing time_step must DROP the
+                    # write (the pre-r5 where() semantics; the output is
+                    # already NaN-poisoned) — select against the one old
+                    # slot, not the whole cache.
+                    upd = jnp.stack([kk, vv]).astype(cache.dtype)
+                    zero = jnp.zeros((), jnp.int32)
+                    pos = jnp.minimum(ts.astype(jnp.int32), max_seq - 1)
+                    start = (zero, zero, zero, pos, zero)
+                    old = jax.lax.dynamic_slice(cache, start, upd.shape)
+                    upd = jnp.where(ts < max_seq, upd, old)
+                    new_caches.append(jax.lax.dynamic_update_slice(
+                        cache, upd, start))
                 else:
                     # prefill: write positions [0, s) so later decode
                     # steps attend over the prompt
@@ -518,7 +533,7 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
                     inmask = (jnp.arange(max_seq) < s)[None, None, :, None]
                     new_k = jnp.where(inmask, jnp.pad(kk, pad), cache[0])
                     new_v = jnp.where(inmask, jnp.pad(vv, pad), cache[1])
-                new_caches.append(jnp.stack([new_k, new_v]))
+                    new_caches.append(jnp.stack([new_k, new_v]))
             if decode:
                 cache_k, cache_v = new_caches[i][0], new_caches[i][1]
                 attn = _cache_attend(q, cache_k, cache_v, ts, maskv,

@@ -114,12 +114,9 @@ class TrainStep:
 
         p_sh = [sh_of(sd[n]._data) for n in self._param_names]
         b_sh = [sh_of(sd[n]._data) for n in self._buffer_names]
-        # params outside optimizer._parameter_list have no accumulator yet
-        # (same fallback _marshal uses)
-        o_sh = [jax.tree.map(
-                    sh_of, opt._accumulators.get(id(sd[n]))
-                    if id(sd[n]) in opt._accumulators
-                    else opt._state_for(sd[n]))
+        # _state_for is get-or-create: params outside the optimizer's
+        # parameter list materialize their accumulator here
+        o_sh = [jax.tree.map(sh_of, opt._state_for(sd[n]))
                 for n in self._param_names]
         if all(s is nosh for s in p_sh + b_sh) and all(
                 s is nosh for st in o_sh for s in jax.tree.leaves(st)):
@@ -347,8 +344,7 @@ class TrainStep:
         param_arrays = [sd[n]._data for n in self._param_names]
         buffer_arrays = [sd[n]._data for n in self._buffer_names]
         opt = self.optimizer
-        opt_states = [opt._accumulators[id(sd[n])] if id(sd[n]) in opt._accumulators
-                      else opt._state_for(sd[n]) for n in self._param_names]
+        opt_states = [opt._state_for(sd[n]) for n in self._param_names]
         lr = jnp.asarray(opt.get_lr(), jnp.float32)
         rng_key = (random_state.next_key() if draw_key
                    else jax.random.PRNGKey(0))

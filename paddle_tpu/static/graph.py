@@ -651,6 +651,37 @@ def register_minimize(optimizer, loss, parameters=None, no_grad_set=None):
     return None, pairs
 
 
+def _mp_state_shardings(params, mesh, opt, gm_k):
+    """Per-param/state shardings for static hybrid training. With an mp
+    axis (>1), params whose last dim divides mp shard over it (column
+    policy; the reference's tensor_parallel_optimizer reaches the same
+    layouts through per-layer program rewrites — fleet/meta_optimizers/
+    (U)); optimizer-state leaves mirror their param, scalars replicate."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    repl = NamedSharding(mesh, PartitionSpec())
+    mp = dict(mesh.shape).get("mp", 1)
+    param_sh = []
+    for p in params:
+        nd = p._data.ndim
+        if mp > 1 and nd >= 2 and p._data.shape[-1] % mp == 0:
+            param_sh.append(NamedSharding(
+                mesh, PartitionSpec(*([None] * (nd - 1) + ["mp"]))))
+        else:
+            param_sh.append(repl)
+    opt_sh = [
+        jax.tree.map(
+            lambda a, _s=s, _p=p: _s
+            if getattr(a, "shape", None) is not None
+            and tuple(a.shape) == tuple(_p._data.shape)
+            else repl,
+            opt._accumulators[id(p)])
+        if opt is not None else []
+        for p, s in zip(params, param_sh)]
+    acc_sh = list(param_sh) if gm_k > 1 else []
+    return param_sh, opt_sh, acc_sh
+
+
 def _dp_local_count(mesh):
     """Number of distinct DP-axis coordinates this process owns in a
     (possibly hybrid) mesh. A process's batch shard splits over the dp
@@ -1030,21 +1061,34 @@ class Executor:
 
             if dp_mesh is not None:
                 # static DATA-PARALLEL training: feeds shard over the dp
-                # axis, params/optimizer state stay replicated — GSPMD
-                # inserts the gradient all-reduce the reference's
-                # transpiled program carried as explicit c_allreduce ops
+                # axis — GSPMD inserts the gradient all-reduce the
+                # reference's transpiled program carried as explicit
+                # c_allreduce ops. Static TENSOR-PARALLEL (r5, the static
+                # analog of the reference's tensor_parallel_optimizer
+                # fleet/meta_optimizers/ (U)): when the mesh has an mp
+                # axis, every recorded param whose last dim divides mp
+                # shards over it (column policy — the reference reaches
+                # the same layout through per-layer annotations; GSPMD
+                # places the matching collectives), optimizer state
+                # mirrors its param, and the state outputs pin to the
+                # entry shardings so updates stay sharded step to step.
                 from jax.sharding import NamedSharding, PartitionSpec
 
                 repl = NamedSharding(dp_mesh, PartitionSpec())
+                param_sh, opt_sh, acc_sh = _mp_state_shardings(
+                    params, dp_mesh, opt, gm_k)
                 feed_sh = [
                     NamedSharding(dp_mesh, PartitionSpec("dp")) if bl
                     else repl for bl in dp_batch_like]
-                # leading args: params, opt_states, lr, scaler_state,
-                # acc, nacc — all replicated
+                # arg order: params, opt_states, lr, scaler_state, acc,
+                # nacc, *feeds; outputs: (fwd_vals, grads, new_params,
+                # new_states, new_scaler_state, out_acc, out_nacc)
                 cached = self._cache_put(key, jax.jit(
                     train_fn,
-                    in_shardings=(repl,) * 6 + tuple(feed_sh),
-                    out_shardings=repl))
+                    in_shardings=(param_sh, opt_sh, repl, repl, acc_sh,
+                                  repl) + tuple(feed_sh),
+                    out_shardings=(repl, tuple(param_sh), param_sh,
+                                   opt_sh, repl, acc_sh, repl)))
             else:
                 cached = self._cache_put(key, jax.jit(train_fn))
         param_arrays = [p._data for p in params]
@@ -1074,6 +1118,26 @@ class Executor:
             scaler_state = jax.tree.map(g, scaler_state)
             acc = [g(a) for a in acc]
             nacc = g(nacc)
+            if dict(dp_mesh.shape).get("mp", 1) > 1 \
+                    and not getattr(opt, "_static_mp_placed", False):
+                # static-mp: the replicated global arrays move to their
+                # mp shardings ONCE (committed arrays can't be resharded
+                # by in_shardings); later calls see the jit outputs,
+                # already sharded — the flag skips the per-step
+                # sharding-object rebuild
+                opt._static_mp_placed = True
+                p_sh, o_sh, a_sh = _mp_state_shardings(
+                    params, dp_mesh, opt, gm_k)
+                param_arrays = [
+                    a if a.sharding == s else jax.device_put(a, s)
+                    for a, s in zip(param_arrays, p_sh)]
+                opt_states = [
+                    jax.tree.map(
+                        lambda a, s: a if a.sharding == s
+                        else jax.device_put(a, s), st, sh)
+                    for st, sh in zip(opt_states, o_sh)]
+                acc = [a if a.sharding == s else jax.device_put(a, s)
+                       for a, s in zip(acc, a_sh)]
             for p, ga in zip(params, param_arrays):
                 p._data = ga
             if opt is not None:

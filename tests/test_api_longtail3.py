@@ -709,3 +709,59 @@ class TestFusedGeneration:
         out_0 = IF.fused_multi_transformer(x, dropout_rate=0.0,
                                            training=False, **P)
         assert np.abs(out_p.numpy() - out_0.numpy()).max() > 1e-4
+
+
+class TestDecodeCacheOverflow:
+    def test_overflowing_time_step_drops_write(self):
+        """r5: the dynamic_update_slice cache write must DROP an
+        out-of-capacity token (the pre-r5 where() semantics) — DUS alone
+        would clamp and silently corrupt the last slot."""
+        import jax
+        import paddle_tpu.incubate.nn.functional as IF
+
+        rng = np.random.RandomState(3)
+        L, dim, n_head, ffn = 1, 32, 4, 64
+        hd = dim // n_head
+        P = {k: [paddle.to_tensor((rng.randn(*t.shape) * 0.05).astype(
+                np.float32)) if hasattr(t, "shape") else t for t in v]
+             for k, v in {}.items()}  # placeholder
+        # reuse the canonical param builder
+        tc = TestFusedGeneration()
+        P = tc._mt_params(rng, L, dim, n_head, ffn)
+        max_seq = 4
+        x = paddle.to_tensor(rng.randn(1, 1, dim).astype(np.float32))
+        caches = [paddle.to_tensor(
+            rng.randn(2, 1, n_head, max_seq, hd).astype(np.float32))]
+        before = caches[0].numpy().copy()
+
+        def run(ts):
+            out, cs = IF.fused_multi_transformer(
+                x, cache_kvs=[paddle.to_tensor(before.copy())],
+                time_step=paddle.to_tensor(np.asarray(ts, np.int32)), **P)
+            return out, cs
+
+        # in-range write modifies exactly the ts slot
+        _, cs = run(2)
+        after = cs[0].numpy()
+        changed = np.abs(after - before).max(axis=(0, 1, 2, 4))
+        assert changed[2] > 0 and changed[[0, 1, 3]].max() == 0
+        # eager overflow raises loudly (pre-existing contract)
+        import pytest as _pytest
+        with _pytest.raises(ValueError, match="cache full"):
+            run(max_seq)
+        # traced overflow (jit decode run past capacity): output is
+        # NaN-poisoned AND the returned cache is UNTOUCHED — DUS alone
+        # would clamp and overwrite the last slot
+        from paddle_tpu.core.tensor import Tensor
+
+        def jit_run(x_a, cache_a, ts_a):
+            out, cs = IF.fused_multi_transformer(
+                Tensor(x_a), cache_kvs=[Tensor(cache_a)],
+                time_step=Tensor(ts_a), **P)
+            return out._data, cs[0]._data
+
+        out_a, cache_a = jax.jit(jit_run)(
+            x._data, jax.numpy.asarray(before),
+            jax.numpy.asarray(max_seq, jax.numpy.int32))
+        assert np.isnan(np.asarray(out_a)).all()
+        np.testing.assert_array_equal(np.asarray(cache_a), before)

