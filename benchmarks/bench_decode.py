@@ -248,9 +248,7 @@ def main():
 
         # ---- per-step decode (donated caches), best-of-3 windows
         t = jnp.asarray(prefill_len, jnp.int32)
-        tok1, caches1 = jit_step(P, tok, t, caches)   # compile
-        # rebuild state consumed by donation
-        tok, caches = jit_prefill(P, ids, fresh_caches())
+        jit_step(P, tok, t, caches)                   # compile
         best = None
         for _ in range(3):
             tok_w, caches_w = jit_prefill(P, ids, fresh_caches())

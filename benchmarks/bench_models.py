@@ -151,7 +151,7 @@ def bench_resnet50(dtype="bfloat16", B=64, scan_k=0):
     return _utilization(res, step, (x, y), ips, B)
 
 
-def bench_bert(B=32, scan_k=0):
+def bench_bert(B=32, scan_k=0, S=128):
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
     from paddle_tpu.models import BertConfig, BertForMaskedLM
@@ -165,7 +165,7 @@ def bench_bert(B=32, scan_k=0):
     opt = paddle.optimizer.AdamW(learning_rate=1e-4,
                                  parameters=model.parameters(),
                                  multi_precision=True)
-    S = 128
+    S = int(S)
 
     def loss_fn(net, ids, labels):
         out = net(ids, labels=labels)

@@ -199,11 +199,14 @@ class StaticMetaOptimizer:
         from ...topology import get_hybrid_communicate_group
 
         hcg = get_hybrid_communicate_group()
-        if hcg is not None and (hcg.get_data_parallel_world_size() > 1
-                                or hcg.get_model_parallel_world_size() > 1):
+        if hcg is not None and (
+                hcg.get_data_parallel_world_size() > 1
+                or hcg.get_model_parallel_world_size() > 1
+                or hcg.get_sharding_parallel_world_size() > 1):
             # dp: feeds shard over 'dp', GSPMD allreduces grads. mp (r5):
-            # params shard over 'mp' (static tensor parallel) — see
-            # static/graph.py _mp_state_shardings
+            # params shard over 'mp' (static tensor parallel). sharding
+            # (r5): optimizer state shards over 'sharding' (static
+            # ZeRO-1) — see static/graph.py _mp_state_shardings
             self._static_dp_mesh = hcg.mesh
             self._static_mp_placed = False   # re-place on re-minimize
 

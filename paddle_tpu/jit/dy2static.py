@@ -46,9 +46,11 @@ traced ones): `del`/`global`/`nonlocal` in bodies; `while/else` /
 `for/else`; exits inside `with`/`try` or non-range `for` loops;
 generators/coroutines; impure return expressions evaluate at the
 function-end dispatch rather than the return site; functions whose
-source is unavailable. Conversion applies to the decorated function only
-(not transitively through calls) — decorate helpers with
-`paddle.jit.to_static` too, or call `static.nn.cond` directly.
+source is unavailable. Conversion IS transitive through calls (r5):
+user call sites inside a converted function route through `convert_call`,
+so undecorated helpers stage too (framework/builtin callables pass
+through untouched; mark a function with `paddle.jit.not_to_static` to
+opt out).
 """
 
 from __future__ import annotations
@@ -59,10 +61,10 @@ import inspect
 import textwrap
 import types
 
-__all__ = ["convert_to_static", "convert_ifelse", "convert_while",
-           "convert_for_range", "convert_for_iter", "convert_logical_and",
-           "convert_logical_or", "convert_logical_not", "range_parts",
-           "UndefinedVar", "UNDEF"]
+__all__ = ["convert_to_static", "convert_call", "convert_ifelse",
+           "convert_while", "convert_for_range", "convert_for_iter",
+           "convert_logical_and", "convert_logical_or",
+           "convert_logical_not", "range_parts", "UndefinedVar", "UNDEF"]
 
 
 class UndefinedVar:
@@ -866,7 +868,9 @@ def _not(expr):
 
 def _terminates(stmts):
     """True when the statement list definitely returns on every path
-    (last stmt is a return, or an if/else whose branches both do)."""
+    (last stmt is a return, an if/else whose branches both do, or a
+    `while True:` with no break — the canonical `while True: ... if eos:
+    return x` decode can only exit through a return)."""
     if not stmts:
         return False
     last = stmts[-1]
@@ -875,6 +879,12 @@ def _terminates(stmts):
     if isinstance(last, ast.If):
         return (bool(last.orelse) and _terminates(last.body)
                 and _terminates(last.orelse))
+    if isinstance(last, ast.While):
+        test_true = (isinstance(last.test, ast.Constant)
+                     and bool(last.test.value))
+        return (test_true
+                and not _contains(last.body, (ast.Break,),
+                                  skip_loops=True))
     return False
 
 
@@ -1182,6 +1192,59 @@ class _EarlyExit:
         return cur + [ret_stmt]
 
 
+_BUILTIN_SKIP = {"range", "len", "print", "isinstance", "issubclass",
+                 "getattr", "setattr", "hasattr", "float", "int", "bool",
+                 "str", "repr", "type", "enumerate", "zip", "list",
+                 "dict", "tuple", "set", "super", "sorted", "reversed",
+                 "min", "max", "abs", "sum", "any", "all", "id", "iter",
+                 "next", "callable", "vars", "globals", "locals"}
+
+
+class _CallTransformer(ast.NodeTransformer):
+    """`f(...)` -> `__jst.convert_call(f)(...)` at user call sites, making
+    conversion TRANSITIVE through calls (ref dy2static convert_call (U)).
+    Applied AFTER the statement conversion: it descends into the
+    generated `__jst_*` branch/body defs (user code lives there now) but
+    leaves genuinely nested user defs alone — those convert at their own
+    call sites. Builtin names and `__jst` helpers are skipped to keep the
+    eager overhead at one cached dict lookup per user call."""
+
+    def __init__(self):
+        self.wrapped = 0
+
+    def _visit_scope(self, node):
+        if node.name.startswith("__jst"):
+            return self.generic_visit(node)
+        return node
+
+    visit_FunctionDef = _visit_scope
+    visit_AsyncFunctionDef = _visit_scope
+
+    def visit_ClassDef(self, node):
+        return node
+
+    def visit_Lambda(self, node):
+        return node
+
+    def visit_Call(self, node):
+        node = self.generic_visit(node)
+        f = node.func
+        if isinstance(f, ast.Name):
+            if f.id in _BUILTIN_SKIP or f.id.startswith("__jst"):
+                return node
+        elif isinstance(f, ast.Attribute):
+            root = f
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if isinstance(root, ast.Name) and root.id.startswith("__jst"):
+                return node
+        else:
+            return node             # call-of-call / subscripted callables
+        node.func = _helper_call("convert_call", [f])
+        self.wrapped += 1
+        return node
+
+
 class _PredicateTransformer(ast.NodeTransformer):
     """Rewrites `and`/`or`/`not` and chained comparisons INSIDE a
     converted statement's test expression into lazy helper calls, so
@@ -1367,6 +1430,48 @@ class _Dy2StaticTransformer(ast.NodeTransformer):
 
 _CONVERT_CACHE = {}
 
+# modules whose callables are never user control-flow candidates: wrapping
+# them through the converter would be pure per-call overhead
+_SKIP_MODULE_PREFIXES = ("paddle_tpu", "jax", "numpy", "builtins",
+                        "functools", "itertools", "operator", "math")
+
+
+def convert_call(fn):
+    """Transitive conversion at CALL SITES (ref dy2static convert_call
+    (U)): every call inside a converted function routes through here, so
+    helper functions the user did NOT decorate still get their if/while/
+    for staged. Framework/builtin callables, classes, Layer instances and
+    anything unconvertible pass through untouched; results cache on the
+    function object (and by code object inside convert_to_static), so the
+    steady-state cost is one attribute lookup."""
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        return fn                   # builtins, classes, callables, Layers
+    if isinstance(fn, types.MethodType):
+        # BEFORE the cache: bound methods proxy attribute reads to their
+        # __func__, so the plain-function cache entry would come back
+        # unbound and the call would drop self — convert the underlying
+        # function (its own cache applies) and rebind
+        conv = convert_call(fn.__func__)
+        if conv is fn.__func__:
+            return fn
+        return types.MethodType(conv, fn.__self__)
+    cached = getattr(fn, "__dy2static_call_cache__", None)
+    if cached is not None:
+        return cached
+    if getattr(fn, "__dy2static_converted__", False) \
+            or getattr(fn, "_not_to_static", False):
+        return fn
+    mod = getattr(fn, "__module__", "") or ""
+    if mod.split(".", 1)[0] in _SKIP_MODULE_PREFIXES:
+        return fn
+    conv = convert_to_static(fn)
+    try:
+        fn.__dy2static_call_cache__ = conv
+    except (AttributeError, TypeError):
+        pass                        # bound methods: code-id cache applies
+    return conv
+
 
 def convert_to_static(fn):
     """Return `fn` with its `if`/`while` statements rewritten to runtime
@@ -1379,7 +1484,8 @@ def convert_to_static(fn):
         if conv is fn.__func__:
             return fn
         return types.MethodType(conv, fn.__self__)
-    if getattr(fn, "_not_to_static", False):
+    if getattr(fn, "_not_to_static", False) \
+            or getattr(fn, "__dy2static_converted__", False):
         return fn
     code = getattr(fn, "__code__", None)
     # closure-bearing functions are NEVER cached: the conversion snapshots
@@ -1418,8 +1524,10 @@ def _convert_uncached(fn):
     fdef = tree.body[0]
     if not isinstance(fdef, ast.FunctionDef):
         return None
-    if not any(isinstance(n, (ast.If, ast.While, ast.For))
-               for n in ast.walk(fdef)):
+    has_cf = any(isinstance(n, (ast.If, ast.While, ast.For))
+                 for n in ast.walk(fdef))
+    has_call = any(isinstance(n, ast.Call) for n in ast.walk(fdef))
+    if not (has_cf or has_call):
         return None
     fdef.decorator_list = []       # re-applying the decorator would recurse
     # pass 1: early exits (return/break/continue) -> flag-guarded dataflow
@@ -1429,7 +1537,12 @@ def _convert_uncached(fn):
     # would treat the def itself as a nested scope
     fdef.body = [s for stmt in fdef.body
                  for s in _as_list(tf.visit(stmt))]
-    if not tf.converted_any:
+    # pass 3: transitive conversion — user call sites route through
+    # convert_call, so undecorated helpers stage too (a function with no
+    # control flow of its own still converts for its call sites)
+    ct = _CallTransformer()
+    fdef.body = [ct.visit(s) for s in fdef.body]
+    if not (tf.converted_any or ct.wrapped):
         return None
     ast.fix_missing_locations(tree)
     # closure cells: rebuild real cells by wrapping the converted def in a

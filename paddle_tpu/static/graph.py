@@ -656,11 +656,16 @@ def _mp_state_shardings(params, mesh, opt, gm_k):
     axis (>1), params whose last dim divides mp shard over it (column
     policy; the reference's tensor_parallel_optimizer reaches the same
     layouts through per-layer program rewrites — fleet/meta_optimizers/
-    (U)); optimizer-state leaves mirror their param, scalars replicate."""
+    (U)); optimizer-state leaves mirror their param. With a 'sharding'
+    axis (>1), optimizer-state leaves additionally shard their FIRST dim
+    over it (static ZeRO-1 — the static sharding_optimizer (U)): params
+    stay replicated, GSPMD reduce-scatters grads into the sharded update
+    and all-gathers the new params. Scalars replicate."""
     from jax.sharding import NamedSharding, PartitionSpec
 
     repl = NamedSharding(mesh, PartitionSpec())
     mp = dict(mesh.shape).get("mp", 1)
+    zr = dict(mesh.shape).get("sharding", 1)
     param_sh = []
     for p in params:
         nd = p._data.ndim
@@ -669,13 +674,22 @@ def _mp_state_shardings(params, mesh, opt, gm_k):
                 mesh, PartitionSpec(*([None] * (nd - 1) + ["mp"]))))
         else:
             param_sh.append(repl)
+
+    def state_leaf_sh(a, p_sh, p):
+        if getattr(a, "shape", None) is None \
+                or tuple(a.shape) != tuple(p._data.shape):
+            return repl
+        spec = list(p_sh.spec) + [None] * (len(a.shape) - len(p_sh.spec))
+        if zr > 1 and len(a.shape) >= 1 and a.shape[0] % zr == 0 \
+                and spec[0] is None:
+            spec[0] = "sharding"
+        while spec and spec[-1] is None:
+            spec.pop()
+        return NamedSharding(mesh, PartitionSpec(*spec))
+
     opt_sh = [
-        jax.tree.map(
-            lambda a, _s=s, _p=p: _s
-            if getattr(a, "shape", None) is not None
-            and tuple(a.shape) == tuple(_p._data.shape)
-            else repl,
-            opt._accumulators[id(p)])
+        jax.tree.map(lambda a, _s=s, _p=p: state_leaf_sh(a, _s, _p),
+                     opt._accumulators[id(p)])
         if opt is not None else []
         for p, s in zip(params, param_sh)]
     acc_sh = list(param_sh) if gm_k > 1 else []
@@ -1118,7 +1132,8 @@ class Executor:
             scaler_state = jax.tree.map(g, scaler_state)
             acc = [g(a) for a in acc]
             nacc = g(nacc)
-            if dict(dp_mesh.shape).get("mp", 1) > 1 \
+            if (dict(dp_mesh.shape).get("mp", 1) > 1
+                    or dict(dp_mesh.shape).get("sharding", 1) > 1) \
                     and not getattr(opt, "_static_mp_placed", False):
                 # static-mp: the replicated global arrays move to their
                 # mp shardings ONCE (committed arrays can't be resharded

@@ -722,10 +722,6 @@ class TestDecodeCacheOverflow:
         rng = np.random.RandomState(3)
         L, dim, n_head, ffn = 1, 32, 4, 64
         hd = dim // n_head
-        P = {k: [paddle.to_tensor((rng.randn(*t.shape) * 0.05).astype(
-                np.float32)) if hasattr(t, "shape") else t for t in v]
-             for k, v in {}.items()}  # placeholder
-        # reuse the canonical param builder
         tc = TestFusedGeneration()
         P = tc._mt_params(rng, L, dim, n_head, ffn)
         max_seq = 4

@@ -871,3 +871,111 @@ class TestTensorIterableScan:
         with pytest.raises(TypeError, match="side effects"):
             jax.jit(lambda s: conv(paddle.Tensor(s))._data)(
                 _t([[1.0], [2.0]])._data)
+
+
+class TestTransitiveConversion:
+    """r5: conversion is transitive through calls (ref convert_call) —
+    undecorated helpers stage when called from a converted function."""
+
+    def test_undecorated_helper_stages(self):
+        def helper(x):
+            if paddle.sum(x) > 0:       # traced predicate inside HELPER
+                return x * 2.0
+            return x - 1.0
+
+        def entry(x):
+            y = helper(x)               # entry has no control flow itself
+            return y + 10.0
+
+        conv = convert_to_static(entry)
+        assert conv.__dy2static_converted__
+        np.testing.assert_allclose(conv(_t([2.0])).numpy(), [14.0])
+        import jax
+
+        jf = jax.jit(lambda x: conv(paddle.Tensor(x))._data)
+        np.testing.assert_allclose(np.asarray(jf(_t([2.0])._data)), [14.0])
+        np.testing.assert_allclose(np.asarray(jf(_t([-2.0])._data)), [7.0])
+
+    def test_two_levels_deep(self):
+        def inner(x):
+            while paddle.sum(x) < 10.0:
+                x = x * 2.0
+            return x
+
+        def mid(x):
+            return inner(x) + 1.0
+
+        def entry(x):
+            return mid(x) * 1.0
+
+        conv = convert_to_static(entry)
+        import jax
+
+        out = jax.jit(lambda x: conv(paddle.Tensor(x))._data)(
+            _t([1.0])._data)
+        np.testing.assert_allclose(np.asarray(out), [17.0])
+
+    def test_not_to_static_opts_out(self):
+        def helper(x):
+            if paddle.sum(x) > 0:
+                return x * 2.0
+            return x
+        helper._not_to_static = True
+
+        def entry(x):
+            return helper(x)
+
+        conv = convert_to_static(entry)
+        # helper untouched: concrete works, traced raises the standard
+        # concretization error
+        np.testing.assert_allclose(conv(_t([2.0])).numpy(), [4.0])
+        import jax
+        import pytest as _pytest
+
+        with _pytest.raises(Exception, match="[Tt]race|[Cc]oncrete"):
+            jax.jit(lambda x: conv(paddle.Tensor(x))._data)(_t([2.0])._data)
+
+    def test_framework_calls_pass_through(self):
+        def entry(x):
+            return paddle.sum(x) + len([1, 2])
+
+        conv = convert_to_static(entry)
+        assert float(conv(_t([1.0, 2.0]))) == 5.0
+
+    def test_while_true_return_only_exit(self):
+        """`while True: ... if done: return x` — the loop's only exit is
+        a return; the dispatch must not add a None fall-through leaf
+        (review r5)."""
+        def f(x):
+            while True:
+                x = x * 2.0
+                if paddle.sum(x) > 10.0:
+                    return x
+
+        conv = convert_to_static(f)
+        np.testing.assert_allclose(conv(_t([1.0])).numpy(), [16.0])
+        import jax
+
+        out = jax.jit(lambda x: conv(paddle.Tensor(x))._data)(
+            _t([1.0])._data)
+        np.testing.assert_allclose(np.asarray(out), [16.0])
+
+    def test_bound_method_after_plain_call_keeps_self(self):
+        """The convert_call cache must not serve a bound method the
+        UNBOUND conversion of its underlying function (review r5:
+        methods proxy attribute reads to __func__)."""
+        from paddle_tpu.jit.dy2static import convert_call
+
+        def f(self_or_x, x=None):
+            if x is None:
+                return self_or_x + 1.0
+            return self_or_x.scale * x
+
+        class C:
+            scale = 10.0
+            m = f
+
+        # plain call first: populates the function-object cache
+        assert convert_call(f)(1.0) == 2.0
+        # bound-method call next: must keep self bound
+        assert convert_call(C().m)(3.0) == 30.0
