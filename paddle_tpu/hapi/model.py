@@ -1,9 +1,11 @@
 """paddle.Model high-level API (ref: python/paddle/hapi/model.py (U)).
 
-fit/evaluate/predict over the dygraph core; when `prepare(jit=True)` (or
-Model(..., jit=True)) the inner loop runs through jit.TrainStep so the whole
-step is one XLA program — the hapi analog of the reference's
-`Model.prepare(...)+to_static` path.
+fit/evaluate/predict over the dygraph core. The train loop runs through
+jit.TrainStep BY DEFAULT (r5, measured: BERT-base fit() on one chip is
+193.7 seq/s jitted vs 0.7 eager — 277x; AB_HAPI_FIT.json), with a loud
+one-time fallback to eager when the forward cannot trace — pass
+`prepare(..., jit=False)` to force the reference's eager-per-batch
+behavior.
 """
 
 from __future__ import annotations
@@ -27,7 +29,7 @@ class Model:
         self._use_jit = False
 
     # -------------- setup --------------
-    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None, jit=False):
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None, jit=True):
         self._optimizer = optimizer
         self._loss = loss
         if metrics is None:
@@ -63,13 +65,36 @@ class Model:
         inputs = _to_list(inputs)
         labels = _to_list(labels)
         if self._train_step is not None and update:
-            if self._train_step.has_aux:
-                loss, outs = self._train_step(*inputs, *labels)
-                self._update_metrics(outs, labels)
-            else:
-                loss = self._train_step(*inputs, *labels)
-            self._optimizer._lr_step()
-            return [float(loss)]
+            try:
+                if self._train_step.has_aux:
+                    loss, outs = self._train_step(*inputs, *labels)
+                    self._update_metrics(outs, labels)
+                else:
+                    loss = self._train_step(*inputs, *labels)
+                self._optimizer._lr_step()
+                return [float(loss)]
+            except Exception as e:
+                import jax
+
+                trace_errs = (jax.errors.TracerBoolConversionError,
+                              jax.errors.ConcretizationTypeError,
+                              jax.errors.TracerArrayConversionError,
+                              jax.errors.TracerIntegerConversionError,
+                              NotImplementedError)
+                if not isinstance(e, trace_errs) \
+                        or self._optimizer._step_count > 0:
+                    raise
+                # jit-by-default: a forward that cannot trace falls back
+                # to the reference's eager-per-batch loop, ONCE, loudly
+                import warnings
+
+                warnings.warn(
+                    "Model.fit: the network's forward cannot be traced "
+                    f"({type(e).__name__}: {e}); falling back to the "
+                    "eager per-batch loop — pass prepare(..., jit=False) "
+                    "to silence, or make the forward traceable for the "
+                    "compiled path (~100x faster on TPU)")
+                self._train_step = None
         outs = self.network(*[_as_tensor(x) for x in inputs])
         loss = self._loss(outs, *[_as_tensor(y) for y in labels]) if self._loss else outs
         loss.backward()

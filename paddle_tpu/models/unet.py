@@ -82,12 +82,23 @@ class CrossAttention(Layer):
         self.to_out = Linear(query_dim, query_dim)
 
     def forward(self, x, context=None):
-        context = x if context is None else context
         b, s, _ = x.shape
-        sk = context.shape[1]
-        q = M.reshape(self.to_q(x), [b, s, self.heads, self.head_dim])
-        k = M.reshape(self.to_k(context), [b, sk, self.heads, self.head_dim])
-        v = M.reshape(self.to_v(context), [b, sk, self.heads, self.head_dim])
+        if context is None:
+            # self-attention: ONE [D, 3D] GEMM (r5 — same in-trace weight
+            # concat as nn.MultiHeadAttention; state_dict unchanged)
+            w = M.concat([self.to_q.weight, self.to_k.weight,
+                          self.to_v.weight], axis=1)
+            qkv = M.reshape(F.linear(x, w),
+                            [b, s, 3, self.heads, self.head_dim])
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        else:
+            sk = context.shape[1]
+            q = M.reshape(self.to_q(x), [b, s, self.heads, self.head_dim])
+            # cross-attention: K/V share the context — one [C, 2D] GEMM
+            wkv = M.concat([self.to_k.weight, self.to_v.weight], axis=1)
+            kv = M.reshape(F.linear(context, wkv),
+                           [b, sk, 2, self.heads, self.head_dim])
+            k, v = kv[:, :, 0], kv[:, :, 1]
         out = F.scaled_dot_product_attention(q, k, v, training=self.training)
         return self.to_out(M.reshape(out, [b, s, self.heads * self.head_dim]))
 

@@ -78,3 +78,42 @@ class TestTupleComputeMetrics:
         model.train_batch([paddle.to_tensor(x)], [paddle.to_tensor(y)])
         val = prec.accumulate()
         assert 0.0 <= val <= 1.0
+
+
+class TestJitDefaultFallback:
+    def test_untraceable_forward_falls_back_loudly(self):
+        """r5: fit runs through TrainStep by default; a forward that
+        cannot trace warns ONCE and falls back to the eager loop."""
+        import warnings
+
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        from paddle_tpu.hapi import Model
+
+        class DataDependent(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = nn.Linear(4, 1)
+
+            def forward(self, x):
+                # bool() on a traced value: untraceable on purpose
+                if float(x.sum()) > 0:
+                    return self.lin(x)
+                return self.lin(x) * 2.0
+
+        net = DataDependent()
+        model = Model(net)
+        model.prepare(
+            optimizer=paddle.optimizer.SGD(
+                learning_rate=0.1, parameters=net.parameters()),
+            loss=nn.loss.MSELoss())
+        assert model._train_step is not None     # jit default ON
+        x = paddle.to_tensor(np.ones((4, 4), np.float32))
+        y = paddle.to_tensor(np.ones((4, 1), np.float32))
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            (l1,) = model.train_batch([x], [y])
+            assert any("cannot be traced" in str(wi.message) for wi in w)
+        assert model._train_step is None          # eager from now on
+        (l2,) = model.train_batch([x], [y])       # trains eagerly
+        assert np.isfinite(l1) and np.isfinite(l2) and l2 < l1
