@@ -610,3 +610,47 @@ class TestStaticZero1:
         serial = run(False)
         z = run(True)
         np.testing.assert_allclose(serial, z, rtol=2e-4, atol=1e-5)
+
+
+class TestHybridComposition:
+    def test_mp_amp_gradient_merge_compose(self, static_mode):
+        """r5: the static meta-optimizers compose with the new mesh
+        axes — bf16 amp rewrite + k-step gradient merge on an mp-sharded
+        program trains and matches the same composition run serially."""
+        import jax
+        import paddle_tpu.distributed as dist
+
+        X, Y = _problem()
+
+        def run(mp):
+            dist.set_hybrid_communicate_group(None)
+            if mp:
+                devs = list(np.array(jax.devices()[:8]).ravel())
+                dist.create_hybrid_communicate_group(dp=2, mp=4,
+                                                     devices=devs)
+            strat = fleet.DistributedStrategy()
+            strat.amp = True
+            strat.gradient_merge = True
+            strat.gradient_merge_configs = {"k_steps": 2, "avg": True}
+            try:
+                with static.program_guard(static.Program()):
+                    x, y, h, loss = _mlp_program()
+                    opt = fleet.distributed_optimizer(
+                        paddle.optimizer.SGD(learning_rate=0.05),
+                        strategy=strat)
+                    opt.minimize(loss)
+                    exe = static.Executor()
+                    losses = []
+                    for _ in range(8):
+                        (lv,) = exe.run(feed={"x": X, "y": Y},
+                                        fetch_list=[loss])
+                        losses.append(float(lv))
+            finally:
+                dist.set_hybrid_communicate_group(None)
+            return losses
+
+        serial = run(False)
+        mp = run(True)
+        # bf16 compute: slightly looser tolerance than the f32 parity
+        np.testing.assert_allclose(serial, mp, rtol=2e-2, atol=1e-3)
+        assert mp[-1] < 0.7 * mp[0]
