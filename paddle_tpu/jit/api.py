@@ -344,6 +344,14 @@ def save(layer, path, input_spec=None, **configs):
         if input_spec:
             sd = layer.state_dict()
             names = list(sd.keys())
+            # the export trace must see the dy2static-CONVERTED forward
+            # (early exits / staged control flow), exactly like __call__
+            # through to_static does — shadow the bound forward for the
+            # duration of the export (hooks still run via layer(...))
+            from .dy2static import convert_to_static
+
+            orig_fwd = layer.forward
+            conv_fwd = convert_to_static(orig_fwd)
 
             def infer_fn(state_arrays, *arg_arrays):
                 arrays = dict(zip(names, state_arrays))
@@ -360,9 +368,24 @@ def save(layer, path, input_spec=None, **configs):
                     f"jit.save: input_spec names must be unique, got "
                     f"{in_names}")
             state_arrays = [sd[k]._data for k in names]
-            exported = export_with_dynamic_dims(
-                jax.jit(infer_fn), [state_arrays],
-                [(tuple(spec.shape), spec.dtype) for spec in input_spec])
+            # restore EXACTLY the prior instance state: a user's own
+            # instance-level forward (monkey-patch, to_static wrapper)
+            # must survive the export shadow
+            had_inst = "forward" in layer.__dict__
+            prev_inst = layer.__dict__.get("forward")
+            if conv_fwd is not orig_fwd:
+                object.__setattr__(layer, "forward", conv_fwd)
+            try:
+                exported = export_with_dynamic_dims(
+                    jax.jit(infer_fn), [state_arrays],
+                    [(tuple(spec.shape), spec.dtype)
+                     for spec in input_spec])
+            finally:
+                if conv_fwd is not orig_fwd:
+                    if had_inst:
+                        object.__setattr__(layer, "forward", prev_inst)
+                    else:
+                        object.__delattr__(layer, "forward")
             write_artifact(
                 path, exported,
                 [(list(s.shape),

@@ -196,33 +196,48 @@ def while_loop(cond, body, loop_vars, is_test=False, name=None):
     tensors = [_as_t(v) for v in loop_vars]
     cond_fn, body_fn = cond, body
 
-    def f(*arrs):
-        def c(carry):
-            with _tape.no_grad():
-                r = cond_fn(*[_T(a) for a in carry])
-            return _as_t(r)._data.reshape(()).astype(bool)
+    import jax
 
-        def b(carry):
-            with _tape.no_grad():
-                out = body_fn(*[_T(a) for a in carry])
-            if not isinstance(out, (tuple, list)):
-                out = (out,)
-            if len(out) != len(carry):
+    def c(carry):
+        with _tape.no_grad():
+            r = cond_fn(*[_T(a) for a in carry])
+        return _as_t(r)._data.reshape(()).astype(bool)
+
+    def b(carry):
+        with _tape.no_grad():
+            out = body_fn(*[_T(a) for a in carry])
+        if not isinstance(out, (tuple, list)):
+            out = (out,)
+        if len(out) != len(carry):
+            raise ValueError(
+                f"while_loop body returned {len(out)} values for "
+                f"{len(carry)} loop_vars")
+        res = []
+        for o, a in zip(out, carry):
+            oa = _as_t(o)._data
+            if oa.shape != a.shape or oa.dtype != a.dtype:
                 raise ValueError(
-                    f"while_loop body returned {len(out)} values for "
-                    f"{len(carry)} loop_vars")
-            res = []
-            for o, a in zip(out, carry):
-                oa = _as_t(o)._data
-                if oa.shape != a.shape or oa.dtype != a.dtype:
-                    raise ValueError(
-                        f"while_loop body changed a loop var from "
-                        f"{a.shape}/{a.dtype} to {oa.shape}/{oa.dtype} "
-                        "(loop-carried values must keep shape and dtype)")
-                res.append(oa)
-            return tuple(res)
+                    f"while_loop body changed a loop var from "
+                    f"{a.shape}/{a.dtype} to {oa.shape}/{oa.dtype} "
+                    "(loop-carried values must keep shape and dtype)")
+            res.append(oa)
+        return tuple(res)
 
-        return lax.while_loop(c, b, tuple(arrs))
+    # forward-only CONTRACT made explicit to jax: an enclosing jax.vjp
+    # (the to_static grad-aware path linearizes the whole forward) must
+    # not linearize through lax.while_loop (it has no reverse rule and
+    # its jvp path crashes on closure-heavy bodies). closure_convert
+    # surfaces the body's closed-over values (params!) as explicit
+    # arguments, and stop_gradient on ALL of them makes the loop a
+    # constant to the outer linearization — exactly the stop_gradient
+    # semantics the Tensor level already declares on the outputs.
+    def f(*arrs):
+        def base(*arrs_t):
+            return lax.while_loop(c, b, tuple(arrs_t))
+
+        conv, consts = jax.closure_convert(base, *arrs)
+        return conv(*[lax.stop_gradient(a) for a in arrs],
+                    *[lax.stop_gradient(x) for x in consts])
 
     outs = apply(f, *tensors, _op_name="while_loop")
     if not isinstance(outs, (tuple, list)):

@@ -638,3 +638,56 @@ class TestManyRngDelta:
             < 0.5 * seq_losses.mean() + 0.05
         w1, w2 = m3[0].weight.numpy(), m4[0].weight.numpy()
         assert abs(w1.std() - w2.std()) < 0.1 * max(w1.std(), w2.std())
+
+
+class TestSaveEarlyExit:
+    def test_jit_save_load_early_exit_decode(self, tmp_path):
+        """r5: jit.save must export the dy2static-CONVERTED forward —
+        an early-exit decode serializes to StableHLO and round-trips."""
+        class Dec(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = nn.Linear(4, 4)
+
+            def forward(self, h):
+                n = 0
+                while n < 8:
+                    h = self.lin(h)
+                    if paddle.max(paddle.abs(h)) < 0.05:
+                        return h * 0.0
+                    n = n + 1
+                return h
+
+        paddle.seed(0)
+        m = Dec()
+        m.eval()
+        # ref from the EAGER forward (concrete control flow is exact);
+        # m stays unwrapped so jit.save itself must do the conversion
+        x = paddle.to_tensor(np.ones((1, 4), np.float32))
+        ref = m(x).numpy()
+        path = str(tmp_path / "dec")
+        paddle.jit.save(m, path, input_spec=[paddle.jit.InputSpec([1, 4])])
+        # the export shadow is fully removed afterwards
+        assert "forward" not in m.__dict__
+        loaded = paddle.jit.load(path)
+        np.testing.assert_allclose(loaded(x).numpy(), ref, rtol=1e-5)
+
+    def test_save_restores_instance_forward(self, tmp_path):
+        """A pre-existing instance-level forward survives jit.save
+        (review r5: the shadow cleanup used to delete it)."""
+        import types
+
+        lin = nn.Linear(4, 2)
+
+        def custom_fwd(self, x):
+            return lin.__class__.forward(self, x) + 1.0
+
+        lin.eval()
+        inst = types.MethodType(custom_fwd, lin)
+        object.__setattr__(lin, "forward", inst)
+        x = paddle.randn([3, 4])
+        before = lin(x).numpy()
+        paddle.jit.save(lin, str(tmp_path / "m"),
+                        input_spec=[paddle.jit.InputSpec([3, 4])])
+        assert lin.__dict__.get("forward") is inst
+        np.testing.assert_allclose(lin(x).numpy(), before, rtol=1e-6)
