@@ -979,3 +979,61 @@ class TestTransitiveConversion:
         assert convert_call(f)(1.0) == 2.0
         # bound-method call next: must keep self bound
         assert convert_call(C().m)(3.0) == 30.0
+
+
+class TestBeamSearchDecode:
+    def test_beam_search_with_early_exit_stages(self):
+        """Capstone (VERDICT r4 item 1's 'canonical dy2static demo'): a
+        beam-search decode — per-step TOPK over the flattened
+        (beam x vocab) scores, GATHER of the winning beams' states,
+        score carries, and a data-dependent early exit when the best
+        score saturates — converts to ONE staged program. The reference
+        values come from the ORIGINAL (unconverted) function."""
+        V, B = 6, 3
+        W = _t((np.linspace(-0.5, 0.5, 4 * V)
+                .reshape(4, V) * 1.0).astype(np.float32))
+        E = _t(np.linspace(-0.2, 0.2, V * 4)
+               .reshape(V, 4).astype(np.float32))
+
+        def beam_decode(h, scores, steps, thresh):
+            # h: [B, 4] beam states; scores: [B]
+            n = 0
+            while n < steps:
+                logits = paddle.matmul(h, W)              # [B, V]
+                cand = scores.unsqueeze(-1) + logits      # [B, V]
+                flat = cand.reshape([B * V])
+                scores, idx = paddle.topk(flat, k=B)      # beam expansion
+                beam = idx // V                           # winning beams
+                tok = idx % V
+                h = paddle.tanh(h[beam] + E[tok])         # gathered state
+                if paddle.max(scores) > thresh:           # early exit
+                    return h, scores
+                n = n + 1
+            return h, scores
+
+        h0 = _t(np.ones((B, 4), np.float32))
+        s0 = _t(np.zeros(B, np.float32))
+        # ORIGINAL function, plain Python: ground truth for both exits
+        eh1, es1 = beam_decode(h0, s0, 50, 1.0)
+        eh2, es2 = beam_decode(h0, s0, 3, 1e9)
+        assert float(es1.numpy().max()) > 1.0   # early exit really fired
+
+        conv = convert_to_static(beam_decode)
+        assert conv.__dy2static_converted__
+        # converted, concrete: exact Python semantics
+        ch1, cs1 = conv(h0, s0, 50, 1.0)
+        np.testing.assert_allclose(cs1.numpy(), es1.numpy(), rtol=1e-5)
+        import jax
+
+        def j(h, s, steps, thresh):
+            a, b = conv(paddle.Tensor(h), paddle.Tensor(s),
+                        paddle.Tensor(steps), paddle.Tensor(thresh))
+            return a._data, b._data
+
+        jf = jax.jit(j)
+        jh1, js1 = jf(h0._data, s0._data, _t(50)._data, _t(1.0)._data)
+        np.testing.assert_allclose(np.asarray(jh1), eh1.numpy(), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(js1), es1.numpy(), rtol=1e-5)
+        jh2, js2 = jf(h0._data, s0._data, _t(3)._data, _t(1e9)._data)
+        np.testing.assert_allclose(np.asarray(jh2), eh2.numpy(), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(js2), es2.numpy(), rtol=1e-5)
