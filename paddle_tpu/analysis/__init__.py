@@ -1,0 +1,31 @@
+"""paddle_tpu.analysis — trace-safety linter + graph doctor for to_static
+programs (ref: the dy2static error/validation layer, SURVEY.md §2.1–2.2).
+
+Three passes share one structured-diagnostic engine:
+
+- ``check(fn)`` / ``lint_source`` / ``lint_file``: AST trace-safety
+  linting WITHOUT running the function (unconvertible constructs,
+  concretization hazards, retrace hazards, side effects under trace).
+- ``doctor(fn, *example_args)`` / ``diagnose_program`` /
+  ``diagnose_jaxpr``: post-build graph analysis (dead nodes, unused
+  feeds, dtype widening, host syncs, unbound collective axes).
+- ``python -m paddle_tpu.analysis <path>``: the package self-lint CLI.
+
+Every finding is a ``Diagnostic{code, severity, file, line, message,
+hint}`` with a stable PTA rule code (see ``RULES`` and docs/PARITY.md);
+``# noqa: PTA0xx`` on the flagged line suppresses it.
+"""
+
+from .diagnostics import (Diagnostic, Rule, RULES, TraceSafetyWarning,
+                          ERROR, WARNING, INFO)
+from .trace_lint import check, lint_source, lint_file
+from .graph_doctor import doctor, diagnose_program, diagnose_jaxpr
+from .cli import main
+
+__all__ = [
+    "Diagnostic", "Rule", "RULES", "TraceSafetyWarning",
+    "ERROR", "WARNING", "INFO",
+    "check", "lint_source", "lint_file",
+    "doctor", "diagnose_program", "diagnose_jaxpr",
+    "main",
+]

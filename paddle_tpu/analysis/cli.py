@@ -1,0 +1,69 @@
+"""`python -m paddle_tpu.analysis <file-or-package> [...]` — lint python
+sources for trace-safety and library self-lint findings.
+
+Exit status: 0 when no error-severity diagnostics, 1 otherwise (warnings
+and infos print but do not fail the run), 2 on usage errors. `--strict`
+fails on warnings too; `--mode trace` treats EVERY function as traced
+(the default `package` mode applies trace rules only under `to_static`
+decorators and self-lint rules everywhere).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from .diagnostics import ERROR, WARNING
+from .trace_lint import lint_file
+
+__all__ = ["main"]
+
+
+def _iter_py_files(path):
+    if os.path.isfile(path):
+        yield path
+        return
+    for root, dirs, files in os.walk(path):
+        dirs[:] = sorted(d for d in dirs
+                         if d not in ("__pycache__", ".git"))
+        for f in sorted(files):
+            if f.endswith(".py"):
+                yield os.path.join(root, f)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.analysis",
+        description="trace-safety linter for to_static programs")
+    ap.add_argument("paths", nargs="+",
+                    help="python files or package directories")
+    ap.add_argument("--mode", choices=("package", "trace"),
+                    default="package",
+                    help="package: trace rules only under @to_static; "
+                         "trace: every function is assumed traced")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit nonzero on warnings as well as errors")
+    ap.add_argument("--no-hint", action="store_true",
+                    help="omit hint lines from the report")
+    args = ap.parse_args(argv)
+
+    n_err = n_warn = n_files = 0
+    for path in args.paths:
+        if not os.path.exists(path):
+            print(f"paddle_tpu.analysis: no such path: {path}",
+                  file=sys.stderr)
+            return 2
+        for f in _iter_py_files(path):
+            n_files += 1
+            for d in lint_file(f, mode=args.mode):
+                print(d.format(with_hint=not args.no_hint))
+                if d.severity == ERROR:
+                    n_err += 1
+                elif d.severity == WARNING:
+                    n_warn += 1
+    print(f"paddle_tpu.analysis: {n_files} file(s), {n_err} error(s), "
+          f"{n_warn} warning(s)")
+    if n_err or (args.strict and n_warn):
+        return 1
+    return 0

@@ -1,0 +1,295 @@
+"""Structured diagnostics shared by the trace-safety linter, the graph
+doctor, and the dy2static converter's runtime errors (ref: the ErrorData /
+error-report machinery in python/paddle/jit/dy2static/error.py (U) — there a
+runtime failure inside translated code is re-raised with the ORIGINAL
+dygraph source location and a suggestion; here the same structured record
+{code, severity, file, line, message, hint} backs three surfaces: the
+pre-trace linter, the post-build graph doctor, and the converter's
+"deliberately NOT converted" runtime error, so the CLI and the runtime tell
+one story).
+
+Rule codes are stable identifiers (PTA = Paddle-Tpu Analysis):
+
+- PTA0xx  constructs the dy2static converter deliberately does not stage
+          (the machine-checked form of the `jit/dy2static.py` docstring
+          contract)
+- PTA1xx  concretization hazards (host-value reads of possibly-traced data)
+- PTA2xx  retrace hazards (per-step recompilation / stale captures)
+- PTA3xx  side effects under trace (mutations the staged program drops)
+- PTA4xx  repo-facing self-lint rules for library code
+- PTA5xx  graph-doctor findings on a recorded Program / traced jaxpr
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+__all__ = ["Diagnostic", "Rule", "RULES", "TraceSafetyWarning",
+           "ERROR", "WARNING", "INFO", "scan_statement"]
+
+ERROR = "error"
+WARNING = "warning"
+INFO = "info"
+
+
+class TraceSafetyWarning(UserWarning):
+    """Emitted by `to_static(..., check=True)` at decoration time."""
+
+
+@dataclass(frozen=True)
+class Rule:
+    code: str
+    severity: str
+    title: str
+    hint: str
+    # which upstream dy2static/program-validation error this rule mirrors
+    # (surfaced in docs/PARITY.md)
+    mirrors: str = ""
+
+
+@dataclass
+class Diagnostic:
+    code: str
+    severity: str
+    file: str
+    line: int
+    message: str
+    hint: str = ""
+
+    def format(self, with_hint=True):
+        s = f"{self.file}:{self.line}: {self.code} {self.severity}: " \
+            f"{self.message}"
+        if with_hint and self.hint:
+            s += f"\n    hint: {self.hint}"
+        return s
+
+    def __str__(self):
+        return self.format()
+
+
+_RULE_LIST = [
+    # ---- PTA0xx: the converter's "deliberately NOT converted" contract
+    Rule("PTA001", WARNING,
+         "`del` inside a convertible control-flow body",
+         "the if/while stays plain Python: fine for concrete predicates, "
+         "but a traced tensor predicate will fail at run time — hoist the "
+         "`del` out of the branch/loop body",
+         mirrors="dy2static ifelse_transformer unsupported-stmt fallback"),
+    Rule("PTA002", WARNING,
+         "`global`/`nonlocal` declaration inside a convertible "
+         "control-flow body",
+         "staged branches carry assigned names as explicit dataflow; "
+         "declare the name outside the if/while and assign through a local",
+         mirrors="dy2static create_nonlocal_stmts limitation"),
+    Rule("PTA003", WARNING,
+         "`while/else` / `for/else` is never staged",
+         "the else clause has no lax equivalent — restructure as a flag "
+         "checked after the loop",
+         mirrors="dy2static loop_transformer (no else-clause support)"),
+    Rule("PTA004", WARNING,
+         "early exit (`return`/`break`/`continue`) inside `with`/`try`",
+         "the early-exit rewrite cannot guard statements across a context "
+         "manager or exception handler — move the exit out of the "
+         "with/try block",
+         mirrors="dy2static return_transformer unsupported placement"),
+    Rule("PTA005", ERROR,
+         "generator/coroutine passed to to_static",
+         "yield/await cannot be staged into one XLA program; make the "
+         "function return whole tensors (e.g. a stacked scan output)",
+         mirrors="dy2static convert_call generator passthrough"),
+    Rule("PTA006", WARNING,
+         "`return` inside a non-range `for` loop",
+         "only `for i in range(...)` (and `for x in <tensor>`) loops get "
+         "the early-exit rewrite — iterate by index or restructure",
+         mirrors="dy2static break_continue_transformer scope limits"),
+    Rule("PTA007", WARNING,
+         "early exit the staging rewrite cannot reach",
+         "this return/break/continue survives the early-exit rewrite, so "
+         "the enclosing statement stays plain Python and fails for traced "
+         "predicates — simplify the exit structure",
+         mirrors="dy2static return_transformer fallback"),
+    # ---- PTA1xx: concretization hazards
+    Rule("PTA101", WARNING,
+         "concretization: host read of a possibly-traced value",
+         ".numpy()/.item()/.tolist() force a device sync and raise under "
+         "jit tracing — keep the computation in tensor ops, or move the "
+         "host read outside the traced function",
+         mirrors="Variable.numpy() restriction under @to_static"),
+    Rule("PTA102", WARNING,
+         "concretization: int()/float()/bool() on a possibly-traced value",
+         "Python scalar coercion needs a concrete value and raises a "
+         "TracerError under jit — use tensor ops (astype/cast, comparisons) "
+         "instead",
+         mirrors="dy2static convert_var_dtype"),
+    Rule("PTA103", ERROR,
+         "tensor-dependent branch in a scope the converter cannot stage",
+         "this if/while predicate depends on traced data but the statement "
+         "contains an unconvertible construct, so it will raise at trace "
+         "time — fix the construct or keep the predicate concrete",
+         mirrors="dy2static ifelse_transformer + error.py report"),
+    # ---- PTA2xx: retrace hazards
+    Rule("PTA201", WARNING,
+         "mutable global read under trace",
+         "the value is captured as a compile-time constant: later mutations "
+         "are silently ignored by cached traces — pass it as an argument "
+         "or make it an immutable constant",
+         mirrors="ProgramCache keyed on function + input signature"),
+    Rule("PTA202", WARNING,
+         "Python-side RNG under trace",
+         "random()/np.random draw ONCE at trace time and bake the value "
+         "into the compiled program — use paddle.rand/randn (traced, keyed "
+         "RNG) instead",
+         mirrors="dygraph-vs-static RNG divergence (seed program ops)"),
+    Rule("PTA203", INFO,
+         "shape-dependent Python branching",
+         "branching on .shape specializes the trace: every new input shape "
+         "recompiles — pad to fixed shapes or mark the dim dynamic in "
+         "InputSpec",
+         mirrors="to_static input_spec re-trace policy"),
+    # ---- PTA3xx: side effects under trace
+    Rule("PTA301", WARNING,
+         "mutation of module/self state under trace",
+         "attribute writes on the layer run at TRACE time, not per step; "
+         "buffers must flow through return values (or register_buffer) to "
+         "update inside the compiled program",
+         mirrors="dy2static convert_attr / parameter write-back rules"),
+    Rule("PTA302", WARNING,
+         "mutation of an outer container under trace",
+         "append/update on a closure or global container runs once at "
+         "trace time (and leaks tracers out of the trace) — accumulate in "
+         "a local and return it",
+         mirrors="dy2static list_transformer (tensor-array conversion)"),
+    # ---- PTA4xx: repo-facing self-lint
+    Rule("PTA401", ERROR,
+         "module-level jax.jit without static-arg annotation",
+         "a jit created at import time hashes every non-array argument by "
+         "value on each call; annotate static_argnums/static_argnames (or "
+         "build the jit inside the function where config rides the "
+         "closure)",
+         mirrors="to_static input_spec contract"),
+    Rule("PTA402", ERROR,
+         "possibly tracer-leaking store into a module-level cache",
+         "storing an argument-derived value into module state from inside "
+         "potentially-traced code can leak tracers across traces; key "
+         "caches on concrete metadata only, or suppress with `# noqa: "
+         "PTA402` after verifying only concrete values reach this line",
+         mirrors="ProgramCache lifetime rules"),
+    # ---- PTA5xx: graph doctor
+    Rule("PTA501", WARNING,
+         "dead node: recorded op unreachable from any fetch",
+         "the op was recorded into the Program (or traced into the jaxpr) "
+         "but no fetch depends on it — dead compute is compiled and "
+         "executed for effects-free ops by the reference executor; remove "
+         "it or fetch its output",
+         mirrors="Program prune/garbage-collection pass"),
+    Rule("PTA502", WARNING,
+         "unused feed: placeholder/input never consumed",
+         "the feed is declared but no fetched value depends on it — drop "
+         "the placeholder or wire it into the graph",
+         mirrors="Executor feed/fetch validation"),
+    Rule("PTA503", WARNING,
+         "silent dtype widening",
+         "a low-precision operand (bf16/f16) is silently promoted to f32+ "
+         "(or f32 to f64 under x64): the op runs at the wide dtype and the "
+         "memory/speed benefit of the narrow dtype is lost — cast "
+         "explicitly or align operand dtypes",
+         mirrors="AMP o2 white/black-list promotion checks"),
+    Rule("PTA504", WARNING,
+         "host-callback/sync point inside the compiled program",
+         "a host callback serializes the device pipeline every step — "
+         "replace debug callbacks/py callbacks with traced ops, or hoist "
+         "them out of the hot program",
+         mirrors="InterpreterCore D2H sync detection"),
+    Rule("PTA505", ERROR,
+         "collective over a mesh axis that is not bound",
+         "the program psums/gathers over an axis name absent from the "
+         "device mesh — it will fail (or silently no-op) at dispatch; "
+         "check fleet topology axis names ('dp','pp','sharding','sep',"
+         "'mp')",
+         mirrors="ProcessGroup ring-id validation on c_* ops"),
+]
+
+RULES = {r.code: r for r in _RULE_LIST}
+
+
+def make(code, file, line, message=None, severity=None, hint=None):
+    """Build a Diagnostic from the registry, with optional overrides."""
+    r = RULES[code]
+    return Diagnostic(code=code, severity=severity or r.severity,
+                      file=file, line=int(line),
+                      message=message or r.title, hint=hint or r.hint)
+
+
+# --------------------------------------------------------------------------
+# The "deliberately NOT converted" contract of jit/dy2static.py as a
+# machine-checked classifier. `scan_statement` reports, for ONE if/while/for
+# statement, every reason the converter will leave it as plain Python —
+# used by the linter (PTA0xx findings) and by the converter itself to cite
+# the matching code in its runtime error.
+
+_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+
+
+def _is_range_call(it):
+    return (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+            and it.func.id == "range" and not it.keywords
+            and 1 <= len(it.args) <= 3
+            and not any(isinstance(a, ast.Starred) for a in it.args))
+
+
+def scan_statement(node, include_plain_exits=False):
+    """Reasons `node` (an ast.If / ast.While / ast.For) cannot be staged,
+    as [(code, lineno)] in source order. With include_plain_exits, a bare
+    return/break/continue remaining in the body (i.e. one the early-exit
+    rewrite did not consume) reports as PTA007 — the converter uses that
+    form; the linter does not (plain exits normally DO stage)."""
+    out = []
+    if isinstance(node, (ast.While, ast.For, ast.AsyncFor)) and node.orelse:
+        out.append(("PTA003", node.lineno))
+
+    def walk(stmts, in_with, loop_stack):
+        for s in stmts:
+            if isinstance(s, _SCOPES):
+                continue
+            if isinstance(s, ast.Delete):
+                out.append(("PTA001", s.lineno))
+            elif isinstance(s, (ast.Global, ast.Nonlocal)):
+                out.append(("PTA002", s.lineno))
+            elif isinstance(s, (ast.Return, ast.Break, ast.Continue)):
+                if in_with:
+                    out.append(("PTA004", s.lineno))
+                elif isinstance(s, ast.Return) and "iter" in loop_stack:
+                    out.append(("PTA006", s.lineno))
+                elif isinstance(s, (ast.Break, ast.Continue)) \
+                        and loop_stack:
+                    pass        # belongs to the inner loop's own rewrite
+                elif include_plain_exits:
+                    out.append(("PTA007", s.lineno))
+            if isinstance(s, (ast.With, ast.AsyncWith)):
+                walk(s.body, True, loop_stack)
+            elif isinstance(s, ast.Try):
+                for blk in (s.body, s.orelse, s.finalbody):
+                    walk(blk, True, loop_stack)
+                for h in s.handlers:
+                    walk(h.body, True, loop_stack)
+            elif isinstance(s, (ast.For, ast.AsyncFor)):
+                if s.orelse:
+                    out.append(("PTA003", s.lineno))
+                kind = ("range" if isinstance(s, ast.For)
+                        and _is_range_call(s.iter) else "iter")
+                walk(s.body, in_with, loop_stack + [kind])
+                walk(s.orelse, in_with, loop_stack)
+            elif isinstance(s, ast.While):
+                if s.orelse:
+                    out.append(("PTA003", s.lineno))
+                walk(s.body, in_with, loop_stack + ["while"])
+                walk(s.orelse, in_with, loop_stack)
+            elif isinstance(s, ast.If):
+                walk(s.body, in_with, loop_stack)
+                walk(s.orelse, in_with, loop_stack)
+
+    for body in (node.body, getattr(node, "orelse", []) or []):
+        walk(body, False, [])
+    out.sort(key=lambda cl: cl[1])
+    return out

@@ -1,0 +1,643 @@
+"""AST trace-safety linter (ref: the validation/error layer around
+python/paddle/jit/dy2static/ (U) — there unsupported constructs surface as
+Dygraph2StaticException with source-mapped reports at TRANSLATION time;
+here the same contract is checked WITHOUT running or tracing the function).
+
+Two modes share one engine:
+
+- **trace mode** (`paddle_tpu.analysis.check(fn)` / `to_static(...,
+  check=True)`): every function in the source is assumed to run under
+  trace; parameters are treated as possibly-traced values and the full
+  rule set applies (PTA0xx unconvertible constructs, PTA1xx
+  concretization, PTA2xx retrace, PTA3xx side effects).
+- **package mode** (`python -m paddle_tpu.analysis <path>` / the repo
+  self-lint gate): only functions decorated with `to_static` get the
+  trace rules; every function gets the library self-lint rules (PTA401
+  module-level jax.jit without static-arg annotation, PTA402
+  tracer-leaking cache stores).
+
+Taint is a deliberately simple forward dataflow: parameters start tainted
+("possibly traced"), any name assigned from an expression that reads a
+tainted name becomes tainted, literals stay clean. One-sided and
+loop-carried flows are handled by a second body pass with (code, line)
+dedup. False negatives are acceptable (it is a linter); false positives
+are suppressible with `# noqa: PTA0xx` on the offending line.
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+import textwrap
+
+from .diagnostics import Diagnostic, RULES, make, scan_statement
+
+__all__ = ["check", "lint_source", "lint_file", "apply_noqa"]
+
+_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+_BUILTIN_NAMES = frozenset(dir(builtins))
+
+_CONCRETIZE_METHODS = ("numpy", "item", "tolist")
+_COERCE_FUNCS = ("int", "float", "bool")
+_MUTATOR_METHODS = ("append", "extend", "insert", "add", "update",
+                    "setdefault", "pop", "popitem", "remove", "clear")
+
+
+def _dotted(node):
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _root_name(node):
+    """The root ast.Name of an Attribute/Subscript/Call chain, else None."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node if isinstance(node, ast.Name) else None
+
+
+def _names_in(expr):
+    return {n.id for n in ast.walk(expr) if isinstance(n, ast.Name)}
+
+
+def _target_names(t, out):
+    if isinstance(t, ast.Name):
+        out.add(t.id)
+    elif isinstance(t, (ast.Tuple, ast.List)):
+        for e in t.elts:
+            _target_names(e, out)
+    elif isinstance(t, ast.Starred):
+        _target_names(t.value, out)
+
+
+def _local_bindings(fdef):
+    """Every name the function body binds (params, assignments, loop
+    targets, withitems, imports, nested defs) — used to distinguish local
+    reads from global/closure reads. Nested scopes keep their own."""
+    out = set()
+    a = fdef.args
+    for arg in (a.posonlyargs + a.args + a.kwonlyargs):
+        out.add(arg.arg)
+    for v in (a.vararg, a.kwarg):
+        if v is not None:
+            out.add(v.arg)
+
+    def walk(stmts):
+        for node in stmts:
+            if isinstance(node, _SCOPES):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    out.add(node.name)
+                continue
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    _target_names(t, out)
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                _target_names(node.target, out)
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                _target_names(node.target, out)
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    if item.optional_vars is not None:
+                        _target_names(item.optional_vars, out)
+            elif isinstance(node, ast.Import):
+                for al in node.names:
+                    out.add((al.asname or al.name).split(".")[0])
+            elif isinstance(node, ast.ImportFrom):
+                for al in node.names:
+                    out.add(al.asname or al.name)
+            for sub in ast.walk(node) if not isinstance(node, _SCOPES) \
+                    else ():
+                if isinstance(sub, ast.NamedExpr) \
+                        and isinstance(sub.target, ast.Name):
+                    out.add(sub.target.id)
+                elif isinstance(sub, ast.ExceptHandler) and sub.name:
+                    out.add(sub.name)
+            for attr in ("body", "orelse", "finalbody"):
+                child = getattr(node, attr, None)
+                if child:
+                    walk(child)
+            for h in getattr(node, "handlers", ()) or ():
+                walk(h.body)
+
+    walk(fdef.body)
+    return out
+
+
+class _ModuleContext:
+    """Per-file facts the function passes need: which module-level names
+    are (probably) mutable containers, and which functions carry a
+    to_static-ish decorator."""
+
+    _MUTABLE_CALLS = ("list", "dict", "set", "bytearray", "OrderedDict",
+                      "defaultdict", "deque", "Counter")
+
+    def __init__(self, filename="<string>"):
+        self.filename = filename
+        self.mutable_globals = set()
+        self.module_globals = set()
+
+    @classmethod
+    def from_tree(cls, tree, filename):
+        ctx = cls(filename)
+        for node in tree.body:
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            else:
+                continue
+            names = set()
+            for t in targets:
+                _target_names(t, names)
+            ctx.module_globals |= names
+            if isinstance(value, (ast.List, ast.Dict, ast.Set)) or (
+                    isinstance(value, ast.Call)
+                    and (_dotted(value.func) or "").split(".")[-1]
+                    in cls._MUTABLE_CALLS):
+                ctx.mutable_globals |= names
+        return ctx
+
+    @classmethod
+    def from_globals(cls, glb, filename):
+        ctx = cls(filename)
+        for name, val in (glb or {}).items():
+            ctx.module_globals.add(name)
+            if isinstance(val, (list, dict, set, bytearray)):
+                ctx.mutable_globals.add(name)
+        return ctx
+
+
+def _is_to_static_decorated(fdef):
+    for dec in fdef.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        dotted = _dotted(target) or ""
+        if dotted.split(".")[-1] == "to_static":
+            return True
+    return False
+
+
+class _FunctionLinter:
+    """Lints ONE function scope. Nested defs are linted by their own
+    instances (driven from lint_source), so `self.fdef.body` statements
+    are walked with nested scopes skipped."""
+
+    def __init__(self, fdef, ctx, traced, diags):
+        self.fdef = fdef
+        self.ctx = ctx
+        self.traced = traced
+        self._sink = diags
+        self._seen = set()
+        a = fdef.args
+        params = [p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)]
+        for v in (a.vararg, a.kwarg):
+            if v is not None:
+                params.append(v.arg)
+        self.self_names = {n for n in params[:1] if n in ("self", "cls")}
+        self.params = set(params) - self.self_names
+        self.tainted = set(self.params)
+        self.locals = _local_bindings(fdef)
+        self.global_decls = set()
+        self.with_depth = 0
+        self.cf_depth = 0
+        self.iterfor_depth = 0
+        self._flagged_globals = set()
+
+    # -- emission ----------------------------------------------------------
+    def emit(self, code, line, message=None):
+        key = (code, line)
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        self._sink.append(make(code, self.ctx.filename, line,
+                               message=message))
+
+    # -- taint -------------------------------------------------------------
+    def is_tainted(self, expr):
+        if expr is None:
+            return False
+        names = _names_in(expr)
+        if names & self.tainted:
+            return True
+        # attribute reads off self are layer state (weights, buffers):
+        # possibly traced
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Attribute) \
+                    and isinstance(n.value, ast.Name) \
+                    and n.value.id in self.self_names:
+                return True
+        return False
+
+    def taint_target(self, t):
+        names = set()
+        _target_names(t, names)
+        self.tainted |= names
+
+    # -- driver ------------------------------------------------------------
+    def run(self):
+        fdef = self.fdef
+        if self.traced:
+            is_gen = isinstance(fdef, ast.AsyncFunctionDef) or any(
+                isinstance(n, (ast.Yield, ast.YieldFrom, ast.Await))
+                for n in ast.walk(fdef)
+                if not isinstance(n, _SCOPES) or n is fdef)
+            if is_gen:
+                self.emit("PTA005", fdef.lineno)
+        self.walk(fdef.body)
+
+    def walk(self, stmts):
+        for s in stmts:
+            self.stmt(s)
+
+    def stmt(self, s):
+        if isinstance(s, _SCOPES):
+            return
+        m = getattr(self, "stmt_" + type(s).__name__, None)
+        if m is not None:
+            m(s)
+            return
+        for v in ast.iter_child_nodes(s):
+            if isinstance(v, ast.expr):
+                self.expr(v)
+        for attr in ("body", "orelse", "finalbody"):
+            child = getattr(s, attr, None)
+            if child:
+                self.walk(child)
+        for h in getattr(s, "handlers", ()) or ():
+            self.walk(h.body)
+
+    # -- statements --------------------------------------------------------
+    def stmt_Delete(self, s):
+        if self.traced and self.cf_depth > 0:
+            self.emit("PTA001", s.lineno)
+
+    def stmt_Global(self, s):
+        self.global_decls |= set(s.names)
+        if self.traced and self.cf_depth > 0:
+            self.emit("PTA002", s.lineno)
+
+    def stmt_Nonlocal(self, s):
+        if self.traced and self.cf_depth > 0:
+            self.emit("PTA002", s.lineno)
+
+    def stmt_Return(self, s):
+        if self.traced and self.with_depth > 0:
+            self.emit("PTA004", s.lineno)
+        elif self.traced and self.iterfor_depth > 0:
+            self.emit("PTA006", s.lineno)
+        if s.value is not None:
+            self.expr(s.value)
+
+    def _exit(self, s):
+        if self.traced and self.with_depth > 0:
+            self.emit("PTA004", s.lineno)
+
+    stmt_Break = _exit
+    stmt_Continue = _exit
+
+    def stmt_Assign(self, s):
+        self.expr(s.value)
+        tainted = self.is_tainted(s.value)
+        for t in s.targets:
+            self._check_store(t, s, tainted)
+            if tainted:
+                self.taint_target(t)
+
+    def stmt_AugAssign(self, s):
+        self.expr(s.value)
+        tainted = self.is_tainted(s.value) or self.is_tainted(s.target)
+        self._check_store(s.target, s, tainted)
+        if tainted:
+            self.taint_target(s.target)
+
+    def stmt_AnnAssign(self, s):
+        if s.value is None:
+            return
+        self.expr(s.value)
+        tainted = self.is_tainted(s.value)
+        self._check_store(s.target, s, tainted)
+        if tainted:
+            self.taint_target(s.target)
+
+    def _check_store(self, target, s, tainted):
+        # PTA301: attribute write on self/a parameter under trace
+        if self.traced and isinstance(target, ast.Attribute):
+            root = _root_name(target)
+            if root is not None \
+                    and root.id in (self.params | self.self_names):
+                self.emit("PTA301", s.lineno)
+        # PTA402 (any mode): subscript store into a module-level name of a
+        # value derived from this function's arguments. Constant-index
+        # slot writes (`_CONFIG[0] = x`) are module config registers, a
+        # deliberate pattern — only keyed (cache-like) stores flag.
+        if isinstance(target, ast.Subscript) \
+                and not isinstance(target.slice, ast.Constant):
+            root = _root_name(target)
+            if root is not None and root.id not in self.locals \
+                    and (root.id in self.ctx.module_globals
+                         or root.id in self.global_decls) \
+                    and tainted:
+                self.emit("PTA402", s.lineno)
+
+    def stmt_If(self, s):
+        self._branch_test(s, s.test)
+        self.cf_depth += 1
+        self._walk_twice(s.body)
+        self._walk_twice(s.orelse)
+        self.cf_depth -= 1
+
+    def stmt_While(self, s):
+        self._branch_test(s, s.test)
+        if s.orelse and self.traced:
+            self.emit("PTA003", s.lineno)
+        self.cf_depth += 1
+        self._walk_twice(s.body)
+        self.walk(s.orelse)
+        self.cf_depth -= 1
+
+    def stmt_For(self, s):
+        self.expr(s.iter)
+        if s.orelse and self.traced:
+            self.emit("PTA003", s.lineno)
+        if self.is_tainted(s.iter):
+            self.taint_target(s.target)
+        from .diagnostics import _is_range_call
+
+        non_range = not _is_range_call(s.iter)
+        self.cf_depth += 1
+        if non_range:
+            self.iterfor_depth += 1
+        self._walk_twice(s.body)
+        if non_range:
+            self.iterfor_depth -= 1
+        self.walk(s.orelse)
+        self.cf_depth -= 1
+
+    stmt_AsyncFor = stmt_For
+
+    def stmt_With(self, s):
+        for item in s.items:
+            self.expr(item.context_expr)
+            if item.optional_vars is not None \
+                    and self.is_tainted(item.context_expr):
+                self.taint_target(item.optional_vars)
+        self.with_depth += 1
+        self.walk(s.body)
+        self.with_depth -= 1
+
+    stmt_AsyncWith = stmt_With
+
+    def stmt_Try(self, s):
+        self.with_depth += 1
+        self.walk(s.body)
+        for h in s.handlers:
+            self.walk(h.body)
+        self.walk(s.orelse)
+        self.walk(s.finalbody)
+        self.with_depth -= 1
+
+    def stmt_Expr(self, s):
+        self.expr(s.value)
+
+    def _walk_twice(self, stmts):
+        """Second pass propagates loop-carried / cross-branch taint; the
+        (code, line) dedup in emit() keeps diagnostics single."""
+        if not stmts:
+            return
+        before = set(self.tainted)
+        self.walk(stmts)
+        if self.tainted != before:
+            self.walk(stmts)
+
+    def _branch_test(self, s, test):
+        self.expr(test)
+        if not self.traced:
+            return
+        if self.is_tainted(test):
+            # PTA203: shape-dependent python branch (retrace per shape)
+            for n in ast.walk(test):
+                if isinstance(n, ast.Attribute) and n.attr == "shape" \
+                        and self.is_tainted(n.value):
+                    self.emit("PTA203", s.lineno)
+                    break
+            # PTA103 + the construct's own PTA0xx: a traced predicate on a
+            # statement the converter refuses to stage fails at trace time
+            reasons = scan_statement(s)
+            if reasons:
+                self.emit("PTA103", s.lineno)
+                for code, line in reasons:
+                    self.emit(code, line)
+
+    # -- expressions -------------------------------------------------------
+    def expr(self, e):
+        if e is None or isinstance(e, _SCOPES):
+            return
+        for node in ast.walk(e):
+            if isinstance(node, ast.Call):
+                self._call(node)
+            elif isinstance(node, ast.Name) \
+                    and isinstance(node.ctx, ast.Load):
+                self._name_load(node)
+
+    def _call(self, node):
+        if not self.traced:
+            return
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            if f.attr in _CONCRETIZE_METHODS and self.is_tainted(f.value):
+                self.emit("PTA101", node.lineno)
+            if f.attr in _MUTATOR_METHODS:
+                root = _root_name(f.value)
+                if root is not None and root.id not in self.locals \
+                        and root.id not in _BUILTIN_NAMES \
+                        and not isinstance(f.value, ast.Attribute):
+                    # container named by an outer (global/closure) binding
+                    self.emit("PTA302", node.lineno)
+                elif root is not None and root.id in self.self_names:
+                    self.emit("PTA301", node.lineno)
+            dotted = _dotted(f) or ""
+            parts = dotted.split(".")
+            if "random" in parts[:-1] or parts[0] == "random":
+                # random.random(), np.random.*, numpy.random.*
+                self.emit("PTA202", node.lineno)
+        elif isinstance(f, ast.Name):
+            if f.id in _COERCE_FUNCS and node.args \
+                    and self.is_tainted(node.args[0]):
+                self.emit("PTA102", node.lineno)
+
+    def _name_load(self, node):
+        if not self.traced:
+            return
+        nid = node.id
+        if nid in self.locals or nid in _BUILTIN_NAMES:
+            return
+        if nid in self.ctx.mutable_globals \
+                and nid not in self._flagged_globals:
+            self._flagged_globals.add(nid)
+            self.emit("PTA201", node.lineno,
+                      message=f"mutable global {nid!r} read under trace "
+                              "is captured as a compile-time constant")
+
+
+# --------------------------------------------------------------------------
+# module-level self-lint (package mode)
+
+
+def _jit_call_missing_static(call):
+    """True when `call` is jax.jit(...) / functools.partial(jax.jit, ...)
+    with no static_argnums/static_argnames annotation."""
+    dotted = _dotted(call.func) or ""
+    kw = {k.arg for k in call.keywords}
+    if dotted.split(".")[-1] == "partial" and call.args \
+            and (_dotted(call.args[0]) or "").endswith("jax.jit"):
+        return not (kw & {"static_argnums", "static_argnames"})
+    if dotted == "jax.jit" or dotted.endswith(".jax.jit"):
+        return not (kw & {"static_argnums", "static_argnames"})
+    return False
+
+
+def _lint_module_level(tree, ctx, diags):
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    if _jit_call_missing_static(dec):
+                        diags.append(make("PTA401", ctx.filename,
+                                          dec.lineno))
+                elif (_dotted(dec) or "") == "jax.jit":
+                    diags.append(make("PTA401", ctx.filename, dec.lineno))
+        elif isinstance(node, ast.Assign):
+            if isinstance(node.value, ast.Call) \
+                    and _jit_call_missing_static(node.value):
+                diags.append(make("PTA401", ctx.filename, node.lineno))
+
+
+# --------------------------------------------------------------------------
+# entry points
+
+
+def _iter_functions(tree):
+    """(fdef, enclosing_chain) for every def at any nesting depth."""
+    stack = [(n, ()) for n in tree.body]
+    while stack:
+        node, chain = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node, chain
+            for child in node.body:
+                stack.append((child, chain + (node,)))
+        elif isinstance(node, ast.ClassDef):
+            for child in node.body:
+                stack.append((child, chain))
+        else:
+            for attr in ("body", "orelse", "finalbody"):
+                for child in getattr(node, attr, None) or ():
+                    stack.append((child, chain))
+            for h in getattr(node, "handlers", ()) or ():
+                for child in h.body:
+                    stack.append((child, chain))
+
+
+def apply_noqa(diags, source):
+    """Honor `# noqa` / `# noqa: PTA001[,PTA002]` markers on the flagged
+    line."""
+    lines = source.splitlines()
+    out = []
+    for d in diags:
+        if 1 <= d.line <= len(lines):
+            line = lines[d.line - 1]
+            idx = line.find("# noqa")
+            if idx >= 0:
+                rest = line[idx + len("# noqa"):]
+                if not rest.lstrip().startswith(":"):
+                    continue                      # bare noqa: drop all
+                codes = rest.lstrip()[1:].replace(",", " ").split()
+                if d.code in codes:
+                    continue
+        out.append(d)
+    return out
+
+
+def lint_source(source, filename="<string>", mode="trace",
+                fn_globals=None, line_offset=0):
+    """Lint python source. mode='trace' treats every function as traced;
+    mode='package' applies trace rules only under to_static decorators and
+    self-lint rules everywhere. Returns [Diagnostic] sorted by line."""
+    source = textwrap.dedent(source)
+    tree = ast.parse(source)
+    if fn_globals is not None:
+        ctx = _ModuleContext.from_globals(fn_globals, filename)
+    else:
+        ctx = _ModuleContext.from_tree(tree, filename)
+    diags = []
+    _lint_module_level(tree, ctx, diags)
+    for fdef, chain in _iter_functions(tree):
+        traced = (mode == "trace" or _is_to_static_decorated(fdef)
+                  or any(_is_to_static_decorated(f) for f in chain))
+        _FunctionLinter(fdef, ctx, traced, diags).run()
+    diags = apply_noqa(diags, source)
+    for d in diags:
+        d.line += line_offset
+    diags.sort(key=lambda d: (d.line, d.code))
+    return diags
+
+
+def lint_file(path, mode="package"):
+    with open(path, "r", encoding="utf-8") as f:
+        src = f.read()
+    try:
+        return lint_source(src, filename=str(path), mode=mode)
+    except SyntaxError as e:
+        return [Diagnostic(code="PTA000", severity="error", file=str(path),
+                           line=int(e.lineno or 0),
+                           message=f"could not parse: {e.msg}", hint="")]
+
+
+def check(fn):
+    """Lint a function (or Layer / to_static-wrapped callable) WITHOUT
+    running it. Returns [Diagnostic]; empty means no findings. The
+    function's real file/line numbers are used, and its live globals feed
+    the mutable-global capture rule (PTA201)."""
+    import inspect
+
+    target = fn
+    # Layer -> its forward; StaticFunction and decorated wrappers unwrap
+    fwd = getattr(target, "forward", None)
+    if fwd is not None and not inspect.isfunction(target) \
+            and not inspect.ismethod(target):
+        target = fwd
+    seen = set()
+    while getattr(target, "__wrapped__", None) is not None \
+            and id(target) not in seen:
+        seen.add(id(target))
+        target = target.__wrapped__
+    inner = getattr(target, "_fn", None)        # StaticFunction
+    if inner is not None and not inspect.isfunction(target):
+        target = inner
+    if isinstance(target, (staticmethod, classmethod)):
+        target = target.__func__
+    if inspect.ismethod(target):
+        target = target.__func__
+    if not (inspect.isfunction(target) or inspect.ismethod(target)):
+        raise TypeError(
+            f"analysis.check expects a function, method, Layer, or "
+            f"to_static-wrapped callable, got {type(fn).__name__}")
+    try:
+        src_lines, src_start = inspect.getsourcelines(target)
+        src = "".join(src_lines)
+        srcfile = inspect.getsourcefile(target) or "<unknown>"
+        line0 = src_start - 1
+    except (OSError, TypeError):
+        return []
+    try:
+        return lint_source(src, filename=srcfile, mode="trace",
+                           fn_globals=getattr(target, "__globals__", None),
+                           line_offset=line0)
+    except SyntaxError:
+        return []

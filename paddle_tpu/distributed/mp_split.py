@@ -63,5 +63,5 @@ def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
             raise ValueError(
                 f"unknown split operation {operation!r}; use "
                 "'linear' or 'embedding'")
-        _SPLIT_CACHE[key] = layer
+        _SPLIT_CACHE[key] = layer  # noqa: PTA402 -- keyed on concrete config, stores a Layer
     return layer(x)

@@ -13,8 +13,15 @@ try:
     #: kwargs disabling the output-replication check, matching the import
     NO_CHECK = {"check_vma": False}
 except ImportError:  # older jax layout (and its older kwarg name)
-    from jax.experimental.shard_map import shard_map
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
 
     NO_CHECK = {"check_rep": False}
+
+    def shard_map(*args, check_vma=None, **kwargs):
+        # accept the modern kwarg spelling and translate it, so callers
+        # written against jax>=0.8 work unchanged on the legacy API
+        if check_vma is not None:
+            kwargs.setdefault("check_rep", check_vma)
+        return _legacy_shard_map(*args, **kwargs)
 
 __all__ = ["shard_map", "NO_CHECK"]

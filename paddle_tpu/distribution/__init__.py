@@ -490,7 +490,7 @@ def register_kl(cls_p, cls_q):
     """Decorator registering a custom KL rule (ref register_kl)."""
 
     def decorator(fn):
-        _KL_REGISTRY[(cls_p, cls_q)] = fn
+        _KL_REGISTRY[(cls_p, cls_q)] = fn  # noqa: PTA402 -- import-time rule registry
         return fn
 
     return decorator

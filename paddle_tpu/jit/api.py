@@ -301,16 +301,37 @@ def _unflatten_tree(tree, tensors):
 
 
 def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
-              full_graph=True, **kwargs):
-    """Decorator/wrapper: compile a function or a Layer's forward with XLA."""
+              full_graph=True, check=False, **kwargs):
+    """Decorator/wrapper: compile a function or a Layer's forward with XLA.
+
+    check=True runs the trace-safety linter (paddle_tpu.analysis.check)
+    over the function at DECORATION time and emits each finding as a
+    TraceSafetyWarning — hazards surface before the first trace."""
+
+    def _run_check(fn):
+        import warnings
+
+        from ..analysis import check as _lint_check
+        from ..analysis.diagnostics import TraceSafetyWarning
+
+        try:
+            diags = _lint_check(fn)
+        except TypeError:
+            return
+        for d in diags:
+            warnings.warn(d.format(), TraceSafetyWarning, stacklevel=4)
 
     def decorate(obj):
         if isinstance(obj, Layer):
+            if check:
+                _run_check(obj.forward)
             static = StaticFunction(obj.forward, layer=obj,
                                     input_spec=input_spec,
                                     full_graph=full_graph)
             obj.forward = static
             return obj
+        if check:
+            _run_check(obj)
         return StaticFunction(obj, layer=None, input_spec=input_spec,
                               full_graph=full_graph)
 

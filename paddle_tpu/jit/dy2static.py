@@ -64,7 +64,8 @@ import types
 __all__ = ["convert_to_static", "convert_call", "convert_ifelse",
            "convert_while", "convert_for_range", "convert_for_iter",
            "convert_logical_and", "convert_logical_or",
-           "convert_logical_not", "range_parts", "UndefinedVar", "UNDEF"]
+           "convert_logical_not", "range_parts", "UndefinedVar", "UNDEF",
+           "UnconvertibleControlFlowError", "unconvertible_guard"]
 
 
 class UndefinedVar:
@@ -133,6 +134,32 @@ def _is_traced(x):
     if isinstance(x, jax.core.Tracer):
         return True
     return type(x).__name__ in ("_SymArr", "_GradSym")
+
+
+class UnconvertibleControlFlowError(TypeError):
+    """A traced predicate reached an if/while the converter deliberately
+    left as plain Python. The message cites the analysis rule code(s) and
+    hint(s) — the same diagnostics `paddle_tpu.analysis.check` reports
+    before tracing (the ErrorData-style shared report)."""
+
+
+def unconvertible_guard(pred, reasons, filename, line):
+    """Runtime guard the transformer wraps around the test of an
+    UNCONVERTIBLE if/while: concrete predicates pass through with exact
+    Python semantics; a traced predicate raises a source-mapped error
+    citing each PTA diagnostic instead of jax's deep concretization
+    traceback. `reasons`: ((code, absolute_line), ...)."""
+    if not _is_traced(pred):
+        return pred
+    from ..analysis.diagnostics import make
+
+    parts = [make(code, filename, ln).format() for code, ln in reasons]
+    raise UnconvertibleControlFlowError(
+        f"{filename}:{line}: this if/while has a traced (tensor) "
+        "predicate, but the statement contains construct(s) dy2static "
+        "deliberately does not stage — run "
+        "paddle_tpu.analysis.check(fn) before tracing to see these "
+        "findings early:\n" + "\n".join(parts))
 
 
 def _to_carry(x, name):
@@ -1296,9 +1323,12 @@ class _PredicateTransformer(ast.NodeTransformer):
 
 
 class _Dy2StaticTransformer(ast.NodeTransformer):
-    def __init__(self):
+    def __init__(self, filename="<dy2static>", line_base=0):
         self.counter = 0
         self.converted_any = False
+        self.guarded = False
+        self.filename = filename
+        self.line_base = line_base
 
     # nested scopes keep their own control flow untouched by THIS pass
     def visit_FunctionDef(self, node):
@@ -1328,10 +1358,35 @@ class _Dy2StaticTransformer(ast.NodeTransformer):
             keywords=[])
         return stmts, call
 
+    def _guard_unconvertible(self, node):
+        """Wrap the test of an unconvertible if/while so a TRACED
+        predicate raises the shared-diagnostic error instead of jax's
+        concretization traceback. Concrete predicates keep exact Python
+        semantics (the guard is identity for them)."""
+        if getattr(node, "_jst_guard", False):
+            return node      # generated flag-guard ifs are ours
+        from ..analysis.diagnostics import scan_statement
+
+        reasons = scan_statement(node, include_plain_exits=True)
+        if not reasons:
+            return node
+        node.test = ast.Call(
+            func=ast.Attribute(value=_load(_HELPER),
+                               attr="unconvertible_guard", ctx=ast.Load()),
+            args=[node.test,
+                  ast.Constant(value=tuple(
+                      (c, self.line_base + ln) for c, ln in reasons)),
+                  ast.Constant(value=self.filename),
+                  ast.Constant(value=self.line_base + node.lineno)],
+            keywords=[])
+        ast.copy_location(node.test, node)
+        self.guarded = True
+        return node
+
     def visit_If(self, node):
         node = self.generic_visit(node)
         if not _convertible(node):
-            return node
+            return self._guard_unconvertible(node)
         node.test = _PredicateTransformer.transform(node.test)
         k = self.counter = self.counter + 1
         names = _assigned_names(node.body + node.orelse)
@@ -1404,7 +1459,7 @@ class _Dy2StaticTransformer(ast.NodeTransformer):
     def visit_While(self, node):
         node = self.generic_visit(node)
         if node.orelse or not _convertible(node):
-            return node  # while/else stays Python
+            return self._guard_unconvertible(node)  # while/else: Python
         node.test = _PredicateTransformer.transform(node.test)
         k = self.counter = self.counter + 1
         names = _assigned_names(node.body)
@@ -1498,7 +1553,9 @@ def convert_to_static(fn):
             return ent[1] or fn
     converted = _convert_uncached(fn)
     if cacheable:
-        _CONVERT_CACHE[id(code)] = (code, converted)
+        # keyed on the CODE OBJECT's id with an identity pin — only
+        # concrete function objects reach this store, never tracers
+        _CONVERT_CACHE[id(code)] = (code, converted)  # noqa: PTA402
     return converted or fn
 
 
@@ -1517,7 +1574,8 @@ def _convert_uncached(fn):
         # ones get the standard concretization error)
         return None
     try:
-        src = textwrap.dedent(inspect.getsource(fn))
+        src_lines, src_start = inspect.getsourcelines(fn)
+        src = textwrap.dedent("".join(src_lines))
         tree = ast.parse(src)
     except (OSError, TypeError, SyntaxError, IndentationError):
         return None
@@ -1532,7 +1590,11 @@ def _convert_uncached(fn):
     fdef.decorator_list = []       # re-applying the decorator would recurse
     # pass 1: early exits (return/break/continue) -> flag-guarded dataflow
     _EarlyExit().transform(fdef)
-    tf = _Dy2StaticTransformer()
+    try:
+        srcfile = inspect.getsourcefile(fn) or "<dy2static>"
+    except TypeError:
+        srcfile = "<dy2static>"
+    tf = _Dy2StaticTransformer(filename=srcfile, line_base=src_start - 1)
     # transform only the TOP function's statements; visit() on the module
     # would treat the def itself as a nested scope
     fdef.body = [s for stmt in fdef.body
@@ -1542,7 +1604,7 @@ def _convert_uncached(fn):
     # control flow of its own still converts for its call sites)
     ct = _CallTransformer()
     fdef.body = [ct.visit(s) for s in fdef.body]
-    if not (tf.converted_any or ct.wrapped):
+    if not (tf.converted_any or ct.wrapped or tf.guarded):
         return None
     ast.fix_missing_locations(tree)
     # closure cells: rebuild real cells by wrapping the converted def in a

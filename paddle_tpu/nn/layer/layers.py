@@ -23,7 +23,7 @@ _NAME_COUNTERS = {}
 
 def _unique_name(prefix: str) -> str:
     idx = _NAME_COUNTERS.get(prefix, 0)
-    _NAME_COUNTERS[prefix] = idx + 1
+    _NAME_COUNTERS[prefix] = idx + 1  # noqa: PTA402 -- str-keyed int counter
     return f"{prefix}_{idx}"
 
 

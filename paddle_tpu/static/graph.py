@@ -141,14 +141,15 @@ class _ParamRef:
 class _Node:
     """One recorded op: fn(*inputs, **kwargs) -> n outputs."""
 
-    __slots__ = ("fn", "inputs", "kwargs", "n_out", "op_name")
+    __slots__ = ("fn", "inputs", "kwargs", "n_out", "op_name", "out_avals")
 
-    def __init__(self, fn, inputs, kwargs, n_out, op_name):
+    def __init__(self, fn, inputs, kwargs, n_out, op_name, out_avals=()):
         self.fn = fn
         self.inputs = inputs      # list of _SymArr | _ParamRef | jax arrays
         self.kwargs = kwargs
         self.n_out = n_out
         self.op_name = op_name
+        self.out_avals = out_avals   # ShapeDtypeStructs (graph doctor)
 
 
 class Program:
@@ -157,6 +158,7 @@ class Program:
 
     def __init__(self):
         self.placeholders = {}   # name -> Tensor (symbolic)
+        self.nodes = []          # creation-order op record (graph doctor)
         self._train_op = None    # (loss Tensor, optimizer) set by minimize
 
     def global_block(self):
@@ -171,6 +173,7 @@ class Program:
             # ref Program.clone(for_test=True): strip training ops
             c = Program()
             c.placeholders = dict(self.placeholders)
+            c.nodes = list(self.nodes)
             return c
         return self
 
@@ -289,7 +292,9 @@ def _static_apply(fn, args, kwargs, op_name):
     # the eager path's _out_type
     container = tuple if hasattr(out_sds, "_fields") else type(out_sds)
     node = _Node(fn, inputs, kwargs, len(outs_flat),
-                 op_name or getattr(fn, "__name__", "op"))
+                 op_name or getattr(fn, "__name__", "op"),
+                 out_avals=tuple(outs_flat))
+    _state["main"].nodes.append(node)
     out_tensors = []
     for i, sds in enumerate(outs_flat):
         t = Tensor.__new__(Tensor)
@@ -444,7 +449,9 @@ def _content_digest(x):
             _digest_memo.move_to_end(id(x))
             return ent[1]
         d = hashlib.sha1(np.asarray(x).tobytes()).hexdigest()[:16]
-        _digest_memo[id(x)] = (x, d)
+        # identity-verified LRU of concrete arrays only — jax.Array check
+        # above guarantees no tracer reaches this store
+        _digest_memo[id(x)] = (x, d)  # noqa: PTA402
         if len(_digest_memo) > _DIGEST_MEMO_SIZE:
             _digest_memo.popitem(last=False)
         return d

@@ -9,7 +9,7 @@ _COUNTERS = {}
 
 def generate(key):
     idx = _COUNTERS.get(key, 0)
-    _COUNTERS[key] = idx + 1
+    _COUNTERS[key] = idx + 1  # noqa: PTA402 -- str-keyed int counter
     return f"{key}_{idx}"
 
 

@@ -1,0 +1,659 @@
+"""paddle_tpu.analysis: trace-safety linter + graph doctor. Every PTA rule
+code gets one positive (fires on a minimal repro) and one negative (silent
+on the corrected version) case; the dy2static "Deliberately NOT converted"
+docstring constructs are each machine-checked; the converter's runtime
+error and to_static(check=True) share the same diagnostics."""
+
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.analysis import (check, lint_source, lint_file,
+                                 diagnose_jaxpr, diagnose_program,
+                                 doctor, RULES, ERROR, TraceSafetyWarning)
+from paddle_tpu.analysis.diagnostics import scan_statement
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a, np.float32))
+
+
+_CFG = {"scale": 2.0}        # mutable global the check=True test reads
+
+
+def codes_of(src, mode="trace"):
+    return {d.code for d in lint_source(src, filename="t.py", mode=mode)}
+
+
+class TestConverterContractRules:
+    """PTA0xx: the 'Deliberately NOT converted' docstring as rules."""
+
+    def test_pta001_del_in_body(self):
+        pos = """
+def f(x):
+    if x > 0:
+        del x
+    return 1
+"""
+        neg = """
+def f(x):
+    y = x * 2
+    del x
+    return y
+"""
+        assert "PTA001" in codes_of(pos)
+        assert "PTA001" not in codes_of(neg)
+
+    def test_pta002_global_nonlocal_in_body(self):
+        pos = """
+def f(x):
+    if x > 0:
+        global G
+        G = 1
+    return x
+"""
+        pos_nonlocal = """
+def outer():
+    n = 0
+    def f(x):
+        while x > 0:
+            nonlocal n
+            n = n + 1
+            x = x - 1
+        return x
+    return f
+"""
+        neg = """
+def f(x):
+    global G
+    G = 1
+    return x
+"""
+        assert "PTA002" in codes_of(pos)
+        assert "PTA002" in codes_of(pos_nonlocal)
+        assert "PTA002" not in codes_of(neg)
+
+    def test_pta003_loop_else(self):
+        pos = """
+def f(x):
+    while x > 0:
+        x = x - 1
+    else:
+        x = x + 1
+    return x
+"""
+        pos_for = """
+def f(x, items):
+    for i in items:
+        x = x + i
+    else:
+        x = x + 1
+    return x
+"""
+        neg = """
+def f(x):
+    while x > 0:
+        x = x - 1
+    return x
+"""
+        assert "PTA003" in codes_of(pos)
+        assert "PTA003" in codes_of(pos_for)
+        assert "PTA003" not in codes_of(neg)
+
+    def test_pta004_exit_inside_with_try(self):
+        pos_with = """
+def f(x):
+    with open("/dev/null") as fh:
+        if x > 0:
+            return x
+    return x + 1
+"""
+        pos_try = """
+def f(x):
+    while x > 0:
+        try:
+            x = x - 1
+            break
+        except ValueError:
+            pass
+    return x
+"""
+        neg = """
+def f(x):
+    with open("/dev/null") as fh:
+        y = x + 1
+    if x > 0:
+        return y
+    return x
+"""
+        assert "PTA004" in codes_of(pos_with)
+        assert "PTA004" in codes_of(pos_try)
+        assert "PTA004" not in codes_of(neg)
+
+    def test_pta005_generator_coroutine(self):
+        pos = """
+def f(xs):
+    for x in xs:
+        yield x
+"""
+        pos_async = """
+async def f(x):
+    return x
+"""
+        neg = """
+def f(xs):
+    return [x for x in xs]
+"""
+        assert "PTA005" in codes_of(pos)
+        assert "PTA005" in codes_of(pos_async)
+        assert "PTA005" not in codes_of(neg)
+        assert RULES["PTA005"].severity == ERROR
+
+    def test_pta006_return_in_non_range_for(self):
+        pos = """
+def f(x, items):
+    for it in items:
+        if it > 0:
+            return it
+    return x
+"""
+        neg = """
+def f(x):
+    for i in range(10):
+        if i > 5:
+            return i
+    return x
+"""
+        assert "PTA006" in codes_of(pos)
+        assert "PTA006" not in codes_of(neg)
+
+    def test_pta007_unreachable_exit_via_scanner(self):
+        # PTA007 is the converter-side form: a plain exit that SURVIVED
+        # the early-exit rewrite (include_plain_exits=True)
+        import ast
+
+        tree = ast.parse("while x > 0:\n    x = x - 1\n    break\n")
+        node = tree.body[0]
+        codes = {c for c, _ in scan_statement(node,
+                                              include_plain_exits=True)}
+        assert codes == {"PTA007"}
+        assert not scan_statement(node)       # linter form: exits stage
+
+    def test_scanner_covers_docstring_contract(self):
+        """Every construct in the dy2static 'Deliberately NOT converted'
+        list classifies to its code."""
+        import ast
+
+        cases = [
+            ("if x:\n    del y\n", "PTA001"),
+            ("if x:\n    global g\n", "PTA002"),
+            ("if x:\n    nonlocal g\n", "PTA002"),
+            ("while x:\n    x = 1\nelse:\n    x = 2\n", "PTA003"),
+            ("for i in it:\n    x = 1\nelse:\n    x = 2\n", "PTA003"),
+            ("if x:\n    with c:\n        return 1\n", "PTA004"),
+            ("if x:\n    try:\n        break\n    finally:\n"
+             "        pass\n", "PTA004"),
+            ("if x:\n    for i in items:\n        return i\n", "PTA006"),
+        ]
+        for src, want in cases:
+            node = ast.parse(src).body[0]
+            got = {c for c, _ in scan_statement(node)}
+            assert want in got, (src, want, got)
+
+
+class TestConcretizationRules:
+    def test_pta101_host_read(self):
+        pos = "def f(x):\n    return x.numpy()\n"
+        pos_item = "def f(x):\n    return x.mean().item()\n"
+        neg = "def f(x):\n    return x + 1\n"
+        assert "PTA101" in codes_of(pos)
+        assert "PTA101" in codes_of(pos_item)
+        assert "PTA101" not in codes_of(neg)
+
+    def test_pta102_scalar_coercion(self):
+        pos = "def f(x):\n    n = int(x)\n    return n\n"
+        neg = "def f(x):\n    n = int(3.7)\n    return x + n\n"
+        assert "PTA102" in codes_of(pos)
+        assert "PTA102" not in codes_of(neg)
+
+    def test_pta103_traced_branch_in_unconvertible_scope(self):
+        pos = """
+def f(x):
+    if x > 0:
+        del x
+        return 1
+    return 0
+"""
+        neg = """
+def f(x):
+    if x > 0:
+        y = x * 2
+    else:
+        y = x - 1
+    return y
+"""
+        assert "PTA103" in codes_of(pos)
+        assert "PTA103" not in codes_of(neg)
+        assert RULES["PTA103"].severity == ERROR
+
+
+class TestRetraceRules:
+    def test_pta201_mutable_global_read(self):
+        pos = """
+CACHE = {}
+
+def f(x):
+    y = CACHE.get("k", 0)
+    return x + y
+"""
+        neg = """
+SCALE = 2.5
+
+def f(x):
+    return x * SCALE
+"""
+        assert "PTA201" in codes_of(pos)
+        assert "PTA201" not in codes_of(neg)
+
+    def test_pta202_python_rng(self):
+        pos = """
+import random
+
+def f(x):
+    return x * random.random()
+"""
+        pos_np = """
+import numpy as np
+
+def f(x):
+    return x + np.random.rand()
+"""
+        neg = """
+def f(x):
+    return x * 2.0
+"""
+        assert "PTA202" in codes_of(pos)
+        assert "PTA202" in codes_of(pos_np)
+        assert "PTA202" not in codes_of(neg)
+
+    def test_pta203_shape_dependent_branch(self):
+        pos = """
+def f(x):
+    if x.shape[0] > 1:
+        return x * 2
+    return x
+"""
+        neg = """
+def f(x):
+    if x.sum() > 1:
+        y = x * 2
+    else:
+        y = x
+    return y
+"""
+        assert "PTA203" in codes_of(pos)
+        assert "PTA203" not in codes_of(neg)
+
+
+class TestSideEffectRules:
+    def test_pta301_module_state_mutation(self):
+        pos = """
+class L:
+    def forward(self, x):
+        self.last_input = x
+        return x * 2
+"""
+        neg = """
+class L:
+    def forward(self, x):
+        y = x * 2
+        return y
+"""
+        assert "PTA301" in codes_of(pos)
+        assert "PTA301" not in codes_of(neg)
+
+    def test_pta302_outer_container_mutation(self):
+        pos = """
+RESULTS = []
+
+def f(x):
+    RESULTS.append(x)
+    return x
+"""
+        neg = """
+def f(x):
+    results = []
+    results.append(x)
+    return results
+"""
+        assert "PTA302" in codes_of(pos)
+        assert "PTA302" not in codes_of(neg)
+
+
+class TestSelfLintRules:
+    def test_pta401_module_level_jit(self):
+        pos = """
+import jax
+
+def _impl(x, n):
+    return x * n
+
+f = jax.jit(_impl)
+"""
+        pos_dec = """
+import jax
+
+@jax.jit
+def f(x):
+    return x * 2
+"""
+        neg = """
+import jax
+
+def _impl(x, n):
+    return x * n
+
+f = jax.jit(_impl, static_argnums=1)
+"""
+        assert "PTA401" in codes_of(pos, mode="package")
+        assert "PTA401" in codes_of(pos_dec, mode="package")
+        assert "PTA401" not in codes_of(neg, mode="package")
+
+    def test_pta402_tracer_leaking_cache(self):
+        pos = """
+_CACHE = {}
+
+def f(key, x):
+    _CACHE[key] = x
+    return x
+"""
+        neg = """
+_CACHE = {}
+
+def f(key, x):
+    _CACHE[key] = x  # noqa: PTA402
+    return x
+"""
+        neg_slot = """
+_CONFIG = [None]
+
+def configure(cfg):
+    _CONFIG[0] = cfg
+"""
+        assert "PTA402" in codes_of(pos, mode="package")
+        assert "PTA402" not in codes_of(neg, mode="package")
+        assert "PTA402" not in codes_of(neg_slot, mode="package")
+
+    def test_package_mode_scopes_trace_rules_to_to_static(self):
+        src = """
+def helper(x):
+    return x.numpy()
+
+@to_static
+def traced(x):
+    return x.numpy()
+"""
+        diags = lint_source(src, filename="t.py", mode="package")
+        lines = [d.line for d in diags if d.code == "PTA101"]
+        assert lines == [7]       # only the decorated function flags
+
+
+class TestNoqaAndFormatting:
+    def test_bare_noqa_suppresses_everything(self):
+        src = "def f(x):\n    return x.numpy()  # noqa\n"
+        assert codes_of(src) == set()
+
+    def test_listed_noqa_is_code_specific(self):
+        src = "def f(x):\n    return x.numpy()  # noqa: PTA102\n"
+        assert "PTA101" in codes_of(src)
+
+    def test_diagnostic_format_and_registry(self):
+        d = lint_source("def f(x):\n    return x.numpy()\n",
+                        filename="m.py")[0]
+        s = d.format()
+        assert s.startswith("m.py:2: PTA101 warning:")
+        assert "hint:" in s
+        assert set(d.code for d in []) == set()
+        for code, rule in RULES.items():
+            assert rule.code == code and rule.hint and rule.title
+
+
+class TestCheckApi:
+    def test_check_reports_real_file_and_line(self):
+        def leaky(x):
+            y = x.numpy()
+            return y
+
+        diags = check(leaky)
+        assert any(d.code == "PTA101" for d in diags)
+        d = next(d for d in diags if d.code == "PTA101")
+        assert d.file.endswith("test_analysis.py")
+        src_line = open(__file__).read().splitlines()[d.line - 1]
+        assert ".numpy()" in src_line
+
+    def test_check_unwraps_to_static(self):
+        @paddle.jit.to_static
+        def g(x):
+            return x.numpy()
+
+        assert any(d.code == "PTA101" for d in check(g))
+
+    def test_check_clean_function(self):
+        def clean(x):
+            return x * 2 + 1
+
+        assert check(clean) == []
+
+    def test_check_rejects_non_callables(self):
+        with pytest.raises(TypeError):
+            check(42)
+
+    def test_to_static_check_kwarg_warns(self):
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+
+            @paddle.jit.to_static(check=True)
+            def h(x):
+                return x * _CFG["scale"]
+
+        msgs = [str(x.message) for x in w
+                if issubclass(x.category, TraceSafetyWarning)]
+        assert any("PTA201" in m for m in msgs)
+        # a retrace hazard WARNS but the function still compiles and runs
+        np.testing.assert_allclose(h(_t([1.0, 2.0])).numpy(), [2.0, 4.0])
+
+
+class TestConverterRuntimeError:
+    def test_traced_predicate_cites_diagnostic(self):
+        from paddle_tpu.jit.dy2static import UnconvertibleControlFlowError
+
+        @paddle.jit.to_static
+        def f(x):
+            if paddle.sum(x) > 0:
+                del x
+                return _t(0.0)
+            return x
+
+        with pytest.raises(UnconvertibleControlFlowError) as ei:
+            f(_t([1.0, 2.0]))
+        msg = str(ei.value)
+        assert "PTA001" in msg
+        assert "hint:" in msg
+        assert "test_analysis.py" in msg
+
+    def test_concrete_predicate_keeps_python_semantics(self):
+        from paddle_tpu.jit.dy2static import convert_to_static
+
+        def f(x, flag):
+            if flag:
+                del flag
+                return x + 1
+            return x
+
+        conv = convert_to_static(f)
+        np.testing.assert_allclose(conv(_t(1.0), True).numpy(), 2.0)
+        np.testing.assert_allclose(conv(_t(1.0), False).numpy(), 1.0)
+
+
+class TestGraphDoctorJaxpr:
+    def test_pta501_dead_compute(self):
+        import jax
+        import jax.numpy as jnp
+
+        def f(a):
+            dead = a + 5.0      # never used
+            return a * 2.0
+
+        j = jax.make_jaxpr(f)(jnp.ones(3))
+        assert any(d.code == "PTA501" for d in diagnose_jaxpr(j))
+
+        def g(a):
+            return a * 2.0
+
+        j2 = jax.make_jaxpr(g)(jnp.ones(3))
+        assert not any(d.code == "PTA501" for d in diagnose_jaxpr(j2))
+
+    def test_pta502_unused_input(self):
+        import jax
+        import jax.numpy as jnp
+
+        j = jax.make_jaxpr(lambda a, b: a * 2.0)(jnp.ones(3), jnp.ones(3))
+        assert any(d.code == "PTA502" for d in diagnose_jaxpr(j))
+        j2 = jax.make_jaxpr(lambda a, b: a * b)(jnp.ones(3), jnp.ones(3))
+        assert not any(d.code == "PTA502" for d in diagnose_jaxpr(j2))
+
+    def test_pta503_silent_widening(self):
+        import jax
+        import jax.numpy as jnp
+
+        def f(x):
+            return jnp.asarray(x, jnp.float32) + 1.0
+
+        j = jax.make_jaxpr(f)(jnp.ones(3, jnp.bfloat16))
+        assert any(d.code == "PTA503" for d in diagnose_jaxpr(j))
+
+        def g(x):                # stays bf16 throughout
+            return x + jnp.ones(3, jnp.bfloat16)
+
+        j2 = jax.make_jaxpr(g)(jnp.ones(3, jnp.bfloat16))
+        assert not any(d.code == "PTA503" for d in diagnose_jaxpr(j2))
+
+    def test_pta504_host_callback(self):
+        import jax
+        import jax.numpy as jnp
+
+        def f(x):
+            y = jax.pure_callback(
+                lambda a: np.asarray(a) * 2.0,
+                jax.ShapeDtypeStruct((3,), jnp.float32), x)
+            return y + 1.0
+
+        j = jax.make_jaxpr(f)(jnp.ones(3, jnp.float32))
+        assert any(d.code == "PTA504" for d in diagnose_jaxpr(j))
+        j2 = jax.make_jaxpr(lambda x: x * 2.0 + 1.0)(jnp.ones(3))
+        assert not any(d.code == "PTA504" for d in diagnose_jaxpr(j2))
+
+    def test_pta505_unbound_collective_axis(self):
+        import jax
+        import jax.numpy as jnp
+
+        j = jax.make_jaxpr(lambda x: jax.lax.psum(x, "tp"),
+                           axis_env=[("tp", 2)])(jnp.ones(3))
+        diags = diagnose_jaxpr(j, mesh_axes=("dp", "mp"))
+        assert any(d.code == "PTA505" for d in diags)
+        ok = diagnose_jaxpr(j, mesh_axes=("tp", "dp"))
+        assert not any(d.code == "PTA505" for d in ok)
+        # no mesh given -> axis check is skipped, not spuriously failed
+        assert not any(d.code == "PTA505" for d in diagnose_jaxpr(j))
+
+    def test_doctor_traces_paddle_functions(self):
+        def f(x):
+            return x * 2.0 + 1.0
+
+        diags = doctor(f, _t([1.0, 2.0, 3.0]))
+        assert not any(d.severity == ERROR for d in diags)
+
+
+class TestGraphDoctorProgram:
+    def test_dead_node_and_unused_feed(self):
+        paddle.enable_static()
+        try:
+            main = paddle.static.Program()
+            startup = paddle.static.Program()
+            with paddle.static.program_guard(main, startup):
+                x = paddle.static.data("x", [4], "float32")
+                paddle.static.data("unused", [4], "float32")
+                y = x * 2.0
+                dead = x + 5.0
+                diags = diagnose_program([y], program=main)
+                codes = {d.code for d in diags}
+                assert "PTA501" in codes
+                assert "PTA502" in codes
+                # fetching everything clears PTA501; wiring the feed
+                # clears PTA502
+                all_fetched = diagnose_program([y, dead], program=main)
+                assert not any(d.code == "PTA501" for d in all_fetched)
+        finally:
+            paddle.disable_static()
+
+    def test_clean_program_is_clean(self):
+        paddle.enable_static()
+        try:
+            main = paddle.static.Program()
+            startup = paddle.static.Program()
+            with paddle.static.program_guard(main, startup):
+                x = paddle.static.data("x", [4], "float32")
+                y = x * 2.0 + 1.0
+                diags = diagnose_program([y], program=main)
+                assert diags == []
+        finally:
+            paddle.disable_static()
+
+
+class TestCli:
+    def test_cli_flags_errors_and_exits_nonzero(self, tmp_path):
+        from paddle_tpu.analysis.cli import main
+
+        bad = tmp_path / "bad.py"
+        bad.write_text(
+            "import jax\n\n"
+            "def _impl(x, n):\n    return x * n\n\n"
+            "f = jax.jit(_impl)\n")
+        assert main([str(bad)]) == 1
+
+    def test_cli_clean_file_exits_zero(self, tmp_path, capsys):
+        from paddle_tpu.analysis.cli import main
+
+        good = tmp_path / "good.py"
+        good.write_text("def f(x):\n    return x + 1\n")
+        assert main([str(good)]) == 0
+        out = capsys.readouterr().out
+        assert "0 error(s)" in out
+
+    def test_cli_missing_path(self):
+        from paddle_tpu.analysis.cli import main
+
+        assert main(["/nonexistent/nowhere.py"]) == 2
+
+    def test_cli_syntax_error_reports_pta000(self, tmp_path):
+        broken = tmp_path / "broken.py"
+        broken.write_text("def f(:\n")
+        diags = lint_file(str(broken))
+        assert len(diags) == 1 and diags[0].code == "PTA000"
+        assert diags[0].severity == ERROR
+
+
+def test_rule_code_count_meets_acceptance():
+    """The issue requires >= 8 distinct demonstrated rule codes; keep the
+    registry honest about what this suite demonstrates."""
+    demonstrated = {
+        "PTA001", "PTA002", "PTA003", "PTA004", "PTA005", "PTA006",
+        "PTA007", "PTA101", "PTA102", "PTA103", "PTA201", "PTA202",
+        "PTA203", "PTA301", "PTA302", "PTA401", "PTA402",
+        "PTA501", "PTA502", "PTA503", "PTA504", "PTA505",
+    }
+    assert demonstrated <= (set(RULES) | {"PTA000"})
+    assert len(demonstrated) >= 8
