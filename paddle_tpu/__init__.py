@@ -68,7 +68,7 @@ _LAZY_SUBMODULES = (
     "nn", "optimizer", "autograd", "amp", "io", "jit", "static", "device",
     "linalg", "fft", "vision", "distributed", "incubate", "profiler", "metric",
     "framework", "hapi", "models", "ops", "utils", "distribution", "sparse",
-    "text", "audio", "onnx", "inference", "signal", "quantization",
+    "text", "audio", "onnx", "inference", "serving", "signal", "quantization",
     "regularizer", "version", "sysconfig", "geometric", "hub",
 )
 

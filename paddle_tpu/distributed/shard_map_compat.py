@@ -3,18 +3,40 @@
 jax 0.8 moved shard_map out of jax.experimental and renamed the
 replication-check kwarg (check_rep -> check_vma). Every caller that wants
 to keep working across that boundary imports the pair from here instead of
-re-implementing the try/except — the kwarg MUST match the import taken
-(the legacy API rejects check_vma and vice versa).
+re-implementing the try/except — the kwarg MUST match what the resolved
+function actually accepts, which is decided by inspecting its signature
+(ADVICE r5: there is a jax window where the top-level `jax.shard_map`
+exists but still takes check_rep, so import location alone is not a
+reliable proxy for the kwarg spelling).
 """
 
+import inspect
+
 try:
-    from jax import shard_map
+    from jax import shard_map as _shard_map
+except ImportError:  # older jax layout
+    from jax.experimental.shard_map import shard_map as _shard_map
 
-    #: kwargs disabling the output-replication check, matching the import
+
+def _takes_check_vma(fn):
+    try:
+        params = inspect.signature(fn).parameters
+    except (TypeError, ValueError):
+        # unsignaturable (C accelerated / wrapped): assume the modern
+        # spelling, which every jax that hides the signature also uses
+        return True
+    if any(p.kind is inspect.Parameter.VAR_KEYWORD
+           for p in params.values()):
+        return "check_rep" not in params
+    return "check_vma" in params
+
+
+if _takes_check_vma(_shard_map):
+    shard_map = _shard_map
+
+    #: kwargs disabling the output-replication check, matching the signature
     NO_CHECK = {"check_vma": False}
-except ImportError:  # older jax layout (and its older kwarg name)
-    from jax.experimental.shard_map import shard_map as _legacy_shard_map
-
+else:
     NO_CHECK = {"check_rep": False}
 
     def shard_map(*args, check_vma=None, **kwargs):
@@ -22,6 +44,6 @@ except ImportError:  # older jax layout (and its older kwarg name)
         # written against jax>=0.8 work unchanged on the legacy API
         if check_vma is not None:
             kwargs.setdefault("check_rep", check_vma)
-        return _legacy_shard_map(*args, **kwargs)
+        return _shard_map(*args, **kwargs)
 
 __all__ = ["shard_map", "NO_CHECK"]

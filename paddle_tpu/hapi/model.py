@@ -26,6 +26,7 @@ class Model:
         self._metrics = []
         self.stop_training = False
         self._train_step = None
+        self._train_step_labels = None
         self._use_jit = False
 
     # -------------- setup --------------
@@ -40,31 +41,44 @@ class Model:
             self._metrics = list(metrics)
         self._use_jit = jit
         if jit and optimizer is not None and loss is not None:
-            from ..jit.train_step import TrainStep
-
-            loss_layer = loss
-            # with metrics, the compiled step also returns the network
-            # outputs (aux) so the jit path reports the same per-batch
-            # metrics as eager (ref Model.fit always updates train metrics);
-            # without metrics, no aux — don't materialize outputs for nothing
-            with_aux = bool(self._metrics)
-
-            def loss_fn(net, *batch):
-                *xs, y = batch
-                out = net(*xs)
-                l = loss_layer(out, y)
-                return (l, out) if with_aux else l
-
-            self._train_step = TrainStep(self.network, loss_fn, optimizer,
-                                         has_aux=with_aux)
+            self._build_train_step(n_labels=1)
         return self
+
+    def _build_train_step(self, n_labels):
+        """Compile the train step for a known inputs/labels split. The
+        label count is baked into the traced loss_fn (ADVICE r5: `*xs,
+        y = batch` fed l1 into the network and scored against l2 only
+        when two labels were passed), so a batch with a different number
+        of labels rebuilds the step instead of silently mis-splitting."""
+        from ..jit.train_step import TrainStep
+
+        loss_layer = self._loss
+        # with metrics, the compiled step also returns the network
+        # outputs (aux) so the jit path reports the same per-batch
+        # metrics as eager (ref Model.fit always updates train metrics);
+        # without metrics, no aux — don't materialize outputs for nothing
+        with_aux = bool(self._metrics)
+
+        def loss_fn(net, *batch):
+            xs, ys = batch[:len(batch) - n_labels], batch[len(batch) - n_labels:]
+            out = net(*xs)
+            l = loss_layer(out, *ys)
+            return (l, out) if with_aux else l
+
+        self._train_step = TrainStep(self.network, loss_fn,
+                                     self._optimizer, has_aux=with_aux)
+        self._train_step_labels = n_labels
 
     # -------------- steps --------------
     def train_batch(self, inputs, labels=None, update=True):
         self.network.train()
         inputs = _to_list(inputs)
         labels = _to_list(labels)
-        if self._train_step is not None and update:
+        use_jit = (self._use_jit and update and labels
+                   and self._train_step is not None)
+        if use_jit:
+            if self._train_step_labels != len(labels):
+                self._build_train_step(n_labels=len(labels))
             try:
                 if self._train_step.has_aux:
                     loss, outs = self._train_step(*inputs, *labels)
@@ -76,11 +90,13 @@ class Model:
             except Exception as e:
                 import jax
 
+                # genuine NotImplementedError bugs from a user forward
+                # must surface, not downgrade fit() to the eager loop
+                # (ADVICE r5) — only jax's tracer-leak errors fall back
                 trace_errs = (jax.errors.TracerBoolConversionError,
                               jax.errors.ConcretizationTypeError,
                               jax.errors.TracerArrayConversionError,
-                              jax.errors.TracerIntegerConversionError,
-                              NotImplementedError)
+                              jax.errors.TracerIntegerConversionError)
                 if not isinstance(e, trace_errs) \
                         or self._optimizer._step_count > 0:
                     raise
@@ -95,6 +111,7 @@ class Model:
                     "to silence, or make the forward traceable for the "
                     "compiled path (~100x faster on TPU)")
                 self._train_step = None
+                self._use_jit = False
         outs = self.network(*[_as_tensor(x) for x in inputs])
         loss = self._loss(outs, *[_as_tensor(y) for y in labels]) if self._loss else outs
         loss.backward()

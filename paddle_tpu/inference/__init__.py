@@ -136,6 +136,17 @@ def create_predictor(config):
     return Predictor(config)
 
 
+def create_llm_engine(model, **config_kwargs):
+    """Predictor-style entry point for LLM serving: wrap a CausalLM Layer
+    in the continuous-batching `paddle_tpu.serving.Engine` (the TPU
+    rebuild of the reference's AnalysisPredictor + fused_multi_transformer
+    decode path). Keyword args populate `serving.EngineConfig`
+    (num_slots, max_seq_len, min_prefill_bucket, cache_dtype)."""
+    from ..serving import Engine, EngineConfig
+
+    return Engine(model, EngineConfig(**config_kwargs))
+
+
 # reference module aliases
 Tensor = InferTensor
 PrecisionType = type("PrecisionType", (), {"Float32": 0, "Half": 1,

@@ -18,6 +18,8 @@ import pickle
 
 import numpy as np
 import jax
+import jax.export  # noqa: F401 — jax 0.4.x only binds jax.export on
+# explicit submodule import; attribute access alone raises AttributeError
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor, Parameter
@@ -110,14 +112,20 @@ class StaticFunction:
             return (tuple(o._data if isinstance(o, Tensor) else o for o in out_flat),
                     tuple(new_state), out_tree, mutated)
 
-        # out_tree / mutation set are trace-time static; capture via cell
+        # out_tree / mutation set are trace-time static, captured per
+        # TRACE: one StaticFunction cache entry can hold several jax.jit
+        # traces (state arrays are not part of _spec_key — e.g. amp
+        # rebinds a buffer's dtype), so the capture is a dict keyed by
+        # the full input aval signature. A single last-trace box would
+        # apply a stale mutated-index set when calls alternate between
+        # cached signatures (ADVICE r5).
         out_tree_box = {}
 
         def jittable(rng_key, state_arrays, *flat_arrays):
             outs, new_state, out_tree, mutated = array_fn(
                 rng_key, state_arrays, *flat_arrays)
-            out_tree_box["tree"] = out_tree
-            out_tree_box["mutated"] = mutated
+            out_tree_box[_aval_sig(state_arrays, flat_arrays)] = {
+                "tree": out_tree, "mutated": mutated}
             return outs, new_state
 
         return jax.jit(jittable), out_tree_box, state_names
@@ -137,6 +145,7 @@ class StaticFunction:
         else:
             state_tensors = []
         state_arrays = [t._data for t in state_tensors]
+        sig = _aval_sig(state_arrays, flat)
 
         # ---- grad-aware path (paddle parity: a to_static model trains
         # with eager loss.backward()): the WHOLE jitted forward records as
@@ -174,7 +183,8 @@ class StaticFunction:
                 res = (res,)
             n_out = len(res) - len(state_names)
             out_tensors = list(res[:n_out])
-            mutated = set(out_tree_box.get("mutated", ()))
+            box = out_tree_box[sig]
+            mutated = set(box["mutated"])
             for si, (t, new) in enumerate(zip(state_tensors, res[n_out:])):
                 if t.stop_gradient or si in mutated:
                     # buffers (BN stats, ...) update in place; params write
@@ -183,13 +193,13 @@ class StaticFunction:
                     # diverged from the no-grad path). Grads still flow
                     # w.r.t. the forward-time values.
                     t._data = new._data
-            return _unflatten_tree(out_tree_box["tree"], out_tensors)
+            return _unflatten_tree(box["tree"], out_tensors)
 
         outs, new_state = jitted(rng_key, state_arrays, *flat)
         for t, arr in zip(state_tensors, new_state):
             t._data = arr
         out_tensors = [Tensor(o) for o in outs]
-        return _unflatten_tree(out_tree_box["tree"], out_tensors)
+        return _unflatten_tree(out_tree_box[sig]["tree"], out_tensors)
 
     # paddle API surface
     def get_concrete_program(self, *args, **kwargs):
@@ -198,6 +208,14 @@ class StaticFunction:
     @property
     def program_cache(self):
         return self._cache
+
+
+def _aval_sig(state_arrays, flat_arrays):
+    """Shape/dtype signature of one jitted-call's inputs — works on both
+    concrete arrays (call time) and tracers (trace time), so the capture
+    written under trace is found again by the call that triggered it."""
+    return (tuple((tuple(a.shape), str(a.dtype)) for a in state_arrays),
+            tuple((tuple(a.shape), str(a.dtype)) for a in flat_arrays))
 
 
 def _make_tree(args, kwargs):

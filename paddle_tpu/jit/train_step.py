@@ -20,6 +20,35 @@ from ..core.tensor import Tensor
 from ..core import tape as _tape
 from ..core import random_state
 
+_LAYOUT_API = False  # unresolved sentinel (None = resolved, unavailable)
+
+
+def _layout_api():
+    """Resolve the compiled-layout API once: jax>=0.5 spells it
+    Format/Layout + compiled.input_formats + arr.format; jax 0.4 spells
+    the same machinery Layout/DeviceLocalLayout + compiled.input_layouts
+    + arr.layout. Returns (AUTO_spec, compiled_attr, leaf_attr), or None
+    on a jax with neither — the AUTO-layout path then disables itself
+    instead of raising ImportError at the first step (r5: the hapi/jit
+    suites went down wholesale on jax 0.4.37)."""
+    global _LAYOUT_API
+    if _LAYOUT_API is False:
+        try:
+            from jax.experimental.layout import Format, Layout
+
+            _LAYOUT_API = (Format(Layout.AUTO), "input_formats", "format")
+        except ImportError:
+            try:
+                from jax.experimental.layout import (
+                    DeviceLocalLayout, Layout,
+                )
+
+                _LAYOUT_API = (Layout(DeviceLocalLayout.AUTO),
+                               "input_layouts", "layout")
+            except ImportError:
+                _LAYOUT_API = None
+    return _LAYOUT_API
+
 
 class TrainStep:
     def __init__(self, model, loss_fn, optimizer, scaler=None, donate=True,
@@ -52,6 +81,8 @@ class TrainStep:
             auto_layout = env not in ("0", "false", "off")
         self.auto_layout = (auto_layout if auto_layout is not None
                             else mesh is None and in_shardings is None)
+        if self.auto_layout and _layout_api() is None:
+            self.auto_layout = False
         benv = _os.environ.get("PADDLE_TPU_UPDATE_BARRIER")
         # None = decide at build time from model size (see _build): the
         # barrier un-fuses dW matmuls from the optimizer update — a big
@@ -144,7 +175,7 @@ class TrainStep:
         keeps every later step zero-copy. `_fn_factory`/`_key_tag` let
         many() run its scanned K-step program through the same treatment
         (args keep the (params, buffers, opt_states, ...) leading trio)."""
-        from jax.experimental.layout import Format, Layout
+        auto_spec, fmt_attr, leaf_attr = _layout_api()
 
         flat, treedef = jax.tree.flatten(args)
         # only the batch part of the signature can vary between calls
@@ -155,7 +186,7 @@ class TrainStep:
                tuple((a.shape, a.dtype) for a in bflat))
         ent = self._compiled_cache.get(key)
         if ent is None:
-            auto = Format(Layout.AUTO)
+            auto = auto_spec
             specs = (auto, auto, auto) + (None,) * (len(args) - 3)
             # buffers (arg 1) are donated here too: their exit layouts
             # must alias their AUTO entry layouts for the trusted-skip
@@ -163,14 +194,15 @@ class TrainStep:
             jitted = jax.jit((_fn_factory or self._make_step_fn)(),
                              donate_argnums=(0, 1, 2) if self.donate else (),
                              in_shardings=specs,
-                             out_shardings=Format(Layout.AUTO))
+                             out_shardings=auto_spec)
             # AUTO-layout lowering requires abstract avals (concrete
             # arrays carry layouts that would contradict AUTO)
             sds = jax.tree.map(
                 lambda a: jax.ShapeDtypeStruct(jnp.shape(a),
                                                jnp.asarray(a).dtype), args)
             compiled = jitted.lower(*sds).compile()
-            fmt_flat, fmt_tree = jax.tree.flatten(compiled.input_formats[0])
+            fmt_flat, fmt_tree = jax.tree.flatten(
+                getattr(compiled, fmt_attr)[0])
             if fmt_tree != treedef:  # defensive: structures must agree
                 raise RuntimeError("input_formats structure mismatch")
             # leaves of args 0/1/2 (params, buffers, opt states) are
@@ -196,7 +228,7 @@ class TrainStep:
         # entry must re-verify from scratch.
         trusted = self._layout_owner == key
         moved = [a if (trusted and i in own)
-                 or getattr(a, "format", None) == f
+                 or getattr(a, leaf_attr, None) == f
                  else jax.device_put(a, f, donate=(i in own))
                  for i, (a, f) in enumerate(zip(flat, fmt_flat))]
         try:

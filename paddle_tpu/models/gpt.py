@@ -105,6 +105,14 @@ class GPTAttention(Layer):
         v = M.reshape(self.v_proj(x), [b, s, self.kv_heads, self.head_dim])
         import numpy as np
 
+        if cache is not None and not isinstance(cache, (tuple, list)):
+            # serving path: SlotKV slotted static-shape cache — per-row
+            # positions, dynamic_update_slice writes, full-length masked
+            # attention. One compiled decode step serves every request
+            # mix (paddle_tpu.serving); the tuple branch below stays the
+            # legacy concat-per-step cache.
+            return self._forward_slotted(q, k, v, cache, b, s)
+
         pos = None
         if position_offset:
             pos_ids = jnp.arange(position_offset, position_offset + s)[None, :]
@@ -126,6 +134,30 @@ class GPTAttention(Layer):
         if cache is not None:
             return out, new_cache
         return out
+
+    def _forward_slotted(self, q, k, v, cache, b, s):
+        """Slotted-cache attention: write this chunk's k/v into the cache
+        rows at the per-row positions, attend over the full static-length
+        buffers under a validity mask. Bit-compatible with the concat
+        path — the same rope/attention math over the same valid keys,
+        with masked positions contributing exp(-inf) = 0."""
+        import jax.numpy as jnp
+
+        from ..serving.kv_cache import SlotKV, visible_mask, write_slots
+
+        pos = cache.pos
+        pos_ids = Tensor(pos[:, None]
+                         + jnp.arange(s, dtype=pos.dtype)[None, :])
+        q = apply_rotary_emb(q, position_ids=pos_ids, base=self.rope_theta)
+        k = apply_rotary_emb(k, position_ids=pos_ids, base=self.rope_theta)
+        k_all = write_slots(cache.k, k._data, pos)
+        v_all = write_slots(cache.v, v._data, pos)
+        mask = visible_mask(pos, s, cache.max_seq_len)
+        out = F.scaled_dot_product_attention(
+            q, Tensor(k_all), Tensor(v_all), attn_mask=Tensor(mask),
+            is_causal=False, training=self.training)
+        out = self.o_proj(M.reshape(out, [b, s, self.num_heads * self.head_dim]))
+        return out, SlotKV(k_all, v_all, pos + s)
 
 
 class GPTMLP(Layer):

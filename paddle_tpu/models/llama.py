@@ -4,6 +4,14 @@ The decoder recipe (pre-norm RMSNorm, RoPE, SwiGLU, optional GQA) is shared
 with the flagship implementation in models/gpt.py; this module gives it the
 LLaMA naming plus the standard config presets so users of the reference's
 ecosystem (PaddleNLP `LlamaForCausalLM`) find the same surface here.
+
+Because the attention layer is shared, LlamaAttention accepts the serving
+subsystem's slotted static-shape KV cache (paddle_tpu.serving.SlotKV)
+anywhere the legacy `(k, v)` concat cache is accepted — a
+LlamaForCausalLM drops straight into paddle_tpu.serving.Engine:
+
+    from paddle_tpu.serving import Engine, EngineConfig
+    engine = Engine(LlamaForCausalLM(LLAMA2_7B), EngineConfig(...))
 """
 
 from .gpt import (

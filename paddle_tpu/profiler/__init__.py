@@ -169,3 +169,39 @@ def profiler_guard(*a, **k):
         yield p
     finally:
         p.stop()
+
+
+# --------------------------------------------------------------------------
+# counter registry: subsystems (paddle_tpu.serving's Engine, dataloaders,
+# ...) publish live observability counters here — queue depth, TTFT,
+# tokens/s, slot utilization, compile-cache hits — so one profiler-side
+# call snapshots the whole process without importing every subsystem.
+
+_counter_providers = {}
+
+
+def register_counter_provider(name, provider):
+    """Register a zero-arg callable returning a {counter: value} mapping
+    under ``name`` (later registrations replace earlier ones)."""
+    if not callable(provider):
+        raise TypeError("provider must be callable")
+    _counter_providers[name] = provider  # noqa: PTA402 — process-global
+    # registry is this function's purpose; keys are subsystem names, not
+    # a per-call cache
+
+
+def unregister_counter_provider(name):
+    _counter_providers.pop(name, None)
+
+
+def counters():
+    """Snapshot every registered provider: {name: {counter: value}}.
+    A provider that raises is reported as an error string instead of
+    poisoning the whole snapshot."""
+    out = {}
+    for name, provider in list(_counter_providers.items()):
+        try:
+            out[name] = dict(provider())
+        except Exception as e:  # pragma: no cover - defensive
+            out[name] = {"error": f"{type(e).__name__}: {e}"}
+    return out

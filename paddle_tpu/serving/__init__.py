@@ -1,0 +1,41 @@
+"""paddle_tpu.serving — continuous-batching LLM inference engine.
+
+The serving subsystem the reference ships as AnalysisPredictor + the
+fused CUDA decode ops (fused_multi_transformer), rebuilt TPU-native
+around three ideas the benches point at (DECODE_BENCH.json):
+
+* a **slotted static-shape KV cache** (kv_cache.py) — one compiled
+  decode step for every step of every request mix, zero retracing;
+* a **prefill/decode split** with power-of-two prefill buckets — one
+  compiled prefill per bucket (engine.py);
+* **continuous batching** — FIFO admission into a fixed slot pool,
+  requests join at decode-step boundaries and free slots on EOS or
+  max-tokens (scheduler.py), with greedy/temperature/top-k/top-p
+  sampling under per-request seeded PRNG (sampling.py).
+
+Quick start::
+
+    from paddle_tpu.models import GPTForCausalLM, GPTConfig
+    from paddle_tpu.serving import Engine, EngineConfig, SamplingParams
+
+    engine = Engine(GPTForCausalLM(cfg),
+                    EngineConfig(num_slots=8, max_seq_len=512))
+    req = engine.submit(prompt_ids, SamplingParams(max_new_tokens=64))
+    while engine.scheduler.has_work:
+        engine.step()          # other submits may land between steps
+    print(req.output_ids)
+
+Counters (queue depth, TTFT, tokens/s, slot utilization, compile-cache
+hits) are exposed through ``paddle_tpu.profiler.counters()``.
+"""
+
+from .engine import CompiledFn, Engine, EngineConfig
+from .kv_cache import SlotKV, SlottedKVCache
+from .sampling import SamplingParams
+from .scheduler import Request, Scheduler
+
+__all__ = [
+    "Engine", "EngineConfig", "CompiledFn",
+    "SlotKV", "SlottedKVCache",
+    "SamplingParams", "Request", "Scheduler",
+]

@@ -1,0 +1,347 @@
+"""The continuous-batching LLM inference engine.
+
+Architecture (prefill/decode split over a slotted static-shape cache):
+
+* **Prefill** — each admitted request runs one ``[1, bucket]`` forward
+  that writes its prompt's k/v into its slot row and samples the first
+  token.  Prompts are right-padded to power-of-two length buckets, so
+  there is exactly ONE compiled prefill program per bucket, reused by
+  every request whose prompt falls in it (heterogeneous prompt lengths
+  stop being a retrace source).
+* **Decode** — ONE fused step over ALL slot rows: embed the last token
+  of every slot, run the model with per-row positions against the full
+  ``[num_slots, max_seq_len, kv_heads, head_dim]`` buffers (written via
+  ``dynamic_update_slice``), and sample per-request tokens under
+  per-request seeded PRNG.  Every step of every request mix has the same
+  input signature, so the step compiles exactly once.
+* **Continuous batching** — requests join at decode-step boundaries and
+  free their slot on EOS/max-tokens; the admission queue drains into
+  freed slots between steps (scheduler.py).
+
+The engine reuses the model's own Layer code (functionalized through
+``use_state``, the TrainStep pattern), so slotted decode is numerically
+the decode path models/gpt.py already ships — just with a cache the
+compiler can keep static.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import tape as _tape
+from ..core.tensor import Tensor
+from .kv_cache import SlotKV, SlottedKVCache
+from .sampling import SamplingParams, request_key, sample_batch, sample_token
+from .scheduler import Scheduler
+
+
+class CompiledFn:
+    """jax.jit wrapper that counts compile-cache hits/misses by input
+    signature (shape+dtype of every array leaf).  The miss counter is the
+    engine's observable proof of static-shape serving: a multi-request
+    run with heterogeneous prompt lengths must show decode misses == 1
+    and prefill misses == number of distinct buckets."""
+
+    def __init__(self, fn, donate_argnums=()):
+        self._jit = jax.jit(fn, donate_argnums=donate_argnums)
+        self._seen = set()
+        self.misses = 0
+        self.hits = 0
+
+    @staticmethod
+    def _signature(args):
+        return tuple((tuple(jnp.shape(a)), str(jnp.result_type(a)))
+                     for a in jax.tree.leaves(args))
+
+    def __call__(self, *args):
+        sig = self._signature(args)
+        if sig in self._seen:
+            self.hits += 1
+        else:
+            self._seen.add(sig)
+            self.misses += 1
+        return self._jit(*args)
+
+
+@dataclass
+class EngineConfig:
+    num_slots: int = 8
+    max_seq_len: int = 256
+    #: smallest prefill bucket; prompts pad up to the next power of two
+    min_prefill_bucket: int = 8
+    #: kv cache dtype; None = the model's parameter dtype
+    cache_dtype: object = None
+
+
+class Engine:
+    """Submit/step/generate over a causal-LM Layer (GPTForCausalLM /
+    LlamaForCausalLM or anything with ``.model``, ``.config`` and
+    ``._logits``)."""
+
+    _instances = 0
+
+    def __init__(self, model, config=None, register_profiler=True):
+        self.model = model
+        self.config = config or EngineConfig()
+        model.eval()
+        mc = model.config
+        self._state_names = list(model.state_dict().keys())
+        sd = model.state_dict()
+        self._state_arrays = [sd[n]._data for n in self._state_names]
+        cache_dtype = (self.config.cache_dtype
+                       or model.model.embed_tokens.weight._data.dtype)
+        self.cache = SlottedKVCache(
+            num_layers=len(model.model.layers),
+            num_slots=self.config.num_slots,
+            max_seq_len=self.config.max_seq_len,
+            kv_heads=mc.kv_heads, head_dim=mc.head_dim,
+            dtype=cache_dtype)
+        self.scheduler = Scheduler(self.config.num_slots)
+
+        n = self.config.num_slots
+        self._tokens = np.zeros(n, np.int32)        # last token per slot
+        self._pos = np.zeros(n, np.int32)           # row length per slot
+        self._seeds = np.zeros(n, np.uint32)
+        self._counts = np.zeros(n, np.int32)        # tokens sampled so far
+        self._temps = np.zeros(n, np.float32)
+        self._top_ks = np.zeros(n, np.int32)
+        self._top_ps = np.ones(n, np.float32)
+
+        # donation buys in-place HBM cache updates on accelerators; CPU
+        # would only warn that donation is unimplemented
+        donate = jax.default_backend() not in ("cpu",)
+        self._decode = CompiledFn(self._decode_fn,
+                                  donate_argnums=(3, 4) if donate else ())
+        self._prefill = CompiledFn(self._prefill_fn,
+                                   donate_argnums=(4, 5) if donate else ())
+
+        # observability
+        self._decode_steps = 0
+        self._prefill_calls = 0
+        self._tokens_generated = 0
+        self._busy_s = 0.0
+        self._slot_busy_integral = 0.0   # sum over steps of used/num
+        self._finished = 0
+        self._ttft_sum = 0.0
+        self._ttft_n = 0
+
+        Engine._instances += 1
+        self._profiler_name = f"serving.engine{Engine._instances}"
+        if register_profiler:
+            from .. import profiler as _profiler
+
+            _profiler.register_counter_provider(self._profiler_name,
+                                                self.counters)
+
+    def close(self):
+        from .. import profiler as _profiler
+
+        _profiler.unregister_counter_provider(self._profiler_name)
+
+    # ------------------------------------------------------------ pure fns
+    def _run_model(self, state_arrays, ids, views):
+        """Functionalized forward: raw param arrays + token ids + SlotKV
+        views -> (last-position logits [B, vocab], new views)."""
+        arrays = dict(zip(self._state_names, state_arrays))
+        with _tape.no_grad():
+            with self.model.use_state(arrays):
+                h, new_views = self.model.model(Tensor(ids), caches=views)
+                logits = self.model._logits(h)
+        return logits._data, new_views
+
+    def _prefill_fn(self, state_arrays, ids, length, slot, cache_k,
+                    cache_v, seed, temp, top_k, top_p):
+        """One request's prompt pass: ids [1, bucket] (right-padded),
+        fresh zero slot row, write k/v for every prompt position, sample
+        the first token from the last VALID position's logits, scatter
+        the row into the full cache at ``slot``."""
+        row_shape = (1, self.cache.max_seq_len, self.cache.kv_heads,
+                     self.cache.head_dim)
+        pos0 = jnp.zeros((1,), jnp.int32)
+        views = [SlotKV(jnp.zeros(row_shape, self.cache.dtype),
+                        jnp.zeros(row_shape, self.cache.dtype), pos0)
+                 for _ in range(self.cache.num_layers)]
+        logits, new_views = self._run_model(state_arrays, ids, views)
+        last = jax.lax.dynamic_index_in_dim(logits[0], length - 1,
+                                            axis=0, keepdims=False)
+        first = sample_token(last, request_key(seed, 0), temp, top_k,
+                             top_p)
+        new_k = [jax.lax.dynamic_update_slice(
+                     ck, nv.k, (slot, 0, 0, 0))
+                 for ck, nv in zip(cache_k, new_views)]
+        new_v = [jax.lax.dynamic_update_slice(
+                     cv, nv.v, (slot, 0, 0, 0))
+                 for cv, nv in zip(cache_v, new_views)]
+        return first, new_k, new_v
+
+    def _decode_fn(self, state_arrays, tokens, pos, cache_k, cache_v,
+                   seeds, counts, temps, top_ks, top_ps):
+        """The ONE fused decode step over all slots: static shapes
+        everywhere, per-row positions, per-request sampling."""
+        views = [SlotKV(ck, cv, pos)
+                 for ck, cv in zip(cache_k, cache_v)]
+        logits, new_views = self._run_model(state_arrays, tokens[:, None],
+                                            views)
+        nxt = sample_batch(logits[:, 0], seeds, counts, temps, top_ks,
+                           top_ps)
+        return nxt, [v.k for v in new_views], [v.v for v in new_views]
+
+    # ------------------------------------------------------------ buckets
+    def _bucket(self, prompt_len):
+        b = self.config.min_prefill_bucket
+        while b < prompt_len:
+            b *= 2
+        return min(b, self.config.max_seq_len)
+
+    # ------------------------------------------------------------ API
+    def submit(self, prompt_ids, sampling=None):
+        """Queue one request; returns the Request handle (its
+        ``output_ids`` fill in as the engine steps)."""
+        prompt_ids = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
+        if not prompt_ids:
+            raise ValueError("empty prompt")
+        sampling = sampling or SamplingParams()
+        if len(prompt_ids) + sampling.max_new_tokens > self.config.max_seq_len:
+            raise ValueError(
+                f"prompt_len {len(prompt_ids)} + max_new_tokens "
+                f"{sampling.max_new_tokens} exceeds max_seq_len "
+                f"{self.config.max_seq_len}")
+        return self.scheduler.submit(prompt_ids, sampling)
+
+    def _admit(self):
+        for req in self.scheduler.admissible(self.cache.free_slots):
+            slot = self.cache.alloc()
+            self.scheduler.start(req, slot)
+            bucket = self._bucket(req.prompt_len)
+            ids = np.zeros((1, bucket), np.int32)
+            ids[0, :req.prompt_len] = req.prompt_ids
+            first, new_k, new_v = self._prefill(
+                self._state_arrays, jnp.asarray(ids),
+                jnp.asarray(req.prompt_len, jnp.int32),
+                jnp.asarray(slot, jnp.int32),
+                self.cache.k, self.cache.v,
+                jnp.asarray(req.sampling.seed, jnp.uint32),
+                jnp.asarray(req.sampling.temperature, jnp.float32),
+                jnp.asarray(req.sampling.top_k, jnp.int32),
+                jnp.asarray(req.sampling.top_p, jnp.float32))
+            self.cache.rebind(new_k, new_v)
+            self._prefill_calls += 1
+            self._tokens_generated += 1
+            tok = int(np.asarray(first))
+            if req.record_token(tok):
+                self._retire(req)
+                continue
+            s = req.sampling
+            self._tokens[slot] = tok
+            self._pos[slot] = req.prompt_len
+            self._seeds[slot] = np.uint32(s.seed)
+            self._counts[slot] = req.n_generated
+            self._temps[slot] = s.temperature
+            self._top_ks[slot] = s.top_k
+            self._top_ps[slot] = s.top_p
+
+    def _retire(self, req):
+        self.cache.free(req.slot)
+        self.scheduler.finish(req)
+        self._finished += 1
+        self._ttft_sum += req.ttft
+        self._ttft_n += 1
+        # park the freed slot on a masked no-op row until reassigned
+        slot = req.slot
+        self._tokens[slot] = 0
+        self._pos[slot] = 0
+        self._temps[slot] = 0.0
+        self._top_ks[slot] = 0
+        self._top_ps[slot] = 1.0
+        self._counts[slot] = 0
+        self._seeds[slot] = 0
+
+    def step(self):
+        """One engine iteration: admit queued requests into free slots
+        (prefill), then run one fused decode step over every active slot.
+        Returns the requests that finished during this step."""
+        t0 = time.time()
+        finished = []
+        self._admit()
+        active = dict(self.scheduler.running)
+        if active:
+            nxt, new_k, new_v = self._decode(
+                self._state_arrays,
+                jnp.asarray(self._tokens), jnp.asarray(self._pos),
+                self.cache.k, self.cache.v,
+                jnp.asarray(self._seeds), jnp.asarray(self._counts),
+                jnp.asarray(self._temps), jnp.asarray(self._top_ks),
+                jnp.asarray(self._top_ps))
+            self.cache.rebind(new_k, new_v)
+            nxt = np.asarray(nxt)
+            self._decode_steps += 1
+            self._slot_busy_integral += len(active) / self.cache.num_slots
+            for slot, req in active.items():
+                self._tokens_generated += 1
+                # the decode step wrote this token's k/v at pos[slot]
+                self._pos[slot] += 1
+                if req.record_token(nxt[slot]):
+                    self._retire(req)
+                    finished.append(req)
+                else:
+                    self._tokens[slot] = nxt[slot]
+                    self._counts[slot] = req.n_generated
+        self._busy_s += time.time() - t0
+        return finished
+
+    def run(self):
+        """Drain the queue: step until every submitted request finished.
+        Returns all requests retired during the drain."""
+        out = []
+        while self.scheduler.has_work:
+            before = self._finished
+            out.extend(self.step())
+            if self._finished == before and not self.scheduler.running \
+                    and self.scheduler.queue_depth:
+                raise RuntimeError("engine stalled with queued work")
+        return out
+
+    def generate(self, prompts, sampling=None):
+        """Convenience wrapper: one prompt (list of ids) or a batch
+        (list of lists).  Submits, drains, and returns the generated ids
+        — a list per prompt, in submission order."""
+        single = bool(prompts) and np.isscalar(prompts[0])
+        batch = [prompts] if single else list(prompts)
+        if isinstance(sampling, (list, tuple)):
+            reqs = [self.submit(p, s) for p, s in zip(batch, sampling)]
+        else:
+            reqs = [self.submit(p, sampling) for p in batch]
+        self.run()
+        outs = [r.output_ids for r in reqs]
+        return outs[0] if single else outs
+
+    # ------------------------------------------------------------ metrics
+    def counters(self):
+        """Observability snapshot (also exposed via
+        paddle_tpu.profiler.counters())."""
+        c = {
+            "queue_depth": self.scheduler.queue_depth,
+            "active_slots": self.cache.used_slots,
+            "num_slots": self.cache.num_slots,
+            "requests_finished": self._finished,
+            "tokens_generated": self._tokens_generated,
+            "decode_steps": self._decode_steps,
+            "prefill_calls": self._prefill_calls,
+            "decode_compiles": self._decode.misses,
+            "decode_cache_hits": self._decode.hits,
+            "prefill_compiles": self._prefill.misses,
+            "prefill_cache_hits": self._prefill.hits,
+        }
+        if self._decode_steps:
+            c["slot_utilization"] = (self._slot_busy_integral
+                                     / self._decode_steps)
+        if self._ttft_n:
+            c["ttft_avg_s"] = self._ttft_sum / self._ttft_n
+        if self._busy_s > 0:
+            c["tokens_per_s"] = self._tokens_generated / self._busy_s
+        return c

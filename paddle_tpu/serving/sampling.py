@@ -1,0 +1,90 @@
+"""Token sampling for the serving engine.
+
+Greedy / temperature / top-k / top-p under a per-request seeded PRNG.
+Everything is expressed as pure jnp on a single logits row so the engine
+can ``vmap`` it across slots inside the fused decode step: a request's
+k-th sampled token depends only on (its seed, k, its logits) — never on
+which slot it occupies or what else is in the batch.  That independence
+is what makes continuous batching reproduce sequential ``generate()``
+token-for-token.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass
+class SamplingParams:
+    """Per-request decoding controls (paddle parity: the generate()
+    kwargs of PaddleNLP's GenerationMixin, reduced to the serving set).
+
+    temperature <= 0 selects greedy argmax decoding; top_k <= 0 and
+    top_p >= 1.0 disable their respective filters.
+    """
+
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    max_new_tokens: int = 16
+    eos_token_id: int | None = None
+    seed: int = 0
+
+    def validate(self):
+        if self.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if not 0.0 < self.top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {self.top_p}")
+        return self
+
+
+def request_key(seed, n_sampled):
+    """The PRNG key for a request's n_sampled-th token: a pure function
+    of (seed, token index), so replays and re-batchings are bitwise
+    deterministic."""
+    return jax.random.fold_in(jax.random.PRNGKey(seed), n_sampled)
+
+
+def sample_token(logits, key, temperature, top_k, top_p):
+    """Sample one token id from a single [vocab] logits row.
+
+    All four controls are traced values, so one compiled program serves
+    every request mix.  Greedy rows still draw nothing from ``key`` —
+    the argmax branch is selected by ``where``.
+    """
+    vocab = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    # temperature scale (guard the greedy rows against divide-by-zero)
+    t = jnp.where(temperature > 0, temperature, 1.0)
+    scaled = logits.astype(jnp.float32) / t
+
+    # top-k: keep logits >= the k-th largest (ties widen the pool)
+    sorted_desc = jnp.sort(scaled)[::-1]
+    k_idx = jnp.clip(top_k, 1, vocab) - 1
+    kth = jnp.take(sorted_desc, k_idx)
+    scaled = jnp.where((top_k > 0) & (scaled < kth), -jnp.inf, scaled)
+
+    # top-p (nucleus): keep the smallest prefix of the sorted
+    # distribution whose mass reaches top_p
+    probs = jax.nn.softmax(scaled)
+    sp = jnp.sort(probs)[::-1]
+    cum = jnp.cumsum(sp)
+    cutoff_idx = jnp.argmax(cum >= top_p)          # first index reaching p
+    threshold = jnp.take(sp, cutoff_idx)
+    scaled = jnp.where((top_p < 1.0) & (probs < threshold), -jnp.inf,
+                       scaled)
+
+    sampled = jax.random.categorical(key, scaled).astype(jnp.int32)
+    return jnp.where(temperature > 0, sampled, greedy)
+
+
+def sample_batch(logits, seeds, counts, temperatures, top_ks, top_ps):
+    """Vectorized sampling across slot rows: logits [N, vocab] plus
+    per-slot parameter arrays [N] -> token ids [N] int32."""
+    keys = jax.vmap(request_key)(seeds, counts)
+    return jax.vmap(sample_token)(logits, keys, temperatures, top_ks,
+                                  top_ps)

@@ -1,0 +1,111 @@
+"""Continuous-batching scheduler: admission queue + slot lifecycle.
+
+Requests enter a FIFO queue on ``submit()`` and join the running batch
+only at decode-step boundaries (the engine admits before each fused
+step).  A request holds its slot until it finishes — EOS or max-tokens —
+then the slot returns to the free list and the next queued request can
+claim it.  All of this is host-side bookkeeping over the static-shape
+device state; nothing here retraces anything.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+from .sampling import SamplingParams
+
+WAITING = "waiting"
+RUNNING = "running"
+FINISHED = "finished"
+
+FINISH_EOS = "eos"
+FINISH_LENGTH = "length"
+
+
+@dataclass
+class Request:
+    """One generation request and its full lifecycle state."""
+
+    request_id: int
+    prompt_ids: list
+    sampling: SamplingParams
+    status: str = WAITING
+    slot: int | None = None
+    output_ids: list = field(default_factory=list)
+    finish_reason: str | None = None
+    submit_time: float = field(default_factory=time.time)
+    first_token_time: float | None = None
+
+    @property
+    def prompt_len(self):
+        return len(self.prompt_ids)
+
+    @property
+    def n_generated(self):
+        return len(self.output_ids)
+
+    @property
+    def ttft(self):
+        """Time-to-first-token in seconds (None until the first token)."""
+        if self.first_token_time is None:
+            return None
+        return self.first_token_time - self.submit_time
+
+    def record_token(self, token):
+        """Append a sampled token; returns True when the request is done
+        (EOS or max_new_tokens reached)."""
+        if self.first_token_time is None:
+            self.first_token_time = time.time()
+        self.output_ids.append(int(token))
+        eos = self.sampling.eos_token_id
+        if eos is not None and int(token) == int(eos):
+            self.finish_reason = FINISH_EOS
+            return True
+        if self.n_generated >= self.sampling.max_new_tokens:
+            self.finish_reason = FINISH_LENGTH
+            return True
+        return False
+
+
+class Scheduler:
+    """FIFO admission over a fixed slot pool."""
+
+    def __init__(self, num_slots):
+        self.num_slots = num_slots
+        self.queue = deque()
+        self.running = {}           # slot -> Request
+        self._next_id = 0
+
+    def submit(self, prompt_ids, sampling):
+        req = Request(self._next_id, list(prompt_ids),
+                      sampling.validate())
+        self._next_id += 1
+        self.queue.append(req)
+        return req
+
+    def admissible(self, free_slots):
+        """Pop up to free_slots queued requests (join happens at the next
+        decode-step boundary)."""
+        out = []
+        while self.queue and len(out) < free_slots:
+            out.append(self.queue.popleft())
+        return out
+
+    def start(self, req, slot):
+        req.status = RUNNING
+        req.slot = slot
+        self.running[slot] = req
+
+    def finish(self, req):
+        req.status = FINISHED
+        del self.running[req.slot]
+
+    @property
+    def queue_depth(self):
+        return len(self.queue)
+
+    @property
+    def has_work(self):
+        return bool(self.queue or self.running)

@@ -49,6 +49,46 @@ class TestToStatic:
         b = drop(x).numpy()
         assert not np.array_equal(a, b)  # rng key threaded per call
 
+    def test_alternating_state_signatures_keep_own_captures(self):
+        """ADVICE r5: one StaticFunction cache entry holds several jax.jit
+        traces when the STATE changes aval (inputs identical, so _spec_key
+        matches) — e.g. amp rebinding a param's dtype. The out-tree /
+        mutation capture must be keyed per trace signature: with the old
+        single last-trace box, alternating calls applied the most recent
+        trace's output structure to the other signature's results."""
+        import jax.numpy as jnp
+
+        class DtypeDependent(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = nn.Linear(4, 4)
+
+            def forward(self, x):
+                y = self.lin(x)
+                # trace-time static on the PARAM dtype, not the input:
+                # both traces live under one _spec_key cache entry
+                if str(self.lin.weight.dtype) == "float32":
+                    return y
+                return {"out": y, "casted": True}
+
+        layer = paddle.jit.to_static(DtypeDependent())
+        x = paddle.ones([2, 4])
+        out_f32 = layer(x)
+        assert isinstance(out_f32, paddle.Tensor)
+        w = layer.lin.weight
+        w32 = w._data
+        w._data = w32.astype(jnp.bfloat16)
+        out_bf16 = layer(x)
+        assert isinstance(out_bf16, dict) and out_bf16["casted"] is True
+        # flip back: the f32 trace's capture must be found again
+        w._data = w32
+        again = layer(x)
+        assert isinstance(again, paddle.Tensor)
+        np.testing.assert_array_equal(again.numpy(), out_f32.numpy())
+        # and forward once more on the bf16 signature
+        w._data = w32.astype(jnp.bfloat16)
+        assert isinstance(layer(x), dict)
+
     def test_jit_save_load_roundtrip(self, tmp_path):
         layer = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
         layer.eval()
