@@ -64,7 +64,7 @@ from .tensor import *  # noqa: F401,F403
 from . import tensor
 
 _LAZY_SUBMODULES = (
-    "analysis",
+    "analysis", "observability",
     "nn", "optimizer", "autograd", "amp", "io", "jit", "static", "device",
     "linalg", "fft", "vision", "distributed", "incubate", "profiler", "metric",
     "framework", "hapi", "models", "ops", "utils", "distribution", "sparse",

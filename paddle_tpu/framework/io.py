@@ -163,15 +163,24 @@ def _payload_bytes(obj):
 
 
 def save(obj, path, protocol=4, **configs):
+    # checkpoint saves land on the observability timeline (begin/end pair
+    # + a duration histogram), so "why did step time spike" is answerable
+    # when the answer is "a checkpoint flushed"
+    from ..observability.span import span as _obs_span
+
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
     saveable = _to_saveable(obj)
-    if _payload_bytes(saveable) >= _CONTAINER_THRESHOLD:
-        _save_container(saveable, path, protocol)
-        return
-    with open(path, "wb") as f:
-        pickle.dump(saveable, f, protocol=protocol)
+    nbytes = _payload_bytes(saveable)
+    with _obs_span("checkpoint.save", cat="io",
+                   event_args={"path": str(path),
+                               "payload_bytes": nbytes}):
+        if nbytes >= _CONTAINER_THRESHOLD:
+            _save_container(saveable, path, protocol)
+            return
+        with open(path, "wb") as f:
+            pickle.dump(saveable, f, protocol=protocol)
 
 
 def load(path, return_numpy=False, **configs):

@@ -165,6 +165,67 @@ class EarlyStopping(Callback):
                 self.model.stop_training = True
 
 
+class ObservabilityCallback(Callback):
+    """Publishes the fit/eval loop into ``paddle_tpu.observability``:
+    epoch begin/end timeline events, per-batch loss/lr gauges, and a
+    train-step counter — so a dashboard scraping
+    ``observability.render_prometheus()`` (or the ``python -m
+    paddle_tpu.observability`` CLI) sees training progress live, next to
+    the jit/serving/dataloader metrics the subsystems publish on their
+    own.  Purely additive: Model.fit already records step-time/ips
+    histograms unconditionally."""
+
+    def __init__(self, prefix="hapi"):
+        super().__init__()
+        from ..observability import events, metrics
+
+        self._events = events
+        self.prefix = prefix
+        self._loss = metrics.gauge(f"{prefix}.loss",
+                                   "last training-batch loss")
+        self._lr = metrics.gauge(f"{prefix}.lr", "current learning rate")
+        self._steps = metrics.counter(f"{prefix}.train_batches",
+                                      "train batches seen by Model.fit")
+        self._eval_loss = metrics.gauge(f"{prefix}.eval_loss",
+                                        "last evaluation loss")
+
+    def on_train_begin(self, logs=None):
+        self._events.instant(f"{self.prefix}.train_begin", cat="hapi",
+                             epochs=self.params.get("epochs"))
+
+    def on_train_end(self, logs=None):
+        self._events.instant(f"{self.prefix}.train_end", cat="hapi")
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._events.begin(f"{self.prefix}.epoch", cat="hapi",
+                           epoch=epoch)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._events.end(f"{self.prefix}.epoch", cat="hapi", epoch=epoch)
+
+    def on_train_batch_end(self, step, logs=None):
+        logs = logs or {}
+        self._steps.inc()
+        loss = logs.get("loss")
+        if isinstance(loss, (list, tuple)) and loss:
+            loss = loss[0]
+        if isinstance(loss, (int, float)):
+            self._loss.set(loss)
+        lr = logs.get("lr")
+        if isinstance(lr, (int, float)):
+            self._lr.set(lr)
+
+    def on_eval_begin(self, logs=None):
+        self._events.begin(f"{self.prefix}.eval", cat="hapi")
+
+    def on_eval_end(self, logs=None):
+        logs = logs or {}
+        loss = logs.get("loss")
+        if isinstance(loss, (int, float)):
+            self._eval_loss.set(loss)
+        self._events.end(f"{self.prefix}.eval", cat="hapi")
+
+
 class VisualDL(Callback):
     """VisualDL is an ecosystem package; on the TPU build scalars are logged
     as TSV so any dashboard can ingest them."""

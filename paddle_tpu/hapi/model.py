@@ -10,12 +10,32 @@ behavior.
 
 from __future__ import annotations
 
+import time
+
 import numpy as np
 
 from ..core.tensor import Tensor
 from ..core import tape as _tape
+from ..observability import metrics as _obs_metrics
 from .callbacks import Callback, ProgBarLogger, ModelCheckpoint, LRScheduler as LRCallback
 from ..metric import Metric
+
+_FIT_STEP_SECONDS = _obs_metrics.histogram(
+    "hapi.step_seconds", "Model.fit wall seconds per train batch")
+_FIT_IPS = _obs_metrics.histogram(
+    "hapi.ips", "Model.fit samples per second, by train batch")
+_EVAL_BATCH_SECONDS = _obs_metrics.histogram(
+    "hapi.eval_batch_seconds", "Model.evaluate wall seconds per batch")
+
+
+def _batch_rows(inputs):
+    """Leading-dim sample count of the first array-like input (None when
+    the batch carries no shaped leaf)."""
+    for x in inputs:
+        shape = getattr(x, "shape", None)
+        if shape:
+            return int(shape[0])
+    return None
 
 
 class Model:
@@ -203,7 +223,13 @@ class Model:
                 for cb in cbs:
                     cb.on_train_batch_begin(step)
                 ins, lbls = _split_batch(batch)
+                bt0 = time.perf_counter()
                 losses = self.train_batch(ins, lbls)
+                bdt = time.perf_counter() - bt0
+                _FIT_STEP_SECONDS.observe(bdt)
+                rows = _batch_rows(ins)
+                if rows and bdt > 0:
+                    _FIT_IPS.observe(rows / bdt)
                 logs = {"loss": losses}
                 logs["lr"] = self._optimizer.get_lr()
                 self._metric_logs(logs)
@@ -229,7 +255,9 @@ class Model:
         losses = []
         for step, batch in enumerate(loader):
             ins, lbls = _split_batch(batch)
+            bt0 = time.perf_counter()
             loss, _ = self.eval_batch(ins, lbls)
+            _EVAL_BATCH_SECONDS.observe(time.perf_counter() - bt0)
             if loss is not None:
                 losses.append(loss)
         logs = {}

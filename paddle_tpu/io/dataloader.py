@@ -32,12 +32,39 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 
 import numpy as np
 
 from ..core.tensor import Tensor
+from ..observability import events as _obs_events
+from ..observability import metrics as _obs_metrics
 from .dataset import IterableDataset
 from .sampler import BatchSampler
+
+# input-pipeline health: queue_depth says how many prefetched batches sit
+# ready (0 while training = the loader is the bottleneck); stall_seconds
+# is how long the consumer blocked waiting for the next batch (producer
+# stall). Stalls > 1 ms also land on the event timeline, so a slow step
+# in the chrome trace shows WHETHER the host pipeline caused it.
+_DL_QUEUE_DEPTH = _obs_metrics.gauge(
+    "dataloader.queue_depth", "prefetched batches ready at consume time")
+_DL_STALL_SECONDS = _obs_metrics.histogram(
+    "dataloader.stall_seconds",
+    "consumer wall seconds blocked waiting for the next batch")
+_DL_BATCHES = _obs_metrics.counter(
+    "dataloader.batches", "batches delivered to the consumer")
+_STALL_EVENT_THRESHOLD_S = 1e-3
+
+
+def _note_delivery(stall, depth, mode, batch_index):
+    _DL_STALL_SECONDS.observe(stall, workers=mode)
+    _DL_QUEUE_DEPTH.set(depth, workers=mode)
+    _DL_BATCHES.inc(workers=mode)
+    if stall > _STALL_EVENT_THRESHOLD_S:
+        _obs_events.instant("dataloader.stall", cat="io", workers=mode,
+                            seconds=round(stall, 6), batch=batch_index,
+                            queue_depth=depth)
 
 
 class WorkerInfo:
@@ -283,6 +310,7 @@ class DataLoader:
                     done_submitting = True
                     break
             while n_consumed < n_submitted or not done_submitting:
+                stall_t0 = time.perf_counter()
                 with results_lock:
                     while n_consumed not in results:
                         got_notify = results_lock.wait(timeout=self.timeout or None)
@@ -295,6 +323,9 @@ class DataLoader:
                                 f"DataLoader worker timed out after "
                                 f"{self.timeout}s waiting for batch {n_consumed}")
                     out = results.pop(n_consumed)
+                    depth = len(results)
+                _note_delivery(time.perf_counter() - stall_t0, depth,
+                               "threads", n_consumed)
                 n_consumed += 1
                 if isinstance(out, Exception):
                     raise out
@@ -343,6 +374,7 @@ class DataLoader:
                     break
             while n_consumed < n_submitted or not done_submitting:
                 waited = 0.0
+                stall_t0 = time.perf_counter()
                 while n_consumed not in results:
                     # poll in short slices so a dead worker (segfault/OOM
                     # kill) raises instead of blocking forever
@@ -361,6 +393,8 @@ class DataLoader:
                             f"DataLoader process worker timed out after "
                             f"{self.timeout}s waiting for batch {n_consumed}")
                 out = results.pop(n_consumed)
+                _note_delivery(time.perf_counter() - stall_t0, len(results),
+                               "procs", n_consumed)
                 n_consumed += 1
                 if isinstance(out, Exception):
                     raise out

@@ -15,6 +15,7 @@ from __future__ import annotations
 import functools
 import os
 import pickle
+import time
 
 import numpy as np
 import jax
@@ -26,6 +27,19 @@ from ..core.tensor import Tensor, Parameter
 from ..core import tape as _tape
 from ..core import random_state
 from ..nn.layer.layers import Layer
+from ..observability import events as _obs_events
+from ..observability import metrics as _obs_metrics
+
+# per-function compile/cache telemetry: the acceptance invariant is that
+# calling a jitted fn twice with identical avals shows cache_hit += 1 and
+# compile_count unchanged (see tests/test_observability.py)
+_COMPILE_COUNT = _obs_metrics.counter(
+    "jit.compile_count", "to_static trace+compile builds, by function")
+_CACHE_HIT = _obs_metrics.counter(
+    "jit.cache_hit", "to_static calls served from the jit cache")
+_COMPILE_SECONDS = _obs_metrics.histogram(
+    "jit.compile_seconds",
+    "wall seconds from cache miss to first result, by function")
 
 
 class InputSpec:
@@ -132,6 +146,34 @@ class StaticFunction:
 
     def __call__(self, *args, **kwargs):
         key = _spec_key(args, kwargs)
+        fn_name = getattr(self, "__name__", None) \
+            or getattr(self._fn, "__name__", "fn")
+        if key in self._cache:
+            _CACHE_HIT.inc(fn=fn_name)
+            return self._call_impl(key, args, kwargs)
+        # miss: a fresh trace+compile — record WHY (first call vs a new
+        # input signature, the retrace cause) and how long the whole
+        # miss-path call takes (trace + XLA compile + first execution:
+        # the user-felt time-to-first-result)
+        _obs_events.instant(
+            "jit.retrace", cat="jit", fn=fn_name,
+            cause=("first_call" if not self._cache
+                   else "new_input_signature"),
+            cached_signatures=len(self._cache),
+            signature=repr(key)[:300])
+        _obs_events.begin("jit.compile", cat="jit", fn=fn_name,
+                          signature=repr(key)[:300])
+        t0 = time.perf_counter()
+        try:
+            return self._call_impl(key, args, kwargs)
+        finally:
+            dt = time.perf_counter() - t0
+            _COMPILE_COUNT.inc(fn=fn_name)
+            _COMPILE_SECONDS.observe(dt, fn=fn_name)
+            _obs_events.end("jit.compile", cat="jit", fn=fn_name,
+                            seconds=round(dt, 9))
+
+    def _call_impl(self, key, args, kwargs):
         if key not in self._cache:
             tree_args, tree_kwargs = _make_tree(args, kwargs)
             self._cache[key] = self._build(tree_args, tree_kwargs)

@@ -12,6 +12,7 @@ path, where `shardings` place params/batch on a mesh.
 from __future__ import annotations
 
 import functools
+import time
 
 import jax
 import jax.numpy as jnp
@@ -19,6 +20,18 @@ import jax.numpy as jnp
 from ..core.tensor import Tensor
 from ..core import tape as _tape
 from ..core import random_state
+from ..observability import metrics as _obs_metrics
+
+# NOTE: jax dispatch is async — step_seconds is host wall time per
+# dispatched step, which converges to true step time whenever the caller
+# consumes the loss (float()) each step, as Model.fit and every trainer
+# in this repo do
+_STEP_SECONDS = _obs_metrics.histogram(
+    "train.step_seconds", "TrainStep wall seconds per compiled step")
+_STEP_IPS = _obs_metrics.histogram(
+    "train.ips", "TrainStep items (batch rows) per second")
+_STEP_COUNT = _obs_metrics.counter(
+    "train.steps", "compiled optimizer steps taken")
 
 _LAYOUT_API = False  # unresolved sentinel (None = resolved, unavailable)
 
@@ -429,6 +442,7 @@ class TrainStep:
         Returns the K per-step losses as one Tensor [K]."""
         if not batches:
             raise ValueError("many() expects at least one batch")
+        t0 = time.perf_counter()
         if self.has_aux:
             raise ValueError("many() does not support has_aux steps (the "
                              "per-step aux would be K-stacked; run "
@@ -511,9 +525,15 @@ class TrainStep:
         for n, st in zip(self._param_names, new_opt_states):
             opt._accumulators[id(sd[n])] = st
         opt._step_count += k
+        dt = time.perf_counter() - t0
+        _STEP_COUNT.inc(k)
+        # one observation per pack: the per-step average of the scanned
+        # K-step program (individual in-scan steps are not host-visible)
+        _STEP_SECONDS.observe(dt / k)
         return Tensor(losses)
 
     def __call__(self, *batch):
+        t0 = time.perf_counter()
         (sd, param_arrays, buffer_arrays, opt_states, lr, rng_key,
          scaler_state, batch_arrays) = self._marshal(*batch)
         opt = self.optimizer
@@ -533,6 +553,12 @@ class TrainStep:
         for n, st in zip(self._param_names, new_opt_states):
             opt._accumulators[id(sd[n])] = st
         opt._step_count += 1
+        dt = time.perf_counter() - t0
+        _STEP_COUNT.inc()
+        _STEP_SECONDS.observe(dt)
+        if batch_arrays and hasattr(batch_arrays[0], "shape") \
+                and batch_arrays[0].shape and dt > 0:
+            _STEP_IPS.observe(batch_arrays[0].shape[0] / dt)
         if self.has_aux:
             return Tensor(loss), jax.tree.map(Tensor, aux_arrays)
         return Tensor(loss)

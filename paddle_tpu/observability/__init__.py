@@ -1,0 +1,57 @@
+"""paddle_tpu.observability — unified metrics, structured event timeline,
+and chrome-trace export across jit / training / serving.
+
+The TPU-native rebuild of the reference's profiler subsystem's LIVE
+half (SURVEY.md N20 host tracer + P26 Python Profiler): where
+``paddle_tpu.profiler`` wraps ``jax.profiler`` device traces, this
+package answers the production questions a device trace cannot —
+"why did step time spike", "which function retraced", "how deep is the
+serving queue" — from one process-wide place.
+
+Three layers (see each module's docstring):
+
+* :mod:`~paddle_tpu.observability.metrics` — typed Counter / Gauge /
+  Histogram registry with label sets; ``snapshot()`` (nested JSON) and
+  ``render_prometheus()`` (text exposition); absorbs the PR 2
+  ``profiler.counters()`` provider registry.
+* :mod:`~paddle_tpu.observability.events` — bounded ring-buffer
+  structured event log with chrome-trace/Perfetto JSON export.
+* :mod:`~paddle_tpu.observability.span` — ``span(name, **labels)``:
+  one context manager emitting a ``jax.profiler.TraceAnnotation``, a
+  histogram observation, and a begin/end timeline pair.
+
+CLI: ``python -m paddle_tpu.observability {snapshot,prometheus,trace}``.
+"""
+
+from __future__ import annotations
+
+from . import events, metrics
+from .events import export_chrome_trace
+from .metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    counter,
+    default_registry,
+    gauge,
+    histogram,
+    render_prometheus,
+    snapshot,
+    value,
+)
+from .span import current_span, span, span_depth
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "Registry",
+    "counter", "gauge", "histogram", "value",
+    "default_registry", "snapshot", "render_prometheus",
+    "events", "metrics", "span", "current_span", "span_depth",
+    "export_chrome_trace", "reset",
+]
+
+
+def reset():
+    """Clear every metric value AND the event timeline (test isolation)."""
+    metrics.reset()
+    events.clear()

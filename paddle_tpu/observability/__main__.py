@@ -1,0 +1,56 @@
+"""``python -m paddle_tpu.observability`` — dump the process's live
+observability state.
+
+Modes:
+  snapshot      nested JSON of every metric + legacy provider (default)
+  prometheus    text exposition (# HELP / # TYPE / samples)
+  trace         chrome-trace JSON of the event timeline
+
+``-o FILE`` writes to a file instead of stdout. ``--exec SCRIPT`` runs a
+Python file first (in this process), so the dump reflects an actual
+workload — the one-process analog of scraping a serving worker.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.observability",
+        description="dump paddle_tpu observability state")
+    parser.add_argument("mode", nargs="?", default="snapshot",
+                        choices=("snapshot", "prometheus", "trace"))
+    parser.add_argument("-o", "--output", default=None,
+                        help="write to FILE instead of stdout")
+    parser.add_argument("--exec", dest="script", default=None,
+                        help="run a Python script first, then dump")
+    args = parser.parse_args(argv)
+
+    if args.script:
+        with open(args.script) as f:
+            code = compile(f.read(), args.script, "exec")
+        exec(code, {"__name__": "__main__", "__file__": args.script})
+
+    from . import events, metrics
+
+    if args.mode == "snapshot":
+        text = json.dumps(metrics.snapshot(), indent=2, default=repr)
+    elif args.mode == "prometheus":
+        text = metrics.render_prometheus()
+    else:
+        text = events.export_chrome_trace()
+
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(text)
+    else:
+        sys.stdout.write(text if text.endswith("\n") else text + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

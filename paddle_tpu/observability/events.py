@@ -1,0 +1,203 @@
+"""Bounded structured event timeline + chrome-trace export.
+
+Every subsystem appends typed host events here — compile begin/end with
+the aval signature and wall seconds, retrace causes, dataloader stalls,
+serving slot alloc/retire/EOS, checkpoint saves — into ONE process-wide
+ring buffer (old events fall off; recording never blocks or grows
+unboundedly).
+
+``export_chrome_trace()`` emits the Chrome Trace Event JSON format
+(``{"traceEvents": [...]}``, ts in microseconds, ``B``/``E``/``i``
+phases), loadable in ``chrome://tracing`` / Perfetto — drop it next to a
+``jax.profiler`` device trace and the host timeline interleaves with the
+XLA one.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+
+DEFAULT_CAPACITY = 4096
+
+#: phases (chrome trace event ``ph`` values)
+BEGIN = "B"
+END = "E"
+INSTANT = "i"
+COMPLETE = "X"
+#: async phases — for spans that overlap rather than nest on one thread
+#: (e.g. serving requests living across many engine steps); require an
+#: ``id`` correlating the pair
+ASYNC_BEGIN = "b"
+ASYNC_END = "e"
+
+
+class Event:
+    """One timeline entry. ``ts`` is ``time.time()`` seconds (wall clock,
+    so host events line up with device-trace timestamps); ``dur`` is
+    seconds for COMPLETE events, None otherwise."""
+
+    __slots__ = ("name", "phase", "ts", "dur", "cat", "tid", "args", "id")
+
+    def __init__(self, name, phase=INSTANT, ts=None, dur=None, cat="host",
+                 tid=None, args=None, id=None):
+        self.name = name
+        self.phase = phase
+        self.ts = time.time() if ts is None else ts
+        self.dur = dur
+        self.cat = cat
+        self.tid = threading.get_ident() if tid is None else tid
+        self.args = dict(args) if args else {}
+        self.id = id
+
+    def to_chrome(self):
+        ev = {
+            "name": self.name,
+            "ph": self.phase,
+            "ts": self.ts * 1e6,          # chrome trace wants microseconds
+            "pid": os.getpid(),
+            "tid": self.tid,
+            "cat": self.cat,
+        }
+        if self.phase == COMPLETE:
+            ev["dur"] = (self.dur or 0.0) * 1e6
+        if self.phase == INSTANT:
+            ev["s"] = "t"                  # thread-scoped instant
+        if self.id is not None:
+            ev["id"] = str(self.id)
+        if self.args:
+            ev["args"] = {k: _jsonable(v) for k, v in self.args.items()}
+        return ev
+
+    def __repr__(self):
+        return (f"Event({self.name!r}, ph={self.phase}, ts={self.ts:.6f}, "
+                f"args={self.args})")
+
+
+def _jsonable(v):
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    return repr(v)
+
+
+class EventLog:
+    """Thread-safe bounded ring buffer of Events."""
+
+    def __init__(self, capacity=DEFAULT_CAPACITY):
+        self._lock = threading.Lock()
+        self._ring = collections.deque(maxlen=capacity)
+        self._dropped = 0
+
+    @property
+    def capacity(self):
+        return self._ring.maxlen
+
+    def set_capacity(self, capacity):
+        with self._lock:
+            old = list(self._ring)
+            self._ring = collections.deque(old[-capacity:],
+                                           maxlen=int(capacity))
+
+    def record(self, name, phase=INSTANT, cat="host", dur=None, args=None,
+               ts=None, id=None):
+        ev = Event(name, phase=phase, ts=ts, dur=dur, cat=cat, args=args,
+                   id=id)
+        with self._lock:
+            if len(self._ring) == self._ring.maxlen:
+                self._dropped += 1
+            self._ring.append(ev)
+        return ev
+
+    def begin(self, name, cat="host", **args):
+        return self.record(name, phase=BEGIN, cat=cat, args=args)
+
+    def end(self, name, cat="host", **args):
+        return self.record(name, phase=END, cat=cat, args=args)
+
+    def instant(self, name, cat="host", **args):
+        return self.record(name, phase=INSTANT, cat=cat, args=args)
+
+    def events(self, name=None, cat=None):
+        with self._lock:
+            evs = list(self._ring)
+        if name is not None:
+            evs = [e for e in evs if e.name == name]
+        if cat is not None:
+            evs = [e for e in evs if e.cat == cat]
+        return evs
+
+    @property
+    def dropped(self):
+        return self._dropped
+
+    def clear(self):
+        with self._lock:
+            self._ring.clear()
+            self._dropped = 0
+
+    def export_chrome_trace(self, file=None):
+        """Chrome Trace Event JSON for the current ring contents, sorted
+        by timestamp (chrome requires monotonically non-decreasing ts
+        within a (pid, tid); sorting globally satisfies the stricter
+        whole-file ordering our tests assert). ``file`` may be a path or
+        a writable file object; returns the JSON string either way."""
+        evs = sorted(self.events(), key=lambda e: e.ts)
+        doc = {
+            "traceEvents": [e.to_chrome() for e in evs],
+            "displayTimeUnit": "ms",
+            "metadata": {"producer": "paddle_tpu.observability",
+                         "dropped_events": self._dropped},
+        }
+        text = json.dumps(doc)
+        if file is not None:
+            if hasattr(file, "write"):
+                file.write(text)
+            else:
+                with open(file, "w") as f:
+                    f.write(text)
+        return text
+
+
+# ------------------------------------------------------------- default log
+_default_log = EventLog()
+
+
+def default_log():
+    return _default_log
+
+
+def record(name, phase=INSTANT, cat="host", dur=None, args=None, ts=None,
+           id=None):
+    return _default_log.record(name, phase=phase, cat=cat, dur=dur,
+                               args=args, ts=ts, id=id)
+
+
+def begin(name, cat="host", **args):
+    return _default_log.begin(name, cat=cat, **args)
+
+
+def end(name, cat="host", **args):
+    return _default_log.end(name, cat=cat, **args)
+
+
+def instant(name, cat="host", **args):
+    return _default_log.instant(name, cat=cat, **args)
+
+
+def events(name=None, cat=None):
+    return _default_log.events(name=name, cat=cat)
+
+
+def clear():
+    _default_log.clear()
+
+
+def set_capacity(capacity):
+    _default_log.set_capacity(capacity)
+
+
+def export_chrome_trace(file=None):
+    return _default_log.export_chrome_trace(file=file)

@@ -65,8 +65,11 @@ class Profiler:
     def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
                  timer_only=False, record_shapes=False, profile_memory=False,
                  with_flops=False, emit_nvtx=False):
+        # tuple form means "record [start, end) once" (reference contract);
+        # repeat=1 — the default repeat=0 would cycle the window forever
         self._scheduler = scheduler if callable(scheduler) else (
-            make_scheduler(closed=scheduler[0], ready=0, record=scheduler[1] - scheduler[0])
+            make_scheduler(closed=scheduler[0], ready=0,
+                           record=scheduler[1] - scheduler[0], repeat=1)
             if isinstance(scheduler, (tuple, list)) else None
         )
         self._on_trace_ready = on_trace_ready
@@ -117,8 +120,41 @@ class Profiler:
             return "no steps recorded"
         import numpy as np
 
-        return (f"steps: {len(times)}  avg: {np.mean(times)*1e3:.3f} ms  "
-                f"p50: {np.percentile(times,50)*1e3:.3f} ms  p99: {np.percentile(times,99)*1e3:.3f} ms")
+        if len(times) == 1:
+            # a single sample has no distribution; percentile interpolation
+            # over an empty tail is meaningless — report the one value as
+            # every quantile instead of crashing/garbage
+            t = times[0]
+            p50 = p99 = t
+        else:
+            p50 = float(np.percentile(times, 50))
+            p99 = float(np.percentile(times, 99))
+        lines = [f"steps: {len(times)}  avg: {np.mean(times)*1e3:.3f} ms  "
+                 f"p50: {p50*1e3:.3f} ms  p99: {p99*1e3:.3f} ms"]
+        lines.extend(self._histogram_lines())
+        return "\n".join(lines)
+
+    @staticmethod
+    def _histogram_lines():
+        """One line per observability histogram family with data — the
+        process-wide view (compile seconds, step time, span durations)
+        alongside this profiler's own step timer."""
+        from ..observability import metrics as _obs_metrics
+
+        lines = []
+        for name, fam in sorted(_obs_metrics.default_registry()
+                                .metrics().items()):
+            if not isinstance(fam, _obs_metrics.Histogram):
+                continue
+            for label_s, st in fam.snapshot_values().items():
+                if not st.get("count"):
+                    continue
+                suffix = f"{{{label_s}}}" if label_s else ""
+                lines.append(
+                    f"  {name}{suffix}: n={st['count']} "
+                    f"mean={st['mean']:.6f} p50={st['p50']:.6f} "
+                    f"p95={st['p95']:.6f} p99={st['p99']:.6f}")
+        return lines
 
     def export(self, path=None, format="json"):
         # xplane files land in self._export_dir via stop_trace
@@ -176,32 +212,30 @@ def profiler_guard(*a, **k):
 # ...) publish live observability counters here — queue depth, TTFT,
 # tokens/s, slot utilization, compile-cache hits — so one profiler-side
 # call snapshots the whole process without importing every subsystem.
-
-_counter_providers = {}
+#
+# The registry itself now lives in paddle_tpu.observability.metrics (one
+# process-wide registry, same {name: zero-arg callable} contract); these
+# names stay as a back-compat facade so PR 2-era callers keep working.
 
 
 def register_counter_provider(name, provider):
     """Register a zero-arg callable returning a {counter: value} mapping
     under ``name`` (later registrations replace earlier ones)."""
-    if not callable(provider):
-        raise TypeError("provider must be callable")
-    _counter_providers[name] = provider  # noqa: PTA402 — process-global
-    # registry is this function's purpose; keys are subsystem names, not
-    # a per-call cache
+    from ..observability import metrics as _obs_metrics
+
+    _obs_metrics.register_provider(name, provider)
 
 
 def unregister_counter_provider(name):
-    _counter_providers.pop(name, None)
+    from ..observability import metrics as _obs_metrics
+
+    _obs_metrics.unregister_provider(name)
 
 
 def counters():
     """Snapshot every registered provider: {name: {counter: value}}.
     A provider that raises is reported as an error string instead of
     poisoning the whole snapshot."""
-    out = {}
-    for name, provider in list(_counter_providers.items()):
-        try:
-            out[name] = dict(provider())
-        except Exception as e:  # pragma: no cover - defensive
-            out[name] = {"error": f"{type(e).__name__}: {e}"}
-    return out
+    from ..observability import metrics as _obs_metrics
+
+    return _obs_metrics.provider_counters()

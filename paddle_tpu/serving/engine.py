@@ -27,6 +27,7 @@ compiler can keep static.
 from __future__ import annotations
 
 import time
+import weakref
 from dataclasses import dataclass
 
 import numpy as np
@@ -35,9 +36,46 @@ import jax.numpy as jnp
 
 from ..core import tape as _tape
 from ..core.tensor import Tensor
+from ..observability import events as _obs_events
+from ..observability import metrics as _obs_metrics
+from ..observability.span import span as _obs_span
 from .kv_cache import SlotKV, SlottedKVCache
 from .sampling import SamplingParams, request_key, sample_batch, sample_token
 from .scheduler import Scheduler
+
+# typed registry families the engine publishes into (labeled by engine
+# instance so two engines in one process stay distinguishable); the
+# legacy flat counters() dict stays as the profiler-facade back-compat
+# surface
+_SRV_TOKENS = _obs_metrics.counter(
+    "serving.tokens_generated", "tokens sampled across prefill+decode")
+_SRV_REQS = _obs_metrics.counter(
+    "serving.requests_finished", "requests retired (EOS or max-tokens)")
+_SRV_DECODE_STEPS = _obs_metrics.counter(
+    "serving.decode_steps", "fused decode steps executed")
+_SRV_PREFILL = _obs_metrics.counter(
+    "serving.prefill_calls", "per-request prefill passes")
+_SRV_QUEUE = _obs_metrics.gauge(
+    "serving.queue_depth", "requests waiting for a slot")
+_SRV_ACTIVE = _obs_metrics.gauge(
+    "serving.active_slots", "slots currently decoding")
+_SRV_UTIL = _obs_metrics.gauge(
+    "serving.slot_utilization", "mean active/total slots over decode steps")
+_SRV_TPS = _obs_metrics.gauge(
+    "serving.tokens_per_s", "generated tokens per engine-busy second")
+_SRV_TTFT = _obs_metrics.histogram(
+    "serving.ttft_seconds", "submit-to-first-token wall seconds")
+_SRV_STEP = _obs_metrics.histogram(
+    "serving.step_seconds", "wall seconds per engine step()")
+# compile/cache families SHARED with jit/api.py: one place answers
+# "which function retraced" for both to_static and serving programs
+_COMPILE_COUNT = _obs_metrics.counter(
+    "jit.compile_count", "to_static trace+compile builds, by function")
+_CACHE_HIT = _obs_metrics.counter(
+    "jit.cache_hit", "to_static calls served from the jit cache")
+_COMPILE_SECONDS = _obs_metrics.histogram(
+    "jit.compile_seconds",
+    "wall seconds from cache miss to first result, by function")
 
 
 class CompiledFn:
@@ -45,10 +83,14 @@ class CompiledFn:
     signature (shape+dtype of every array leaf).  The miss counter is the
     engine's observable proof of static-shape serving: a multi-request
     run with heterogeneous prompt lengths must show decode misses == 1
-    and prefill misses == number of distinct buckets."""
+    and prefill misses == number of distinct buckets.  Hits/misses also
+    land on the typed registry (``jit.compile_count`` / ``jit.cache_hit``
+    labeled ``fn=name``) and every miss leaves a retrace-cause event plus
+    a compile begin/end pair on the timeline."""
 
-    def __init__(self, fn, donate_argnums=()):
+    def __init__(self, fn, donate_argnums=(), name=None):
         self._jit = jax.jit(fn, donate_argnums=donate_argnums)
+        self._name = name or getattr(fn, "__name__", "fn")
         self._seen = set()
         self.misses = 0
         self.hits = 0
@@ -62,10 +104,25 @@ class CompiledFn:
         sig = self._signature(args)
         if sig in self._seen:
             self.hits += 1
-        else:
-            self._seen.add(sig)
-            self.misses += 1
-        return self._jit(*args)
+            _CACHE_HIT.inc(fn=self._name)
+            return self._jit(*args)
+        self._seen.add(sig)
+        self.misses += 1
+        _obs_events.instant(
+            "jit.retrace", cat="serving", fn=self._name,
+            cause=("first_call" if self.misses == 1
+                   else "new_input_signature"),
+            cached_signatures=len(self._seen) - 1)
+        _obs_events.begin("jit.compile", cat="serving", fn=self._name)
+        t0 = time.perf_counter()
+        try:
+            return self._jit(*args)
+        finally:
+            dt = time.perf_counter() - t0
+            _COMPILE_COUNT.inc(fn=self._name)
+            _COMPILE_SECONDS.observe(dt, fn=self._name)
+            _obs_events.end("jit.compile", cat="serving", fn=self._name,
+                            seconds=round(dt, 9))
 
 
 @dataclass
@@ -116,9 +173,11 @@ class Engine:
         # would only warn that donation is unimplemented
         donate = jax.default_backend() not in ("cpu",)
         self._decode = CompiledFn(self._decode_fn,
-                                  donate_argnums=(3, 4) if donate else ())
+                                  donate_argnums=(3, 4) if donate else (),
+                                  name="serving.decode")
         self._prefill = CompiledFn(self._prefill_fn,
-                                   donate_argnums=(4, 5) if donate else ())
+                                   donate_argnums=(4, 5) if donate else (),
+                                   name="serving.prefill")
 
         # observability
         self._decode_steps = 0
@@ -132,16 +191,33 @@ class Engine:
 
         Engine._instances += 1
         self._profiler_name = f"serving.engine{Engine._instances}"
+        self._finalizer = None
         if register_profiler:
             from .. import profiler as _profiler
 
+            # the provider must NOT keep the engine alive (a bound method
+            # in a process-global registry pins the engine — and its full
+            # KV cache — forever): register a weakref-backed provider and
+            # let GC unregister it, so repeated engine construction in
+            # one process never leaks stale providers into
+            # profiler.counters()
+            ref = weakref.ref(self)
+
+            def _provider():
+                eng = ref()
+                return eng.counters() if eng is not None else {}
+
             _profiler.register_counter_provider(self._profiler_name,
-                                                self.counters)
+                                                _provider)
+            self._finalizer = weakref.finalize(
+                self, _profiler.unregister_counter_provider,
+                self._profiler_name)
 
     def close(self):
-        from .. import profiler as _profiler
-
-        _profiler.unregister_counter_provider(self._profiler_name)
+        """Unregister this engine's counter provider (idempotent; also
+        runs automatically when the engine is garbage-collected)."""
+        if self._finalizer is not None:
+            self._finalizer()
 
     # ------------------------------------------------------------ pure fns
     def _run_model(self, state_arrays, ids, views):
@@ -211,27 +287,44 @@ class Engine:
                 f"prompt_len {len(prompt_ids)} + max_new_tokens "
                 f"{sampling.max_new_tokens} exceeds max_seq_len "
                 f"{self.config.max_seq_len}")
-        return self.scheduler.submit(prompt_ids, sampling)
+        req = self.scheduler.submit(prompt_ids, sampling)
+        _SRV_QUEUE.set(self.scheduler.queue_depth,
+                       engine=self._profiler_name)
+        return req
 
     def _admit(self):
         for req in self.scheduler.admissible(self.cache.free_slots):
             slot = self.cache.alloc()
             self.scheduler.start(req, slot)
             bucket = self._bucket(req.prompt_len)
+            _obs_events.instant("serving.slot_alloc", cat="serving",
+                                slot=slot, request=req.request_id,
+                                prompt_len=req.prompt_len, bucket=bucket)
+            # async span: a request's life overlaps other requests on
+            # this thread, so it pairs by id, not by B/E nesting
+            _obs_events.record(
+                "serving.request", phase=_obs_events.ASYNC_BEGIN,
+                cat="serving", id=req.request_id,
+                args={"slot": slot, "prompt_len": req.prompt_len})
             ids = np.zeros((1, bucket), np.int32)
             ids[0, :req.prompt_len] = req.prompt_ids
-            first, new_k, new_v = self._prefill(
-                self._state_arrays, jnp.asarray(ids),
-                jnp.asarray(req.prompt_len, jnp.int32),
-                jnp.asarray(slot, jnp.int32),
-                self.cache.k, self.cache.v,
-                jnp.asarray(req.sampling.seed, jnp.uint32),
-                jnp.asarray(req.sampling.temperature, jnp.float32),
-                jnp.asarray(req.sampling.top_k, jnp.int32),
-                jnp.asarray(req.sampling.top_p, jnp.float32))
+            with _obs_span("serving.prefill_pass", cat="serving",
+                           event_args={"request": req.request_id,
+                                       "bucket": bucket}):
+                first, new_k, new_v = self._prefill(
+                    self._state_arrays, jnp.asarray(ids),
+                    jnp.asarray(req.prompt_len, jnp.int32),
+                    jnp.asarray(slot, jnp.int32),
+                    self.cache.k, self.cache.v,
+                    jnp.asarray(req.sampling.seed, jnp.uint32),
+                    jnp.asarray(req.sampling.temperature, jnp.float32),
+                    jnp.asarray(req.sampling.top_k, jnp.int32),
+                    jnp.asarray(req.sampling.top_p, jnp.float32))
             self.cache.rebind(new_k, new_v)
             self._prefill_calls += 1
             self._tokens_generated += 1
+            _SRV_PREFILL.inc(engine=self._profiler_name)
+            _SRV_TOKENS.inc(engine=self._profiler_name)
             tok = int(np.asarray(first))
             if req.record_token(tok):
                 self._retire(req)
@@ -251,6 +344,18 @@ class Engine:
         self._finished += 1
         self._ttft_sum += req.ttft
         self._ttft_n += 1
+        _SRV_REQS.inc(engine=self._profiler_name)
+        _SRV_TTFT.observe(req.ttft, engine=self._profiler_name)
+        _obs_events.instant("serving.slot_retire", cat="serving",
+                            slot=req.slot, request=req.request_id,
+                            reason=req.finish_reason,
+                            n_generated=req.n_generated)
+        _obs_events.record(
+            "serving.request", phase=_obs_events.ASYNC_END,
+            cat="serving", id=req.request_id,
+            args={"reason": req.finish_reason,
+                  "n_generated": req.n_generated,
+                  "ttft_s": round(req.ttft, 6)})
         # park the freed slot on a masked no-op row until reassigned
         slot = req.slot
         self._tokens[slot] = 0
@@ -281,6 +386,8 @@ class Engine:
             nxt = np.asarray(nxt)
             self._decode_steps += 1
             self._slot_busy_integral += len(active) / self.cache.num_slots
+            _SRV_DECODE_STEPS.inc(engine=self._profiler_name)
+            _SRV_TOKENS.inc(len(active), engine=self._profiler_name)
             for slot, req in active.items():
                 self._tokens_generated += 1
                 # the decode step wrote this token's k/v at pos[slot]
@@ -291,8 +398,24 @@ class Engine:
                 else:
                     self._tokens[slot] = nxt[slot]
                     self._counts[slot] = req.n_generated
-        self._busy_s += time.time() - t0
+        dt = time.time() - t0
+        self._busy_s += dt
+        _SRV_STEP.observe(dt, engine=self._profiler_name)
+        self._publish_gauges()
         return finished
+
+    def _publish_gauges(self):
+        """Refresh the point-in-time typed gauges (once per step — the
+        counters/histograms above accumulate incrementally)."""
+        name = self._profiler_name
+        _SRV_QUEUE.set(self.scheduler.queue_depth, engine=name)
+        _SRV_ACTIVE.set(self.cache.used_slots, engine=name)
+        if self._decode_steps:
+            _SRV_UTIL.set(self._slot_busy_integral / self._decode_steps,
+                          engine=name)
+        if self._busy_s > 0:
+            _SRV_TPS.set(self._tokens_generated / self._busy_s,
+                         engine=name)
 
     def run(self):
         """Drain the queue: step until every submitted request finished.

@@ -1,0 +1,443 @@
+"""paddle_tpu.observability tests: typed registry semantics, histogram
+percentiles vs a numpy reference, chrome-trace export validity, the
+jit compile-counter invariant, span nesting, the profiler facade and its
+satellite fixes (tuple scheduler, n=1 summary, engine provider GC), and
+a CLI smoke via ``python -m``."""
+
+import gc
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu import profiler
+from paddle_tpu.observability import events as obs_events
+from paddle_tpu.observability import metrics as obs_metrics
+from paddle_tpu.observability.metrics import (
+    Counter, Gauge, Histogram, Registry,
+)
+from paddle_tpu.observability.span import current_span, span, span_depth
+
+
+class TestRegistry:
+    def test_counter_labels_and_monotonicity(self):
+        reg = Registry()
+        c = reg.counter("requests", "total requests")
+        c.inc()
+        c.inc(2, route="a")
+        c.inc(route="a")
+        assert c.value() == 1
+        assert c.value(route="a") == 3
+        assert c.value(route="missing") == 0
+        with pytest.raises(ValueError):
+            c.inc(-1)
+
+    def test_gauge_set_inc_dec(self):
+        reg = Registry()
+        g = reg.gauge("depth")
+        g.set(7, q="main")
+        g.inc(q="main")
+        g.dec(3, q="main")
+        assert g.value(q="main") == 5
+
+    def test_get_or_create_returns_same_family(self):
+        reg = Registry()
+        a = reg.counter("x")
+        b = reg.counter("x")
+        assert a is b
+
+    def test_type_conflict_raises(self):
+        reg = Registry()
+        reg.counter("x")
+        with pytest.raises(ValueError):
+            reg.gauge("x")
+
+    def test_label_order_is_canonical(self):
+        reg = Registry()
+        c = reg.counter("c")
+        c.inc(a=1, b=2)
+        c.inc(b=2, a=1)
+        assert c.value(b=2, a=1) == 2
+
+    def test_snapshot_shape(self):
+        reg = Registry()
+        reg.counter("n", "help text").inc(5)
+        reg.gauge("g").set(1.5)
+        reg.histogram("h").observe(0.2)
+        snap = reg.snapshot()
+        assert snap["metrics"]["n"]["type"] == "counter"
+        assert snap["metrics"]["n"]["help"] == "help text"
+        assert snap["metrics"]["n"]["values"][""] == 5
+        assert snap["metrics"]["g"]["values"][""] == 1.5
+        assert snap["metrics"]["h"]["values"][""]["count"] == 1
+        json.dumps(snap)  # must be JSON-able as-is
+
+    def test_reset_keeps_families(self):
+        reg = Registry()
+        c = reg.counter("c")
+        c.inc(10)
+        reg.reset()
+        assert c.value() == 0
+        assert reg.get("c") is c
+        c.inc()
+        assert c.value() == 1
+
+
+class TestHistogram:
+    def test_percentiles_match_numpy(self):
+        reg = Registry()
+        h = reg.histogram("lat")
+        rng = np.random.default_rng(0)
+        samples = rng.lognormal(-3, 1.0, size=500)
+        for s in samples:
+            h.observe(s)
+        for q in (50, 95, 99):
+            assert h.percentile(q) == pytest.approx(
+                float(np.percentile(samples, q)))
+        st = h.stats()
+        assert st["count"] == 500
+        assert st["sum"] == pytest.approx(samples.sum())
+        assert st["mean"] == pytest.approx(samples.mean())
+        assert st["p50"] == pytest.approx(np.percentile(samples, 50))
+
+    def test_buckets_are_cumulative(self):
+        reg = Registry()
+        h = reg.histogram("lat", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+            h.observe(v)
+        b = h.stats()["buckets"]
+        assert b[repr(0.1)] == 1
+        assert b[repr(1.0)] == 3
+        assert b[repr(10.0)] == 4
+        assert b["+Inf"] == 5
+
+    def test_reservoir_is_bounded(self):
+        reg = Registry()
+        h = reg.histogram("lat", reservoir=16)
+        for i in range(100):
+            h.observe(float(i))
+        st = h.stats()
+        assert st["count"] == 100          # exact totals survive
+        # percentiles slide to the most recent window
+        assert h.percentile(50) >= 84.0
+
+    def test_labelled_slots_are_independent(self):
+        reg = Registry()
+        h = reg.histogram("lat")
+        h.observe(1.0, op="a")
+        h.observe(100.0, op="b")
+        assert h.percentile(50, op="a") == 1.0
+        assert h.percentile(50, op="b") == 100.0
+        assert h.percentile(50, op="c") is None
+
+
+class TestPrometheusRendering:
+    def test_exposition_format(self):
+        reg = Registry()
+        reg.counter("jit.compile_count", "compiles").inc(3, fn="f")
+        reg.gauge("queue.depth").set(2)
+        reg.histogram("lat", buckets=(0.1, 1.0)).observe(0.5)
+        text = reg.render_prometheus()
+        assert "# TYPE jit_compile_count counter" in text
+        assert '# HELP jit_compile_count compiles' in text
+        assert 'jit_compile_count{fn="f"} 3' in text
+        assert "# TYPE queue_depth gauge" in text
+        assert "# TYPE lat histogram" in text
+        assert 'lat_bucket{le="0.1"} 0' in text
+        assert 'lat_bucket{le="1.0"} 1' in text
+        assert 'lat_bucket{le="+Inf"} 1' in text
+        assert "lat_sum" in text and "lat_count" in text
+
+    def test_providers_render_as_gauges(self):
+        reg = Registry()
+        reg.register_provider("serving.engine0",
+                              lambda: {"tokens": 42, "note": "text"})
+        text = reg.render_prometheus()
+        assert '# TYPE serving_engine0 gauge' in text
+        assert 'serving_engine0{counter="tokens"} 42' in text
+        assert "note" not in text          # non-numeric values skipped
+
+    def test_default_registry_render_nonempty(self):
+        text = obs.render_prometheus()
+        assert "# TYPE " in text
+
+
+class TestProviders:
+    def test_register_snapshot_unregister(self):
+        reg = Registry()
+        reg.register_provider("sub", lambda: {"a": 1})
+        assert reg.provider_counters() == {"sub": {"a": 1}}
+        assert reg.snapshot()["providers"] == {"sub": {"a": 1}}
+        reg.unregister_provider("sub")
+        assert reg.provider_counters() == {}
+
+    def test_raising_provider_is_isolated(self):
+        reg = Registry()
+
+        def bad():
+            raise RuntimeError("boom")
+
+        reg.register_provider("bad", bad)
+        reg.register_provider("good", lambda: {"x": 1})
+        out = reg.provider_counters()
+        assert out["good"] == {"x": 1}
+        assert "RuntimeError" in out["bad"]["error"]
+
+    def test_non_callable_rejected(self):
+        reg = Registry()
+        with pytest.raises(TypeError):
+            reg.register_provider("x", {"not": "callable"})
+
+
+class TestEvents:
+    def test_ring_is_bounded_and_counts_drops(self):
+        log = obs_events.EventLog(capacity=8)
+        for i in range(20):
+            log.instant(f"e{i}")
+        evs = log.events()
+        assert len(evs) == 8
+        assert evs[0].name == "e12"        # oldest 12 fell off
+        assert log.dropped == 12
+
+    def test_chrome_trace_valid_json_monotonic_ts(self, tmp_path):
+        log = obs_events.EventLog()
+        log.begin("outer", cat="test", k=1)
+        log.instant("mark", cat="test")
+        log.end("outer", cat="test")
+        path = tmp_path / "trace.json"
+        text = log.export_chrome_trace(file=str(path))
+        with open(path) as f:
+            doc = json.load(f)             # must be loadable by json.load
+        assert json.loads(text) == doc
+        evs = doc["traceEvents"]
+        assert len(evs) == 3
+        ts = [e["ts"] for e in evs]
+        assert ts == sorted(ts)            # monotonically ordered
+        assert {e["ph"] for e in evs} == {"B", "i", "E"}
+        assert all("pid" in e and "tid" in e for e in evs)
+        assert evs[0]["args"] == {"k": 1}
+
+    def test_filtering(self):
+        log = obs_events.EventLog()
+        log.instant("a", cat="x")
+        log.instant("b", cat="y")
+        assert [e.name for e in log.events(cat="x")] == ["a"]
+        assert [e.name for e in log.events(name="b")] == ["b"]
+
+
+class TestSpan:
+    def test_nesting_and_histogram(self):
+        reg_before = obs_metrics.value("span.seconds", name="outer-span")
+        n_before = reg_before["count"] if reg_before else 0
+        assert current_span() is None
+        with span("outer-span", cat="test"):
+            assert current_span() == "outer-span"
+            d = span_depth()
+            with span("inner-span", cat="test"):
+                assert current_span() == "inner-span"
+                assert span_depth() == d + 1
+            assert current_span() == "outer-span"
+        assert current_span() is None
+        st = obs_metrics.value("span.seconds", name="outer-span")
+        assert st["count"] == n_before + 1
+        # begin/end pairs landed on the timeline with depth recorded
+        begins = [e for e in obs_events.events(name="inner-span")
+                  if e.phase == obs_events.BEGIN]
+        assert begins and begins[-1].args["depth"] == d
+
+    def test_elapsed_and_error_annotation(self):
+        s = span("failing-span", cat="test")
+        with pytest.raises(ValueError):
+            with s:
+                raise ValueError("x")
+        assert s.elapsed is not None and s.elapsed >= 0
+        ends = [e for e in obs_events.events(name="failing-span")
+                if e.phase == obs_events.END]
+        assert ends[-1].args["error"] == "ValueError"
+
+    def test_event_args_stay_off_histogram_labels(self):
+        with span("arg-span", cat="test", event_args={"path": "/tmp/x"}):
+            pass
+        st = obs_metrics.value("span.seconds", name="arg-span")
+        assert st["count"] >= 1            # labeled only by name
+        begins = [e for e in obs_events.events(name="arg-span")
+                  if e.phase == obs_events.BEGIN]
+        assert begins[-1].args["path"] == "/tmp/x"
+
+
+class TestJitInstrumentation:
+    def test_compile_counter_invariant(self):
+        """Two calls with identical avals = one compile + one cache hit;
+        a new input signature = a second compile, not a hit."""
+        import paddle_tpu.jit as jit
+
+        @jit.to_static
+        def obs_fn(x):
+            return x * 2 + 1
+
+        def vals():
+            c = obs.value("jit.compile_count", fn="obs_fn") or 0
+            h = obs.value("jit.cache_hit", fn="obs_fn") or 0
+            return c, h
+
+        c0, h0 = vals()
+        a = paddle.to_tensor(np.ones((2, 3), np.float32))
+        obs_fn(a)
+        obs_fn(paddle.to_tensor(np.zeros((2, 3), np.float32)))
+        c1, h1 = vals()
+        assert c1 == c0 + 1
+        assert h1 == h0 + 1
+        obs_fn(paddle.to_tensor(np.ones((4, 3), np.float32)))
+        c2, h2 = vals()
+        assert c2 == c0 + 2
+        assert h2 == h0 + 1
+        # compile begin/end pairs match the compile count
+        begins = [e for e in obs_events.events(name="jit.compile")
+                  if e.phase == obs_events.BEGIN
+                  and e.args.get("fn") == "obs_fn"]
+        ends = [e for e in obs_events.events(name="jit.compile")
+                if e.phase == obs_events.END
+                and e.args.get("fn") == "obs_fn"]
+        assert len(begins) == len(ends) == 2
+        assert all(e.args["seconds"] >= 0 for e in ends)
+        # the miss also explains itself on the timeline
+        causes = [e.args["cause"] for e in
+                  obs_events.events(name="jit.retrace")
+                  if e.args.get("fn") == "obs_fn"]
+        assert causes == ["first_call", "new_input_signature"]
+        st = obs.value("jit.compile_seconds", fn="obs_fn")
+        assert st["count"] >= 2
+
+
+class TestProfilerSatellites:
+    def test_make_scheduler_tuple_records_once(self):
+        """(start, end) = record [start, end) ONCE — regression for the
+        repeat=0 form that cycled the window forever."""
+        from paddle_tpu.profiler import Profiler, ProfilerState
+
+        p = Profiler(scheduler=(2, 5), timer_only=True)
+        states = [p._scheduler(i) for i in range(12)]
+        assert states[:2] == [ProfilerState.CLOSED] * 2
+        assert states[2:4] == [ProfilerState.RECORD] * 2
+        assert states[4] == ProfilerState.RECORD_AND_RETURN
+        # the old bug: step 7 re-entered RECORD; now closed forever
+        assert states[5:] == [ProfilerState.CLOSED] * 7
+
+    def test_summary_single_step(self):
+        from paddle_tpu.profiler import Profiler
+
+        p = Profiler(timer_only=True)
+        p.start()
+        p.step()
+        text = p.summary()
+        assert "steps: 1" in text
+        assert "p50" in text and "p99" in text
+
+    def test_summary_includes_observability_histograms(self):
+        from paddle_tpu.profiler import Profiler
+
+        obs_metrics.histogram("test.profiler_summary").observe(0.25)
+        p = Profiler(timer_only=True)
+        p.start()
+        p.step()
+        p.step()
+        assert "test.profiler_summary" in p.summary()
+
+    def test_facade_register_and_counters(self):
+        profiler.register_counter_provider("facade.test",
+                                           lambda: {"v": 7})
+        try:
+            assert profiler.counters()["facade.test"] == {"v": 7}
+            # one registry: visible through observability too
+            assert obs_metrics.provider_counters()["facade.test"] == \
+                {"v": 7}
+            assert obs.snapshot()["providers"]["facade.test"] == {"v": 7}
+        finally:
+            profiler.unregister_counter_provider("facade.test")
+        assert "facade.test" not in profiler.counters()
+
+
+class TestEngineProviderLifecycle:
+    """Repeated engine construction must not leak stale providers
+    (regression: bound-method providers pinned engines forever)."""
+
+    def _tiny_engine(self, register=True):
+        from paddle_tpu.models import GPTConfig, GPTForCausalLM
+        from paddle_tpu.serving import Engine, EngineConfig
+
+        cfg = GPTConfig(vocab_size=64, hidden_size=32,
+                        intermediate_size=64, num_hidden_layers=1,
+                        num_attention_heads=2,
+                        max_position_embeddings=32)
+        paddle.seed(0)
+        m = GPTForCausalLM(cfg)
+        m.eval()
+        return Engine(m, EngineConfig(num_slots=1, max_seq_len=16),
+                      register_profiler=register)
+
+    def test_close_unregisters_provider(self):
+        eng = self._tiny_engine()
+        name = eng._profiler_name
+        assert name in profiler.counters()
+        eng.close()
+        assert name not in profiler.counters()
+
+    def test_gc_unregisters_provider(self):
+        eng = self._tiny_engine()
+        name = eng._profiler_name
+        assert name in profiler.counters()
+        del eng
+        gc.collect()
+        assert name not in profiler.counters()
+
+    def test_live_engine_counters_unchanged_via_facade(self):
+        eng = self._tiny_engine()
+        try:
+            via_facade = profiler.counters()[eng._profiler_name]
+            assert via_facade == eng.counters()
+        finally:
+            eng.close()
+
+
+class TestCLI:
+    def test_snapshot_smoke(self, tmp_path):
+        script = tmp_path / "load.py"
+        script.write_text(
+            "from paddle_tpu.observability import metrics, events\n"
+            "metrics.counter('cli.test').inc(3)\n"
+            "events.instant('cli.mark')\n")
+        out = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.observability",
+             "snapshot", "--exec", str(script)],
+            capture_output=True, text=True, timeout=120,
+            env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"})
+        assert out.returncode == 0, out.stderr
+        snap = json.loads(out.stdout)
+        assert snap["metrics"]["cli.test"]["values"][""] == 3
+
+    def test_trace_and_prometheus_modes(self, tmp_path):
+        script = tmp_path / "load.py"
+        script.write_text(
+            "from paddle_tpu.observability import metrics, events\n"
+            "metrics.histogram('cli.h').observe(0.1)\n"
+            "events.instant('cli.mark')\n")
+        env = {**__import__("os").environ, "JAX_PLATFORMS": "cpu"}
+        trace_file = tmp_path / "t.json"
+        out = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.observability", "trace",
+             "--exec", str(script), "-o", str(trace_file)],
+            capture_output=True, text=True, timeout=120, env=env)
+        assert out.returncode == 0, out.stderr
+        with open(trace_file) as f:
+            doc = json.load(f)
+        assert any(e["name"] == "cli.mark" for e in doc["traceEvents"])
+        out = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.observability",
+             "prometheus", "--exec", str(script)],
+            capture_output=True, text=True, timeout=120, env=env)
+        assert out.returncode == 0, out.stderr
+        assert "# TYPE cli_h histogram" in out.stdout
