@@ -4,12 +4,16 @@
 rotary and a fixed-capacity KV cache — the serving path the reference
 ships as AnalysisPredictor + fused CUDA decode ops (SURVEY §2.1 N19).
 
-Two decode drivers are measured:
+Decode drivers measured:
   * per-step: one jitted step per token, caches DONATED (in-place HBM
     cache update) — the latency-interactive shape;
   * scan128: all 128 steps as ONE lax.scan program (one dispatch) — the
     TPU-native offline/serving shape; on a tunneled chip this is also
-    the dispatch-noise-free number.
+    the dispatch-noise-free number;
+  * engine horizon rows: serving.Engine at fixed horizon 1/4/8/16 — the
+    continuous-batching engine's horizon-scanned decode (one dispatch +
+    one host sync per H steps), reporting how much of the per-step
+    host overhead the horizon amortizes and the roofline % recovered.
 
 A numerics gate runs first ON THE BENCH DEVICE: fused cached decode must
 match the fused prefill of the concatenated sequence (self-consistency)
@@ -188,6 +192,89 @@ def _numerics_gate(dtype):
     assert err2 < 2e-3, f"fused-vs-dense mismatch: {err2}"
 
 
+def _bench_engine_horizons(backend, on_tpu, rng):
+    """serving.Engine single-stream decode at fixed horizons 1/4/8/16:
+    the engine-side answer to the per-step-vs-scan128 gap above.  Each
+    row times a b1 request decoding `new_tokens` through num_slots=1,
+    forcing one compiled horizon bucket, and splits wall per-step time
+    into device time (one directly-timed horizon dispatch via
+    Engine.measure_decode_seconds) and host overhead (admit + harvest +
+    dispatch glue) — the quantity horizon scanning amortizes."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.serving import Engine, EngineConfig, SamplingParams
+
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=32000, hidden_size=1536,
+                        intermediate_size=4096, num_hidden_layers=12,
+                        num_attention_heads=12,
+                        max_position_embeddings=1024)
+        max_seq, prompt_len, new_tokens = 768, 512, 128
+        dtype = jnp.bfloat16
+    else:
+        cfg = GPTConfig(vocab_size=1024, hidden_size=256,
+                        intermediate_size=512, num_hidden_layers=2,
+                        num_attention_heads=4,
+                        max_position_embeddings=128)
+        max_seq, prompt_len, new_tokens = 64, 16, 32
+        dtype = jnp.float32
+
+    itemsize = jnp.dtype(dtype).itemsize
+    dim, ffn, vocab = (cfg.hidden_size, cfg.intermediate_size,
+                       cfg.vocab_size)
+    layer_w = (4 * dim * dim + 3 * dim * ffn) * cfg.num_hidden_layers
+    weight_bytes = (layer_w + dim * vocab) * itemsize
+    roofline_ms = (weight_bytes / 819e9 * 1e3) if on_tpu else None
+
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    prompt = rng.randint(0, cfg.vocab_size, prompt_len).tolist()
+    sp = SamplingParams(max_new_tokens=new_tokens)
+    rows = []
+    for horizon in (1, 4, 8, 16):
+        eng = Engine(model, EngineConfig(num_slots=1, max_seq_len=max_seq,
+                                         max_horizon=16,
+                                         cache_dtype=dtype),
+                     register_profiler=False)
+        # warm both compiles (the prefill bucket + this horizon bucket)
+        eng.submit(prompt, sp)
+        while eng.scheduler.has_work:
+            eng.step(horizon=horizon)
+        # timed stream: prefill outside the decode window (matching the
+        # per-step/scan rows above), then fixed-horizon decode
+        eng.submit(prompt, sp)
+        eng.admit()
+        t0 = time.time()
+        while eng.scheduler.has_work:
+            eng.step(horizon=horizon)
+        dt = time.time() - t0
+        per_step_ms = dt * 1000.0 / new_tokens
+        device_s = eng.measure_decode_seconds(horizon)
+        host_ms = max(0.0, per_step_ms - device_s * 1000.0 / horizon)
+        c = eng.stats()
+        eng.close()
+        row = {
+            "metric": f"engine decode tokens/s b1 horizon{horizon} "
+                      f"(prefill {prompt_len} + {new_tokens} new, "
+                      f"{backend})",
+            "value": round(new_tokens / dt, 1),
+            "unit": "tokens/s",
+            "per_step_ms": round(per_step_ms, 3),
+            "host_overhead_ms": round(host_ms, 3),
+            "decode_horizons": c["decode_horizons"],
+            "host_syncs": c["decode_host_syncs"],
+        }
+        if roofline_ms is not None:
+            row["weight_roofline_ms"] = round(roofline_ms, 3)
+            row["roofline_pct"] = round(100.0 * roofline_ms / per_step_ms,
+                                        1)
+        rows.append(row)
+    return rows
+
+
 def _bench_engine(backend, on_tpu, rng):
     """Continuous-batching throughput through serving.Engine: b8 slots,
     STAGGERED arrivals (requests join at decode-step boundaries while
@@ -234,7 +321,7 @@ def _bench_engine(backend, on_tpu, rng):
         if pending and finished:                  # staggered arrivals:
             eng.submit(pending.pop(0), sp)        # join mid-stream
     dt = time.time() - t0
-    c = eng.counters()
+    c = eng.stats()
     eng.close()
     return {
         "metric": f"engine continuous-batching tokens/s b8 staggered "
@@ -246,6 +333,9 @@ def _bench_engine(backend, on_tpu, rng):
         "slot_utilization": round(c["slot_utilization"], 3),
         "decode_compiles": c["decode_compiles"],
         "prefill_compiles": c["prefill_compiles"],
+        "decode_horizons": c["decode_horizons"],
+        "horizon_buckets": c["horizon_buckets"],
+        "wasted_lane_fraction": round(c["wasted_lane_fraction"], 4),
     }
 
 
@@ -358,6 +448,7 @@ def main():
                 100.0 * roofline_ms / (best * 1000.0 / n_steps), 1)
         results.append(row)
 
+    results.extend(_bench_engine_horizons(backend, on_tpu, rng))
     results.append(_bench_engine(backend, on_tpu, rng))
 
     for r in results:

@@ -141,7 +141,10 @@ def create_llm_engine(model, **config_kwargs):
     in the continuous-batching `paddle_tpu.serving.Engine` (the TPU
     rebuild of the reference's AnalysisPredictor + fused_multi_transformer
     decode path). Keyword args populate `serving.EngineConfig`
-    (num_slots, max_seq_len, min_prefill_bucket, cache_dtype)."""
+    (num_slots, max_seq_len, min_prefill_bucket, cache_dtype,
+    max_horizon — the ceiling for horizon-scanned fused decode, where
+    one compiled ``lax.scan`` dispatch advances every slot up to
+    ``max_horizon`` tokens with a single host sync per horizon)."""
     from ..serving import Engine, EngineConfig
 
     return Engine(model, EngineConfig(**config_kwargs))

@@ -9,9 +9,17 @@ around three ideas the benches point at (DECODE_BENCH.json):
 * a **prefill/decode split** with power-of-two prefill buckets — one
   compiled prefill per bucket (engine.py);
 * **continuous batching** — FIFO admission into a fixed slot pool,
-  requests join at decode-step boundaries and free slots on EOS or
+  requests join at horizon boundaries and free slots on EOS or
   max-tokens (scheduler.py), with greedy/temperature/top-k/top-p
-  sampling under per-request seeded PRNG (sampling.py).
+  sampling under per-request seeded PRNG (sampling.py);
+* **horizon-scanned fused decode** — ``Engine.step(horizon=H)`` runs H
+  decode steps as one compiled ``lax.scan`` over device-resident engine
+  state: one dispatch and one host sync per horizon instead of per
+  token, with per-slot EOS/max-token masking inside the scan.  An
+  adaptive policy shrinks the horizon to 1 while requests are queued
+  and grows it toward ``EngineConfig.max_horizon`` when the slot mix is
+  stable.  ``fold_in(seed, n_generated)`` PRNG keeps every horizon
+  bitwise-equal to per-step decode.
 
 Quick start::
 
@@ -19,10 +27,11 @@ Quick start::
     from paddle_tpu.serving import Engine, EngineConfig, SamplingParams
 
     engine = Engine(GPTForCausalLM(cfg),
-                    EngineConfig(num_slots=8, max_seq_len=512))
+                    EngineConfig(num_slots=8, max_seq_len=512,
+                                 max_horizon=8))
     req = engine.submit(prompt_ids, SamplingParams(max_new_tokens=64))
     while engine.scheduler.has_work:
-        engine.step()          # other submits may land between steps
+        engine.step()          # other submits land at horizon boundaries
     print(req.output_ids)
 
 Counters (queue depth, TTFT, tokens/s, slot utilization, compile-cache

@@ -8,15 +8,35 @@ Architecture (prefill/decode split over a slotted static-shape cache):
   there is exactly ONE compiled prefill program per bucket, reused by
   every request whose prompt falls in it (heterogeneous prompt lengths
   stop being a retrace source).
-* **Decode** — ONE fused step over ALL slot rows: embed the last token
-  of every slot, run the model with per-row positions against the full
-  ``[num_slots, max_seq_len, kv_heads, head_dim]`` buffers (written via
-  ``dynamic_update_slice``), and sample per-request tokens under
-  per-request seeded PRNG.  Every step of every request mix has the same
-  input signature, so the step compiles exactly once.
-* **Continuous batching** — requests join at decode-step boundaries and
-  free their slot on EOS/max-tokens; the admission queue drains into
-  freed slots between steps (scheduler.py).
+* **Horizon-scanned decode** — ONE compiled program advances ALL slot
+  rows by ``H`` fused steps: a ``lax.scan`` whose body embeds the last
+  token of every slot, runs the model with per-row positions against
+  the full ``[num_slots, max_seq_len, kv_heads, head_dim]`` buffers
+  (written via ``dynamic_update_slice``), samples per-request tokens
+  under per-request ``fold_in(seed, n_generated)`` PRNG, and masks
+  retired lanes (EOS / max-tokens detected INSIDE the scan: their
+  ``pos``/``counts`` freeze and their sampled tokens harvest as ``-1``).
+  Tokens for all ``H`` steps come back in one ``[H, num_slots]`` array —
+  one dispatch and one host sync per horizon, instead of one of each per
+  token (DECODE_BENCH.json: the per-step driver pays ~1 ms/step of pure
+  host dispatch + sync against a 0.77 ms weight roofline).
+* **Device-resident engine state** — the per-slot decode state
+  (``tokens/pos/counts/active`` plus the loop-invariant
+  ``seeds/temps/top_ks/top_ps/eos_ids/limits``) lives on device and is
+  updated inside the compiled program; the host re-uploads it only when
+  admission changes it (dirty flag), never per step.  Host mirrors are
+  maintained from the harvested tokens alone — no extra device reads.
+* **Continuous batching** — requests join at horizon boundaries and
+  free their slot on EOS/max-tokens; an adaptive policy shrinks the
+  horizon toward 1 when the queue is non-empty or a lane is close to
+  its token budget (so admission latency and EOS-mask waste stay
+  bounded) and grows it toward ``max_horizon`` while the batch is
+  stable.  Horizons are power-of-two buckets, so the decode program
+  compiles exactly once per distinct bucket.
+
+Every horizon partition of a request's token stream is bitwise-equal:
+the scan body is the same jaxpr as a standalone single step, and a
+request's k-th token depends only on (its seed, k, its logits).
 
 The engine reuses the model's own Layer code (functionalized through
 ``use_state``, the TrainStep pattern), so slotted decode is numerically
@@ -55,6 +75,9 @@ _SRV_DECODE_STEPS = _obs_metrics.counter(
     "serving.decode_steps", "fused decode steps executed")
 _SRV_PREFILL = _obs_metrics.counter(
     "serving.prefill_calls", "per-request prefill passes")
+_SRV_WASTED = _obs_metrics.counter(
+    "serving.wasted_lane_tokens",
+    "masked tokens scanned for lanes that retired mid-horizon")
 _SRV_QUEUE = _obs_metrics.gauge(
     "serving.queue_depth", "requests waiting for a slot")
 _SRV_ACTIVE = _obs_metrics.gauge(
@@ -67,6 +90,9 @@ _SRV_TTFT = _obs_metrics.histogram(
     "serving.ttft_seconds", "submit-to-first-token wall seconds")
 _SRV_STEP = _obs_metrics.histogram(
     "serving.step_seconds", "wall seconds per engine step()")
+_SRV_HORIZON = _obs_metrics.histogram(
+    "serving.horizon", "fused decode steps per compiled horizon dispatch",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128))
 # compile/cache families SHARED with jit/api.py: one place answers
 # "which function retraced" for both to_static and serving programs
 _COMPILE_COUNT = _obs_metrics.counter(
@@ -80,25 +106,35 @@ _COMPILE_SECONDS = _obs_metrics.histogram(
 
 class CompiledFn:
     """jax.jit wrapper that counts compile-cache hits/misses by input
-    signature (shape+dtype of every array leaf).  The miss counter is the
-    engine's observable proof of static-shape serving: a multi-request
-    run with heterogeneous prompt lengths must show decode misses == 1
-    and prefill misses == number of distinct buckets.  Hits/misses also
-    land on the typed registry (``jit.compile_count`` / ``jit.cache_hit``
+    signature (shape+dtype of every array leaf, plus the VALUES of any
+    static args — a new static horizon bucket is a new program).  The
+    miss counter is the engine's observable proof of static-shape
+    serving: a multi-request run with heterogeneous prompt lengths must
+    show decode misses == number of distinct horizon buckets and prefill
+    misses == number of distinct length buckets.  Hits/misses also land
+    on the typed registry (``jit.compile_count`` / ``jit.cache_hit``
     labeled ``fn=name``) and every miss leaves a retrace-cause event plus
     a compile begin/end pair on the timeline."""
 
-    def __init__(self, fn, donate_argnums=(), name=None):
-        self._jit = jax.jit(fn, donate_argnums=donate_argnums)
+    def __init__(self, fn, donate_argnums=(), name=None, static_argnums=()):
+        self._jit = jax.jit(fn, donate_argnums=donate_argnums,
+                            static_argnums=static_argnums)
         self._name = name or getattr(fn, "__name__", "fn")
+        self._static = tuple(static_argnums)
         self._seen = set()
         self.misses = 0
         self.hits = 0
 
-    @staticmethod
-    def _signature(args):
-        return tuple((tuple(jnp.shape(a)), str(jnp.result_type(a)))
-                     for a in jax.tree.leaves(args))
+    @property
+    def calls(self):
+        return self.hits + self.misses
+
+    def _signature(self, args):
+        static = tuple(args[i] for i in self._static if i < len(args))
+        dynamic = [a for i, a in enumerate(args) if i not in self._static]
+        return static + tuple(
+            (tuple(jnp.shape(a)), str(jnp.result_type(a)))
+            for a in jax.tree.leaves(dynamic))
 
     def __call__(self, *args):
         sig = self._signature(args)
@@ -133,6 +169,10 @@ class EngineConfig:
     min_prefill_bucket: int = 8
     #: kv cache dtype; None = the model's parameter dtype
     cache_dtype: object = None
+    #: largest number of fused decode steps one compiled dispatch may
+    #: scan (power of two; 1 disables horizon decode).  The adaptive
+    #: policy picks a bucket in [1, max_horizon] at every boundary.
+    max_horizon: int = 8
 
 
 class Engine:
@@ -160,6 +200,12 @@ class Engine:
             dtype=cache_dtype)
         self.scheduler = Scheduler(self.config.num_slots)
 
+        # host MIRRORS of the per-slot decode state.  The authoritative
+        # copy lives on device between horizons (updated inside the
+        # compiled scan); the mirrors exist so admission can rebuild the
+        # device arrays when it dirties them, and are maintained from
+        # harvested tokens alone — retirement is detected inside the
+        # scan, so it never dirties the device state.
         n = self.config.num_slots
         self._tokens = np.zeros(n, np.int32)        # last token per slot
         self._pos = np.zeros(n, np.int32)           # row length per slot
@@ -168,19 +214,33 @@ class Engine:
         self._temps = np.zeros(n, np.float32)
         self._top_ks = np.zeros(n, np.int32)
         self._top_ps = np.ones(n, np.float32)
+        self._eos_ids = np.full(n, -1, np.int32)    # -1 = no EOS token
+        self._limits = np.zeros(n, np.int32)        # max_new_tokens
+        self._active = np.zeros(n, bool)
+        self._state_dirty = True
+        self._d_tokens = self._d_pos = self._d_counts = None
+        self._d_active = None
+        self._d_params = None
 
         # donation buys in-place HBM cache updates on accelerators; CPU
         # would only warn that donation is unimplemented
         donate = jax.default_backend() not in ("cpu",)
-        self._decode = CompiledFn(self._decode_fn,
-                                  donate_argnums=(3, 4) if donate else (),
-                                  name="serving.decode")
+        self._decode = CompiledFn(
+            self._decode_fn,
+            donate_argnums=(1, 2, 3, 4, 11, 12) if donate else (),
+            static_argnums=(13,), name="serving.decode")
         self._prefill = CompiledFn(self._prefill_fn,
                                    donate_argnums=(4, 5) if donate else (),
                                    name="serving.prefill")
 
         # observability
         self._decode_steps = 0
+        self._decode_horizons = 0
+        self._host_syncs = 0
+        self._decode_harvested = 0
+        self._wasted_lane_tokens = 0
+        self._horizon_buckets = set()
+        self._grow = 1                   # adaptive-horizon growth state
         self._prefill_calls = 0
         self._tokens_generated = 0
         self._busy_s = 0.0
@@ -255,17 +315,41 @@ class Engine:
                  for cv, nv in zip(cache_v, new_views)]
         return first, new_k, new_v
 
-    def _decode_fn(self, state_arrays, tokens, pos, cache_k, cache_v,
-                   seeds, counts, temps, top_ks, top_ps):
-        """The ONE fused decode step over all slots: static shapes
-        everywhere, per-row positions, per-request sampling."""
-        views = [SlotKV(ck, cv, pos)
-                 for ck, cv in zip(cache_k, cache_v)]
-        logits, new_views = self._run_model(state_arrays, tokens[:, None],
-                                            views)
-        nxt = sample_batch(logits[:, 0], seeds, counts, temps, top_ks,
-                           top_ps)
-        return nxt, [v.k for v in new_views], [v.v for v in new_views]
+    def _decode_fn(self, state_arrays, tokens, pos, counts, active,
+                   seeds, temps, top_ks, top_ps, eos_ids, limits,
+                   cache_k, cache_v, horizon):
+        """The horizon-scanned fused decode: ``lax.scan`` over ``horizon``
+        fused steps, all slots, static shapes everywhere.  Retirement is
+        detected inside the scan — a lane whose sampled token hits its
+        EOS id or exhausts its token budget freezes (``pos``/``counts``
+        stop advancing, its carried token stops changing) and harvests
+        ``-1`` from then on.  Frozen lanes still run the model (their
+        k/v writes land at a frozen position in a dead row, overwritten
+        by the next prefill into that slot), so every iteration keeps
+        the one static shape.  ``horizon`` is static: one compiled
+        program per bucket."""
+
+        def body(carry, _):
+            tok, p, cnt, act, ck, cv = carry
+            views = [SlotKV(k, v, p) for k, v in zip(ck, cv)]
+            logits, new_views = self._run_model(state_arrays, tok[:, None],
+                                                views)
+            nxt = sample_batch(logits[:, 0], seeds, cnt, temps, top_ks,
+                               top_ps)
+            nxt = jnp.where(act, nxt, tok)
+            new_cnt = jnp.where(act, cnt + 1, cnt)
+            new_p = jnp.where(act, p + 1, p)
+            done = act & ((nxt == eos_ids) | (new_cnt >= limits))
+            harvest = jnp.where(act, nxt, -1)
+            return ((nxt, new_p, new_cnt, act & ~done,
+                     tuple(v.k for v in new_views),
+                     tuple(v.v for v in new_views)), harvest)
+
+        init = (tokens, pos, counts, active,
+                tuple(cache_k), tuple(cache_v))
+        (tok, p, cnt, act, ck, cv), toks = jax.lax.scan(
+            body, init, None, length=horizon)
+        return (tok, p, cnt, act), list(ck), list(cv), toks
 
     # ------------------------------------------------------------ buckets
     def _bucket(self, prompt_len):
@@ -273,6 +357,31 @@ class Engine:
         while b < prompt_len:
             b *= 2
         return min(b, self.config.max_seq_len)
+
+    @staticmethod
+    def _pow2_floor(x):
+        return 1 << (int(x).bit_length() - 1)
+
+    def _resolve_horizon(self, requested=None):
+        """Pick the horizon bucket for the next decode dispatch.
+
+        Explicit ``requested`` is clamped to ``[1, max_horizon]`` and
+        rounded down to a power of two (the static compile buckets).
+        Adaptive (``requested=None``): 1 while the queue is non-empty
+        (admit at every boundary) or a lane is within one step of its
+        token budget; otherwise grow multiplicatively from the last
+        stable horizon toward ``max_horizon``, capped by the smallest
+        remaining budget so length-retirement never wastes lane steps
+        (EOS remains unpredictable — mid-horizon EOS waste is measured
+        by ``serving.wasted_lane_tokens``)."""
+        max_h = max(1, int(self.config.max_horizon))
+        if requested is not None:
+            return self._pow2_floor(min(max(1, int(requested)), max_h))
+        if self.scheduler.queue_depth:
+            return 1
+        rem = min(r.remaining_budget
+                  for r in self.scheduler.running.values())
+        return self._pow2_floor(max(1, min(max_h, self._grow, rem)))
 
     # ------------------------------------------------------------ API
     def submit(self, prompt_ids, sampling=None):
@@ -292,7 +401,10 @@ class Engine:
                        engine=self._profiler_name)
         return req
 
-    def _admit(self):
+    def admit(self):
+        """Run admission + prefill for queued requests without decoding
+        (step() calls this; exposed so latency-sensitive callers and
+        benchmarks can separate prefill from the decode window)."""
         for req in self.scheduler.admissible(self.cache.free_slots):
             slot = self.cache.alloc()
             self.scheduler.start(req, slot)
@@ -337,6 +449,15 @@ class Engine:
             self._temps[slot] = s.temperature
             self._top_ks[slot] = s.top_k
             self._top_ps[slot] = s.top_p
+            self._eos_ids[slot] = -1 if s.eos_token_id is None \
+                else int(s.eos_token_id)
+            self._limits[slot] = s.max_new_tokens
+            self._active[slot] = True
+            self._state_dirty = True     # admission is the ONLY host
+            # write into device-resident state; retirement is detected
+            # inside the scan, so it needs no re-upload
+
+    _admit = admit      # pre-horizon internal name, kept for callers
 
     def _retire(self, req):
         self.cache.free(req.slot)
@@ -356,53 +477,121 @@ class Engine:
             args={"reason": req.finish_reason,
                   "n_generated": req.n_generated,
                   "ttft_s": round(req.ttft, 6)})
-        # park the freed slot on a masked no-op row until reassigned
-        slot = req.slot
-        self._tokens[slot] = 0
-        self._pos[slot] = 0
-        self._temps[slot] = 0.0
-        self._top_ks[slot] = 0
-        self._top_ps[slot] = 1.0
-        self._counts[slot] = 0
-        self._seeds[slot] = 0
+        # the freed lane keeps its frozen state (matching the device
+        # copy, which masked it inside the scan); the mirror only drops
+        # the active bit — no re-upload, no parking
+        self._active[req.slot] = False
 
-    def step(self):
+    def _sync_device_state(self):
+        """Upload the per-slot state mirrors — only when admission
+        dirtied them.  In steady-state decode the device arrays returned
+        by the previous horizon are passed straight back in."""
+        if not self._state_dirty:
+            return
+        self._d_tokens = jnp.asarray(self._tokens)
+        self._d_pos = jnp.asarray(self._pos)
+        self._d_counts = jnp.asarray(self._counts)
+        self._d_active = jnp.asarray(self._active)
+        self._d_params = tuple(
+            jnp.asarray(a) for a in (self._seeds, self._temps,
+                                     self._top_ks, self._top_ps,
+                                     self._eos_ids, self._limits))
+        self._state_dirty = False
+
+    def _dispatch_horizon(self, h):
+        """One compiled decode dispatch over ``h`` fused steps; adopts
+        the returned device state and returns the harvested ``[h, n]``
+        token array AFTER the one blocking host sync."""
+        self._sync_device_state()
+        seeds, temps, top_ks, top_ps, eos_ids, limits = self._d_params
+        (tok, p, cnt, act), new_k, new_v, toks = self._decode(
+            self._state_arrays, self._d_tokens, self._d_pos,
+            self._d_counts, self._d_active,
+            seeds, temps, top_ks, top_ps, eos_ids, limits,
+            self.cache.k, self.cache.v, h)
+        self.cache.rebind(new_k, new_v)
+        self._d_tokens, self._d_pos = tok, p
+        self._d_counts, self._d_active = cnt, act
+        toks = np.asarray(toks)      # the ONE host sync per horizon
+        self._host_syncs += 1
+        return toks
+
+    def step(self, horizon=None):
         """One engine iteration: admit queued requests into free slots
-        (prefill), then run one fused decode step over every active slot.
-        Returns the requests that finished during this step."""
+        (prefill), then run ONE compiled horizon of fused decode steps
+        over every slot.  ``horizon=None`` lets the adaptive policy pick
+        the bucket; an explicit value is bucketed to a power of two
+        (scanning past a request's retirement is correct — masked — just
+        wasteful).  Returns the requests that finished during this
+        step."""
         t0 = time.time()
         finished = []
-        self._admit()
+        self.admit()
         active = dict(self.scheduler.running)
         if active:
-            nxt, new_k, new_v = self._decode(
-                self._state_arrays,
-                jnp.asarray(self._tokens), jnp.asarray(self._pos),
-                self.cache.k, self.cache.v,
-                jnp.asarray(self._seeds), jnp.asarray(self._counts),
-                jnp.asarray(self._temps), jnp.asarray(self._top_ks),
-                jnp.asarray(self._top_ps))
-            self.cache.rebind(new_k, new_v)
-            nxt = np.asarray(nxt)
-            self._decode_steps += 1
-            self._slot_busy_integral += len(active) / self.cache.num_slots
-            _SRV_DECODE_STEPS.inc(engine=self._profiler_name)
-            _SRV_TOKENS.inc(len(active), engine=self._profiler_name)
-            for slot, req in active.items():
-                self._tokens_generated += 1
-                # the decode step wrote this token's k/v at pos[slot]
-                self._pos[slot] += 1
-                if req.record_token(nxt[slot]):
-                    self._retire(req)
-                    finished.append(req)
-                else:
-                    self._tokens[slot] = nxt[slot]
-                    self._counts[slot] = req.n_generated
+            h = self._resolve_horizon(horizon)
+            self._horizon_buckets.add(h)
+            with _obs_span("serving.decode_step", cat="serving",
+                           engine=self._profiler_name,
+                           event_args={"horizon": h}) as sp:
+                toks = self._dispatch_horizon(h)
+                harvested, wasted = self._harvest(toks, active, h,
+                                                  finished)
+                sp.event_args["tokens_harvested"] = harvested
+            self._decode_steps += h
+            self._decode_horizons += 1
+            self._slot_busy_integral += h * len(active) / self.cache.num_slots
+            name = self._profiler_name
+            _SRV_DECODE_STEPS.inc(h, engine=name)
+            _SRV_HORIZON.observe(h, engine=name)
+            _SRV_TOKENS.inc(harvested, engine=name)
+            if wasted:
+                _SRV_WASTED.inc(wasted, engine=name)
+            # adaptive growth: stable horizon (nothing retired, nothing
+            # waiting) doubles the next one; churn resets to 1
+            if finished or self.scheduler.queue_depth:
+                self._grow = 1
+            else:
+                self._grow = min(max(1, int(self.config.max_horizon)),
+                                 max(self._grow, h) * 2)
         dt = time.time() - t0
         self._busy_s += dt
         _SRV_STEP.observe(dt, engine=self._profiler_name)
         self._publish_gauges()
         return finished
+
+    def _harvest(self, toks, active, h, finished):
+        """Walk the ``[h, num_slots]`` harvested tokens, replaying each
+        running request's stream in order: record real tokens, retire on
+        EOS/limit (the host check mirrors the in-scan mask), count
+        post-retirement ``-1`` lane steps as waste, and keep the host
+        mirrors equal to the frozen device state."""
+        harvested = wasted = 0
+        for slot, req in active.items():
+            done = False
+            for k in range(h):
+                t = int(toks[k, slot])
+                if done:
+                    wasted += 1
+                    continue
+                if t < 0:
+                    raise RuntimeError(
+                        f"horizon mask retired slot {slot} at step {k} "
+                        "but the scheduler still runs its request — "
+                        "in-scan EOS/limit logic diverged from "
+                        "record_token")
+                harvested += 1
+                self._tokens_generated += 1
+                self._tokens[slot] = t
+                self._pos[slot] += 1
+                if req.record_token(t):
+                    self._retire(req)
+                    finished.append(req)
+                    done = True
+                self._counts[slot] = req.n_generated
+        self._decode_harvested += harvested
+        self._wasted_lane_tokens += wasted
+        return harvested, wasted
 
     def _publish_gauges(self):
         """Refresh the point-in-time typed gauges (once per step — the
@@ -443,6 +632,22 @@ class Engine:
         outs = [r.output_ids for r in reqs]
         return outs[0] if single else outs
 
+    # ------------------------------------------------------------ bench
+    def measure_decode_seconds(self, horizon, iters=3):
+        """Benchmark hook: best wall seconds for ONE compiled horizon
+        dispatch (including its single host sync) over the engine's
+        current device state.  Advances the cache/state buffers, so call
+        it only after draining — it exists to separate device time from
+        the engine's host-side per-horizon overhead."""
+        h = self._resolve_horizon(horizon)
+        best = None
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            self._dispatch_horizon(h)
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        return best
+
     # ------------------------------------------------------------ metrics
     def counters(self):
         """Observability snapshot (also exposed via
@@ -454,6 +659,10 @@ class Engine:
             "requests_finished": self._finished,
             "tokens_generated": self._tokens_generated,
             "decode_steps": self._decode_steps,
+            "decode_horizons": self._decode_horizons,
+            "decode_calls": self._decode.calls,
+            "decode_host_syncs": self._host_syncs,
+            "wasted_lane_tokens": self._wasted_lane_tokens,
             "prefill_calls": self._prefill_calls,
             "decode_compiles": self._decode.misses,
             "decode_cache_hits": self._decode.hits,
@@ -468,3 +677,15 @@ class Engine:
         if self._busy_s > 0:
             c["tokens_per_s"] = self._tokens_generated / self._busy_s
         return c
+
+    def stats(self):
+        """counters() plus horizon-decode derived stats: the distinct
+        compiled horizon buckets and the fraction of scanned lane steps
+        wasted on lanes that had already retired mid-horizon."""
+        s = dict(self.counters())
+        lane_steps = self._decode_harvested + self._wasted_lane_tokens
+        s["wasted_lane_fraction"] = (
+            self._wasted_lane_tokens / lane_steps if lane_steps else 0.0)
+        s["horizon_buckets"] = sorted(self._horizon_buckets)
+        s["next_horizon_growth"] = self._grow
+        return s

@@ -18,6 +18,15 @@ Two layers of API:
   buffers and the slot free-list.
 
 All helpers are pure jnp functions so they trace into one XLA program.
+
+Horizon-scan contract (engine.py fused decode): the engine advances all
+slots H steps inside one ``lax.scan``, and lanes that hit EOS/max-tokens
+mid-horizon are *frozen* — their ``pos`` stops advancing — but the scan
+body still issues a ``write_slots`` for every lane every step.  A frozen
+lane therefore keeps rewriting the same row position with garbage.  That
+is safe by construction: the row's visible window is bounded by ``pos``
+(``visible_mask``), so the garbage is never attended over, and prefill
+overwrites the full ``max_seq_len`` row before a freed slot is reused.
 """
 
 from __future__ import annotations

@@ -7,6 +7,13 @@ k-th sampled token depends only on (its seed, k, its logits) — never on
 which slot it occupies or what else is in the batch.  That independence
 is what makes continuous batching reproduce sequential ``generate()``
 token-for-token.
+
+The same property makes horizon-scanned decode exact: the engine keeps
+a per-slot sample counter in the scan carry and derives each step's key
+as ``request_key(seed, counter)`` — i.e. ``fold_in(seed, n_generated)``
+— so whether H tokens come from one fused ``lax.scan`` dispatch or H
+separate step dispatches, token k of a request is sampled with the
+identical key and is bitwise-equal across horizons.
 """
 
 from __future__ import annotations

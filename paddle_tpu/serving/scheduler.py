@@ -47,6 +47,14 @@ class Request:
         return len(self.output_ids)
 
     @property
+    def remaining_budget(self):
+        """Decode steps left before length retirement.  The engine's
+        adaptive horizon never exceeds the smallest remaining budget of
+        any running request, so a horizon dispatch cannot overrun a
+        lane's ``max_new_tokens`` limit."""
+        return self.sampling.max_new_tokens - self.n_generated
+
+    @property
     def ttft(self):
         """Time-to-first-token in seconds (None until the first token)."""
         if self.first_token_time is None:

@@ -171,8 +171,9 @@ class TestEngine:
 
     def test_single_decode_compilation_heterogeneous_prompts(self):
         """The acceptance criterion: a multi-request run with
-        heterogeneous prompt lengths compiles the fused decode step
-        exactly ONCE, and prefill once per length bucket."""
+        heterogeneous prompt lengths compiles the fused decode program
+        exactly ONCE PER HORIZON BUCKET, and prefill once per length
+        bucket."""
         m = _model()
         eng = Engine(m, EngineConfig(num_slots=3, max_seq_len=48,
                                      min_prefill_bucket=4),
@@ -182,12 +183,13 @@ class TestEngine:
                   [9, 8, 7, 6, 5], [1] * 9):
             eng.submit(p, SamplingParams(max_new_tokens=4))
         eng.run()
-        c = eng.counters()
-        assert c["decode_compiles"] == 1
-        assert c["prefill_compiles"] == 3          # buckets {4, 8, 16}
-        assert c["prefill_calls"] == 5
-        assert c["decode_cache_hits"] == c["decode_steps"] - 1
-        assert c["tokens_generated"] == 5 * 4
+        s = eng.stats()
+        assert s["decode_compiles"] == len(s["horizon_buckets"])
+        assert s["prefill_compiles"] == 3          # buckets {4, 8, 16}
+        assert s["prefill_calls"] == 5
+        assert s["decode_cache_hits"] == \
+            s["decode_horizons"] - s["decode_compiles"]
+        assert s["tokens_generated"] == 5 * 4
 
     def test_eos_frees_slot_early(self):
         m = _model()
@@ -272,6 +274,208 @@ class TestEngine:
         finally:
             eng.close()
         assert eng._profiler_name not in profiler.counters()
+
+
+class TestHorizonDecode:
+    """Horizon-scanned fused decode: one compiled dispatch and one host
+    sync advance every slot by H steps, with in-scan EOS/limit masking.
+    Every horizon partition of a request's stream must be bitwise-equal
+    to horizon=1 and to sequential generation."""
+
+    MIXED_PROMPTS = [[1, 5, 9], [2, 7, 4, 11], [3, 3, 8, 1, 2, 9]]
+    MIXED_SAMP = [
+        SamplingParams(max_new_tokens=9),
+        SamplingParams(temperature=0.8, top_k=20, seed=7,
+                       max_new_tokens=12),
+        SamplingParams(temperature=1.0, top_p=0.9, seed=123,
+                       max_new_tokens=10),
+    ]
+
+    @staticmethod
+    def _sequential(m, prompts, samp):
+        outs = []
+        for p, s in zip(prompts, samp):
+            e = Engine(m, EngineConfig(num_slots=2, max_seq_len=32,
+                                       max_horizon=1),
+                       register_profiler=False)
+            outs.append(e.generate(p, s))
+        return outs
+
+    def test_horizon8_bitwise_equals_horizon1_and_sequential(self):
+        m = _model()
+        seq = self._sequential(m, self.MIXED_PROMPTS, self.MIXED_SAMP)
+        e1 = Engine(m, EngineConfig(num_slots=3, max_seq_len=32,
+                                    max_horizon=1),
+                    register_profiler=False)
+        e8 = Engine(m, EngineConfig(num_slots=3, max_seq_len=32,
+                                    max_horizon=8),
+                    register_profiler=False)
+        out1 = e1.generate(self.MIXED_PROMPTS, self.MIXED_SAMP)
+        out8 = e8.generate(self.MIXED_PROMPTS, self.MIXED_SAMP)
+        assert out8 == out1 == seq
+        s1, s8 = e1.stats(), e8.stats()
+        assert s1["horizon_buckets"] == [1]
+        assert max(s8["horizon_buckets"]) > 1       # adaptive growth ran
+        # the horizon engine did the same work in fewer dispatches/syncs
+        assert s8["decode_horizons"] < s1["decode_horizons"]
+        assert s8["decode_host_syncs"] < s1["decode_host_syncs"]
+
+    def test_one_dispatch_and_one_sync_per_horizon(self):
+        """The dispatch-count probe: compiled decode calls == horizon
+        dispatches == blocking host syncs (the per-step np.asarray sync
+        is gone from the decode path)."""
+        m = _model()
+        eng = Engine(m, EngineConfig(num_slots=1, max_seq_len=64,
+                                     max_horizon=8),
+                     register_profiler=False)
+        eng.submit([2, 4, 6], SamplingParams(max_new_tokens=17))
+        while eng.scheduler.has_work:
+            eng.step(horizon=8)
+        c = eng.counters()
+        # 16 decode tokens through horizon-8 dispatches: exactly 2
+        assert c["decode_horizons"] == 2
+        assert c["decode_calls"] == 2
+        assert c["decode_host_syncs"] == 2
+        assert c["decode_steps"] == 16
+        assert c["tokens_generated"] == 17
+
+    def test_mid_horizon_eos_masks_lane(self):
+        """A lane hitting EOS inside the scan freezes: its tokens stop
+        at the EOS, the rest of the horizon is discarded (-1 harvest),
+        and the co-resident request is unaffected bitwise."""
+        m = _model()
+        prompt = [4, 8, 15, 16, 23, 42]
+        other_prompt = [9, 1, 7, 3]
+        ref_engine = Engine(m, EngineConfig(num_slots=1, max_seq_len=32,
+                                            max_horizon=1),
+                            register_profiler=False)
+        ref = ref_engine.generate(prompt, SamplingParams(max_new_tokens=12))
+        other_ref = Engine(
+            m, EngineConfig(num_slots=1, max_seq_len=32, max_horizon=1),
+            register_profiler=False).generate(
+                other_prompt, SamplingParams(max_new_tokens=14))
+        # pick an EOS whose FIRST occurrence lands mid-horizon (decode
+        # scan step 0..6 of the first horizon-8 dispatch)
+        eos = stop = None
+        for k in range(1, 8):
+            if 1 <= ref.index(ref[k]) <= 7:
+                eos, stop = ref[k], ref.index(ref[k])
+                break
+        assert eos is not None, "greedy stream had no usable EOS token"
+        eng = Engine(m, EngineConfig(num_slots=2, max_seq_len=32,
+                                     max_horizon=8),
+                     register_profiler=False)
+        victim = eng.submit(prompt, SamplingParams(max_new_tokens=12,
+                                                   eos_token_id=eos))
+        other = eng.submit(other_prompt, SamplingParams(max_new_tokens=14))
+        while eng.scheduler.has_work:
+            eng.step(horizon=8)
+        assert victim.output_ids == ref[:stop + 1]
+        assert victim.finish_reason == "eos"
+        assert other.output_ids == other_ref
+        s = eng.stats()
+        assert s["wasted_lane_tokens"] > 0          # masked EOS tail
+        assert 0.0 < s["wasted_lane_fraction"] < 1.0
+
+    def test_slot_free_and_reuse_across_horizon_boundary(self):
+        """One slot, two queued requests: the second is admitted at a
+        horizon boundary into the slot the first freed mid-horizon, and
+        both streams match their sequential references."""
+        m = _model()
+        prompts = [[5, 3, 1], [8, 8, 2, 6]]
+        samp = [SamplingParams(max_new_tokens=6),
+                SamplingParams(temperature=0.7, top_k=16, seed=31,
+                               max_new_tokens=7)]
+        seq = self._sequential(m, prompts, samp)
+        eng = Engine(m, EngineConfig(num_slots=1, max_seq_len=32,
+                                     max_horizon=4),
+                     register_profiler=False)
+        reqs = [eng.submit(p, s) for p, s in zip(prompts, samp)]
+        while eng.scheduler.has_work:
+            eng.step(horizon=4)
+        assert [r.output_ids for r in reqs] == seq
+        assert reqs[0].slot == reqs[1].slot         # the slot was reused
+        c = eng.counters()
+        assert c["requests_finished"] == 2
+        assert eng.cache.free_slots == 1
+
+    def test_staggered_admission_with_horizons(self):
+        """Requests joining at horizon boundaries mid-stream reproduce
+        sequential generation bitwise (continuous batching preserved)."""
+        m = _model()
+        seq = self._sequential(m, self.MIXED_PROMPTS, self.MIXED_SAMP)
+        eng = Engine(m, EngineConfig(num_slots=2, max_seq_len=32,
+                                     max_horizon=8),
+                     register_profiler=False)
+        reqs = [eng.submit(self.MIXED_PROMPTS[0], self.MIXED_SAMP[0])]
+        eng.step(horizon=2)
+        reqs.append(eng.submit(self.MIXED_PROMPTS[1], self.MIXED_SAMP[1]))
+        eng.step(horizon=4)
+        reqs.append(eng.submit(self.MIXED_PROMPTS[2], self.MIXED_SAMP[2]))
+        eng.run()
+        assert [r.output_ids for r in reqs] == seq
+
+    def test_one_compile_per_horizon_bucket(self):
+        """Forced horizon sequence 1,8,8,4,2,8: exactly one compile per
+        distinct bucket {1,2,4,8}, cache hits for every repeat."""
+        m = _model()
+        eng = Engine(m, EngineConfig(num_slots=1, max_seq_len=64,
+                                     max_horizon=8),
+                     register_profiler=False)
+        eng.submit([3, 1, 4], SamplingParams(max_new_tokens=26))
+        for h in (1, 8, 8, 4, 2, 8):
+            assert eng.scheduler.has_work
+            eng.step(horizon=h)
+        assert not eng.scheduler.has_work
+        s = eng.stats()
+        assert s["horizon_buckets"] == [1, 2, 4, 8]
+        assert s["decode_compiles"] == 4
+        assert s["decode_horizons"] == 6
+        assert s["decode_cache_hits"] == 2          # the repeated 8s
+        assert s["decode_host_syncs"] == 6
+        # 25 decode tokens out of 1+8+8+4+2+8=31 scanned lane steps
+        assert s["tokens_generated"] == 26
+        assert s["wasted_lane_tokens"] == 6
+
+    def test_adaptive_horizon_growth_and_budget_cap(self):
+        """Stable single-request decode grows 1->2->4->8 and the budget
+        cap retires the lane exactly at a horizon edge: zero waste,
+        4 dispatches for 15 decode tokens."""
+        m = _model()
+        eng = Engine(m, EngineConfig(num_slots=1, max_seq_len=64,
+                                     max_horizon=8),
+                     register_profiler=False)
+        ref = Engine(m, EngineConfig(num_slots=1, max_seq_len=64,
+                                     max_horizon=1),
+                     register_profiler=False).generate(
+            [11, 7, 5], SamplingParams(max_new_tokens=16))
+        out = eng.generate([11, 7, 5], SamplingParams(max_new_tokens=16))
+        assert out == ref
+        s = eng.stats()
+        assert s["horizon_buckets"] == [1, 2, 4, 8]
+        assert s["decode_horizons"] == 4
+        assert s["decode_steps"] == 15
+        assert s["wasted_lane_tokens"] == 0
+        assert s["wasted_lane_fraction"] == 0.0
+        assert s["decode_host_syncs"] == 4
+
+    def test_device_state_not_rebuilt_between_horizons(self):
+        """Steady-state decode never re-uploads per-slot state: the
+        dirty flag is set by admission only, and the device arrays the
+        scan returns are fed straight back in."""
+        m = _model()
+        eng = Engine(m, EngineConfig(num_slots=1, max_seq_len=64,
+                                     max_horizon=4),
+                     register_profiler=False)
+        eng.submit([1, 2, 3], SamplingParams(max_new_tokens=12))
+        eng.step(horizon=2)          # admission dirtied, then uploaded
+        assert eng._state_dirty is False
+        first = eng._d_tokens
+        eng.step(horizon=2)
+        assert eng._state_dirty is False
+        assert eng._d_tokens is not first    # advanced by the scan...
+        eng.run()                            # ...never rebuilt from host
+        assert eng._state_dirty is False
 
 
 class TestSamplingPrimitives:
