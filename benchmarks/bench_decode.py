@@ -339,6 +339,93 @@ def _bench_engine(backend, on_tpu, rng):
     }
 
 
+def _bench_prefix_prefill(backend, on_tpu, rng):
+    """Shared-prefix admission: 8 requests extending one 64-token system
+    prompt, the workload prefix caching + batched prefill target.  Three
+    admission modes ablate the two mechanisms:
+
+      * per-request — submit+admit one at a time: one prefill dispatch
+        per request (the PR-4 engine's admission shape);
+      * batched     — submit all, co-bucketed admission: ONE prefill
+        dispatch for all 8 lanes, every prompt fully recomputed;
+      * prefix      — batched + warm prefix cache: ONE dispatch that
+        gathers the cached 64-token prefix and prefills only the
+        8-token suffixes.
+
+    Each mode runs the workload twice unmeasured (compile + cache warm)
+    then once timed; rows report avg/p95 TTFT (submit -> first token,
+    queue + prefill included) and prefill dispatch counts as deltas over
+    the timed pass."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.serving import Engine, EngineConfig, SamplingParams
+
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=32000, hidden_size=1536,
+                        intermediate_size=4096, num_hidden_layers=12,
+                        num_attention_heads=12,
+                        max_position_embeddings=1024)
+        max_seq, new_tokens = 768, 16
+    else:
+        cfg = GPTConfig(vocab_size=1024, hidden_size=256,
+                        intermediate_size=512, num_hidden_layers=2,
+                        num_attention_heads=4,
+                        max_position_embeddings=128)
+        max_seq, new_tokens = 128, 4
+
+    system = rng.randint(0, cfg.vocab_size, 64).tolist()
+    prompts = [system + rng.randint(0, cfg.vocab_size, 8).tolist()
+               for _ in range(8)]
+    sp = SamplingParams(max_new_tokens=new_tokens)
+
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+
+    def drive(eng, per_request):
+        t0 = time.time()
+        reqs = [eng.submit(p, sp) for p in prompts]
+        if per_request:
+            # PR-4 admission shape: strict-FIFO solo prefills, one
+            # compiled dispatch per request (engine internals on
+            # purpose — the public path always co-buckets now)
+            while eng.scheduler.queue_depth and eng.cache.free_slots:
+                eng._prefill_batch(eng.scheduler.admissible(1))
+        while eng.scheduler.has_work:
+            eng.step()
+        return time.time() - t0, reqs
+
+    rows = []
+    for mode in ("per-request", "batched", "prefix"):
+        eng = Engine(model, EngineConfig(
+            num_slots=8, max_seq_len=max_seq,
+            prefix_block_size=16 if mode == "prefix" else 0),
+            register_profiler=False)
+        drive(eng, mode == "per-request")   # warm compiles (+ cache)
+        drive(eng, mode == "per-request")   # warm the warm-path bucket
+        before = eng.counters()
+        dt, reqs = drive(eng, mode == "per-request")
+        after = eng.counters()
+        eng.close()
+        ttfts = sorted(r.ttft for r in reqs)
+        hit = (after["prefix_hit_tokens"] - before["prefix_hit_tokens"])
+        tot = (after["prompt_tokens"] - before["prompt_tokens"])
+        rows.append({
+            "metric": f"prefill TTFT shared-prefix 64tok x 8 reqs "
+                      f"[{mode}] (+{new_tokens} new, {backend})",
+            "value": round(sum(ttfts) / len(ttfts) * 1e3, 3),
+            "unit": "ms avg TTFT",
+            "ttft_p95_ms": round(ttfts[-1] * 1e3, 3),
+            "prefill_dispatches": (after["prefill_calls"]
+                                   - before["prefill_calls"]),
+            "prefill_requests": (after["prefill_requests"]
+                                 - before["prefill_requests"]),
+            "prefix_hit_ratio": round(hit / tot, 3) if tot else 0.0,
+            "wall_s": round(dt, 4),
+        })
+    return rows
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -450,13 +537,26 @@ def main():
 
     results.extend(_bench_engine_horizons(backend, on_tpu, rng))
     results.append(_bench_engine(backend, on_tpu, rng))
+    results.extend(_bench_prefix_prefill(backend, on_tpu, rng))
 
     for r in results:
         print(json.dumps(r))
     out = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "DECODE_BENCH.json")
+    # merge-preserving write: rows from OTHER backends (each metric
+    # string carries its backend tag) survive a re-run on this one
+    merged = results
+    if os.path.exists(out):
+        try:
+            with open(out) as f:
+                prev = json.load(f)
+            merged = [r for r in prev.get("results", [])
+                      if f"({backend})" not in r.get("metric", "")]
+            merged += results
+        except (ValueError, OSError):
+            pass
     with open(out, "w") as f:
-        json.dump({"backend": backend, "results": results}, f, indent=1)
+        json.dump({"backend": backend, "results": merged}, f, indent=1)
 
 
 if __name__ == "__main__":

@@ -144,7 +144,12 @@ def create_llm_engine(model, **config_kwargs):
     (num_slots, max_seq_len, min_prefill_bucket, cache_dtype,
     max_horizon — the ceiling for horizon-scanned fused decode, where
     one compiled ``lax.scan`` dispatch advances every slot up to
-    ``max_horizon`` tokens with a single host sync per horizon)."""
+    ``max_horizon`` tokens with a single host sync per horizon;
+    prefix_block_size / prefix_cache_bytes — the shared-prefix KV cache
+    that reuses cached prompt blocks instead of recomputing them, 0
+    block size disables; reorder_window — how far admission may
+    co-bucket queued requests into one batched prefill dispatch without
+    starving FIFO order)."""
     from ..serving import Engine, EngineConfig
 
     return Engine(model, EngineConfig(**config_kwargs))

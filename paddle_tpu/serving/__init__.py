@@ -7,7 +7,18 @@ around three ideas the benches point at (DECODE_BENCH.json):
 * a **slotted static-shape KV cache** (kv_cache.py) — one compiled
   decode step for every step of every request mix, zero retracing;
 * a **prefill/decode split** with power-of-two prefill buckets — one
-  compiled prefill per bucket (engine.py);
+  compiled prefill per (lane-bucket, length-bucket) pair (engine.py);
+* **batched fused prefill** — admission groups same-bucket queued
+  requests (``Scheduler.pop_batch``, bounded reorder window so FIFO
+  order is never violated by more than ``reorder_window`` overtakes)
+  and prefills the whole group in ONE compiled dispatch;
+* a **prefix KV cache** (prefix_cache.py) — a block-granular radix
+  store over prompt token ids (RadixAttention-style reuse over
+  vLLM-style fixed-size blocks) backed by a device-resident block
+  pool: a prompt extending a cached prefix gathers the cached KV into
+  its slot row inside the prefill program and prefills only the
+  suffix, bitwise-equal to full recomputation; blocks are refcounted
+  while borrowed and LRU-evicted under ``prefix_cache_bytes``;
 * **continuous batching** — FIFO admission into a fixed slot pool,
   requests join at horizon boundaries and free slots on EOS or
   max-tokens (scheduler.py), with greedy/temperature/top-k/top-p
@@ -40,11 +51,13 @@ hits) are exposed through ``paddle_tpu.profiler.counters()``.
 
 from .engine import CompiledFn, Engine, EngineConfig
 from .kv_cache import SlotKV, SlottedKVCache
+from .prefix_cache import PrefixCache, PrefixLease
 from .sampling import SamplingParams
 from .scheduler import Request, Scheduler
 
 __all__ = [
     "Engine", "EngineConfig", "CompiledFn",
     "SlotKV", "SlottedKVCache",
+    "PrefixCache", "PrefixLease",
     "SamplingParams", "Request", "Scheduler",
 ]

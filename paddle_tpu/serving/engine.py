@@ -2,12 +2,28 @@
 
 Architecture (prefill/decode split over a slotted static-shape cache):
 
-* **Prefill** — each admitted request runs one ``[1, bucket]`` forward
-  that writes its prompt's k/v into its slot row and samples the first
-  token.  Prompts are right-padded to power-of-two length buckets, so
-  there is exactly ONE compiled prefill program per bucket, reused by
-  every request whose prompt falls in it (heterogeneous prompt lengths
-  stop being a retrace source).
+* **Batched fused prefill** — admission groups queued requests that
+  share a prefill bucket (``Scheduler.pop_batch``, bounded reorder
+  window) and prefills the whole group in ONE ``[lanes, bucket]``
+  compiled dispatch: each lane writes its prompt's k/v into its slot
+  row and samples its first token.  Suffixes are right-padded to
+  power-of-two length buckets and the lane count is bucketed the same
+  way, so there is exactly one compiled prefill program per
+  (lane-bucket, length-bucket) pair, reused by every admission batch
+  that falls in it (heterogeneous prompt lengths and batch sizes stop
+  being retrace sources).  Padding lanes carry a ``valid=False`` flag
+  and spare slot ids: they identity-write their rows, so one program
+  serves every real batch size in the lane bucket.
+* **Prefix KV reuse** — a block-granular radix store over prompt token
+  ids (``prefix_cache.py``; RadixAttention's reuse structure over
+  vLLM-style fixed-size blocks) maps cached prefixes to a device-
+  resident block pool.  A request whose prompt extends a cached prefix
+  gathers the cached blocks into its slot row INSIDE the prefill
+  program (``pool[block_ids]`` is traced, not dispatched) and prefills
+  only the suffix; after prefill, the new full blocks of its prompt are
+  scattered back into the pool with one compiled copy per admission
+  batch.  Blocks are refcounted while a slot borrows them and evicted
+  LRU under a byte budget.
 * **Horizon-scanned decode** — ONE compiled program advances ALL slot
   rows by ``H`` fused steps: a ``lax.scan`` whose body embeds the last
   token of every slot, runs the model with per-row positions against
@@ -60,6 +76,7 @@ from ..observability import events as _obs_events
 from ..observability import metrics as _obs_metrics
 from ..observability.span import span as _obs_span
 from .kv_cache import SlotKV, SlottedKVCache
+from .prefix_cache import PrefixCache
 from .sampling import SamplingParams, request_key, sample_batch, sample_token
 from .scheduler import Scheduler
 
@@ -74,7 +91,18 @@ _SRV_REQS = _obs_metrics.counter(
 _SRV_DECODE_STEPS = _obs_metrics.counter(
     "serving.decode_steps", "fused decode steps executed")
 _SRV_PREFILL = _obs_metrics.counter(
-    "serving.prefill_calls", "per-request prefill passes")
+    "serving.prefill_calls", "batched prefill dispatches")
+_SRV_PREFILL_REQS = _obs_metrics.counter(
+    "serving.prefill_requests", "requests prefilled (across batches)")
+_SRV_PREFIX_HIT = _obs_metrics.counter(
+    "serving.prefix_hit_tokens",
+    "prompt tokens served from the prefix KV cache instead of recomputed")
+_SRV_PREFIX_RATIO = _obs_metrics.gauge(
+    "serving.prefix_hit_ratio",
+    "cumulative prefix-cache hit tokens / admitted prompt tokens")
+_SRV_PREFILL_BATCH = _obs_metrics.histogram(
+    "serving.prefill_batch_size", "requests co-prefilled per dispatch",
+    buckets=(1, 2, 4, 8, 16, 32))
 _SRV_WASTED = _obs_metrics.counter(
     "serving.wasted_lane_tokens",
     "masked tokens scanned for lanes that retired mid-horizon")
@@ -173,6 +201,18 @@ class EngineConfig:
     #: scan (power of two; 1 disables horizon decode).  The adaptive
     #: policy picks a bucket in [1, max_horizon] at every boundary.
     max_horizon: int = 8
+    #: prefix-cache block size in tokens: full blocks of every admitted
+    #: prompt are cached and reused by later prompts sharing the prefix
+    #: (0 disables prefix caching)
+    prefix_block_size: int = 16
+    #: device-byte budget for the prefix-cache block pool; the pool
+    #: holds budget // bytes_per_block blocks, LRU-evicted when full
+    prefix_cache_bytes: int = 8 << 20
+    #: admission reorder window: a queued request is never overtaken by
+    #: more than this many later-submitted requests when admission
+    #: groups same-bucket prompts into one prefill dispatch (0 = strict
+    #: FIFO, co-batching only contiguous same-bucket runs)
+    reorder_window: int = 8
 
 
 class Engine:
@@ -198,7 +238,25 @@ class Engine:
             max_seq_len=self.config.max_seq_len,
             kv_heads=mc.kv_heads, head_dim=mc.head_dim,
             dtype=cache_dtype)
-        self.scheduler = Scheduler(self.config.num_slots)
+        self.scheduler = Scheduler(self.config.num_slots,
+                                   reorder_window=self.config.reorder_window)
+
+        # prefix KV reuse: block-granular radix store over prompt ids +
+        # a device-resident block pool the prefill program gathers from.
+        # A zero block size / budget degenerates to a scratch-only pool;
+        # the compiled prefill keeps the identical structure either way.
+        self._block_size = max(1, int(self.config.prefix_block_size) or 16)
+        budget = (self.config.prefix_cache_bytes
+                  if self.config.prefix_block_size else 0)
+        self.prefix = PrefixCache(
+            num_layers=len(model.model.layers),
+            block_size=self._block_size,
+            kv_heads=mc.kv_heads, head_dim=mc.head_dim,
+            dtype=cache_dtype, budget_bytes=budget)
+        # blocks needed to tile a full slot row (gather pads past the
+        # row end; the traced reshape slices back to max_seq_len)
+        self._max_blocks = -(-self.config.max_seq_len // self._block_size)
+        self._leases = {}            # request_id -> PrefixLease
 
         # host MIRRORS of the per-slot decode state.  The authoritative
         # copy lives on device between horizons (updated inside the
@@ -230,8 +288,11 @@ class Engine:
             donate_argnums=(1, 2, 3, 4, 11, 12) if donate else (),
             static_argnums=(13,), name="serving.decode")
         self._prefill = CompiledFn(self._prefill_fn,
-                                   donate_argnums=(4, 5) if donate else (),
+                                   donate_argnums=(9, 10) if donate else (),
                                    name="serving.prefill")
+        self._insert = CompiledFn(self._insert_fn,
+                                  donate_argnums=(5, 6) if donate else (),
+                                  name="serving.prefix_insert")
 
         # observability
         self._decode_steps = 0
@@ -241,7 +302,10 @@ class Engine:
         self._wasted_lane_tokens = 0
         self._horizon_buckets = set()
         self._grow = 1                   # adaptive-horizon growth state
-        self._prefill_calls = 0
+        self._prefill_calls = 0          # compiled prefill DISPATCHES
+        self._prefill_requests = 0       # requests prefilled (>= calls)
+        self._prefix_hit_tokens = 0
+        self._prompt_tokens = 0
         self._tokens_generated = 0
         self._busy_s = 0.0
         self._slot_busy_integral = 0.0   # sum over steps of used/num
@@ -290,30 +354,81 @@ class Engine:
                 logits = self.model._logits(h)
         return logits._data, new_views
 
-    def _prefill_fn(self, state_arrays, ids, length, slot, cache_k,
-                    cache_v, seed, temp, top_k, top_p):
-        """One request's prompt pass: ids [1, bucket] (right-padded),
-        fresh zero slot row, write k/v for every prompt position, sample
-        the first token from the last VALID position's logits, scatter
-        the row into the full cache at ``slot``."""
-        row_shape = (1, self.cache.max_seq_len, self.cache.kv_heads,
-                     self.cache.head_dim)
-        pos0 = jnp.zeros((1,), jnp.int32)
-        views = [SlotKV(jnp.zeros(row_shape, self.cache.dtype),
-                        jnp.zeros(row_shape, self.cache.dtype), pos0)
-                 for _ in range(self.cache.num_layers)]
+    def _prefill_fn(self, state_arrays, ids, lengths, prefix_lens, slots,
+                    valid, block_ids, pool_k, pool_v, cache_k, cache_v,
+                    seeds, temps, top_ks, top_ps):
+        """Batched fused prefill: one compiled dispatch prefills a whole
+        admission batch.
+
+        ids [L, bucket]      right-padded prompt SUFFIXES (the part not
+                             served by the prefix cache)
+        lengths [L]          suffix lengths (>= 1: an exact-hit prompt
+                             still prefills its final token)
+        prefix_lens [L]      cached-prefix lengths (0 on a miss)
+        slots [L]            UNIQUE target slot rows; padding lanes get
+                             spare slot ids so the scatter stays
+                             collision-free
+        valid [L]            real-request lanes; padding lanes
+                             identity-write their slot row
+        block_ids [L, MB]    prefix-pool blocks per lane (0 = scratch)
+
+        Each lane's initial row is gathered from the prefix pool —
+        cached-prefix copy is traced INTO this program, not a separate
+        dispatch — then the model writes the suffix k/v at
+        ``prefix_lens`` and the first token is sampled from the last
+        valid position's logits with ``request_key(seed, 0)``, exactly
+        as per-request prefill did."""
+        bs = self._block_size
+        max_seq = self.cache.max_seq_len
+        lanes = ids.shape[0]
+
+        def lane_rows(pool):
+            # [L, MB, bs, H, D] -> [L, MB*bs, H, D] -> slice to the row
+            g = pool[block_ids]
+            g = g.reshape(lanes, self._max_blocks * bs,
+                          self.cache.kv_heads, self.cache.head_dim)
+            return g[:, :max_seq]
+
+        views = [SlotKV(lane_rows(pk), lane_rows(pv), prefix_lens)
+                 for pk, pv in zip(pool_k, pool_v)]
         logits, new_views = self._run_model(state_arrays, ids, views)
-        last = jax.lax.dynamic_index_in_dim(logits[0], length - 1,
-                                            axis=0, keepdims=False)
-        first = sample_token(last, request_key(seed, 0), temp, top_k,
-                             top_p)
-        new_k = [jax.lax.dynamic_update_slice(
-                     ck, nv.k, (slot, 0, 0, 0))
-                 for ck, nv in zip(cache_k, new_views)]
-        new_v = [jax.lax.dynamic_update_slice(
-                     cv, nv.v, (slot, 0, 0, 0))
-                 for cv, nv in zip(cache_v, new_views)]
+        last = jax.vmap(
+            lambda lg, n: jax.lax.dynamic_index_in_dim(
+                lg, n - 1, axis=0, keepdims=False))(logits, lengths)
+        keys = jax.vmap(request_key)(seeds, jnp.zeros(lanes, jnp.int32))
+        first = jax.vmap(sample_token)(last, keys, temps, top_ks, top_ps)
+        mask = valid[:, None, None, None]
+
+        def scatter(cache, rows):
+            keep = cache[slots]          # identity content for padding
+            return cache.at[slots].set(jnp.where(mask, rows, keep))
+
+        new_k = [scatter(ck, nv.k) for ck, nv in zip(cache_k, new_views)]
+        new_v = [scatter(cv, nv.v) for cv, nv in zip(cache_v, new_views)]
         return first, new_k, new_v
+
+    def _insert_fn(self, cache_k, cache_v, src_slots, src_offsets,
+                   dst_ids, pool_k, pool_v):
+        """Copy freshly prefilled KV blocks into the prefix pool: for
+        each entry, the ``block_size`` tokens at block offset
+        ``src_offsets[i]`` of slot row ``src_slots[i]`` land in pool
+        block ``dst_ids[i]``.  Padding entries target scratch block 0.
+        One compiled dispatch covers a whole admission batch (entry
+        count is bucketed to a power of two)."""
+        bs = self._block_size
+
+        def copy(cache, pool):
+            rows = cache[src_slots]              # [T, max_seq, H, D]
+
+            def cut(row, off):
+                return jax.lax.dynamic_slice(
+                    row, (off * bs, 0, 0), (bs,) + row.shape[1:])
+
+            blocks = jax.vmap(cut)(rows, src_offsets)
+            return pool.at[dst_ids].set(blocks)
+
+        return ([copy(c, p) for c, p in zip(cache_k, pool_k)],
+                [copy(c, p) for c, p in zip(cache_v, pool_v)])
 
     def _decode_fn(self, state_arrays, tokens, pos, counts, active,
                    seeds, temps, top_ks, top_ps, eos_ids, limits,
@@ -357,6 +472,25 @@ class Engine:
         while b < prompt_len:
             b *= 2
         return min(b, self.config.max_seq_len)
+
+    def _lane_bucket(self, n):
+        """Static lane count for an n-request prefill batch: the next
+        power of two, capped at num_slots (so slot ids stay unique)."""
+        lanes = 1
+        while lanes < n:
+            lanes *= 2
+        return min(lanes, self.config.num_slots)
+
+    def _admission_bucket(self, req):
+        """The prefill length bucket a request would dispatch in right
+        now: its suffix past the cached prefix, padded to a power of
+        two, clamped so prefix + bucket fits the slot row.  Used both
+        for co-batch grouping (Scheduler.pop_batch) and for sizing the
+        actual dispatch."""
+        matched = self.prefix.lookup(req.prompt_ids)
+        bucket = min(self._bucket(req.prompt_len - matched),
+                     self.config.max_seq_len - matched)
+        return bucket
 
     @staticmethod
     def _pow2_floor(x):
@@ -404,40 +538,122 @@ class Engine:
     def admit(self):
         """Run admission + prefill for queued requests without decoding
         (step() calls this; exposed so latency-sensitive callers and
-        benchmarks can separate prefill from the decode window)."""
-        for req in self.scheduler.admissible(self.cache.free_slots):
+        benchmarks can separate prefill from the decode window).
+
+        Admission pops co-bucketed batches (same suffix bucket after
+        prefix matching, bounded reorder window) and prefills each batch
+        in ONE compiled dispatch — N same-bucket admissible requests
+        cost 1 prefill dispatch, not N."""
+        while self.cache.free_slots and self.scheduler.queue_depth:
+            batch = self.scheduler.pop_batch(self.cache.free_slots,
+                                             bucket_of=self._admission_bucket)
+            if not batch:
+                break
+            self._prefill_batch(batch)
+
+    _admit = admit      # pre-horizon internal name, kept for callers
+
+    def _prefill_batch(self, batch):
+        """One compiled prefill dispatch for a co-bucketed admission
+        batch: allocate slots, pin cached prefixes, gather + suffix-
+        prefill every lane, insert the new blocks into the prefix pool,
+        then harvest first tokens and arm the decode state."""
+        n = len(batch)
+        bucket = max(self._admission_bucket(r) for r in batch)
+        lanes = self._lane_bucket(n)
+        slots, leases = [], []
+        for req in batch:
             slot = self.cache.alloc()
+            slots.append(slot)
             self.scheduler.start(req, slot)
-            bucket = self._bucket(req.prompt_len)
+            lease = self.prefix.acquire(req.prompt_ids)
+            leases.append(lease)
+            self._leases[req.request_id] = lease
+            req.prefix_hit_tokens = lease.matched_tokens
             _obs_events.instant("serving.slot_alloc", cat="serving",
                                 slot=slot, request=req.request_id,
-                                prompt_len=req.prompt_len, bucket=bucket)
+                                prompt_len=req.prompt_len, bucket=bucket,
+                                prefix_hit=lease.matched_tokens)
             # async span: a request's life overlaps other requests on
             # this thread, so it pairs by id, not by B/E nesting
             _obs_events.record(
                 "serving.request", phase=_obs_events.ASYNC_BEGIN,
                 cat="serving", id=req.request_id,
-                args={"slot": slot, "prompt_len": req.prompt_len})
-            ids = np.zeros((1, bucket), np.int32)
-            ids[0, :req.prompt_len] = req.prompt_ids
-            with _obs_span("serving.prefill_pass", cat="serving",
-                           event_args={"request": req.request_id,
-                                       "bucket": bucket}):
-                first, new_k, new_v = self._prefill(
-                    self._state_arrays, jnp.asarray(ids),
-                    jnp.asarray(req.prompt_len, jnp.int32),
-                    jnp.asarray(slot, jnp.int32),
-                    self.cache.k, self.cache.v,
-                    jnp.asarray(req.sampling.seed, jnp.uint32),
-                    jnp.asarray(req.sampling.temperature, jnp.float32),
-                    jnp.asarray(req.sampling.top_k, jnp.int32),
-                    jnp.asarray(req.sampling.top_p, jnp.float32))
-            self.cache.rebind(new_k, new_v)
-            self._prefill_calls += 1
+                args={"slot": slot, "prompt_len": req.prompt_len,
+                      "prefix_hit_tokens": lease.matched_tokens})
+
+        # lane arrays: real requests first, then padding lanes carrying
+        # spare (unique, unprefilled) slot ids and identity writes
+        ids = np.zeros((lanes, bucket), np.int32)
+        lengths = np.ones(lanes, np.int32)
+        prefix_lens = np.zeros(lanes, np.int32)
+        block_ids = np.zeros((lanes, self._max_blocks), np.int32)
+        valid = np.zeros(lanes, bool)
+        seeds = np.zeros(lanes, np.uint32)
+        temps = np.zeros(lanes, np.float32)
+        top_ks = np.zeros(lanes, np.int32)
+        top_ps = np.ones(lanes, np.float32)
+        lane_slots = np.zeros(lanes, np.int32)
+        spare = iter(sorted(set(range(self.cache.num_slots)) - set(slots)))
+        for i in range(lanes):
+            if i < n:
+                req, lease = batch[i], leases[i]
+                suffix = req.prompt_ids[lease.matched_tokens:]
+                ids[i, :len(suffix)] = suffix
+                lengths[i] = len(suffix)
+                prefix_lens[i] = lease.matched_tokens
+                block_ids[i, :len(lease.block_ids)] = lease.block_ids
+                valid[i] = True
+                s = req.sampling
+                seeds[i] = np.uint32(s.seed)
+                temps[i] = s.temperature
+                top_ks[i] = s.top_k
+                top_ps[i] = s.top_p
+                lane_slots[i] = slots[i]
+            else:
+                lane_slots[i] = next(spare)
+
+        with _obs_span("serving.prefill_pass", cat="serving",
+                       engine=self._profiler_name,
+                       event_args={"batch_size": n, "lanes": lanes,
+                                   "bucket": bucket}):
+            first, new_k, new_v = self._prefill(
+                self._state_arrays, jnp.asarray(ids),
+                jnp.asarray(lengths), jnp.asarray(prefix_lens),
+                jnp.asarray(lane_slots), jnp.asarray(valid),
+                jnp.asarray(block_ids),
+                self.prefix.pool_k, self.prefix.pool_v,
+                self.cache.k, self.cache.v,
+                jnp.asarray(seeds), jnp.asarray(temps),
+                jnp.asarray(top_ks), jnp.asarray(top_ps))
+        self.cache.rebind(new_k, new_v)
+        self._prefill_calls += 1
+        self._prefill_requests += n
+        name = self._profiler_name
+        _SRV_PREFILL.inc(engine=name)
+        _SRV_PREFILL_REQS.inc(n, engine=name)
+        _SRV_PREFILL_BATCH.observe(n, engine=name)
+
+        # cache the new full blocks of every admitted prompt (reads the
+        # freshly written slot rows, BEFORE any later dispatch reuses
+        # them); one compiled copy covers the whole batch
+        copies = []
+        for req, lease, slot in zip(batch, leases, slots):
+            for off, dst in self.prefix.insert(req.prompt_ids, lease):
+                copies.append((slot, off, dst))
+        if copies:
+            self._dispatch_insert(copies)
+
+        first_np = np.asarray(first)     # the one prefill host sync
+        for i, (req, lease, slot) in enumerate(zip(batch, leases, slots)):
+            hit = lease.matched_tokens
+            self._prefix_hit_tokens += hit
+            self._prompt_tokens += req.prompt_len
+            if hit:
+                _SRV_PREFIX_HIT.inc(hit, engine=name)
             self._tokens_generated += 1
-            _SRV_PREFILL.inc(engine=self._profiler_name)
-            _SRV_TOKENS.inc(engine=self._profiler_name)
-            tok = int(np.asarray(first))
+            _SRV_TOKENS.inc(engine=name)
+            tok = int(first_np[i])
             if req.record_token(tok):
                 self._retire(req)
                 continue
@@ -457,11 +673,32 @@ class Engine:
             # write into device-resident state; retirement is detected
             # inside the scan, so it needs no re-upload
 
-    _admit = admit      # pre-horizon internal name, kept for callers
+    def _dispatch_insert(self, copies):
+        """Scatter new prefix blocks from slot rows into the pool: one
+        compiled dispatch per admission batch, entry count padded to a
+        power of two (padding targets scratch block 0)."""
+        t = 1
+        while t < len(copies):
+            t *= 2
+        src_slots = np.zeros(t, np.int32)
+        src_offsets = np.zeros(t, np.int32)
+        dst_ids = np.zeros(t, np.int32)
+        for i, (slot, off, dst) in enumerate(copies):
+            src_slots[i] = slot
+            src_offsets[i] = off
+            dst_ids[i] = dst
+        new_pk, new_pv = self._insert(
+            self.cache.k, self.cache.v, jnp.asarray(src_slots),
+            jnp.asarray(src_offsets), jnp.asarray(dst_ids),
+            self.prefix.pool_k, self.prefix.pool_v)
+        self.prefix.rebind(new_pk, new_pv)
 
     def _retire(self, req):
         self.cache.free(req.slot)
         self.scheduler.finish(req)
+        lease = self._leases.pop(req.request_id, None)
+        if lease is not None:
+            self.prefix.release(lease)   # blocks become evictable again
         self._finished += 1
         self._ttft_sum += req.ttft
         self._ttft_n += 1
@@ -605,6 +842,10 @@ class Engine:
         if self._busy_s > 0:
             _SRV_TPS.set(self._tokens_generated / self._busy_s,
                          engine=name)
+        if self._prompt_tokens:
+            _SRV_PREFIX_RATIO.set(
+                self._prefix_hit_tokens / self._prompt_tokens,
+                engine=name)
 
     def run(self):
         """Drain the queue: step until every submitted request finished.
@@ -664,10 +905,17 @@ class Engine:
             "decode_host_syncs": self._host_syncs,
             "wasted_lane_tokens": self._wasted_lane_tokens,
             "prefill_calls": self._prefill_calls,
+            "prefill_requests": self._prefill_requests,
+            "prefix_hit_tokens": self._prefix_hit_tokens,
+            "prompt_tokens": self._prompt_tokens,
+            "prefix_hit_ratio": (
+                self._prefix_hit_tokens / self._prompt_tokens
+                if self._prompt_tokens else 0.0),
             "decode_compiles": self._decode.misses,
             "decode_cache_hits": self._decode.hits,
             "prefill_compiles": self._prefill.misses,
             "prefill_cache_hits": self._prefill.hits,
+            "prefix_insert_calls": self._insert.calls,
         }
         if self._decode_steps:
             c["slot_utilization"] = (self._slot_busy_integral
@@ -679,13 +927,20 @@ class Engine:
         return c
 
     def stats(self):
-        """counters() plus horizon-decode derived stats: the distinct
-        compiled horizon buckets and the fraction of scanned lane steps
-        wasted on lanes that had already retired mid-horizon."""
+        """counters() plus derived stats: the distinct compiled horizon
+        buckets, the fraction of scanned lane steps wasted on lanes that
+        had already retired mid-horizon, prefix-cache internals, and
+        exact TTFT percentiles from the observability reservoir."""
         s = dict(self.counters())
         lane_steps = self._decode_harvested + self._wasted_lane_tokens
         s["wasted_lane_fraction"] = (
             self._wasted_lane_tokens / lane_steps if lane_steps else 0.0)
         s["horizon_buckets"] = sorted(self._horizon_buckets)
         s["next_horizon_growth"] = self._grow
+        s["prefix"] = self.prefix.stats()
+        if self._ttft_n:
+            s["ttft_p50_s"] = _SRV_TTFT.percentile(
+                50, engine=self._profiler_name)
+            s["ttft_p95_s"] = _SRV_TTFT.percentile(
+                95, engine=self._profiler_name)
         return s

@@ -1,0 +1,249 @@
+"""Shared-prefix KV reuse: a block-granular radix store over prompt
+token ids, mapping cached prefixes to device-resident KV blocks.
+
+The serving regime the ROADMAP targets — heavy traffic from millions of
+users — is dominated by prompts that share long prefixes (system
+prompts, few-shot preambles, chat history).  Recomputing the KV for a
+shared prefix on every admission wastes exactly the work this module
+caches: SGLang's RadixAttention (Zheng et al., 2023) keeps reusable KV
+in a radix tree over token ids, and vLLM (Kwon et al., 2023) stores KV
+in fixed-size blocks so reuse needs no reshapes.  This module combines
+both ideas TPU-native:
+
+* **Block pool** — per layer, ONE preallocated
+  ``[capacity + 1, block_size, kv_heads, head_dim]`` k/v buffer pair.
+  A cached prefix is a chain of block ids into that pool, so "copy the
+  cached prefix into a request's slot row" is a single gather the
+  engine traces INTO its batched prefill program (no extra dispatch).
+  Block 0 is a reserved scratch block: padding lanes gather/scatter it
+  freely, and nothing semantic ever reads it.
+* **Radix store** — a trie whose edges are full blocks of
+  ``block_size`` token ids (the hash-on-block-tokens formulation of a
+  radix tree: shared prefixes share nodes, block-granular splits).
+  Matching walks full blocks only and is capped at ``len(prompt) - 1``
+  tokens, so an exact-hit prompt still prefills at least its final
+  token (the logits source for its first sampled token).
+* **LRU eviction under a byte budget** — capacity is
+  ``budget_bytes // bytes_per_block``; when the free list runs dry the
+  least-recently-used *unpinned leaf* is evicted (leaves only, so every
+  cached chain stays reachable from the root).
+* **Refcounts** — ``acquire()`` pins the matched chain while a slot
+  borrows it; pinned nodes are never evicted.  ``insert()`` extends the
+  lease over newly cached blocks; ``release()`` unpins on retirement.
+
+Everything here is host-side bookkeeping over small python dicts; the
+only device state is the block pool, which the engine's compiled
+programs gather from (prefill) and scatter into (post-prefill insert).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class _Node:
+    """One full-block edge of the radix store."""
+
+    __slots__ = ("tokens", "block", "parent", "children", "refcount",
+                 "last_used")
+
+    def __init__(self, tokens, block, parent):
+        self.tokens = tokens          # tuple of block_size token ids
+        self.block = block            # pool block id (>= 1; 0 is scratch)
+        self.parent = parent
+        self.children = {}            # block-token tuple -> _Node
+        self.refcount = 0
+        self.last_used = 0
+
+
+class PrefixLease:
+    """A pinned match: the node chain a running request borrows.
+
+    ``block_ids`` are the pool blocks covering ``matched_tokens`` prompt
+    tokens (``matched_tokens == len(block_ids) * block_size``).  The
+    engine holds the lease for the request's whole slot residency and
+    releases it on retirement; ``insert()`` extends it over any blocks
+    newly cached from this request's prefill."""
+
+    __slots__ = ("nodes", "block_ids", "matched_tokens")
+
+    def __init__(self, nodes, block_size):
+        self.nodes = list(nodes)
+        self.block_ids = [n.block for n in self.nodes]
+        self.matched_tokens = len(self.nodes) * block_size
+
+
+class PrefixCache:
+    """Device-resident prefix-KV block pool + the radix store over it.
+
+    ``budget_bytes`` bounds pool HBM use; a budget smaller than one
+    block (or ``block_size=0`` upstream) degenerates to capacity 0 —
+    every lookup misses, and the engine's prefill program still traces
+    the same gather over the scratch-only pool, so enabling the cache
+    never changes compiled-program structure.
+    """
+
+    def __init__(self, num_layers, block_size, kv_heads, head_dim,
+                 dtype=jnp.float32, budget_bytes=0):
+        self.num_layers = num_layers
+        self.block_size = int(block_size)
+        self.kv_heads = kv_heads
+        self.head_dim = head_dim
+        self.dtype = dtype
+        itemsize = jnp.dtype(dtype).itemsize
+        self.bytes_per_block = (2 * num_layers * self.block_size
+                                * kv_heads * head_dim * itemsize)
+        self.capacity = max(0, int(budget_bytes) // self.bytes_per_block) \
+            if self.block_size else 0
+        shape = (self.capacity + 1, max(1, self.block_size), kv_heads,
+                 head_dim)
+        self.pool_k = [jnp.zeros(shape, dtype) for _ in range(num_layers)]
+        self.pool_v = [jnp.zeros(shape, dtype) for _ in range(num_layers)]
+        self._free = list(range(self.capacity, 0, -1))   # ids 1..capacity
+        self._root = _Node((), 0, None)
+        self._clock = 0
+        # counters (engine surfaces them through stats())
+        self.hit_tokens = 0
+        self.miss_tokens = 0
+        self.evictions = 0
+        self.inserted_blocks = 0
+
+    # ------------------------------------------------------------ match
+    def _walk(self, tokens, limit_tokens):
+        """The matched node chain for ``tokens``, full blocks only,
+        covering at most ``limit_tokens`` tokens."""
+        bs = self.block_size
+        chain = []
+        if not bs or self.capacity == 0:
+            return chain
+        node = self._root
+        max_blocks = limit_tokens // bs
+        for i in range(max_blocks):
+            key = tuple(tokens[i * bs:(i + 1) * bs])
+            child = node.children.get(key)
+            if child is None:
+                break
+            chain.append(child)
+            node = child
+        return chain
+
+    def lookup(self, tokens):
+        """Matched-prefix length in tokens, side-effect free (used for
+        admission bucketing; capped at ``len(tokens) - 1`` so a suffix
+        of at least one token always remains to prefill)."""
+        return len(self._walk(tokens, len(tokens) - 1)) * self.block_size
+
+    def acquire(self, tokens):
+        """Match + pin: refcount the matched chain and bump its LRU
+        clock.  Returns the lease the engine holds until retirement."""
+        chain = self._walk(tokens, len(tokens) - 1)
+        self._clock += 1
+        for n in chain:
+            n.refcount += 1
+            n.last_used = self._clock
+        lease = PrefixLease(chain, self.block_size)
+        self.hit_tokens += lease.matched_tokens
+        self.miss_tokens += len(tokens) - lease.matched_tokens
+        return lease
+
+    def release(self, lease):
+        """Unpin a lease (idempotent): the chain becomes evictable once
+        no other slot borrows it."""
+        for n in lease.nodes:
+            if n.refcount > 0:
+                n.refcount -= 1
+        lease.nodes = []
+
+    # ------------------------------------------------------------ insert
+    def insert(self, tokens, lease):
+        """Cache every full block of ``tokens`` not already stored.
+
+        Walks the trie creating missing nodes; each new node allocates a
+        pool block (evicting LRU unpinned leaves when the free list is
+        dry) and is pinned into ``lease``.  Returns
+        ``[(block_index, block_id), ...]`` for the NEW blocks — the
+        engine copies those ``block_size``-token windows of the
+        request's freshly prefilled slot row into the pool.  Stops at
+        the first block it cannot allocate (deeper blocks would be
+        unreachable anyway)."""
+        bs = self.block_size
+        if not bs or self.capacity == 0:
+            return []
+        self._clock += 1
+        node = self._root
+        new = []
+        for i in range(len(tokens) // bs):
+            key = tuple(tokens[i * bs:(i + 1) * bs])
+            child = node.children.get(key)
+            if child is None:
+                block = self._alloc_block()
+                if block is None:
+                    break
+                child = _Node(key, block, node)
+                node.children[key] = child
+                child.refcount += 1
+                lease.nodes.append(child)
+                lease.block_ids.append(block)
+                new.append((i, block))
+                self.inserted_blocks += 1
+            child.last_used = self._clock
+            node = child
+        return new
+
+    def _alloc_block(self):
+        if self._free:
+            return self._free.pop()
+        victim = self._lru_evictable()
+        if victim is None:
+            return None
+        self._evict(victim)
+        return self._free.pop()
+
+    def _lru_evictable(self):
+        """Oldest unpinned leaf, or None.  Leaves only: interior nodes
+        stay until their whole subtree ages out, keeping every cached
+        chain reachable from the root."""
+        best = None
+        stack = [self._root]
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children.values())
+            if node is self._root or node.children or node.refcount:
+                continue
+            if best is None or node.last_used < best.last_used:
+                best = node
+        return best
+
+    def _evict(self, node):
+        del node.parent.children[node.tokens]
+        self._free.append(node.block)
+        self.evictions += 1
+
+    # ------------------------------------------------------------ device
+    def rebind(self, new_k, new_v):
+        """Adopt updated pool buffers returned by a jitted program."""
+        self.pool_k = list(new_k)
+        self.pool_v = list(new_v)
+
+    # ------------------------------------------------------------ stats
+    def _count_nodes(self):
+        n, stack = 0, [self._root]
+        while stack:
+            node = stack.pop()
+            n += len(node.children)
+            stack.extend(node.children.values())
+        return n
+
+    def stats(self):
+        total = self.hit_tokens + self.miss_tokens
+        return {
+            "block_size": self.block_size,
+            "capacity_blocks": self.capacity,
+            "used_blocks": self.capacity - len(self._free),
+            "cached_nodes": self._count_nodes(),
+            "hit_tokens": self.hit_tokens,
+            "miss_tokens": self.miss_tokens,
+            "hit_ratio": (self.hit_tokens / total) if total else 0.0,
+            "evictions": self.evictions,
+            "inserted_blocks": self.inserted_blocks,
+        }

@@ -6,6 +6,14 @@ step).  A request holds its slot until it finishes — EOS or max-tokens —
 then the slot returns to the free list and the next queued request can
 claim it.  All of this is host-side bookkeeping over the static-shape
 device state; nothing here retraces anything.
+
+Admission is batch-aware: ``pop_batch()`` returns a group of queued
+requests that share one prefill bucket so the engine can prefill them
+all in ONE compiled dispatch.  Grouping may admit a later-submitted
+same-bucket request ahead of an earlier different-bucket one, but only
+inside a bounded **reorder window**: the queue head always anchors the
+batch (strict no-head-starvation), and no request is ever overtaken by
+more than ``reorder_window`` later-submitted requests in total.
 """
 
 from __future__ import annotations
@@ -35,8 +43,18 @@ class Request:
     slot: int | None = None
     output_ids: list = field(default_factory=list)
     finish_reason: str | None = None
+    #: wall time of submit() — the TTFT clock starts HERE, so queue wait
+    #: and prefill are both inside a request's time-to-first-token
     submit_time: float = field(default_factory=time.time)
+    #: wall time admission claimed a slot (prefill start)
+    admit_time: float | None = None
     first_token_time: float | None = None
+    #: tokens of this prompt served from the prefix cache (set by the
+    #: engine at admission; 0 when the cache is off or missed)
+    prefix_hit_tokens: int = 0
+    #: how many later-submitted requests were admitted ahead of this one
+    #: (bounded by the scheduler's reorder window)
+    bypassed: int = 0
 
     @property
     def prompt_len(self):
@@ -55,8 +73,17 @@ class Request:
         return self.sampling.max_new_tokens - self.n_generated
 
     @property
+    def queue_seconds(self):
+        """Seconds spent waiting for a slot (None until admitted)."""
+        if self.admit_time is None:
+            return None
+        return self.admit_time - self.submit_time
+
+    @property
     def ttft(self):
-        """Time-to-first-token in seconds (None until the first token)."""
+        """Time-to-first-token in seconds, measured submit -> first
+        sampled token, so it INCLUDES queue wait and prefill (None until
+        the first token)."""
         if self.first_token_time is None:
             return None
         return self.first_token_time - self.submit_time
@@ -78,10 +105,12 @@ class Request:
 
 
 class Scheduler:
-    """FIFO admission over a fixed slot pool."""
+    """FIFO admission over a fixed slot pool, with bounded-reorder
+    co-bucketed batching via :meth:`pop_batch`."""
 
-    def __init__(self, num_slots):
+    def __init__(self, num_slots, reorder_window=8):
         self.num_slots = num_slots
+        self.reorder_window = int(reorder_window)
         self.queue = deque()
         self.running = {}           # slot -> Request
         self._next_id = 0
@@ -94,16 +123,67 @@ class Scheduler:
         return req
 
     def admissible(self, free_slots):
-        """Pop up to free_slots queued requests (join happens at the next
-        decode-step boundary)."""
+        """Pop up to free_slots queued requests in strict FIFO order
+        (join happens at the next decode-step boundary)."""
         out = []
         while self.queue and len(out) < free_slots:
             out.append(self.queue.popleft())
         return out
 
+    def pop_batch(self, free_slots, bucket_of=None, window=None):
+        """Pop one co-bucketed admission batch of up to ``free_slots``
+        requests.
+
+        The queue head anchors the batch — it is ALWAYS admitted, so
+        FIFO heads never starve.  The scan then extends the batch with
+        later queued requests whose ``bucket_of(req)`` equals the
+        anchor's, subject to the reorder window ``window`` (default: the
+        scheduler's ``reorder_window``):
+
+        * a contiguous same-bucket run behind the head batches freely
+          (no reordering happens, so no window applies);
+        * once any request has been skipped, admitting a request from
+          behind it counts as an overtake; a request is never overtaken
+          more than ``window`` times in total, and no admission reaches
+          past the window once a skip exists.
+
+        With ``bucket_of=None`` or ``window<=0`` this degrades to strict
+        FIFO (``admissible``), batching only the contiguous same-bucket
+        prefix when ``bucket_of`` is given.
+        """
+        if free_slots <= 0 or not self.queue:
+            return []
+        if bucket_of is None:
+            return self.admissible(free_slots)
+        w = self.reorder_window if window is None else int(window)
+        q = list(self.queue)
+        anchor_bucket = bucket_of(q[0])
+        batch = [q[0]]
+        skipped = []
+        for idx in range(1, len(q)):
+            if len(batch) >= free_slots:
+                break
+            r = q[idx]
+            if skipped and idx >= max(w, 1):
+                break            # reordering beyond the window forbidden
+            if bucket_of(r) == anchor_bucket:
+                if any(s.bypassed >= w for s in skipped):
+                    break        # someone ahead is at their overtake cap
+                batch.append(r)
+                for s in skipped:
+                    s.bypassed += 1
+            else:
+                skipped.append(r)
+                if w <= 0 or r.bypassed >= w:
+                    break        # nobody may pass this request anymore
+        taken = {id(r) for r in batch}
+        self.queue = deque(r for r in q if id(r) not in taken)
+        return batch
+
     def start(self, req, slot):
         req.status = RUNNING
         req.slot = slot
+        req.admit_time = time.time()
         self.running[slot] = req
 
     def finish(self, req):

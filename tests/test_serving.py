@@ -12,7 +12,8 @@ from paddle_tpu.core import tape as _tape
 from paddle_tpu.models import GPTConfig, GPTForCausalLM
 from paddle_tpu.models.llama import LlamaForCausalLM
 from paddle_tpu.serving import (
-    Engine, EngineConfig, SamplingParams, SlotKV, SlottedKVCache,
+    Engine, EngineConfig, PrefixCache, SamplingParams, Scheduler,
+    SlotKV, SlottedKVCache,
 )
 from paddle_tpu.serving.kv_cache import visible_mask, write_slots
 
@@ -172,21 +173,24 @@ class TestEngine:
     def test_single_decode_compilation_heterogeneous_prompts(self):
         """The acceptance criterion: a multi-request run with
         heterogeneous prompt lengths compiles the fused decode program
-        exactly ONCE PER HORIZON BUCKET, and prefill once per length
-        bucket."""
+        exactly ONCE PER HORIZON BUCKET, and prefill once per
+        (lane-bucket, length-bucket) pair — with same-bucket requests
+        co-admitted into a single batched dispatch."""
         m = _model()
         eng = Engine(m, EngineConfig(num_slots=3, max_seq_len=48,
                                      min_prefill_bucket=4),
                      register_profiler=False)
-        # buckets: 3->4, 4->4, 6->8, 5->8, 9->16
+        # length buckets: 3->4, 4->4, 6->8, 5->8, 9->16
         for p in ([1, 2, 3], [1, 2, 3, 4], [5, 6, 7, 8, 9, 1],
                   [9, 8, 7, 6, 5], [1] * 9):
             eng.submit(p, SamplingParams(max_new_tokens=4))
         eng.run()
         s = eng.stats()
         assert s["decode_compiles"] == len(s["horizon_buckets"])
-        assert s["prefill_compiles"] == 3          # buckets {4, 8, 16}
-        assert s["prefill_calls"] == 5
+        # dispatch shapes: (2 lanes, 4), (1, 8) twice, (1, 16)
+        assert s["prefill_compiles"] == 3
+        assert s["prefill_calls"] == 4       # first two share ONE dispatch
+        assert s["prefill_requests"] == 5    # ...but all 5 were prefilled
         assert s["decode_cache_hits"] == \
             s["decode_horizons"] - s["decode_compiles"]
         assert s["tokens_generated"] == 5 * 4
@@ -511,3 +515,240 @@ class TestSamplingPrimitives:
             SamplingParams(max_new_tokens=0).validate()
         with pytest.raises(ValueError):
             SamplingParams(top_p=0.0).validate()
+
+
+class TestPrefixCacheUnit:
+    """Host-side radix-store bookkeeping: byte-budget capacity, LRU
+    eviction of unpinned leaves, refcount pinning while leased."""
+
+    @staticmethod
+    def _cache(blocks, bs=4):
+        # bytes_per_block = 2 (k+v) * 1 layer * bs * 1 head * 2 * 4B
+        c = PrefixCache(num_layers=1, block_size=bs, kv_heads=1,
+                        head_dim=2, budget_bytes=blocks * 2 * bs * 2 * 4)
+        assert c.capacity == blocks
+        return c
+
+    def test_insert_then_match_is_block_granular(self):
+        c = self._cache(4)
+        p = [7, 3, 9, 1, 4, 4, 2, 8, 5]           # 9 tokens -> 2 blocks
+        lease = c.acquire(p)
+        assert lease.matched_tokens == 0           # cold cache
+        assert [i for i, _ in c.insert(p, lease)] == [0, 1]
+        c.release(lease)
+        assert c.lookup(p + [1]) == 8              # both blocks reusable
+        assert c.lookup(p) == 8                    # cap: len-1 = 8 -> 2
+        assert c.lookup(p[:8]) == 4                # cap: len-1 = 7 -> 1
+        assert c.lookup([1] + p) == 0              # no shared prefix
+
+    def test_eviction_under_byte_budget(self):
+        c = self._cache(2)
+        a, b = [1] * 8, [2] * 8
+        la = c.acquire(a)
+        c.insert(a, la)
+        c.release(la)
+        lb = c.acquire(b)
+        c.insert(b, lb)
+        c.release(lb)
+        s = c.stats()
+        assert s["used_blocks"] <= s["capacity_blocks"] == 2
+        assert s["evictions"] == 2                 # A aged out, leaf first
+        assert c.lookup(a + [0]) == 0
+        assert c.lookup(b + [0]) == 8
+
+    def test_refcount_pins_leased_blocks(self):
+        c = self._cache(2)
+        a, b = [1] * 8, [2] * 8
+        la = c.acquire(a)
+        c.insert(a, la)                            # NOT released: pinned
+        lb = c.acquire(b)
+        assert c.insert(b, lb) == []               # nothing evictable
+        assert c.stats()["evictions"] == 0
+        assert c.lookup(a + [0]) == 8              # A untouched
+        c.release(la)
+        c.release(la)                              # idempotent unpin
+        lb2 = c.acquire(b)
+        assert len(c.insert(b, lb2)) == 2          # now A ages out
+        assert c.lookup(b + [0]) == 8
+        assert c.stats()["evictions"] == 2
+
+
+class TestPrefixReuse:
+    """The tentpole acceptance gates: cached-prefix + suffix-only
+    prefill is bitwise-equal to full uncached prefill and to sequential
+    generation; same-bucket admission is ONE compiled dispatch."""
+
+    SHARED = [7, 3, 9, 1, 4, 4, 2, 8]              # 2 blocks of 4
+
+    @staticmethod
+    def _cfg(**kw):
+        kw.setdefault("num_slots", 4)
+        kw.setdefault("max_seq_len", 48)
+        kw.setdefault("min_prefill_bucket", 4)
+        kw.setdefault("prefix_block_size", 4)
+        return EngineConfig(**kw)
+
+    @classmethod
+    def _sequential(cls, m, prompts, samp):
+        outs = []
+        for p, s in zip(prompts, samp):
+            e = Engine(m, cls._cfg(num_slots=1, prefix_block_size=0),
+                       register_profiler=False)
+            outs.append(e.generate(p, s))
+        return outs
+
+    def test_shared_prefix_parity_on_off_sequential(self):
+        """Warm-cache suffix prefill == cache-off prefill == one-at-a-
+        time generation, bitwise, with hit/miss lanes co-batched."""
+        m = _model()
+        prompts = [self.SHARED + [5, 6, 7],
+                   self.SHARED + [1, 2],
+                   [2, 2, 1],                      # unrelated: cold miss
+                   self.SHARED + [9, 9, 9, 9, 2]]
+        samp = [SamplingParams(max_new_tokens=5),
+                SamplingParams(temperature=0.8, top_k=20, seed=7,
+                               max_new_tokens=6),
+                SamplingParams(max_new_tokens=4),
+                SamplingParams(temperature=0.6, top_p=0.9, seed=3,
+                               max_new_tokens=5)]
+        seq = self._sequential(m, prompts, samp)
+        on = Engine(m, self._cfg(), register_profiler=False)
+        warm = on.submit(prompts[0], samp[0])
+        on.run()                                   # caches SHARED blocks
+        reqs = [on.submit(p, s) for p, s in zip(prompts[1:], samp[1:])]
+        on.run()
+        assert warm.output_ids == seq[0]
+        assert [r.output_ids for r in reqs] == seq[1:]
+        assert warm.prefix_hit_tokens == 0         # cold cache
+        assert reqs[0].prefix_hit_tokens == 8
+        assert reqs[1].prefix_hit_tokens == 0
+        assert reqs[2].prefix_hit_tokens == 8
+        s = on.stats()
+        assert s["prefix"]["hit_tokens"] >= 16
+        assert 0.0 < s["prefix_hit_ratio"] < 1.0
+
+        off = Engine(m, self._cfg(prefix_block_size=0),
+                     register_profiler=False)
+        offs = [off.submit(p, sp) for p, sp in zip(prompts, samp)]
+        off.run()
+        assert [r.output_ids for r in offs] == seq
+        assert off.stats()["prefix"]["capacity_blocks"] == 0
+
+    def test_exact_resubmit_and_mid_block_extension(self):
+        m = _model()
+        a = self.SHARED + [5, 6, 7, 1]             # 12 tokens: 3 blocks
+        b = self.SHARED + [5, 6, 9, 9, 3]          # diverges IN block 3
+        sp = SamplingParams(max_new_tokens=5)
+        seq = self._sequential(m, [a, b], [sp, sp])
+        eng = Engine(m, self._cfg(), register_profiler=False)
+        assert eng.generate(a, sp) == seq[0]       # warm: caches 3 blocks
+        again = eng.submit(a, sp)
+        eng.run()
+        assert again.output_ids == seq[0]          # exact-hit resubmit
+        assert again.prefix_hit_tokens == 8        # capped below len(a)
+        mid = eng.submit(b, sp)
+        eng.run()
+        assert mid.output_ids == seq[1]
+        assert mid.prefix_hit_tokens == 8          # match block-aligned
+
+    def test_same_bucket_batch_is_one_dispatch(self):
+        """The dispatch-count probe: N co-bucketed admissions prefill in
+        ONE compiled call (plus at most one block-insert scatter)."""
+        m = _model()
+        prompts = [[3, 1, 4, 1, 5], [9, 2, 6, 5, 3, 5],
+                   [8, 9, 7, 9, 1], [2, 3, 8, 4, 6, 2, 6]]  # buckets: 8
+        samp = [SamplingParams(max_new_tokens=4, seed=i,
+                               temperature=0.7 if i % 2 else 0.0)
+                for i in range(4)]
+        seq = self._sequential(m, prompts, samp)
+        eng = Engine(m, self._cfg(), register_profiler=False)
+        reqs = [eng.submit(p, s) for p, s in zip(prompts, samp)]
+        eng.run()
+        c = eng.counters()
+        assert c["prefill_calls"] == 1             # ONE prefill dispatch
+        assert c["prefill_requests"] == 4
+        assert c["prefix_insert_calls"] <= 1       # plus <= one scatter
+        assert eng.stats()["prefill_compiles"] == 1
+        assert [r.output_ids for r in reqs] == seq
+
+    def test_leases_released_on_retirement(self):
+        m = _model()
+        eng = Engine(m, self._cfg(num_slots=2), register_profiler=False)
+        for p in (self.SHARED + [1], self.SHARED + [2], [4, 4, 1]):
+            eng.submit(p, SamplingParams(max_new_tokens=3))
+        eng.run()
+        assert eng._leases == {}                   # every lease released
+        stack = [eng.prefix._root]
+        while stack:                               # ...and nothing pinned
+            n = stack.pop()
+            stack.extend(n.children.values())
+            assert n.refcount == 0
+
+
+class TestTTFT:
+    def test_ttft_includes_queue_and_prefill(self):
+        """TTFT clock starts at submit(): a request that waited for a
+        slot carries its queue time inside its TTFT."""
+        m = _model()
+        eng = Engine(m, EngineConfig(num_slots=1, max_seq_len=32),
+                     register_profiler=False)
+        first = eng.submit([1, 2, 3], SamplingParams(max_new_tokens=6))
+        queued = eng.submit([4, 5, 6], SamplingParams(max_new_tokens=4))
+        eng.run()
+        assert first.ttft is not None and queued.ttft is not None
+        assert queued.queue_seconds > 0            # waited for the slot
+        assert queued.ttft >= queued.queue_seconds
+        s = eng.stats()
+        assert s["ttft_p50_s"] >= 0.0
+        assert s["ttft_p95_s"] >= s["ttft_p50_s"]
+
+
+class TestPopBatch:
+    """Bounded-reorder co-bucketed admission: the head always anchors,
+    and no request is overtaken more than ``reorder_window`` times."""
+
+    @staticmethod
+    def _sched(window, lens):
+        s = Scheduler(4, reorder_window=window)
+        return s, [s.submit([0] * n, SamplingParams(max_new_tokens=2))
+                   for n in lens]
+
+    @staticmethod
+    def _bucket(r):
+        return r.prompt_len
+
+    def test_contiguous_same_bucket_batches_fully(self):
+        s, reqs = self._sched(2, [3, 3, 3, 3])
+        assert s.pop_batch(8, bucket_of=self._bucket) == reqs
+        assert s.queue_depth == 0
+
+    def test_head_always_anchors(self):
+        s, reqs = self._sched(2, [5, 3, 3, 3])
+        assert s.pop_batch(8, bucket_of=self._bucket)[0] is reqs[0]
+
+    def test_no_request_starved_past_window(self):
+        w = 3
+        s, reqs = self._sched(w, [3, 5, 3, 3, 3, 3, 3, 3])
+        odd = reqs[1]                              # the lone bucket-5
+        pops = []
+        while s.queue_depth:
+            pops.append(s.pop_batch(8, bucket_of=self._bucket))
+            assert all(r.bypassed <= w for r in reqs)
+        flat = [r for b in pops for r in b]
+        assert sorted(r.request_id for r in flat) == \
+            [r.request_id for r in reqs]           # nobody dropped
+        # overtaken at most w times => admitted by the second batch
+        k = next(i for i, b in enumerate(pops) if odd in b)
+        assert k <= 1 and odd.bypassed <= w
+
+    def test_window_zero_is_strict_fifo(self):
+        s, reqs = self._sched(0, [3, 5, 3])
+        assert s.pop_batch(8, bucket_of=self._bucket) == [reqs[0]]
+        assert s.pop_batch(8, bucket_of=self._bucket) == [reqs[1]]
+        assert s.pop_batch(8, bucket_of=self._bucket) == [reqs[2]]
+
+    def test_free_slot_cap_and_fifo_fallback(self):
+        s, reqs = self._sched(4, [3, 3, 3])
+        assert s.pop_batch(2, bucket_of=self._bucket) == reqs[:2]
+        assert s.pop_batch(0, bucket_of=self._bucket) == []
+        assert s.pop_batch(4) == [reqs[2]]         # bucket_of=None: FIFO
