@@ -13,7 +13,11 @@ Decode drivers measured:
   * engine horizon rows: serving.Engine at fixed horizon 1/4/8/16 — the
     continuous-batching engine's horizon-scanned decode (one dispatch +
     one host sync per H steps), reporting how much of the per-step
-    host overhead the horizon amortizes and the roofline % recovered.
+    host overhead the horizon amortizes and the roofline % recovered;
+  * paged-ablation rows: ragged paged attention vs full-width table
+    reads (tok/s, KV bytes/step, decode tokens per GB of KV traffic) —
+    see _bench_paged_ablation for the b8 scan-regression diagnosis
+    these rows ablate.
 
 A numerics gate runs first ON THE BENCH DEVICE: fused cached decode must
 match the fused prefill of the concatenated sequence (self-consistency)
@@ -247,6 +251,7 @@ def _bench_engine_horizons(backend, on_tpu, rng):
         # per-step/scan rows above), then fixed-horizon decode
         eng.submit(prompt, sp)
         eng.admit()
+        kv0 = eng.counters()["kv_bytes_read"]
         t0 = time.time()
         while eng.scheduler.has_work:
             eng.step(horizon=horizon)
@@ -255,6 +260,7 @@ def _bench_engine_horizons(backend, on_tpu, rng):
         device_s = eng.measure_decode_seconds(horizon)
         host_ms = max(0.0, per_step_ms - device_s * 1000.0 / horizon)
         c = eng.stats()
+        kv_bytes = c["kv_pool"]["kv_bytes_read"] - kv0
         eng.close()
         row = {
             "metric": f"engine decode tokens/s b1 horizon{horizon} "
@@ -266,6 +272,12 @@ def _bench_engine_horizons(backend, on_tpu, rng):
             "host_overhead_ms": round(host_ms, 3),
             "decode_horizons": c["decode_horizons"],
             "host_syncs": c["decode_host_syncs"],
+            # ragged paged attention: bytes of KV pool the decode scans
+            # actually gathered this window (table-width buckets x block
+            # bytes), and decode throughput per GB of KV traffic
+            "kv_bytes_read_per_step": int(kv_bytes // new_tokens),
+            "tokens_per_gb_kv_read": round(new_tokens
+                                           / (kv_bytes / 1e9), 1),
         }
         if roofline_ms is not None:
             row["weight_roofline_ms"] = round(roofline_ms, 3)
@@ -311,6 +323,7 @@ def _bench_engine(backend, on_tpu, rng):
     # warm the compile caches (one prefill bucket + the decode step)
     eng.generate(prompts[0], sp)
 
+    kv0 = eng.counters()["kv_bytes_read"]
     t0 = time.time()
     it = iter(prompts)
     for p in (next(it) for _ in range(8)):        # fill the slots
@@ -322,12 +335,14 @@ def _bench_engine(backend, on_tpu, rng):
             eng.submit(pending.pop(0), sp)        # join mid-stream
     dt = time.time() - t0
     c = eng.stats()
+    kv_bytes = c["kv_pool"]["kv_bytes_read"] - kv0
+    toks = c["tokens_generated"] - new_tokens
     eng.close()
     return {
         "metric": f"engine continuous-batching tokens/s b8 staggered "
                   f"(prefill {prompt_len} + {new_tokens} new x {n_req} "
                   f"reqs, {backend})",
-        "value": round((c["tokens_generated"] - new_tokens) / dt, 1),
+        "value": round(toks / dt, 1),
         "unit": "tokens/s",
         "ttft_avg_s": round(c["ttft_avg_s"], 4),
         "slot_utilization": round(c["slot_utilization"], 3),
@@ -336,6 +351,9 @@ def _bench_engine(backend, on_tpu, rng):
         "decode_horizons": c["decode_horizons"],
         "horizon_buckets": c["horizon_buckets"],
         "wasted_lane_fraction": round(c["wasted_lane_fraction"], 4),
+        "kv_bytes_read_per_step": int(kv_bytes
+                                      // max(1, c["decode_steps"])),
+        "tokens_per_gb_kv_read": round(toks / (kv_bytes / 1e9), 1),
     }
 
 
@@ -423,6 +441,110 @@ def _bench_prefix_prefill(backend, on_tpu, rng):
             "prefix_hit_ratio": round(hit / tot, 3) if tot else 0.0,
             "wall_s": round(dt, 4),
         })
+    return rows
+
+
+def _bench_paged_ablation(backend, on_tpu, rng):
+    """Ragged paged attention vs full-width table reads — the ablation
+    behind the b8 fused-scan regression (scan128 b8: 2662.5 tok/s /
+    3.005 ms/step vs 3156.1 / 2.535 per-step, 25.5% vs ~30% of the
+    weight roofline).
+
+    DIAGNOSIS of that regression: at b1 the scan wins 1.6x because it
+    removes per-step dispatch (~1 ms host gap).  At b8 the step is
+    device-bound (the async per-step driver already hides dispatch), so
+    the scan gains nothing — and loses 0.47 ms/step because the slotted
+    cache makes KV traffic scale with CAPACITY, not live tokens: every
+    step masked-reads 8 full max_seq=768 rows (2*12L*768*1536*2B =
+    56.6 MB/lane, 453 MB/step = 0.55 ms of bandwidth at 819 GB/s, vs
+    0.07 MB of live-token writes), and inside ``lax.scan`` the
+    dynamic-update-slice cache write forces the loop to materialize the
+    full carried buffers again instead of updating in place.  The paged
+    pool attacks exactly that scaling: decode writes touch one BLOCK
+    per lane and ragged attention reads only table-mapped blocks, so
+    per-step KV bytes track live length.
+
+    Rows: ragged (table width bucketed to the deepest live row) vs full
+    (``ragged_attention=False`` — width pinned to max_blocks_per_slot,
+    the slotted-bandwidth shape) at a short and a long prompt.  Ragged
+    should show (a) fewer KV bytes/step at short lengths — per-step
+    cost DROPPING with shorter sequences — and (b) more decode tokens
+    per GB of KV read; full-width reads the same bytes regardless.  On
+    CPU the bytes accounting is exact but timings mostly measure
+    dispatch overhead, so tokens_per_gb_kv_read is the load-bearing
+    column there."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.serving import Engine, EngineConfig, SamplingParams
+
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=32000, hidden_size=1536,
+                        intermediate_size=4096, num_hidden_layers=12,
+                        num_attention_heads=12,
+                        max_position_embeddings=1024)
+        max_seq, new_tokens, dtype = 768, 64, jnp.bfloat16
+        prompt_lens = (32, 512)
+    else:
+        cfg = GPTConfig(vocab_size=1024, hidden_size=256,
+                        intermediate_size=512, num_hidden_layers=2,
+                        num_attention_heads=4,
+                        max_position_embeddings=128)
+        max_seq, new_tokens, dtype = 64, 16, jnp.float32
+        prompt_lens = (8, 40)
+
+    itemsize = jnp.dtype(dtype).itemsize
+    dim, ffn, vocab = (cfg.hidden_size, cfg.intermediate_size,
+                       cfg.vocab_size)
+    layer_w = (4 * dim * dim + 3 * dim * ffn) * cfg.num_hidden_layers
+    weight_bytes = (layer_w + dim * vocab) * itemsize
+    roofline_ms = (weight_bytes / 819e9 * 1e3) if on_tpu else None
+
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    sp = SamplingParams(max_new_tokens=new_tokens)
+    rows = []
+    for ragged in (True, False):
+        for plen in prompt_lens:
+            prompt = rng.randint(0, cfg.vocab_size, plen).tolist()
+            eng = Engine(model, EngineConfig(
+                num_slots=1, max_seq_len=max_seq, max_horizon=8,
+                cache_dtype=dtype, ragged_attention=ragged),
+                register_profiler=False)
+            eng.submit(prompt, sp)                # warm the compiles
+            while eng.scheduler.has_work:
+                eng.step(horizon=8)
+            eng.submit(prompt, sp)
+            eng.admit()
+            kv0 = eng.counters()["kv_bytes_read"]
+            t0 = time.time()
+            while eng.scheduler.has_work:
+                eng.step(horizon=8)
+            dt = time.time() - t0
+            c = eng.stats()
+            kv_bytes = c["kv_pool"]["kv_bytes_read"] - kv0
+            eng.close()
+            per_step_ms = dt * 1000.0 / new_tokens
+            mode = "ragged" if ragged else "full-width"
+            row = {
+                "metric": f"engine paged-decode [{mode}] b1 prefill "
+                          f"{plen} + {new_tokens} new ({backend})",
+                "value": round(new_tokens / dt, 1),
+                "unit": "tokens/s",
+                "per_step_ms": round(per_step_ms, 3),
+                "table_width_buckets": sorted(
+                    {nb for _, nb in c["decode_buckets"]}),
+                "kv_bytes_read_per_step": int(kv_bytes // new_tokens),
+                "tokens_per_gb_kv_read": round(new_tokens
+                                               / (kv_bytes / 1e9), 1),
+            }
+            if roofline_ms is not None:
+                row["weight_roofline_ms"] = round(roofline_ms, 3)
+                row["roofline_pct"] = round(
+                    100.0 * roofline_ms / per_step_ms, 1)
+            rows.append(row)
     return rows
 
 
@@ -537,6 +659,7 @@ def main():
 
     results.extend(_bench_engine_horizons(backend, on_tpu, rng))
     results.append(_bench_engine(backend, on_tpu, rng))
+    results.extend(_bench_paged_ablation(backend, on_tpu, rng))
     results.extend(_bench_prefix_prefill(backend, on_tpu, rng))
 
     for r in results:

@@ -106,6 +106,11 @@ class GPTAttention(Layer):
         import numpy as np
 
         if cache is not None and not isinstance(cache, (tuple, list)):
+            if hasattr(cache, "tables"):
+                # serving paged path: PagedKV — scatter this chunk's k/v
+                # into table-mapped pool blocks, ragged paged attention
+                # reads only live blocks (paddle_tpu.serving).
+                return self._forward_paged(q, k, v, cache, b, s)
             # serving path: SlotKV slotted static-shape cache — per-row
             # positions, dynamic_update_slice writes, full-length masked
             # attention. One compiled decode step serves every request
@@ -158,6 +163,30 @@ class GPTAttention(Layer):
             is_causal=False, training=self.training)
         out = self.o_proj(M.reshape(out, [b, s, self.num_heads * self.head_dim]))
         return out, SlotKV(k_all, v_all, pos + s)
+
+    def _forward_paged(self, q, k, v, cache, b, s):
+        """Paged-cache attention: rope at the per-row positions, scatter
+        k/v into the lane's table-mapped pool blocks (write-before-attend
+        so the current token's keys are visible to itself), then ragged
+        paged attention over the block table — only blocks below each
+        lane's length are read. Bitwise-compatible with the slotted path:
+        same rope/attention math over the same visible keys."""
+        import jax.numpy as jnp
+
+        from ..serving.kv_cache import PagedKV, paged_write
+        from ..serving.paged_attention import paged_attention
+
+        pos = cache.pos
+        pos_ids = Tensor(pos[:, None]
+                         + jnp.arange(s, dtype=pos.dtype)[None, :])
+        q = apply_rotary_emb(q, position_ids=pos_ids, base=self.rope_theta)
+        k = apply_rotary_emb(k, position_ids=pos_ids, base=self.rope_theta)
+        k_pool = paged_write(cache.k, k._data, cache.tables, pos)
+        v_pool = paged_write(cache.v, v._data, cache.tables, pos)
+        out = paged_attention(q._data, k_pool, v_pool, cache.tables, pos)
+        out = self.o_proj(M.reshape(Tensor(out),
+                                    [b, s, self.num_heads * self.head_dim]))
+        return out, PagedKV(k_pool, v_pool, cache.tables, pos + s)
 
 
 class GPTMLP(Layer):

@@ -6,9 +6,10 @@ LLaMA naming plus the standard config presets so users of the reference's
 ecosystem (PaddleNLP `LlamaForCausalLM`) find the same surface here.
 
 Because the attention layer is shared, LlamaAttention accepts the serving
-subsystem's slotted static-shape KV cache (paddle_tpu.serving.SlotKV)
-anywhere the legacy `(k, v)` concat cache is accepted — a
-LlamaForCausalLM drops straight into paddle_tpu.serving.Engine:
+subsystem's cache views (the paged-pool `PagedKV` block-table view and
+the slotted static-shape `SlotKV`) anywhere the legacy `(k, v)` concat
+cache is accepted — a LlamaForCausalLM drops straight into
+paddle_tpu.serving.Engine:
 
     from paddle_tpu.serving import Engine, EngineConfig
     engine = Engine(LlamaForCausalLM(LLAMA2_7B), EngineConfig(...))

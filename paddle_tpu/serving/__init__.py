@@ -4,33 +4,47 @@ The serving subsystem the reference ships as AnalysisPredictor + the
 fused CUDA decode ops (fused_multi_transformer), rebuilt TPU-native
 around three ideas the benches point at (DECODE_BENCH.json):
 
-* a **slotted static-shape KV cache** (kv_cache.py) — one compiled
-  decode step for every step of every request mix, zero retracing;
+* a **unified paged KV pool** (kv_cache.py) — all KV in ONE per-layer
+  ``[num_blocks, block_size, kv_heads, head_dim]`` pool (vLLM-style
+  fixed blocks) addressed through per-slot block tables; table entries
+  are allocated lazily, so HBM scales with live tokens, and every
+  block is host-refcounted (table entries and the prefix store each
+  hold a reference).  The slotted static-shape cache
+  (:class:`SlottedKVCache`) remains as the simpler reference design;
+* **ragged paged-attention decode** (paged_attention.py) — decode
+  attention reads ONLY each lane's table-mapped blocks (Pallas kernel
+  on TPU, an XLA online-softmax fallback on CPU whose exact-zero
+  masking makes outputs bitwise-invariant to the static table width),
+  so per-step KV bandwidth tracks live sequence length, not
+  ``max_seq_len``;
 * a **prefill/decode split** with power-of-two prefill buckets — one
   compiled prefill per (lane-bucket, length-bucket) pair (engine.py);
 * **batched fused prefill** — admission groups same-bucket queued
   requests (``Scheduler.pop_batch``, bounded reorder window so FIFO
   order is never violated by more than ``reorder_window`` overtakes)
   and prefills the whole group in ONE compiled dispatch;
-* a **prefix KV cache** (prefix_cache.py) — a block-granular radix
-  store over prompt token ids (RadixAttention-style reuse over
-  vLLM-style fixed-size blocks) backed by a device-resident block
-  pool: a prompt extending a cached prefix gathers the cached KV into
-  its slot row inside the prefill program and prefills only the
-  suffix, bitwise-equal to full recomputation; blocks are refcounted
-  while borrowed and LRU-evicted under ``prefix_cache_bytes``;
-* **continuous batching** — FIFO admission into a fixed slot pool,
-  requests join at horizon boundaries and free slots on EOS or
-  max-tokens (scheduler.py), with greedy/temperature/top-k/top-p
-  sampling under per-request seeded PRNG (sampling.py);
+* a **copy-free prefix KV cache** (prefix_cache.py) — a block-granular
+  radix store over prompt token ids (RadixAttention-style reuse over
+  vLLM-style fixed blocks) holding refcounted blocks of the unified
+  pool: a hit leases cached blocks straight into the slot's block
+  table (zero copies; a partial tail match is copy-on-write), caching
+  new content is ``adopt()`` refcounting, and unpinned blocks are
+  LRU-evicted under ``prefix_cache_bytes``;
+* **continuous batching + preemption** — FIFO admission into a fixed
+  slot pool, requests join at horizon boundaries and release their
+  blocks on EOS or max-tokens (scheduler.py), with greedy/temperature/
+  top-k/top-p sampling under per-request seeded PRNG (sampling.py);
+  under block pressure the engine preempts the youngest lane
+  (``Engine.preempt``: blocks released, request requeued at the front,
+  re-admission reproduces its stream bitwise);
 * **horizon-scanned fused decode** — ``Engine.step(horizon=H)`` runs H
   decode steps as one compiled ``lax.scan`` over device-resident engine
-  state: one dispatch and one host sync per horizon instead of per
-  token, with per-slot EOS/max-token masking inside the scan.  An
-  adaptive policy shrinks the horizon to 1 while requests are queued
-  and grows it toward ``EngineConfig.max_horizon`` when the slot mix is
-  stable.  ``fold_in(seed, n_generated)`` PRNG keeps every horizon
-  bitwise-equal to per-step decode.
+  state with the pool as donated carry: one dispatch and one host sync
+  per horizon instead of per token, with per-slot EOS/max-token masking
+  inside the scan.  An adaptive policy shrinks the horizon to 1 while
+  requests are queued and grows it toward ``EngineConfig.max_horizon``
+  when the slot mix is stable.  ``fold_in(seed, n_generated)`` PRNG
+  keeps every horizon bitwise-equal to per-step decode.
 
 Quick start::
 
@@ -50,13 +64,16 @@ hits) are exposed through ``paddle_tpu.profiler.counters()``.
 """
 
 from .engine import CompiledFn, Engine, EngineConfig
-from .kv_cache import SlotKV, SlottedKVCache
+from .kv_cache import (PagedKV, PagedKVCache, PagedKVPool, SlotKV,
+                       SlottedKVCache)
+from .paged_attention import paged_attention
 from .prefix_cache import PrefixCache, PrefixLease
 from .sampling import SamplingParams
 from .scheduler import Request, Scheduler
 
 __all__ = [
     "Engine", "EngineConfig", "CompiledFn",
+    "PagedKV", "PagedKVCache", "PagedKVPool", "paged_attention",
     "SlotKV", "SlottedKVCache",
     "PrefixCache", "PrefixLease",
     "SamplingParams", "Request", "Scheduler",

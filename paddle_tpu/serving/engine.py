@@ -1,61 +1,76 @@
 """The continuous-batching LLM inference engine.
 
-Architecture (prefill/decode split over a slotted static-shape cache):
+Architecture (prefill/decode split over ONE paged KV block pool):
 
+* **Unified paged KV pool** — all KV lives in a single per-layer
+  ``[num_blocks, block_size, kv_heads, head_dim]`` pool
+  (``kv_cache.PagedKVCache``): each slot addresses it through a
+  host-authoritative ``[num_slots, max_blocks_per_slot]`` block table
+  whose live prefix is uploaded before a dispatch only when dirty.
+  Blocks are refcounted — a table entry and a prefix-store node each
+  hold one reference — so prefix sharing is copy-free and preemption is
+  bookkeeping.  Table entries are allocated lazily (admission covers
+  the prompt, ``_ensure_blocks`` extends coverage per horizon), so HBM
+  scales with LIVE tokens, not ``num_slots * max_seq_len``.
 * **Batched fused prefill** — admission groups queued requests that
   share a prefill bucket (``Scheduler.pop_batch``, bounded reorder
   window) and prefills the whole group in ONE ``[lanes, bucket]``
-  compiled dispatch: each lane writes its prompt's k/v into its slot
-  row and samples its first token.  Suffixes are right-padded to
-  power-of-two length buckets and the lane count is bucketed the same
-  way, so there is exactly one compiled prefill program per
-  (lane-bucket, length-bucket) pair, reused by every admission batch
-  that falls in it (heterogeneous prompt lengths and batch sizes stop
-  being retrace sources).  Padding lanes carry a ``valid=False`` flag
-  and spare slot ids: they identity-write their rows, so one program
-  serves every real batch size in the lane bucket.
-* **Prefix KV reuse** — a block-granular radix store over prompt token
-  ids (``prefix_cache.py``; RadixAttention's reuse structure over
-  vLLM-style fixed-size blocks) maps cached prefixes to a device-
-  resident block pool.  A request whose prompt extends a cached prefix
-  gathers the cached blocks into its slot row INSIDE the prefill
-  program (``pool[block_ids]`` is traced, not dispatched) and prefills
-  only the suffix; after prefill, the new full blocks of its prompt are
-  scattered back into the pool with one compiled copy per admission
-  batch.  Blocks are refcounted while a slot borrows them and evicted
-  LRU under a byte budget.
-* **Horizon-scanned decode** — ONE compiled program advances ALL slot
-  rows by ``H`` fused steps: a ``lax.scan`` whose body embeds the last
-  token of every slot, runs the model with per-row positions against
-  the full ``[num_slots, max_seq_len, kv_heads, head_dim]`` buffers
-  (written via ``dynamic_update_slice``), samples per-request tokens
-  under per-request ``fold_in(seed, n_generated)`` PRNG, and masks
-  retired lanes (EOS / max-tokens detected INSIDE the scan: their
-  ``pos``/``counts`` freeze and their sampled tokens harvest as ``-1``).
-  Tokens for all ``H`` steps come back in one ``[H, num_slots]`` array —
-  one dispatch and one host sync per horizon, instead of one of each per
-  token (DECODE_BENCH.json: the per-step driver pays ~1 ms/step of pure
-  host dispatch + sync against a 0.77 ms weight roofline).
+  compiled dispatch: each lane scatters its suffix k/v through its
+  block-table row and samples its first token.  Suffixes are
+  right-padded to power-of-two length buckets and the lane count is
+  bucketed the same way — one compiled prefill program per
+  (lane-bucket, length-bucket) pair.  Padding lanes carry an all-zero
+  table row, so their writes land in the reserved scratch block 0 and
+  no validity masking or spare-slot machinery is needed.
+* **Copy-free prefix reuse** — the radix store (``prefix_cache.py``,
+  unified-pool mode) holds refcounted blocks of the SAME pool.  A hit
+  leases the matched blocks straight into the slot's table
+  (``lease_block``: one ``pool.share`` per entry, zero copies); a
+  partial tail match is served copy-on-write — the prefill program
+  copies that ONE block into the slot's private tail block, then
+  overwrites from the divergence offset on.  After prefill, ``adopt()``
+  takes shared references on the slot's freshly written private blocks
+  — caching new content is host-side refcounting, no gather/scatter
+  dispatches at all.
+* **Horizon-scanned ragged decode** — ONE compiled program advances ALL
+  slots by ``H`` fused steps: a ``lax.scan`` carrying the donated pool,
+  whose body embeds the last token of every slot, scatter-writes k/v
+  through the (loop-invariant) block tables, runs paged attention over
+  ONLY the ``nb`` table-mapped blocks per lane
+  (``paged_attention.py``: Pallas kernel on TPU, the nb-invariant XLA
+  online-softmax fallback on CPU), samples per-request tokens under
+  ``fold_in(seed, n_generated)`` PRNG, and masks retired lanes (EOS /
+  max-tokens detected INSIDE the scan: their ``pos``/``counts`` freeze
+  and their sampled tokens harvest as ``-1``).  ``nb`` is bucketed to a
+  power of two of the deepest live row, so per-step KV traffic tracks
+  live sequence length instead of ``max_seq_len`` and the program
+  compiles once per ``(horizon, nb)`` bucket (``stats()``:
+  ``decode_buckets``); the fallback's exact-zero masking makes outputs
+  bitwise-invariant to ``nb``, so re-bucketing as sequences grow never
+  perturbs a token.
 * **Device-resident engine state** — the per-slot decode state
   (``tokens/pos/counts/active`` plus the loop-invariant
   ``seeds/temps/top_ks/top_ps/eos_ids/limits``) lives on device and is
   updated inside the compiled program; the host re-uploads it only when
   admission changes it (dirty flag), never per step.  Host mirrors are
   maintained from the harvested tokens alone — no extra device reads.
-* **Continuous batching** — requests join at horizon boundaries and
-  free their slot on EOS/max-tokens; an adaptive policy shrinks the
-  horizon toward 1 when the queue is non-empty or a lane is close to
-  its token budget (so admission latency and EOS-mask waste stay
-  bounded) and grows it toward ``max_horizon`` while the batch is
-  stable.  Horizons are power-of-two buckets, so the decode program
-  compiles exactly once per distinct bucket.
+* **Continuous batching + preemption** — requests join at horizon
+  boundaries and release their blocks on EOS/max-tokens; an adaptive
+  policy shrinks the horizon toward 1 when the queue is non-empty or a
+  lane is near its token budget, and grows it toward ``max_horizon``
+  while the batch is stable.  Under block pressure the engine first
+  reclaims unpinned prefix blocks, then **preempts** the youngest
+  running request (``preempt()``: release blocks + requeue at the
+  front; re-admission re-prefills prompt + generated-so-far and the
+  fold_in PRNG reproduces its next token bitwise, so swapping an idle
+  sequence out and back is invisible in its output).
 
 Every horizon partition of a request's token stream is bitwise-equal:
 the scan body is the same jaxpr as a standalone single step, and a
 request's k-th token depends only on (its seed, k, its logits).
 
 The engine reuses the model's own Layer code (functionalized through
-``use_state``, the TrainStep pattern), so slotted decode is numerically
+``use_state``, the TrainStep pattern), so paged decode is numerically
 the decode path models/gpt.py already ships — just with a cache the
 compiler can keep static.
 """
@@ -75,7 +90,7 @@ from ..core.tensor import Tensor
 from ..observability import events as _obs_events
 from ..observability import metrics as _obs_metrics
 from ..observability.span import span as _obs_span
-from .kv_cache import SlotKV, SlottedKVCache
+from .kv_cache import PagedKV, PagedKVCache
 from .prefix_cache import PrefixCache
 from .sampling import SamplingParams, request_key, sample_batch, sample_token
 from .scheduler import Scheduler
@@ -121,6 +136,15 @@ _SRV_STEP = _obs_metrics.histogram(
 _SRV_HORIZON = _obs_metrics.histogram(
     "serving.horizon", "fused decode steps per compiled horizon dispatch",
     buckets=(1, 2, 4, 8, 16, 32, 64, 128))
+_SRV_KV_BLOCKS = _obs_metrics.gauge(
+    "serving.kv_blocks_in_use",
+    "unified-pool KV blocks currently referenced (tables + prefix store)")
+_SRV_KV_BYTES = _obs_metrics.counter(
+    "serving.kv_bytes_read",
+    "KV bytes gathered by decode attention (table-mapped blocks only)")
+_SRV_PREEMPTIONS = _obs_metrics.counter(
+    "serving.preemptions",
+    "running requests swapped out under KV block pressure")
 # compile/cache families SHARED with jit/api.py: one place answers
 # "which function retraced" for both to_static and serving programs
 _COMPILE_COUNT = _obs_metrics.counter(
@@ -213,6 +237,18 @@ class EngineConfig:
     #: groups same-bucket prompts into one prefill dispatch (0 = strict
     #: FIFO, co-batching only contiguous same-bucket runs)
     reorder_window: int = 8
+    #: total blocks in the unified paged KV pool (incl. the reserved
+    #: scratch block 0).  0 = auto: every slot can grow to a full row
+    #: plus prefix-cache headroom — no request can ever starve.  A
+    #: smaller explicit value oversubscribes HBM: admission defers and
+    #: decode preempts the youngest lane when the pool runs dry.
+    kv_pool_blocks: int = 0
+    #: ragged decode attention: bucket the decode program's block-table
+    #: width to a power of two of the deepest live row, so per-step KV
+    #: reads track live sequence length.  False pins the width to
+    #: max_blocks_per_slot — the slotted-bandwidth ablation knob
+    #: (benchmarks/bench_decode.py measures both).
+    ragged_attention: bool = True
 
 
 class Engine:
@@ -232,30 +268,40 @@ class Engine:
         self._state_arrays = [sd[n]._data for n in self._state_names]
         cache_dtype = (self.config.cache_dtype
                        or model.model.embed_tokens.weight._data.dtype)
-        self.cache = SlottedKVCache(
-            num_layers=len(model.model.layers),
-            num_slots=self.config.num_slots,
-            max_seq_len=self.config.max_seq_len,
-            kv_heads=mc.kv_heads, head_dim=mc.head_dim,
-            dtype=cache_dtype)
-        self.scheduler = Scheduler(self.config.num_slots,
-                                   reorder_window=self.config.reorder_window)
-
-        # prefix KV reuse: block-granular radix store over prompt ids +
-        # a device-resident block pool the prefill program gathers from.
-        # A zero block size / budget degenerates to a scratch-only pool;
-        # the compiled prefill keeps the identical structure either way.
+        # ONE paged block pool backs every slot's table AND the prefix
+        # store; the pool block size doubles as the prefix block size.
+        # With kv_pool_blocks=0 the pool is sized so no request can
+        # starve (full row per slot) plus prefix-budget headroom.
         self._block_size = max(1, int(self.config.prefix_block_size) or 16)
         budget = (self.config.prefix_cache_bytes
                   if self.config.prefix_block_size else 0)
+        bytes_per_block = (2 * len(model.model.layers) * self._block_size
+                           * mc.kv_heads * mc.head_dim
+                           * jnp.dtype(cache_dtype).itemsize)
+        prefix_capacity = int(budget) // bytes_per_block
+        self.cache = PagedKVCache(
+            num_layers=len(model.model.layers),
+            num_slots=self.config.num_slots,
+            max_seq_len=self.config.max_seq_len,
+            block_size=self._block_size,
+            kv_heads=mc.kv_heads, head_dim=mc.head_dim,
+            dtype=cache_dtype,
+            num_blocks=int(self.config.kv_pool_blocks),
+            extra_blocks=prefix_capacity)
+        self.pool = self.cache.pool
+        self.scheduler = Scheduler(self.config.num_slots,
+                                   reorder_window=self.config.reorder_window)
+
+        # prefix KV reuse in unified-pool mode: the radix store holds
+        # refcounted blocks of self.pool — hits lease blocks straight
+        # into slot tables, caching is adopt() refcounting, and the
+        # byte budget bounds how many pool blocks the store may pin.
         self.prefix = PrefixCache(
             num_layers=len(model.model.layers),
             block_size=self._block_size,
             kv_heads=mc.kv_heads, head_dim=mc.head_dim,
-            dtype=cache_dtype, budget_bytes=budget)
-        # blocks needed to tile a full slot row (gather pads past the
-        # row end; the traced reshape slices back to max_seq_len)
-        self._max_blocks = -(-self.config.max_seq_len // self._block_size)
+            dtype=cache_dtype, budget_bytes=budget, pool=self.pool)
+        self._max_blocks = self.cache.max_blocks_per_slot
         self._leases = {}            # request_id -> PrefixLease
 
         # host MIRRORS of the per-slot decode state.  The authoritative
@@ -279,20 +325,21 @@ class Engine:
         self._d_tokens = self._d_pos = self._d_counts = None
         self._d_active = None
         self._d_params = None
+        # device copy of the live block-table prefix ([num_slots, nb]);
+        # re-uploaded when the host tables dirty or nb re-buckets
+        self._d_tables = None
+        self._d_tables_nb = -1
 
-        # donation buys in-place HBM cache updates on accelerators; CPU
+        # donation buys in-place HBM pool updates on accelerators; CPU
         # would only warn that donation is unimplemented
         donate = jax.default_backend() not in ("cpu",)
         self._decode = CompiledFn(
             self._decode_fn,
-            donate_argnums=(1, 2, 3, 4, 11, 12) if donate else (),
-            static_argnums=(13,), name="serving.decode")
+            donate_argnums=(1, 2, 3, 4, 12, 13) if donate else (),
+            static_argnums=(14,), name="serving.decode")
         self._prefill = CompiledFn(self._prefill_fn,
-                                   donate_argnums=(9, 10) if donate else (),
+                                   donate_argnums=(8, 9) if donate else (),
                                    name="serving.prefill")
-        self._insert = CompiledFn(self._insert_fn,
-                                  donate_argnums=(5, 6) if donate else (),
-                                  name="serving.prefix_insert")
 
         # observability
         self._decode_steps = 0
@@ -302,6 +349,10 @@ class Engine:
         self._wasted_lane_tokens = 0
         self._horizon_buckets = set()
         self._grow = 1                   # adaptive-horizon growth state
+        self._decode_buckets = set()     # compiled (horizon, nb) pairs
+        self._kv_bytes_read = 0
+        self._cow_copies = 0
+        self._preemptions = 0
         self._prefill_calls = 0          # compiled prefill DISPATCHES
         self._prefill_requests = 0       # requests prefilled (>= calls)
         self._prefix_hit_tokens = 0
@@ -345,7 +396,7 @@ class Engine:
 
     # ------------------------------------------------------------ pure fns
     def _run_model(self, state_arrays, ids, views):
-        """Functionalized forward: raw param arrays + token ids + SlotKV
+        """Functionalized forward: raw param arrays + token ids + PagedKV
         views -> (last-position logits [B, vocab], new views)."""
         arrays = dict(zip(self._state_names, state_arrays))
         with _tape.no_grad():
@@ -354,99 +405,72 @@ class Engine:
                 logits = self.model._logits(h)
         return logits._data, new_views
 
-    def _prefill_fn(self, state_arrays, ids, lengths, prefix_lens, slots,
-                    valid, block_ids, pool_k, pool_v, cache_k, cache_v,
+    def _prefill_fn(self, state_arrays, ids, lengths, prefix_lens,
+                    tables, cow_src, cow_dst, counts, pool_k, pool_v,
                     seeds, temps, top_ks, top_ps):
-        """Batched fused prefill: one compiled dispatch prefills a whole
-        admission batch.
+        """Batched fused prefill over the paged pool: one compiled
+        dispatch prefills a whole admission batch.
 
         ids [L, bucket]      right-padded prompt SUFFIXES (the part not
                              served by the prefix cache)
         lengths [L]          suffix lengths (>= 1: an exact-hit prompt
                              still prefills its final token)
-        prefix_lens [L]      cached-prefix lengths (0 on a miss)
-        slots [L]            UNIQUE target slot rows; padding lanes get
-                             spare slot ids so the scatter stays
-                             collision-free
-        valid [L]            real-request lanes; padding lanes
-                             identity-write their slot row
-        block_ids [L, MB]    prefix-pool blocks per lane (0 = scratch)
+        prefix_lens [L]      cached-prefix lengths incl. a COW tail
+                             match (0 on a miss)
+        tables [L, MB]       each lane's block-table row: leased prefix
+                             blocks first, then private blocks covering
+                             the rest of the prompt.  Padding lanes are
+                             all-zero — their writes land in scratch.
+        cow_src/cow_dst [L]  copy-on-write: cached tail block to copy
+                             into the lane's private tail block before
+                             the model runs (0/0 = no-op scratch copy)
+        counts [L]           tokens already sampled (0 on first
+                             admission; preemption re-admission passes
+                             ``n_generated - 1`` so the PRNG reproduces
+                             the in-flight token bitwise)
 
-        Each lane's initial row is gathered from the prefix pool —
-        cached-prefix copy is traced INTO this program, not a separate
-        dispatch — then the model writes the suffix k/v at
-        ``prefix_lens`` and the first token is sampled from the last
-        valid position's logits with ``request_key(seed, 0)``, exactly
-        as per-request prefill did."""
-        bs = self._block_size
-        max_seq = self.cache.max_seq_len
-        lanes = ids.shape[0]
-
-        def lane_rows(pool):
-            # [L, MB, bs, H, D] -> [L, MB*bs, H, D] -> slice to the row
-            g = pool[block_ids]
-            g = g.reshape(lanes, self._max_blocks * bs,
-                          self.cache.kv_heads, self.cache.head_dim)
-            return g[:, :max_seq]
-
-        views = [SlotKV(lane_rows(pk), lane_rows(pv), prefix_lens)
+        No gathers: cached prefix blocks are ALREADY in the lane's
+        table, so attention reads them in place.  The only data motion
+        is the single-block COW copy; the model then scatters suffix
+        k/v at ``prefix_lens`` (overwriting the COW block from the
+        divergence offset on) and the first token is sampled from the
+        last valid position's logits with ``request_key(seed, count)``."""
+        # COW first: duplicate-dst lanes (all no-COW lanes share dst 0)
+        # write identical values, so the scatter is collision-safe
+        pool_k = [pk.at[cow_dst].set(pk[cow_src]) for pk in pool_k]
+        pool_v = [pv.at[cow_dst].set(pv[cow_src]) for pv in pool_v]
+        views = [PagedKV(pk, pv, tables, prefix_lens)
                  for pk, pv in zip(pool_k, pool_v)]
         logits, new_views = self._run_model(state_arrays, ids, views)
         last = jax.vmap(
             lambda lg, n: jax.lax.dynamic_index_in_dim(
                 lg, n - 1, axis=0, keepdims=False))(logits, lengths)
-        keys = jax.vmap(request_key)(seeds, jnp.zeros(lanes, jnp.int32))
+        keys = jax.vmap(request_key)(seeds, counts)
         first = jax.vmap(sample_token)(last, keys, temps, top_ks, top_ps)
-        mask = valid[:, None, None, None]
-
-        def scatter(cache, rows):
-            keep = cache[slots]          # identity content for padding
-            return cache.at[slots].set(jnp.where(mask, rows, keep))
-
-        new_k = [scatter(ck, nv.k) for ck, nv in zip(cache_k, new_views)]
-        new_v = [scatter(cv, nv.v) for cv, nv in zip(cache_v, new_views)]
-        return first, new_k, new_v
-
-    def _insert_fn(self, cache_k, cache_v, src_slots, src_offsets,
-                   dst_ids, pool_k, pool_v):
-        """Copy freshly prefilled KV blocks into the prefix pool: for
-        each entry, the ``block_size`` tokens at block offset
-        ``src_offsets[i]`` of slot row ``src_slots[i]`` land in pool
-        block ``dst_ids[i]``.  Padding entries target scratch block 0.
-        One compiled dispatch covers a whole admission batch (entry
-        count is bucketed to a power of two)."""
-        bs = self._block_size
-
-        def copy(cache, pool):
-            rows = cache[src_slots]              # [T, max_seq, H, D]
-
-            def cut(row, off):
-                return jax.lax.dynamic_slice(
-                    row, (off * bs, 0, 0), (bs,) + row.shape[1:])
-
-            blocks = jax.vmap(cut)(rows, src_offsets)
-            return pool.at[dst_ids].set(blocks)
-
-        return ([copy(c, p) for c, p in zip(cache_k, pool_k)],
-                [copy(c, p) for c, p in zip(cache_v, pool_v)])
+        return (first, [nv.k for nv in new_views],
+                [nv.v for nv in new_views])
 
     def _decode_fn(self, state_arrays, tokens, pos, counts, active,
                    seeds, temps, top_ks, top_ps, eos_ids, limits,
-                   cache_k, cache_v, horizon):
+                   tables, pool_k, pool_v, horizon):
         """The horizon-scanned fused decode: ``lax.scan`` over ``horizon``
-        fused steps, all slots, static shapes everywhere.  Retirement is
-        detected inside the scan — a lane whose sampled token hits its
-        EOS id or exhausts its token budget freezes (``pos``/``counts``
-        stop advancing, its carried token stops changing) and harvests
-        ``-1`` from then on.  Frozen lanes still run the model (their
-        k/v writes land at a frozen position in a dead row, overwritten
-        by the next prefill into that slot), so every iteration keeps
-        the one static shape.  ``horizon`` is static: one compiled
-        program per bucket."""
+        fused steps, all slots, static shapes everywhere — the pool is
+        the scan carry (donated on accelerators, so writes are in-place
+        HBM updates) and the block tables are loop-invariant (block
+        coverage for the whole horizon is ensured before dispatch).
+        Retirement is detected inside the scan — a lane whose sampled
+        token hits its EOS id or exhausts its token budget freezes
+        (``pos``/``counts`` stop advancing, its carried token stops
+        changing) and harvests ``-1`` from then on.  Frozen lanes still
+        run the model: their writes land at a frozen position of a
+        still-held block (or in scratch once the row is zeroed), which
+        the masking contract makes invisible.  ``horizon`` is static and
+        ``nb = tables.shape[1]`` re-buckets by shape: one compiled
+        program per (horizon, nb) pair."""
 
         def body(carry, _):
-            tok, p, cnt, act, ck, cv = carry
-            views = [SlotKV(k, v, p) for k, v in zip(ck, cv)]
+            tok, p, cnt, act, pk, pv = carry
+            views = [PagedKV(k, v, tables, p) for k, v in zip(pk, pv)]
             logits, new_views = self._run_model(state_arrays, tok[:, None],
                                                 views)
             nxt = sample_batch(logits[:, 0], seeds, cnt, temps, top_ks,
@@ -461,10 +485,10 @@ class Engine:
                      tuple(v.v for v in new_views)), harvest)
 
         init = (tokens, pos, counts, active,
-                tuple(cache_k), tuple(cache_v))
-        (tok, p, cnt, act, ck, cv), toks = jax.lax.scan(
+                tuple(pool_k), tuple(pool_v))
+        (tok, p, cnt, act, pk, pv), toks = jax.lax.scan(
             body, init, None, length=horizon)
-        return (tok, p, cnt, act), list(ck), list(cv), toks
+        return (tok, p, cnt, act), list(pk), list(pv), toks
 
     # ------------------------------------------------------------ buckets
     def _bucket(self, prompt_len):
@@ -481,20 +505,61 @@ class Engine:
             lanes *= 2
         return min(lanes, self.config.num_slots)
 
+    @staticmethod
+    def _admission_tokens(req):
+        """The token sequence a prefill must cover for this request.
+        First admission: the prompt.  Re-admission after preemption:
+        prompt + all-but-the-last generated token — the last one is
+        reproduced by the prefill's own sampling (count
+        ``n_generated - 1`` under the fold_in PRNG), which doubles as a
+        bitwise consistency check on the swap-in."""
+        if req.output_ids:
+            return req.prompt_ids + req.output_ids[:-1]
+        return req.prompt_ids
+
     def _admission_bucket(self, req):
         """The prefill length bucket a request would dispatch in right
         now: its suffix past the cached prefix, padded to a power of
         two, clamped so prefix + bucket fits the slot row.  Used both
         for co-batch grouping (Scheduler.pop_batch) and for sizing the
         actual dispatch."""
-        matched = self.prefix.lookup(req.prompt_ids)
-        bucket = min(self._bucket(req.prompt_len - matched),
+        toks = self._admission_tokens(req)
+        matched = self.prefix.lookup(toks)
+        bucket = min(self._bucket(len(toks) - matched),
                      self.config.max_seq_len - matched)
         return bucket
+
+    def _blocks_needed(self, req):
+        """Fresh pool blocks this request's admission would allocate:
+        its table entries minus the full-block prefix hits it would
+        lease (a COW tail match still needs its own private block)."""
+        toks = self._admission_tokens(req)
+        full = self.prefix.lookup(toks) // self._block_size
+        return -(-len(toks) // self._block_size) - full
 
     @staticmethod
     def _pow2_floor(x):
         return 1 << (int(x).bit_length() - 1)
+
+    @staticmethod
+    def _pow2_ceil(x):
+        return 1 << max(0, int(x) - 1).bit_length()
+
+    def _attn_blocks(self, h):
+        """The decode program's static block-table width ``nb`` for an
+        ``h``-step horizon: enough entries to cover the deepest live
+        row's write window, bucketed to a power of two and clamped to
+        ``max_blocks_per_slot``.  With ``ragged_attention=False`` it
+        pins to the full width (the every-step-reads-everything slotted
+        ablation).  Attention output is bitwise-invariant to ``nb``
+        (see paged_attention.py), so re-bucketing never perturbs a
+        token — it only changes how many blocks each step reads."""
+        if not self.config.ragged_attention:
+            return self._max_blocks
+        mx = max((int(self._pos[s]) for s in self.scheduler.running),
+                 default=0)
+        need = -(-(mx + h) // self._block_size)
+        return min(self._max_blocks, max(1, self._pow2_ceil(need)))
 
     def _resolve_horizon(self, requested=None):
         """Pick the horizon bucket for the next decode dispatch.
@@ -543,75 +608,130 @@ class Engine:
         Admission pops co-bucketed batches (same suffix bucket after
         prefix matching, bounded reorder window) and prefills each batch
         in ONE compiled dispatch — N same-bucket admissible requests
-        cost 1 prefill dispatch, not N."""
+        cost 1 prefill dispatch, not N.
+
+        Block-pool capacity gates admission: a batch whose table
+        entries don't fit first reclaims unpinned prefix blocks, and if
+        the pool is still short the whole batch goes back to the queue
+        front (order preserved) to retry after running requests retire.
+        An oversubscribed pool therefore defers admission instead of
+        failing mid-prefill."""
         while self.cache.free_slots and self.scheduler.queue_depth:
             batch = self.scheduler.pop_batch(self.cache.free_slots,
                                              bucket_of=self._admission_bucket)
             if not batch:
                 break
+            need = sum(self._blocks_needed(r) for r in batch)
+            short = need - self.pool.free_blocks
+            if short > 0:
+                short -= self.prefix.reclaim(short)
+            if short > 0:
+                self.scheduler.queue.extendleft(reversed(batch))
+                if self.scheduler.running:
+                    break            # retry after retirements free blocks
+                # nothing running to wait for: admit the longest
+                # queue-head prefix of the batch that fits (same bucket,
+                # so it still prefills as one dispatch)
+                fit, free = [], self.pool.free_blocks
+                for r in batch:
+                    nb = self._blocks_needed(r)
+                    if nb > free:
+                        break
+                    free -= nb
+                    fit.append(r)
+                if not fit:
+                    raise RuntimeError(
+                        f"KV pool too small: the queue head alone needs "
+                        f"{self._blocks_needed(batch[0])} blocks, pool "
+                        f"has {self.pool.free_blocks} free and nothing "
+                        "is running to retire (raise kv_pool_blocks or "
+                        "free the prefix budget)")
+                for _ in fit:
+                    self.scheduler.queue.popleft()
+                batch = fit
             self._prefill_batch(batch)
 
     _admit = admit      # pre-horizon internal name, kept for callers
 
     def _prefill_batch(self, batch):
         """One compiled prefill dispatch for a co-bucketed admission
-        batch: allocate slots, pin cached prefixes, gather + suffix-
-        prefill every lane, insert the new blocks into the prefix pool,
-        then harvest first tokens and arm the decode state."""
+        batch: allocate slots, lease cached prefix blocks straight into
+        the block tables, allocate private blocks for the rest, COW +
+        suffix-prefill every lane, adopt the new blocks into the radix
+        store (refcounting only), then harvest first tokens and arm the
+        decode state."""
         n = len(batch)
         bucket = max(self._admission_bucket(r) for r in batch)
         lanes = self._lane_bucket(n)
-        slots, leases = [], []
+        bs = self._block_size
+        slots, leases, all_tokens = [], [], []
         for req in batch:
             slot = self.cache.alloc()
             slots.append(slot)
             self.scheduler.start(req, slot)
-            lease = self.prefix.acquire(req.prompt_ids)
+            toks = self._admission_tokens(req)
+            all_tokens.append(toks)
+            lease = self.prefix.acquire(toks)
             leases.append(lease)
             self._leases[req.request_id] = lease
             req.prefix_hit_tokens = lease.matched_tokens
+            # table row: leased full-match blocks first (copy-free,
+            # shared), then private blocks out to the last prompt token
+            # (the COW tail copy, if any, lands in the first private one)
+            full = len(lease.block_ids)
+            for j, bid in enumerate(lease.block_ids):
+                self.cache.lease_block(slot, j, bid)
+            for j in range(full, -(-len(toks) // bs)):
+                if self.cache.alloc_entry(slot, j) is None:
+                    raise RuntimeError(
+                        "KV pool exhausted mid-admission — "
+                        "admit()'s capacity pre-check diverged from "
+                        "the blocks actually allocated")
             _obs_events.instant("serving.slot_alloc", cat="serving",
                                 slot=slot, request=req.request_id,
                                 prompt_len=req.prompt_len, bucket=bucket,
                                 prefix_hit=lease.matched_tokens)
-            # async span: a request's life overlaps other requests on
-            # this thread, so it pairs by id, not by B/E nesting
-            _obs_events.record(
-                "serving.request", phase=_obs_events.ASYNC_BEGIN,
-                cat="serving", id=req.request_id,
-                args={"slot": slot, "prompt_len": req.prompt_len,
-                      "prefix_hit_tokens": lease.matched_tokens})
+            if not req.output_ids:
+                # async span: a request's life overlaps other requests
+                # on this thread, so it pairs by id, not by B/E nesting
+                # (a preempted request's span is already open)
+                _obs_events.record(
+                    "serving.request", phase=_obs_events.ASYNC_BEGIN,
+                    cat="serving", id=req.request_id,
+                    args={"slot": slot, "prompt_len": req.prompt_len,
+                          "prefix_hit_tokens": lease.matched_tokens})
 
-        # lane arrays: real requests first, then padding lanes carrying
-        # spare (unique, unprefilled) slot ids and identity writes
+        # lane arrays: real requests first, then padding lanes whose
+        # all-zero table rows route every write to scratch block 0
         ids = np.zeros((lanes, bucket), np.int32)
         lengths = np.ones(lanes, np.int32)
         prefix_lens = np.zeros(lanes, np.int32)
-        block_ids = np.zeros((lanes, self._max_blocks), np.int32)
-        valid = np.zeros(lanes, bool)
+        tables = np.zeros((lanes, self._max_blocks), np.int32)
+        cow_src = np.zeros(lanes, np.int32)
+        cow_dst = np.zeros(lanes, np.int32)
+        counts = np.zeros(lanes, np.int32)
         seeds = np.zeros(lanes, np.uint32)
         temps = np.zeros(lanes, np.float32)
         top_ks = np.zeros(lanes, np.int32)
         top_ps = np.ones(lanes, np.float32)
-        lane_slots = np.zeros(lanes, np.int32)
-        spare = iter(sorted(set(range(self.cache.num_slots)) - set(slots)))
-        for i in range(lanes):
-            if i < n:
-                req, lease = batch[i], leases[i]
-                suffix = req.prompt_ids[lease.matched_tokens:]
-                ids[i, :len(suffix)] = suffix
-                lengths[i] = len(suffix)
-                prefix_lens[i] = lease.matched_tokens
-                block_ids[i, :len(lease.block_ids)] = lease.block_ids
-                valid[i] = True
-                s = req.sampling
-                seeds[i] = np.uint32(s.seed)
-                temps[i] = s.temperature
-                top_ks[i] = s.top_k
-                top_ps[i] = s.top_p
-                lane_slots[i] = slots[i]
-            else:
-                lane_slots[i] = next(spare)
+        for i in range(n):
+            req, lease, toks = batch[i], leases[i], all_tokens[i]
+            suffix = toks[lease.matched_tokens:]
+            ids[i, :len(suffix)] = suffix
+            lengths[i] = len(suffix)
+            prefix_lens[i] = lease.matched_tokens
+            tables[i] = self.cache.tables[slots[i]]
+            if lease.tail_tokens:
+                cow_src[i] = lease.tail_block
+                cow_dst[i] = self.cache.tables[slots[i],
+                                               len(lease.block_ids)]
+                self._cow_copies += 1
+            counts[i] = max(0, req.n_generated - 1)
+            s = req.sampling
+            seeds[i] = np.uint32(s.seed)
+            temps[i] = s.temperature
+            top_ks[i] = s.top_k
+            top_ps[i] = s.top_p
 
         with _obs_span("serving.prefill_pass", cat="serving",
                        engine=self._profiler_name,
@@ -620,13 +740,12 @@ class Engine:
             first, new_k, new_v = self._prefill(
                 self._state_arrays, jnp.asarray(ids),
                 jnp.asarray(lengths), jnp.asarray(prefix_lens),
-                jnp.asarray(lane_slots), jnp.asarray(valid),
-                jnp.asarray(block_ids),
-                self.prefix.pool_k, self.prefix.pool_v,
-                self.cache.k, self.cache.v,
+                jnp.asarray(tables), jnp.asarray(cow_src),
+                jnp.asarray(cow_dst), jnp.asarray(counts),
+                self.pool.k, self.pool.v,
                 jnp.asarray(seeds), jnp.asarray(temps),
                 jnp.asarray(top_ks), jnp.asarray(top_ps))
-        self.cache.rebind(new_k, new_v)
+        self.pool.rebind(new_k, new_v)
         self._prefill_calls += 1
         self._prefill_requests += n
         name = self._profiler_name
@@ -634,32 +753,40 @@ class Engine:
         _SRV_PREFILL_REQS.inc(n, engine=name)
         _SRV_PREFILL_BATCH.observe(n, engine=name)
 
-        # cache the new full blocks of every admitted prompt (reads the
-        # freshly written slot rows, BEFORE any later dispatch reuses
-        # them); one compiled copy covers the whole batch
-        copies = []
-        for req, lease, slot in zip(batch, leases, slots):
-            for off, dst in self.prefix.insert(req.prompt_ids, lease):
-                copies.append((slot, off, dst))
-        if copies:
-            self._dispatch_insert(copies)
+        # cache the new full blocks of every admitted prompt: the radix
+        # store takes shared references on the slot's freshly written
+        # private blocks — pure host-side refcounting, no data motion
+        for lease, toks, slot in zip(leases, all_tokens, slots):
+            row = self.cache.tables[slot]
+            self.prefix.adopt(toks, lease,
+                              block_of=lambda j, row=row: row[j])
 
         first_np = np.asarray(first)     # the one prefill host sync
         for i, (req, lease, slot) in enumerate(zip(batch, leases, slots)):
             hit = lease.matched_tokens
             self._prefix_hit_tokens += hit
-            self._prompt_tokens += req.prompt_len
+            self._prompt_tokens += len(all_tokens[i])
             if hit:
                 _SRV_PREFIX_HIT.inc(hit, engine=name)
-            self._tokens_generated += 1
-            _SRV_TOKENS.inc(engine=name)
             tok = int(first_np[i])
-            if req.record_token(tok):
-                self._retire(req)
-                continue
+            if req.output_ids:
+                # preemption swap-in: the prefill re-sampled the token
+                # that was in flight when the request was swapped out —
+                # fold_in(seed, n-1) must reproduce it bitwise
+                if tok != req.output_ids[-1]:
+                    raise RuntimeError(
+                        f"preemption resume diverged for request "
+                        f"{req.request_id}: re-prefill sampled {tok}, "
+                        f"expected {req.output_ids[-1]}")
+            else:
+                self._tokens_generated += 1
+                _SRV_TOKENS.inc(engine=name)
+                if req.record_token(tok):
+                    self._retire(req)
+                    continue
             s = req.sampling
             self._tokens[slot] = tok
-            self._pos[slot] = req.prompt_len
+            self._pos[slot] = len(all_tokens[i])
             self._seeds[slot] = np.uint32(s.seed)
             self._counts[slot] = req.n_generated
             self._temps[slot] = s.temperature
@@ -673,27 +800,13 @@ class Engine:
             # write into device-resident state; retirement is detected
             # inside the scan, so it needs no re-upload
 
-    def _dispatch_insert(self, copies):
-        """Scatter new prefix blocks from slot rows into the pool: one
-        compiled dispatch per admission batch, entry count padded to a
-        power of two (padding targets scratch block 0)."""
-        t = 1
-        while t < len(copies):
-            t *= 2
-        src_slots = np.zeros(t, np.int32)
-        src_offsets = np.zeros(t, np.int32)
-        dst_ids = np.zeros(t, np.int32)
-        for i, (slot, off, dst) in enumerate(copies):
-            src_slots[i] = slot
-            src_offsets[i] = off
-            dst_ids[i] = dst
-        new_pk, new_pv = self._insert(
-            self.cache.k, self.cache.v, jnp.asarray(src_slots),
-            jnp.asarray(src_offsets), jnp.asarray(dst_ids),
-            self.prefix.pool_k, self.prefix.pool_v)
-        self.prefix.rebind(new_pk, new_pv)
-
     def _retire(self, req):
+        # release every table entry: private blocks return to the pool
+        # (block-leak invariant: leased_blocks == 0 once all requests
+        # retire), blocks the radix store adopted live on under its
+        # references, and the zeroed row routes any still-masked lane
+        # writes to scratch
+        self.cache.release_slot_blocks(req.slot)
         self.cache.free(req.slot)
         self.scheduler.finish(req)
         lease = self._leases.pop(req.request_id, None)
@@ -719,6 +832,62 @@ class Engine:
         # the active bit — no re-upload, no parking
         self._active[req.slot] = False
 
+    def preempt(self, req):
+        """Swap a RUNNING request out: release its slot, table entries,
+        and prefix lease, and requeue it at the queue front with its
+        generated tokens intact.  Re-admission re-prefills prompt +
+        generated-so-far and the fold_in PRNG reproduces its next token
+        bitwise, so the output stream is unaffected.  Called by the
+        engine under KV block pressure; also public for schedulers that
+        want to swap idle sequences explicitly."""
+        from .scheduler import RUNNING
+
+        if req.status != RUNNING:
+            raise ValueError(
+                f"cannot preempt request {req.request_id}: {req.status}")
+        slot = req.slot
+        self.cache.release_slot_blocks(slot)
+        lease = self._leases.pop(req.request_id, None)
+        if lease is not None:
+            self.prefix.release(lease)
+        self._active[slot] = False
+        self._state_dirty = True
+        self.scheduler.requeue_front(req)
+        self.cache.free(slot)
+        self._preemptions += 1
+        _SRV_PREEMPTIONS.inc(engine=self._profiler_name)
+        _obs_events.instant("serving.preempt", cat="serving", slot=slot,
+                            request=req.request_id,
+                            n_generated=req.n_generated)
+
+    def _ensure_blocks(self, h):
+        """Extend every running slot's block table to cover its next
+        ``h`` write positions (lazy allocation: rows only hold blocks
+        they have reached).  Under pool pressure: reclaim unpinned
+        prefix blocks first, then preempt the YOUNGEST other running
+        request (most recently submitted — it has the least sunk decode
+        work and re-prefills cheapest) until the allocation fits.  Runs
+        BEFORE the step() harvest snapshot, so a preempted lane is never
+        mistaken for a mid-horizon retirement."""
+        for slot, req in sorted(self.scheduler.running.items()):
+            if self.scheduler.running.get(slot) is not req:
+                continue                 # preempted earlier in this loop
+            need = min(int(self._pos[slot]) + h, self.config.max_seq_len)
+            while not self.cache.ensure_blocks(slot, need):
+                if self.prefix.reclaim(1):
+                    continue
+                victim = max(
+                    (r for r in self.scheduler.running.values()
+                     if r is not req),
+                    key=lambda r: r.request_id, default=None)
+                if victim is None:
+                    raise RuntimeError(
+                        f"KV pool exhausted: slot {slot} needs blocks "
+                        "for its decode window and there is nothing "
+                        "left to reclaim or preempt (raise "
+                        "kv_pool_blocks)")
+                self.preempt(victim)
+
     def _sync_device_state(self):
         """Upload the per-slot state mirrors — only when admission
         dirtied them.  In steady-state decode the device arrays returned
@@ -735,20 +904,43 @@ class Engine:
                                      self._eos_ids, self._limits))
         self._state_dirty = False
 
+    def _sync_tables(self, nb):
+        """Upload the live ``[:, :nb]`` prefix of the host block tables
+        — only when a table changed (lease/alloc/release) or ``nb``
+        re-bucketed.  In steady-state decode nothing is uploaded and the
+        tables stay loop-invariant across horizons."""
+        if self.cache.tables_dirty or nb != self._d_tables_nb:
+            self._d_tables = jnp.asarray(self.cache.tables[:, :nb])
+            self._d_tables_nb = nb
+            self.cache.tables_dirty = False
+
     def _dispatch_horizon(self, h):
         """One compiled decode dispatch over ``h`` fused steps; adopts
         the returned device state and returns the harvested ``[h, n]``
-        token array AFTER the one blocking host sync."""
+        token array AFTER the one blocking host sync.  The block-table
+        width ``nb`` is bucketed per dispatch (ragged attention), and
+        the decode program re-compiles only on a new (h, nb) pair."""
+        self._ensure_blocks(h)       # idempotent; step() already ran it
+        nb = self._attn_blocks(h)
         self._sync_device_state()
+        self._sync_tables(nb)
         seeds, temps, top_ks, top_ps, eos_ids, limits = self._d_params
         (tok, p, cnt, act), new_k, new_v, toks = self._decode(
             self._state_arrays, self._d_tokens, self._d_pos,
             self._d_counts, self._d_active,
             seeds, temps, top_ks, top_ps, eos_ids, limits,
-            self.cache.k, self.cache.v, h)
-        self.cache.rebind(new_k, new_v)
+            self._d_tables, self.pool.k, self.pool.v, h)
+        self.pool.rebind(new_k, new_v)
         self._d_tokens, self._d_pos = tok, p
         self._d_counts, self._d_active = cnt, act
+        self._decode_buckets.add((h, nb))
+        # KV traffic actually gathered by the fallback scan (and the
+        # upper bound for the block-culling Pallas kernel): every lane
+        # reads its nb table-mapped blocks — k + v, all layers — per
+        # step (bytes_per_block already spans k+v and every layer)
+        step_bytes = self.cache.num_slots * nb * self.pool.bytes_per_block
+        self._kv_bytes_read += step_bytes * h
+        _SRV_KV_BYTES.inc(step_bytes * h, engine=self._profiler_name)
         toks = np.asarray(toks)      # the ONE host sync per horizon
         self._host_syncs += 1
         return toks
@@ -764,9 +956,14 @@ class Engine:
         t0 = time.time()
         finished = []
         self.admit()
+        if self.scheduler.running:
+            h = self._resolve_horizon(horizon)
+            # block coverage (and any pressure preemption) BEFORE the
+            # harvest snapshot: a lane preempted here simply isn't in
+            # `active`, so its -1 harvest rows are never misread
+            self._ensure_blocks(h)
         active = dict(self.scheduler.running)
         if active:
-            h = self._resolve_horizon(horizon)
             self._horizon_buckets.add(h)
             with _obs_span("serving.decode_step", cat="serving",
                            engine=self._profiler_name,
@@ -836,6 +1033,7 @@ class Engine:
         name = self._profiler_name
         _SRV_QUEUE.set(self.scheduler.queue_depth, engine=name)
         _SRV_ACTIVE.set(self.cache.used_slots, engine=name)
+        _SRV_KV_BLOCKS.set(self.pool.blocks_in_use, engine=name)
         if self._decode_steps:
             _SRV_UTIL.set(self._slot_busy_integral / self._decode_steps,
                           engine=name)
@@ -915,7 +1113,13 @@ class Engine:
             "decode_cache_hits": self._decode.hits,
             "prefill_compiles": self._prefill.misses,
             "prefill_cache_hits": self._prefill.hits,
-            "prefix_insert_calls": self._insert.calls,
+            # unified pool: caching new prefix blocks is adopt()
+            # refcounting, so the old scatter-insert dispatch is gone
+            "prefix_insert_calls": 0,
+            "kv_blocks_in_use": self.pool.blocks_in_use,
+            "kv_bytes_read": self._kv_bytes_read,
+            "cow_copies": self._cow_copies,
+            "preemptions": self._preemptions,
         }
         if self._decode_steps:
             c["slot_utilization"] = (self._slot_busy_integral
@@ -936,8 +1140,21 @@ class Engine:
         s["wasted_lane_fraction"] = (
             self._wasted_lane_tokens / lane_steps if lane_steps else 0.0)
         s["horizon_buckets"] = sorted(self._horizon_buckets)
+        s["decode_buckets"] = sorted(self._decode_buckets)
         s["next_horizon_growth"] = self._grow
         s["prefix"] = self.prefix.stats()
+        s["kv_pool"] = {
+            "block_size": self._block_size,
+            "capacity_blocks": self.pool.capacity,
+            "free_blocks": self.pool.free_blocks,
+            "blocks_in_use": self.pool.blocks_in_use,
+            "leased_blocks": self.cache.leased_blocks,
+            "cached_blocks": self.prefix._held,
+            "bytes_per_block": self.pool.bytes_per_block,
+            "kv_bytes_read": self._kv_bytes_read,
+            "cow_copies": self._cow_copies,
+            "preemptions": self._preemptions,
+        }
         if self._ttft_n:
             s["ttft_p50_s"] = _SRV_TTFT.percentile(
                 50, engine=self._profiler_name)

@@ -1,37 +1,53 @@
-"""Slotted, static-shape KV cache for continuous-batching decode.
+"""KV caches for continuous-batching decode: the slotted static-shape
+cache and the unified paged block pool.
 
 The legacy decode path in models/gpt.py grows a `(k, v)` concat cache by
 one position per step, so every step has a new shape and eager decode
 retraces constantly (DECODE_BENCH.json: ~2.6 ms/token against a 0.77 ms
-weight roofline). The serving cache instead preallocates per-layer
-``[num_slots, max_seq_len, kv_heads, head_dim]`` buffers and writes each
-new token in place via ``lax.dynamic_update_slice`` — one compiled decode
-step serves every step of every request mix with zero retracing.
+weight roofline).  Two static-shape cache designs fix that:
 
-Two layers of API:
+* **Slotted rows** (:class:`SlottedKVCache` + :class:`SlotKV`) — one
+  per-layer ``[num_slots, max_seq_len, kv_heads, head_dim]`` buffer,
+  written in place via ``lax.dynamic_update_slice``.  Simple, but every
+  decode step attends position-masked over the FULL ``max_seq_len`` row,
+  so short sequences pay bandwidth for the whole row, and a separate
+  prefix-cache pool needs per-admission gathers to bridge the two
+  allocations.
+* **Paged pool** (:class:`PagedKVPool` + :class:`PagedKV`) — ONE
+  per-layer ``[num_blocks, block_size, kv_heads, head_dim]`` pool
+  (vLLM-style fixed blocks) shared by every slot AND the prefix cache,
+  addressed through a per-slot block table.  Decode attention reads only
+  the table-mapped blocks below each row's length (ragged), prefix hits
+  lease cached blocks straight into a slot's table (copy-free,
+  refcounted), and preempting an idle sequence is just releasing its
+  table entries.  This is the serving engine's cache since the unified-
+  pool refactor; the slotted classes remain for the model-level parity
+  tests and as the simpler reference design.
 
-* :class:`SlotKV` — the per-layer *view* a model forward sees: the slot
-  rows it attends over (``k``/``v``, batch-major) plus the per-row write
-  position ``pos``.  models/gpt.py's attention accepts it anywhere the
-  legacy ``(k, v)`` tuple cache is accepted.
-* :class:`SlottedKVCache` — the engine-side owner of the full per-layer
-  buffers and the slot free-list.
-
-All helpers are pure jnp functions so they trace into one XLA program.
+All device-side helpers are pure jnp functions so they trace into one
+XLA program.
 
 Horizon-scan contract (engine.py fused decode): the engine advances all
 slots H steps inside one ``lax.scan``, and lanes that hit EOS/max-tokens
 mid-horizon are *frozen* — their ``pos`` stops advancing — but the scan
-body still issues a ``write_slots`` for every lane every step.  A frozen
-lane therefore keeps rewriting the same row position with garbage.  That
-is safe by construction: the row's visible window is bounded by ``pos``
-(``visible_mask``), so the garbage is never attended over, and prefill
-overwrites the full ``max_seq_len`` row before a freed slot is reused.
+body still issues a cache write for every lane every step.  A frozen
+lane keeps rewriting the same position with garbage.  That is safe by
+construction: the garbage lands at exactly the position the next real
+write will overwrite first (decode writes before it attends), everything
+written is finite, and the row's visible window is bounded by ``pos``.
+After a slot retires, the engine zeroes its block-table row, so any
+further masked-lane writes land in the reserved scratch block 0 — slot
+reuse never depends on overwriting stale rows, the freed blocks simply
+return to the pool.  (The slotted cache relied on the analogous masking
+argument: stale row positions sit at indices >= the new occupant's
+length until prefill re-writes them.)
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -126,3 +142,244 @@ class SlottedKVCache:
         """Adopt updated buffers returned by a jitted program."""
         self.k = list(new_k)
         self.v = list(new_v)
+
+
+# --------------------------------------------------------------- paged
+
+@dataclass
+class PagedKV:
+    """One layer's paged-cache view for a batch of lanes.
+
+    k, v:    [num_blocks, block_size, kv_heads, head_dim] — the layer's
+             slice of the unified pool (block 0 is reserved scratch)
+    tables:  [batch, nb] int32 block table — entry j maps token
+             positions ``j*block_size .. (j+1)*block_size-1`` of a lane
+             to a pool block; 0 marks an unallocated entry (scratch)
+    pos:     [batch] int32 — tokens already cached per lane; incoming
+             tokens are written at positions pos .. pos+s-1 and attend
+             over keys 0 .. pos+s-1 (ragged: only the table-mapped
+             blocks are ever read)
+    """
+
+    k: jax.Array
+    v: jax.Array
+    tables: jax.Array
+    pos: jax.Array
+
+    @property
+    def block_size(self):
+        return self.k.shape[1]
+
+
+def paged_write(pool, new, tables, pos):
+    """Scatter ``new`` [B, s, H, D] into the paged ``pool``
+    [NB, bs, H, D] at per-lane positions ``pos`` [B] through the block
+    ``tables`` [B, nb].  Positions past the table's coverage — padding
+    lanes, frozen lanes whose table row was zeroed, write positions in
+    not-yet-allocated entries — resolve to block 0 (scratch), where
+    colliding garbage writes are harmless by convention."""
+    bs = pool.shape[1]
+    b, s = new.shape[0], new.shape[1]
+    tpos = pos[:, None] + jnp.arange(s, dtype=pos.dtype)         # [B, s]
+    blk_idx = tpos // bs
+    in_range = blk_idx < tables.shape[1]
+    blk_idx = jnp.clip(blk_idx, 0, tables.shape[1] - 1)
+    blocks = jnp.take_along_axis(tables, blk_idx, axis=1)        # [B, s]
+    blocks = jnp.where(in_range, blocks, 0)
+    offs = tpos % bs
+    flat = new.astype(pool.dtype).reshape((b * s,) + new.shape[2:])
+    return pool.at[blocks.reshape(-1), offs.reshape(-1)].set(flat)
+
+
+class PagedKVPool:
+    """The unified refcounted block pool: per layer, ONE
+    ``[num_blocks, block_size, kv_heads, head_dim]`` k/v buffer pair
+    shared by every slot's block table and the prefix cache.
+
+    Block 0 is permanently reserved scratch (padding lanes and
+    out-of-coverage writes target it).  Every other block is tracked by
+    a host-side refcount: a slot-table entry and a prefix-store node
+    each hold one reference; a block returns to the free list when the
+    last reference is released — which is what makes prefix sharing
+    copy-free and preemption just bookkeeping."""
+
+    def __init__(self, num_layers, num_blocks, block_size, kv_heads,
+                 head_dim, dtype=jnp.float32):
+        if num_blocks < 2:
+            raise ValueError("paged pool needs >= 2 blocks (one scratch)")
+        self.num_layers = num_layers
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.kv_heads = kv_heads
+        self.head_dim = head_dim
+        self.dtype = dtype
+        shape = (num_blocks, block_size, kv_heads, head_dim)
+        self.k = [jnp.zeros(shape, dtype) for _ in range(num_layers)]
+        self.v = [jnp.zeros(shape, dtype) for _ in range(num_layers)]
+        self._refs = np.zeros(num_blocks, np.int32)
+        self._refs[0] = 1                    # scratch: pinned forever
+        self._free = list(range(num_blocks - 1, 0, -1))
+
+    @property
+    def capacity(self):
+        """Allocatable blocks (excludes the scratch block)."""
+        return self.num_blocks - 1
+
+    @property
+    def free_blocks(self):
+        return len(self._free)
+
+    @property
+    def blocks_in_use(self):
+        return self.capacity - len(self._free)
+
+    @property
+    def bytes_per_block(self):
+        return (2 * self.num_layers * self.block_size * self.kv_heads
+                * self.head_dim * jnp.dtype(self.dtype).itemsize)
+
+    def alloc(self):
+        """Claim a free block (refcount 1), or None when exhausted."""
+        if not self._free:
+            return None
+        bid = self._free.pop()
+        self._refs[bid] = 1
+        return bid
+
+    def share(self, block_id):
+        """Take one more reference on a live block (prefix lease into a
+        slot table, radix-store adoption of a slot's block)."""
+        if self._refs[block_id] <= 0:
+            raise ValueError(f"block {block_id} shared while free")
+        self._refs[block_id] += 1
+
+    def release(self, block_id):
+        """Drop one reference; the block returns to the free list when
+        the last holder lets go.  Block 0 (scratch) is never released."""
+        if block_id == 0:
+            return
+        if self._refs[block_id] <= 0:
+            raise ValueError(f"block {block_id} over-released")
+        self._refs[block_id] -= 1
+        if self._refs[block_id] == 0:
+            self._free.append(block_id)
+
+    def refcount(self, block_id):
+        return int(self._refs[block_id])
+
+    def rebind(self, new_k, new_v):
+        """Adopt updated pool buffers returned by a jitted program."""
+        self.k = list(new_k)
+        self.v = list(new_v)
+
+
+class PagedKVCache:
+    """Engine-side owner of the paged serving cache: the unified pool,
+    the per-slot block tables, and the slot free-list.
+
+    The block table is host-authoritative (``tables`` np array, one row
+    per slot, ``max_blocks_per_slot`` entries); the engine uploads the
+    live prefix of each row before a dispatch whenever ``tables_dirty``
+    is set.  Entries are filled lazily: admission covers the prompt,
+    ``ensure_blocks`` extends coverage to each horizon's write window,
+    and retirement releases every entry back to the pool."""
+
+    def __init__(self, num_layers, num_slots, max_seq_len, block_size,
+                 kv_heads, head_dim, dtype=jnp.float32, num_blocks=0,
+                 extra_blocks=0):
+        self.num_layers = num_layers
+        self.num_slots = num_slots
+        self.max_seq_len = max_seq_len
+        self.block_size = block_size
+        self.kv_heads = kv_heads
+        self.head_dim = head_dim
+        self.dtype = dtype
+        self.max_blocks_per_slot = -(-max_seq_len // block_size)
+        if num_blocks <= 0:
+            # auto: every slot can grow to a full row, plus headroom for
+            # the prefix store, plus the scratch block
+            num_blocks = (1 + num_slots * self.max_blocks_per_slot
+                          + extra_blocks)
+        self.pool = PagedKVPool(num_layers, num_blocks, block_size,
+                                kv_heads, head_dim, dtype)
+        self.tables = np.zeros((num_slots, self.max_blocks_per_slot),
+                               np.int32)
+        self.tables_dirty = True
+        self._free = list(range(num_slots - 1, -1, -1))
+
+    # ---------------- slot bookkeeping (host side)
+    def alloc(self):
+        """Claim a free slot index, or None when every slot is taken."""
+        return self._free.pop() if self._free else None
+
+    def free(self, slot):
+        if slot in self._free:
+            raise ValueError(f"slot {slot} double-freed")
+        self._free.append(slot)
+
+    @property
+    def free_slots(self):
+        return len(self._free)
+
+    @property
+    def used_slots(self):
+        return self.num_slots - len(self._free)
+
+    # ---------------- block-table bookkeeping (host side)
+    def lease_block(self, slot, index, block_id):
+        """Map a SHARED pool block (a prefix-cache hit) into a slot's
+        table: the table entry takes its own reference."""
+        self.pool.share(block_id)
+        self.tables[slot, index] = block_id
+        self.tables_dirty = True
+
+    def alloc_entry(self, slot, index):
+        """Fill one table entry with a fresh private block; returns the
+        block id or None when the pool is exhausted."""
+        bid = self.pool.alloc()
+        if bid is None:
+            return None
+        self.tables[slot, index] = bid
+        self.tables_dirty = True
+        return bid
+
+    def ensure_blocks(self, slot, n_tokens):
+        """Extend a slot's table to cover ``n_tokens`` positions
+        (lazily: only entries still 0 are allocated).  Returns False —
+        with any partial allocation kept, it stays valid coverage — when
+        the pool runs dry; the engine then reclaims or preempts."""
+        need = min(-(-n_tokens // self.block_size),
+                   self.max_blocks_per_slot)
+        for j in range(need):
+            if self.tables[slot, j] == 0:
+                if self.alloc_entry(slot, j) is None:
+                    return False
+        return True
+
+    def release_slot_blocks(self, slot):
+        """Release every table entry of a slot (retirement/preemption):
+        shared blocks survive while other holders remain; private ones
+        return to the pool.  The zeroed row routes any still-in-flight
+        masked-lane writes to scratch."""
+        row = self.tables[slot]
+        for j in np.nonzero(row)[0]:
+            self.pool.release(int(row[j]))
+        row[:] = 0
+        self.tables_dirty = True
+
+    @property
+    def leased_blocks(self):
+        """Live (slot, entry) references across all block tables."""
+        return int(np.count_nonzero(self.tables))
+
+    def layer_views(self, tables, pos):
+        """Per-layer PagedKV views over device arrays ``tables``/``pos``
+        (the fused decode step runs every slot; inactive lanes are
+        masked by their pos and write through zeroed table rows into
+        scratch)."""
+        return [PagedKV(self.pool.k[i], self.pool.v[i], tables, pos)
+                for i in range(self.num_layers)]
+
+    def rebind(self, new_k, new_v):
+        """Adopt updated pool buffers returned by a jitted program."""
+        self.pool.rebind(new_k, new_v)

@@ -1,0 +1,217 @@
+"""Ragged paged-attention over the unified KV block pool.
+
+Decode attention against a slotted cache reads the full ``max_seq``
+row of every lane under a position mask — short sequences pay bandwidth
+for the whole row (DECODE_BENCH.json: fused decode stuck at 41-47% of
+the weight roofline at b1 and 25.5% at b8, where the masked reads are 8
+full rows per step).  Paged attention instead walks each lane's block
+table and reads ONLY the table-mapped blocks, so per-step KV traffic is
+proportional to the live sequence length.
+
+Two implementations behind one entry point:
+
+* :func:`paged_attention` — the router.  A Pallas TPU kernel serves the
+  single-token decode hot path on TPU; everything else (CPU tier-1,
+  multi-token prefill) runs the XLA fallback.  Override with
+  ``PADDLE_TPU_PAGED_ATTN=xla|pallas``.
+* **XLA fallback** — a blockwise online-softmax ``lax.scan`` over the
+  table entries (flash-attention recurrence: running max ``m``, running
+  normalizer ``l``, unnormalized accumulator ``acc``).  The scan is the
+  engine's PARITY REFERENCE: a block with no visible keys contributes
+  exactly nothing — its masked scores sit at the finite ``NEG_INF``
+  floor so ``m`` is unchanged (``max(m, NEG_INF) == m``), its
+  probabilities are forced to literal 0.0, and ``l``/``acc`` pass
+  through bitwise (``x * 1.0 + 0.0 == x``).  Outputs are therefore
+  invariant to the STATIC number of table columns ``nb``, which is what
+  keeps batched/horizoned paged decode bitwise-equal to sequential
+  generation even though the engine re-buckets ``nb`` as sequences grow.
+* **Pallas TPU kernel** — grid ``(batch, nb)`` with the flattened block
+  table and per-lane lengths as scalar prefetch (the table drives the
+  k/v BlockSpec index maps, so each grid cell DMAs exactly one pool
+  block); ``pl.when`` skips cells whose block starts past the lane's
+  visible window, so a short sequence's tail blocks cost neither
+  bandwidth nor compute.  f32 accumulation in VMEM scratch, finalized
+  on the last block column.
+
+Layout contract (matches ``kv_cache.PagedKV``): q ``[B, s, QH, D]``,
+pools ``[NB, bs, KH, D]`` with GQA group size ``G = QH // KH`` (query
+head ``h`` reads kv head ``h // G``), tables ``[B, nb]`` int32 (0 =
+scratch), pos ``[B]`` int32.  Returns ``[B, s, QH, D]`` in q's dtype.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30    # finite floor: keeps exp(s - m) NaN-free when a
+#                    query row has no visible key in a block
+
+try:  # pallas import is TPU-oriented; CPU-only builds may lack it
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _HAVE_PALLAS = True
+except Exception:  # pragma: no cover - exercised only without pallas
+    pl = pltpu = None
+    _HAVE_PALLAS = False
+
+
+def paged_attention(q, k_pool, v_pool, tables, pos):
+    """Route to the Pallas decode kernel (TPU, s == 1) or the XLA
+    online-softmax fallback (everything else — including all of CPU
+    tier-1, which is also the bitwise parity reference)."""
+    impl = os.environ.get("PADDLE_TPU_PAGED_ATTN", "auto")
+    use_pallas = impl == "pallas" or (
+        impl == "auto" and q.shape[1] == 1
+        and jax.default_backend() == "tpu")
+    if use_pallas:
+        return _pallas_paged_decode(q, k_pool, v_pool, tables, pos)
+    return _xla_paged_attention(q, k_pool, v_pool, tables, pos)
+
+
+# ------------------------------------------------------------------ XLA
+
+def _xla_paged_attention(q, k_pool, v_pool, tables, pos):
+    """Blockwise online-softmax over the block table, one ``lax.scan``
+    step per table column.  Fixed shapes per step ([B, bs] gathers), so
+    the whole thing traces into the engine's horizon scan; see the
+    module docstring for the nb-invariance argument."""
+    b, s, qh, d = q.shape
+    bs, kh = k_pool.shape[1], k_pool.shape[2]
+    g = qh // kh
+    nb = tables.shape[1]
+    scale = 1.0 / math.sqrt(d)
+
+    qg = (q.astype(jnp.float32) * scale).reshape(b, s, kh, g, d)
+    q_pos = pos[:, None] + jnp.arange(s, dtype=pos.dtype)        # [B, s]
+
+    def block_step(carry, i):
+        m, l, acc = carry
+        blocks = jnp.take(tables, i, axis=1)                     # [B]
+        kb = k_pool[blocks].astype(jnp.float32)                  # [B,bs,KH,D]
+        vb = v_pool[blocks].astype(jnp.float32)
+        sc = jnp.einsum("bskgd,btkd->bskgt", qg, kb)
+        key_idx = i * bs + jnp.arange(bs, dtype=pos.dtype)       # [bs]
+        vis = key_idx[None, None, :] <= q_pos[:, :, None]        # [B,s,bs]
+        vis = vis[:, :, None, None, :]                           # [B,s,1,1,bs]
+        sc = jnp.where(vis, sc, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(sc, axis=-1))
+        # exact-zero masked probabilities (not exp(NEG_INF - m)): padded
+        # blocks and padded key columns contribute literal +0.0, which
+        # is what makes the output bitwise-invariant to nb
+        p = jnp.where(vis, jnp.exp(sc - m_new[..., None]), 0.0)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[..., None] + \
+            jnp.einsum("bskgt,btkd->bskgd", p, vb)
+        return (m_new, l_new, acc_new), None
+
+    m0 = jnp.full((b, s, kh, g), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, s, kh, g), jnp.float32)
+    acc0 = jnp.zeros((b, s, kh, g, d), jnp.float32)
+    (_, l, acc), _ = jax.lax.scan(block_step, (m0, l0, acc0),
+                                  jnp.arange(nb))
+    # every query row sees at least key 0 (key_idx 0 <= q_pos), so l > 0
+    out = acc / l[..., None]
+    return out.reshape(b, s, qh, d).astype(q.dtype)
+
+
+# --------------------------------------------------------------- Pallas
+
+def _paged_decode_kernel(tables, pos, q_ref, k_ref, v_ref, o_ref,
+                         m_ref, l_ref, acc_ref, *, block_size, groups,
+                         nb, scale):
+    """One grid cell = (lane b, table column i): accumulate pool block
+    ``tables[b, i]`` into lane b's online-softmax state.  The k/v
+    BlockSpec index maps already selected the pool block from the
+    scalar-prefetched table, so refs hold exactly one block."""
+    b, i = pl.program_id(0), pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    p_b = pos[b]
+
+    # skip blocks that start past the lane's visible window [0, pos]:
+    # a retired/short lane's tail blocks are never read at all
+    @pl.when(i * block_size <= p_b)
+    def _accumulate():
+        kh = k_ref.shape[2]
+        d = k_ref.shape[3]
+        q = q_ref[0].astype(jnp.float32) * scale          # [QH, D]
+        q = q.reshape(kh, groups, d)
+        k = k_ref[0].astype(jnp.float32)                  # [bs, KH, D]
+        v = v_ref[0].astype(jnp.float32)
+        sc = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)           # [KH, G, bs]
+        key_idx = i * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, sc.shape, 2)
+        vis = key_idx <= p_b
+        sc = jnp.where(vis, sc, NEG_INF)
+        m_prev = m_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(sc, axis=-1))
+        p = jnp.where(vis, jnp.exp(sc - m_new[..., None]), 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=-1)
+        pv = jax.lax.dot_general(
+            p, v, (((2,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)           # [KH, G, D]
+        acc_ref[...] = acc_ref[...] * alpha[..., None] + pv
+        m_ref[...] = m_new
+
+    @pl.when(i == nb - 1)
+    def _finalize():
+        out = acc_ref[...] / l_ref[...][..., None]        # [KH, G, D]
+        o_ref[0] = out.reshape(o_ref.shape[1:]).astype(o_ref.dtype)
+
+
+def _pallas_paged_decode(q, k_pool, v_pool, tables, pos):
+    """Decode-path (s == 1) ragged kernel: grid (B, nb), block table +
+    lane lengths scalar-prefetched so the k/v index maps gather pool
+    blocks directly and ``pl.when`` culls dead columns."""
+    if not _HAVE_PALLAS:  # pragma: no cover
+        return _xla_paged_attention(q, k_pool, v_pool, tables, pos)
+    b, s, qh, d = q.shape
+    assert s == 1, "the Pallas kernel serves single-token decode"
+    bs, kh = k_pool.shape[1], k_pool.shape[2]
+    g = qh // kh
+    nb = tables.shape[1]
+    q2 = q.reshape(b, qh, d)
+
+    kernel = functools.partial(
+        _paged_decode_kernel, block_size=bs, groups=g, nb=nb,
+        scale=1.0 / math.sqrt(d))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,                 # tables, pos
+        grid=(b, nb),
+        in_specs=[
+            pl.BlockSpec((1, qh, d), lambda bb, i, tables, pos: (bb, 0, 0)),
+            pl.BlockSpec((1, bs, kh, d),
+                         lambda bb, i, tables, pos: (tables[bb, i], 0, 0, 0)),
+            pl.BlockSpec((1, bs, kh, d),
+                         lambda bb, i, tables, pos: (tables[bb, i], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, qh, d),
+                               lambda bb, i, tables, pos: (bb, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((kh, g), jnp.float32),       # running max m
+            pltpu.VMEM((kh, g), jnp.float32),       # running sum l
+            pltpu.VMEM((kh, g, d), jnp.float32),    # accumulator
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, qh, d), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")),
+    )(tables, pos, q2, k_pool, v_pool)
+    return out.reshape(b, s, qh, d)

@@ -34,6 +34,21 @@ both ideas TPU-native:
 Everything here is host-side bookkeeping over small python dicts; the
 only device state is the block pool, which the engine's compiled
 programs gather from (prefill) and scatter into (post-prefill insert).
+
+**Unified-pool mode** (``pool=...``): instead of owning its own block
+buffers, the radix store holds refcounted blocks of the engine's
+:class:`~paddle_tpu.serving.kv_cache.PagedKVPool` — the SAME pool the
+slot block tables point into.  Prefix hits become copy-free: the engine
+leases matched blocks straight into a slot's block table
+(``pool.share`` per borrow), and a partial tail match is served
+copy-on-write (``lease.tail_block``/``tail_tokens``: the engine copies
+that one block into the slot's private tail block inside the prefill
+dispatch, then overwrites from offset ``tail_tokens`` on).  Caching new
+content is ``adopt()`` — the radix store takes shared references on the
+slot's freshly written private blocks — so the gather/scatter insert
+path disappears entirely.  ``budget_bytes`` still bounds how many pool
+blocks the store may hold; ``reclaim()`` lets the engine evict unpinned
+leaves back to the free list under block pressure.
 """
 
 from __future__ import annotations
@@ -63,14 +78,24 @@ class PrefixLease:
     tokens (``matched_tokens == len(block_ids) * block_size``).  The
     engine holds the lease for the request's whole slot residency and
     releases it on retirement; ``insert()`` extends it over any blocks
-    newly cached from this request's prefill."""
+    newly cached from this request's prefill.
 
-    __slots__ = ("nodes", "block_ids", "matched_tokens")
+    In unified-pool mode a partial tail match rides along:
+    ``tail_block`` is a cached pool block whose first ``tail_tokens``
+    tokens extend the full-block match (``matched_tokens`` includes
+    them); the engine serves it copy-on-write.  The tail node is pinned
+    in ``nodes`` (so it survives until release) but its block is NOT in
+    ``block_ids`` — it is never leased into a table directly."""
+
+    __slots__ = ("nodes", "block_ids", "matched_tokens", "tail_block",
+                 "tail_tokens")
 
     def __init__(self, nodes, block_size):
         self.nodes = list(nodes)
         self.block_ids = [n.block for n in self.nodes]
         self.matched_tokens = len(self.nodes) * block_size
+        self.tail_block = None
+        self.tail_tokens = 0
 
 
 class PrefixCache:
@@ -84,7 +109,7 @@ class PrefixCache:
     """
 
     def __init__(self, num_layers, block_size, kv_heads, head_dim,
-                 dtype=jnp.float32, budget_bytes=0):
+                 dtype=jnp.float32, budget_bytes=0, pool=None):
         self.num_layers = num_layers
         self.block_size = int(block_size)
         self.kv_heads = kv_heads
@@ -95,11 +120,21 @@ class PrefixCache:
                                 * kv_heads * head_dim * itemsize)
         self.capacity = max(0, int(budget_bytes) // self.bytes_per_block) \
             if self.block_size else 0
-        shape = (self.capacity + 1, max(1, self.block_size), kv_heads,
-                 head_dim)
-        self.pool_k = [jnp.zeros(shape, dtype) for _ in range(num_layers)]
-        self.pool_v = [jnp.zeros(shape, dtype) for _ in range(num_layers)]
-        self._free = list(range(self.capacity, 0, -1))   # ids 1..capacity
+        #: unified-pool mode: hold refcounted blocks of the engine's
+        #: PagedKVPool instead of owning buffers (see module docstring)
+        self.pool = pool
+        self._held = 0               # pool blocks the radix store holds
+        if pool is None:
+            shape = (self.capacity + 1, max(1, self.block_size), kv_heads,
+                     head_dim)
+            self.pool_k = [jnp.zeros(shape, dtype)
+                           for _ in range(num_layers)]
+            self.pool_v = [jnp.zeros(shape, dtype)
+                           for _ in range(num_layers)]
+            self._free = list(range(self.capacity, 0, -1))  # 1..capacity
+        else:
+            self.pool_k = self.pool_v = None
+            self._free = []
         self._root = _Node((), 0, None)
         self._clock = 0
         # counters (engine surfaces them through stats())
@@ -127,21 +162,64 @@ class PrefixCache:
             node = child
         return chain
 
+    def _cow_match(self, tokens, chain):
+        """Unified-mode partial-tail match after the full-block walk:
+        among the children of the last matched node, the one sharing the
+        longest common token prefix with the rest of ``tokens``.
+        Returns ``(node, m)`` with ``0 < m < block_size`` tokens usable
+        copy-on-write, or ``(None, 0)``.  The cap at
+        ``len(tokens) - 1 - matched`` keeps the one-token-to-prefill
+        invariant, and also proves ``m < block_size``: a child matching
+        a WHOLE in-cap block would have been matched by the walk."""
+        if self.pool is None:
+            return None, 0
+        matched = len(chain) * self.block_size
+        node = chain[-1] if chain else self._root
+        rest = tokens[matched:]
+        cap = len(tokens) - 1 - matched
+        best, best_m = None, 0
+        for child in node.children.values():
+            m = 0
+            for a, b in zip(child.tokens, rest):
+                if a != b:
+                    break
+                m += 1
+            m = min(m, cap)
+            if m > best_m:
+                best, best_m = child, m
+        return best, best_m
+
     def lookup(self, tokens):
         """Matched-prefix length in tokens, side-effect free (used for
         admission bucketing; capped at ``len(tokens) - 1`` so a suffix
-        of at least one token always remains to prefill)."""
-        return len(self._walk(tokens, len(tokens) - 1)) * self.block_size
+        of at least one token always remains to prefill).  In unified
+        mode this includes the copy-on-write tail match."""
+        chain = self._walk(tokens, len(tokens) - 1)
+        _, m = self._cow_match(tokens, chain)
+        return len(chain) * self.block_size + m
 
     def acquire(self, tokens):
         """Match + pin: refcount the matched chain and bump its LRU
-        clock.  Returns the lease the engine holds until retirement."""
+        clock.  Returns the lease the engine holds until retirement.
+        In unified mode a partial tail match is pinned too and exposed
+        as ``lease.tail_block``/``tail_tokens`` for the engine's COW
+        copy (the tail node sits in ``lease.nodes`` so it stays alive,
+        but not in ``lease.block_ids`` — it is never leased into a
+        block table directly)."""
         chain = self._walk(tokens, len(tokens) - 1)
         self._clock += 1
         for n in chain:
             n.refcount += 1
             n.last_used = self._clock
         lease = PrefixLease(chain, self.block_size)
+        tail, m = self._cow_match(tokens, chain)
+        if m > 0:
+            tail.refcount += 1
+            tail.last_used = self._clock
+            lease.nodes.append(tail)
+            lease.tail_block = tail.block
+            lease.tail_tokens = m
+            lease.matched_tokens += m
         self.hit_tokens += lease.matched_tokens
         self.miss_tokens += len(tokens) - lease.matched_tokens
         return lease
@@ -166,6 +244,10 @@ class PrefixCache:
         request's freshly prefilled slot row into the pool.  Stops at
         the first block it cannot allocate (deeper blocks would be
         unreachable anyway)."""
+        if self.pool is not None:
+            raise RuntimeError(
+                "insert() is the standalone-pool path; unified-pool "
+                "mode caches via adopt()")
         bs = self.block_size
         if not bs or self.capacity == 0:
             return []
@@ -189,6 +271,63 @@ class PrefixCache:
             child.last_used = self._clock
             node = child
         return new
+
+    # ------------------------------------------------------- unified pool
+    def adopt(self, tokens, lease, block_of):
+        """Unified-mode caching: take shared references on the slot's
+        freshly written private blocks instead of copying anything.
+
+        Called after a prefill dispatch.  ``block_of(i)`` maps full-block
+        index ``i`` of ``tokens`` to the pool block the slot's table
+        points at.  Blocks already cached are skipped (for ``i`` below
+        the lease's full-block match that is guaranteed — those table
+        entries ARE the cached blocks); missing ones get a new radix
+        node holding ``pool.share(block)`` — including a COW tail copy,
+        which after prefill is a complete valid block and lands as a
+        sibling of its source.  New nodes are pinned into ``lease``.
+        Stops when the byte budget is exhausted and nothing is
+        evictable."""
+        bs = self.block_size
+        if self.pool is None:
+            raise RuntimeError("adopt() requires unified-pool mode")
+        if not bs or self.capacity == 0:
+            return 0
+        self._clock += 1
+        node = self._root
+        adopted = 0
+        for i in range(len(tokens) // bs):
+            key = tuple(tokens[i * bs:(i + 1) * bs])
+            child = node.children.get(key)
+            if child is None:
+                if self._held >= self.capacity and self.reclaim(1) == 0:
+                    break
+                block = int(block_of(i))
+                if block == 0:
+                    break            # scratch: slot row ended early
+                self.pool.share(block)
+                self._held += 1
+                child = _Node(key, block, node)
+                node.children[key] = child
+                child.refcount += 1
+                lease.nodes.append(child)
+                adopted += 1
+                self.inserted_blocks += 1
+            child.last_used = self._clock
+            node = child
+        return adopted
+
+    def reclaim(self, n_blocks):
+        """Evict up to ``n_blocks`` LRU unpinned leaves, returning their
+        pool blocks to the engine's free list.  Returns how many were
+        freed (0 when everything live is pinned)."""
+        freed = 0
+        while freed < n_blocks:
+            victim = self._lru_evictable()
+            if victim is None:
+                break
+            self._evict(victim)
+            freed += 1
+        return freed
 
     def _alloc_block(self):
         if self._free:
@@ -216,7 +355,11 @@ class PrefixCache:
 
     def _evict(self, node):
         del node.parent.children[node.tokens]
-        self._free.append(node.block)
+        if self.pool is not None:
+            self.pool.release(node.block)   # back to the engine free list
+            self._held -= 1
+        else:
+            self._free.append(node.block)
         self.evictions += 1
 
     # ------------------------------------------------------------ device
@@ -239,7 +382,8 @@ class PrefixCache:
         return {
             "block_size": self.block_size,
             "capacity_blocks": self.capacity,
-            "used_blocks": self.capacity - len(self._free),
+            "used_blocks": self._held if self.pool is not None
+            else self.capacity - len(self._free),
             "cached_nodes": self._count_nodes(),
             "hit_tokens": self.hit_tokens,
             "miss_tokens": self.miss_tokens,

@@ -190,6 +190,17 @@ class Scheduler:
         req.status = FINISHED
         del self.running[req.slot]
 
+    def requeue_front(self, req):
+        """Preempt a RUNNING request back to the queue head: it gives up
+        its slot (and, in the paged engine, its KV blocks) but keeps its
+        generated tokens, and is first in line to be re-admitted.  The
+        engine re-prefills prompt + generated-so-far on re-admission, so
+        preemption is invisible in the output stream."""
+        del self.running[req.slot]
+        req.status = WAITING
+        req.slot = None
+        self.queue.appendleft(req)
+
     @property
     def queue_depth(self):
         return len(self.queue)

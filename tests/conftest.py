@@ -21,6 +21,15 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy end-to-end parity tests excluded from the tier-1 "
+        "run (-m 'not slow'); the dedicated CI serving jobs run them "
+        "without the filter",
+    )
+
+
 @pytest.fixture(autouse=True)
 def _fresh_seed():
     import paddle_tpu as paddle

@@ -12,10 +12,11 @@ from paddle_tpu.core import tape as _tape
 from paddle_tpu.models import GPTConfig, GPTForCausalLM
 from paddle_tpu.models.llama import LlamaForCausalLM
 from paddle_tpu.serving import (
-    Engine, EngineConfig, PrefixCache, SamplingParams, Scheduler,
-    SlotKV, SlottedKVCache,
+    Engine, EngineConfig, PagedKVCache, PagedKVPool, PrefixCache,
+    SamplingParams, Scheduler, SlotKV, SlottedKVCache,
 )
-from paddle_tpu.serving.kv_cache import visible_mask, write_slots
+from paddle_tpu.serving.kv_cache import paged_write, visible_mask, write_slots
+from paddle_tpu.serving.paged_attention import _xla_paged_attention
 
 TINY = GPTConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
                  num_hidden_layers=2, num_attention_heads=4,
@@ -135,6 +136,7 @@ class TestEngine:
                          max_new_tokens=6, temperature=0)
         assert out == gen.numpy()[0, len(prompt):].tolist()
 
+    @pytest.mark.slow
     def test_continuous_batching_matches_sequential(self):
         """Staggered submits/EOS with mixed sampling params produce the
         SAME tokens as one-request-at-a-time generation: a request's
@@ -186,7 +188,7 @@ class TestEngine:
             eng.submit(p, SamplingParams(max_new_tokens=4))
         eng.run()
         s = eng.stats()
-        assert s["decode_compiles"] == len(s["horizon_buckets"])
+        assert s["decode_compiles"] == len(s["decode_buckets"])
         # dispatch shapes: (2 lanes, 4), (1, 8) twice, (1, 16)
         assert s["prefill_compiles"] == 3
         assert s["prefill_calls"] == 4       # first two share ONE dispatch
@@ -305,6 +307,7 @@ class TestHorizonDecode:
             outs.append(e.generate(p, s))
         return outs
 
+    @pytest.mark.slow
     def test_horizon8_bitwise_equals_horizon1_and_sequential(self):
         m = _model()
         seq = self._sequential(m, self.MIXED_PROMPTS, self.MIXED_SAMP)
@@ -403,6 +406,7 @@ class TestHorizonDecode:
         assert c["requests_finished"] == 2
         assert eng.cache.free_slots == 1
 
+    @pytest.mark.slow
     def test_staggered_admission_with_horizons(self):
         """Requests joining at horizon boundaries mid-stream reproduce
         sequential generation bitwise (continuous batching preserved)."""
@@ -421,7 +425,11 @@ class TestHorizonDecode:
 
     def test_one_compile_per_horizon_bucket(self):
         """Forced horizon sequence 1,8,8,4,2,8: exactly one compile per
-        distinct bucket {1,2,4,8}, cache hits for every repeat."""
+        distinct (horizon, table-width) bucket, cache hits for every
+        repeat.  Ragged paged attention re-buckets the static table
+        width nb as the sequence grows (block_size 16, so nb steps
+        1 -> 2 -> 4 here), so the compile key is the PAIR — the repeated
+        8s land on different nb and are real compiles, not hits."""
         m = _model()
         eng = Engine(m, EngineConfig(num_slots=1, max_seq_len=64,
                                      max_horizon=8),
@@ -433,9 +441,12 @@ class TestHorizonDecode:
         assert not eng.scheduler.has_work
         s = eng.stats()
         assert s["horizon_buckets"] == [1, 2, 4, 8]
-        assert s["decode_compiles"] == 4
+        assert s["decode_buckets"] == [(1, 1), (2, 2), (4, 2),
+                                       (8, 1), (8, 2), (8, 4)]
+        assert s["decode_compiles"] == len(s["decode_buckets"])
         assert s["decode_horizons"] == 6
-        assert s["decode_cache_hits"] == 2          # the repeated 8s
+        assert s["decode_cache_hits"] == \
+            s["decode_horizons"] - s["decode_compiles"]
         assert s["decode_host_syncs"] == 6
         # 25 decode tokens out of 1+8+8+4+2+8=31 scanned lane steps
         assert s["tokens_generated"] == 26
@@ -597,6 +608,7 @@ class TestPrefixReuse:
             outs.append(e.generate(p, s))
         return outs
 
+    @pytest.mark.slow
     def test_shared_prefix_parity_on_off_sequential(self):
         """Warm-cache suffix prefill == cache-off prefill == one-at-a-
         time generation, bitwise, with hit/miss lanes co-batched."""
@@ -645,12 +657,16 @@ class TestPrefixReuse:
         again = eng.submit(a, sp)
         eng.run()
         assert again.output_ids == seq[0]          # exact-hit resubmit
-        assert again.prefix_hit_tokens == 8        # capped below len(a)
+        # 2 full-block leases (8) + a 3-token copy-on-write tail match
+        # against cached block 3, capped at len(a) - 1 = 11
+        assert again.prefix_hit_tokens == 11
         mid = eng.submit(b, sp)
         eng.run()
         assert mid.output_ids == seq[1]
-        assert mid.prefix_hit_tokens == 8          # match block-aligned
+        # 8 leased + COW tail: [5, 6] of cached [5, 6, 7, 1] matches
+        assert mid.prefix_hit_tokens == 10
 
+    @pytest.mark.slow
     def test_same_bucket_batch_is_one_dispatch(self):
         """The dispatch-count probe: N co-bucketed admissions prefill in
         ONE compiled call (plus at most one block-insert scatter)."""
@@ -752,3 +768,214 @@ class TestPopBatch:
         assert s.pop_batch(2, bucket_of=self._bucket) == reqs[:2]
         assert s.pop_batch(0, bucket_of=self._bucket) == []
         assert s.pop_batch(4) == [reqs[2]]         # bucket_of=None: FIFO
+
+class TestPagedPool:
+    """Unified-pool host bookkeeping: refcounted blocks, the reserved
+    scratch block 0, lazy table growth, and write routing."""
+
+    @staticmethod
+    def _pool(num_blocks=6, bs=4):
+        return PagedKVPool(num_layers=1, num_blocks=num_blocks,
+                           block_size=bs, kv_heads=1, head_dim=2)
+
+    def test_refcounts_and_scratch_block(self):
+        p = self._pool()
+        assert p.capacity == 5 and p.free_blocks == 5
+        a = p.alloc()
+        assert a != 0                              # scratch never handed out
+        assert p.refcount(a) == 1 and p.blocks_in_use == 1
+        p.share(a)
+        assert p.refcount(a) == 2
+        p.release(a)
+        assert p.blocks_in_use == 1                # still one ref held
+        p.release(a)
+        assert p.blocks_in_use == 0 and p.free_blocks == 5
+        with pytest.raises(ValueError):
+            p.release(a)                           # over-release is a bug
+        p.release(0)                               # scratch release: no-op
+        assert p.refcount(0) == 1
+
+    def test_pool_exhaustion_returns_none(self):
+        p = self._pool(num_blocks=3)
+        assert p.alloc() is not None and p.alloc() is not None
+        assert p.alloc() is None                   # dry, not an exception
+
+    def test_cache_lazy_growth_and_release(self):
+        c = PagedKVCache(num_layers=1, num_slots=2, max_seq_len=16,
+                         block_size=4, kv_heads=1, head_dim=2)
+        s = c.alloc()
+        assert c.ensure_blocks(s, 5)               # 5 tokens -> 2 blocks
+        row = c.tables[s]
+        assert (row[:2] > 0).all() and (row[2:] == 0).all()
+        assert c.pool.blocks_in_use == 2
+        assert c.ensure_blocks(s, 6)               # same need: no growth
+        assert c.pool.blocks_in_use == 2
+        c.release_slot_blocks(s)
+        assert (c.tables[s] == 0).all()
+        assert c.pool.blocks_in_use == 0
+        c.free(s)
+
+    def test_lease_block_shares_refcount(self):
+        c = PagedKVCache(num_layers=1, num_slots=2, max_seq_len=16,
+                         block_size=4, kv_heads=1, head_dim=2)
+        donor = c.pool.alloc()                     # e.g. a prefix block
+        s = c.alloc()
+        c.lease_block(s, 0, donor)
+        assert c.pool.refcount(donor) == 2 and c.leased_blocks == 1
+        c.release_slot_blocks(s)
+        assert c.pool.refcount(donor) == 1         # table ref dropped...
+        c.pool.release(donor)                      # ...owner ref remains
+
+    def test_paged_write_roundtrip_and_scratch_clip(self):
+        bs, kh, d = 4, 1, 2
+        pool = jnp.zeros((4, bs, kh, d), jnp.float32)
+        tables = jnp.array([[1, 2]], jnp.int32)    # one lane, two blocks
+        new = jnp.arange(2 * kh * d, dtype=jnp.float32).reshape(1, 2, kh, d)
+        # write 2 tokens straddling the block boundary (pos 3, 4)
+        out = np.asarray(paged_write(pool, new, tables, jnp.array([3])))
+        assert (out[1, 3] == new[0, 0]).all()      # block 1, offset 3
+        assert (out[2, 0] == new[0, 1]).all()      # block 2, offset 0
+        # out-of-table positions route to scratch block 0, real blocks
+        # untouched (this is what makes bench overflow writes harmless)
+        far = np.asarray(paged_write(pool, new, tables, jnp.array([8])))
+        assert (far[1:] == 0).all()
+
+
+class TestPagedAttention:
+    """The XLA fallback is the parity reference: bitwise-invariant to
+    the static table width and equal to dense softmax attention."""
+
+    @staticmethod
+    def _case(b=2, s=1, qh=4, kh=2, d=8, bs=4, nb=3, seed=0):
+        r = np.random.RandomState(seed)
+        q = jnp.asarray(r.randn(b, s, qh, d).astype(np.float32))
+        num_blocks = 1 + b * nb
+        k = jnp.asarray(r.randn(num_blocks, bs, kh, d).astype(np.float32))
+        v = jnp.asarray(r.randn(num_blocks, bs, kh, d).astype(np.float32))
+        tables = jnp.asarray(
+            1 + np.arange(b * nb, dtype=np.int32).reshape(b, nb))
+        pos = jnp.asarray(np.array([5, 9], np.int32)[:b])
+        return q, k, v, tables, pos
+
+    def test_bitwise_invariant_to_table_width(self):
+        """Padding the table with scratch columns must not change ONE
+        bit of the output — this is what lets the engine re-bucket nb
+        as sequences grow without breaking decode determinism."""
+        q, k, v, tables, pos = self._case()
+        out = np.asarray(_xla_paged_attention(q, k, v, tables, pos))
+        for pad in (1, 3, 8):
+            wide = jnp.concatenate(
+                [tables, jnp.zeros((tables.shape[0], pad), jnp.int32)],
+                axis=1)
+            out_w = np.asarray(_xla_paged_attention(q, k, v, wide, pos))
+            np.testing.assert_array_equal(out, out_w)
+
+    def test_matches_dense_attention(self):
+        q, k, v, tables, pos = self._case(s=1)
+        b, s, qh, d = q.shape
+        bs, kh = k.shape[1], k.shape[2]
+        g = qh // kh
+        out = np.asarray(_xla_paged_attention(q, k, v, tables, pos))
+        kn, vn, tn, pn = (np.asarray(x) for x in (k, v, tables, pos))
+        for i in range(b):
+            keys = kn[tn[i]].reshape(-1, kh, d)[:pn[i] + 1]   # [T, KH, D]
+            vals = vn[tn[i]].reshape(-1, kh, d)[:pn[i] + 1]
+            for h in range(qh):
+                qv = np.asarray(q)[i, 0, h] / np.sqrt(d)
+                sc = keys[:, h // g] @ qv
+                w = np.exp(sc - sc.max())
+                w /= w.sum()
+                ref = w @ vals[:, h // g]
+                np.testing.assert_allclose(out[i, 0, h], ref, atol=1e-5)
+
+    def test_multi_token_prefill_is_causal(self):
+        """s > 1 (prefill): each query row attends to keys <= its own
+        position only; row s-1 must equal a fresh s=1 decode query."""
+        q, k, v, tables, pos = self._case(s=3)
+        pos0 = pos - 2                             # 3 queries end at pos
+        out = np.asarray(_xla_paged_attention(q, k, v, tables, pos0))
+        last = np.asarray(_xla_paged_attention(
+            q[:, 2:], k, v, tables, pos0 + 2))
+        np.testing.assert_array_equal(out[:, 2:], last)
+
+
+class TestPreemptionSwap:
+    """Preempt-and-resume: an idle lane's blocks are released, the
+    request requeues at the FRONT, and re-admission (re-prefill of
+    prompt + generated-so-far) reproduces its stream bitwise."""
+
+    @staticmethod
+    def _cfg(**kw):
+        kw.setdefault("num_slots", 2)
+        kw.setdefault("max_seq_len", 32)
+        kw.setdefault("max_horizon", 4)
+        kw.setdefault("prefix_block_size", 4)
+        kw.setdefault("prefix_cache_bytes", 0)     # isolate pool effects
+        return EngineConfig(**kw)
+
+    @classmethod
+    def _sequential(cls, m, prompts, samp):
+        return [Engine(m, cls._cfg(num_slots=1), register_profiler=False)
+                .generate(p, s) for p, s in zip(prompts, samp)]
+
+    @pytest.mark.slow
+    def test_explicit_preempt_resume_parity(self):
+        m = _model()
+        prompts = [[3, 1, 4, 1, 5], [9, 2, 6]]
+        samp = [SamplingParams(max_new_tokens=10),
+                SamplingParams(temperature=0.8, top_k=20, seed=11,
+                               max_new_tokens=10)]
+        seq = self._sequential(m, prompts, samp)
+        eng = Engine(m, self._cfg(), register_profiler=False)
+        reqs = [eng.submit(p, s) for p, s in zip(prompts, samp)]
+        eng.step(horizon=2)                        # both lanes decoding
+        victim = reqs[1]
+        held = int(np.count_nonzero(eng.cache.tables[victim.slot]))
+        assert held > 0
+        before = eng.pool.blocks_in_use
+        eng.preempt(victim)
+        assert victim.status == "waiting" and victim.slot is None
+        assert eng.scheduler.queue[0] is victim    # front of the queue
+        assert eng.pool.blocks_in_use == before - held
+        eng.run()                                  # re-admit + finish
+        assert [r.output_ids for r in reqs] == seq
+        assert eng.counters()["preemptions"] == 1
+        assert eng.pool.blocks_in_use == 0         # nothing leaked
+
+    @pytest.mark.slow
+    def test_auto_preempt_under_block_pressure(self):
+        """An explicitly undersized pool: decode growth runs the pool
+        dry, the engine preempts the youngest lane, and every request
+        still finishes with sequential parity."""
+        m = _model()
+        prompts = [[7, 3, 9, 1, 4, 4, 2, 8], [5, 6, 7, 8, 9, 1, 2, 3]]
+        samp = [SamplingParams(max_new_tokens=12) for _ in prompts]
+        seq = self._sequential(m, prompts, samp)
+        # capacity 7 blocks of 4: both admit (2+2) but cannot both grow
+        # to 20 tokens (5+5)
+        eng = Engine(m, self._cfg(kv_pool_blocks=8),
+                     register_profiler=False)
+        reqs = [eng.submit(p, s) for p, s in zip(prompts, samp)]
+        eng.run()
+        assert [r.output_ids for r in reqs] == seq
+        assert eng.counters()["preemptions"] >= 1
+        assert eng.pool.blocks_in_use == 0
+
+    def test_block_leak_invariant(self):
+        """After every request retires: zero leased table entries, and
+        the only live blocks are the prefix cache's (none when it's
+        off).  This is the CI smoke invariant."""
+        m = _model()
+        prompts = [[1, 2, 3, 4, 5], [1, 2, 3, 4, 5, 6, 7], [9, 9]]
+        for bs, budget in ((4, 0), (4, 1 << 20)):
+            eng = Engine(m, self._cfg(num_slots=2, prefix_block_size=bs,
+                                      prefix_cache_bytes=budget),
+                         register_profiler=False)
+            for p in prompts:
+                eng.submit(p, SamplingParams(max_new_tokens=4))
+            eng.run()
+            s = eng.stats()["kv_pool"]
+            assert s["leased_blocks"] == 0
+            assert s["blocks_in_use"] == s["cached_blocks"]
+            if budget == 0:
+                assert s["blocks_in_use"] == 0
