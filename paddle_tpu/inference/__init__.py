@@ -149,7 +149,14 @@ def create_llm_engine(model, **config_kwargs):
     that reuses cached prompt blocks instead of recomputing them, 0
     block size disables; reorder_window — how far admission may
     co-bucket queued requests into one batched prefill dispatch without
-    starving FIFO order)."""
+    starving FIFO order; spec_k — speculative decoding draft width:
+    each decode step self-drafts up to ``spec_k`` tokens per lane from
+    an n-gram lookup over the lane's own history and verifies all
+    ``spec_k + 1`` positions in one forward, emitting every accepted
+    token — outputs stay bitwise-equal to ``spec_k=0``, 0 disables;
+    spec_adaptive — per-lane acceptance-rate gating that stops drafting
+    for lanes where speculation is not paying, so incompressible
+    streams keep plain-decode throughput)."""
     from ..serving import Engine, EngineConfig
 
     return Engine(model, EngineConfig(**config_kwargs))

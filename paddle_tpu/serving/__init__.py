@@ -44,7 +44,17 @@ around three ideas the benches point at (DECODE_BENCH.json):
   inside the scan.  An adaptive policy shrinks the horizon to 1 while
   requests are queued and grows it toward ``EngineConfig.max_horizon``
   when the slot mix is stable.  ``fold_in(seed, n_generated)`` PRNG
-  keeps every horizon bitwise-equal to per-step decode.
+  keeps every horizon bitwise-equal to per-step decode;
+* **self-drafting speculative decode** (drafter.py + engine.py) — with
+  ``EngineConfig.spec_k = K > 0`` each fused step verifies a
+  ``K+1``-token window per lane: a traced prompt-lookup drafter
+  proposes K tokens from the lane's own history, one forward scores
+  all K+1 positions through the same ragged paged-attention path, and
+  the lane emits the longest matching draft prefix plus the model's
+  own next token — 1..K+1 tokens per forward, greedy and seeded
+  output bitwise-equal to ``spec_k=0``.  ``spec_adaptive`` gates
+  low-acceptance lanes off and shrinks the dispatch back to plain
+  decode when nobody's drafts are landing.
 
 Quick start::
 
@@ -63,6 +73,7 @@ Counters (queue depth, TTFT, tokens/s, slot utilization, compile-cache
 hits) are exposed through ``paddle_tpu.profiler.counters()``.
 """
 
+from .drafter import draft_tokens
 from .engine import CompiledFn, Engine, EngineConfig
 from .kv_cache import (PagedKV, PagedKVCache, PagedKVPool, SlotKV,
                        SlottedKVCache)
@@ -77,4 +88,5 @@ __all__ = [
     "SlotKV", "SlottedKVCache",
     "PrefixCache", "PrefixLease",
     "SamplingParams", "Request", "Scheduler",
+    "draft_tokens",
 ]

@@ -1,0 +1,87 @@
+"""Self-drafting token proposal for speculative decoding.
+
+Prompt-lookup / n-gram drafting: a lane's best guess for its next K
+tokens is whatever followed the LAST earlier occurrence of its current
+``ngram``-token suffix in its own prompt+output history.  No second
+model, no host round-trip — the history already lives on device (the
+engine carries a ``[num_slots, max_seq_len]`` token buffer through the
+decode scan), and the matcher is a pure gather/compare, so it traces
+straight into the compiled decode program.
+
+The drafter is allowed to be wrong: rejected draft positions cost one
+wasted lane-column of the verify forward and nothing else (the engine's
+acceptance rule only ever emits tokens the model itself would have
+produced, and rejected-position KV writes are overwritten before they
+can be read — see engine.py).  It is therefore deliberately simple and
+cheap; the only contract is the **sentinel**: a position with no valid
+proposal must return ``-1``, which can never equal a sampled token id,
+so invalid drafts are never accepted.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def draft_tokens(hist, lengths, k, ngram=2):
+    """Propose up to ``k`` draft tokens per lane by suffix matching.
+
+    hist [N, S] int32   per-lane token history; positions ``< lengths``
+                        are valid (prompt followed by emitted tokens)
+    lengths [N] int32   valid history length per lane (``pos + 1`` in
+                        engine terms: prompt plus tokens sampled so far)
+    k                   static draft width (>= 1)
+    ngram               static suffix length to match (>= 1)
+
+    Returns [N, k] int32 draft ids, ``-1`` where no proposal exists
+    (history shorter than ``ngram + 1``, no earlier occurrence of the
+    suffix, or the continuation would run past the valid history).
+
+    Matching prefers the occurrence with the most RUNWAY — known history
+    after the match to draft from, capped at ``k`` — and breaks runway
+    ties by recency.  (Pure recency would pick the match closest to the
+    end of history, which for a cyclic stream is the one with nothing
+    after it to copy: drafts would cap at 1 useful token however large
+    ``k`` is.)  Everything is fixed-shape: the window compare is an
+    [S-ngram+1, ngram] gather and the winner an argmax, so the whole
+    proposal compiles into the decode scan body.
+    """
+    if k < 1:
+        raise ValueError(f"draft width k must be >= 1, got {k}")
+    if ngram < 1:
+        raise ValueError(f"ngram must be >= 1, got {ngram}")
+    n, s = hist.shape
+    if s < ngram + 1:
+        return jnp.full((n, k), -1, jnp.int32)
+
+    starts = jnp.arange(s - ngram + 1, dtype=jnp.int32)
+    offs = jnp.arange(ngram, dtype=jnp.int32)
+    ks = jnp.arange(k, dtype=jnp.int32)
+
+    def one(row, length):
+        # the lane's current trailing ngram (clamped start keeps the
+        # slice in bounds; short histories are rejected by `enough`)
+        g0 = jnp.maximum(length - ngram, 0)
+        g = jax.lax.dynamic_slice(row, (g0,), (ngram,))
+        # every candidate window hist[j : j+ngram], compared at once
+        win = row[starts[:, None] + offs[None, :]]        # [S-n+1, ngram]
+        hit = jnp.all(win == g[None, :], axis=1)
+        # a usable match must END strictly before the last valid token
+        # so at least one continuation token is known history (this
+        # also excludes the trailing window matching itself)
+        hit &= (starts + ngram) <= (length - 1)
+        enough = length >= (ngram + 1)
+        # rank matches by runway (continuation tokens inside known
+        # history, capped at k), then by recency; encode as
+        # runway * (S+1) + start so one argmax resolves both
+        runway = jnp.clip(length - (starts + ngram), 0, k)
+        score = jnp.where(hit & enough, runway * (s + 1) + starts, -1)
+        top = jnp.max(score)
+        best = jnp.where(top >= 0, top % (s + 1), -1)
+        cont = best + ngram + ks                          # continuation idx
+        cand = row[jnp.clip(cont, 0, s - 1)]
+        valid = (best >= 0) & (cont < length)
+        return jnp.where(valid, cand, -1).astype(jnp.int32)
+
+    return jax.vmap(one)(hist, lengths)

@@ -54,6 +54,24 @@ Architecture (prefill/decode split over ONE paged KV block pool):
   updated inside the compiled program; the host re-uploads it only when
   admission changes it (dirty flag), never per step.  Host mirrors are
   maintained from the harvested tokens alone — no extra device reads.
+* **Self-drafting speculative decode** — with ``spec_k > 0`` every
+  fused step verifies a ``K+1``-token window per lane instead of one
+  token: a traced prompt-lookup drafter (``drafter.py``) proposes K
+  continuation tokens from the lane's own device-resident token history,
+  the model scores all K+1 positions in ONE forward (the verify step is
+  a short ragged prefill through the same paged-attention kernel), and
+  the lane emits the longest draft prefix whose sampled tokens match,
+  plus the model's own next token — 1..K+1 tokens per forward.  Token k
+  of a request is ALWAYS sampled from position k's logits under
+  ``fold_in(seed, k)``, so greedy and seeded-sampled outputs stay
+  bitwise-equal to sequential ``generate()`` for every K.  Rejected
+  draft positions write garbage KV at ``pos+n..pos+K`` — but the next
+  step's window writes at ``pos' = pos+n`` BEFORE any read reaches
+  those positions (write-before-attend), so the garbage is dead on
+  arrival.  ``K`` is a static compile bucket like ``horizon``
+  (``decode_buckets`` becomes ``(horizon, nb, K)`` triples) and an
+  adaptive policy shrinks the dispatch to K=0 (plain decode) when no
+  running lane's recent acceptance EMA clears ``spec_accept_floor``.
 * **Continuous batching + preemption** — requests join at horizon
   boundaries and release their blocks on EOS/max-tokens; an adaptive
   policy shrinks the horizon toward 1 when the queue is non-empty or a
@@ -90,9 +108,11 @@ from ..core.tensor import Tensor
 from ..observability import events as _obs_events
 from ..observability import metrics as _obs_metrics
 from ..observability.span import span as _obs_span
+from .drafter import draft_tokens
 from .kv_cache import PagedKV, PagedKVCache
 from .prefix_cache import PrefixCache
-from .sampling import SamplingParams, request_key, sample_batch, sample_token
+from .sampling import (SamplingParams, request_key, sample_token,
+                       sample_window)
 from .scheduler import Scheduler
 
 # typed registry families the engine publishes into (labeled by engine
@@ -145,6 +165,19 @@ _SRV_KV_BYTES = _obs_metrics.counter(
 _SRV_PREEMPTIONS = _obs_metrics.counter(
     "serving.preemptions",
     "running requests swapped out under KV block pressure")
+_SRV_SPEC_ACCEPT = _obs_metrics.histogram(
+    "serving.spec_accept_len",
+    "tokens emitted per speculative verify window (accepted prefix + 1)",
+    buckets=(1, 2, 3, 4, 5, 6, 8, 12, 17))
+_SRV_SPEC_DRAFTED = _obs_metrics.counter(
+    "serving.spec_draft_tokens",
+    "draft tokens proposed to the verify forward")
+_SRV_SPEC_ACCEPTED = _obs_metrics.counter(
+    "serving.spec_accepted_tokens",
+    "draft tokens whose sampled verification matched")
+_SRV_SPEC_RATE = _obs_metrics.gauge(
+    "serving.spec_accept_rate",
+    "cumulative accepted / drafted speculative tokens")
 # compile/cache families SHARED with jit/api.py: one place answers
 # "which function retraced" for both to_static and serving programs
 _COMPILE_COUNT = _obs_metrics.counter(
@@ -249,6 +282,23 @@ class EngineConfig:
     #: max_blocks_per_slot — the slotted-bandwidth ablation knob
     #: (benchmarks/bench_decode.py measures both).
     ragged_attention: bool = True
+    #: speculative decoding: max draft tokens per lane per fused step.
+    #: 0 = plain decode.  K > 0 self-drafts K tokens per lane from its
+    #: prompt+output history (prompt-lookup n-gram matching, traced into
+    #: the decode program), verifies all K+1 positions in one forward,
+    #: and emits the longest matching prefix plus one — greedy and
+    #: seeded-sampled output stays bitwise-equal to spec_k=0.
+    spec_k: int = 0
+    #: shrink the dispatch draft width to 0 (plain decode) when no
+    #: running lane's recent acceptance EMA clears spec_accept_floor;
+    #: lanes below the floor are also gated off inside a K-wide dispatch
+    #: (they draft nothing and emit exactly one token per step)
+    spec_adaptive: bool = True
+    #: trailing-suffix length the self-drafter matches on
+    spec_ngram: int = 2
+    #: per-lane acceptance-rate floor (EMA of accepted/K per verify
+    #: window) below which adaptive drafting turns off for that lane
+    spec_accept_floor: float = 0.125
 
 
 class Engine:
@@ -321,9 +371,18 @@ class Engine:
         self._eos_ids = np.full(n, -1, np.int32)    # -1 = no EOS token
         self._limits = np.zeros(n, np.int32)        # max_new_tokens
         self._active = np.zeros(n, bool)
+        # speculative-decode state: the per-lane token history (prompt +
+        # emitted tokens — the drafter's corpus; device copy rides the
+        # scan carry), the per-lane acceptance EMA, and the draft gates
+        # the adaptive policy feeds into the compiled program
+        self._hist = np.zeros((n, self.config.max_seq_len), np.int32)
+        self._spec_ema = np.ones(n, np.float32)
+        self._spec_gates = np.ones(n, bool)
         self._state_dirty = True
         self._d_tokens = self._d_pos = self._d_counts = None
         self._d_active = None
+        self._d_hist = None
+        self._d_gates = None
         self._d_params = None
         # device copy of the live block-table prefix ([num_slots, nb]);
         # re-uploaded when the host tables dirty or nb re-buckets
@@ -335,8 +394,8 @@ class Engine:
         donate = jax.default_backend() not in ("cpu",)
         self._decode = CompiledFn(
             self._decode_fn,
-            donate_argnums=(1, 2, 3, 4, 12, 13) if donate else (),
-            static_argnums=(14,), name="serving.decode")
+            donate_argnums=(1, 2, 3, 4, 5, 14, 15) if donate else (),
+            static_argnums=(16, 17), name="serving.decode")
         self._prefill = CompiledFn(self._prefill_fn,
                                    donate_argnums=(8, 9) if donate else (),
                                    name="serving.prefill")
@@ -349,7 +408,11 @@ class Engine:
         self._wasted_lane_tokens = 0
         self._horizon_buckets = set()
         self._grow = 1                   # adaptive-horizon growth state
-        self._decode_buckets = set()     # compiled (horizon, nb) pairs
+        self._decode_buckets = set()     # compiled (horizon, nb, K)
+        self._spec_draft_tokens = 0
+        self._spec_accepted_tokens = 0
+        self._spec_windows = 0           # verify windows of drafting lanes
+        self._spec_accept_hist = {}      # tokens-emitted-per-window -> n
         self._kv_bytes_read = 0
         self._cow_copies = 0
         self._preemptions = 0
@@ -450,45 +513,93 @@ class Engine:
         return (first, [nv.k for nv in new_views],
                 [nv.v for nv in new_views])
 
-    def _decode_fn(self, state_arrays, tokens, pos, counts, active,
-                   seeds, temps, top_ks, top_ps, eos_ids, limits,
-                   tables, pool_k, pool_v, horizon):
+    def _decode_fn(self, state_arrays, tokens, pos, counts, active, hist,
+                   gates, seeds, temps, top_ks, top_ps, eos_ids, limits,
+                   tables, pool_k, pool_v, horizon, k_draft):
         """The horizon-scanned fused decode: ``lax.scan`` over ``horizon``
         fused steps, all slots, static shapes everywhere — the pool is
         the scan carry (donated on accelerators, so writes are in-place
         HBM updates) and the block tables are loop-invariant (block
-        coverage for the whole horizon is ensured before dispatch).
-        Retirement is detected inside the scan — a lane whose sampled
-        token hits its EOS id or exhausts its token budget freezes
+        coverage for the whole horizon's write window is ensured before
+        dispatch).  Retirement is detected inside the scan — a lane that
+        hits its EOS id or exhausts its token budget freezes
         (``pos``/``counts`` stop advancing, its carried token stops
         changing) and harvests ``-1`` from then on.  Frozen lanes still
         run the model: their writes land at a frozen position of a
         still-held block (or in scratch once the row is zeroed), which
-        the masking contract makes invisible.  ``horizon`` is static and
+        the masking contract makes invisible.
+
+        With ``k_draft > 0`` every step is a draft-and-verify window of
+        ``W = k_draft + 1`` positions: the traced drafter proposes K
+        continuation tokens from the lane's history buffer (``-1`` where
+        it has no proposal, which no sampled token can equal), ONE
+        forward scores all W positions through the paged path (the
+        verify is a W-token ragged prefill against the lane's block
+        table), and position j is sampled under ``fold_in(seed, cnt+j)``
+        — the exact key and logits sequential decode would use for that
+        token, PROVIDED the draft prefix before it matched.  The lane
+        emits positions ``0..n_acc`` where ``n_acc`` is the longest
+        draft prefix whose sampled verification matched, truncated at
+        the first EOS/budget stop; unemitted positions harvest ``-1``.
+        Rejected-position KV is garbage, but the next step writes at
+        ``pos + n_emit`` onward before anything reads there, so it is
+        never observed.  ``horizon`` and ``k_draft`` are static and
         ``nb = tables.shape[1]`` re-buckets by shape: one compiled
-        program per (horizon, nb) pair."""
+        program per (horizon, nb, K) triple."""
+        n, s = hist.shape
+        lanes = jnp.arange(n)[:, None]
+        j_idx = jnp.arange(k_draft + 1, dtype=counts.dtype)[None, :]
 
         def body(carry, _):
-            tok, p, cnt, act, pk, pv = carry
+            tok, p, cnt, act, hb, pk, pv = carry
+            if k_draft:
+                drafts = draft_tokens(hb, p + 1, k_draft,
+                                      self.config.spec_ngram)
+                drafts = jnp.where(gates[:, None], drafts, -1)
+                ids = jnp.concatenate(
+                    [tok[:, None], jnp.maximum(drafts, 0)], axis=1)
+            else:
+                ids = tok[:, None]
             views = [PagedKV(k, v, tables, p) for k, v in zip(pk, pv)]
-            logits, new_views = self._run_model(state_arrays, tok[:, None],
-                                                views)
-            nxt = sample_batch(logits[:, 0], seeds, cnt, temps, top_ks,
-                               top_ps)
-            nxt = jnp.where(act, nxt, tok)
-            new_cnt = jnp.where(act, cnt + 1, cnt)
-            new_p = jnp.where(act, p + 1, p)
-            done = act & ((nxt == eos_ids) | (new_cnt >= limits))
-            harvest = jnp.where(act, nxt, -1)
-            return ((nxt, new_p, new_cnt, act & ~done,
+            logits, new_views = self._run_model(state_arrays, ids, views)
+            e = sample_window(logits, seeds, cnt, temps, top_ks, top_ps)
+            if k_draft:
+                chain = jnp.cumprod(
+                    (drafts == e[:, :k_draft]).astype(jnp.int32), axis=1)
+                n_acc = jnp.sum(chain, axis=1)
+            else:
+                n_acc = jnp.zeros_like(cnt)
+            # emit the accepted prefix plus the bonus token, truncated
+            # at the first position that retires the lane (EOS or
+            # budget) — positions past a stop must not be emitted
+            stop = (e == eos_ids[:, None]) | \
+                   (cnt[:, None] + j_idx + 1 >= limits[:, None])
+            keep = jnp.cumprod(1 - stop.astype(jnp.int32), axis=1)
+            prev_ok = jnp.concatenate(
+                [jnp.ones_like(keep[:, :1]), keep[:, :-1]], axis=1)
+            emitted = (j_idx <= n_acc[:, None]) & (prev_ok > 0) \
+                & act[:, None]
+            n_emit = jnp.sum(emitted.astype(cnt.dtype), axis=1)
+            done = act & jnp.any(emitted & stop, axis=1)
+            last = jnp.take_along_axis(
+                e, jnp.maximum(n_emit - 1, 0)[:, None], axis=1)[:, 0]
+            nxt = jnp.where(act, last, tok)
+            new_cnt = cnt + n_emit       # n_emit is 0 for frozen lanes
+            new_p = p + n_emit
+            # append the emitted tokens to the history buffer (column S
+            # is the drop target for unemitted positions)
+            cols = jnp.where(emitted, p[:, None] + 1 + j_idx, s)
+            hb = hb.at[lanes, cols].set(e, mode="drop")
+            harvest = jnp.where(emitted, e, -1)
+            return ((nxt, new_p, new_cnt, act & ~done, hb,
                      tuple(v.k for v in new_views),
                      tuple(v.v for v in new_views)), harvest)
 
-        init = (tokens, pos, counts, active,
+        init = (tokens, pos, counts, active, hist,
                 tuple(pool_k), tuple(pool_v))
-        (tok, p, cnt, act, pk, pv), toks = jax.lax.scan(
+        (tok, p, cnt, act, hb, pk, pv), toks = jax.lax.scan(
             body, init, None, length=horizon)
-        return (tok, p, cnt, act), list(pk), list(pv), toks
+        return (tok, p, cnt, act, hb), list(pk), list(pv), toks
 
     # ------------------------------------------------------------ buckets
     def _bucket(self, prompt_len):
@@ -545,21 +656,39 @@ class Engine:
     def _pow2_ceil(x):
         return 1 << max(0, int(x) - 1).bit_length()
 
-    def _attn_blocks(self, h):
+    def _attn_blocks(self, h, w=1):
         """The decode program's static block-table width ``nb`` for an
-        ``h``-step horizon: enough entries to cover the deepest live
-        row's write window, bucketed to a power of two and clamped to
-        ``max_blocks_per_slot``.  With ``ragged_attention=False`` it
-        pins to the full width (the every-step-reads-everything slotted
-        ablation).  Attention output is bitwise-invariant to ``nb``
-        (see paged_attention.py), so re-bucketing never perturbs a
-        token — it only changes how many blocks each step reads."""
+        ``h``-step horizon of ``w``-position verify windows: enough
+        entries to cover the deepest live row's write window (up to
+        ``h*w`` new positions when every draft is accepted), bucketed to
+        a power of two and clamped to ``max_blocks_per_slot``.  With
+        ``ragged_attention=False`` it pins to the full width (the
+        every-step-reads-everything slotted ablation).  Attention output
+        is bitwise-invariant to ``nb`` (see paged_attention.py), so
+        re-bucketing never perturbs a token — it only changes how many
+        blocks each step reads."""
         if not self.config.ragged_attention:
             return self._max_blocks
         mx = max((int(self._pos[s]) for s in self.scheduler.running),
                  default=0)
-        need = -(-(mx + h) // self._block_size)
+        need = -(-(mx + h * w) // self._block_size)
         return min(self._max_blocks, max(1, self._pow2_ceil(need)))
+
+    def _resolve_spec_k(self):
+        """The draft width for the next decode dispatch.  ``spec_k`` is
+        a static compile bucket (like horizon), so the adaptive choice
+        is dispatch-level: drafting stays on while ANY running lane's
+        acceptance EMA clears the floor — below-floor lanes are gated
+        off INSIDE the K-wide program (they draft nothing and emit one
+        token per step, i.e. plain decode), and once every lane is
+        below the floor the dispatch itself shrinks to K=0 so the
+        verify window costs nothing at all."""
+        k = max(0, int(self.config.spec_k))
+        if not k or not self.config.spec_adaptive:
+            return k
+        if any(self._spec_gates[s] for s in self.scheduler.running):
+            return k
+        return 0
 
     def _resolve_horizon(self, requested=None):
         """Pick the horizon bucket for the next decode dispatch.
@@ -787,6 +916,15 @@ class Engine:
             s = req.sampling
             self._tokens[slot] = tok
             self._pos[slot] = len(all_tokens[i])
+            # the drafter's corpus: prompt (plus regenerated tokens on a
+            # preemption resume) followed by the first sampled token —
+            # the tail past the valid length is never matched, but zero
+            # it so a reused slot carries nothing of its previous tenant
+            self._hist[slot, :len(all_tokens[i])] = all_tokens[i]
+            self._hist[slot, len(all_tokens[i])] = tok
+            self._hist[slot, len(all_tokens[i]) + 1:] = 0
+            self._spec_ema[slot] = 1.0   # optimistic: draft until shown
+            self._spec_gates[slot] = True  # not to pay off
             self._seeds[slot] = np.uint32(s.seed)
             self._counts[slot] = req.n_generated
             self._temps[slot] = s.temperature
@@ -860,19 +998,23 @@ class Engine:
                             request=req.request_id,
                             n_generated=req.n_generated)
 
-    def _ensure_blocks(self, h):
+    def _ensure_blocks(self, h, w=1):
         """Extend every running slot's block table to cover its next
-        ``h`` write positions (lazy allocation: rows only hold blocks
-        they have reached).  Under pool pressure: reclaim unpinned
-        prefix blocks first, then preempt the YOUNGEST other running
-        request (most recently submitted — it has the least sunk decode
-        work and re-prefills cheapest) until the allocation fits.  Runs
-        BEFORE the step() harvest snapshot, so a preempted lane is never
+        ``h * w`` write positions — ``w = K+1`` when drafting, so a
+        fully-accepted horizon's tail-block overflow spills into table
+        entries that already exist when the compiled program scatters
+        through them (lazy allocation: rows only hold blocks they have
+        reached).  Under pool pressure: reclaim unpinned prefix blocks
+        first, then preempt the YOUNGEST other running request (most
+        recently submitted — it has the least sunk decode work and
+        re-prefills cheapest) until the allocation fits.  Runs BEFORE
+        the step() harvest snapshot, so a preempted lane is never
         mistaken for a mid-horizon retirement."""
         for slot, req in sorted(self.scheduler.running.items()):
             if self.scheduler.running.get(slot) is not req:
                 continue                 # preempted earlier in this loop
-            need = min(int(self._pos[slot]) + h, self.config.max_seq_len)
+            need = min(int(self._pos[slot]) + h * w,
+                       self.config.max_seq_len)
             while not self.cache.ensure_blocks(slot, need):
                 if self.prefix.reclaim(1):
                     continue
@@ -898,6 +1040,8 @@ class Engine:
         self._d_pos = jnp.asarray(self._pos)
         self._d_counts = jnp.asarray(self._counts)
         self._d_active = jnp.asarray(self._active)
+        self._d_hist = jnp.asarray(self._hist)
+        self._d_gates = jnp.asarray(self._spec_gates)
         self._d_params = tuple(
             jnp.asarray(a) for a in (self._seeds, self._temps,
                                      self._top_ks, self._top_ps,
@@ -914,26 +1058,30 @@ class Engine:
             self._d_tables_nb = nb
             self.cache.tables_dirty = False
 
-    def _dispatch_horizon(self, h):
-        """One compiled decode dispatch over ``h`` fused steps; adopts
-        the returned device state and returns the harvested ``[h, n]``
-        token array AFTER the one blocking host sync.  The block-table
-        width ``nb`` is bucketed per dispatch (ragged attention), and
-        the decode program re-compiles only on a new (h, nb) pair."""
-        self._ensure_blocks(h)       # idempotent; step() already ran it
-        nb = self._attn_blocks(h)
+    def _dispatch_horizon(self, h, k=None):
+        """One compiled decode dispatch over ``h`` fused steps of
+        ``k+1``-position verify windows; adopts the returned device
+        state and returns the harvested ``[h, n, k+1]`` token array
+        AFTER the one blocking host sync.  The block-table width ``nb``
+        is bucketed per dispatch (ragged attention), and the decode
+        program re-compiles only on a new (h, nb, k) triple."""
+        if k is None:
+            k = self._resolve_spec_k()
+        self._ensure_blocks(h, k + 1)   # idempotent; step() already ran it
+        nb = self._attn_blocks(h, k + 1)
         self._sync_device_state()
         self._sync_tables(nb)
         seeds, temps, top_ks, top_ps, eos_ids, limits = self._d_params
-        (tok, p, cnt, act), new_k, new_v, toks = self._decode(
+        (tok, p, cnt, act, hb), new_k, new_v, toks = self._decode(
             self._state_arrays, self._d_tokens, self._d_pos,
-            self._d_counts, self._d_active,
+            self._d_counts, self._d_active, self._d_hist, self._d_gates,
             seeds, temps, top_ks, top_ps, eos_ids, limits,
-            self._d_tables, self.pool.k, self.pool.v, h)
+            self._d_tables, self.pool.k, self.pool.v, h, k)
         self.pool.rebind(new_k, new_v)
         self._d_tokens, self._d_pos = tok, p
         self._d_counts, self._d_active = cnt, act
-        self._decode_buckets.add((h, nb))
+        self._d_hist = hb
+        self._decode_buckets.add((h, nb, k))
         # KV traffic actually gathered by the fallback scan (and the
         # upper bound for the block-culling Pallas kernel): every lane
         # reads its nb table-mapped blocks — k + v, all layers — per
@@ -958,18 +1106,19 @@ class Engine:
         self.admit()
         if self.scheduler.running:
             h = self._resolve_horizon(horizon)
+            k = self._resolve_spec_k()
             # block coverage (and any pressure preemption) BEFORE the
             # harvest snapshot: a lane preempted here simply isn't in
             # `active`, so its -1 harvest rows are never misread
-            self._ensure_blocks(h)
+            self._ensure_blocks(h, k + 1)
         active = dict(self.scheduler.running)
         if active:
             self._horizon_buckets.add(h)
             with _obs_span("serving.decode_step", cat="serving",
                            engine=self._profiler_name,
-                           event_args={"horizon": h}) as sp:
-                toks = self._dispatch_horizon(h)
-                harvested, wasted = self._harvest(toks, active, h,
+                           event_args={"horizon": h, "spec_k": k}) as sp:
+                toks = self._dispatch_horizon(h, k)
+                harvested, wasted = self._harvest(toks, active, h, k,
                                                   finished)
                 sp.event_args["tokens_harvested"] = harvested
             self._decode_steps += h
@@ -994,35 +1143,77 @@ class Engine:
         self._publish_gauges()
         return finished
 
-    def _harvest(self, toks, active, h, finished):
-        """Walk the ``[h, num_slots]`` harvested tokens, replaying each
-        running request's stream in order: record real tokens, retire on
-        EOS/limit (the host check mirrors the in-scan mask), count
-        post-retirement ``-1`` lane steps as waste, and keep the host
-        mirrors equal to the frozen device state."""
+    def _harvest(self, toks, active, h, k_draft, finished):
+        """Walk the ``[h, num_slots, k_draft+1]`` harvested token
+        windows, replaying each running request's stream in order:
+        record the 1..K+1 emitted tokens of every live window (the
+        ``-1`` tail of a window marks rejected/unemitted positions),
+        retire on EOS/limit (the host check mirrors the in-scan mask),
+        count post-retirement lane STEPS as waste (one per scan step,
+        matching the K=0 meaning), and keep the host mirrors — last
+        token, row length, sample count, token history — equal to the
+        frozen device state.  Drafting lanes also update their
+        acceptance EMA here, which drives the adaptive gates (a gate
+        flip dirties the device state for the next upload)."""
         harvested = wasted = 0
+        w = k_draft + 1
+        drafted = accepted = 0
+        floor = float(self.config.spec_accept_floor)
+        gated = self._spec_gates.copy()  # gates the dispatch ran with
         for slot, req in active.items():
             done = False
-            for k in range(h):
-                t = int(toks[k, slot])
+            for step_i in range(h):
+                row = toks[step_i, slot]
                 if done:
                     wasted += 1
                     continue
-                if t < 0:
+                if int(row[0]) < 0:
                     raise RuntimeError(
-                        f"horizon mask retired slot {slot} at step {k} "
-                        "but the scheduler still runs its request — "
-                        "in-scan EOS/limit logic diverged from "
-                        "record_token")
-                harvested += 1
-                self._tokens_generated += 1
-                self._tokens[slot] = t
-                self._pos[slot] += 1
-                if req.record_token(t):
-                    self._retire(req)
-                    finished.append(req)
-                    done = True
+                        f"horizon mask retired slot {slot} at step "
+                        f"{step_i} but the scheduler still runs its "
+                        "request — in-scan EOS/limit logic diverged "
+                        "from record_token")
+                n_emit = 0
+                for j in range(w):
+                    t = int(row[j])
+                    if t < 0:
+                        break            # rejected/unemitted window tail
+                    n_emit += 1
+                    harvested += 1
+                    self._tokens_generated += 1
+                    self._tokens[slot] = t
+                    self._pos[slot] += 1
+                    self._hist[slot, self._pos[slot]] = t
+                    if req.record_token(t):
+                        self._retire(req)
+                        finished.append(req)
+                        done = True
+                        break
                 self._counts[slot] = req.n_generated
+                if k_draft and gated[slot]:
+                    drafted += k_draft
+                    accepted += n_emit - 1
+                    self._spec_windows += 1
+                    self._spec_accept_hist[n_emit] = \
+                        self._spec_accept_hist.get(n_emit, 0) + 1
+                    _SRV_SPEC_ACCEPT.observe(
+                        n_emit, engine=self._profiler_name)
+                    ema = 0.5 * float(self._spec_ema[slot]) \
+                        + 0.5 * (n_emit - 1) / k_draft
+                    self._spec_ema[slot] = ema
+                    if self.config.spec_adaptive and \
+                            (ema >= floor) != bool(self._spec_gates[slot]):
+                        self._spec_gates[slot] = ema >= floor
+                        self._state_dirty = True
+        if drafted:
+            self._spec_draft_tokens += drafted
+            self._spec_accepted_tokens += accepted
+            name = self._profiler_name
+            _SRV_SPEC_DRAFTED.inc(drafted, engine=name)
+            _SRV_SPEC_ACCEPTED.inc(accepted, engine=name)
+            _SRV_SPEC_RATE.set(
+                self._spec_accepted_tokens / self._spec_draft_tokens,
+                engine=name)
         self._decode_harvested += harvested
         self._wasted_lane_tokens += wasted
         return harvested, wasted
@@ -1120,6 +1311,11 @@ class Engine:
             "kv_bytes_read": self._kv_bytes_read,
             "cow_copies": self._cow_copies,
             "preemptions": self._preemptions,
+            "spec_draft_tokens": self._spec_draft_tokens,
+            "spec_accepted_tokens": self._spec_accepted_tokens,
+            "spec_accept_rate": (
+                self._spec_accepted_tokens / self._spec_draft_tokens
+                if self._spec_draft_tokens else 0.0),
         }
         if self._decode_steps:
             c["slot_utilization"] = (self._slot_busy_integral
@@ -1154,6 +1350,26 @@ class Engine:
             "kv_bytes_read": self._kv_bytes_read,
             "cow_copies": self._cow_copies,
             "preemptions": self._preemptions,
+        }
+        s["spec"] = {
+            "k": int(self.config.spec_k),
+            "adaptive": bool(self.config.spec_adaptive),
+            "ngram": int(self.config.spec_ngram),
+            "draft_tokens": self._spec_draft_tokens,
+            "accepted_tokens": self._spec_accepted_tokens,
+            "accept_rate": (
+                self._spec_accepted_tokens / self._spec_draft_tokens
+                if self._spec_draft_tokens else 0.0),
+            # tokens emitted per verify window (accepted prefix + the
+            # bonus token) -> number of windows, drafting lanes only
+            "accept_len_hist": {
+                int(n): c
+                for n, c in sorted(self._spec_accept_hist.items())},
+            "mean_accept_len": (
+                sum(n * c for n, c in self._spec_accept_hist.items())
+                / self._spec_windows if self._spec_windows else 0.0),
+            "lane_accept_ema": [round(float(x), 4)
+                                for x in self._spec_ema],
         }
         if self._ttft_n:
             s["ttft_p50_s"] = _SRV_TTFT.percentile(

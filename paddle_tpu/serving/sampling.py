@@ -91,7 +91,40 @@ def sample_token(logits, key, temperature, top_k, top_p):
 
 def sample_batch(logits, seeds, counts, temperatures, top_ks, top_ps):
     """Vectorized sampling across slot rows: logits [N, vocab] plus
-    per-slot parameter arrays [N] -> token ids [N] int32."""
-    keys = jax.vmap(request_key)(seeds, counts)
-    return jax.vmap(sample_token)(logits, keys, temperatures, top_ks,
-                                  top_ps)
+    per-slot parameter arrays [N] -> token ids [N] int32.
+
+    When EVERY row is greedy (temperature <= 0) the whole
+    sort/filter/categorical pipeline is provably dead — each row
+    reduces to ``argmax`` — so a runtime ``lax.cond`` skips it.  The
+    branch predicate is data-dependent, not traced shape, so one
+    compiled program still serves every request mix; the greedy branch
+    returns exactly what the full pipeline's ``where(temperature > 0,
+    ...)`` would have picked, so outputs are bitwise unchanged."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def full(_):
+        keys = jax.vmap(request_key)(seeds, counts)
+        return jax.vmap(sample_token)(logits, keys, temperatures,
+                                      top_ks, top_ps)
+
+    return jax.lax.cond(jnp.any(temperatures > 0), full,
+                        lambda _: greedy, None)
+
+
+def sample_window(logits, seeds, counts, temperatures, top_ks, top_ps):
+    """Sampling across a speculative verify window: logits [N, W, vocab]
+    -> token ids [N, W], where window position j of lane i is sampled
+    with key ``request_key(seeds[i], counts[i] + j)`` — the exact key
+    sequential decode would use for that request's (counts+j)-th token.
+    Keys are pure functions of (seed, index), so the verify forward
+    consumes no PRNG state for positions the acceptance rule discards:
+    emitted token k of a request is bitwise the token sequential
+    ``generate()`` samples, whatever W the engine verified with."""
+    n, w, vocab = logits.shape
+    js = jnp.arange(w, dtype=counts.dtype)
+    rep = lambda a: jnp.repeat(a, w, axis=0)
+    flat_counts = (counts[:, None] + js[None, :]).reshape(-1)
+    out = sample_batch(logits.reshape(n * w, vocab), rep(seeds),
+                       flat_counts, rep(temperatures), rep(top_ks),
+                       rep(top_ps))
+    return out.reshape(n, w)

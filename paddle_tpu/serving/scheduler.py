@@ -55,6 +55,12 @@ class Request:
     #: how many later-submitted requests were admitted ahead of this one
     #: (bounded by the scheduler's reorder window)
     bypassed: int = 0
+    #: True while this request waits for RE-admission after preemption:
+    #: it already held a slot and was swapped out, so admitting it ahead
+    #: of later-submitted requests restores order rather than overtakes
+    #: — pop_batch extends the head-anchor exemption to it (it neither
+    #: spends the reorder window nor charges anyone's bypassed counter)
+    resumed: bool = False
 
     @property
     def prompt_len(self):
@@ -145,7 +151,11 @@ class Scheduler:
         * once any request has been skipped, admitting a request from
           behind it counts as an overtake; a request is never overtaken
           more than ``window`` times in total, and no admission reaches
-          past the window once a skip exists.
+          past the window once a skip exists;
+        * a ``resumed`` request (preempted, waiting to be re-admitted)
+          shares the head anchor's exemption: admitting it restores the
+          order the preemption disturbed, so it neither consumes the
+          window nor increments anyone's ``bypassed`` counter.
 
         With ``bucket_of=None`` or ``window<=0`` this degrades to strict
         FIFO (``admissible``), batching only the contiguous same-bucket
@@ -160,22 +170,33 @@ class Scheduler:
         anchor_bucket = bucket_of(q[0])
         batch = [q[0]]
         skipped = []
+        # once the reorder window is exhausted the batch is SEALED for
+        # ordinary requests, but the scan keeps walking: resumes restore
+        # order rather than reorder, so they may still join
+        sealed = False
         for idx in range(1, len(q)):
             if len(batch) >= free_slots:
                 break
             r = q[idx]
+            if r.resumed and bucket_of(r) == anchor_bucket:
+                batch.append(r)  # head-anchor exemption for resumes
+                continue
+            if sealed:
+                continue
             if skipped and idx >= max(w, 1):
-                break            # reordering beyond the window forbidden
+                sealed = True    # reordering beyond the window forbidden
+                continue
             if bucket_of(r) == anchor_bucket:
                 if any(s.bypassed >= w for s in skipped):
-                    break        # someone ahead is at their overtake cap
+                    sealed = True  # someone ahead is at their overtake cap
+                    continue
                 batch.append(r)
                 for s in skipped:
                     s.bypassed += 1
             else:
                 skipped.append(r)
                 if w <= 0 or r.bypassed >= w:
-                    break        # nobody may pass this request anymore
+                    sealed = True  # nobody may pass this request anymore
         taken = {id(r) for r in batch}
         self.queue = deque(r for r in q if id(r) not in taken)
         return batch
@@ -183,6 +204,7 @@ class Scheduler:
     def start(self, req, slot):
         req.status = RUNNING
         req.slot = slot
+        req.resumed = False
         req.admit_time = time.time()
         self.running[slot] = req
 
@@ -199,6 +221,7 @@ class Scheduler:
         del self.running[req.slot]
         req.status = WAITING
         req.slot = None
+        req.resumed = True
         self.queue.appendleft(req)
 
     @property

@@ -425,11 +425,12 @@ class TestHorizonDecode:
 
     def test_one_compile_per_horizon_bucket(self):
         """Forced horizon sequence 1,8,8,4,2,8: exactly one compile per
-        distinct (horizon, table-width) bucket, cache hits for every
-        repeat.  Ragged paged attention re-buckets the static table
-        width nb as the sequence grows (block_size 16, so nb steps
-        1 -> 2 -> 4 here), so the compile key is the PAIR — the repeated
-        8s land on different nb and are real compiles, not hits."""
+        distinct (horizon, table-width, spec-K) bucket, cache hits for
+        every repeat.  Ragged paged attention re-buckets the static
+        table width nb as the sequence grows (block_size 16, so nb steps
+        1 -> 2 -> 4 here), so the compile key is the TRIPLE — the
+        repeated 8s land on different nb and are real compiles, not
+        hits (K stays 0 with speculative decoding off)."""
         m = _model()
         eng = Engine(m, EngineConfig(num_slots=1, max_seq_len=64,
                                      max_horizon=8),
@@ -441,8 +442,8 @@ class TestHorizonDecode:
         assert not eng.scheduler.has_work
         s = eng.stats()
         assert s["horizon_buckets"] == [1, 2, 4, 8]
-        assert s["decode_buckets"] == [(1, 1), (2, 2), (4, 2),
-                                       (8, 1), (8, 2), (8, 4)]
+        assert s["decode_buckets"] == [(1, 1, 0), (2, 2, 0), (4, 2, 0),
+                                       (8, 1, 0), (8, 2, 0), (8, 4, 0)]
         assert s["decode_compiles"] == len(s["decode_buckets"])
         assert s["decode_horizons"] == 6
         assert s["decode_cache_hits"] == \
@@ -979,3 +980,443 @@ class TestPreemptionSwap:
             assert s["blocks_in_use"] == s["cached_blocks"]
             if budget == 0:
                 assert s["blocks_in_use"] == 0
+
+
+class TestPopBatchResume:
+    """The ``resumed`` head-anchor exemption: re-admitting a preempted
+    request restores FIFO order rather than violating it, so it must
+    neither spend the reorder window nor charge bypassed counters —
+    even from behind requests that are at their overtake cap."""
+
+    @staticmethod
+    def _sched(window, lens):
+        s = Scheduler(4, reorder_window=window)
+        return s, [s.submit([0] * n, SamplingParams(max_new_tokens=2))
+                   for n in lens]
+
+    @staticmethod
+    def _bucket(r):
+        return r.prompt_len
+
+    def test_resumed_admitted_from_behind_capped_skips(self):
+        # window 1: normally nothing same-bucket can be admitted from
+        # behind a skipped request at index >= 1
+        s, reqs = self._sched(1, [3, 5, 3])
+        reqs[2].resumed = True
+        batch = s.pop_batch(8, bucket_of=self._bucket)
+        assert batch == [reqs[0], reqs[2]]
+        assert reqs[1].bypassed == 0       # exemption: no overtake charged
+
+    def test_resumed_does_not_consume_window_for_others(self):
+        # [A(3), B(5), C(5), D(3,resumed), E(3)] with window 2: D rides
+        # the exemption, but E is a genuine overtake past the window cap
+        s, reqs = self._sched(2, [3, 5, 5, 3, 3])
+        reqs[3].resumed = True
+        batch = s.pop_batch(8, bucket_of=self._bucket)
+        assert batch == [reqs[0], reqs[3]]
+        assert reqs[1].bypassed == 0 and reqs[2].bypassed == 0
+
+    def test_non_resumed_same_shape_is_still_bounded(self):
+        # identical queue WITHOUT the resumed flag: the bucket-3 request
+        # behind the skip is not admitted (control for the test above)
+        s, reqs = self._sched(1, [3, 5, 3])
+        batch = s.pop_batch(8, bucket_of=self._bucket)
+        assert batch == [reqs[0]]
+
+    def test_requeue_front_marks_and_start_clears(self):
+        s = Scheduler(2)
+        r = s.submit([1, 2, 3], SamplingParams(max_new_tokens=2))
+        assert r.resumed is False
+        s.start(r, 0)
+        s.requeue_front(r)
+        assert r.resumed is True and s.queue[0] is r
+        s.start(r, 1)
+        assert r.resumed is False
+
+    def test_resume_ordering_under_load(self):
+        """Preempt under a full queue: the resumed request re-admits
+        FIRST (front of queue, head anchor) and co-buckets with same-
+        bucket resumes; queued newcomers never jump it."""
+        s, reqs = self._sched(2, [3, 3, 5, 3])
+        s.start(reqs[0], 0)
+        s.start(reqs[1], 1)
+        s.queue = __import__("collections").deque(reqs[2:])
+        s.requeue_front(reqs[1])
+        s.requeue_front(reqs[0])
+        batch = s.pop_batch(2, bucket_of=self._bucket)
+        assert batch == [reqs[0], reqs[1]]  # both resumes, before all
+        assert reqs[2].bypassed == 0 and reqs[3].bypassed == 0
+
+
+class TestDrafter:
+    """draft_tokens unit behavior: the -1 sentinel contract and the
+    runway-then-recency match ranking."""
+
+    @staticmethod
+    def _draft(row, length, k=3, ngram=2, width=16):
+        from paddle_tpu.serving import draft_tokens
+
+        hist = np.zeros((1, width), np.int32)
+        hist[0, :len(row)] = row
+        out = draft_tokens(jnp.asarray(hist),
+                           jnp.asarray([length], jnp.int32), k, ngram)
+        return np.asarray(out)[0].tolist()
+
+    def test_history_shorter_than_ngram_plus_one_is_sentinel(self):
+        assert self._draft([7, 7], 2) == [-1, -1, -1]
+        from paddle_tpu.serving import draft_tokens
+        out = draft_tokens(jnp.zeros((2, 2), jnp.int32),
+                           jnp.asarray([2, 2], jnp.int32), 4)
+        assert np.asarray(out).tolist() == [[-1] * 4] * 2
+
+    def test_no_earlier_match_is_sentinel(self):
+        assert self._draft([1, 2, 3, 4, 5, 6], 6) == [-1, -1, -1]
+
+    def test_match_with_full_runway_drafts_continuation(self):
+        # suffix [1,2] matched at start 0; continuation 3, 9, 1
+        assert self._draft([1, 2, 3, 9, 1, 2], 6) == [3, 9, 1]
+
+    def test_runway_beats_recency(self):
+        # suffix [1,2] occurs at 0 (runway 5) and 3 (runway 2): the
+        # early match drafts k=3 tokens, the late one only 2
+        assert self._draft([1, 2, 3, 1, 2, 1, 2], 7) == [3, 1, 2]
+
+    def test_recency_breaks_runway_ties(self):
+        # both matches have >= k runway; the later one wins
+        assert self._draft([1, 2, 5, 5, 5, 1, 2, 8, 8, 8, 1, 2], 12) \
+            == [8, 8, 8]
+
+    def test_drafts_clamped_to_known_history(self):
+        # the only match sits 2 tokens from the end: the third draft
+        # would read past known history and must be the sentinel
+        assert self._draft([7, 1, 2, 1, 2], 5) == [1, 2, -1]
+
+    def test_tail_never_matches_itself(self):
+        # the trailing window is the only occurrence: no proposal
+        assert self._draft([5, 1, 2], 3) == [-1, -1, -1]
+
+    def test_lanes_are_independent(self):
+        from paddle_tpu.serving import draft_tokens
+
+        hist = np.zeros((2, 16), np.int32)
+        hist[0, :6] = [1, 2, 3, 9, 1, 2]
+        hist[1, :6] = [4, 5, 6, 7, 8, 9]
+        out = draft_tokens(jnp.asarray(hist),
+                           jnp.asarray([6, 6], jnp.int32), 3)
+        assert np.asarray(out).tolist() == [[3, 9, 1], [-1, -1, -1]]
+
+    def test_validates_static_args(self):
+        from paddle_tpu.serving import draft_tokens
+
+        h = jnp.zeros((1, 8), jnp.int32)
+        with pytest.raises(ValueError):
+            draft_tokens(h, jnp.asarray([4], jnp.int32), 0)
+        with pytest.raises(ValueError):
+            draft_tokens(h, jnp.asarray([4], jnp.int32), 2, ngram=0)
+
+
+class TestSpeculativeDecode:
+    """Self-drafting speculative decoding: every K and every workload
+    must reproduce the spec_k=0 stream bitwise — drafting is a pure
+    perf lever, invisible in outputs, PRNG, EOS, and budgets."""
+
+    REP_PROMPT = [3, 17, 42, 9] * 4          # repeated pattern
+    RND_PROMPT = [11, 62, 97, 23, 5, 81, 40, 108]
+    #: cached sequential K=0 greedy stream for REP_PROMPT (computed
+    #: once; greedy decode of a prefix is a prefix of the stream, so
+    #: every shorter-budget reference is a slice of this one)
+    _REP_STREAM = None
+
+    @classmethod
+    def _rep_stream(cls, m, n):
+        if cls._REP_STREAM is None:
+            sp = SamplingParams(max_new_tokens=16)
+            ref, _ = cls._run(m, cls.REP_PROMPT, sp, 0)
+            cls._REP_STREAM = list(ref.output_ids)
+        assert n <= len(cls._REP_STREAM)
+        return cls._REP_STREAM[:n]
+
+    @staticmethod
+    def _engine(m, k, adaptive=False, **kw):
+        kw.setdefault("num_slots", 2)
+        kw.setdefault("max_seq_len", 48)
+        kw.setdefault("max_horizon", 4)
+        return Engine(m, EngineConfig(spec_k=k, spec_adaptive=adaptive,
+                                      **kw), register_profiler=False)
+
+    @classmethod
+    def _run(cls, m, prompt, sp, k, adaptive=False, **kw):
+        eng = cls._engine(m, k, adaptive, **kw)
+        req = eng.submit(list(prompt), sp)
+        while eng.scheduler.has_work:
+            eng.step()
+        stats = eng.stats()
+        eng.close()
+        return req, stats
+
+    def test_greedy_parity_repetitive_prompt(self):
+        m = _model()
+        sp = SamplingParams(max_new_tokens=16)
+        ref = self._rep_stream(m, 16)
+        out, stats = self._run(m, self.REP_PROMPT, sp, 4)
+        assert out.output_ids == ref
+        assert stats["spec"]["draft_tokens"] > 0
+
+    def test_greedy_parity_random_prompt(self):
+        m = _model()
+        sp = SamplingParams(max_new_tokens=16)
+        ref, _ = self._run(m, self.RND_PROMPT, sp, 0)
+        out, _ = self._run(m, self.RND_PROMPT, sp, 4)
+        assert out.output_ids == ref.output_ids
+
+    def test_parity_across_draft_widths(self):
+        m = _model()
+        sp = SamplingParams(max_new_tokens=12)
+        ref = self._rep_stream(m, 12)
+        # extreme widths; K=4 is exercised by every other test here
+        for k in (1, 8):
+            out, _ = self._run(m, self.REP_PROMPT, sp, k)
+            assert out.output_ids == ref, f"K={k} diverged"
+
+    def test_seeded_sampling_parity(self):
+        m = _model()
+        sp = SamplingParams(temperature=0.9, top_k=20, top_p=0.9,
+                            seed=7, max_new_tokens=10)
+        ref, _ = self._run(m, self.REP_PROMPT, sp, 0)
+        out, _ = self._run(m, self.REP_PROMPT, sp, 4)
+        assert out.output_ids == ref.output_ids
+
+    def test_mid_window_eos(self):
+        """EOS landing inside a verify window must truncate the emitted
+        run exactly where sequential decode stops."""
+        m = _model()
+        sp = SamplingParams(max_new_tokens=12)
+        stream = self._rep_stream(m, 12)
+        # an EOS whose FIRST occurrence is interior (not window-aligned)
+        idx = next(i for i in range(2, 9) if stream.index(stream[i]) == i)
+        eos = stream[idx]
+        sp_eos = SamplingParams(max_new_tokens=12, eos_token_id=eos)
+        out, _ = self._run(m, self.REP_PROMPT, sp_eos, 4)
+        assert out.output_ids == stream[:idx + 1]
+        assert out.finish_reason == "eos"
+
+    def test_budget_truncation_mid_window(self):
+        """max_new_tokens that is no multiple of any window size: the
+        lane must stop at EXACTLY the budget even when the accepted
+        window would overshoot it."""
+        m = _model()
+        for budget in (1, 7):
+            sp = SamplingParams(max_new_tokens=budget)
+            ref = self._rep_stream(m, budget)
+            out, _ = self._run(m, self.REP_PROMPT, sp, 4)
+            assert out.output_ids == ref
+            assert len(out.output_ids) == budget
+            assert out.finish_reason == "length"
+
+    @pytest.mark.slow
+    def test_staggered_admission_parity(self):
+        """Requests joining at horizon boundaries mid-flight see the
+        same streams as sequential runs, drafting included."""
+        m = _model()
+        prompts = [self.REP_PROMPT, [2, 7, 4, 11], [9, 9, 9, 9, 9, 9]]
+        samp = [SamplingParams(max_new_tokens=10),
+                SamplingParams(max_new_tokens=8),
+                SamplingParams(temperature=0.9, top_k=16, seed=3,
+                               max_new_tokens=9)]
+        seq = []
+        for p, s in zip(prompts, samp):
+            r, _ = self._run(m, p, s, 0)
+            seq.append(r.output_ids)
+        eng = self._engine(m, 4)
+        reqs = [eng.submit(prompts[0], samp[0])]
+        eng.step()
+        reqs.append(eng.submit(prompts[1], samp[1]))
+        eng.step()
+        reqs.append(eng.submit(prompts[2], samp[2]))
+        while eng.scheduler.has_work:
+            eng.step()
+        eng.close()
+        assert [r.output_ids for r in reqs] == seq
+
+    @pytest.mark.slow
+    def test_preempt_resume_parity_with_spec(self):
+        """Preemption mid-draft: blocks released, request re-admitted
+        (resumed exemption), stream still bitwise-sequential."""
+        m = _model()
+        prompts = [self.REP_PROMPT, [9, 2, 6, 1]]
+        samp = [SamplingParams(max_new_tokens=10),
+                SamplingParams(max_new_tokens=10)]
+        seq = []
+        for p, s in zip(prompts, samp):
+            r, _ = self._run(m, p, s, 0, num_slots=1)
+            seq.append(r.output_ids)
+        eng = self._engine(m, 4)
+        reqs = [eng.submit(p, s) for p, s in zip(prompts, samp)]
+        eng.step(horizon=2)
+        eng.preempt(reqs[1])
+        assert reqs[1].resumed is True
+        while eng.scheduler.has_work:
+            eng.step()
+        eng.close()
+        assert [r.output_ids for r in reqs] == seq
+
+    def test_one_compile_per_horizon_width_k_bucket(self):
+        """Decode programs are keyed by (horizon, table-width, K): one
+        compile per distinct triple, cache hits for every repeat."""
+        m = _model()
+        eng = self._engine(m, 4, num_slots=1)
+        sp = SamplingParams(max_new_tokens=8)
+        for _ in range(2):
+            eng.submit(self.REP_PROMPT, sp)
+            while eng.scheduler.has_work:
+                eng.step(horizon=4)
+        s = eng.stats()
+        eng.close()
+        assert all(b[2] == 4 for b in s["decode_buckets"])
+        assert s["decode_compiles"] == len(s["decode_buckets"])
+        assert s["decode_cache_hits"] == \
+            s["decode_horizons"] - s["decode_compiles"]
+
+    def test_accept_stats_exported(self):
+        m = _model()
+        sp = SamplingParams(max_new_tokens=16)
+        _, s = self._run(m, self.REP_PROMPT, sp, 4)
+        spec = s["spec"]
+        assert spec["k"] == 4 and spec["adaptive"] is False
+        assert spec["draft_tokens"] > 0
+        assert 0.0 <= spec["accept_rate"] <= 1.0
+        hist = spec["accept_len_hist"]
+        windows = sum(hist.values())
+        assert windows > 0
+        assert all(1 <= n <= 5 for n in hist)      # emits 1..K+1
+        got = sum(n * c for n, c in hist.items())
+        assert abs(spec["mean_accept_len"] - got / windows) < 1e-9
+        # counters() mirrors the totals
+        eng = self._engine(m, 4)
+        req = eng.submit(self.REP_PROMPT, sp)
+        while eng.scheduler.has_work:
+            eng.step()
+        c = eng.counters()
+        eng.close()
+        assert c["spec_draft_tokens"] == eng.stats()["spec"]["draft_tokens"]
+        assert "spec_accept_rate" in c
+        assert req.output_ids  # the run actually decoded
+
+    def test_adaptive_gate_shrinks_dispatch_to_k0(self):
+        """A lane whose drafts never land falls below the acceptance
+        floor, flips its gate off, and — when no gated lane remains —
+        the next dispatch compiles/reuses the plain K=0 program."""
+        m = _model()
+        sp = SamplingParams(max_new_tokens=16)
+        ref, _ = self._run(m, self.RND_PROMPT, sp, 0)
+        eng = self._engine(m, 4, adaptive=True, num_slots=1)
+        eng.config.spec_accept_floor = 1.1         # unreachable: always off
+        req = eng.submit(self.RND_PROMPT, sp)
+        while eng.scheduler.has_work:
+            eng.step()
+        s = eng.stats()
+        eng.close()
+        assert req.output_ids == ref.output_ids    # parity through the flip
+        ks = {b[2] for b in s["decode_buckets"]}
+        assert 0 in ks and 4 in ks                 # shrank mid-request
+        assert all(e < 1.0 for e in s["spec"]["lane_accept_ema"][:1])
+
+    def test_k0_engine_reports_no_spec_activity(self):
+        m = _model()
+        sp = SamplingParams(max_new_tokens=8)
+        _, s = self._run(m, self.REP_PROMPT, sp, 0)
+        assert s["spec"]["draft_tokens"] == 0
+        assert s["spec"]["accepted_tokens"] == 0
+        assert s["spec"]["accept_len_hist"] == {}
+        assert all(b[2] == 0 for b in s["decode_buckets"])
+
+    def test_spec_with_prefix_cache_and_gqa(self):
+        """Drafting composes with prefix-cache hits and GQA models."""
+        paddle.seed(3)
+        m = GPTForCausalLM(TINY_GQA)
+        m.eval()
+        sp = SamplingParams(max_new_tokens=10)
+        prompt = [5, 9, 5, 9, 5, 9, 5, 9]
+        ref, _ = self._run(m, prompt, sp, 0,
+                           prefix_cache_bytes=1 << 20)
+        out, _ = self._run(m, prompt, sp, 4,
+                           prefix_cache_bytes=1 << 20)
+        assert out.output_ids == ref.output_ids
+
+
+class TestPagedAttentionVerify:
+    """Multi-position (verify-window) queries through the paged kernel:
+    each row must equal the single-token decode at that position, and
+    the whole window must match a dense causal reference."""
+
+    @staticmethod
+    def _case(b=2, s=1, qh=4, kh=2, d=8, bs=4, nb=4, seed=0,
+              pos_vals=(9, 13)):
+        r = np.random.RandomState(seed)
+        q = jnp.asarray(r.randn(b, s, qh, d).astype(np.float32))
+        num_blocks = 1 + b * nb
+        k = jnp.asarray(r.randn(num_blocks, bs, kh, d).astype(np.float32))
+        v = jnp.asarray(r.randn(num_blocks, bs, kh, d).astype(np.float32))
+        tables = jnp.asarray(
+            1 + np.arange(b * nb, dtype=np.int32).reshape(b, nb))
+        pos = jnp.asarray(np.array(pos_vals, np.int32)[:b])
+        return q, k, v, tables, pos
+
+    @pytest.mark.parametrize("w", [1, 2, 4, 8])
+    def test_window_rows_bitwise_match_single_queries(self, w):
+        """Row j of an s=w window at base position p equals an s=1 call
+        at position p+j — the property that makes verify-as-prefill
+        bitwise-safe, across block boundaries (bs=4, windows straddle
+        them for w >= 2)."""
+        q, k, v, tables, pos = self._case(s=w)
+        base = pos - (w - 1)
+        out = np.asarray(_xla_paged_attention(q, k, v, tables, base))
+        for j in range(w):
+            one = np.asarray(_xla_paged_attention(
+                q[:, j:j + 1], k, v, tables, base + j))
+            np.testing.assert_array_equal(out[:, j:j + 1], one)
+
+    def test_window_matches_dense_causal_reference(self):
+        w = 4
+        q, k, v, tables, pos = self._case(s=w)
+        base = pos - (w - 1)
+        out = np.asarray(_xla_paged_attention(q, k, v, tables, base))
+        kn, vn, tn, bn = (np.asarray(x) for x in (k, v, tables, base))
+        b, _, qh, d = q.shape
+        kh = kn.shape[2]
+        g = qh // kh
+        for i in range(b):
+            keys = kn[tn[i]].reshape(-1, kh, d)
+            vals = vn[tn[i]].reshape(-1, kh, d)
+            for j in range(w):
+                t = int(bn[i]) + j + 1             # visible prefix length
+                for h in range(qh):
+                    qv = np.asarray(q)[i, j, h] / np.sqrt(d)
+                    sc = keys[:t, h // g] @ qv
+                    ww = np.exp(sc - sc.max())
+                    ww /= ww.sum()
+                    ref = ww @ vals[:t, h // g]
+                    np.testing.assert_allclose(out[i, j, h], ref,
+                                               atol=1e-5)
+
+    def test_shared_prefix_cow_tail_blocks(self):
+        """Two lanes share a prefix block (COW-style table aliasing);
+        their divergent tails must not bleed into each other, and each
+        lane's window must equal a private-copy run."""
+        r = np.random.RandomState(1)
+        bs, kh, d, qh, w = 4, 2, 8, 4, 2
+        k = jnp.asarray(r.randn(6, bs, kh, d).astype(np.float32))
+        v = jnp.asarray(r.randn(6, bs, kh, d).astype(np.float32))
+        q = jnp.asarray(r.randn(2, w, qh, d).astype(np.float32))
+        # lanes alias block 1 as their shared prefix, own tails 2/3
+        shared = jnp.asarray([[1, 2], [1, 3]], jnp.int32)
+        base = jnp.asarray([4, 4], jnp.int32)      # window rows 4,5
+        out_shared = np.asarray(
+            _xla_paged_attention(q, k, v, shared, base))
+        # private copies of the prefix (blocks 4/5 = copies of block 1)
+        k2 = k.at[4].set(k[1]).at[5].set(k[1])
+        v2 = v.at[4].set(v[1]).at[5].set(v[1])
+        private = jnp.asarray([[4, 2], [5, 3]], jnp.int32)
+        out_private = np.asarray(
+            _xla_paged_attention(q, k2, v2, private, base))
+        np.testing.assert_array_equal(out_shared, out_private)
