@@ -17,7 +17,15 @@ Decode drivers measured:
   * paged-ablation rows: ragged paged attention vs full-width table
     reads (tok/s, KV bytes/step, decode tokens per GB of KV traffic) —
     see _bench_paged_ablation for the b8 scan-regression diagnosis
-    these rows ablate.
+    these rows ablate;
+  * quant-ablation rows: fp vs int8 weight-only vs int8 weights + int8
+    paged KV (tok/s, KV bytes/step, weight bytes) plus a fixed-byte-
+    budget capacity row — see _bench_quant_ablation.
+
+Roofline math uses a per-backend bandwidth table (TPU datasheet
+numbers) with a one-shot memcpy probe for unlisted backends, so CPU
+rows carry an honest ``roofline_bw_gbs`` instead of omitting the
+column (see _backend_bandwidth_gbs).
 
 A numerics gate runs first ON THE BENCH DEVICE: fused cached decode must
 match the fused prefill of the concatenated sequence (self-consistency)
@@ -34,6 +42,38 @@ import time
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+#: Published HBM bandwidth per accelerator backend (GB/s).  v5e HBM2e
+#: is the paper's serving chip; "axon" is the same part behind the
+#: tunneled plugin.  Backends not listed here (cpu in CI) are measured
+#: once per process by a memcpy probe instead of being skipped, so
+#: every roofline-bearing row records the bandwidth it was judged
+#: against.
+_HBM_BW_TABLE = {"tpu": 819.0, "axon": 819.0}
+_BW_PROBED = {}
+
+
+def _backend_bandwidth_gbs(backend):
+    """Decode-roofline bandwidth for `backend` in GB/s: the datasheet
+    table when we have one, else a one-shot streaming-memcpy probe
+    (64 MiB source, read+write counted, best of 4 passes — DRAM speed,
+    not L3, at that footprint).  Memoized: the probe runs at most once
+    per process so repeated bench sections agree on the number."""
+    if backend in _HBM_BW_TABLE:
+        return _HBM_BW_TABLE[backend]
+    if backend not in _BW_PROBED:
+        src = np.ones(1 << 26, np.uint8)          # 64 MiB
+        dst = np.empty_like(src)
+        np.copyto(dst, src)                       # fault pages in
+        best = None
+        for _ in range(4):
+            t0 = time.perf_counter()
+            np.copyto(dst, src)
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        _BW_PROBED[backend] = round(2.0 * src.nbytes / best / 1e9, 1)
+    return _BW_PROBED[backend]
 
 
 def _build_params(rng, L, dim, n_head, ffn, dtype):
@@ -230,7 +270,8 @@ def _bench_engine_horizons(backend, on_tpu, rng):
                        cfg.vocab_size)
     layer_w = (4 * dim * dim + 3 * dim * ffn) * cfg.num_hidden_layers
     weight_bytes = (layer_w + dim * vocab) * itemsize
-    roofline_ms = (weight_bytes / 819e9 * 1e3) if on_tpu else None
+    bw_gbs = _backend_bandwidth_gbs(backend)
+    roofline_ms = weight_bytes / (bw_gbs * 1e9) * 1e3
 
     paddle.seed(0)
     model = GPTForCausalLM(cfg)
@@ -278,11 +319,10 @@ def _bench_engine_horizons(backend, on_tpu, rng):
             "kv_bytes_read_per_step": int(kv_bytes // new_tokens),
             "tokens_per_gb_kv_read": round(new_tokens
                                            / (kv_bytes / 1e9), 1),
+            "roofline_bw_gbs": bw_gbs,
+            "weight_roofline_ms": round(roofline_ms, 3),
+            "roofline_pct": round(100.0 * roofline_ms / per_step_ms, 1),
         }
-        if roofline_ms is not None:
-            row["weight_roofline_ms"] = round(roofline_ms, 3)
-            row["roofline_pct"] = round(100.0 * roofline_ms / per_step_ms,
-                                        1)
         rows.append(row)
     return rows
 
@@ -499,7 +539,8 @@ def _bench_paged_ablation(backend, on_tpu, rng):
                        cfg.vocab_size)
     layer_w = (4 * dim * dim + 3 * dim * ffn) * cfg.num_hidden_layers
     weight_bytes = (layer_w + dim * vocab) * itemsize
-    roofline_ms = (weight_bytes / 819e9 * 1e3) if on_tpu else None
+    bw_gbs = _backend_bandwidth_gbs(backend)
+    roofline_ms = weight_bytes / (bw_gbs * 1e9) * 1e3
 
     paddle.seed(0)
     model = GPTForCausalLM(cfg)
@@ -539,11 +580,11 @@ def _bench_paged_ablation(backend, on_tpu, rng):
                 "kv_bytes_read_per_step": int(kv_bytes // new_tokens),
                 "tokens_per_gb_kv_read": round(new_tokens
                                                / (kv_bytes / 1e9), 1),
+                "roofline_bw_gbs": bw_gbs,
+                "weight_roofline_ms": round(roofline_ms, 3),
+                "roofline_pct": round(
+                    100.0 * roofline_ms / per_step_ms, 1),
             }
-            if roofline_ms is not None:
-                row["weight_roofline_ms"] = round(roofline_ms, 3)
-                row["roofline_pct"] = round(
-                    100.0 * roofline_ms / per_step_ms, 1)
             rows.append(row)
     return rows
 
@@ -689,10 +730,145 @@ def _bench_spec_decode(backend, on_tpu, rng):
     return rows
 
 
-#: DECODE_BENCH.json row schema: 2 adds per-row provenance
+def _bench_quant_ablation(backend, on_tpu, rng):
+    """Quantized-serving ablation (int8 weight-only decode + int8 paged
+    KV) — the PR-8 levers on the decode roofline's two byte streams:
+
+      * fp     — knobs off: the exact PR-7 engine (bitwise-identical
+        programs, asserted by TestQuantServing);
+      * w8     — ``weight_dtype="int8"``: per-output-channel absmax PTQ
+        of every Linear weight; programs read int8 + one fp scale row
+        and dequantize inline, so the per-step weight stream shrinks
+        ~4x (f32) / ~2x (bf16) while matmul math stays fp;
+      * w8kv8  — plus ``kv_cache_dtype="int8"``: the paged pool stores
+        int8 blocks with per-token fp32 scales beside the block table;
+        quantize at append/COW, dequantize after the ragged gather.
+
+    Throughput rows report tok/s, measured KV bytes/step (from the same
+    block-table telemetry as every other row — int8 blocks + scale
+    reads, not a formula), decode tokens per GB of KV traffic, and the
+    resident weight bytes the step streams.  On CPU the timings mostly
+    measure dispatch, so the bytes columns are the load-bearing ones
+    (kv_bytes/step for w8kv8 must land <= 0.55x the fp row).
+
+    The capacity row holds the pool BYTE budget fixed (what an HBM
+    reservation actually is), sizes each mode's pool as
+    budget // bytes_per_block, and drives an oversubscribed workload
+    counting the peak number of concurrently-running sequences: int8 KV
+    fits ~2x (bf16) / ~4x (f32) the sequences of the fp pool."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.serving import Engine, EngineConfig, SamplingParams
+
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=32000, hidden_size=1536,
+                        intermediate_size=4096, num_hidden_layers=12,
+                        num_attention_heads=12,
+                        max_position_embeddings=1024)
+        max_seq, new_tokens, dtype = 768, 64, jnp.bfloat16
+        prompt_len = 512
+    else:
+        cfg = GPTConfig(vocab_size=1024, hidden_size=256,
+                        intermediate_size=512, num_hidden_layers=2,
+                        num_attention_heads=4,
+                        max_position_embeddings=128)
+        max_seq, new_tokens, dtype = 64, 16, jnp.float32
+        prompt_len = 40
+
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    sp = SamplingParams(max_new_tokens=new_tokens)
+    prompt = rng.randint(0, cfg.vocab_size, prompt_len).tolist()
+    modes = (("fp", None, None),
+             ("w8", "int8", None),
+             ("w8kv8", "int8", "int8"))
+    rows, bpb = [], {}
+    for mode, wq, kq in modes:
+        eng = Engine(model, EngineConfig(
+            num_slots=1, max_seq_len=max_seq, max_horizon=8,
+            cache_dtype=dtype, weight_dtype=wq, kv_cache_dtype=kq),
+            register_profiler=False)
+        bpb[mode] = eng.pool.bytes_per_block
+        eng.submit(prompt, sp)                # warm the compiles
+        while eng.scheduler.has_work:
+            eng.step(horizon=8)
+        eng.submit(prompt, sp)
+        eng.admit()                           # prefill outside the window
+        kv0 = eng.counters()["kv_bytes_read"]
+        t0 = time.time()
+        while eng.scheduler.has_work:
+            eng.step(horizon=8)
+        dt = time.time() - t0
+        c = eng.stats()
+        kv_bytes = c["kv_pool"]["kv_bytes_read"] - kv0
+        eng.close()
+        rows.append({
+            "metric": f"engine quant-decode [{mode}] b1 prefill "
+                      f"{prompt_len} + {new_tokens} new ({backend})",
+            "value": round(new_tokens / dt, 1),
+            "unit": "tokens/s",
+            "per_step_ms": round(dt * 1000.0 / new_tokens, 3),
+            "weight_dtype": wq or "fp",
+            "kv_cache_dtype": kq or str(jnp.dtype(dtype)),
+            "kv_store_dtype": c["kv_pool"]["dtype"],
+            "kv_bytes_per_block": bpb[mode],
+            "kv_bytes_read_per_step": int(kv_bytes // new_tokens),
+            "tokens_per_gb_kv_read": round(new_tokens
+                                           / (kv_bytes / 1e9), 1),
+            "weight_bytes": c["quant"]["weight_bytes"],
+        })
+
+    # ---- capacity at a fixed pool byte budget: enough fp blocks for
+    # ~4 sequences of this workload, then the same BYTES per mode
+    seq_blocks = -(-(prompt_len + new_tokens) // 16)
+    budget = (1 + 4 * seq_blocks) * bpb["fp"]
+    n_req = 24
+
+    def peak_running(kq, blocks):
+        eng = Engine(model, EngineConfig(
+            num_slots=n_req, max_seq_len=max_seq, max_horizon=4,
+            cache_dtype=dtype, kv_cache_dtype=kq,
+            kv_pool_blocks=blocks, prefix_block_size=0),
+            register_profiler=False)
+        for _ in range(n_req):
+            eng.submit(prompt, sp)
+        peak = 0
+        while eng.scheduler.has_work:
+            eng.step(horizon=4)
+            peak = max(peak, len(eng.scheduler.running))
+        pre = eng.counters().get("preemptions", 0)
+        eng.close()
+        return peak, pre
+
+    cap = {}
+    for mode, kq in (("fp", None), ("kv8", "int8")):
+        blocks = max(2, budget // bpb["fp" if kq is None else "w8kv8"])
+        cap[mode] = dict(zip(("peak", "preemptions"),
+                             peak_running(kq, blocks)))
+        cap[mode]["pool_blocks"] = blocks
+    rows.append({
+        "metric": f"engine quant kv-capacity fixed {budget} B pool, "
+                  f"{n_req} reqs ({backend})",
+        "value": round(cap["kv8"]["peak"] / max(1, cap["fp"]["peak"]),
+                       2),
+        "unit": "x peak concurrent seqs (int8 KV / fp)",
+        "budget_bytes": budget,
+        "bytes_per_block": {"fp": bpb["fp"], "int8": bpb["w8kv8"]},
+        "fp": cap["fp"],
+        "int8": cap["kv8"],
+    })
+    return rows
+
+
+#: DECODE_BENCH.json row schema: 2 added per-row provenance
 #: (schema_version, git_sha, run_id) so the bench trajectory is
-#: reconstructable across PRs from the file's git history alone
-SCHEMA_VERSION = 2
+#: reconstructable across PRs from the file's git history alone;
+#: 3 adds roofline_bw_gbs — the per-backend bandwidth (datasheet or
+#: memcpy-probed) every roofline column in the row was computed from
+SCHEMA_VERSION = 3
 
 
 def _git_sha():
@@ -735,13 +911,14 @@ def main():
     results = []
 
     # decode is weight-traffic-bound: every step reads all layer weights
-    # + the LM head once from HBM (v5e ~819 GB/s). KV-cache reads are
-    # tiny at this seq. This roofline contextualizes per-step latency.
+    # + the LM head once from HBM (v5e ~819 GB/s, from the bandwidth
+    # table; probed on CPU). KV-cache reads are tiny at this seq. This
+    # roofline contextualizes per-step latency.
     itemsize = jnp.dtype(dtype).itemsize
     layer_w = (3 * dim * dim + dim * dim + 2 * dim * ffn) * L
     weight_bytes = (layer_w + dim * vocab) * itemsize
-    hbm_bw = 819e9 if on_tpu else None
-    roofline_ms = (weight_bytes / hbm_bw * 1e3) if hbm_bw else None
+    bw_gbs = _backend_bandwidth_gbs(backend)
+    roofline_ms = weight_bytes / (bw_gbs * 1e9) * 1e3
 
     for b in bsizes:
         P = {
@@ -813,11 +990,10 @@ def main():
             "value": round(b * n_steps / best, 1),
             "unit": "tokens/s",
             "per_step_ms": round(best * 1000.0 / n_steps, 3),
+            "weight_roofline_ms": round(roofline_ms, 3),
+            "roofline_pct": round(
+                100.0 * roofline_ms / (best * 1000.0 / n_steps), 1),
         }
-        if roofline_ms is not None:
-            row["weight_roofline_ms"] = round(roofline_ms, 3)
-            row["roofline_pct"] = round(
-                100.0 * roofline_ms / (best * 1000.0 / n_steps), 1)
         results.append(row)
 
     results.extend(_bench_engine_horizons(backend, on_tpu, rng))
@@ -825,6 +1001,7 @@ def main():
     results.extend(_bench_paged_ablation(backend, on_tpu, rng))
     results.extend(_bench_prefix_prefill(backend, on_tpu, rng))
     results.extend(_bench_spec_decode(backend, on_tpu, rng))
+    results.extend(_bench_quant_ablation(backend, on_tpu, rng))
 
     # merge-preserving write: rows from OTHER backends (each metric
     # string ends with its backend tag, as "(cpu)" or "..., cpu)")
@@ -861,6 +1038,10 @@ def main():
         r["schema_version"] = SCHEMA_VERSION
         r["git_sha"] = sha
         r["run_id"] = run_id
+        # the bandwidth every roofline-bearing number in this run was
+        # judged against (rows without roofline columns carry it too,
+        # as run provenance)
+        r.setdefault("roofline_bw_gbs", bw_gbs)
     for r in results:
         print(json.dumps(r))
     with open(out, "w") as f:

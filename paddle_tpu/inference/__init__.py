@@ -156,7 +156,18 @@ def create_llm_engine(model, **config_kwargs):
     token — outputs stay bitwise-equal to ``spec_k=0``, 0 disables;
     spec_adaptive — per-lane acceptance-rate gating that stops drafting
     for lanes where speculation is not paying, so incompressible
-    streams keep plain-decode throughput)."""
+    streams keep plain-decode throughput;
+    weight_dtype — "int8" PTQ-quantizes every Linear weight at engine
+    build (per-output-channel absmax scales) and dequantizes inline in
+    the compiled programs, shrinking the per-step weight stream ~2x at
+    bf16 / ~4x at f32 while matmul math stays fp — greedy outputs may
+    legitimately differ from fp within quantization tolerance;
+    kv_cache_dtype — "int8" stores paged-KV blocks as int8 with one f32
+    scale per written token beside the block table (quantize at
+    append/COW, dequantize after the attention gather), cutting decode
+    KV traffic ~4x at f32 and ~2x-ing how many sequences fit a fixed
+    pool byte budget; None for either knob keeps the fp path
+    bitwise-untouched)."""
     from ..serving import Engine, EngineConfig
 
     return Engine(model, EngineConfig(**config_kwargs))

@@ -170,10 +170,13 @@ class GPTAttention(Layer):
         so the current token's keys are visible to itself), then ragged
         paged attention over the block table — only blocks below each
         lane's length are read. Bitwise-compatible with the slotted path:
-        same rope/attention math over the same visible keys."""
+        same rope/attention math over the same visible keys. A quantized
+        pool (cache.k_scale set) quantizes each token at the write and
+        dequantizes gathered blocks inside paged attention — same math
+        over dequantized values, so parity within a quant config holds."""
         import jax.numpy as jnp
 
-        from ..serving.kv_cache import PagedKV, paged_write
+        from ..serving.kv_cache import PagedKV, paged_write, paged_write_quant
         from ..serving.paged_attention import paged_attention
 
         pos = cache.pos
@@ -181,12 +184,21 @@ class GPTAttention(Layer):
                          + jnp.arange(s, dtype=pos.dtype)[None, :])
         q = apply_rotary_emb(q, position_ids=pos_ids, base=self.rope_theta)
         k = apply_rotary_emb(k, position_ids=pos_ids, base=self.rope_theta)
-        k_pool = paged_write(cache.k, k._data, cache.tables, pos)
-        v_pool = paged_write(cache.v, v._data, cache.tables, pos)
-        out = paged_attention(q._data, k_pool, v_pool, cache.tables, pos)
+        if cache.k_scale is not None:
+            k_pool, k_scale = paged_write_quant(
+                cache.k, cache.k_scale, k._data, cache.tables, pos)
+            v_pool, v_scale = paged_write_quant(
+                cache.v, cache.v_scale, v._data, cache.tables, pos)
+        else:
+            k_pool = paged_write(cache.k, k._data, cache.tables, pos)
+            v_pool = paged_write(cache.v, v._data, cache.tables, pos)
+            k_scale = v_scale = None
+        out = paged_attention(q._data, k_pool, v_pool, cache.tables, pos,
+                              k_scale, v_scale)
         out = self.o_proj(M.reshape(Tensor(out),
                                     [b, s, self.num_heads * self.head_dim]))
-        return out, PagedKV(k_pool, v_pool, cache.tables, pos + s)
+        return out, PagedKV(k_pool, v_pool, cache.tables, pos + s,
+                            k_scale, v_scale)
 
 
 class GPTMLP(Layer):

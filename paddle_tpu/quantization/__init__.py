@@ -64,6 +64,26 @@ class AbsmaxObserver(BaseObserver):
         return jnp.max(jnp.abs(x))
 
 
+class PerChannelAbsmaxObserver(BaseObserver):
+    """Per-channel abs-max over every axis except ``channel_axis`` (ref
+    AbsmaxObserver with quant_axis): the scale is an ARRAY broadcastable
+    against ``x``, so ``fake_quant``'s ``maximum(scale, 1e-8)`` floor
+    applies per channel — an all-zero channel quantizes to exact zeros
+    instead of dividing by zero, and one outlier channel cannot crush
+    every other channel's resolution the way a post-max per-tensor
+    scale would.  This is the observer behind the serving engine's
+    weight-only int8 path (see ``quantization.serving``)."""
+
+    def __init__(self, quant_bits=8, channel_axis=-1):
+        super().__init__(quant_bits)
+        self.channel_axis = channel_axis
+
+    def scale(self, x):
+        axis = self.channel_axis % x.ndim
+        reduce_axes = tuple(i for i in range(x.ndim) if i != axis)
+        return jnp.max(jnp.abs(x), axis=reduce_axes, keepdims=True)
+
+
 class EMAObserver(BaseObserver):
     """Moving-average abs-max (ref EMAObserver); state updates eagerly
     between steps (host-side float), the in-graph scale is the snapshot."""
@@ -206,7 +226,13 @@ class PTQ(QAT):
         super().__init__(config)
 
 
+from .serving import (QuantizedWeight, channelwise_scales,  # noqa: E402
+                      dequantize_weight, quantize_for_serving,
+                      quantize_weight)
+
 __all__ = [
     "QuantConfig", "QAT", "PTQ", "AbsmaxObserver", "EMAObserver",
     "FakeQuanterWithAbsMax", "QuantedLayer", "BaseObserver",
+    "PerChannelAbsmaxObserver", "QuantizedWeight", "channelwise_scales",
+    "quantize_weight", "dequantize_weight", "quantize_for_serving",
 ]

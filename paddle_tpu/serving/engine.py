@@ -299,6 +299,21 @@ class EngineConfig:
     #: per-lane acceptance-rate floor (EMA of accepted/K per verify
     #: window) below which adaptive drafting turns off for that lane
     spec_accept_floor: float = 0.125
+    #: weight-only serving quantization: "int8" absmax-calibrates
+    #: per-output-channel scales for every Linear projection at engine
+    #: construction (quantization.quantize_for_serving) and stores int8
+    #: weights + f32 scales; prefill/decode dequantize inline (fused by
+    #: XLA into the matmul weight read), halving decode's weight-byte
+    #: roofline.  None keeps fp weights — and the compiled programs
+    #: bitwise-identical to an unquantized engine.
+    weight_dtype: object = None
+    #: KV-cache storage dtype for the unified paged pool: "int8" stores
+    #: quantized blocks with one f32 absmax scale per token beside the
+    #: block table (quantize at append/COW, dequantize after the
+    #: attention gather), halving per-step serving.kv_bytes_read and
+    #: ~2x-ing how many sequences fit a fixed kv_pool_blocks byte
+    #: budget.  None keeps the fp pool (cache_dtype).
+    kv_cache_dtype: object = None
 
 
 class Engine:
@@ -313,9 +328,27 @@ class Engine:
         self.config = config or EngineConfig()
         model.eval()
         mc = model.config
+        self._weight_dtype = self._norm_quant_knob(
+            self.config.weight_dtype, "weight_dtype")
+        self._kv_quant = self._norm_quant_knob(
+            self.config.kv_cache_dtype, "kv_cache_dtype")
         self._state_names = list(model.state_dict().keys())
         sd = model.state_dict()
-        self._state_arrays = [sd[n]._data for n in self._state_names]
+        if self._weight_dtype:
+            # weight-only PTQ: matmul weights ride the jitted programs
+            # as (int8, f32-scale) pairs and _run_model dequantizes them
+            # inline — XLA fuses the multiply into the weight read, so
+            # only int8 bytes stream from HBM per decode step
+            from ..quantization import quantize_for_serving
+
+            qmap = quantize_for_serving(model)
+            self._wq_dtypes = {n: qw.dtype for n, qw in qmap.items()}
+            self._state_arrays = [
+                qmap[n].pair if n in qmap else sd[n]._data
+                for n in self._state_names]
+        else:
+            self._wq_dtypes = {}
+            self._state_arrays = [sd[n]._data for n in self._state_names]
         cache_dtype = (self.config.cache_dtype
                        or model.model.embed_tokens.weight._data.dtype)
         # ONE paged block pool backs every slot's table AND the prefix
@@ -325,9 +358,12 @@ class Engine:
         self._block_size = max(1, int(self.config.prefix_block_size) or 16)
         budget = (self.config.prefix_cache_bytes
                   if self.config.prefix_block_size else 0)
+        token_bytes = (mc.kv_heads * mc.head_dim
+                       * (1 if self._kv_quant
+                          else jnp.dtype(cache_dtype).itemsize)
+                       + (4 if self._kv_quant else 0))
         bytes_per_block = (2 * len(model.model.layers) * self._block_size
-                           * mc.kv_heads * mc.head_dim
-                           * jnp.dtype(cache_dtype).itemsize)
+                           * token_bytes)
         prefix_capacity = int(budget) // bytes_per_block
         self.cache = PagedKVCache(
             num_layers=len(model.model.layers),
@@ -337,7 +373,8 @@ class Engine:
             kv_heads=mc.kv_heads, head_dim=mc.head_dim,
             dtype=cache_dtype,
             num_blocks=int(self.config.kv_pool_blocks),
-            extra_blocks=prefix_capacity)
+            extra_blocks=prefix_capacity,
+            quant_dtype=self._kv_quant)
         self.pool = self.cache.pool
         self.scheduler = Scheduler(self.config.num_slots,
                                    reorder_window=self.config.reorder_window)
@@ -350,7 +387,8 @@ class Engine:
             num_layers=len(model.model.layers),
             block_size=self._block_size,
             kv_heads=mc.kv_heads, head_dim=mc.head_dim,
-            dtype=cache_dtype, budget_bytes=budget, pool=self.pool)
+            dtype=cache_dtype, budget_bytes=budget, pool=self.pool,
+            bytes_per_block=self.pool.bytes_per_block)
         self._max_blocks = self.cache.max_blocks_per_slot
         self._leases = {}            # request_id -> PrefixLease
 
@@ -390,14 +428,24 @@ class Engine:
         self._d_tables_nb = -1
 
         # donation buys in-place HBM pool updates on accelerators; CPU
-        # would only warn that donation is unimplemented
+        # would only warn that donation is unimplemented.  The scale
+        # pools (args 16/17 decode, 10/11 prefill) are donated only when
+        # they carry arrays — donating the fp path's None placeholders
+        # is a no-op but keeping the tuples identical to the pre-quant
+        # engine documents that nothing changed with the knobs off.
         donate = jax.default_backend() not in ("cpu",)
+        decode_donate = (1, 2, 3, 4, 5, 14, 15)
+        prefill_donate = (8, 9)
+        if self._kv_quant:
+            decode_donate += (16, 17)
+            prefill_donate += (10, 11)
         self._decode = CompiledFn(
             self._decode_fn,
-            donate_argnums=(1, 2, 3, 4, 5, 14, 15) if donate else (),
-            static_argnums=(16, 17), name="serving.decode")
+            donate_argnums=decode_donate if donate else (),
+            static_argnums=(18, 19), name="serving.decode")
         self._prefill = CompiledFn(self._prefill_fn,
-                                   donate_argnums=(8, 9) if donate else (),
+                                   donate_argnums=(prefill_donate
+                                                   if donate else ()),
                                    name="serving.prefill")
 
         # observability
@@ -457,11 +505,35 @@ class Engine:
         if self._finalizer is not None:
             self._finalizer()
 
+    @staticmethod
+    def _norm_quant_knob(value, name):
+        """Normalize a serving quant knob to None or "int8"
+        (case-insensitive)."""
+        key = value if value is None else str(value).lower()
+        if key in (None, "", "none"):
+            return None
+        if key in ("int8", "i8"):
+            return "int8"
+        raise ValueError(
+            f"unsupported {name} {value!r} (supported: None, 'int8')")
+
     # ------------------------------------------------------------ pure fns
     def _run_model(self, state_arrays, ids, views):
         """Functionalized forward: raw param arrays + token ids + PagedKV
-        views -> (last-position logits [B, vocab], new views)."""
-        arrays = dict(zip(self._state_names, state_arrays))
+        views -> (last-position logits [B, vocab], new views).
+
+        Weight-quantized entries arrive as (int8, f32-scale) pairs and
+        are dequantized HERE, inside the traced program — XLA fuses
+        ``q.astype(f32) * scale`` into the consuming matmul's weight
+        read, so every caller (prefill, horizon scan, verify windows)
+        streams int8 weight bytes without code changes of its own."""
+        arrays = {}
+        for name, a in zip(self._state_names, state_arrays):
+            if type(a) is tuple:
+                q, scale = a
+                a = (q.astype(jnp.float32)
+                     * scale).astype(self._wq_dtypes[name])
+            arrays[name] = a
         with _tape.no_grad():
             with self.model.use_state(arrays):
                 h, new_views = self.model.model(Tensor(ids), caches=views)
@@ -470,7 +542,7 @@ class Engine:
 
     def _prefill_fn(self, state_arrays, ids, lengths, prefix_lens,
                     tables, cow_src, cow_dst, counts, pool_k, pool_v,
-                    seeds, temps, top_ks, top_ps):
+                    pool_ks, pool_vs, seeds, temps, top_ks, top_ps):
         """Batched fused prefill over the paged pool: one compiled
         dispatch prefills a whole admission batch.
 
@@ -497,13 +569,26 @@ class Engine:
         is the single-block COW copy; the model then scatters suffix
         k/v at ``prefix_lens`` (overwriting the COW block from the
         divergence offset on) and the first token is sampled from the
-        last valid position's logits with ``request_key(seed, count)``."""
+        last valid position's logits with ``request_key(seed, count)``.
+
+        ``pool_ks``/``pool_vs`` are the quantized pool's per-token scale
+        buffers (None on the fp path — an empty pytree, so the traced
+        program is unchanged when the knob is off).  The COW copy moves
+        a block's scales with its bytes, keeping every stored token's
+        dequantization step attached to it."""
         # COW first: duplicate-dst lanes (all no-COW lanes share dst 0)
         # write identical values, so the scatter is collision-safe
         pool_k = [pk.at[cow_dst].set(pk[cow_src]) for pk in pool_k]
         pool_v = [pv.at[cow_dst].set(pv[cow_src]) for pv in pool_v]
-        views = [PagedKV(pk, pv, tables, prefix_lens)
-                 for pk, pv in zip(pool_k, pool_v)]
+        if pool_ks is not None:
+            pool_ks = [s.at[cow_dst].set(s[cow_src]) for s in pool_ks]
+            pool_vs = [s.at[cow_dst].set(s[cow_src]) for s in pool_vs]
+        else:
+            pool_ks = [None] * len(pool_k)
+            pool_vs = [None] * len(pool_v)
+        views = [PagedKV(pk, pv, tables, prefix_lens, ks, vs)
+                 for pk, pv, ks, vs in zip(pool_k, pool_v,
+                                           pool_ks, pool_vs)]
         logits, new_views = self._run_model(state_arrays, ids, views)
         last = jax.vmap(
             lambda lg, n: jax.lax.dynamic_index_in_dim(
@@ -511,11 +596,14 @@ class Engine:
         keys = jax.vmap(request_key)(seeds, counts)
         first = jax.vmap(sample_token)(last, keys, temps, top_ks, top_ps)
         return (first, [nv.k for nv in new_views],
-                [nv.v for nv in new_views])
+                [nv.v for nv in new_views],
+                [nv.k_scale for nv in new_views],
+                [nv.v_scale for nv in new_views])
 
     def _decode_fn(self, state_arrays, tokens, pos, counts, active, hist,
                    gates, seeds, temps, top_ks, top_ps, eos_ids, limits,
-                   tables, pool_k, pool_v, horizon, k_draft):
+                   tables, pool_k, pool_v, pool_ks, pool_vs, horizon,
+                   k_draft):
         """The horizon-scanned fused decode: ``lax.scan`` over ``horizon``
         fused steps, all slots, static shapes everywhere — the pool is
         the scan carry (donated on accelerators, so writes are in-place
@@ -545,13 +633,21 @@ class Engine:
         ``pos + n_emit`` onward before anything reads there, so it is
         never observed.  ``horizon`` and ``k_draft`` are static and
         ``nb = tables.shape[1]`` re-buckets by shape: one compiled
-        program per (horizon, nb, K) triple."""
+        program per (horizon, nb, K) triple.
+
+        A quantized pool's scale buffers (``pool_ks``/``pool_vs``) ride
+        the scan carry beside the pools they describe; the fp path
+        carries tuples of None — empty pytrees, so the scan's jaxpr is
+        unchanged with the knob off."""
         n, s = hist.shape
         lanes = jnp.arange(n)[:, None]
         j_idx = jnp.arange(k_draft + 1, dtype=counts.dtype)[None, :]
+        if pool_ks is None:
+            pool_ks = [None] * len(pool_k)
+            pool_vs = [None] * len(pool_v)
 
         def body(carry, _):
-            tok, p, cnt, act, hb, pk, pv = carry
+            tok, p, cnt, act, hb, pk, pv, pks, pvs = carry
             if k_draft:
                 drafts = draft_tokens(hb, p + 1, k_draft,
                                       self.config.spec_ngram)
@@ -560,7 +656,8 @@ class Engine:
                     [tok[:, None], jnp.maximum(drafts, 0)], axis=1)
             else:
                 ids = tok[:, None]
-            views = [PagedKV(k, v, tables, p) for k, v in zip(pk, pv)]
+            views = [PagedKV(k, v, tables, p, ks, vs)
+                     for k, v, ks, vs in zip(pk, pv, pks, pvs)]
             logits, new_views = self._run_model(state_arrays, ids, views)
             e = sample_window(logits, seeds, cnt, temps, top_ks, top_ps)
             if k_draft:
@@ -593,13 +690,17 @@ class Engine:
             harvest = jnp.where(emitted, e, -1)
             return ((nxt, new_p, new_cnt, act & ~done, hb,
                      tuple(v.k for v in new_views),
-                     tuple(v.v for v in new_views)), harvest)
+                     tuple(v.v for v in new_views),
+                     tuple(v.k_scale for v in new_views),
+                     tuple(v.v_scale for v in new_views)), harvest)
 
         init = (tokens, pos, counts, active, hist,
-                tuple(pool_k), tuple(pool_v))
-        (tok, p, cnt, act, hb, pk, pv), toks = jax.lax.scan(
+                tuple(pool_k), tuple(pool_v),
+                tuple(pool_ks), tuple(pool_vs))
+        (tok, p, cnt, act, hb, pk, pv, pks, pvs), toks = jax.lax.scan(
             body, init, None, length=horizon)
-        return (tok, p, cnt, act, hb), list(pk), list(pv), toks
+        return ((tok, p, cnt, act, hb), list(pk), list(pv),
+                list(pks), list(pvs), toks)
 
     # ------------------------------------------------------------ buckets
     def _bucket(self, prompt_len):
@@ -866,15 +967,16 @@ class Engine:
                        engine=self._profiler_name,
                        event_args={"batch_size": n, "lanes": lanes,
                                    "bucket": bucket}):
-            first, new_k, new_v = self._prefill(
+            first, new_k, new_v, new_ks, new_vs = self._prefill(
                 self._state_arrays, jnp.asarray(ids),
                 jnp.asarray(lengths), jnp.asarray(prefix_lens),
                 jnp.asarray(tables), jnp.asarray(cow_src),
                 jnp.asarray(cow_dst), jnp.asarray(counts),
                 self.pool.k, self.pool.v,
+                self.pool.k_scale, self.pool.v_scale,
                 jnp.asarray(seeds), jnp.asarray(temps),
                 jnp.asarray(top_ks), jnp.asarray(top_ps))
-        self.pool.rebind(new_k, new_v)
+        self.pool.rebind(new_k, new_v, new_ks, new_vs)
         self._prefill_calls += 1
         self._prefill_requests += n
         name = self._profiler_name
@@ -1072,12 +1174,14 @@ class Engine:
         self._sync_device_state()
         self._sync_tables(nb)
         seeds, temps, top_ks, top_ps, eos_ids, limits = self._d_params
-        (tok, p, cnt, act, hb), new_k, new_v, toks = self._decode(
-            self._state_arrays, self._d_tokens, self._d_pos,
-            self._d_counts, self._d_active, self._d_hist, self._d_gates,
-            seeds, temps, top_ks, top_ps, eos_ids, limits,
-            self._d_tables, self.pool.k, self.pool.v, h, k)
-        self.pool.rebind(new_k, new_v)
+        (tok, p, cnt, act, hb), new_k, new_v, new_ks, new_vs, toks = \
+            self._decode(
+                self._state_arrays, self._d_tokens, self._d_pos,
+                self._d_counts, self._d_active, self._d_hist,
+                self._d_gates, seeds, temps, top_ks, top_ps, eos_ids,
+                limits, self._d_tables, self.pool.k, self.pool.v,
+                self.pool.k_scale, self.pool.v_scale, h, k)
+        self.pool.rebind(new_k, new_v, new_ks, new_vs)
         self._d_tokens, self._d_pos = tok, p
         self._d_counts, self._d_active = cnt, act
         self._d_hist = hb
@@ -1085,7 +1189,9 @@ class Engine:
         # KV traffic actually gathered by the fallback scan (and the
         # upper bound for the block-culling Pallas kernel): every lane
         # reads its nb table-mapped blocks — k + v, all layers — per
-        # step (bytes_per_block already spans k+v and every layer)
+        # step.  bytes_per_block is the pool's ACTUAL footprint: int8
+        # payload + per-token f32 scales when quantized, so the quant
+        # ablation's bandwidth numbers come from this same telemetry.
         step_bytes = self.cache.num_slots * nb * self.pool.bytes_per_block
         self._kv_bytes_read += step_bytes * h
         _SRV_KV_BYTES.inc(step_bytes * h, engine=self._profiler_name)
@@ -1350,6 +1456,19 @@ class Engine:
             "kv_bytes_read": self._kv_bytes_read,
             "cow_copies": self._cow_copies,
             "preemptions": self._preemptions,
+            "dtype": str(jnp.dtype(self.pool.store_dtype)),
+            "quant_dtype": self.pool.quant_dtype,
+        }
+        s["quant"] = {
+            "weight_dtype": self._weight_dtype,
+            "kv_cache_dtype": self._kv_quant,
+            "quantized_weights": len(self._wq_dtypes),
+            # actual bytes the decode step streams for parameters —
+            # int8 payload + scale vectors for quantized entries, fp
+            # bytes for the rest
+            "weight_bytes": int(sum(
+                sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(a))
+                for a in self._state_arrays)),
         }
         s["spec"] = {
             "k": int(self.config.spec_k),

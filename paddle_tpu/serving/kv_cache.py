@@ -159,36 +159,90 @@ class PagedKV:
              tokens are written at positions pos .. pos+s-1 and attend
              over keys 0 .. pos+s-1 (ragged: only the table-mapped
              blocks are ever read)
+    k_scale, v_scale:  [num_blocks, block_size] f32, only when the pool
+             stores quantized blocks: the per-token dequantization step
+             written beside each int8 token by ``paged_write_quant``;
+             None on the fp path (attention then skips dequant).
     """
 
     k: jax.Array
     v: jax.Array
     tables: jax.Array
     pos: jax.Array
+    k_scale: jax.Array = None
+    v_scale: jax.Array = None
 
     @property
     def block_size(self):
         return self.k.shape[1]
 
 
-def paged_write(pool, new, tables, pos):
-    """Scatter ``new`` [B, s, H, D] into the paged ``pool``
-    [NB, bs, H, D] at per-lane positions ``pos`` [B] through the block
-    ``tables`` [B, nb].  Positions past the table's coverage — padding
-    lanes, frozen lanes whose table row was zeroed, write positions in
-    not-yet-allocated entries — resolve to block 0 (scratch), where
-    colliding garbage writes are harmless by convention."""
-    bs = pool.shape[1]
-    b, s = new.shape[0], new.shape[1]
+def _write_coords(bs, s, tables, pos):
+    """Per-token (block, offset) scatter coordinates [B, s] for a write
+    of ``s`` tokens at per-lane positions ``pos`` through ``tables``.
+    Positions past the table's coverage — padding lanes, frozen lanes
+    whose table row was zeroed, write positions in not-yet-allocated
+    entries — resolve to block 0 (scratch), where colliding garbage
+    writes are harmless by convention."""
     tpos = pos[:, None] + jnp.arange(s, dtype=pos.dtype)         # [B, s]
     blk_idx = tpos // bs
     in_range = blk_idx < tables.shape[1]
     blk_idx = jnp.clip(blk_idx, 0, tables.shape[1] - 1)
     blocks = jnp.take_along_axis(tables, blk_idx, axis=1)        # [B, s]
     blocks = jnp.where(in_range, blocks, 0)
-    offs = tpos % bs
+    return blocks, tpos % bs
+
+
+def paged_write(pool, new, tables, pos):
+    """Scatter ``new`` [B, s, H, D] into the paged ``pool``
+    [NB, bs, H, D] at per-lane positions ``pos`` [B] through the block
+    ``tables`` [B, nb] (out-of-coverage writes land in scratch — see
+    :func:`_write_coords`)."""
+    bs = pool.shape[1]
+    b, s = new.shape[0], new.shape[1]
+    blocks, offs = _write_coords(bs, s, tables, pos)
     flat = new.astype(pool.dtype).reshape((b * s,) + new.shape[2:])
     return pool.at[blocks.reshape(-1), offs.reshape(-1)].set(flat)
+
+
+#: symmetric int8 range used for quantized KV blocks
+KV_QMAX = 127.0
+
+
+def paged_write_quant(pool, scales, new, tables, pos):
+    """Quantize-at-append: scatter ``new`` [B, s, H, D] into the int8
+    ``pool`` [NB, bs, H, D] with one f32 absmax scale per TOKEN written
+    beside it in ``scales`` [NB, bs].
+
+    The scale granularity is per block-position, not per block: decode
+    appends one token at a time, so a coarser per-block scale would have
+    to requantize every already-written position of the block whenever a
+    new token raised the block's absmax — making stored bytes (and
+    therefore attention output) depend on append timing.  Per-token
+    quantization is write-once: a token's stored bytes are a pure
+    function of its own k/v vector, which preserves the engine's
+    bitwise invariants (horizon partitioning, prefill-vs-decode replay
+    on preemption resume, prefix-block sharing) within a quant config.
+    The cost is 4 bytes per token against ``kv_heads*head_dim`` int8
+    payload bytes.
+
+    The per-token floor (``maximum(absmax, 1e-8)``) makes all-zero
+    vectors — scratch writes, padding lanes — quantize to exact zeros,
+    matching the fp pool's zero-initialized blocks."""
+    bs = pool.shape[1]
+    b, s = new.shape[0], new.shape[1]
+    blocks, offs = _write_coords(bs, s, tables, pos)
+    x = new.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(x), axis=(2, 3))                    # [B, s]
+    step = jnp.maximum(absmax, 1e-8) / KV_QMAX
+    q = jnp.clip(jnp.round(x / step[..., None, None]),
+                 -KV_QMAX, KV_QMAX)
+    flat = q.astype(pool.dtype).reshape((b * s,) + new.shape[2:])
+    bi, oi = blocks.reshape(-1), offs.reshape(-1)
+    new_pool = pool.at[bi, oi].set(flat)
+    new_scales = scales.at[bi, oi].set(
+        step.reshape(-1).astype(scales.dtype))
+    return new_pool, new_scales
 
 
 class PagedKVPool:
@@ -201,21 +255,44 @@ class PagedKVPool:
     a host-side refcount: a slot-table entry and a prefix-store node
     each hold one reference; a block returns to the free list when the
     last reference is released — which is what makes prefix sharing
-    copy-free and preemption just bookkeeping."""
+    copy-free and preemption just bookkeeping.
+
+    ``quant_dtype="int8"`` switches block storage to int8 with a
+    per-layer ``[num_blocks, block_size]`` f32 scale array beside each
+    k/v buffer (``paged_write_quant`` fills both; attention dequantizes
+    after the gather).  All block bookkeeping — refcounts, leasing,
+    COW, preemption — is unchanged: it moves block ids, not bytes."""
 
     def __init__(self, num_layers, num_blocks, block_size, kv_heads,
-                 head_dim, dtype=jnp.float32):
+                 head_dim, dtype=jnp.float32, quant_dtype=None):
         if num_blocks < 2:
             raise ValueError("paged pool needs >= 2 blocks (one scratch)")
+        if quant_dtype not in (None, "int8"):
+            raise ValueError(
+                f"unsupported KV quant_dtype {quant_dtype!r} "
+                "(supported: None, 'int8')")
         self.num_layers = num_layers
         self.num_blocks = num_blocks
         self.block_size = block_size
         self.kv_heads = kv_heads
         self.head_dim = head_dim
         self.dtype = dtype
+        self.quant_dtype = quant_dtype
+        store_dtype = jnp.int8 if quant_dtype else dtype
+        self.store_dtype = store_dtype
         shape = (num_blocks, block_size, kv_heads, head_dim)
-        self.k = [jnp.zeros(shape, dtype) for _ in range(num_layers)]
-        self.v = [jnp.zeros(shape, dtype) for _ in range(num_layers)]
+        self.k = [jnp.zeros(shape, store_dtype) for _ in range(num_layers)]
+        self.v = [jnp.zeros(shape, store_dtype) for _ in range(num_layers)]
+        if quant_dtype:
+            # zero scales dequantize the zero-initialized blocks to the
+            # exact 0.0 the fp pool starts with
+            sshape = (num_blocks, block_size)
+            self.k_scale = [jnp.zeros(sshape, jnp.float32)
+                            for _ in range(num_layers)]
+            self.v_scale = [jnp.zeros(sshape, jnp.float32)
+                            for _ in range(num_layers)]
+        else:
+            self.k_scale = self.v_scale = None
         self._refs = np.zeros(num_blocks, np.int32)
         self._refs[0] = 1                    # scratch: pinned forever
         self._free = list(range(num_blocks - 1, 0, -1))
@@ -235,8 +312,16 @@ class PagedKVPool:
 
     @property
     def bytes_per_block(self):
-        return (2 * self.num_layers * self.block_size * self.kv_heads
-                * self.head_dim * jnp.dtype(self.dtype).itemsize)
+        """ACTUAL device bytes per block across k+v and every layer:
+        payload at the storage dtype plus, when quantized, the 4-byte
+        f32 scale stored beside each token — the figure the engine's
+        ``serving.kv_bytes_read`` accounting multiplies, so quant bench
+        numbers come from real bytes, not an fp-equivalent estimate."""
+        token_bytes = (self.kv_heads * self.head_dim
+                       * jnp.dtype(self.store_dtype).itemsize)
+        if self.quant_dtype:
+            token_bytes += jnp.dtype(jnp.float32).itemsize
+        return 2 * self.num_layers * self.block_size * token_bytes
 
     def alloc(self):
         """Claim a free block (refcount 1), or None when exhausted."""
@@ -267,10 +352,15 @@ class PagedKVPool:
     def refcount(self, block_id):
         return int(self._refs[block_id])
 
-    def rebind(self, new_k, new_v):
-        """Adopt updated pool buffers returned by a jitted program."""
+    def rebind(self, new_k, new_v, new_k_scale=None, new_v_scale=None):
+        """Adopt updated pool buffers returned by a jitted program
+        (scale buffers ride along on the quantized path; fp-path callers
+        may pass the program's ``None`` placeholders back unchanged)."""
         self.k = list(new_k)
         self.v = list(new_v)
+        if self.quant_dtype:
+            self.k_scale = list(new_k_scale)
+            self.v_scale = list(new_v_scale)
 
 
 class PagedKVCache:
@@ -286,7 +376,7 @@ class PagedKVCache:
 
     def __init__(self, num_layers, num_slots, max_seq_len, block_size,
                  kv_heads, head_dim, dtype=jnp.float32, num_blocks=0,
-                 extra_blocks=0):
+                 extra_blocks=0, quant_dtype=None):
         self.num_layers = num_layers
         self.num_slots = num_slots
         self.max_seq_len = max_seq_len
@@ -301,7 +391,8 @@ class PagedKVCache:
             num_blocks = (1 + num_slots * self.max_blocks_per_slot
                           + extra_blocks)
         self.pool = PagedKVPool(num_layers, num_blocks, block_size,
-                                kv_heads, head_dim, dtype)
+                                kv_heads, head_dim, dtype,
+                                quant_dtype=quant_dtype)
         self.tables = np.zeros((num_slots, self.max_blocks_per_slot),
                                np.int32)
         self.tables_dirty = True
@@ -377,9 +468,12 @@ class PagedKVCache:
         (the fused decode step runs every slot; inactive lanes are
         masked by their pos and write through zeroed table rows into
         scratch)."""
-        return [PagedKV(self.pool.k[i], self.pool.v[i], tables, pos)
+        ks = self.pool.k_scale or [None] * self.num_layers
+        vs = self.pool.v_scale or [None] * self.num_layers
+        return [PagedKV(self.pool.k[i], self.pool.v[i], tables, pos,
+                        ks[i], vs[i])
                 for i in range(self.num_layers)]
 
-    def rebind(self, new_k, new_v):
+    def rebind(self, new_k, new_v, new_k_scale=None, new_v_scale=None):
         """Adopt updated pool buffers returned by a jitted program."""
-        self.pool.rebind(new_k, new_v)
+        self.pool.rebind(new_k, new_v, new_k_scale, new_v_scale)

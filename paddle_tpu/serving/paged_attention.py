@@ -60,26 +60,39 @@ except Exception:  # pragma: no cover - exercised only without pallas
     _HAVE_PALLAS = False
 
 
-def paged_attention(q, k_pool, v_pool, tables, pos):
+def paged_attention(q, k_pool, v_pool, tables, pos,
+                    k_scale=None, v_scale=None):
     """Route to the Pallas decode kernel (TPU, s == 1) or the XLA
     online-softmax fallback (everything else — including all of CPU
-    tier-1, which is also the bitwise parity reference)."""
+    tier-1, which is also the bitwise parity reference).
+
+    ``k_scale``/``v_scale`` ([NB, bs] f32, or None) mark a quantized
+    pool: both implementations dequantize each gathered block token-wise
+    (``block.astype(f32) * scale``) before the softmax math, so the
+    int8 path reuses the exact fp recurrence — and inherits its
+    nb-invariance — just over dequantized values."""
     impl = os.environ.get("PADDLE_TPU_PAGED_ATTN", "auto")
     use_pallas = impl == "pallas" or (
         impl == "auto" and q.shape[1] == 1
         and jax.default_backend() == "tpu")
     if use_pallas:
-        return _pallas_paged_decode(q, k_pool, v_pool, tables, pos)
-    return _xla_paged_attention(q, k_pool, v_pool, tables, pos)
+        return _pallas_paged_decode(q, k_pool, v_pool, tables, pos,
+                                    k_scale, v_scale)
+    return _xla_paged_attention(q, k_pool, v_pool, tables, pos,
+                                k_scale, v_scale)
 
 
 # ------------------------------------------------------------------ XLA
 
-def _xla_paged_attention(q, k_pool, v_pool, tables, pos):
+def _xla_paged_attention(q, k_pool, v_pool, tables, pos,
+                         k_scale=None, v_scale=None):
     """Blockwise online-softmax over the block table, one ``lax.scan``
     step per table column.  Fixed shapes per step ([B, bs] gathers), so
     the whole thing traces into the engine's horizon scan; see the
-    module docstring for the nb-invariance argument."""
+    module docstring for the nb-invariance argument (dequantizing a
+    gathered block is an elementwise pre-multiply on values the masked
+    positions never contribute, so the argument survives int8 pools
+    unchanged)."""
     b, s, qh, d = q.shape
     bs, kh = k_pool.shape[1], k_pool.shape[2]
     g = qh // kh
@@ -94,6 +107,9 @@ def _xla_paged_attention(q, k_pool, v_pool, tables, pos):
         blocks = jnp.take(tables, i, axis=1)                     # [B]
         kb = k_pool[blocks].astype(jnp.float32)                  # [B,bs,KH,D]
         vb = v_pool[blocks].astype(jnp.float32)
+        if k_scale is not None:
+            kb = kb * k_scale[blocks][:, :, None, None]
+            vb = vb * v_scale[blocks][:, :, None, None]
         sc = jnp.einsum("bskgd,btkd->bskgt", qg, kb)
         key_idx = i * bs + jnp.arange(bs, dtype=pos.dtype)       # [bs]
         vis = key_idx[None, None, :] <= q_pos[:, :, None]        # [B,s,bs]
@@ -122,13 +138,20 @@ def _xla_paged_attention(q, k_pool, v_pool, tables, pos):
 
 # --------------------------------------------------------------- Pallas
 
-def _paged_decode_kernel(tables, pos, q_ref, k_ref, v_ref, o_ref,
-                         m_ref, l_ref, acc_ref, *, block_size, groups,
-                         nb, scale):
+def _paged_decode_kernel(tables, pos, q_ref, k_ref, v_ref, *refs,
+                         block_size, groups, nb, scale, quantized):
     """One grid cell = (lane b, table column i): accumulate pool block
     ``tables[b, i]`` into lane b's online-softmax state.  The k/v
     BlockSpec index maps already selected the pool block from the
-    scalar-prefetched table, so refs hold exactly one block."""
+    scalar-prefetched table, so refs hold exactly one block.  On a
+    quantized pool two extra [1, bs] scale refs ride between the pool
+    refs and the output: the block is dequantized token-wise right
+    after its DMA, before any softmax math."""
+    if quantized:
+        ksc_ref, vsc_ref, o_ref, m_ref, l_ref, acc_ref = refs
+    else:
+        ksc_ref = vsc_ref = None
+        o_ref, m_ref, l_ref, acc_ref = refs
     b, i = pl.program_id(0), pl.program_id(1)
 
     @pl.when(i == 0)
@@ -149,6 +172,9 @@ def _paged_decode_kernel(tables, pos, q_ref, k_ref, v_ref, o_ref,
         q = q.reshape(kh, groups, d)
         k = k_ref[0].astype(jnp.float32)                  # [bs, KH, D]
         v = v_ref[0].astype(jnp.float32)
+        if quantized:
+            k = k * ksc_ref[0][:, None, None]
+            v = v * vsc_ref[0][:, None, None]
         sc = jax.lax.dot_general(
             q, k, (((2,), (2,)), ((0,), (1,))),
             preferred_element_type=jnp.float32)           # [KH, G, bs]
@@ -173,32 +199,47 @@ def _paged_decode_kernel(tables, pos, q_ref, k_ref, v_ref, o_ref,
         o_ref[0] = out.reshape(o_ref.shape[1:]).astype(o_ref.dtype)
 
 
-def _pallas_paged_decode(q, k_pool, v_pool, tables, pos):
+def _pallas_paged_decode(q, k_pool, v_pool, tables, pos,
+                         k_scale=None, v_scale=None):
     """Decode-path (s == 1) ragged kernel: grid (B, nb), block table +
     lane lengths scalar-prefetched so the k/v index maps gather pool
-    blocks directly and ``pl.when`` culls dead columns."""
+    blocks directly and ``pl.when`` culls dead columns.  Quantized
+    pools add two [1, bs] scale inputs gathered through the same table
+    index map as their blocks."""
     if not _HAVE_PALLAS:  # pragma: no cover
-        return _xla_paged_attention(q, k_pool, v_pool, tables, pos)
+        return _xla_paged_attention(q, k_pool, v_pool, tables, pos,
+                                    k_scale, v_scale)
     b, s, qh, d = q.shape
     assert s == 1, "the Pallas kernel serves single-token decode"
     bs, kh = k_pool.shape[1], k_pool.shape[2]
     g = qh // kh
     nb = tables.shape[1]
     q2 = q.reshape(b, qh, d)
+    quantized = k_scale is not None
 
     kernel = functools.partial(
         _paged_decode_kernel, block_size=bs, groups=g, nb=nb,
-        scale=1.0 / math.sqrt(d))
+        scale=1.0 / math.sqrt(d), quantized=quantized)
+    in_specs = [
+        pl.BlockSpec((1, qh, d), lambda bb, i, tables, pos: (bb, 0, 0)),
+        pl.BlockSpec((1, bs, kh, d),
+                     lambda bb, i, tables, pos: (tables[bb, i], 0, 0, 0)),
+        pl.BlockSpec((1, bs, kh, d),
+                     lambda bb, i, tables, pos: (tables[bb, i], 0, 0, 0)),
+    ]
+    operands = [tables, pos, q2, k_pool, v_pool]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, bs),
+                         lambda bb, i, tables, pos: (tables[bb, i], 0)),
+            pl.BlockSpec((1, bs),
+                         lambda bb, i, tables, pos: (tables[bb, i], 0)),
+        ]
+        operands += [k_scale, v_scale]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,                 # tables, pos
         grid=(b, nb),
-        in_specs=[
-            pl.BlockSpec((1, qh, d), lambda bb, i, tables, pos: (bb, 0, 0)),
-            pl.BlockSpec((1, bs, kh, d),
-                         lambda bb, i, tables, pos: (tables[bb, i], 0, 0, 0)),
-            pl.BlockSpec((1, bs, kh, d),
-                         lambda bb, i, tables, pos: (tables[bb, i], 0, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, qh, d),
                                lambda bb, i, tables, pos: (bb, 0, 0)),
         scratch_shapes=[
@@ -213,5 +254,5 @@ def _pallas_paged_decode(q, k_pool, v_pool, tables, pos):
         out_shape=jax.ShapeDtypeStruct((b, qh, d), q.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
-    )(tables, pos, q2, k_pool, v_pool)
+    )(*operands)
     return out.reshape(b, s, qh, d)

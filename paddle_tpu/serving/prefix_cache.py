@@ -109,15 +109,21 @@ class PrefixCache:
     """
 
     def __init__(self, num_layers, block_size, kv_heads, head_dim,
-                 dtype=jnp.float32, budget_bytes=0, pool=None):
+                 dtype=jnp.float32, budget_bytes=0, pool=None,
+                 bytes_per_block=None):
         self.num_layers = num_layers
         self.block_size = int(block_size)
         self.kv_heads = kv_heads
         self.head_dim = head_dim
         self.dtype = dtype
         itemsize = jnp.dtype(dtype).itemsize
-        self.bytes_per_block = (2 * num_layers * self.block_size
-                                * kv_heads * head_dim * itemsize)
+        # the engine overrides bytes_per_block in unified-pool mode so
+        # the byte budget caps pinned blocks at the pool's ACTUAL block
+        # size (a quantized pool's blocks are ~4x smaller, so the same
+        # budget pins ~4x more of them)
+        self.bytes_per_block = bytes_per_block or (
+            2 * num_layers * self.block_size
+            * kv_heads * head_dim * itemsize)
         self.capacity = max(0, int(budget_bytes) // self.bytes_per_block) \
             if self.block_size else 0
         #: unified-pool mode: hold refcounted blocks of the engine's
