@@ -868,6 +868,80 @@ def _bench_quant_ablation(backend, on_tpu, rng):
 #: reconstructable across PRs from the file's git history alone;
 #: 3 adds roofline_bw_gbs — the per-backend bandwidth (datasheet or
 #: memcpy-probed) every roofline column in the row was computed from
+def _bench_tracing_overhead(backend, on_tpu, rng):
+    """Observability phase-2 overhead gate: the SAME b1 horizon-8
+    decode stream as _bench_engine_horizons, run PAIRED in one process
+    — once with request tracing + SLO tracking on (the serving
+    default), once with ``request_tracing=False`` — so the overhead
+    percentage compares two engines that differ ONLY in the flight
+    record appends and SLO window observes on the hot path.  The traced
+    row's tokens/s is the number the acceptance gate holds within 3 %
+    of the horizon-8 engine baseline."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.serving import Engine, EngineConfig, SamplingParams
+
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=32000, hidden_size=1536,
+                        intermediate_size=4096, num_hidden_layers=12,
+                        num_attention_heads=12,
+                        max_position_embeddings=1024)
+        max_seq, prompt_len, new_tokens = 768, 512, 128
+        dtype = jnp.bfloat16
+    else:
+        cfg = GPTConfig(vocab_size=1024, hidden_size=256,
+                        intermediate_size=512, num_hidden_layers=2,
+                        num_attention_heads=4,
+                        max_position_embeddings=128)
+        max_seq, prompt_len, new_tokens = 64, 16, 32
+        dtype = jnp.float32
+
+    horizon = 8
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    prompt = rng.randint(0, cfg.vocab_size, prompt_len).tolist()
+    sp = SamplingParams(max_new_tokens=new_tokens)
+
+    def run(traced):
+        kw = dict(num_slots=1, max_seq_len=max_seq, max_horizon=16,
+                  cache_dtype=dtype, request_tracing=traced)
+        if traced:
+            # generous thresholds: the gauge publishes fire per retire,
+            # which is the cost being measured, not the breach math
+            kw.update(slo_ttft_s=60.0, slo_tpot_s=10.0)
+        eng = Engine(model, EngineConfig(**kw), register_profiler=False)
+        # warm both compiles (prefill bucket + this horizon bucket)
+        eng.submit(prompt, sp)
+        while eng.scheduler.has_work:
+            eng.step(horizon=horizon)
+        best = None
+        for _ in range(3):
+            eng.submit(prompt, sp)
+            eng.admit()               # prefill outside the decode timer
+            t0 = time.time()
+            while eng.scheduler.has_work:
+                eng.step(horizon=horizon)
+            dt = time.time() - t0
+            best = dt if best is None else min(best, dt)
+        eng.close()
+        return new_tokens / best
+
+    off = run(False)
+    on = run(True)
+    return [{
+        "metric": f"engine decode tokens/s b1 horizon{horizon} traced "
+                  f"(prefill {prompt_len} + {new_tokens} new, "
+                  f"{backend})",
+        "value": round(on, 1),
+        "unit": "tokens/s",
+        "untraced_tokens_per_s": round(off, 1),
+        "tracing_overhead_pct": round((off - on) / off * 100.0, 2),
+    }]
+
+
 SCHEMA_VERSION = 3
 
 
@@ -887,9 +961,35 @@ def _git_sha():
         return "unknown"
 
 
-def main():
+#: --only choices: "core" is the raw per-step/scan driver loop, the
+#: rest map 1:1 onto the _bench_* section functions
+SECTIONS = ("core", "engine_horizons", "engine", "paged_ablation",
+            "prefix_prefill", "spec_decode", "quant_ablation",
+            "tracing_overhead")
+
+
+def main(argv=None):
+    import argparse
+
     import jax
     import jax.numpy as jnp
+
+    parser = argparse.ArgumentParser(
+        description="decode-path benchmark suite")
+    parser.add_argument(
+        "--only", default=None,
+        help="comma-separated section filter (choices: %s); a filtered "
+             "run only replaces its OWN rows in DECODE_BENCH.json"
+             % ",".join(SECTIONS))
+    args = parser.parse_args(argv)
+    if args.only is None:
+        only = set(SECTIONS)
+    else:
+        only = set(s.strip() for s in args.only.split(",") if s.strip())
+        unknown = only - set(SECTIONS)
+        if unknown:
+            parser.error("unknown section(s) %s; choices: %s"
+                         % (sorted(unknown), ",".join(SECTIONS)))
 
     backend = jax.default_backend()
     on_tpu = backend in ("tpu", "axon")
@@ -920,7 +1020,7 @@ def main():
     bw_gbs = _backend_bandwidth_gbs(backend)
     roofline_ms = weight_bytes / (bw_gbs * 1e9) * 1e3
 
-    for b in bsizes:
+    for b in (bsizes if "core" in only else ()):
         P = {
             "layers": _build_params(rng, L, dim, n_head, ffn, dtype),
             "embed": jnp.asarray(
@@ -996,12 +1096,20 @@ def main():
         }
         results.append(row)
 
-    results.extend(_bench_engine_horizons(backend, on_tpu, rng))
-    results.append(_bench_engine(backend, on_tpu, rng))
-    results.extend(_bench_paged_ablation(backend, on_tpu, rng))
-    results.extend(_bench_prefix_prefill(backend, on_tpu, rng))
-    results.extend(_bench_spec_decode(backend, on_tpu, rng))
-    results.extend(_bench_quant_ablation(backend, on_tpu, rng))
+    if "engine_horizons" in only:
+        results.extend(_bench_engine_horizons(backend, on_tpu, rng))
+    if "engine" in only:
+        results.append(_bench_engine(backend, on_tpu, rng))
+    if "paged_ablation" in only:
+        results.extend(_bench_paged_ablation(backend, on_tpu, rng))
+    if "prefix_prefill" in only:
+        results.extend(_bench_prefix_prefill(backend, on_tpu, rng))
+    if "spec_decode" in only:
+        results.extend(_bench_spec_decode(backend, on_tpu, rng))
+    if "quant_ablation" in only:
+        results.extend(_bench_quant_ablation(backend, on_tpu, rng))
+    if "tracing_overhead" in only:
+        results.extend(_bench_tracing_overhead(backend, on_tpu, rng))
 
     # merge-preserving write: rows from OTHER backends (each metric
     # string ends with its backend tag, as "(cpu)" or "..., cpu)")
@@ -1018,6 +1126,16 @@ def main():
     def _same_backend(metric):
         return metric.endswith((f"({backend})", f", {backend})"))
 
+    # a full run replaces every same-backend row; a --only run replaces
+    # just the metrics it re-measured, so the other sections' rows on
+    # this backend survive
+    new_metrics = {r["metric"] for r in results}
+
+    def _keep(metric):
+        if args.only is not None:
+            return metric not in new_metrics
+        return not _same_backend(metric)
+
     kept, run_id = [], 1
     if os.path.exists(out):
         try:
@@ -1026,7 +1144,7 @@ def main():
             prev_rows = prev.get("results", [])
             latest = {}
             for r in prev_rows:
-                if not _same_backend(r.get("metric", "")):
+                if _keep(r.get("metric", "")):
                     latest[r.get("metric", "")] = r
             kept = list(latest.values())
             run_id = 1 + max((int(r.get("run_id", 0))

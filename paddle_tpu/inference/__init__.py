@@ -167,7 +167,20 @@ def create_llm_engine(model, **config_kwargs):
     append/COW, dequantize after the attention gather), cutting decode
     KV traffic ~4x at f32 and ~2x-ing how many sequences fit a fixed
     pool byte budget; None for either knob keeps the fp path
-    bitwise-untouched)."""
+    bitwise-untouched;
+    request_tracing / flight_recorder_capacity — per-request lifecycle
+    flight records (queued/prefill/decode/preempt/finish events with
+    monotonic timestamps) retained for all live plus the last-N
+    finished requests, inspectable via ``engine.recorder`` or the
+    ``/debug/requests`` endpoint;
+    slo_ttft_s / slo_tpot_s / slo_abort_rate (+ slo_target,
+    slo_fast_window, slo_slow_window) — declared SLO objectives over
+    step-sized rolling windows with multi-window burn-rate health,
+    published as ``slo.*`` gauges and driving ``/readyz``;
+    telemetry_port — start an HTTP telemetry endpoint (``/metrics``,
+    ``/healthz``, ``/readyz``, ``/debug/requests``, ``/debug/slo``,
+    ``/trace``) on a background thread at engine construction, 0 for an
+    ephemeral port, stopped by ``engine.close()``)."""
     from ..serving import Engine, EngineConfig
 
     return Engine(model, EngineConfig(**config_kwargs))

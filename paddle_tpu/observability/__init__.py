@@ -20,12 +20,26 @@ Three layers (see each module's docstring):
   one context manager emitting a ``jax.profiler.TraceAnnotation``, a
   histogram observation, and a begin/end timeline pair.
 
-CLI: ``python -m paddle_tpu.observability {snapshot,prometheus,trace}``.
+Phase 2 (request-scoped + externally visible):
+
+* :mod:`~paddle_tpu.observability.tracing` — per-request
+  :class:`RequestTrace` flight records in a bounded
+  :class:`FlightRecorder` (all live + last-N finished), exportable as
+  chrome async spans.
+* :mod:`~paddle_tpu.observability.slo` — declared objectives over
+  step-sized rolling windows; compliance, multi-window burn rate, and
+  an overall ``slo_healthy`` readiness signal.
+* :mod:`~paddle_tpu.observability.server` — stdlib HTTP exporter
+  (``/metrics``, ``/healthz``, ``/readyz``, ``/debug/requests``,
+  ``/debug/slo``, ``/trace``) on a background thread.
+
+CLI: ``python -m paddle_tpu.observability
+{snapshot,prometheus,trace,serve}``.
 """
 
 from __future__ import annotations
 
-from . import events, metrics
+from . import events, metrics, slo, tracing
 from .events import export_chrome_trace
 from .metrics import (
     Counter,
@@ -38,16 +52,24 @@ from .metrics import (
     histogram,
     render_prometheus,
     snapshot,
+    validate_exposition,
     value,
 )
+from .server import TelemetryServer
+from .slo import Objective, SLOTracker
 from .span import current_span, span, span_depth
+from .tracing import FlightRecorder, RequestTrace
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "Registry",
     "counter", "gauge", "histogram", "value",
     "default_registry", "snapshot", "render_prometheus",
+    "validate_exposition",
     "events", "metrics", "span", "current_span", "span_depth",
     "export_chrome_trace", "reset",
+    "slo", "tracing",
+    "RequestTrace", "FlightRecorder", "Objective", "SLOTracker",
+    "TelemetryServer",
 ]
 
 

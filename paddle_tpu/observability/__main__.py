@@ -5,10 +5,14 @@ Modes:
   snapshot      nested JSON of every metric + legacy provider (default)
   prometheus    text exposition (# HELP / # TYPE / samples)
   trace         chrome-trace JSON of the event timeline
+  serve         start the telemetry HTTP endpoint (blocks; --port,
+                --duration to exit after N seconds)
 
 ``-o FILE`` writes to a file instead of stdout. ``--exec SCRIPT`` runs a
 Python file first (in this process), so the dump reflects an actual
-workload — the one-process analog of scraping a serving worker.
+workload — the one-process analog of scraping a serving worker. With
+``serve``, ``--exec`` runs the script while the endpoint is already up,
+so it can be scraped mid-workload.
 """
 
 from __future__ import annotations
@@ -23,12 +27,21 @@ def main(argv=None):
         prog="python -m paddle_tpu.observability",
         description="dump paddle_tpu observability state")
     parser.add_argument("mode", nargs="?", default="snapshot",
-                        choices=("snapshot", "prometheus", "trace"))
+                        choices=("snapshot", "prometheus", "trace",
+                                 "serve"))
     parser.add_argument("-o", "--output", default=None,
                         help="write to FILE instead of stdout")
     parser.add_argument("--exec", dest="script", default=None,
                         help="run a Python script first, then dump")
+    parser.add_argument("--port", type=int, default=9400,
+                        help="serve mode: port to bind (0 = ephemeral)")
+    parser.add_argument("--duration", type=float, default=None,
+                        help="serve mode: exit after N seconds "
+                        "(default: serve until interrupted)")
     args = parser.parse_args(argv)
+
+    if args.mode == "serve":
+        return _serve(args)
 
     if args.script:
         with open(args.script) as f:
@@ -49,6 +62,32 @@ def main(argv=None):
             f.write(text)
     else:
         sys.stdout.write(text if text.endswith("\n") else text + "\n")
+    return 0
+
+
+def _serve(args):
+    import time
+
+    from .server import TelemetryServer
+
+    srv = TelemetryServer(port=args.port).start()
+    print(f"telemetry listening on {srv.url()} "
+          f"(endpoints: /metrics /healthz /readyz /debug/requests "
+          f"/debug/slo /trace)", flush=True)
+    try:
+        if args.script:
+            with open(args.script) as f:
+                code = compile(f.read(), args.script, "exec")
+            exec(code, {"__name__": "__main__", "__file__": args.script})
+        if args.duration is not None:
+            time.sleep(args.duration)
+        else:
+            while True:
+                time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.stop()
     return 0
 
 

@@ -138,18 +138,30 @@ class EventLog:
             self._ring.clear()
             self._dropped = 0
 
-    def export_chrome_trace(self, file=None):
+    def export_chrome_trace(self, file=None, extra=None):
         """Chrome Trace Event JSON for the current ring contents, sorted
         by timestamp (chrome requires monotonically non-decreasing ts
         within a (pid, tid); sorting globally satisfies the stricter
         whole-file ordering our tests assert). ``file`` may be a path or
-        a writable file object; returns the JSON string either way."""
-        evs = sorted(self.events(), key=lambda e: e.ts)
+        a writable file object; returns the JSON string either way.
+
+        ``extra`` merges pre-rendered chrome events (dicts with a
+        ``ts`` in µs — e.g. the flight recorder's per-request async
+        spans) into the same timeline.  The metadata header carries
+        ``dropped_events`` plus process identity (``process_name``,
+        ``git_sha``) so a truncated ring or a stale build is visible
+        right in Perfetto."""
+        chrome = [e.to_chrome() for e in self.events()]
+        if extra:
+            chrome.extend(extra)
+        chrome.sort(key=lambda e: e["ts"])
         doc = {
-            "traceEvents": [e.to_chrome() for e in evs],
+            "traceEvents": chrome,
             "displayTimeUnit": "ms",
             "metadata": {"producer": "paddle_tpu.observability",
-                         "dropped_events": self._dropped},
+                         "dropped_events": self._dropped,
+                         "process_name": _process_name(),
+                         "git_sha": _git_sha()},
         }
         text = json.dumps(doc)
         if file is not None:
@@ -159,6 +171,33 @@ class EventLog:
                 with open(file, "w") as f:
                     f.write(text)
         return text
+
+
+def _process_name():
+    import sys
+
+    return f"python:{os.path.basename(sys.argv[0] or 'interactive')}"
+
+
+_GIT_SHA = None
+
+
+def _git_sha():
+    """Short git SHA of the working tree, best-effort and cached (trace
+    export must never fail or block on a missing git)."""
+    global _GIT_SHA
+    if _GIT_SHA is None:
+        try:
+            import subprocess
+
+            _GIT_SHA = subprocess.run(
+                ["git", "rev-parse", "--short", "HEAD"],
+                capture_output=True, text=True, timeout=5,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            ).stdout.strip() or "unknown"
+        except Exception:
+            _GIT_SHA = "unknown"
+    return _GIT_SHA
 
 
 # ------------------------------------------------------------- default log
@@ -199,5 +238,5 @@ def set_capacity(capacity):
     _default_log.set_capacity(capacity)
 
 
-def export_chrome_trace(file=None):
-    return _default_log.export_chrome_trace(file=file)
+def export_chrome_trace(file=None, extra=None):
+    return _default_log.export_chrome_trace(file=file, extra=extra)

@@ -26,6 +26,7 @@ returning ``{counter: value}`` per subsystem) lives HERE now;
 from __future__ import annotations
 
 import collections
+import re
 import threading
 
 import numpy as np
@@ -58,6 +59,27 @@ def _label_prom(key):
 def _escape(v):
     return str(v).replace("\\", r"\\").replace('"', r"\"").replace(
         "\n", r"\n")
+
+
+def _escape_help(v):
+    """HELP text escapes only backslash and newline (quotes stay raw),
+    per the exposition format spec."""
+    return str(v).replace("\\", r"\\").replace("\n", r"\n")
+
+
+def _fmt_value(v):
+    """Render a sample value in canonical exposition form: whole
+    numbers as ints, non-finite floats as ``NaN``/``+Inf``/``-Inf``
+    (Python's ``nan``/``inf`` spellings are not in the grammar)."""
+    f = float(v)
+    if f != f:
+        return "NaN"
+    if f == float("inf"):
+        return "+Inf"
+    if f == float("-inf"):
+        return "-Inf"
+    i = int(f)
+    return str(i) if i == f else repr(f)
 
 
 def _prom_name(name):
@@ -340,7 +362,7 @@ class Registry:
         for name, m in metrics:
             pname = _prom_name(name)
             if m.help:
-                lines.append(f"# HELP {pname} {m.help}")
+                lines.append(f"# HELP {pname} {_escape_help(m.help)}")
             lines.append(f"# TYPE {pname} {m.kind}")
             if isinstance(m, Histogram):
                 for key in sorted(m._values):
@@ -357,21 +379,23 @@ class Registry:
                         f"{_label_prom(key + (('le', '+Inf'),))} "
                         f"{slot.count}")
                     lines.append(
-                        f"{pname}_sum{_label_prom(key)} {slot.sum}")
+                        f"{pname}_sum{_label_prom(key)} "
+                        f"{_fmt_value(slot.sum)}")
                     lines.append(
                         f"{pname}_count{_label_prom(key)} {slot.count}")
             else:
                 for key in sorted(m._values):
                     lines.append(
                         f"{pname}{_label_prom(key)} "
-                        f"{_as_scalar(m._values[key][0])}")
+                        f"{_fmt_value(m._values[key][0])}")
         for sub, counters in sorted(self.provider_counters().items()):
             base = _prom_name(sub)
             lines.append(f"# TYPE {base} gauge")
             for cname, v in sorted(counters.items()):
                 if isinstance(v, (int, float)):
                     lines.append(
-                        f"{base}{{counter=\"{_escape(cname)}\"}} {v}")
+                        f"{base}{{counter=\"{_escape(cname)}\"}} "
+                        f"{_fmt_value(v)}")
         return "\n".join(lines) + "\n"
 
 
@@ -381,6 +405,164 @@ def _as_scalar(v):
     f = float(v)
     i = int(f)
     return i if i == f else f
+
+
+# ----------------------------------------------------- exposition checker
+_EXPO_NAME = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*\Z")
+_EXPO_LABEL_NAME = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*\Z")
+_EXPO_VALUE = re.compile(
+    r"(?:[+-]?(?:\d+\.?\d*|\.\d+)(?:[eE][+-]?\d+)?|NaN|[+-]?Inf)\Z")
+_EXPO_TYPES = ("counter", "gauge", "histogram", "summary", "untyped")
+
+
+def _parse_labels(raw, errors, lineno):
+    """Parse the inside of ``{...}``; returns {name: value} or None on
+    error.  Hand-rolled scanner because label VALUES may contain
+    escaped quotes/commas a regex split would mangle."""
+    labels = {}
+    i, n = 0, len(raw)
+    while i < n:
+        eq = raw.find("=", i)
+        if eq < 0:
+            errors.append(f"line {lineno}: label without '=': {raw[i:]!r}")
+            return None
+        lname = raw[i:eq]
+        if not _EXPO_LABEL_NAME.match(lname):
+            errors.append(f"line {lineno}: bad label name {lname!r}")
+            return None
+        if eq + 1 >= n or raw[eq + 1] != '"':
+            errors.append(f"line {lineno}: label value not quoted")
+            return None
+        j = eq + 2
+        val = []
+        while j < n:
+            c = raw[j]
+            if c == "\\":
+                if j + 1 >= n or raw[j + 1] not in ('\\', '"', 'n'):
+                    errors.append(
+                        f"line {lineno}: bad escape in label value")
+                    return None
+                val.append({"\\": "\\", '"': '"', "n": "\n"}[raw[j + 1]])
+                j += 2
+            elif c == '"':
+                break
+            elif c == "\n":
+                errors.append(
+                    f"line {lineno}: raw newline in label value")
+                return None
+            else:
+                val.append(c)
+                j += 1
+        else:
+            errors.append(f"line {lineno}: unterminated label value")
+            return None
+        if lname in labels:
+            errors.append(f"line {lineno}: duplicate label {lname!r}")
+            return None
+        labels[lname] = "".join(val)
+        i = j + 1
+        if i < n:
+            if raw[i] != ",":
+                errors.append(
+                    f"line {lineno}: expected ',' between labels")
+                return None
+            i += 1
+    return labels
+
+
+def validate_exposition(text):
+    """Parse-check a Prometheus text-exposition document against the
+    0.0.4 grammar: comment/HELP/TYPE lines, sample-line shape, metric
+    and label name charsets, label-value escaping, value syntax, TYPE
+    declared at most once and before its samples, histogram structure
+    (``le`` on ``_bucket`` lines), and (family, labels) uniqueness.
+
+    Returns the number of sample lines on success; raises
+    ``ValueError`` listing every violation otherwise."""
+    errors = []
+    types = {}          # family -> declared type
+    seen_samples = set()  # (name, sorted label items)
+    families_emitted = set()
+    n_samples = 0
+    for lineno, line in enumerate(text.split("\n"), start=1):
+        if not line:
+            continue
+        if line != line.strip():
+            errors.append(f"line {lineno}: leading/trailing whitespace")
+            line = line.strip()
+            if not line:
+                continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 2 and parts[1] in ("HELP", "TYPE"):
+                if len(parts) < 3 or not _EXPO_NAME.match(parts[2]):
+                    errors.append(f"line {lineno}: bad {parts[1]} line")
+                    continue
+                if parts[1] == "TYPE":
+                    mtype = parts[3].strip() if len(parts) > 3 else ""
+                    if mtype not in _EXPO_TYPES:
+                        errors.append(
+                            f"line {lineno}: unknown type {mtype!r}")
+                    if parts[2] in types:
+                        errors.append(
+                            f"line {lineno}: duplicate TYPE for "
+                            f"{parts[2]!r}")
+                    if parts[2] in families_emitted:
+                        errors.append(
+                            f"line {lineno}: TYPE for {parts[2]!r} "
+                            "after its samples")
+                    types[parts[2]] = mtype
+            continue  # other comments are free-form
+        # ---- sample line: name[{labels}] value [timestamp]
+        rest = line
+        brace = rest.find("{")
+        if brace >= 0:
+            name = rest[:brace]
+            close = rest.rfind("}")
+            if close < brace:
+                errors.append(f"line {lineno}: unbalanced braces")
+                continue
+            labels = _parse_labels(rest[brace + 1:close], errors, lineno)
+            if labels is None:
+                continue
+            tail = rest[close + 1:].split()
+        else:
+            fields = rest.split()
+            name, labels, tail = fields[0], {}, fields[1:]
+        if not _EXPO_NAME.match(name):
+            errors.append(f"line {lineno}: bad metric name {name!r}")
+            continue
+        if not tail or len(tail) > 2:
+            errors.append(f"line {lineno}: expected 'value [timestamp]'")
+            continue
+        if not _EXPO_VALUE.match(tail[0]):
+            errors.append(f"line {lineno}: bad value {tail[0]!r}")
+        if len(tail) == 2 and not re.match(r"-?\d+\Z", tail[1]):
+            errors.append(f"line {lineno}: bad timestamp {tail[1]!r}")
+        # family resolution: histogram samples append _bucket/_sum/_count
+        family = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            base = name[:-len(suffix)] if name.endswith(suffix) else None
+            if base and types.get(base) in ("histogram", "summary"):
+                family = base
+                if (suffix == "_bucket"
+                        and types.get(base) == "histogram"
+                        and "le" not in labels):
+                    errors.append(
+                        f"line {lineno}: histogram _bucket without "
+                        "'le' label")
+                break
+        families_emitted.add(family)
+        key = (name, tuple(sorted(labels.items())))
+        if key in seen_samples:
+            errors.append(
+                f"line {lineno}: duplicate sample {name}{labels}")
+        seen_samples.add(key)
+        n_samples += 1
+    if errors:
+        raise ValueError(
+            "invalid exposition:\n  " + "\n  ".join(errors))
+    return n_samples
 
 
 # ---------------------------------------------------------------- default

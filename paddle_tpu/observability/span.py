@@ -83,15 +83,25 @@ class span:
         if stack and stack[-1] == self.name:
             stack.pop()
         self.elapsed = time.perf_counter() - self._t0
-        _events.record(self.name, phase=_events.END, cat=self.cat,
-                       args=dict(self.labels, depth=len(stack),
-                                 seconds=round(self.elapsed, 9),
-                                 error=exc_type.__name__ if exc_type
-                                 else None, **self.event_args))
-        if self._ann is not None:
-            try:
-                self._ann.__exit__(exc_type, exc, tb)
-            except Exception:
-                pass
-        SPAN_SECONDS.observe(self.elapsed, name=self.name, **self.labels)
+        try:
+            _events.record(self.name, phase=_events.END, cat=self.cat,
+                           args=dict(self.labels, depth=len(stack),
+                                     seconds=round(self.elapsed, 9),
+                                     error=exc_type.__name__ if exc_type
+                                     else None, **self.event_args))
+        finally:
+            # the span must ALWAYS end: close the device annotation and
+            # observe the histogram even if the event ring raised.  A
+            # raising body tags the observation error=1 so error and
+            # success latencies stay separable.
+            if self._ann is not None:
+                try:
+                    self._ann.__exit__(exc_type, exc, tb)
+                except Exception:
+                    pass
+            hist_labels = dict(self.labels)
+            if exc_type is not None:
+                hist_labels["error"] = 1
+            SPAN_SECONDS.observe(self.elapsed, name=self.name,
+                                 **hist_labels)
         return False

@@ -107,6 +107,7 @@ from ..core import tape as _tape
 from ..core.tensor import Tensor
 from ..observability import events as _obs_events
 from ..observability import metrics as _obs_metrics
+from ..observability import tracing as _obs_tracing
 from ..observability.span import span as _obs_span
 from .drafter import draft_tokens
 from .kv_cache import PagedKV, PagedKVCache
@@ -178,6 +179,17 @@ _SRV_SPEC_ACCEPTED = _obs_metrics.counter(
 _SRV_SPEC_RATE = _obs_metrics.gauge(
     "serving.spec_accept_rate",
     "cumulative accepted / drafted speculative tokens")
+_SRV_SPEC_EMA = _obs_metrics.gauge(
+    "serving.spec_lane_accept_ema",
+    "per-lane speculative acceptance EMA driving the adaptive gates")
+_SRV_KV_OCC = _obs_metrics.gauge(
+    "serving.kv_pool_occupancy_ratio",
+    "unified KV pool blocks in use / pool capacity")
+_SRV_BUCKETS = _obs_metrics.gauge(
+    "serving.decode_bucket_count",
+    "distinct compiled decode programs ((horizon, nb, K) triples)")
+_SRV_ABORTS = _obs_metrics.counter(
+    "serving.requests_aborted", "requests cancelled by the caller")
 # compile/cache families SHARED with jit/api.py: one place answers
 # "which function retraced" for both to_static and serving programs
 _COMPILE_COUNT = _obs_metrics.counter(
@@ -314,6 +326,33 @@ class EngineConfig:
     #: ~2x-ing how many sequences fit a fixed kv_pool_blocks byte
     #: budget.  None keeps the fp pool (cache_dtype).
     kv_cache_dtype: object = None
+    #: request-scoped tracing: attach a RequestTrace flight record to
+    #: every request at submit, retained by a bounded FlightRecorder
+    #: (all live traces + the last ``flight_recorder_capacity``
+    #: finished ones) and served at /debug/requests.  Appends are O(1)
+    #: per lifecycle transition, so the decode path cost is bounded
+    #: (bench_decode's tracing-overhead section measures it).
+    request_tracing: bool = True
+    flight_recorder_capacity: int = 256
+    #: start a TelemetryServer (observability.server) on this port at
+    #: engine construction, stopped by close().  0 binds an ephemeral
+    #: port (engine.telemetry.port reports it); None disables.
+    telemetry_port: int | None = None
+    #: SLO objectives over step-sized rolling windows (observability
+    #: .slo): per-request TTFT seconds, per-request mean TPOT seconds,
+    #: and abort rate.  None disables an objective; with all three None
+    #: no tracker is created and /readyz is always ready.
+    slo_ttft_s: float | None = None
+    slo_tpot_s: float | None = None
+    slo_abort_rate: float | None = None
+    #: compliance target shared by the latency objectives (e.g. 0.95 =
+    #: "p95 under the threshold") and the burn-rate denominator
+    slo_target: float = 0.95
+    #: rolling window sizes in OBSERVATIONS (retired requests), not
+    #: wall-clock — deterministic under test; unhealthy requires both
+    #: windows burning above 1x budget
+    slo_fast_window: int = 64
+    slo_slow_window: int = 640
 
 
 class Engine:
@@ -464,6 +503,7 @@ class Engine:
         self._kv_bytes_read = 0
         self._cow_copies = 0
         self._preemptions = 0
+        self._aborted = 0
         self._prefill_calls = 0          # compiled prefill DISPATCHES
         self._prefill_requests = 0       # requests prefilled (>= calls)
         self._prefix_hit_tokens = 0
@@ -499,9 +539,49 @@ class Engine:
                 self, _profiler.unregister_counter_provider,
                 self._profiler_name)
 
+        # observability phase 2: per-request flight records, declared
+        # SLOs over the retirement stream, and the HTTP telemetry
+        # endpoint.  The server holds the recorder/tracker (not the
+        # engine), so it can never pin the engine's KV pool alive.
+        self.recorder = (
+            _obs_tracing.FlightRecorder(
+                self.config.flight_recorder_capacity)
+            if self.config.request_tracing else None)
+        self.slo = None
+        cfg = self.config
+        if (cfg.slo_ttft_s is not None or cfg.slo_tpot_s is not None
+                or cfg.slo_abort_rate is not None):
+            from ..observability.slo import SLOTracker
+
+            self.slo = SLOTracker(self._profiler_name)
+            windows = dict(fast_window=cfg.slo_fast_window,
+                           slow_window=cfg.slo_slow_window)
+            if cfg.slo_ttft_s is not None:
+                self.slo.declare("ttft", cfg.slo_ttft_s,
+                                 target=cfg.slo_target, **windows)
+            if cfg.slo_tpot_s is not None:
+                self.slo.declare("tpot", cfg.slo_tpot_s,
+                                 target=cfg.slo_target, **windows)
+            if cfg.slo_abort_rate is not None:
+                # 0/1 observations per retirement; "abort rate < Z"
+                # is "1 - Z of observations must be 0"
+                self.slo.declare("abort", 0.5,
+                                 target=1.0 - cfg.slo_abort_rate,
+                                 unit="bool", **windows)
+        self.telemetry = None
+        if cfg.telemetry_port is not None:
+            from ..observability.server import TelemetryServer
+
+            self.telemetry = TelemetryServer(
+                port=cfg.telemetry_port, recorder=self.recorder,
+                slo=self.slo).start()
+
     def close(self):
-        """Unregister this engine's counter provider (idempotent; also
+        """Stop the telemetry server and unregister this engine's
+        counter provider (idempotent; the provider unregistration also
         runs automatically when the engine is garbage-collected)."""
+        if self.telemetry is not None:
+            self.telemetry.stop()
         if self._finalizer is not None:
             self._finalizer()
 
@@ -826,6 +906,13 @@ class Engine:
                 f"{sampling.max_new_tokens} exceeds max_seq_len "
                 f"{self.config.max_seq_len}")
         req = self.scheduler.submit(prompt_ids, sampling)
+        if self.recorder is not None:
+            req.trace = _obs_tracing.RequestTrace(
+                req.request_id, engine=self._profiler_name)
+            req.trace.add(_obs_tracing.QUEUED,
+                          prompt_len=req.prompt_len,
+                          max_new_tokens=sampling.max_new_tokens)
+            self.recorder.attach(req.trace)
         _SRV_QUEUE.set(self.scheduler.queue_depth,
                        engine=self._profiler_name)
         return req
@@ -921,6 +1008,13 @@ class Engine:
                                 slot=slot, request=req.request_id,
                                 prompt_len=req.prompt_len, bucket=bucket,
                                 prefix_hit=lease.matched_tokens)
+            if req.trace is not None:
+                req.trace.add(
+                    _obs_tracing.RESUME if req.output_ids
+                    else _obs_tracing.PREFILL,
+                    slot=slot, bucket=bucket,
+                    prefill_tokens=len(toks),
+                    prefix_hit_tokens=lease.matched_tokens)
             if not req.output_ids:
                 # async span: a request's life overlaps other requests
                 # on this thread, so it pairs by id, not by B/E nesting
@@ -1012,7 +1106,11 @@ class Engine:
             else:
                 self._tokens_generated += 1
                 _SRV_TOKENS.inc(engine=name)
-                if req.record_token(tok):
+                done = req.record_token(tok)
+                if req.trace is not None:
+                    req.trace.add(_obs_tracing.FIRST_TOKEN, token=tok,
+                                  ttft_s=round(req.ttft, 6))
+                if done:
                     self._retire(req)
                     continue
             s = req.sampling
@@ -1067,6 +1165,18 @@ class Engine:
             args={"reason": req.finish_reason,
                   "n_generated": req.n_generated,
                   "ttft_s": round(req.ttft, 6)})
+        if req.trace is not None:
+            req.trace.add(_obs_tracing.FINISH, reason=req.finish_reason,
+                          n_generated=req.n_generated,
+                          ttft_s=round(req.ttft, 6))
+            self.recorder.finish(req.trace)
+        if self.slo is not None:
+            self.slo.observe("ttft", req.ttft)
+            if req.n_generated > 1:
+                self.slo.observe(
+                    "tpot", (time.time() - req.first_token_time)
+                    / (req.n_generated - 1))
+            self.slo.observe("abort", 0.0)
         # the freed lane keeps its frozen state (matching the device
         # copy, which masked it inside the scan); the mirror only drops
         # the active bit — no re-upload, no parking
@@ -1099,6 +1209,61 @@ class Engine:
         _obs_events.instant("serving.preempt", cat="serving", slot=slot,
                             request=req.request_id,
                             n_generated=req.n_generated)
+        if req.trace is not None:
+            req.trace.add(_obs_tracing.PREEMPT, slot=slot,
+                          n_generated=req.n_generated)
+
+    def abort(self, req):
+        """Cancel a request: a QUEUED one leaves the queue, a RUNNING
+        one releases its slot, table entries, and prefix lease (the
+        preemption teardown) without requeueing.  The request finishes
+        with ``finish_reason="abort"`` and keeps whatever tokens it had
+        generated; aborts feed the ``abort`` SLO objective and the
+        flight record ends with an ``abort`` event."""
+        from .scheduler import FINISHED, FINISH_ABORT, RUNNING, WAITING
+
+        if req.status == FINISHED:
+            raise ValueError(
+                f"cannot abort request {req.request_id}: already "
+                f"finished ({req.finish_reason})")
+        if req.status == WAITING:
+            try:
+                self.scheduler.queue.remove(req)
+            except ValueError:
+                raise ValueError(
+                    f"cannot abort request {req.request_id}: waiting "
+                    "but not queued on this engine") from None
+            req.status = FINISHED
+        else:
+            assert req.status == RUNNING
+            slot = req.slot
+            self.cache.release_slot_blocks(slot)
+            lease = self._leases.pop(req.request_id, None)
+            if lease is not None:
+                self.prefix.release(lease)
+            self._active[slot] = False
+            self._state_dirty = True
+            self.scheduler.finish(req)
+            self.cache.free(slot)
+        req.finish_reason = FINISH_ABORT
+        self._aborted += 1
+        name = self._profiler_name
+        _SRV_ABORTS.inc(engine=name)
+        _SRV_QUEUE.set(self.scheduler.queue_depth, engine=name)
+        if req.admit_time is not None:
+            # only requests that prefilled opened an async span
+            _obs_events.record(
+                "serving.request", phase=_obs_events.ASYNC_END,
+                cat="serving", id=req.request_id,
+                args={"reason": FINISH_ABORT,
+                      "n_generated": req.n_generated})
+        if req.trace is not None:
+            req.trace.add(_obs_tracing.ABORT,
+                          n_generated=req.n_generated)
+            self.recorder.finish(req.trace)
+        if self.slo is not None:
+            self.slo.observe("abort", 1.0)
+        return req
 
     def _ensure_blocks(self, h, w=1):
         """Extend every running slot's block table to cover its next
@@ -1268,6 +1433,7 @@ class Engine:
         gated = self._spec_gates.copy()  # gates the dispatch ran with
         for slot, req in active.items():
             done = False
+            lane_tokens = lane_accept = 0
             for step_i in range(h):
                 row = toks[step_i, slot]
                 if done:
@@ -1291,14 +1457,14 @@ class Engine:
                     self._pos[slot] += 1
                     self._hist[slot, self._pos[slot]] = t
                     if req.record_token(t):
-                        self._retire(req)
-                        finished.append(req)
-                        done = True
-                        break
+                        done = True      # retire AFTER the lane's trace
+                        break            # event, below
+                lane_tokens += n_emit
                 self._counts[slot] = req.n_generated
                 if k_draft and gated[slot]:
                     drafted += k_draft
                     accepted += n_emit - 1
+                    lane_accept += n_emit - 1
                     self._spec_windows += 1
                     self._spec_accept_hist[n_emit] = \
                         self._spec_accept_hist.get(n_emit, 0) + 1
@@ -1311,6 +1477,13 @@ class Engine:
                             (ema >= floor) != bool(self._spec_gates[slot]):
                         self._spec_gates[slot] = ema >= floor
                         self._state_dirty = True
+            if req.trace is not None and lane_tokens:
+                req.trace.add(_obs_tracing.DECODE, horizon=h,
+                              spec_k=k_draft, tokens=lane_tokens,
+                              accepted=lane_accept)
+            if done:
+                self._retire(req)
+                finished.append(req)
         if drafted:
             self._spec_draft_tokens += drafted
             self._spec_accepted_tokens += accepted
@@ -1331,6 +1504,13 @@ class Engine:
         _SRV_QUEUE.set(self.scheduler.queue_depth, engine=name)
         _SRV_ACTIVE.set(self.cache.used_slots, engine=name)
         _SRV_KV_BLOCKS.set(self.pool.blocks_in_use, engine=name)
+        _SRV_KV_OCC.set(self.pool.blocks_in_use / self.pool.capacity,
+                        engine=name)
+        _SRV_BUCKETS.set(len(self._decode_buckets), engine=name)
+        if self.config.spec_k:
+            for slot in range(self.cache.num_slots):
+                _SRV_SPEC_EMA.set(float(self._spec_ema[slot]),
+                                  engine=name, lane=slot)
         if self._decode_steps:
             _SRV_UTIL.set(self._slot_busy_integral / self._decode_steps,
                           engine=name)
@@ -1417,6 +1597,7 @@ class Engine:
             "kv_bytes_read": self._kv_bytes_read,
             "cow_copies": self._cow_copies,
             "preemptions": self._preemptions,
+            "requests_aborted": self._aborted,
             "spec_draft_tokens": self._spec_draft_tokens,
             "spec_accepted_tokens": self._spec_accepted_tokens,
             "spec_accept_rate": (
@@ -1495,4 +1676,15 @@ class Engine:
                 50, engine=self._profiler_name)
             s["ttft_p95_s"] = _SRV_TTFT.percentile(
                 95, engine=self._profiler_name)
+        if self.slo is not None:
+            s["slo"] = self.slo.snapshot()
+        if self.recorder is not None:
+            s["tracing"] = {
+                "live_traces": len(self.recorder.live()),
+                "finished_retained": len(self.recorder.recent()),
+                "dropped_finished": self.recorder.dropped,
+                "capacity": self.recorder.capacity,
+            }
+        if self.telemetry is not None:
+            s["telemetry_port"] = self.telemetry.port
         return s

@@ -30,6 +30,7 @@ FINISHED = "finished"
 
 FINISH_EOS = "eos"
 FINISH_LENGTH = "length"
+FINISH_ABORT = "abort"
 
 
 @dataclass
@@ -61,6 +62,10 @@ class Request:
     #: — pop_batch extends the head-anchor exemption to it (it neither
     #: spends the reorder window nor charges anyone's bypassed counter)
     resumed: bool = False
+    #: the request's observability flight record
+    #: (observability.tracing.RequestTrace, attached by the engine at
+    #: submit when request tracing is on; None otherwise)
+    trace: object = None
 
     @property
     def prompt_len(self):

@@ -441,3 +441,359 @@ class TestCLI:
             capture_output=True, text=True, timeout=120, env=env)
         assert out.returncode == 0, out.stderr
         assert "# TYPE cli_h histogram" in out.stdout
+
+
+class TestFlightRecorder:
+    """RequestTrace flight records + bounded FlightRecorder retention."""
+
+    @staticmethod
+    def _finished_trace(rid, tokens=3):
+        from paddle_tpu.observability import tracing
+
+        tr = tracing.RequestTrace(rid, engine="e0")
+        tr.add(tracing.QUEUED, prompt_len=4)
+        tr.add(tracing.PREFILL, slot=0, prefill_tokens=4,
+               prefix_hit_tokens=2)
+        tr.add(tracing.FIRST_TOKEN, token=7)
+        tr.add(tracing.DECODE, horizon=4, tokens=tokens - 1, accepted=1)
+        tr.add(tracing.FINISH, reason="eos", n_generated=tokens)
+        return tr
+
+    def test_counts_reconstruct_lifecycle(self):
+        from paddle_tpu.observability import tracing
+
+        tr = tracing.RequestTrace(5)
+        tr.add(tracing.QUEUED)
+        tr.add(tracing.PREFILL, prefix_hit_tokens=4)
+        tr.add(tracing.FIRST_TOKEN, token=1)
+        tr.add(tracing.DECODE, tokens=3, accepted=2, horizon=4)
+        tr.add(tracing.PREEMPT)
+        tr.add(tracing.RESUME, prefix_hit_tokens=6)
+        tr.add(tracing.DECODE, tokens=2, accepted=0, horizon=2)
+        tr.add(tracing.FINISH, reason="length")
+        c = tr.counts()
+        assert c == {"tokens_emitted": 6, "prefix_hit_tokens": 6,
+                     "preemptions": 1, "decode_horizons": 2,
+                     "spec_accepted_tokens": 2}
+        assert tr.finished
+        # monotonic event times
+        ts = [t for _, t, _ in tr.events]
+        assert ts == sorted(ts) and all(t >= 0 for t in ts)
+
+    def test_bounded_retention_drops_oldest_finished(self):
+        from paddle_tpu.observability import tracing
+
+        rec = tracing.FlightRecorder(capacity=3)
+        for i in range(10):
+            tr = self._finished_trace(i)
+            rec.attach(tr)
+            rec.finish(tr)
+        assert [t.request_id for t in rec.recent()] == [7, 8, 9]
+        assert rec.dropped == 7
+        assert rec.to_json()["finished_total"] == 10
+        assert rec.get(9) is not None and rec.get(0) is None
+
+    def test_live_traces_are_pinned(self):
+        from paddle_tpu.observability import tracing
+
+        rec = tracing.FlightRecorder(capacity=2)
+        live = tracing.RequestTrace(100)
+        live.add(tracing.QUEUED)
+        rec.attach(live)
+        for i in range(8):          # churn far past capacity
+            tr = self._finished_trace(i)
+            rec.attach(tr)
+            rec.finish(tr)
+        assert rec.get(100) is live          # still reachable
+        assert [t.request_id for t in rec.live()] == [100]
+        doc = rec.to_json()
+        assert doc["live_count"] == 1
+        assert doc["finished_retained"] == 2
+        assert not doc["live"][0]["finished"]
+        json.dumps(doc)                      # fully JSON-able
+
+    def test_chrome_async_span_export(self):
+        from paddle_tpu.observability import tracing
+
+        rec = tracing.FlightRecorder()
+        tr = self._finished_trace(42)
+        rec.attach(tr)
+        rec.finish(tr)
+        doc = json.loads(rec.export_chrome_trace())
+        evs = [e for e in doc["traceEvents"] if e["id"] == "42"]
+        phases = [e["ph"] for e in evs]
+        assert phases[0] == "b" and phases[-1] == "e"
+        assert phases.count("n") == 5        # one per lifecycle event
+        ts = [e["ts"] for e in doc["traceEvents"]]
+        assert ts == sorted(ts)
+        # mergeable into the process event ring export
+        merged = json.loads(obs_events.EventLog().export_chrome_trace(
+            extra=rec.chrome_events()))
+        assert len(merged["traceEvents"]) == 7
+
+
+class TestSLO:
+    """Deterministic step-window burn-rate math (no clocks)."""
+
+    def _tracker(self, **kw):
+        from paddle_tpu.observability.slo import SLOTracker
+
+        reg = Registry()
+        t = SLOTracker("t", registry=reg)
+        kw.setdefault("target", 0.9)
+        kw.setdefault("fast_window", 4)
+        kw.setdefault("slow_window", 8)
+        t.declare("ttft", 0.5, **kw)
+        return t, reg
+
+    def test_empty_window_is_compliant(self):
+        t, _ = self._tracker()
+        obj = t.objective("ttft")
+        assert obj.compliance("fast") == 1.0
+        assert obj.burn_rate("slow") == 0.0
+        assert t.healthy
+
+    def test_window_math_exact(self):
+        t, _ = self._tracker()
+        obj = t.objective("ttft")
+        for v in (0.1, 0.1, 2.0, 0.1):       # 1 breach in 4
+            t.observe("ttft", v)
+        assert obj.compliance("fast") == pytest.approx(0.75)
+        # burn = (1 - 0.75) / (1 - 0.9) = 2.5x budget
+        assert obj.burn_rate("fast") == pytest.approx(2.5)
+        assert obj.compliance("slow") == pytest.approx(0.75)
+
+    def test_multiwindow_and_breach_and_recovery(self):
+        t, reg = self._tracker()
+        obj = t.objective("ttft")
+        # one bad observation: fast window burns, slow doesn't -> healthy
+        for _ in range(7):
+            t.observe("ttft", 0.1)
+        t.observe("ttft", 2.0)
+        assert obj.burn_rate("fast") > 1.0
+        assert obj.burn_rate("slow") > 1.0  # 1/8 breach > 10% budget
+        # sustained outage: both windows burn -> unhealthy
+        for _ in range(8):
+            t.observe("ttft", 2.0)
+        assert not obj.healthy and not t.healthy
+        assert reg.value("slo.healthy", tracker="t") == 0
+        assert reg.value("slo.burn_rate", tracker="t", objective="ttft",
+                         window="fast") == pytest.approx(10.0)
+        # recovery: the fast window forgives as soon as it refills
+        for _ in range(4):
+            t.observe("ttft", 0.1)
+        assert obj.burn_rate("fast") == 0.0
+        assert obj.healthy and t.healthy
+        assert reg.value("slo.healthy", tracker="t") == 1
+        assert reg.value("slo.compliance", tracker="t", objective="ttft",
+                         window="fast") == 1
+
+    def test_unknown_objective_ignored(self):
+        t, _ = self._tracker()
+        t.observe("nope", 1.0)               # must not raise
+        assert t.healthy
+
+    def test_invalid_declarations_rejected(self):
+        from paddle_tpu.observability.slo import Objective
+
+        with pytest.raises(ValueError):
+            Objective("x", 1.0, target=1.0)
+        with pytest.raises(ValueError):
+            Objective("x", 1.0, fast_window=8, slow_window=4)
+
+
+class TestExpositionConformance:
+    """validate_exposition: the renderer's output parses, and the
+    validator actually rejects malformed documents."""
+
+    def test_renderer_output_parses(self):
+        from paddle_tpu.observability.metrics import validate_exposition
+
+        reg = Registry()
+        reg.counter("c.plain", "simple").inc(3)
+        g = reg.gauge("g.hard", 'help with "quotes", \\slash\nnewline')
+        g.set(1.5, path='va"l\\ue', msg="line\nbreak")
+        g.set(float("inf"), k="inf")
+        g.set(float("nan"), k="nan")
+        h = reg.histogram("h.lat", "lat", buckets=(0.1, 1.0))
+        h.observe(0.5, op="a")
+        reg.register_provider("sub.sys", lambda: {"n": 2})
+        n = validate_exposition(reg.render_prometheus())
+        assert n >= 9       # every emitted sample line parsed
+        text = reg.render_prometheus()
+        assert "NaN" in text and "+Inf" in text
+        assert "\\n" in text          # newlines escaped, never raw
+
+    def test_default_registry_conforms(self):
+        from paddle_tpu.observability.metrics import validate_exposition
+
+        with span("expo-conform", cat="test"):
+            pass
+        assert validate_exposition(obs.render_prometheus()) > 0
+
+    @pytest.mark.parametrize("doc", [
+        "9bad_name 1\n",                       # name starts with digit
+        'm{l="unterminated} 1\n',              # unbalanced quote
+        'm{l="x"} notanumber\n',               # bad value
+        'm{l="x"}\n',                          # missing value
+        'm{bad-label="x"} 1\n',                # bad label name
+        "# TYPE m wrongtype\nm 1\n",           # unknown type
+        "m 1\nm 1\n",                          # duplicate sample
+        "# TYPE h histogram\nh_bucket 1\n",    # bucket without le
+    ])
+    def test_rejects_malformed(self, doc):
+        from paddle_tpu.observability.metrics import validate_exposition
+
+        with pytest.raises(ValueError):
+            validate_exposition(doc)
+
+
+class TestSpanErrorPath:
+    """Regression: the span histogram must be observed on the exception
+    path (with error=1), even if the event sink itself raises."""
+
+    def test_error_observation_labeled(self):
+        st0 = obs_metrics.value("span.seconds", name="err-span",
+                                error="1")
+        n0 = st0["count"] if st0 else 0
+        with pytest.raises(RuntimeError):
+            with span("err-span", cat="test"):
+                raise RuntimeError("boom")
+        st = obs_metrics.value("span.seconds", name="err-span",
+                               error="1")
+        assert st["count"] == n0 + 1
+        # the success path stays on the unlabeled slot
+        with span("err-span", cat="test"):
+            pass
+        ok = obs_metrics.value("span.seconds", name="err-span")
+        assert ok["count"] >= 1
+
+    def test_histogram_observed_even_if_event_sink_raises(self,
+                                                          monkeypatch):
+        import importlib
+
+        span_mod = importlib.import_module(
+            "paddle_tpu.observability.span")
+
+        def boom(*a, **k):
+            raise RuntimeError("sink down")
+
+        st0 = obs_metrics.value("span.seconds", name="sink-span")
+        n0 = st0["count"] if st0 else 0
+        s = span_mod.span("sink-span", cat="test")
+        s.__enter__()
+        monkeypatch.setattr(span_mod._events, "record", boom)
+        with pytest.raises(RuntimeError):
+            s.__exit__(None, None, None)
+        st = obs_metrics.value("span.seconds", name="sink-span")
+        assert st["count"] == n0 + 1       # observed despite the raise
+        assert s.elapsed is not None
+
+
+class TestChromeTraceMetadata:
+    def test_header_has_process_identity_and_drops(self):
+        log = obs_events.EventLog(capacity=2)
+        for i in range(5):
+            log.instant(f"e{i}")
+        doc = json.loads(log.export_chrome_trace())
+        meta = doc["metadata"]
+        assert meta["dropped_events"] == 3
+        assert meta["process_name"].startswith("python:")
+        assert meta["git_sha"]          # short sha or "unknown"
+
+
+class TestTelemetryEndpoint:
+    """Scrape a LIVE engine's telemetry endpoint."""
+
+    def _engine(self, **extra):
+        from paddle_tpu.models import GPTConfig, GPTForCausalLM
+        from paddle_tpu.serving import Engine, EngineConfig
+
+        cfg = GPTConfig(vocab_size=64, hidden_size=32,
+                        intermediate_size=64, num_hidden_layers=1,
+                        num_attention_heads=2,
+                        max_position_embeddings=32)
+        paddle.seed(0)
+        m = GPTForCausalLM(cfg)
+        m.eval()
+        kw = dict(num_slots=1, max_seq_len=16, telemetry_port=0,
+                  slo_ttft_s=60.0, slo_target=0.9,
+                  slo_fast_window=4, slo_slow_window=8)
+        kw.update(extra)
+        return Engine(m, EngineConfig(**kw), register_profiler=False)
+
+    @staticmethod
+    def _get(url):
+        import urllib.error
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(url, timeout=10) as r:
+                return r.status, r.read().decode()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read().decode()
+
+    @pytest.mark.slow
+    def test_scrape_running_engine(self):
+        from paddle_tpu.observability.metrics import validate_exposition
+        from paddle_tpu.serving import SamplingParams
+
+        eng = self._engine()
+        try:
+            eng.generate([3, 1, 4], SamplingParams(max_new_tokens=4))
+            assert eng.telemetry.port > 0
+            code, body = self._get(eng.telemetry.url("/metrics"))
+            assert code == 200
+            assert validate_exposition(body) > 0
+            assert "serving_kv_pool_occupancy_ratio" in body
+            assert "serving_decode_bucket_count" in body
+            assert "slo_burn_rate" in body
+            code, body = self._get(eng.telemetry.url("/healthz"))
+            assert (code, body) == (200, "ok\n")
+            code, body = self._get(eng.telemetry.url("/debug/requests"))
+            assert code == 200
+            doc = json.loads(body)
+            assert doc["finished_total"] == 1
+            rec = doc["recent"][0]
+            kinds = [e["kind"] for e in rec["events"]]
+            assert kinds[0] == "queued" and kinds[-1] == "finish"
+            assert rec["counts"]["tokens_emitted"] == 4
+            code, body = self._get(eng.telemetry.url("/trace"))
+            assert code == 200
+            trace = json.loads(body)
+            assert any(e.get("cat") == "serving.request"
+                       for e in trace["traceEvents"])
+            assert self._get(eng.telemetry.url("/nope"))[0] == 404
+        finally:
+            url = eng.telemetry.url("/healthz")
+            eng.close()
+        # clean shutdown: the port no longer answers
+        assert not eng.telemetry or not eng.telemetry.running
+        with pytest.raises(Exception):
+            self._get(url)
+
+    @pytest.mark.slow
+    def test_readyz_flips_on_ttft_breach_and_recovers(self):
+        eng = self._engine()
+        try:
+            code, body = self._get(eng.telemetry.url("/readyz"))
+            assert code == 200 and json.loads(body)["ready"]
+            # injected sustained TTFT breach fills both windows
+            for _ in range(8):
+                eng.slo.observe("ttft", 120.0)
+            code, body = self._get(eng.telemetry.url("/readyz"))
+            assert code == 503
+            doc = json.loads(body)
+            assert not doc["ready"]
+            burn = doc["slo"]["objectives"]["ttft"]["fast"]["burn_rate"]
+            assert burn > 1.0
+            # the burn-rate gauge is visible in the same scrape
+            _, metrics_body = self._get(eng.telemetry.url("/metrics"))
+            assert 'slo_burn_rate{' in metrics_body
+            # recovery: fast window refills with good observations
+            for _ in range(4):
+                eng.slo.observe("ttft", 0.01)
+            code, body = self._get(eng.telemetry.url("/readyz"))
+            assert code == 200 and json.loads(body)["ready"]
+        finally:
+            eng.close()

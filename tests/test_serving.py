@@ -1703,3 +1703,123 @@ class TestQuantServing:
             outs.append(eng.generate(list(prompt), sp))
             eng.close()
         assert outs[0] == outs[1]
+
+
+class TestRequestTracing:
+    """Flight records vs engine ground truth: every request's trace must
+    reconstruct the engine's own counters — under continuous batching
+    with preemption AND speculative decoding enabled — and abort must
+    tear down cleanly from both the queued and the running state."""
+
+    @staticmethod
+    def _cfg(**kw):
+        kw.setdefault("num_slots", 2)
+        kw.setdefault("max_seq_len", 32)
+        kw.setdefault("max_horizon", 4)
+        kw.setdefault("prefix_block_size", 4)
+        kw.setdefault("prefix_cache_bytes", 0)
+        return EngineConfig(**kw)
+
+    @pytest.mark.slow
+    def test_trace_matches_counters_under_preempt_and_spec(self):
+        m = _model()
+        # the auto-preempt recipe (undersized pool forces at least one
+        # swap round-trip) with self-drafting speculation on top
+        prompts = [[7, 3, 9, 1, 4, 4, 2, 8], [5, 6, 7, 8, 9, 1, 2, 3]]
+        samp = [SamplingParams(max_new_tokens=12) for _ in prompts]
+        eng = Engine(m, self._cfg(kv_pool_blocks=8, spec_k=2),
+                     register_profiler=False)
+        reqs = [eng.submit(p, s) for p, s in zip(prompts, samp)]
+        eng.run()
+        c = eng.counters()
+        assert c["preemptions"] >= 1
+        for r in reqs:
+            assert r.trace is not None and r.trace.finished
+            tc = r.trace.counts()
+            assert tc["tokens_emitted"] == r.n_generated == 12
+            assert tc["prefix_hit_tokens"] == r.prefix_hit_tokens
+            kinds = [k for k, _, _ in r.trace.events]
+            assert kinds[0] == "queued" and kinds[-1] == "finish"
+            assert kinds.count("first_token") == 1
+            # every preempt pairs with a resume; FIRST_TOKEN only once
+            assert (kinds.count("preempt") == kinds.count("resume")
+                    == tc["preemptions"])
+            ts = [t for _, t, _ in r.trace.events]
+            assert ts == sorted(ts)
+        # trace sums ARE the engine counters restated per request
+        tcs = [r.trace.counts() for r in reqs]
+        assert (sum(t["tokens_emitted"] for t in tcs)
+                == c["tokens_generated"])
+        assert (sum(t["preemptions"] for t in tcs) == c["preemptions"])
+        assert (sum(t["spec_accepted_tokens"] for t in tcs)
+                == c["spec_accepted_tokens"])
+        # recorder retained both finished flight records
+        assert ({t.request_id for t in eng.recorder.recent()}
+                == {r.request_id for r in reqs})
+        assert not eng.recorder.live()
+
+    def test_prefix_hit_tokens_in_trace(self):
+        m = _model()
+        eng = Engine(m, self._cfg(num_slots=1,
+                                  prefix_cache_bytes=1 << 20),
+                     register_profiler=False)
+        prompt = [1, 2, 3, 4, 5, 6, 7, 8]
+        r1 = eng.submit(prompt, SamplingParams(max_new_tokens=4))
+        eng.run()
+        r2 = eng.submit(prompt, SamplingParams(max_new_tokens=4))
+        eng.run()
+        assert r2.prefix_hit_tokens > 0          # served from the cache
+        for r in (r1, r2):
+            assert (r.trace.counts()["prefix_hit_tokens"]
+                    == r.prefix_hit_tokens)
+        assert (r1.prefix_hit_tokens + r2.prefix_hit_tokens
+                == eng.counters()["prefix_hit_tokens"])
+
+    def test_abort_queued_and_running(self):
+        m = _model()
+        eng = Engine(m, self._cfg(num_slots=1), register_profiler=False)
+        running = eng.submit([1, 2, 3, 4], SamplingParams(max_new_tokens=8))
+        queued = eng.submit([5, 6, 7], SamplingParams(max_new_tokens=8))
+        eng.step(horizon=2)
+        assert running.status == "running" and queued.status == "waiting"
+
+        eng.abort(queued)
+        assert queued.status == "finished"
+        assert queued.finish_reason == "abort"
+        # never admitted: the flight record is queued -> abort, nothing else
+        assert [k for k, _, _ in queued.trace.events] == ["queued", "abort"]
+
+        had = running.n_generated
+        assert had >= 1
+        eng.abort(running)
+        assert running.finish_reason == "abort"
+        assert running.n_generated == had        # keeps its tokens
+        kinds = [k for k, _, _ in running.trace.events]
+        assert kinds[-1] == "abort" and "prefill" in kinds
+        # full teardown: no queue, no running lane, no leaked blocks
+        assert eng.scheduler.queue_depth == 0
+        assert not eng.scheduler.running
+        assert eng.pool.blocks_in_use == 0
+        c = eng.counters()
+        assert c["requests_aborted"] == 2
+        assert ({t.request_id for t in eng.recorder.recent()}
+                == {queued.request_id, running.request_id})
+        with pytest.raises(ValueError):
+            eng.abort(running)                   # already finished
+        # the engine keeps serving after aborts
+        r3 = eng.submit([9, 9], SamplingParams(max_new_tokens=3))
+        eng.run()
+        assert r3.n_generated == 3 and r3.finish_reason == "length"
+
+    def test_tracing_disabled(self):
+        m = _model()
+        eng = Engine(m, self._cfg(num_slots=1, request_tracing=False),
+                     register_profiler=False)
+        r = eng.submit([1, 2, 3], SamplingParams(max_new_tokens=2))
+        eng.run()
+        assert r.trace is None and eng.recorder is None
+        assert "tracing" not in eng.stats()
+        eng.abort is not None                    # abort path still works
+        r2 = eng.submit([4, 5], SamplingParams(max_new_tokens=4))
+        eng.abort(r2)
+        assert r2.finish_reason == "abort"
