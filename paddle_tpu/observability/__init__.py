@@ -31,15 +31,30 @@ Phase 2 (request-scoped + externally visible):
   an overall ``slo_healthy`` readiness signal.
 * :mod:`~paddle_tpu.observability.server` — stdlib HTTP exporter
   (``/metrics``, ``/healthz``, ``/readyz``, ``/debug/requests``,
-  ``/debug/slo``, ``/trace``) on a background thread.
+  ``/debug/slo``, ``/debug/programs``, ``/trace``) on a background
+  thread.
+
+Phase 3 (the performance observatory):
+
+* :mod:`~paddle_tpu.observability.profiling` — per-compiled-program
+  cost cards (XLA cost/memory analysis, compile seconds, bucket
+  metadata) in a process-wide :class:`ProgramCardRegistry`; the
+  engine's cost model for per-request attribution.
+* :mod:`~paddle_tpu.observability.memory` — device-memory ledger
+  reconciling component-accounted bytes against ``jax.live_arrays()``
+  (leak-detector delta) plus the backend-bandwidth probe behind the
+  live achieved-vs-roofline gauge.
+* :mod:`~paddle_tpu.observability.regression` — the bench-regression
+  gate comparing a fresh bench run against the committed
+  DECODE_BENCH.json (``check-bench`` CLI mode, run in CI).
 
 CLI: ``python -m paddle_tpu.observability
-{snapshot,prometheus,trace,serve}``.
+{snapshot,prometheus,trace,programs,check-bench,serve}``.
 """
 
 from __future__ import annotations
 
-from . import events, metrics, slo, tracing
+from . import events, memory, metrics, profiling, regression, slo, tracing
 from .events import export_chrome_trace
 from .metrics import (
     Counter,
@@ -55,6 +70,8 @@ from .metrics import (
     validate_exposition,
     value,
 )
+from .memory import MemoryLedger
+from .profiling import ProgramCard, ProgramCardRegistry
 from .server import TelemetryServer
 from .slo import Objective, SLOTracker
 from .span import current_span, span, span_depth
@@ -70,6 +87,8 @@ __all__ = [
     "slo", "tracing",
     "RequestTrace", "FlightRecorder", "Objective", "SLOTracker",
     "TelemetryServer",
+    "memory", "profiling", "regression",
+    "MemoryLedger", "ProgramCard", "ProgramCardRegistry",
 ]
 
 

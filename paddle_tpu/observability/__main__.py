@@ -5,6 +5,12 @@ Modes:
   snapshot      nested JSON of every metric + legacy provider (default)
   prometheus    text exposition (# HELP / # TYPE / samples)
   trace         chrome-trace JSON of the event timeline
+  programs      program-card registry: per-compiled-program FLOPs,
+                bytes-accessed, compile seconds (--json for raw dump)
+  check-bench   bench-regression gate: compare a fresh bench document
+                (--fresh, from ``bench_decode.py --out``) against the
+                committed baseline (--baseline, DECODE_BENCH.json);
+                exits 1 on an unallowed regression
   serve         start the telemetry HTTP endpoint (blocks; --port,
                 --duration to exit after N seconds)
 
@@ -28,11 +34,27 @@ def main(argv=None):
         description="dump paddle_tpu observability state")
     parser.add_argument("mode", nargs="?", default="snapshot",
                         choices=("snapshot", "prometheus", "trace",
-                                 "serve"))
+                                 "programs", "check-bench", "serve"))
     parser.add_argument("-o", "--output", default=None,
                         help="write to FILE instead of stdout")
     parser.add_argument("--exec", dest="script", default=None,
                         help="run a Python script first, then dump")
+    parser.add_argument("--json", action="store_true",
+                        help="programs mode: raw JSON instead of a table")
+    parser.add_argument("--baseline", default="DECODE_BENCH.json",
+                        help="check-bench: committed baseline document")
+    parser.add_argument("--fresh", default=None,
+                        help="check-bench: fresh bench document "
+                        "(bench_decode.py --out FILE)")
+    parser.add_argument("--tolerance", type=float, default=0.25,
+                        help="check-bench: relative tolerance on the "
+                        "timing-derived primary value (0.25 = 25%%)")
+    parser.add_argument("--det-tolerance", type=float, default=0.0,
+                        help="check-bench: tolerance on deterministic "
+                        "fields (bytes/compile/dispatch counts)")
+    parser.add_argument("--allow-regress", action="append", default=[],
+                        help="check-bench: substring of metric[::field] "
+                        "whose regression is acknowledged (repeatable)")
     parser.add_argument("--port", type=int, default=9400,
                         help="serve mode: port to bind (0 = ephemeral)")
     parser.add_argument("--duration", type=float, default=None,
@@ -42,6 +64,8 @@ def main(argv=None):
 
     if args.mode == "serve":
         return _serve(args)
+    if args.mode == "check-bench":
+        return _check_bench(args)
 
     if args.script:
         with open(args.script) as f:
@@ -54,6 +78,11 @@ def main(argv=None):
         text = json.dumps(metrics.snapshot(), indent=2, default=repr)
     elif args.mode == "prometheus":
         text = metrics.render_prometheus()
+    elif args.mode == "programs":
+        from . import profiling
+
+        text = (json.dumps(profiling.to_json(), indent=2, default=repr)
+                if args.json else profiling.render_text())
     else:
         text = events.export_chrome_trace()
 
@@ -65,6 +94,26 @@ def main(argv=None):
     return 0
 
 
+def _check_bench(args):
+    from . import regression
+
+    if not args.fresh:
+        print("check-bench: --fresh FILE is required "
+              "(produce one with benchmarks/bench_decode.py --out)",
+              file=sys.stderr)
+        return 2
+    report = regression.check_bench(
+        args.baseline, args.fresh, tolerance=args.tolerance,
+        det_tolerance=args.det_tolerance,
+        allow_regress=args.allow_regress)
+    text = regression.render_text(report)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(json.dumps(report, indent=2) + "\n")
+    sys.stdout.write(text)
+    return 0 if report["ok"] else 1
+
+
 def _serve(args):
     import time
 
@@ -73,7 +122,7 @@ def _serve(args):
     srv = TelemetryServer(port=args.port).start()
     print(f"telemetry listening on {srv.url()} "
           f"(endpoints: /metrics /healthz /readyz /debug/requests "
-          f"/debug/slo /trace)", flush=True)
+          f"/debug/slo /debug/programs /trace)", flush=True)
     try:
         if args.script:
             with open(args.script) as f:

@@ -1,0 +1,207 @@
+"""Device-memory ledger + online roofline (observability phase 3).
+
+Two answers this module owns:
+
+**Where did the HBM go?**  :class:`MemoryLedger` holds one byte-
+accounting callable per named component (the engine registers its paged
+KV pool, its weight arrays, and its device-resident decode state) and
+reconciles their sum against what JAX actually holds alive
+(``jax.live_arrays()``).  ``snapshot()`` publishes the result as
+``memory.*`` gauges:
+
+* ``memory.accounted_bytes{ledger,component}`` — each component's own
+  claim;
+* ``memory.accounted_total_bytes`` / ``memory.live_bytes`` — the two
+  sides of the reconciliation;
+* ``memory.unaccounted_bytes`` — live minus accounted (rotary tables,
+  scratch, anything nobody claims);
+* ``memory.leak_delta_bytes`` — the leak detector: growth of the
+  unaccounted residue since the baseline mark.  Pool-accounted bytes
+  are allowed to grow (admission allocates blocks); bytes NOBODY
+  accounts for growing monotonically is a leak signature.
+
+Reconciliation walks every live array, so it runs on demand
+(``Engine.stats()``, tests, dashboards) — not per decode step.
+
+**How close to the roofline is decode running?**  The per-backend
+bandwidth probe lives here (moved from benchmarks/bench_decode.py so
+the live engine and the bench share one number): a datasheet table for
+known accelerators, a one-shot 64 MiB memcpy probe otherwise.  The
+engine combines a decode program card's bytes-accessed with its
+dispatch wall time and publishes
+``memory.roofline_utilization{engine,horizon}`` — the bench's
+``roofline_pct`` column as a LIVE gauge.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from . import metrics as _metrics
+
+_ACCT = _metrics.gauge(
+    "memory.accounted_bytes",
+    "device bytes each registered component claims to hold")
+_ACCT_TOTAL = _metrics.gauge(
+    "memory.accounted_total_bytes",
+    "sum of all component-accounted device bytes")
+_LIVE = _metrics.gauge(
+    "memory.live_bytes",
+    "total bytes of jax.live_arrays() at the last reconcile")
+_UNACCT = _metrics.gauge(
+    "memory.unaccounted_bytes",
+    "live bytes no registered component accounts for")
+_LEAK = _metrics.gauge(
+    "memory.leak_delta_bytes",
+    "growth of the unaccounted residue since the baseline mark")
+_ROOFLINE = _metrics.gauge(
+    "memory.roofline_utilization",
+    "achieved bytes/s of the last decode dispatch / backend bandwidth")
+_ACHIEVED = _metrics.gauge(
+    "memory.achieved_bandwidth_gbs",
+    "bytes-accessed of the last decode dispatch over its wall seconds")
+
+#: Published HBM bandwidth per accelerator backend (GB/s).  v5e HBM2e
+#: is the paper's serving chip; "axon" is the same part behind the
+#: tunneled plugin.  Unlisted backends (cpu in CI) are measured once
+#: per process by a memcpy probe instead of being skipped.
+_HBM_BW_TABLE = {"tpu": 819.0, "axon": 819.0}
+_BW_PROBED = {}
+_BW_LOCK = threading.Lock()
+
+
+def backend_bandwidth_gbs(backend):
+    """Roofline bandwidth for ``backend`` in GB/s: the datasheet table
+    when we have one, else a one-shot streaming-memcpy probe (64 MiB
+    source, read+write counted, best of 4 passes — DRAM speed, not L3,
+    at that footprint).  Memoized: the probe runs at most once per
+    process so the live gauge and every bench section agree on the
+    number."""
+    if backend in _HBM_BW_TABLE:
+        return _HBM_BW_TABLE[backend]
+    with _BW_LOCK:
+        if backend not in _BW_PROBED:
+            src = np.ones(1 << 26, np.uint8)          # 64 MiB
+            dst = np.empty_like(src)
+            np.copyto(dst, src)                       # fault pages in
+            best = None
+            for _ in range(4):
+                t0 = time.perf_counter()
+                np.copyto(dst, src)
+                dt = time.perf_counter() - t0
+                best = dt if best is None else min(best, dt)
+            _BW_PROBED[backend] = round(2.0 * src.nbytes / best / 1e9, 1)
+        return _BW_PROBED[backend]
+
+
+def live_device_bytes():
+    """Total bytes of every live jax array in the process (0 when the
+    runtime doesn't expose live_arrays)."""
+    try:
+        import jax
+
+        arrays = jax.live_arrays()
+    except Exception:                # pragma: no cover - defensive
+        return 0
+    total = 0
+    for a in arrays:
+        try:
+            total += int(a.nbytes)
+        except Exception:            # deleted/donated buffers
+            continue
+    return total
+
+
+def publish_roofline(engine, horizon, bytes_accessed, wall_seconds,
+                     backend):
+    """One decode dispatch's achieved-vs-roofline utilization as live
+    gauges (called by the engine after each non-compiling dispatch)."""
+    if not bytes_accessed or wall_seconds <= 0:
+        return None
+    achieved = bytes_accessed / wall_seconds / 1e9
+    util = achieved / backend_bandwidth_gbs(backend)
+    _ACHIEVED.set(round(achieved, 4), engine=engine, horizon=horizon)
+    _ROOFLINE.set(round(util, 6), engine=engine, horizon=horizon)
+    return util
+
+
+class MemoryLedger:
+    """Named byte-accounting components reconciled against
+    ``jax.live_arrays()``.
+
+    Components are zero-arg callables returning their current device
+    bytes; they are polled at ``snapshot()`` time.  The ledger never
+    holds device arrays itself — callables typically close over the
+    pools they account, and the engine owns the ledger, so its
+    lifetime is the engine's."""
+
+    def __init__(self, name=""):
+        self.name = name
+        self._lock = threading.Lock()
+        self._components = {}
+        self._baseline_unaccounted = None
+
+    def register(self, component, fn):
+        if not callable(fn):
+            raise TypeError("component accounting fn must be callable")
+        with self._lock:
+            self._components[component] = fn
+        return self
+
+    def unregister(self, component):
+        with self._lock:
+            self._components.pop(component, None)
+
+    def components(self):
+        with self._lock:
+            return list(self._components)
+
+    def account(self):
+        """Poll every component: {component: bytes} (a component that
+        raises reports 0 rather than poisoning the snapshot)."""
+        with self._lock:
+            items = list(self._components.items())
+        out = {}
+        for name, fn in items:
+            try:
+                out[name] = int(fn())
+            except Exception:        # pragma: no cover - defensive
+                out[name] = 0
+        return out
+
+    def mark_baseline(self):
+        """Re-anchor the leak detector at the current residue (called
+        automatically by the first snapshot)."""
+        acct = self.account()
+        self._baseline_unaccounted = (live_device_bytes()
+                                      - sum(acct.values()))
+        return self._baseline_unaccounted
+
+    def snapshot(self):
+        """Reconcile + publish the ``memory.*`` gauges; returns the
+        ledger state as a JSON-able dict."""
+        acct = self.account()
+        accounted = sum(acct.values())
+        live = live_device_bytes()
+        unaccounted = live - accounted
+        if self._baseline_unaccounted is None:
+            self._baseline_unaccounted = unaccounted
+        leak = unaccounted - self._baseline_unaccounted
+        labels = dict(ledger=self.name)
+        for comp, b in acct.items():
+            _ACCT.set(b, component=comp, **labels)
+        _ACCT_TOTAL.set(accounted, **labels)
+        _LIVE.set(live, **labels)
+        _UNACCT.set(unaccounted, **labels)
+        _LEAK.set(leak, **labels)
+        return {
+            "ledger": self.name,
+            "components": acct,
+            "accounted_total_bytes": accounted,
+            "live_bytes": live,
+            "unaccounted_bytes": unaccounted,
+            "leak_delta_bytes": leak,
+        }

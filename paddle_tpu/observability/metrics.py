@@ -26,6 +26,7 @@ returning ``{counter: value}`` per subsystem) lives HERE now;
 from __future__ import annotations
 
 import collections
+import math
 import re
 import threading
 
@@ -401,8 +402,12 @@ class Registry:
 
 def _as_scalar(v):
     """Counters/gauges hold floats internally; render whole numbers as
-    ints so snapshots compare cleanly against expected counts."""
+    ints so snapshots compare cleanly against expected counts.  NaN and
+    infinities (gauges for unavailable analyses) pass through as-is —
+    json.dumps spells them NaN/Infinity, like the text exposition."""
     f = float(v)
+    if math.isnan(f) or math.isinf(f):
+        return f
     i = int(f)
     return i if i == f else f
 

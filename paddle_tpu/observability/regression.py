@@ -1,0 +1,169 @@
+"""Bench-regression gate: DECODE_BENCH.json as an enforced contract
+(observability phase 3).
+
+The bench trajectory accumulated across PRs (tok/s, KV bytes/step,
+compile counts, TTFT) was advisory until now — a PR could silently
+regress any row and CI stayed green.  This module compares a FRESH
+bench run (``benchmarks/bench_decode.py --only <section> --out f.json``)
+against the committed DECODE_BENCH.json and fails on regressions:
+
+* rows pair by exact ``metric`` string, which embeds the backend tag —
+  a cpu run never gates against a tpu row;
+* direction comes from the row's ``unit``: ``tokens/s`` and capacity
+  ratios regress DOWN, latency (``ms``) regresses UP;
+* the primary ``value`` is timing-derived and noisy, so it gets a
+  configurable relative ``tolerance`` (CI on shared cpu runners wants
+  a generous one);
+* deterministic per-row fields — KV bytes per step, compile counts,
+  dispatch counts — are pure functions of the code, so they gate at
+  ``det_tolerance`` (default exact): a paged-attention change that
+  doubles KV traffic fails even if tok/s noise hides it;
+* an explicit ``allow_regress`` substring list acknowledges intended
+  regressions (e.g. a PR that trades decode speed for capacity) —
+  allowed findings are reported but don't fail the gate.
+
+``python -m paddle_tpu.observability check-bench`` is the CLI; CI runs
+it against a tiny ``--only`` section per push.
+"""
+
+from __future__ import annotations
+
+import json
+
+#: deterministic per-row fields gated at det_tolerance, with their
+#: regression direction (False = lower is better, True = higher is
+#: better).  All byte/compile/dispatch counts regress UP.
+DETERMINISTIC_FIELDS = {
+    "kv_bytes_read_per_step": False,
+    "kv_bytes_per_block": False,
+    "weight_bytes": False,
+    "decode_compiles": False,
+    "prefill_compiles": False,
+    "prefill_dispatches": False,
+    "host_syncs": False,
+    "tokens_per_gb_kv_read": True,
+}
+
+
+def higher_is_better(unit):
+    """Regression direction from a row's unit string: throughput and
+    capacity regress down, latency regresses up."""
+    u = (unit or "").lower()
+    if "ms" in u or "second" in u or u.endswith("s avg ttft"):
+        return False
+    return True        # tokens/s, capacity ratios, unit-less counts
+
+
+def _rows_by_metric(doc):
+    rows = doc.get("results", doc) if isinstance(doc, dict) else doc
+    out = {}
+    for r in rows:
+        m = r.get("metric")
+        if m:
+            out[m] = r               # last write wins, like the bench
+    return out
+
+
+def _relative_change(baseline, fresh, better_up):
+    """Signed relative regression: positive = got worse."""
+    if baseline == 0:
+        return 0.0 if fresh == 0 else float("inf")
+    change = (fresh - baseline) / abs(baseline)
+    return -change if better_up else change
+
+
+def compare(baseline_doc, fresh_doc, tolerance=0.25, det_tolerance=0.0,
+            allow_regress=()):
+    """Compare two bench documents; returns a report dict.
+
+    Only metrics present in BOTH documents are gated (a ``--only``
+    fresh run re-measures one section; everything else is skipped and
+    listed).  ``allow_regress`` entries are case-insensitive substrings
+    matched against ``metric`` or ``metric::field``."""
+    base = _rows_by_metric(baseline_doc)
+    fresh = _rows_by_metric(fresh_doc)
+    shared = sorted(set(base) & set(fresh))
+    allow = [a.lower() for a in allow_regress]
+
+    def _allowed(metric, field):
+        probe = f"{metric}::{field}".lower()
+        return any(a in probe for a in allow)
+
+    findings, regressions, allowed = [], 0, 0
+    compared = 0
+    for metric in shared:
+        b, f = base[metric], fresh[metric]
+        checks = [("value", higher_is_better(b.get("unit")), tolerance)]
+        for field, up in DETERMINISTIC_FIELDS.items():
+            if field in b and field in f:
+                checks.append((field, up, det_tolerance))
+        for field, up, tol in checks:
+            bv, fv = b.get(field), f.get(field)
+            if not isinstance(bv, (int, float)) or \
+                    not isinstance(fv, (int, float)):
+                continue
+            compared += 1
+            worse = _relative_change(bv, fv, up)
+            if worse <= tol:
+                continue
+            ok = _allowed(metric, field)
+            findings.append({
+                "metric": metric,
+                "field": field,
+                "baseline": bv,
+                "fresh": fv,
+                "regression_pct": round(worse * 100.0, 2),
+                "tolerance_pct": round(tol * 100.0, 2),
+                "direction": "higher_is_better" if up
+                             else "lower_is_better",
+                "allowed": ok,
+            })
+            if ok:
+                allowed += 1
+            else:
+                regressions += 1
+    return {
+        "ok": regressions == 0,
+        "compared_metrics": len(shared),
+        "compared_values": compared,
+        "skipped_baseline_only": sorted(set(base) - set(fresh)),
+        "skipped_fresh_only": sorted(set(fresh) - set(base)),
+        "regressions": regressions,
+        "allowed_regressions": allowed,
+        "findings": findings,
+    }
+
+
+def load(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+def check_bench(baseline_path, fresh_path, tolerance=0.25,
+                det_tolerance=0.0, allow_regress=()):
+    """File-level entry point for the CLI/CI: returns the compare()
+    report with the paths recorded."""
+    report = compare(load(baseline_path), load(fresh_path),
+                     tolerance=tolerance, det_tolerance=det_tolerance,
+                     allow_regress=allow_regress)
+    report["baseline"] = str(baseline_path)
+    report["fresh"] = str(fresh_path)
+    return report
+
+
+def render_text(report):
+    lines = [
+        f"check-bench: {report['compared_metrics']} shared metrics, "
+        f"{report['compared_values']} values gated "
+        f"({len(report.get('skipped_baseline_only', []))} baseline-only "
+        "skipped)"]
+    for f in report["findings"]:
+        tag = "ALLOWED" if f["allowed"] else "REGRESSION"
+        lines.append(
+            f"  {tag}: {f['metric']} [{f['field']}] "
+            f"{f['baseline']} -> {f['fresh']} "
+            f"({f['regression_pct']:+.1f}% worse, tolerance "
+            f"{f['tolerance_pct']:.0f}%)")
+    lines.append("PASS" if report["ok"] else
+                 f"FAIL: {report['regressions']} regression(s)")
+    return "\n".join(lines) + "\n"

@@ -18,6 +18,8 @@ path               payload
                    request traces
 ``/debug/slo``     full SLO tracker snapshot (objectives, windows,
                    compliance, burn rates)
+``/debug/programs``  program-card registry JSON: per-compiled-program
+                   FLOPs, bytes-accessed, compile seconds, bucket meta
 ``/trace``         chrome-trace JSON: process event ring merged with
                    per-request async spans (load in Perfetto)
 ``/``              tiny JSON index of the above
@@ -32,22 +34,31 @@ the registry / recorder / SLO tracker, not the engine — an engine owns
 and stops its server (``EngineConfig(telemetry_port=...)``), but the
 server can outlive or predate any engine
 (``python -m paddle_tpu.observability serve``).
+
+Lifecycle: ``start()`` registers a ``telemetry.serverN`` provider on
+its registry (the scrape endpoint is itself observable — up/port per
+server); ``stop()`` unregisters it, shuts the listener down, and joins
+the serving thread.  A server the owner forgets to stop still cleans
+up at GC via ``weakref.finalize`` (the engine's provider pattern), so
+repeated engine build/close cycles never accumulate stale providers.
 """
 
 from __future__ import annotations
 
 import json
 import threading
+import weakref
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from . import events as _events
 from . import metrics as _metrics
+from . import profiling as _profiling
 
 #: content type the Prometheus exposition format 0.0.4 mandates
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 ROUTES = ("/metrics", "/healthz", "/readyz", "/debug/requests",
-          "/debug/slo", "/trace")
+          "/debug/slo", "/debug/programs", "/trace")
 
 
 class TelemetryServer:
@@ -70,6 +81,8 @@ class TelemetryServer:
         self.slo = slo
         self._httpd = None
         self._thread = None
+        self._provider_name = None
+        self._finalizer = None
 
     # ------------------------------------------------------------ plumbing
     def _registry(self):
@@ -91,8 +104,14 @@ class TelemetryServer:
     def url(self, path="/"):
         return f"http://{self._host}:{self.port}{path}"
 
+    _instances = 0
+
     def start(self):
-        """Bind and serve on a daemon thread; idempotent."""
+        """Bind and serve on a daemon thread; idempotent.  Registers a
+        ``telemetry.serverN`` counter provider on the registry (the
+        endpoint itself is observable) and arms a ``weakref.finalize``
+        so an un-stopped server still unregisters and closes its
+        socket when garbage-collected."""
         if self._httpd is not None:
             return self
         handler = _make_handler(self)
@@ -103,13 +122,35 @@ class TelemetryServer:
             target=self._httpd.serve_forever,
             name=f"telemetry:{self.port}", daemon=True)
         self._thread.start()
+        TelemetryServer._instances += 1
+        self._provider_name = f"telemetry.server{TelemetryServer._instances}"
+        reg = self._registry()
+        # the provider must not pin the server (mirror the engine's
+        # weakref provider): a dead/stopped server reports nothing
+        ref = weakref.ref(self)
+
+        def _provider():
+            srv = ref()
+            if srv is None or srv._httpd is None:
+                return {}
+            return {"up": 1, "port": srv.port}
+
+        reg.register_provider(self._provider_name, _provider)
+        self._finalizer = weakref.finalize(
+            self, _finalize_server, self._httpd, reg, self._provider_name)
         _events.instant("telemetry.start", cat="observability",
                         port=self.port)
         return self
 
     def stop(self):
-        """Shut down the listener and join the serving thread;
-        idempotent."""
+        """Unregister the metrics provider, shut down the listener, and
+        join the serving thread; idempotent."""
+        if self._finalizer is not None:
+            self._finalizer.detach()
+            self._finalizer = None
+        if self._provider_name is not None:
+            self._registry().unregister_provider(self._provider_name)
+            self._provider_name = None
         httpd, thread = self._httpd, self._thread
         self._httpd = self._thread = None
         if httpd is None:
@@ -156,6 +197,8 @@ class TelemetryServer:
                        else {"tracker": None, "healthy": True,
                              "objectives": {}})
             return 200, "application/json", _js(payload)
+        if path == "/debug/programs":
+            return 200, "application/json", _js(_profiling.to_json())
         if path == "/trace":
             extra = (self.recorder.chrome_events()
                      if self.recorder is not None else None)
@@ -172,13 +215,34 @@ def _js(obj):
     return (json.dumps(obj, indent=2, default=repr) + "\n").encode()
 
 
+def _finalize_server(httpd, registry, provider_name):
+    """GC fallback for a server that was never stop()ed: drop its
+    provider and close the socket (must not reference the server —
+    weakref.finalize callbacks that do would keep it alive forever)."""
+    registry.unregister_provider(provider_name)
+    try:
+        httpd.shutdown()
+        httpd.server_close()
+    except Exception:                # pragma: no cover - interp exit
+        pass
+
+
 def _make_handler(server):
+    # weakref, not a closure over the server: the serving thread holds
+    # the httpd which holds this handler class — a strong ref here
+    # would pin an abandoned server alive and its GC finalizer would
+    # never fire
+    ref = weakref.ref(server)
+
     class _Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
 
         def do_GET(self):
+            srv = ref()
             try:
-                status, ctype, body = server.handle(self.path)
+                if srv is None:
+                    raise RuntimeError("server shutting down")
+                status, ctype, body = srv.handle(self.path)
             except Exception as e:  # never kill the serving thread
                 status, ctype = 500, "text/plain; charset=utf-8"
                 body = f"error: {type(e).__name__}: {e}\n".encode()

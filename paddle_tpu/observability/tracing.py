@@ -78,8 +78,12 @@ class RequestTrace:
         self.events = []
 
     def add(self, kind, **args):
-        """Append one lifecycle event (monotonic-stamped)."""
+        """Append one lifecycle event (monotonic-stamped).  Returns the
+        event's args dict: the engine patches dispatch-derived fields
+        (program-card cost shares) into it after the compiled call,
+        when the card is actually known."""
         self.events.append((kind, time.monotonic() - self._mono0, args))
+        return args
 
     # ------------------------------------------------------------ queries
     def _snapshot(self):
@@ -98,9 +102,14 @@ class RequestTrace:
 
     def counts(self):
         """Engine-counter view reconstructed from the event sequence
-        alone: tokens emitted, prefix-hit tokens, preemptions,
-        decode horizons ridden, speculative accepted tokens."""
+        alone: tokens emitted, prefix-hit tokens, preemptions, decode
+        horizons ridden, speculative accepted tokens, and the request's
+        cost bill — program-card FLOP/byte shares summed over every
+        prefill/resume/decode dispatch it rode (the unit a fleet router
+        or per-tenant quota bills against; summed across requests these
+        reconstruct the engine's dispatch totals)."""
         tokens = prefix_hit = preempts = horizons = accepted = 0
+        flops = bytes_est = 0.0
         for kind, _, args in self._snapshot():
             if kind == FIRST_TOKEN:
                 tokens += 1
@@ -114,9 +123,13 @@ class RequestTrace:
                 prefix_hit = args.get("prefix_hit_tokens", prefix_hit)
             elif kind == PREEMPT:
                 preempts += 1
+            if kind in (PREFILL, RESUME, DECODE):
+                flops += args.get("flops_est", 0.0)
+                bytes_est += args.get("bytes_est", 0.0)
         return {"tokens_emitted": tokens, "prefix_hit_tokens": prefix_hit,
                 "preemptions": preempts, "decode_horizons": horizons,
-                "spec_accepted_tokens": accepted}
+                "spec_accepted_tokens": accepted,
+                "flops_est": flops, "bytes_est": bytes_est}
 
     def to_json(self):
         """Plain-dict reconstruction (the /debug/requests payload)."""

@@ -106,7 +106,9 @@ import jax.numpy as jnp
 from ..core import tape as _tape
 from ..core.tensor import Tensor
 from ..observability import events as _obs_events
+from ..observability import memory as _obs_memory
 from ..observability import metrics as _obs_metrics
+from ..observability import profiling as _obs_profiling
 from ..observability import tracing as _obs_tracing
 from ..observability.span import span as _obs_span
 from .drafter import draft_tokens
@@ -190,6 +192,10 @@ _SRV_BUCKETS = _obs_metrics.gauge(
     "distinct compiled decode programs ((horizon, nb, K) triples)")
 _SRV_ABORTS = _obs_metrics.counter(
     "serving.requests_aborted", "requests cancelled by the caller")
+_SRV_QUEUE_WAIT = _obs_metrics.histogram(
+    "serving.queue_wait_seconds",
+    "submit-to-admission wall seconds, observed when a request claims "
+    "a slot (re-admissions after preemption observe again)")
 # compile/cache families SHARED with jit/api.py: one place answers
 # "which function retraced" for both to_static and serving programs
 _COMPILE_COUNT = _obs_metrics.counter(
@@ -211,14 +217,31 @@ class CompiledFn:
     misses == number of distinct length buckets.  Hits/misses also land
     on the typed registry (``jit.compile_count`` / ``jit.cache_hit``
     labeled ``fn=name``) and every miss leaves a retrace-cause event plus
-    a compile begin/end pair on the timeline."""
+    a compile begin/end pair on the timeline.
 
-    def __init__(self, fn, donate_argnums=(), name=None, static_argnums=()):
+    With ``capture_cards=True`` every miss also probes the lowered
+    program for a :class:`~paddle_tpu.observability.profiling
+    .ProgramCard` — XLA cost/memory analysis, compile seconds, donated
+    bytes, and whatever static metadata ``meta_fn(args)`` supplies
+    (the engine passes the bucket key).  The probe's
+    ``lowered.compile()`` may re-run XLA (the executable cache does not
+    absorb it on every backend), so cards are memoized PROCESS-WIDE by
+    (name, signature): a second engine with the same shapes pays
+    nothing.  ``self.last_card`` tracks the card of the most recent
+    dispatch (hit or miss) — the engine's per-dispatch cost model."""
+
+    def __init__(self, fn, donate_argnums=(), name=None, static_argnums=(),
+                 capture_cards=False, meta_fn=None):
         self._jit = jax.jit(fn, donate_argnums=donate_argnums,
                             static_argnums=static_argnums)
         self._name = name or getattr(fn, "__name__", "fn")
         self._static = tuple(static_argnums)
+        self._donate = tuple(donate_argnums)
+        self._capture_cards = bool(capture_cards)
+        self._meta_fn = meta_fn
         self._seen = set()
+        self.cards = {}              # signature -> ProgramCard
+        self.last_card = None
         self.misses = 0
         self.hits = 0
 
@@ -233,11 +256,42 @@ class CompiledFn:
             (tuple(jnp.shape(a)), str(jnp.result_type(a)))
             for a in jax.tree.leaves(dynamic))
 
+    @staticmethod
+    def _card_key(sig):
+        """Short stable card key for one input signature (the human-
+        readable bucket semantics live in the card's meta)."""
+        import hashlib
+
+        return hashlib.sha1(repr(sig).encode()).hexdigest()[:12]
+
+    def _donated_bytes(self, args):
+        """Bytes of the argument leaves a dispatch donates (aval
+        metadata — safe to compute even around donation)."""
+        total = 0
+        for i in self._donate:
+            if i < len(args):
+                for leaf in jax.tree.leaves(args[i]):
+                    total += int(np.prod(jnp.shape(leaf), dtype=np.int64)
+                                 * jnp.dtype(jnp.result_type(leaf)).itemsize)
+        return total
+
+    def _meta(self, args):
+        if self._meta_fn is None:
+            return {}
+        try:
+            return dict(self._meta_fn(args))
+        except Exception:            # pragma: no cover - defensive
+            return {}
+
     def __call__(self, *args):
         sig = self._signature(args)
         if sig in self._seen:
             self.hits += 1
             _CACHE_HIT.inc(fn=self._name)
+            card = self.cards.get(sig)
+            if card is not None:
+                card.dispatches += 1
+            self.last_card = card
             return self._jit(*args)
         self._seen.add(sig)
         self.misses += 1
@@ -247,6 +301,20 @@ class CompiledFn:
                    else "new_input_signature"),
             cached_signatures=len(self._seen) - 1)
         _obs_events.begin("jit.compile", cat="serving", fn=self._name)
+        # lower BEFORE the call: on donating backends the call deletes
+        # the donated buffers, after which tracing them would fail.  A
+        # process-wide card for this exact program skips the probe.
+        lowered = card = None
+        donated = 0
+        if self._capture_cards:
+            key = self._card_key(sig)
+            card = _obs_profiling.default_registry().get(self._name, key)
+            if card is None:
+                donated = self._donated_bytes(args)
+                try:
+                    lowered = self._jit.lower(*args)
+                except Exception:    # pragma: no cover - defensive
+                    lowered = None
         t0 = time.perf_counter()
         try:
             return self._jit(*args)
@@ -256,6 +324,16 @@ class CompiledFn:
             _COMPILE_SECONDS.observe(dt, fn=self._name)
             _obs_events.end("jit.compile", cat="serving", fn=self._name,
                             seconds=round(dt, 9))
+            if self._capture_cards:
+                if card is None and lowered is not None:
+                    card = _obs_profiling.capture(
+                        self._name, key, lowered, compile_seconds=dt,
+                        donated_bytes=donated, meta=self._meta(args),
+                        backend=jax.default_backend())
+                if card is not None:
+                    card.dispatches += 1
+                    self.cards[sig] = card
+                self.last_card = card
 
 
 @dataclass
@@ -334,6 +412,15 @@ class EngineConfig:
     #: (bench_decode's tracing-overhead section measures it).
     request_tracing: bool = True
     flight_recorder_capacity: int = 256
+    #: program cards: capture XLA cost/memory analysis, compile seconds,
+    #: donated bytes, and the bucket key at the first compile of every
+    #: decode/prefill program (observability.profiling).  Cards feed the
+    #: compile.* gauges, /debug/programs, per-request cost attribution,
+    #: and the live roofline gauge.  The probe may cost one extra XLA
+    #: compile per DISTINCT program per process (cards are memoized
+    #: process-wide, so same-shape engines re-use them); False turns the
+    #: observatory off entirely.
+    program_cards: bool = True
     #: start a TelemetryServer (observability.server) on this port at
     #: engine construction, stopped by close().  0 binds an ephemeral
     #: port (engine.telemetry.port reports it); None disables.
@@ -478,14 +565,31 @@ class Engine:
         if self._kv_quant:
             decode_donate += (16, 17)
             prefill_donate += (10, 11)
+        # program-card metadata: the human-readable bucket key of each
+        # compiled program, read off the dispatch's own arguments
+        # (decode: tables arg 13, horizon/k statics 18/19; prefill: the
+        # padded ids arg 1)
+        def _decode_meta(args):
+            return {"horizon": int(args[18]), "k_draft": int(args[19]),
+                    "nb": int(args[13].shape[1]),
+                    "num_slots": int(args[13].shape[0])}
+
+        def _prefill_meta(args):
+            return {"lanes": int(args[1].shape[0]),
+                    "bucket": int(args[1].shape[1])}
+
+        cards = bool(self.config.program_cards)
         self._decode = CompiledFn(
             self._decode_fn,
             donate_argnums=decode_donate if donate else (),
-            static_argnums=(18, 19), name="serving.decode")
+            static_argnums=(18, 19), name="serving.decode",
+            capture_cards=cards, meta_fn=_decode_meta)
         self._prefill = CompiledFn(self._prefill_fn,
                                    donate_argnums=(prefill_donate
                                                    if donate else ()),
-                                   name="serving.prefill")
+                                   name="serving.prefill",
+                                   capture_cards=cards,
+                                   meta_fn=_prefill_meta)
 
         # observability
         self._decode_steps = 0
@@ -501,6 +605,12 @@ class Engine:
         self._spec_windows = 0           # verify windows of drafting lanes
         self._spec_accept_hist = {}      # tokens-emitted-per-window -> n
         self._kv_bytes_read = 0
+        # engine-local cost-model totals: card FLOPs/bytes summed over
+        # THIS engine's dispatches (card.dispatches is process-global
+        # across engines, so it can't serve as a per-engine total).
+        # Per-request attribution must reconstruct these within 1%.
+        self._program_flops = 0.0
+        self._program_bytes = 0.0
         self._cow_copies = 0
         self._preemptions = 0
         self._aborted = 0
@@ -538,6 +648,17 @@ class Engine:
             self._finalizer = weakref.finalize(
                 self, _profiler.unregister_counter_provider,
                 self._profiler_name)
+
+        # observability phase 3: the device-memory ledger reconciles
+        # what the engine KNOWS it holds (paged KV pool, weights,
+        # device decode state) against jax.live_arrays() at stats()
+        # time; live bytes NOBODY accounts for growing past the first
+        # snapshot is the leak signature (memory.leak_delta_bytes).
+        # Engine-owned, so the accounting closures can't outlive it.
+        self.ledger = _obs_memory.MemoryLedger(self._profiler_name)
+        self.ledger.register("kv_pool", self._kv_pool_bytes)
+        self.ledger.register("weights", self._weight_device_bytes)
+        self.ledger.register("engine_state", self._state_device_bytes)
 
         # observability phase 2: per-request flight records, declared
         # SLOs over the retirement stream, and the HTTP telemetry
@@ -982,10 +1103,13 @@ class Engine:
         lanes = self._lane_bucket(n)
         bs = self._block_size
         slots, leases, all_tokens = [], [], []
+        admit_events = []            # per-request trace args, for cost
         for req in batch:
             slot = self.cache.alloc()
             slots.append(slot)
             self.scheduler.start(req, slot)
+            _SRV_QUEUE_WAIT.observe(req.queue_seconds,
+                                    engine=self._profiler_name)
             toks = self._admission_tokens(req)
             all_tokens.append(toks)
             lease = self.prefix.acquire(toks)
@@ -1009,12 +1133,17 @@ class Engine:
                                 prompt_len=req.prompt_len, bucket=bucket,
                                 prefix_hit=lease.matched_tokens)
             if req.trace is not None:
-                req.trace.add(
+                # keep the event's args dict: the prefill program card
+                # isn't known until the dispatch below, so its cost
+                # share is patched in afterwards
+                admit_events.append(req.trace.add(
                     _obs_tracing.RESUME if req.output_ids
                     else _obs_tracing.PREFILL,
                     slot=slot, bucket=bucket,
                     prefill_tokens=len(toks),
-                    prefix_hit_tokens=lease.matched_tokens)
+                    prefix_hit_tokens=lease.matched_tokens))
+            else:
+                admit_events.append(None)
             if not req.output_ids:
                 # async span: a request's life overlaps other requests
                 # on this thread, so it pairs by id, not by B/E nesting
@@ -1077,6 +1206,21 @@ class Engine:
         _SRV_PREFILL.inc(engine=name)
         _SRV_PREFILL_REQS.inc(n, engine=name)
         _SRV_PREFILL_BATCH.observe(n, engine=name)
+
+        # cost attribution: the dispatch's program-card totals split
+        # evenly over the n REAL requests (padding lanes ride free but
+        # their work is part of serving these n), so per-request shares
+        # sum back to the engine's _program_* totals exactly
+        card = self._prefill.last_card
+        if card is not None:
+            self._program_flops += card.flops or 0.0
+            self._program_bytes += card.bytes_accessed or 0.0
+            for ev in admit_events:
+                if ev is not None:
+                    if card.flops is not None:
+                        ev["flops_est"] = card.flops / n
+                    if card.bytes_accessed is not None:
+                        ev["bytes_est"] = card.bytes_accessed / n
 
         # cache the new full blocks of every admitted prompt: the radix
         # store takes shared references on the slot's freshly written
@@ -1339,6 +1483,8 @@ class Engine:
         self._sync_device_state()
         self._sync_tables(nb)
         seeds, temps, top_ks, top_ps, eos_ids, limits = self._d_params
+        misses0 = self._decode.misses
+        t_disp = time.perf_counter()
         (tok, p, cnt, act, hb), new_k, new_v, new_ks, new_vs, toks = \
             self._decode(
                 self._state_arrays, self._d_tokens, self._d_pos,
@@ -1362,6 +1508,18 @@ class Engine:
         _SRV_KV_BYTES.inc(step_bytes * h, engine=self._profiler_name)
         toks = np.asarray(toks)      # the ONE host sync per horizon
         self._host_syncs += 1
+        dt_disp = time.perf_counter() - t_disp
+        card = self._decode.last_card
+        if card is not None:
+            self._program_flops += card.flops or 0.0
+            self._program_bytes += card.bytes_accessed or 0.0
+            # online roofline: this dispatch's bytes-accessed over its
+            # wall time vs the backend bandwidth — skipped on compiling
+            # dispatches, whose wall time is dominated by XLA
+            if self._decode.misses == misses0:
+                _obs_memory.publish_roofline(
+                    self._profiler_name, h, card.bytes_accessed,
+                    dt_disp, jax.default_backend())
         return toks
 
     def step(self, horizon=None):
@@ -1428,6 +1586,17 @@ class Engine:
         flip dirties the device state for the next upload)."""
         harvested = wasted = 0
         w = k_draft + 1
+        # cost attribution: the dispatch's program-card totals split
+        # evenly over the active lanes (every active lane — including
+        # one that retires mid-horizon — rides the whole compiled scan),
+        # so lane shares sum back to the engine's _program_* totals
+        card = self._decode.last_card
+        flops_share = bytes_share = None
+        if card is not None and active:
+            if card.flops is not None:
+                flops_share = card.flops / len(active)
+            if card.bytes_accessed is not None:
+                bytes_share = card.bytes_accessed / len(active)
         drafted = accepted = 0
         floor = float(self.config.spec_accept_floor)
         gated = self._spec_gates.copy()  # gates the dispatch ran with
@@ -1478,9 +1647,13 @@ class Engine:
                         self._spec_gates[slot] = ema >= floor
                         self._state_dirty = True
             if req.trace is not None and lane_tokens:
-                req.trace.add(_obs_tracing.DECODE, horizon=h,
-                              spec_k=k_draft, tokens=lane_tokens,
-                              accepted=lane_accept)
+                ev = req.trace.add(_obs_tracing.DECODE, horizon=h,
+                                   spec_k=k_draft, tokens=lane_tokens,
+                                   accepted=lane_accept)
+                if flops_share is not None:
+                    ev["flops_est"] = flops_share
+                if bytes_share is not None:
+                    ev["bytes_est"] = bytes_share
             if done:
                 self._retire(req)
                 finished.append(req)
@@ -1565,6 +1738,31 @@ class Engine:
         return best
 
     # ------------------------------------------------------------ metrics
+    # ------------------------------------------------- memory accounting
+    @staticmethod
+    def _tree_bytes(tree):
+        """Device bytes over a pytree of arrays (None leaves drop out of
+        jax.tree.leaves; a deleted buffer still reports its aval size)."""
+        total = 0
+        for leaf in jax.tree.leaves(tree):
+            try:
+                total += int(leaf.nbytes)
+            except Exception:        # pragma: no cover - defensive
+                continue
+        return total
+
+    def _kv_pool_bytes(self):
+        p = self.pool
+        return self._tree_bytes([p.k, p.v, p.k_scale, p.v_scale])
+
+    def _weight_device_bytes(self):
+        return self._tree_bytes(self._state_arrays)
+
+    def _state_device_bytes(self):
+        return self._tree_bytes([
+            self._d_tokens, self._d_pos, self._d_counts, self._d_active,
+            self._d_hist, self._d_gates, self._d_params, self._d_tables])
+
     def counters(self):
         """Observability snapshot (also exposed via
         paddle_tpu.profiler.counters())."""
@@ -1671,6 +1869,21 @@ class Engine:
             "lane_accept_ema": [round(float(x), 4)
                                 for x in self._spec_ema],
         }
+        # observability phase 3: program-card cost model + memory ledger
+        s["cost"] = {
+            "program_flops_total": self._program_flops,
+            "program_bytes_total": self._program_bytes,
+            "decode_cards": len({id(c) for c in
+                                 self._decode.cards.values()}),
+            "prefill_cards": len({id(c) for c in
+                                  self._prefill.cards.values()}),
+        }
+        s["memory"] = self.ledger.snapshot()
+        qp50 = _SRV_QUEUE_WAIT.percentile(50, engine=self._profiler_name)
+        if qp50 is not None:
+            s["queue_wait_p50_s"] = qp50
+            s["queue_wait_p95_s"] = _SRV_QUEUE_WAIT.percentile(
+                95, engine=self._profiler_name)
         if self._ttft_n:
             s["ttft_p50_s"] = _SRV_TTFT.percentile(
                 50, engine=self._profiler_name)

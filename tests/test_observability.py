@@ -474,7 +474,8 @@ class TestFlightRecorder:
         c = tr.counts()
         assert c == {"tokens_emitted": 6, "prefix_hit_tokens": 6,
                      "preemptions": 1, "decode_horizons": 2,
-                     "spec_accepted_tokens": 2}
+                     "spec_accepted_tokens": 2,
+                     "flops_est": 0.0, "bytes_est": 0.0}
         assert tr.finished
         # monotonic event times
         ts = [t for _, t, _ in tr.events]
@@ -797,3 +798,486 @@ class TestTelemetryEndpoint:
             assert code == 200 and json.loads(body)["ready"]
         finally:
             eng.close()
+
+
+class TestProgramCards:
+    """Phase 3 program cards: capture from a real Lowered, process-wide
+    memoization, renderers, and NaN exposition for backends without an
+    analysis."""
+
+    def _capture_tiny(self, fn_name="test.prog", key="k0", **kw):
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.observability import profiling
+
+        f = jax.jit(lambda x: (x * 2.0).sum())
+        lowered = f.lower(jnp.ones((8, 8), jnp.float32))
+        return profiling.capture(fn_name, key, lowered,
+                                 compile_seconds=0.012,
+                                 donated_bytes=256,
+                                 meta={"bucket": 8}, backend="cpu", **kw)
+
+    def test_capture_from_lowered(self):
+        from paddle_tpu.observability import profiling
+
+        reg = profiling.ProgramCardRegistry()
+        card = self._capture_tiny(registry=reg)
+        assert card.flops and card.flops > 0
+        assert card.bytes_accessed and card.bytes_accessed > 0
+        assert card.analysis_source in ("lowered", "compiled")
+        assert card.compile_seconds == pytest.approx(0.012)
+        assert card.donated_bytes == 256
+        assert card.meta == {"bucket": 8}
+        # gauges published per (fn, key)
+        assert obs_metrics.value("compile.program_flops",
+                                 fn="test.prog", key="k0") == card.flops
+        assert obs_metrics.value("compile.programs",
+                                 fn="test.prog") == 1
+        # memoization handle: the registry serves the same card back
+        assert reg.get("test.prog", "k0") is card
+        assert reg.get("test.prog", "other") is None
+
+    def test_registry_json_totals_and_render(self):
+        from paddle_tpu.observability import profiling
+
+        reg = profiling.ProgramCardRegistry()
+        card = self._capture_tiny(registry=reg)
+        card.dispatches = 3
+        doc = reg.to_json()
+        assert doc["count"] == 1
+        assert doc["total_flops_dispatched"] == pytest.approx(
+            card.flops * 3)
+        assert doc["total_bytes_dispatched"] == pytest.approx(
+            card.bytes_accessed * 3)
+        json.dumps(doc)                       # JSON-able as-is
+        text = reg.render_text()
+        assert "test.prog" in text and "bucket=8" in text
+        assert profiling.ProgramCardRegistry().render_text().startswith(
+            "no program cards")
+
+    def test_capture_never_raises_and_records_nones(self):
+        """A backend without any analysis still yields a card; its
+        gauges render as NaN, and the exposition stays parseable."""
+        from paddle_tpu.observability import profiling
+        from paddle_tpu.observability.metrics import validate_exposition
+
+        class _DeadLowered:
+            def cost_analysis(self):
+                raise NotImplementedError("no analysis on this backend")
+
+            def compile(self):
+                raise NotImplementedError
+
+        reg = profiling.ProgramCardRegistry()
+        card = profiling.capture("test.dead", "kx", _DeadLowered(),
+                                 compile_seconds=0.5, backend="cpu",
+                                 registry=reg)
+        assert card.flops is None and card.bytes_accessed is None
+        assert card.analysis_source is None
+        v = obs_metrics.value("compile.program_flops",
+                              fn="test.dead", key="kx")
+        assert v != v                          # NaN
+        text = obs_metrics.render_prometheus()
+        assert validate_exposition(text) > 0
+        assert "compile_program_flops" in text and "NaN" in text
+
+    def test_deep_probe_fills_memory_stats(self):
+        """deep=True reads the executable's memory_analysis (where the
+        backend provides one) — argument bytes at minimum."""
+        card = self._capture_tiny(fn_name="test.deep", key="kd",
+                                  deep=True)
+        # cpu's memory_analysis may legitimately be absent; when it is
+        # present the fields must be ints, and to_json carries them
+        doc = card.to_json()
+        for f in ("argument_bytes", "output_bytes", "temp_bytes"):
+            assert doc[f] is None or isinstance(doc[f], int)
+
+
+class TestMemoryLedger:
+    """Phase 3 device-memory ledger: component accounting, leak-delta
+    baseline, gauge publication, and the roofline helpers."""
+
+    def test_account_and_raising_component(self):
+        from paddle_tpu.observability.memory import MemoryLedger
+
+        led = MemoryLedger("t")
+        led.register("a", lambda: 100).register("b", lambda: 28)
+
+        def boom():
+            raise RuntimeError("accounting down")
+
+        led.register("bad", boom)
+        assert led.account() == {"a": 100, "b": 28, "bad": 0}
+        led.unregister("bad")
+        assert sorted(led.components()) == ["a", "b"]
+        with pytest.raises(TypeError):
+            led.register("notfn", 42)
+
+    def test_snapshot_reconciles_and_publishes(self):
+        from paddle_tpu.observability.memory import MemoryLedger
+
+        led = MemoryLedger("snap-test")
+        led.register("kv", lambda: 64)
+        snap = led.snapshot()
+        assert snap["accounted_total_bytes"] == 64
+        assert snap["live_bytes"] >= 0
+        assert snap["unaccounted_bytes"] == snap["live_bytes"] - 64
+        # first snapshot self-baselines -> zero leak
+        assert snap["leak_delta_bytes"] == 0
+        assert obs_metrics.value("memory.accounted_bytes",
+                                 ledger="snap-test", component="kv") == 64
+        assert obs_metrics.value(
+            "memory.accounted_total_bytes", ledger="snap-test") == 64
+        # the memory.* gauges render as a parseable exposition
+        from paddle_tpu.observability.metrics import validate_exposition
+
+        text = obs_metrics.render_prometheus()
+        assert validate_exposition(text) > 0
+        for name in ("memory_accounted_bytes", "memory_live_bytes",
+                     "memory_unaccounted_bytes",
+                     "memory_leak_delta_bytes"):
+            assert name in text
+        # ...and survive snapshot() too (NaN-bearing registries broke
+        # this once: int(NaN) in _as_scalar)
+        json.dumps(obs_metrics.snapshot())
+
+    def test_leak_delta_tracks_unaccounted_growth(self, monkeypatch):
+        from paddle_tpu.observability import memory as mem
+
+        led = mem.MemoryLedger("leak-test")
+        led.register("pool", lambda: 1000)
+        live = {"v": 1500}
+        monkeypatch.setattr(mem, "live_device_bytes",
+                            lambda: live["v"])
+        assert led.snapshot()["leak_delta_bytes"] == 0
+        # pool growth alone is NOT a leak: accounted grows with live
+        led.unregister("pool")
+        led.register("pool", lambda: 1400)
+        live["v"] = 1900
+        assert led.snapshot()["leak_delta_bytes"] == 0
+        # unaccounted residue growth IS
+        live["v"] = 2100
+        assert led.snapshot()["leak_delta_bytes"] == 200
+        # re-anchoring forgives the residue
+        led.mark_baseline()
+        assert led.snapshot()["leak_delta_bytes"] == 0
+
+    def test_publish_roofline(self):
+        from paddle_tpu.observability import memory as mem
+
+        bw = mem.backend_bandwidth_gbs("tpu")
+        assert bw == 819.0                    # datasheet entry
+        # 819 GB in 2 s against an 819 GB/s roofline = 50%
+        util = mem.publish_roofline("e0", 8, 819.0e9, 2.0, "tpu")
+        assert util == pytest.approx(0.5)
+        assert obs_metrics.value("memory.roofline_utilization",
+                                 engine="e0", horizon=8) == \
+            pytest.approx(0.5, abs=1e-4)
+        assert obs_metrics.value("memory.achieved_bandwidth_gbs",
+                                 engine="e0", horizon=8) == \
+            pytest.approx(409.5, rel=1e-3)
+        # degenerate dispatches publish nothing
+        assert mem.publish_roofline("e0", 8, 0, 1.0, "tpu") is None
+        assert mem.publish_roofline("e0", 8, 100.0, 0.0, "tpu") is None
+
+    def test_bandwidth_probe_memoized(self):
+        from paddle_tpu.observability import memory as mem
+
+        a = mem.backend_bandwidth_gbs("cpu")
+        b = mem.backend_bandwidth_gbs("cpu")
+        assert a == b and a > 0               # one probe per process
+
+
+class TestRegressionGate:
+    """Phase 3 bench-regression gate over synthetic fixtures."""
+
+    @staticmethod
+    def _doc(tok_s=100.0, ttft_ms=50.0, kv_bytes=4096,
+             decode_compiles=2):
+        return {"backend": "cpu", "results": [
+            {"metric": "engine decode tokens/s b1 (cpu)",
+             "value": tok_s, "unit": "tokens/s",
+             "kv_bytes_read_per_step": kv_bytes,
+             "decode_compiles": decode_compiles},
+            {"metric": "engine ttft (cpu)",
+             "value": ttft_ms, "unit": "ms"},
+        ]}
+
+    def test_identical_docs_pass(self):
+        from paddle_tpu.observability import regression
+
+        rep = regression.compare(self._doc(), self._doc(), tolerance=0.0)
+        assert rep["ok"] and rep["regressions"] == 0
+        assert rep["compared_metrics"] == 2
+        assert rep["compared_values"] == 4    # 2 values + 2 det fields
+        assert regression.render_text(rep).rstrip().endswith("PASS")
+
+    def test_injected_20pct_tok_s_regression_detected(self):
+        """The acceptance fixture: 20% tok/s drop must trip a 10%
+        tolerance gate, and the finding must carry the numbers."""
+        from paddle_tpu.observability import regression
+
+        rep = regression.compare(self._doc(tok_s=100.0),
+                                 self._doc(tok_s=80.0), tolerance=0.10)
+        assert not rep["ok"] and rep["regressions"] == 1
+        f = rep["findings"][0]
+        assert f["field"] == "value"
+        assert f["regression_pct"] == pytest.approx(20.0)
+        assert f["direction"] == "higher_is_better"
+        assert "FAIL: 1 regression(s)" in regression.render_text(rep)
+        # the same drop under a generous tolerance passes
+        rep = regression.compare(self._doc(tok_s=100.0),
+                                 self._doc(tok_s=80.0), tolerance=0.25)
+        assert rep["ok"]
+        # tok/s going UP is an improvement, never a finding
+        rep = regression.compare(self._doc(tok_s=100.0),
+                                 self._doc(tok_s=130.0), tolerance=0.10)
+        assert rep["ok"] and not rep["findings"]
+
+    def test_latency_direction_from_unit(self):
+        from paddle_tpu.observability import regression
+
+        assert regression.higher_is_better("tokens/s")
+        assert not regression.higher_is_better("ms")
+        assert not regression.higher_is_better("s avg ttft")
+        # ttft (ms) rising 40% trips; falling is an improvement
+        rep = regression.compare(self._doc(ttft_ms=50.0),
+                                 self._doc(ttft_ms=70.0), tolerance=0.10)
+        assert not rep["ok"]
+        assert rep["findings"][0]["metric"] == "engine ttft (cpu)"
+        rep = regression.compare(self._doc(ttft_ms=50.0),
+                                 self._doc(ttft_ms=30.0), tolerance=0.10)
+        assert rep["ok"]
+
+    def test_deterministic_fields_gate_exact(self):
+        """KV traffic doubling fails at det_tolerance=0 even when tok/s
+        noise hides it behind the loose value tolerance."""
+        from paddle_tpu.observability import regression
+
+        rep = regression.compare(self._doc(kv_bytes=4096),
+                                 self._doc(kv_bytes=8192),
+                                 tolerance=0.5, det_tolerance=0.0)
+        assert not rep["ok"]
+        assert rep["findings"][0]["field"] == "kv_bytes_read_per_step"
+        # compile-count creep is likewise deterministic
+        rep = regression.compare(self._doc(decode_compiles=2),
+                                 self._doc(decode_compiles=3),
+                                 tolerance=0.5)
+        assert not rep["ok"]
+        assert rep["findings"][0]["field"] == "decode_compiles"
+        # det_tolerance loosens it explicitly
+        rep = regression.compare(self._doc(decode_compiles=2),
+                                 self._doc(decode_compiles=3),
+                                 tolerance=0.5, det_tolerance=0.6)
+        assert rep["ok"]
+
+    def test_allow_regress_acknowledges(self):
+        from paddle_tpu.observability import regression
+
+        rep = regression.compare(
+            self._doc(tok_s=100.0), self._doc(tok_s=70.0),
+            tolerance=0.10,
+            allow_regress=["decode tokens/s b1 (cpu)::value"])
+        assert rep["ok"] and rep["regressions"] == 0
+        assert rep["allowed_regressions"] == 1
+        assert rep["findings"][0]["allowed"]
+        assert "ALLOWED" in regression.render_text(rep)
+        # the allowlist is per metric::field, not a blanket waiver
+        rep = regression.compare(
+            self._doc(tok_s=70.0, ttft_ms=90.0), self._doc(tok_s=70.0,
+                                                           ttft_ms=90.0))
+        assert rep["ok"]
+
+    def test_only_shared_metrics_gate(self):
+        """A --only fresh run re-measures one section; baseline-only
+        rows are skipped and listed, never failed."""
+        from paddle_tpu.observability import regression
+
+        fresh = {"results": [self._doc()["results"][0]]}
+        rep = regression.compare(self._doc(), fresh, tolerance=0.0)
+        assert rep["ok"] and rep["compared_metrics"] == 1
+        assert rep["skipped_baseline_only"] == ["engine ttft (cpu)"]
+        extra = {"results": self._doc()["results"] + [
+            {"metric": "brand new (cpu)", "value": 1.0, "unit": "x"}]}
+        rep = regression.compare(self._doc(), extra, tolerance=0.0)
+        assert rep["skipped_fresh_only"] == ["brand new (cpu)"]
+
+    def test_check_bench_files(self, tmp_path):
+        from paddle_tpu.observability import regression
+
+        b = tmp_path / "base.json"
+        f = tmp_path / "fresh.json"
+        b.write_text(json.dumps(self._doc()))
+        f.write_text(json.dumps(self._doc(tok_s=75.0)))
+        rep = regression.check_bench(str(b), str(f), tolerance=0.10)
+        assert not rep["ok"]
+        assert rep["baseline"] == str(b) and rep["fresh"] == str(f)
+
+    def test_committed_bench_self_check_passes(self):
+        """The committed DECODE_BENCH.json gates cleanly against
+        itself (the CI job's degenerate case)."""
+        import os
+
+        from paddle_tpu.observability import regression
+
+        path = os.path.join(os.path.dirname(__file__), os.pardir,
+                            "DECODE_BENCH.json")
+        doc = regression.load(path)
+        rep = regression.compare(doc, doc, tolerance=0.0,
+                                 det_tolerance=0.0)
+        assert rep["ok"] and rep["regressions"] == 0
+        assert rep["compared_metrics"] > 10
+
+
+class TestProgramsEndpointAndCLI:
+    """/debug/programs routing + the programs / check-bench CLI modes."""
+
+    def test_debug_programs_route(self):
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.observability import profiling
+        from paddle_tpu.observability.server import TelemetryServer
+
+        f = jax.jit(lambda x: x + 1)
+        lowered = f.lower(jnp.ones((4,), jnp.float32))
+        profiling.capture("test.route", "rk", lowered, backend="cpu")
+        try:
+            srv = TelemetryServer(port=0)
+            status, ctype, body = srv.handle("/debug/programs")
+            assert status == 200 and ctype == "application/json"
+            doc = json.loads(body)
+            assert doc["count"] >= 1
+            assert any(c["fn"] == "test.route" for c in doc["cards"])
+            # the index advertises the route
+            _, _, idx = srv.handle("/")
+            assert "/debug/programs" in json.loads(idx)["endpoints"]
+        finally:
+            profiling.clear()
+
+    @pytest.mark.slow
+    def test_programs_cli_mode(self, tmp_path):
+        script = tmp_path / "load.py"
+        script.write_text(
+            "import jax, jax.numpy as jnp\n"
+            "from paddle_tpu.observability import profiling\n"
+            "f = jax.jit(lambda x: x * 3.0)\n"
+            "low = f.lower(jnp.ones((8,), jnp.float32))\n"
+            "profiling.capture('cli.prog', 'ck', low,\n"
+            "                  compile_seconds=0.02, backend='cpu',\n"
+            "                  meta={'bucket': 8})\n")
+        env = {**__import__("os").environ, "JAX_PLATFORMS": "cpu"}
+        out = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.observability",
+             "programs", "--exec", str(script)],
+            capture_output=True, text=True, timeout=120, env=env)
+        assert out.returncode == 0, out.stderr
+        assert "cli.prog" in out.stdout and "bucket=8" in out.stdout
+        out = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.observability",
+             "programs", "--exec", str(script), "--json"],
+            capture_output=True, text=True, timeout=120, env=env)
+        assert out.returncode == 0, out.stderr
+        doc = json.loads(out.stdout)
+        assert doc["cards"][0]["fn"] == "cli.prog"
+        assert doc["cards"][0]["flops"] > 0
+
+    @pytest.mark.slow
+    def test_check_bench_cli_mode(self, tmp_path):
+        base = tmp_path / "base.json"
+        fresh = tmp_path / "fresh.json"
+        row = {"metric": "m (cpu)", "value": 100.0, "unit": "tokens/s"}
+        base.write_text(json.dumps({"results": [row]}))
+        fresh.write_text(json.dumps(
+            {"results": [{**row, "value": 79.0}]}))
+        env = {**__import__("os").environ, "JAX_PLATFORMS": "cpu"}
+
+        def run(*extra):
+            return subprocess.run(
+                [sys.executable, "-m", "paddle_tpu.observability",
+                 "check-bench", "--baseline", str(base), *extra],
+                capture_output=True, text=True, timeout=120, env=env)
+
+        # missing --fresh is usage error 2
+        assert run().returncode == 2
+        # 21% drop vs 10% tolerance: rc 1, FAIL rendered
+        out = run("--fresh", str(fresh), "--tolerance", "0.10")
+        assert out.returncode == 1, out.stderr
+        assert "FAIL: 1 regression(s)" in out.stdout
+        # allow-regress turns the same comparison green
+        report = tmp_path / "report.json"
+        out = run("--fresh", str(fresh), "--tolerance", "0.10",
+                  "--allow-regress", "m (cpu)::value",
+                  "-o", str(report))
+        assert out.returncode == 0, out.stderr
+        assert "PASS" in out.stdout
+        rep = json.loads(report.read_text())
+        assert rep["ok"] and rep["allowed_regressions"] == 1
+        # baseline vs itself: rc 0
+        out = run("--fresh", str(base), "--tolerance", "0.0")
+        assert out.returncode == 0, out.stderr
+
+
+class TestTelemetryServerLifecycle:
+    """Satellite: the server's own provider registers on start(),
+    unregisters on stop()/GC, and the serving thread is joined."""
+
+    def test_provider_registered_while_running(self):
+        from paddle_tpu.observability.server import TelemetryServer
+
+        reg = Registry()
+        srv = TelemetryServer(port=0, registry=reg)
+        assert reg.provider_counters() == {}
+        srv.start()
+        name = srv._provider_name
+        try:
+            assert name.startswith("telemetry.server")
+            provided = reg.provider_counters()[name]
+            assert provided == {"up": 1, "port": srv.port}
+        finally:
+            srv.stop()
+        assert name not in reg.provider_counters()
+        assert not srv.running and srv._thread is None
+
+    def test_stop_joins_thread_and_is_idempotent(self):
+        import urllib.request
+
+        from paddle_tpu.observability.server import TelemetryServer
+
+        srv = TelemetryServer(port=0, registry=Registry())
+        srv.start()
+        thread = srv._thread
+        url = srv.url("/healthz")
+        with urllib.request.urlopen(url, timeout=10) as r:
+            assert r.status == 200
+        srv.stop()
+        assert not thread.is_alive()
+        srv.stop()                            # idempotent
+        with pytest.raises(Exception):
+            urllib.request.urlopen(url, timeout=2)
+
+    def test_gc_unregisters_provider(self):
+        from paddle_tpu.observability.server import TelemetryServer
+
+        reg = Registry()
+        srv = TelemetryServer(port=0, registry=reg)
+        srv.start()
+        name = srv._provider_name
+        assert name in reg.provider_counters()
+        del srv
+        gc.collect()
+        assert name not in reg.provider_counters()
+
+    def test_repeated_cycles_leave_no_stale_providers(self):
+        from paddle_tpu.observability.server import TelemetryServer
+
+        reg = Registry()
+        for _ in range(3):
+            srv = TelemetryServer(port=0, registry=reg)
+            srv.start()
+            assert len([n for n in reg.provider_counters()
+                        if n.startswith("telemetry.server")]) == 1
+            srv.stop()
+        assert not [n for n in reg.provider_counters()
+                    if n.startswith("telemetry.server")]

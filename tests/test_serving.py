@@ -1823,3 +1823,176 @@ class TestRequestTracing:
         r2 = eng.submit([4, 5], SamplingParams(max_new_tokens=4))
         eng.abort(r2)
         assert r2.finish_reason == "abort"
+
+
+class TestPerformanceObservatory:
+    """Observability phase 3 at the engine level: every compiled
+    program has a cost card, per-request attribution reconstructs the
+    engine's dispatch totals, the memory ledger reconciles, and the
+    queue-wait histogram feeds stats()."""
+
+    @staticmethod
+    def _cfg(**kw):
+        kw.setdefault("num_slots", 2)
+        kw.setdefault("max_seq_len", 32)
+        kw.setdefault("max_horizon", 4)
+        kw.setdefault("prefix_block_size", 4)
+        kw.setdefault("prefix_cache_bytes", 0)
+        return EngineConfig(**kw)
+
+    def test_every_compiled_program_has_a_card(self):
+        m = _model()
+        eng = Engine(m, self._cfg(), register_profiler=False)
+        reqs = [eng.submit([1 + i, 2, 3, 4, 5][:3 + i % 3],
+                           SamplingParams(max_new_tokens=6, seed=i))
+                for i in range(4)]
+        eng.run()
+        assert all(r.finish_reason is not None for r in reqs)
+        # one card per distinct compiled program, on both fns
+        assert len(eng._decode.cards) == eng._decode.misses > 0
+        assert len(eng._prefill.cards) == eng._prefill.misses > 0
+        for fn in (eng._decode, eng._prefill):
+            for card in fn.cards.values():
+                assert card.flops and card.flops > 0
+                assert card.bytes_accessed and card.bytes_accessed > 0
+                assert card.compile_seconds > 0
+                assert card.dispatches >= 1
+        # decode cards carry the bucket semantics in meta
+        metas = [c.meta for c in eng._decode.cards.values()]
+        assert all({"horizon", "nb", "k_draft"} <= set(mt)
+                   for mt in metas)
+        assert ({(mt["horizon"], mt["nb"], mt["k_draft"])
+                 for mt in metas}
+                == set(eng.stats()["decode_buckets"]))
+        # ...and prefill cards the (lanes, bucket) pair
+        assert all({"lanes", "bucket"} <= set(mt.keys())
+                   for mt in (c.meta for c in eng._prefill.cards.values()))
+        # the dispatch ledger: every call rode a card (cards are
+        # process-wide, so other engines may have bumped them too)
+        assert (sum(c.dispatches for c in eng._decode.cards.values())
+                >= eng._decode.calls)
+        st = eng.stats()
+        assert st["cost"]["decode_cards"] == len(
+            {id(c) for c in eng._decode.cards.values()})
+        eng.close()
+
+    @pytest.mark.slow
+    def test_attribution_reconciles_under_preempt_and_spec(self):
+        """Sum of per-request flops/bytes estimates == the engine's own
+        dispatch-weighted card totals, within 1%, under continuous
+        batching with preemption and speculative decoding."""
+        m = _model()
+        prompts = [[7, 3, 9, 1, 4, 4, 2, 8], [5, 6, 7, 8, 9, 1, 2, 3],
+                   [2, 4, 6, 8], [1, 3, 5, 7, 9, 2]]
+        eng = Engine(m, self._cfg(kv_pool_blocks=8, spec_k=2),
+                     register_profiler=False)
+        reqs = [eng.submit(p, SamplingParams(max_new_tokens=10, seed=i))
+                for i, p in enumerate(prompts)]
+        eng.run()
+        assert eng.counters()["preemptions"] >= 1
+        assert eng.counters()["spec_accepted_tokens"] >= 0
+        st = eng.stats()
+        assert st["cost"]["program_flops_total"] > 0
+        assert st["cost"]["program_bytes_total"] > 0
+        got_f = sum(r.trace.counts()["flops_est"] for r in reqs)
+        got_b = sum(r.trace.counts()["bytes_est"] for r in reqs)
+        assert got_f == pytest.approx(st["cost"]["program_flops_total"],
+                                      rel=0.01)
+        assert got_b == pytest.approx(st["cost"]["program_bytes_total"],
+                                      rel=0.01)
+        # attribution is per-request meaningful, not all-on-one
+        assert all(r.trace.counts()["flops_est"] > 0 for r in reqs)
+        # /debug/requests carries the same numbers
+        doc = eng.recorder.to_json()
+        assert (sum(t["counts"]["flops_est"] for t in doc["recent"])
+                == pytest.approx(got_f))
+        eng.close()
+
+    def test_memory_ledger_reconciles_in_stats(self):
+        import gc
+
+        gc.collect()                 # settle foreign arrays first
+        m = _model()
+        eng = Engine(m, self._cfg(), register_profiler=False)
+        eng.submit([1, 2, 3], SamplingParams(max_new_tokens=4))
+        eng.run()
+        mem = eng.stats()["memory"]
+        assert set(mem["components"]) == {"kv_pool", "weights",
+                                          "engine_state"}
+        assert all(v > 0 for v in mem["components"].values())
+        assert (mem["accounted_total_bytes"]
+                == sum(mem["components"].values()))
+        # live_arrays is process-wide (other tests' arrays included),
+        # but it must at least cover what this engine accounts for
+        assert mem["live_bytes"] >= mem["accounted_total_bytes"]
+        # steady state: the unaccounted residue does not grow between
+        # snapshots of the same engine (the leak-detector contract)
+        eng.submit([4, 5, 6], SamplingParams(max_new_tokens=4))
+        eng.run()
+        gc.collect()
+        assert eng.stats()["memory"]["leak_delta_bytes"] <= 1 << 16
+        eng.close()
+
+    def test_queue_wait_histogram_in_stats(self):
+        m = _model()
+        eng = Engine(m, self._cfg(num_slots=1), register_profiler=False)
+        # second request queues behind the first -> nonzero wait
+        eng.submit([1, 2, 3], SamplingParams(max_new_tokens=6))
+        eng.submit([4, 5, 6], SamplingParams(max_new_tokens=2))
+        eng.run()
+        st = eng.stats()
+        assert "queue_wait_p50_s" in st and "queue_wait_p95_s" in st
+        assert st["queue_wait_p95_s"] >= st["queue_wait_p50_s"] >= 0.0
+        eng.close()
+
+    def test_program_cards_disabled(self):
+        m = _model()
+        eng = Engine(m, self._cfg(num_slots=1, program_cards=False),
+                     register_profiler=False)
+        r = eng.submit([1, 2, 3], SamplingParams(max_new_tokens=3))
+        eng.run()
+        assert r.finish_reason == "length"
+        assert eng._decode.cards == {} and eng._prefill.cards == {}
+        st = eng.stats()
+        assert st["cost"]["program_flops_total"] == 0.0
+        assert st["cost"]["decode_cards"] == 0
+        # tracing still works, just without cost estimates
+        assert r.trace.counts()["flops_est"] == 0.0
+        eng.close()
+
+    def test_abort_storm_flight_recorder_retention(self):
+        """Satellite: N submits then abort everything — the recorder's
+        ring retains only the last `capacity` finished traces, counts
+        the drops, and pins zero live traces afterwards."""
+        m = _model()
+        eng = Engine(m, self._cfg(num_slots=2,
+                                  flight_recorder_capacity=3),
+                     register_profiler=False)
+        reqs = [eng.submit([1 + i, 2, 3], SamplingParams(
+            max_new_tokens=8, seed=i)) for i in range(8)]
+        eng.step(horizon=2)          # two admitted + decoding, six queued
+        for r in reqs:
+            if r.finish_reason is None:
+                eng.abort(r)
+        assert all(r.finish_reason is not None for r in reqs)
+        aborted = [r for r in reqs if r.finish_reason == "abort"]
+        assert len(aborted) >= 6
+        for r in aborted:
+            assert [k for k, _, _ in r.trace.events][-1] == "abort"
+        rec = eng.recorder
+        assert rec.live() == []                  # nothing pinned
+        doc = rec.to_json()
+        assert doc["live_count"] == 0
+        assert doc["finished_total"] == len(reqs)
+        assert doc["finished_retained"] == 3
+        assert rec.dropped == len(reqs) - 3
+        assert ([t.request_id for t in rec.recent()]
+                == [r.request_id for r in reqs[-3:]])
+        # the engine is fully torn down and still serviceable
+        assert eng.scheduler.queue_depth == 0
+        assert not eng.scheduler.running
+        assert eng.pool.blocks_in_use == 0
+        r9 = eng.submit([7, 7], SamplingParams(max_new_tokens=2))
+        eng.run()
+        assert r9.finish_reason == "length"
+        eng.close()
