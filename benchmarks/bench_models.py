@@ -477,8 +477,100 @@ print("HYBRID_OK")
                       if ok else r.stderr[-300:]}
 
 
+MULTICHIP_SCHEMA_VERSION = 1
+
+
+def _git_sha():
+    import subprocess
+
+    try:
+        r = subprocess.run(["git", "rev-parse", "--short=12", "HEAD"],
+                           capture_output=True, text=True, timeout=10,
+                           cwd=os.path.dirname(os.path.abspath(__file__)))
+        sha = r.stdout.strip()
+        return sha if r.returncode == 0 and sha else "unknown"
+    except (OSError, ValueError):
+        return "unknown"
+
+
+def bench_multichip_comms(out=None):
+    """Collective-comms census + step timing of the explicit multichip
+    configs (benchmarks/multichip_comms.py) on 8 virtual CPU devices.
+
+    Rows carry the jaxpr walker's per-config collective counts by op
+    (deterministic — gated EXACT by check-bench), the modeled ring
+    wire bytes per step, and the comms-roofline share of the measured
+    step.  Written with the DECODE_BENCH provenance discipline:
+    ``out=None`` merge-writes the committed MULTICHIP_BENCH.json
+    (run_id increments over the file's lifetime); ``out=FILE`` writes a
+    fresh document with run_id 0 for ``check-bench --bench-file``."""
+    import subprocess
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    child = os.path.join(root, "benchmarks", "multichip_comms.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    t0 = time.time()
+    r = subprocess.run([sys.executable, child], capture_output=True,
+                       text=True, timeout=1800, env=env, cwd=root)
+    rows, errors = [], []
+    for line in r.stdout.splitlines():
+        if not line.startswith("{"):
+            continue
+        row = json.loads(line)
+        (errors if "error" in row else rows).append(row)
+    ok = "MULTICHIP_COMMS_OK" in r.stdout and not errors
+    sha = _git_sha()
+
+    if out is not None:
+        for row in rows:
+            row["schema_version"] = MULTICHIP_SCHEMA_VERSION
+            row["git_sha"] = sha
+            row["run_id"] = 0
+        with open(out, "w") as f:
+            json.dump({"backend": "cpu8", "results": rows}, f, indent=1)
+    elif rows:
+        path = os.path.join(root, "MULTICHIP_BENCH.json")
+        kept, run_id = [], 1
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    prev = json.load(f)
+                prev_rows = prev.get("results", [])
+                new_metrics = {row["metric"] for row in rows}
+                latest = {}
+                for row in prev_rows:
+                    if row.get("metric", "") not in new_metrics:
+                        latest[row.get("metric", "")] = row
+                kept = list(latest.values())
+                run_id = 1 + max((int(row.get("run_id", 0))
+                                  for row in prev_rows), default=0)
+            except (ValueError, OSError):
+                kept, run_id = [], 1
+        for row in rows:
+            row["schema_version"] = MULTICHIP_SCHEMA_VERSION
+            row["git_sha"] = sha
+            row["run_id"] = run_id
+        with open(path, "w") as f:
+            json.dump({"backend": "cpu8", "results": kept + rows},
+                      f, indent=1)
+    for row in rows:
+        print(json.dumps(row))
+    return {"metric": "multichip comms suite (8-dev virtual mesh)",
+            "value": len(rows), "unit": "configs",
+            "ok": ok, "wall_s": round(time.time() - t0, 1),
+            **({"errors": [e.get("error", "")[:120] for e in errors]}
+               if errors else {})}
+
+
 def main():
-    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    argv = sys.argv[1:]
+    out = None
+    if "--out" in argv:
+        i = argv.index("--out")
+        out = argv[i + 1]
+        argv = argv[:i] + argv[i + 2:]
+    which = argv[0] if argv else "all"
     benches = {"resnet50": bench_resnet50,
                "resnet50_f32": lambda: bench_resnet50(dtype="float32"),
                "bert": bench_bert,
@@ -493,7 +585,8 @@ def main():
                "gpt_s4096": lambda: bench_gpt_longseq(seq=4096, batch=4),
                "gpt_s8192": bench_gpt_longseq,
                "llama": bench_llama,
-               "ernie_hybrid": bench_ernie_hybrid}
+               "ernie_hybrid": bench_ernie_hybrid,
+               "multichip_comms": lambda: bench_multichip_comms(out=out)}
     if which != "all" and which not in benches:
         print(f"unknown benchmark {which!r}; choose from "
               f"{sorted(benches)} or 'all'", file=sys.stderr)
@@ -504,7 +597,7 @@ def main():
               if n not in ("resnet50_f32", "unet_b16", "bert_b128",
                            "resnet50_b256", "resnet50_scan8", "bert_scan8",
                            "unet_scan8", "decode",
-                           "gpt_s4096", "gpt_s8192")]
+                           "gpt_s4096", "gpt_s8192", "multichip_comms")]
              if which == "all" else [which])
     if which == "all":
         # one fresh process per bench: HBM from a previous model (cached

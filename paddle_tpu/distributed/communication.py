@@ -16,6 +16,15 @@ per-rank eager tensor in single-controller jax) and raises with guidance.
 
 Gradient support: every wrapper routes through `core.op_call.apply`, so tape
 autograd records the vjp jax derives for the collective (psum ↔ psum, etc.).
+
+Observability (phase 4): every wrapper ticks the shared
+``comms.collective_calls``/``comms.wire_bytes`` families via
+``observability.comms.record_collective`` — including the world-size-1
+eager identity path, whose wire bytes are 0 by the ring model — so the
+eager API and the jaxpr walker feed ONE ledger.  A module-level
+``distributed.groups`` provider (registered once at import; by-name
+replacement makes re-import idempotent, so create/destroy cycles cannot
+accumulate providers) reports live and total-created groups.
 """
 
 from __future__ import annotations
@@ -29,6 +38,8 @@ from jax import lax
 
 from ..core.op_call import apply
 from ..core.tensor import Tensor
+from ..observability import comms as _obs_comms
+from ..observability import metrics as _obs_metrics
 from . import collective_ctx
 from .topology import Group, ReduceOp, get_hybrid_communicate_group
 
@@ -40,6 +51,42 @@ __all__ = [
 ]
 
 _GROUPS = {}
+_GROUPS_CREATED = 0
+
+
+def _groups_provider():
+    return {"live_groups": len(_GROUPS),
+            "created_total": _GROUPS_CREATED}
+
+
+_obs_metrics.register_provider("distributed.groups", _groups_provider)
+
+
+def _nbytes(x):
+    """Best-effort operand bytes of a Tensor/array/tracer (0 when the
+    value has no array-like shape — the ledger prefers honest zeros to
+    raising inside a collective)."""
+    data = getattr(x, "_data", x)
+    aval = getattr(data, "aval", None)
+    try:
+        if aval is not None:
+            return int(aval.size) * int(aval.dtype.itemsize)
+        return int(data.nbytes)
+    except Exception:
+        return 0
+
+
+def _tick(op, group, *operands):
+    """Record one collective call on the shared comms ledger.  Called at
+    Python-call time: once per eager identity call, once per trace for
+    compiled programs (the jaxpr walker owns per-dispatch accounting of
+    traced programs; this counter answers "which API paths fire")."""
+    try:
+        _obs_comms.record_collective(
+            op, group.axis_name, group.nranks,
+            sum(_nbytes(t) for t in operands))
+    except Exception:                # pragma: no cover - defensive
+        pass
 
 
 def _default_group():
@@ -62,12 +109,14 @@ def new_group(ranks=None, backend=None, timeout=None, axis_name=None):
     """Create a Group. TPU-native: a group must correspond to a mesh axis to
     be usable inside compiled code; `axis_name` picks it. Plain rank lists
     produce an opaque group usable only for bookkeeping/world-size-1."""
+    global _GROUPS_CREATED
     g = Group(
         axis_name=axis_name,
         nranks=len(ranks) if ranks else 1,
         ranks=ranks or [0],
     )
     _GROUPS[g.id] = g  # noqa: PTA402 -- bookkeeping registry, ints/ids only
+    _GROUPS_CREATED += 1
     return g
 
 
@@ -110,9 +159,18 @@ def _unary(tensor, fn, in_place=True):
     return out
 
 
+#: ReduceOp -> the collective the ledger records for an all_reduce
+#: (PROD gathers then multiplies; AVG's pmean lowers to psum + divide)
+_REDUCE_TICK_OP = {
+    ReduceOp.SUM: "psum", ReduceOp.MAX: "pmax", ReduceOp.MIN: "pmin",
+    ReduceOp.PROD: "all_gather", ReduceOp.AVG: "psum",
+}
+
+
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """In-place all-reduce (ref: communication/all_reduce.py (U))."""
     group = _resolve(group)
+    _tick(_REDUCE_TICK_OP.get(op, "psum"), group, tensor)
     axis = _axis_live(group)
     if axis is None:
         _eager_guard(group, "all_reduce")
@@ -141,6 +199,7 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
     fills `tensor_list` with per-rank tensors; we append per-rank slices so
     caller code written against the reference API keeps working."""
     group = _resolve(group)
+    _tick("all_gather", group, tensor)
     ax = _axis_live(group)
     if ax is None:
         _eager_guard(group, "all_gather")
@@ -180,6 +239,8 @@ def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None, s
     group = _resolve(group)
     ax = _axis_live(group)
     src = tensor_or_tensor_list
+    _tick("psum_scatter", group,
+          *(src if isinstance(src, (list, tuple)) else (src,)))
     if isinstance(src, (list, tuple)):
         from ..tensor.manipulation import concat
 
@@ -201,6 +262,7 @@ def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None, s
 def broadcast(tensor, src=0, group=None, sync_op=True):
     """Under SPMD a broadcast is: select the source shard on every rank."""
     group = _resolve(group)
+    _tick("all_gather", group, tensor)
     ax = _axis_live(group)
     if ax is None:
         _eager_guard(group, "broadcast")
@@ -245,6 +307,7 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
 def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
     """ref: communication/all_to_all.py (U). SPMD: lax.all_to_all."""
     group = _resolve(group)
+    _tick("all_to_all", group, *in_tensor_list)
     ax = _axis_live(group)
     if ax is None:
         _eager_guard(group, "alltoall")
@@ -267,6 +330,7 @@ def alltoall_single(
     group=None, sync_op=True,
 ):
     group = _resolve(group)
+    _tick("all_to_all", group, in_tensor)
     ax = _axis_live(group)
     if ax is None:
         _eager_guard(group, "alltoall_single")
@@ -292,6 +356,7 @@ def shift(tensor, offset=1, group=None):
     `lax.ppermute` — the building block pipeline/ring layers use instead of
     the reference's send_v2/recv_v2 ops (SURVEY.md §2.1 N14)."""
     group = _resolve(group)
+    _tick("ppermute", group, tensor)
     ax = _axis_live(group)
     if ax is None:
         _eager_guard(group, "shift")

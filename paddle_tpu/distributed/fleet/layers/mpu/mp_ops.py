@@ -24,6 +24,7 @@ from jax import lax
 from .....core.op_call import apply
 from .....core.tensor import Tensor
 from .... import collective_ctx
+from ....shard_map_compat import axis_size as _axis_size
 
 
 # ---------------------------------------------------------------- raw (jnp)
@@ -67,7 +68,7 @@ allreduce_fwd_identity_bwd.defvjp(_ar_fwd, _ar_bwd)
 def split_last_dim(x, axis_name):
     """ref `_c_split`: keep this rank's slice of the last dim. Backward is the
     all-gather jax derives from dynamic_slice + the surrounding shard_map."""
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     i = lax.axis_index(axis_name)
     size = x.shape[-1] // n
     return lax.dynamic_slice_in_dim(x, i * size, size, axis=-1)
@@ -92,7 +93,7 @@ def vocab_parallel_embedding_lookup(ids, local_weight, axis_name):
     """ref `_c_lookup_table` + VocabParallelEmbedding.forward: each rank owns
     rows [i*per, (i+1)*per) of the embedding table; out-of-range ids produce
     zeros and the partial lookups are summed over the mp axis."""
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     i = lax.axis_index(axis_name)
     per = local_weight.shape[0]
     start = i * per

@@ -940,10 +940,25 @@ class PipelineParallel(Layer):
             self._train_step = TrainStep(
                 self._layers, self._loss_fn_for(self.accumulate_steps),
                 optimizer, scaler=scaler)
+            self._publish_schedule_skew()
         loss = self._train_step(x_mbs, y_mbs)
         if lr_scheduler is not None:
             lr_scheduler.step()
         return loss
+
+    def _publish_schedule_skew(self):
+        """Publish the pipeline-bubble skew gauge once per compiled
+        schedule (the observability comms ledger; best-effort — a
+        metrics failure must never fail training)."""
+        try:
+            from ....observability import comms as _obs_comms
+
+            _obs_comms.publish_pipeline_schedule(
+                self.schedule, self._layers.num_stages,
+                self.accumulate_steps,
+                virtual=getattr(self._layers, "num_virtual_stages", 1))
+        except Exception:            # pragma: no cover - defensive
+            pass
 
     def eval_batch(self, data, compute_loss=True):
         x, y = data

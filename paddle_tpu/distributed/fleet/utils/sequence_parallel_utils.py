@@ -24,6 +24,7 @@ from ....nn import functional as F
 from ....nn.initializer import XavierNormal
 from ....nn.layer.layers import Layer
 from ... import collective_ctx
+from ...shard_map_compat import axis_size as _axis_size
 from ...topology import get_hybrid_communicate_group
 from ..layers.mpu import mp_ops
 
@@ -48,7 +49,7 @@ def scatter(t, axis=_DEFAULT_SP_DIM):
         return t
 
     def f(x):
-        n = lax.axis_size("mp")
+        n = _axis_size("mp")
         i = lax.axis_index("mp")
         size = x.shape[axis] // n
         return lax.dynamic_slice_in_dim(x, i * size, size, axis=axis)

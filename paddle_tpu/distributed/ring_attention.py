@@ -38,6 +38,7 @@ from ..ops.pallas.flash import (
     _pad_seq,
 )
 from . import collective_ctx
+from .shard_map_compat import axis_size as _axis_size
 
 NEG_INF = -1e30
 
@@ -60,7 +61,7 @@ def _ring_mode(src, idx):
 
 
 def _ring_fwd_res(q, k, v, causal, scale, axis_name, interpret):
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     b, s_local, h, d = q.shape
     hkv = k.shape[2]
@@ -137,7 +138,7 @@ def _ring_core_bwd(causal, scale, axis_name, interpret, res, g):
     visiting block; dk/dv accumulators rotate in lockstep with k/v, so after
     the full cycle each lands back on its owner."""
     qp, kp, vp, outp, lsep = res
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     b, s_local, h, d = g.shape
     hkv_bh = kp.shape[0]
@@ -215,7 +216,7 @@ def ring_flash_attention_arrays(q, k, v, causal=False, scale=None,
 def ulysses_attention_arrays(q, k, v, causal=False, scale=None,
                              axis_name="sep", attn_fn=None):
     """Ulysses: all_to_all seq-shard -> head-shard, attend, swap back."""
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     h = q.shape[2]
     if h % n:
         raise ValueError(f"num_heads {h} not divisible by sep degree {n}")

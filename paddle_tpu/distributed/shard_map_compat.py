@@ -46,4 +46,19 @@ else:
             kwargs.setdefault("check_rep", check_vma)
         return _shard_map(*args, **kwargs)
 
-__all__ = ["shard_map", "NO_CHECK"]
+
+def axis_size(axis_name):
+    """Static size of a named mesh axis from inside shard_map.
+
+    ``lax.axis_size`` only exists in newer jax; on older releases
+    ``lax.psum(1, axis)`` constant-folds to the same static int (no
+    collective is emitted for a literal operand), so every mapped-code
+    caller (ring attention, MoE EP, mp_ops) resolves through here."""
+    from jax import lax
+
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
+
+
+__all__ = ["shard_map", "NO_CHECK", "axis_size"]

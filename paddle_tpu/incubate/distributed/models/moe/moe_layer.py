@@ -21,6 +21,7 @@ from jax import lax
 from .....core.op_call import apply
 from .....core.tensor import Tensor
 from .....distributed import collective_ctx
+from .....distributed.shard_map_compat import axis_size as _axis_size
 from .....nn import functional as F
 from .....nn.initializer import XavierNormal
 from .....nn.layer.layers import Layer
@@ -54,6 +55,7 @@ class MoELayer(Layer):
         self.gate = gate
         self.activation = activation
         self.l_aux = None  # set each forward (ref keeps it on the layer)
+        self.tokens_per_expert = None  # [E] per-expert load, set each forward
 
         self.gate_weight = self.create_parameter(
             [d_model, num_experts], default_initializer=XavierNormal())
@@ -79,14 +81,19 @@ class MoELayer(Layer):
         return y + b2
 
     def _forward_arrays(self, x, gw, w1, b1, w2, b2, axis):
-        """x [T, M]; returns (y [T, M], aux loss scalar)."""
+        """x [T, M]; returns (y [T, M], aux loss scalar,
+        tokens-per-expert [E])."""
         logits = jnp.einsum("tm,me->te", x, gw,
                             preferred_element_type=jnp.float32)
         dispatch, combine, aux = self.gate(logits)
+        # [T, E, C] one-hot dispatch summed over tokens and capacity
+        # slots = tokens routed to each expert (post-drop); the ledger's
+        # expert-load skew signal
+        tokens_per_expert = dispatch.astype(jnp.float32).sum(axis=(0, 2))
         expert_in = jnp.einsum("tec,tm->ecm", dispatch.astype(x.dtype), x)
 
         if axis is not None:
-            n = lax.axis_size(axis)
+            n = _axis_size(axis)
             e_loc = self.num_experts // n
             # [E, C, M] -> send each rank its experts' buffers, gather the
             # buffers every rank built for OUR experts along capacity
@@ -114,7 +121,7 @@ class MoELayer(Layer):
             out = self._experts(expert_in, w1, b1, w2, b2)
 
         y = jnp.einsum("tec,ecm->tm", combine.astype(x.dtype), out)
-        return y, aux
+        return y, aux, tokens_per_expert
 
     def forward(self, x):
         axis = collective_ctx.current_axis(self.axis_name)
@@ -123,12 +130,17 @@ class MoELayer(Layer):
 
         def f(xa, gw, w1, b1, w2, b2):
             flat = xa.reshape(-1, m)
-            y, aux = self._forward_arrays(flat, gw, w1, b1, w2, b2, axis)
-            return y.reshape(xa.shape), aux
+            y, aux, tok = self._forward_arrays(
+                flat, gw, w1, b1, w2, b2, axis)
+            return y.reshape(xa.shape), aux, tok
 
-        y, aux = apply(f, x, self.gate_weight, self.w1, self.b1, self.w2,
-                       self.b2, _op_name="moe")
+        y, aux, tok = apply(f, x, self.gate_weight, self.w1, self.b1,
+                            self.w2, self.b2, _op_name="moe")
         self.l_aux = aux
+        # like l_aux, recorded on the layer each forward; callers feed it
+        # to observability.comms.observe_expert_load OUTSIDE the traced
+        # region (under jit/shard_map it is a tracer here)
+        self.tokens_per_expert = tok
         return y
 
     def extra_repr(self):

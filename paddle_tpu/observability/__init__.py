@@ -46,15 +46,26 @@ Phase 3 (the performance observatory):
   live achieved-vs-roofline gauge.
 * :mod:`~paddle_tpu.observability.regression` — the bench-regression
   gate comparing a fresh bench run against the committed
-  DECODE_BENCH.json (``check-bench`` CLI mode, run in CI).
+  DECODE_BENCH.json (``check-bench`` CLI mode, run in CI; phase 4 adds
+  ``--bench-file`` so MULTICHIP_BENCH.json rides the same gate).
+
+Phase 4 (the mesh stack):
+
+* :mod:`~paddle_tpu.observability.comms` — collective-comms ledger:
+  a jaxpr walker counting collectives by (op, axis) with analytic
+  ring-algorithm wire bytes, an ICI/DCN interconnect-bandwidth
+  datasheet + modeled comms-seconds roofline, mesh telemetry
+  (``/debug/mesh``, chrome-trace mesh stamp), and skew gauges
+  (pipeline-bubble ratio, MoE expert-load imbalance).
 
 CLI: ``python -m paddle_tpu.observability
-{snapshot,prometheus,trace,programs,check-bench,serve}``.
+{snapshot,prometheus,trace,programs,mesh,check-bench,serve}``.
 """
 
 from __future__ import annotations
 
-from . import events, memory, metrics, profiling, regression, slo, tracing
+from . import (comms, events, memory, metrics, profiling, regression,
+               slo, tracing)
 from .events import export_chrome_trace
 from .metrics import (
     Counter,
@@ -87,7 +98,7 @@ __all__ = [
     "slo", "tracing",
     "RequestTrace", "FlightRecorder", "Objective", "SLOTracker",
     "TelemetryServer",
-    "memory", "profiling", "regression",
+    "comms", "memory", "profiling", "regression",
     "MemoryLedger", "ProgramCard", "ProgramCardRegistry",
 ]
 

@@ -7,10 +7,16 @@ Modes:
   trace         chrome-trace JSON of the event timeline
   programs      program-card registry: per-compiled-program FLOPs,
                 bytes-accessed, compile seconds (--json for raw dump)
+  mesh          live HybridCommunicateGroup topology (axes, dims, comm
+                rank-lists) + the collective-comms ledger, as JSON —
+                the CLI twin of the ``/debug/mesh`` endpoint
   check-bench   bench-regression gate: compare a fresh bench document
-                (--fresh, from ``bench_decode.py --out``) against the
-                committed baseline (--baseline, DECODE_BENCH.json);
-                exits 1 on an unallowed regression
+                (--fresh, from ``bench_decode.py --out`` or
+                ``bench_models.py bench_multichip_comms --out``)
+                against the committed baseline (--baseline /
+                --bench-file, DECODE_BENCH.json or
+                MULTICHIP_BENCH.json); exits 1 on an unallowed
+                regression
   serve         start the telemetry HTTP endpoint (blocks; --port,
                 --duration to exit after N seconds)
 
@@ -34,7 +40,8 @@ def main(argv=None):
         description="dump paddle_tpu observability state")
     parser.add_argument("mode", nargs="?", default="snapshot",
                         choices=("snapshot", "prometheus", "trace",
-                                 "programs", "check-bench", "serve"))
+                                 "programs", "mesh", "check-bench",
+                                 "serve"))
     parser.add_argument("-o", "--output", default=None,
                         help="write to FILE instead of stdout")
     parser.add_argument("--exec", dest="script", default=None,
@@ -43,6 +50,10 @@ def main(argv=None):
                         help="programs mode: raw JSON instead of a table")
     parser.add_argument("--baseline", default="DECODE_BENCH.json",
                         help="check-bench: committed baseline document")
+    parser.add_argument("--bench-file", default=None,
+                        help="check-bench: gate against this committed "
+                        "bench document instead of --baseline (e.g. "
+                        "MULTICHIP_BENCH.json)")
     parser.add_argument("--fresh", default=None,
                         help="check-bench: fresh bench document "
                         "(bench_decode.py --out FILE)")
@@ -83,6 +94,10 @@ def main(argv=None):
 
         text = (json.dumps(profiling.to_json(), indent=2, default=repr)
                 if args.json else profiling.render_text())
+    elif args.mode == "mesh":
+        from . import comms
+
+        text = json.dumps(comms.mesh_json(), indent=2, default=repr)
     else:
         text = events.export_chrome_trace()
 
@@ -105,7 +120,8 @@ def _check_bench(args):
     report = regression.check_bench(
         args.baseline, args.fresh, tolerance=args.tolerance,
         det_tolerance=args.det_tolerance,
-        allow_regress=args.allow_regress)
+        allow_regress=args.allow_regress,
+        bench_file=args.bench_file)
     text = regression.render_text(report)
     if args.output:
         with open(args.output, "w") as f:

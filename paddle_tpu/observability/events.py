@@ -161,7 +161,8 @@ class EventLog:
             "metadata": {"producer": "paddle_tpu.observability",
                          "dropped_events": self._dropped,
                          "process_name": _process_name(),
-                         "git_sha": _git_sha()},
+                         "git_sha": _git_sha(),
+                         "mesh": _mesh_meta()},
         }
         text = json.dumps(doc)
         if file is not None:
@@ -177,6 +178,19 @@ def _process_name():
     import sys
 
     return f"python:{os.path.basename(sys.argv[0] or 'interactive')}"
+
+
+def _mesh_meta():
+    """Mesh summary for the trace header (world size, mesh shape,
+    parallel mode) when a HybridCommunicateGroup is live; None
+    otherwise.  Lazy + guarded: trace export must never fail because
+    the distributed stack is absent or half-initialized."""
+    try:
+        from . import comms
+
+        return comms.mesh_meta()
+    except Exception:                # pragma: no cover - defensive
+        return None
 
 
 _GIT_SHA = None

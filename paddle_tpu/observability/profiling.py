@@ -70,13 +70,14 @@ class ProgramCard:
     __slots__ = ("fn", "key", "backend", "flops", "bytes_accessed",
                  "compile_seconds", "donated_bytes", "argument_bytes",
                  "output_bytes", "temp_bytes", "generated_code_bytes",
-                 "meta", "created_wall", "dispatches", "analysis_source")
+                 "meta", "created_wall", "dispatches", "analysis_source",
+                 "comms")
 
     def __init__(self, fn, key, backend="", flops=None,
                  bytes_accessed=None, compile_seconds=0.0,
                  donated_bytes=0, argument_bytes=None, output_bytes=None,
                  temp_bytes=None, generated_code_bytes=None, meta=None,
-                 analysis_source=None):
+                 analysis_source=None, comms=None):
         self.fn = fn
         self.key = key
         self.backend = backend
@@ -93,6 +94,9 @@ class ProgramCard:
         self.created_wall = time.time()
         self.dispatches = 0          # bumped by the owner per call
         self.analysis_source = analysis_source
+        # phase 4: comms.analyze_jaxpr(...).to_json() of the traced
+        # program, when the caller ran the walker; None = not analyzed
+        self.comms = comms
 
     def to_json(self):
         return {
@@ -108,6 +112,7 @@ class ProgramCard:
             "temp_bytes": self.temp_bytes,
             "generated_code_bytes": self.generated_code_bytes,
             "analysis_source": self.analysis_source,
+            "comms": self.comms,
             "dispatches": self.dispatches,
             "created_wall": self.created_wall,
             "meta": dict(self.meta),
@@ -258,13 +263,17 @@ def analyze_lowered(lowered, deep=False):
 
 
 def capture(fn_name, key, lowered, compile_seconds=0.0, donated_bytes=0,
-            meta=None, backend="", registry=None, deep=None):
+            meta=None, backend="", registry=None, deep=None, comms=None):
     """Build + record one ProgramCard from a ``Lowered``; never raises
     (a backend without analyses still yields a card with Nones, and any
     probe failure degrades the same way).  ``deep=None`` auto-selects:
     the compile-probe (memory stats, optimized-HLO cost) on accelerator
     backends, the free HLO-level estimate on cpu — so test suites never
-    pay a second XLA compile per program."""
+    pay a second XLA compile per program.
+
+    ``comms`` (phase 4) attaches a collective census to the card: pass
+    the ``comms.CommsReport`` of the traced program (its ``comms.*``
+    counters are published once, here) or an already-rendered dict."""
     reg = registry if registry is not None else _default_registry
     if deep is None:
         deep = backend not in ("", "cpu")
@@ -274,6 +283,11 @@ def capture(fn_name, key, lowered, compile_seconds=0.0, donated_bytes=0,
     except Exception:                # pragma: no cover - defensive
         flops = bytes_accessed = source = None
         stats = {}
+    if comms is not None and hasattr(comms, "to_json"):
+        try:
+            comms = comms.publish().to_json()
+        except Exception:            # pragma: no cover - defensive
+            comms = None
     card = ProgramCard(
         fn_name, key, backend=backend, flops=flops,
         bytes_accessed=bytes_accessed, compile_seconds=compile_seconds,
@@ -282,7 +296,7 @@ def capture(fn_name, key, lowered, compile_seconds=0.0, donated_bytes=0,
         output_bytes=stats.get("output_size_in_bytes"),
         temp_bytes=stats.get("temp_size_in_bytes"),
         generated_code_bytes=stats.get("generated_code_size_in_bytes"),
-        meta=meta, analysis_source=source)
+        meta=meta, analysis_source=source, comms=comms)
     reg.record(card)
     _events.instant("compile.program_card", cat="observability",
                     fn=fn_name, key=key,

@@ -42,6 +42,18 @@ DETERMINISTIC_FIELDS = {
     "prefill_dispatches": False,
     "host_syncs": False,
     "tokens_per_gb_kv_read": True,
+    # phase 4: collective counts from the jaxpr comms walker are pure
+    # functions of (program, mesh shape) — an extra all_gather per step
+    # gates exact even when step-time noise hides it
+    "psum_calls": False,
+    "pmax_calls": False,
+    "pmin_calls": False,
+    "all_gather_calls": False,
+    "psum_scatter_calls": False,
+    "all_to_all_calls": False,
+    "ppermute_calls": False,
+    "collective_calls_total": False,
+    "modeled_wire_bytes_per_step": False,
 }
 
 
@@ -140,14 +152,23 @@ def load(path):
 
 
 def check_bench(baseline_path, fresh_path, tolerance=0.25,
-                det_tolerance=0.0, allow_regress=()):
+                det_tolerance=0.0, allow_regress=(), bench_file=None):
     """File-level entry point for the CLI/CI: returns the compare()
-    report with the paths recorded."""
+    report with the paths recorded.
+
+    ``bench_file`` names an alternative committed baseline document
+    (MULTICHIP_BENCH.json rides the same gate as DECODE_BENCH.json);
+    when given it overrides ``baseline_path`` and is recorded in the
+    report."""
+    if bench_file:
+        baseline_path = bench_file
     report = compare(load(baseline_path), load(fresh_path),
                      tolerance=tolerance, det_tolerance=det_tolerance,
                      allow_regress=allow_regress)
     report["baseline"] = str(baseline_path)
     report["fresh"] = str(fresh_path)
+    if bench_file:
+        report["bench_file"] = str(bench_file)
     return report
 
 

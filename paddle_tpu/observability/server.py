@@ -20,6 +20,10 @@ path               payload
                    compliance, burn rates)
 ``/debug/programs``  program-card registry JSON: per-compiled-program
                    FLOPs, bytes-accessed, compile seconds, bucket meta
+``/debug/comms``   collective-comms ledger JSON: ``comms.*`` family
+                   values + the interconnect datasheet
+``/debug/mesh``    live ``HybridCommunicateGroup`` topology (axes,
+                   dims, comm rank-lists) plus the comms ledger
 ``/trace``         chrome-trace JSON: process event ring merged with
                    per-request async spans (load in Perfetto)
 ``/``              tiny JSON index of the above
@@ -50,6 +54,7 @@ import threading
 import weakref
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from . import comms as _comms
 from . import events as _events
 from . import metrics as _metrics
 from . import profiling as _profiling
@@ -58,7 +63,8 @@ from . import profiling as _profiling
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 ROUTES = ("/metrics", "/healthz", "/readyz", "/debug/requests",
-          "/debug/slo", "/debug/programs", "/trace")
+          "/debug/slo", "/debug/programs", "/debug/comms",
+          "/debug/mesh", "/trace")
 
 
 class TelemetryServer:
@@ -199,6 +205,10 @@ class TelemetryServer:
             return 200, "application/json", _js(payload)
         if path == "/debug/programs":
             return 200, "application/json", _js(_profiling.to_json())
+        if path == "/debug/comms":
+            return 200, "application/json", _js(_comms.to_json())
+        if path == "/debug/mesh":
+            return 200, "application/json", _js(_comms.mesh_json())
         if path == "/trace":
             extra = (self.recorder.chrome_events()
                      if self.recorder is not None else None)
