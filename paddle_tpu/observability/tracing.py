@@ -45,6 +45,10 @@ import time
 
 #: lifecycle event kinds, in the order a request may emit them
 QUEUED = "queued"
+GATEWAY = "gateway"          # accepted by the HTTP gateway: records the
+                             # receive->queued admission hop (hop_s) plus
+                             # tenant/priority — present only for requests
+                             # that entered through the serving gateway
 PREFILL = "prefill"          # first admission: batched fused prefill
 FIRST_TOKEN = "first_token"  # sampled by the prefill dispatch (TTFT)
 DECODE = "decode"            # one fused decode horizon this lane rode
@@ -109,10 +113,13 @@ class RequestTrace:
         or per-tenant quota bills against; summed across requests these
         reconstruct the engine's dispatch totals)."""
         tokens = prefix_hit = preempts = horizons = accepted = 0
+        aborted = 0
         flops = bytes_est = 0.0
         for kind, _, args in self._snapshot():
             if kind == FIRST_TOKEN:
                 tokens += 1
+            elif kind == ABORT:
+                aborted += 1
             elif kind == DECODE:
                 tokens += args.get("tokens", 0)
                 accepted += args.get("accepted", 0)
@@ -128,7 +135,7 @@ class RequestTrace:
                 bytes_est += args.get("bytes_est", 0.0)
         return {"tokens_emitted": tokens, "prefix_hit_tokens": prefix_hit,
                 "preemptions": preempts, "decode_horizons": horizons,
-                "spec_accepted_tokens": accepted,
+                "spec_accepted_tokens": accepted, "aborted": aborted,
                 "flops_est": flops, "bytes_est": bytes_est}
 
     def to_json(self):

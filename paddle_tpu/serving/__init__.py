@@ -54,7 +54,14 @@ around three ideas the benches point at (DECODE_BENCH.json):
   own next token — 1..K+1 tokens per forward, greedy and seeded
   output bitwise-equal to ``spec_k=0``.  ``spec_adaptive`` gates
   low-acceptance lanes off and shrinks the dispatch back to plain
-  decode when nobody's drafts are landing.
+  decode when nobody's drafts are landing;
+* an **HTTP/SSE front door** (gateway/) — an OpenAI-style
+  ``/v1/completions`` endpoint with per-horizon SSE streaming, priority
+  + deadline + per-tenant-quota admission (429/503 + Retry-After load
+  shedding), and a prefix-affinity router over N in-process engine
+  replicas (rendezvous-hashed radix-cache-block keys; SLO-unhealthy
+  replicas stop receiving sessions).  Import from
+  ``paddle_tpu.serving.gateway``.
 
 Quick start::
 
@@ -75,6 +82,8 @@ hits) are exposed through ``paddle_tpu.profiler.counters()``.
 
 from .drafter import draft_tokens
 from .engine import CompiledFn, Engine, EngineConfig
+from .gateway import (EngineWorker, Gateway, GatewayConfig,
+                      PrefixAffinityRouter, TenantQuotas)
 from .kv_cache import (PagedKV, PagedKVCache, PagedKVPool, SlotKV,
                        SlottedKVCache)
 from .paged_attention import paged_attention
@@ -89,4 +98,6 @@ __all__ = [
     "PrefixCache", "PrefixLease",
     "SamplingParams", "Request", "Scheduler",
     "draft_tokens",
+    "Gateway", "GatewayConfig", "EngineWorker", "PrefixAffinityRouter",
+    "TenantQuotas",
 ]

@@ -192,6 +192,10 @@ _SRV_BUCKETS = _obs_metrics.gauge(
     "distinct compiled decode programs ((horizon, nb, K) triples)")
 _SRV_ABORTS = _obs_metrics.counter(
     "serving.requests_aborted", "requests cancelled by the caller")
+_SRV_DEADLINE = _obs_metrics.counter(
+    "serving.deadline_expired",
+    "queued requests aborted because their admission deadline passed "
+    "(a subset of serving.requests_aborted)")
 _SRV_QUEUE_WAIT = _obs_metrics.histogram(
     "serving.queue_wait_seconds",
     "submit-to-admission wall seconds, observed when a request claims "
@@ -614,6 +618,9 @@ class Engine:
         self._cow_copies = 0
         self._preemptions = 0
         self._aborted = 0
+        self._deadline_expired = 0
+        self._tenants = {}               # tenant -> accounting dict
+        self._draining = False
         self._prefill_calls = 0          # compiled prefill DISPATCHES
         self._prefill_requests = 0       # requests prefilled (>= calls)
         self._prefix_hit_tokens = 0
@@ -1014,25 +1021,55 @@ class Engine:
         return self._pow2_floor(max(1, min(max_h, self._grow, rem)))
 
     # ------------------------------------------------------------ API
-    def submit(self, prompt_ids, sampling=None):
+    def submit(self, prompt_ids, sampling=None, priority=0,
+               deadline_s=None, tenant=None):
         """Queue one request; returns the Request handle (its
-        ``output_ids`` fill in as the engine steps)."""
+        ``output_ids`` fill in as the engine steps).
+
+        The gateway-era admission fields are optional and inert for
+        plain in-process callers: ``priority`` widens the scheduler's
+        overtake budget (see ``Scheduler.overtake_cap``), ``deadline_s``
+        bounds queue wait — a request still QUEUED when the deadline
+        passes is aborted at the next admission pass
+        (``finish_reason="abort"``) — and ``tenant`` tags the request
+        for per-tenant accounting in ``stats()['tenants']``."""
+        if self._draining:
+            raise RuntimeError("engine is draining; submissions refused")
         prompt_ids = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
         if not prompt_ids:
             raise ValueError("empty prompt")
+        if int(priority) < 0:
+            raise ValueError(f"priority must be >= 0, got {priority}")
+        if deadline_s is not None and not float(deadline_s) > 0:
+            raise ValueError(
+                f"deadline_s must be > 0 or None, got {deadline_s}")
         sampling = sampling or SamplingParams()
         if len(prompt_ids) + sampling.max_new_tokens > self.config.max_seq_len:
             raise ValueError(
                 f"prompt_len {len(prompt_ids)} + max_new_tokens "
                 f"{sampling.max_new_tokens} exceeds max_seq_len "
                 f"{self.config.max_seq_len}")
-        req = self.scheduler.submit(prompt_ids, sampling)
+        req = self.scheduler.submit(prompt_ids, sampling,
+                                    priority=priority,
+                                    deadline_s=deadline_s, tenant=tenant)
+        t = self._tenants.setdefault(
+            tenant if tenant is not None else "",
+            {"submitted": 0, "finished": 0, "aborted": 0,
+             "tokens_generated": 0})
+        t["submitted"] += 1
         if self.recorder is not None:
             req.trace = _obs_tracing.RequestTrace(
                 req.request_id, engine=self._profiler_name)
+            gw = {}
+            if req.priority:
+                gw["priority"] = req.priority
+            if req.deadline_s is not None:
+                gw["deadline_s"] = req.deadline_s
+            if req.tenant is not None:
+                gw["tenant"] = req.tenant
             req.trace.add(_obs_tracing.QUEUED,
                           prompt_len=req.prompt_len,
-                          max_new_tokens=sampling.max_new_tokens)
+                          max_new_tokens=sampling.max_new_tokens, **gw)
             self.recorder.attach(req.trace)
         _SRV_QUEUE.set(self.scheduler.queue_depth,
                        engine=self._profiler_name)
@@ -1054,6 +1091,10 @@ class Engine:
         front (order preserved) to retry after running requests retire.
         An oversubscribed pool therefore defers admission instead of
         failing mid-prefill."""
+        self._expire_deadlines()
+        # while draining, the queue can only hold `resumed` requests
+        # (submit() refuses and drain() aborted the rest) — re-admitting
+        # them is finishing in-flight work, so admission proceeds
         while self.cache.free_slots and self.scheduler.queue_depth:
             batch = self.scheduler.pop_batch(self.cache.free_slots,
                                              bucket_of=self._admission_bucket)
@@ -1090,6 +1131,20 @@ class Engine:
             self._prefill_batch(batch)
 
     _admit = admit      # pre-horizon internal name, kept for callers
+
+    def _expire_deadlines(self):
+        """Abort every still-QUEUED request whose admission deadline
+        passed (the gateway's deadline enforcement point: deadlines
+        bound *queue wait*, so a request that already claimed a slot
+        runs to completion).  Runs at the top of every admission pass;
+        expired requests finish with ``finish_reason="abort"`` and are
+        counted in both ``serving.requests_aborted`` and
+        ``serving.deadline_expired``."""
+        expired = [r for r in self.scheduler.queue if r.deadline_expired]
+        for req in expired:
+            self._deadline_expired += 1
+            _SRV_DEADLINE.inc(engine=self._profiler_name)
+            self.abort(req, cause="deadline")
 
     def _prefill_batch(self, batch):
         """One compiled prefill dispatch for a co-bucketed admission
@@ -1297,6 +1352,11 @@ class Engine:
         self._finished += 1
         self._ttft_sum += req.ttft
         self._ttft_n += 1
+        tn = self._tenants.get(req.tenant if req.tenant is not None
+                               else "")
+        if tn is not None:
+            tn["finished"] += 1
+            tn["tokens_generated"] += req.n_generated
         _SRV_REQS.inc(engine=self._profiler_name)
         _SRV_TTFT.observe(req.ttft, engine=self._profiler_name)
         _obs_events.instant("serving.slot_retire", cat="serving",
@@ -1357,13 +1417,16 @@ class Engine:
             req.trace.add(_obs_tracing.PREEMPT, slot=slot,
                           n_generated=req.n_generated)
 
-    def abort(self, req):
+    def abort(self, req, cause=None):
         """Cancel a request: a QUEUED one leaves the queue, a RUNNING
         one releases its slot, table entries, and prefix lease (the
         preemption teardown) without requeueing.  The request finishes
         with ``finish_reason="abort"`` and keeps whatever tokens it had
         generated; aborts feed the ``abort`` SLO objective and the
-        flight record ends with an ``abort`` event."""
+        flight record ends with an ``abort`` event.  ``cause`` (e.g.
+        ``"deadline"``, ``"drain"``, ``"client_disconnect"``) is
+        recorded on the trace event and the process event ring; the
+        caller-facing ``finish_reason`` stays ``"abort"``."""
         from .scheduler import FINISHED, FINISH_ABORT, RUNNING, WAITING
 
         if req.status == FINISHED:
@@ -1391,6 +1454,11 @@ class Engine:
             self.cache.free(slot)
         req.finish_reason = FINISH_ABORT
         self._aborted += 1
+        tn = self._tenants.get(req.tenant if req.tenant is not None
+                               else "")
+        if tn is not None:
+            tn["aborted"] += 1
+            tn["tokens_generated"] += req.n_generated
         name = self._profiler_name
         _SRV_ABORTS.inc(engine=name)
         _SRV_QUEUE.set(self.scheduler.queue_depth, engine=name)
@@ -1399,11 +1467,12 @@ class Engine:
             _obs_events.record(
                 "serving.request", phase=_obs_events.ASYNC_END,
                 cat="serving", id=req.request_id,
-                args={"reason": FINISH_ABORT,
+                args={"reason": FINISH_ABORT, "cause": cause,
                       "n_generated": req.n_generated})
         if req.trace is not None:
+            extra = {} if cause is None else {"cause": cause}
             req.trace.add(_obs_tracing.ABORT,
-                          n_generated=req.n_generated)
+                          n_generated=req.n_generated, **extra)
             self.recorder.finish(req.trace)
         if self.slo is not None:
             self.slo.observe("abort", 1.0)
@@ -1707,6 +1776,42 @@ class Engine:
                 raise RuntimeError("engine stalled with queued work")
         return out
 
+    def drain(self):
+        """Graceful shutdown of admission: refuse new submissions, abort
+        every still-QUEUED request (``finish_reason="abort"``, cause
+        ``"drain"`` — they never claimed a slot), run the in-flight
+        lanes to completion, then release every pool block the engine
+        still references (the radix prefix store's unpinned chains are
+        reclaimed) and verify ``kv_blocks_in_use == 0`` — the block-leak
+        invariant a replica must satisfy before the router removes it.
+
+        Returns every request retired during the drain (aborted queued
+        requests first, then lanes in retirement order).  The engine is
+        empty but fully usable afterwards: ``submit()`` works again once
+        ``drain()`` returns."""
+        self._draining = True
+        try:
+            out = [self.abort(req, cause="drain")
+                   for req in list(self.scheduler.queue)]
+            # every running lane makes progress each step (preempted
+            # lanes requeue as `resumed` and re-admit as slots free),
+            # so this loop terminates within the remaining token budget
+            while self.scheduler.has_work:
+                out.extend(self.step())
+        finally:
+            self._draining = False
+        # all leases are back, so every prefix chain is unpinned and
+        # reclaimable; anything the reclaim cannot free is a leak
+        self.prefix.reclaim(self.prefix._held)
+        if self.pool.blocks_in_use != 0:
+            raise RuntimeError(
+                f"drain() left {self.pool.blocks_in_use} KV pool blocks "
+                f"referenced ({self.cache.leased_blocks} leased by slot "
+                f"tables, {self.prefix._held} pinned by the prefix "
+                "store) — block-leak invariant violated")
+        self._publish_gauges()
+        return out
+
     def generate(self, prompts, sampling=None):
         """Convenience wrapper: one prompt (list of ids) or a batch
         (list of lists).  Submits, drains, and returns the generated ids
@@ -1796,6 +1901,7 @@ class Engine:
             "cow_copies": self._cow_copies,
             "preemptions": self._preemptions,
             "requests_aborted": self._aborted,
+            "deadline_expired": self._deadline_expired,
             "spec_draft_tokens": self._spec_draft_tokens,
             "spec_accepted_tokens": self._spec_accepted_tokens,
             "spec_accept_rate": (
@@ -1824,6 +1930,12 @@ class Engine:
         s["decode_buckets"] = sorted(self._decode_buckets)
         s["next_horizon_growth"] = self._grow
         s["prefix"] = self.prefix.stats()
+        # gateway-era admission fields: per-tenant accounting (tenant
+        # None bills to "") and the deadline-abort tally; priorities
+        # live on the requests themselves and in their QUEUED trace
+        # events
+        s["tenants"] = {k: dict(v) for k, v in self._tenants.items()}
+        s["draining"] = self._draining
         s["kv_pool"] = {
             "block_size": self._block_size,
             "capacity_blocks": self.pool.capacity,

@@ -1,0 +1,26 @@
+"""Serving gateway: the OpenAI-style HTTP/SSE front door over a fleet
+of in-process engine replicas.
+
+Three layers (one module each):
+
+* :mod:`.protocol` — stdlib-threaded HTTP server, ``/v1/completions``
+  with SSE streaming, structured OpenAI-style errors.
+* :mod:`.admission` — per-tenant token-bucket quotas (429) and the SLO
+  load-shed decision (503 + Retry-After).
+* :mod:`.router` — :class:`EngineWorker` replica threads and
+  prefix-affinity (rendezvous-hashed radix-cache-block) routing.
+"""
+
+from .admission import TenantQuotas, TokenBucket
+from .protocol import Gateway, GatewayConfig
+from .router import EngineWorker, PrefixAffinityRouter, StreamHandle
+
+__all__ = [
+    "Gateway",
+    "GatewayConfig",
+    "TenantQuotas",
+    "TokenBucket",
+    "EngineWorker",
+    "PrefixAffinityRouter",
+    "StreamHandle",
+]

@@ -1,0 +1,325 @@
+"""Gateway router layer: N in-process engine replicas behind one front
+door, with prefix-affinity session routing.
+
+Two pieces:
+
+* :class:`EngineWorker` — the ownership boundary between the threaded
+  HTTP layer and a (single-threaded) :class:`~..engine.Engine`.  One
+  daemon thread per replica owns every ``submit()/step()/abort()`` on
+  its engine; other threads talk to it through a command inbox and get
+  a :class:`StreamHandle` back.  After every ``step()`` the worker
+  flushes each tracked request's newly harvested tokens into its
+  handle's queue — that per-horizon flush is exactly the granularity
+  SSE chunks stream at, and since the engine's sampling is a pure
+  function of ``(seed, token index, logits)``, the streamed token
+  sequence is bitwise what in-process ``Engine.run()`` produces.
+* :class:`PrefixAffinityRouter` — picks a replica per request.  The
+  affinity key is the prompt's leading **prefix-cache blocks**, chunked
+  exactly the way the radix cache keys its trie
+  (``tuple(tokens[:k * block_size])`` — see ``PrefixCache._walk``), so
+  two prompts sharing a system prompt share a key and land on the same
+  replica, where the radix store already holds those blocks.  Keys map
+  to replicas by rendezvous (highest-random-weight) hashing — stable
+  under replica add/remove — over the **healthy** replica set only:
+  per-replica health is the engine's SLO signal (the same one
+  ``/readyz`` serves), so a replica burning its error budget stops
+  receiving new sessions until it recovers.  Prompts shorter than one
+  block have no affinity key and fall back to the least-loaded healthy
+  replica (queue depth + active slots from the engine's scheduler).
+
+Graceful replica removal composes the two: ``router.remove(worker)``
+stops routing to it, the worker finishes its in-flight work, and
+``Engine.drain()`` releases every pool block (asserting the block-leak
+invariant) before the engine is closed.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import queue
+import threading
+import time
+
+from ..scheduler import FINISHED
+
+
+class StreamHandle:
+    """The caller-side view of one request running on a worker thread.
+
+    ``events`` is a queue of ``("tokens", [ids])`` chunks — one per
+    decode horizon the request rode — terminated by exactly one
+    ``("finish", finish_reason)``.  ``request`` is the live engine
+    Request (its ``output_ids``/``finish_reason`` fill in as the worker
+    steps); treat it as read-only from other threads."""
+
+    def __init__(self, request, worker):
+        self.request = request
+        self.worker = worker
+        self.events = queue.Queue()
+        #: tokens already flushed into ``events``
+        self.sent = 0
+
+    @property
+    def request_id(self):
+        return self.request.request_id
+
+
+class EngineWorker:
+    """Drives one Engine on a dedicated daemon thread.
+
+    All engine mutation happens on that thread: ``submit()``/
+    ``abort()``/``drain()`` enqueue commands and block on a reply, the
+    loop applies them between horizon dispatches, steps while work
+    exists, and flushes per-request token deltas after every step.
+    Reads exposed to other threads (``load``, ``healthy``, ``stats()``)
+    are GIL-atomic snapshots of host-side counters."""
+
+    def __init__(self, engine, name=None):
+        self.engine = engine
+        self.name = name or engine._profiler_name
+        self._inbox = queue.Queue()
+        self._pending = {}           # request_id -> StreamHandle
+        self._draining = False
+        self._drained = threading.Event()
+        self._stopped = False
+        self._thread = threading.Thread(
+            target=self._loop, name=f"gateway.worker:{self.name}",
+            daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------- control
+    def submit(self, prompt_ids, sampling=None, priority=0,
+               deadline_s=None, tenant=None, trace_args=None,
+               timeout=30.0):
+        """Submit on the worker thread; returns a :class:`StreamHandle`.
+        ``trace_args`` (tenant/priority/hop_s from the gateway) are
+        appended to the flight record as the ``gateway`` event — on the
+        engine thread, so event order stays queued -> gateway ->
+        prefill.  Raises whatever ``Engine.submit`` raises (validation)
+        or RuntimeError when the replica is draining/stopped."""
+        if not self.alive:
+            raise RuntimeError(f"replica {self.name} is stopped")
+        reply = queue.Queue(1)
+        self._inbox.put(("submit", dict(
+            prompt_ids=prompt_ids, sampling=sampling, priority=priority,
+            deadline_s=deadline_s, tenant=tenant), trace_args, reply))
+        kind, value = reply.get(timeout=timeout)
+        if kind == "error":
+            raise value
+        return value
+
+    def abort(self, handle, cause="client_disconnect"):
+        """Abort a tracked request (fire-and-forget; the handle's queue
+        still receives its terminal ``("finish", "abort")``)."""
+        self._inbox.put(("abort", handle, cause, None))
+
+    def drain(self, timeout=120.0):
+        """Stop accepting submissions, let in-flight AND queued requests
+        run to completion, then ``Engine.drain()`` (releases every pool
+        block, asserts the block-leak invariant).  Blocks until done.
+        Idempotent; the worker stays alive (for ``stats()``) until
+        ``stop()``."""
+        self._inbox.put(("drain", None, None, None))
+        if not self._drained.wait(timeout):
+            raise TimeoutError(f"worker {self.name} drain timed out")
+
+    def stop(self, timeout=30.0):
+        """Stop the driving thread (does NOT close the engine — the
+        owner does, after ``drain()``)."""
+        if self._stopped:
+            return
+        self._inbox.put(("stop", None, None, None))
+        self._thread.join(timeout)
+        self._stopped = True
+
+    # -------------------------------------------------------------- health
+    @property
+    def alive(self):
+        return self._thread.is_alive() and not self._stopped
+
+    @property
+    def draining(self):
+        return self._draining
+
+    @property
+    def healthy(self):
+        """Routable: thread alive, not draining, and the engine's SLO
+        tracker (if any) reports healthy — the same signal the
+        telemetry server's ``/readyz`` flips on."""
+        if not self.alive or self._draining:
+            return False
+        slo = self.engine.slo
+        return slo is None or slo.healthy
+
+    @property
+    def load(self):
+        """Instantaneous load for least-loaded routing: queued +
+        running requests."""
+        return (self.engine.scheduler.queue_depth
+                + len(self.engine.scheduler.running))
+
+    @property
+    def prefix_block_size(self):
+        return self.engine._block_size
+
+    def stats(self):
+        """The engine's ``stats()`` snapshot plus worker state.  Host
+        counters only — safe to call from any thread."""
+        s = self.engine.stats()
+        s["worker"] = {"name": self.name, "alive": self.alive,
+                       "draining": self._draining,
+                       "healthy": self.healthy, "load": self.load,
+                       "streams": len(self._pending)}
+        return s
+
+    # ---------------------------------------------------------- the thread
+    def _loop(self):
+        while True:
+            busy = self.engine.scheduler.has_work
+            try:
+                cmd = (self._inbox.get_nowait() if busy
+                       else self._inbox.get(timeout=0.05))
+            except queue.Empty:
+                cmd = None
+            if cmd is not None and self._apply(cmd):
+                return
+            # apply everything already queued before paying for a step
+            while True:
+                try:
+                    cmd = self._inbox.get_nowait()
+                except queue.Empty:
+                    break
+                if self._apply(cmd):
+                    return
+            if self.engine.scheduler.has_work:
+                self.engine.step()
+                if self._flush():
+                    # yield the GIL before the next dispatch so handler
+                    # threads woken by the flush get to write their SSE
+                    # frames now, not a switch-interval (~5 ms) later
+                    time.sleep(0)
+            elif self._draining and not self._drained.is_set():
+                self.engine.drain()      # queue empty: releases blocks
+                self._drained.set()
+
+    def _apply(self, cmd):
+        """Execute one command on the engine thread; True = stop."""
+        op, arg, extra, reply = cmd
+        if op == "stop":
+            return True
+        if op == "submit":
+            if self._draining:
+                reply.put(("error", RuntimeError(
+                    f"replica {self.name} is draining")))
+                return False
+            try:
+                req = self.engine.submit(**arg)
+            except Exception as e:
+                reply.put(("error", e))
+                return False
+            if extra and req.trace is not None:
+                from ...observability import tracing as _obs_tracing
+
+                req.trace.add(_obs_tracing.GATEWAY, **extra)
+            handle = StreamHandle(req, self)
+            self._pending[req.request_id] = handle
+            reply.put(("ok", handle))
+        elif op == "abort":
+            handle, cause = arg, extra
+            if handle.request.status != FINISHED:
+                self.engine.abort(handle.request, cause=cause)
+                self._flush()
+        elif op == "drain":
+            self._draining = True
+        return False
+
+    def _flush(self):
+        """Push each tracked request's newly harvested tokens (and its
+        terminal event) into its handle queue — the per-horizon flush
+        the SSE stream rides.  Returns True if any event was pushed."""
+        done, pushed = [], False
+        for rid, h in self._pending.items():
+            n = h.request.n_generated
+            if n > h.sent:
+                h.events.put(("tokens",
+                              list(h.request.output_ids[h.sent:n])))
+                h.sent = n
+                pushed = True
+            if h.request.status == FINISHED:
+                h.events.put(("finish", h.request.finish_reason))
+                done.append(rid)
+                pushed = True
+        for rid in done:
+            del self._pending[rid]
+        return pushed
+
+
+def _rendezvous_weight(key, name):
+    """Deterministic highest-random-weight score for (affinity key,
+    replica name) — stable across processes (no PYTHONHASHSEED
+    dependence), uniform enough that distinct system prompts spread
+    over replicas."""
+    h = hashlib.blake2b(repr(key).encode() + b"|" + name.encode(),
+                        digest_size=8)
+    return int.from_bytes(h.digest(), "big")
+
+
+class PrefixAffinityRouter:
+    """Routes requests over a set of :class:`EngineWorker` replicas.
+
+    ``affinity_blocks`` bounds how many leading prefix-cache blocks key
+    the session: hashing MORE blocks than the shared system prompt
+    would scatter same-prefix sessions (their suffixes differ), hashing
+    fewer costs nothing — so the default is small."""
+
+    def __init__(self, workers, affinity_blocks=2):
+        if not workers:
+            raise ValueError("router needs at least one worker")
+        self.workers = list(workers)
+        self.affinity_blocks = int(affinity_blocks)
+
+    def affinity_key(self, prompt_ids):
+        """The routing key: the prompt's leading full blocks, chunked
+        with the radix cache's block size (``None`` when the prompt is
+        shorter than one block — no reusable prefix to be affine to)."""
+        bs = self.workers[0].prefix_block_size
+        nb = min(self.affinity_blocks, len(prompt_ids) // bs)
+        if nb <= 0:
+            return None
+        return tuple(int(t) for t in prompt_ids[:nb * bs])
+
+    def route(self, prompt_ids):
+        """Pick a replica: ``(worker, how)`` where ``how`` is
+        ``"affine"`` (rendezvous hash of the prefix key over healthy
+        replicas) or ``"least-loaded"`` (no key).  ``(None, "shed")``
+        when no replica is healthy — the gateway's 503 signal."""
+        live = [w for w in self.workers if w.healthy]
+        if not live:
+            return None, "shed"
+        key = self.affinity_key(prompt_ids)
+        if key is None:
+            return min(live, key=lambda w: (w.load, w.name)), \
+                "least-loaded"
+        return max(live,
+                   key=lambda w: _rendezvous_weight(key, w.name)), \
+            "affine"
+
+    def submit(self, prompt_ids, sampling=None, **kw):
+        """Route + submit in one call (convenience for tests/benches);
+        returns ``(handle, worker, how)`` or raises RuntimeError when
+        every replica is shedding."""
+        worker, how = self.route(prompt_ids)
+        if worker is None:
+            raise RuntimeError("no healthy replica")
+        return worker.submit(prompt_ids, sampling=sampling, **kw), \
+            worker, how
+
+    def remove(self, worker, close_engine=True):
+        """Graceful replica removal: stop routing to it, drain it
+        (in-flight work finishes, every pool block released), stop its
+        thread, and optionally close its engine."""
+        self.workers.remove(worker)
+        worker.drain()
+        worker.stop()
+        if close_engine:
+            worker.engine.close()

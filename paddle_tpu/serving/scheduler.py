@@ -14,6 +14,23 @@ same-bucket request ahead of an earlier different-bucket one, but only
 inside a bounded **reorder window**: the queue head always anchors the
 batch (strict no-head-starvation), and no request is ever overtaken by
 more than ``reorder_window`` later-submitted requests in total.
+
+Admission is also priority-aware (the gateway's admission layer):
+every request carries an integer ``priority`` (default 0) and the
+reorder window generalizes into a per-pair **overtake budget** —
+request ``o`` may be admitted ahead of an earlier-submitted request
+``s`` only while
+
+    ``s.bypassed < reorder_window * (1 + max(0, o.priority - s.priority))``
+
+so same-priority traffic keeps the original window exactly, a
+higher-priority request gets a budget that widens linearly with the
+priority gap, and the starvation bound stays hard: with priorities
+capped at ``P``, a queued request is overtaken by at most
+``reorder_window * (1 + P)`` later-submitted requests before it MUST
+anchor the next batch.  A bounded stable promotion pass
+(:meth:`Scheduler.promote`) bubbles higher-priority requests toward
+the head inside that budget before each ``pop_batch``.
 """
 
 from __future__ import annotations
@@ -66,6 +83,25 @@ class Request:
     #: (observability.tracing.RequestTrace, attached by the engine at
     #: submit when request tracing is on; None otherwise)
     trace: object = None
+    #: admission priority (gateway-era field): 0 is baseline; a higher
+    #: value widens the overtake budget against lower-priority queued
+    #: requests by ``reorder_window * priority_gap`` (see module doc)
+    priority: int = 0
+    #: seconds after ``submit_time`` by which the request must have been
+    #: admitted; the engine aborts still-QUEUED requests whose deadline
+    #: expired (``finish_reason="abort"``, counted in
+    #: ``serving.requests_aborted``).  None = no deadline.
+    deadline_s: float | None = None
+    #: the tenant this request bills against (gateway quota key); None
+    #: for in-process callers
+    tenant: str | None = None
+
+    @property
+    def deadline_expired(self):
+        """True when a deadline was set and has passed (measured from
+        ``submit_time`` on the wall clock, like TTFT)."""
+        return (self.deadline_s is not None
+                and time.time() - self.submit_time > self.deadline_s)
 
     @property
     def prompt_len(self):
@@ -126,12 +162,56 @@ class Scheduler:
         self.running = {}           # slot -> Request
         self._next_id = 0
 
-    def submit(self, prompt_ids, sampling):
+    def submit(self, prompt_ids, sampling, priority=0, deadline_s=None,
+               tenant=None):
         req = Request(self._next_id, list(prompt_ids),
-                      sampling.validate())
+                      sampling.validate(), priority=int(priority),
+                      deadline_s=deadline_s, tenant=tenant)
         self._next_id += 1
         self.queue.append(req)
         return req
+
+    def overtake_cap(self, victim, overtaker, window=None):
+        """The overtake budget of ``victim`` against ``overtaker``: how
+        many times ``victim`` may be bypassed in total before requests
+        like ``overtaker`` must stop passing it.  Equal (or lower)
+        priority keeps the plain reorder window; each point of priority
+        advantage adds one more window's worth of budget.  This single
+        cap bounds BOTH reorder sources — same-bucket co-batching and
+        the priority promotion pass — so the documented starvation
+        bound (``window * (1 + max priority gap)`` total overtakes)
+        holds across them combined."""
+        w = self.reorder_window if window is None else int(window)
+        gap = max(0, int(overtaker.priority) - int(victim.priority))
+        return w * (1 + gap)
+
+    def promote(self, window=None):
+        """Bounded stable priority promotion: bubble higher-priority
+        queued requests toward the head, one overtake at a time, each
+        hop allowed only while the passed request still has overtake
+        budget (:meth:`overtake_cap`) — and charged against it.  Equal
+        priorities never reorder (FIFO preserved), ``resumed`` requests
+        are never passed (re-admission order after preemption is part
+        of the bitwise-replay contract), and with ``window == 0`` the
+        cap is 0 so this is a no-op (strict FIFO).  Idempotent: once
+        the queue is priority-sorted within budget, no further hops
+        happen and no further budget is charged."""
+        q = list(self.queue)
+        if len(q) < 2 or all(r.priority == q[0].priority for r in q):
+            return
+        out = []
+        for r in q:
+            pos = len(out)
+            while pos > 0:
+                s = out[pos - 1]
+                if (s.resumed or s.priority >= r.priority
+                        or s.bypassed >= self.overtake_cap(s, r, window)):
+                    break
+                pos -= 1
+            for s in out[pos:]:
+                s.bypassed += 1
+            out.insert(pos, r)
+        self.queue = deque(out)
 
     def admissible(self, free_slots):
         """Pop up to free_slots queued requests in strict FIFO order
@@ -160,7 +240,12 @@ class Scheduler:
         * a ``resumed`` request (preempted, waiting to be re-admitted)
           shares the head anchor's exemption: admitting it restores the
           order the preemption disturbed, so it neither consumes the
-          window nor increments anyone's ``bypassed`` counter.
+          window nor increments anyone's ``bypassed`` counter;
+        * priorities widen the budget per overtaken request
+          (:meth:`overtake_cap`): a :meth:`promote` pass runs first so
+          higher-priority requests reach the head within budget, and a
+          same-bucket join is allowed while every skipped request still
+          has budget *against that candidate's priority*.
 
         With ``bucket_of=None`` or ``window<=0`` this degrades to strict
         FIFO (``admissible``), batching only the contiguous same-bucket
@@ -168,6 +253,7 @@ class Scheduler:
         """
         if free_slots <= 0 or not self.queue:
             return []
+        self.promote(window)
         if bucket_of is None:
             return self.admissible(free_slots)
         w = self.reorder_window if window is None else int(window)
@@ -192,7 +278,8 @@ class Scheduler:
                 sealed = True    # reordering beyond the window forbidden
                 continue
             if bucket_of(r) == anchor_bucket:
-                if any(s.bypassed >= w for s in skipped):
+                if any(s.bypassed >= self.overtake_cap(s, r, w)
+                       for s in skipped):
                     sealed = True  # someone ahead is at their overtake cap
                     continue
                 batch.append(r)

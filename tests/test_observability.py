@@ -474,7 +474,7 @@ class TestFlightRecorder:
         c = tr.counts()
         assert c == {"tokens_emitted": 6, "prefix_hit_tokens": 6,
                      "preemptions": 1, "decode_horizons": 2,
-                     "spec_accepted_tokens": 2,
+                     "spec_accepted_tokens": 2, "aborted": 0,
                      "flops_est": 0.0, "bytes_est": 0.0}
         assert tr.finished
         # monotonic event times

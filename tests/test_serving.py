@@ -1758,6 +1758,31 @@ class TestRequestTracing:
                 == {r.request_id for r in reqs})
         assert not eng.recorder.live()
 
+    def test_counts_reconcile_deadline_aborts(self):
+        """The counts() reconciliation must also hold when requests die
+        to the admission deadline: per-trace ``aborted`` tallies sum to
+        the engine's requests_aborted, and aborted requests contribute
+        zero emitted tokens."""
+        import time as _time
+
+        m = _model()
+        eng = Engine(m, self._cfg(num_slots=1), register_profiler=False)
+        runner = eng.submit([1, 2, 3, 4],
+                            SamplingParams(max_new_tokens=6))
+        doomed = eng.submit([5, 6, 7, 8],
+                            SamplingParams(max_new_tokens=6),
+                            deadline_s=0.01)
+        _time.sleep(0.03)                # deadline passes while queued
+        eng.run()
+        c = eng.counters()
+        assert c["deadline_expired"] == 1 == c["requests_aborted"]
+        tcs = [r.trace.counts() for r in (runner, doomed)]
+        assert sum(t["aborted"] for t in tcs) == c["requests_aborted"]
+        assert (sum(t["tokens_emitted"] for t in tcs)
+                == c["tokens_generated"] == 6)
+        assert doomed.trace.counts()["tokens_emitted"] == 0
+        eng.close()
+
     def test_prefix_hit_tokens_in_trace(self):
         m = _model()
         eng = Engine(m, self._cfg(num_slots=1,
