@@ -844,6 +844,100 @@ def _bench_quant_ablation(backend, on_tpu, rng):
 #: reconstructable across PRs from the file's git history alone;
 #: 3 adds roofline_bw_gbs — the per-backend bandwidth (datasheet or
 #: memcpy-probed) every roofline column in the row was computed from
+def _bench_sharded(backend, on_tpu, rng):
+    """Tensor-parallel sharded serving: MeshEngine tp=2 vs the
+    single-chip Engine on the same model, same workload, same knobs.
+
+    HONESTY: on CPU the two tp 'devices' are VIRTUAL
+    (--xla_force_host_platform_device_count) — both shards share one
+    physical socket, so the tok/s ratio here measures the sharding
+    machinery's overhead, NOT a speedup; treat the tp2 row as a
+    correctness row.  What it pins: the streams are bitwise-equal to
+    the single chip's, each shard's KV read share is
+    ``kv_bytes_read / tp`` (the pool is head-sharded, every chip reads
+    only its kv_heads/tp slice of every block), and the decode census
+    matches the hand formula gated in MULTICHIP_BENCH.json.  On real
+    multi-chip hardware the same rows become the speedup claim."""
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.serving import (Engine, EngineConfig, MeshEngine,
+                                    SamplingParams)
+
+    if len(jax.devices()) < 2:
+        print("[sharded] fewer than 2 devices visible — skipping "
+              "(CPU runs need "
+              "XLA_FLAGS=--xla_force_host_platform_device_count=8)")
+        return []
+
+    cfg = GPTConfig(vocab_size=1024, hidden_size=256,
+                    intermediate_size=512, num_hidden_layers=2,
+                    num_attention_heads=4, max_position_embeddings=128)
+    max_seq, new_tokens, n_req, horizon = 96, 32, 4, 8
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    prompts = [rng.randint(0, cfg.vocab_size, 16).tolist()
+               for _ in range(n_req)]
+    sp = SamplingParams(max_new_tokens=new_tokens)
+    ecfg = dict(num_slots=n_req, max_seq_len=max_seq,
+                max_horizon=horizon)
+
+    def measure(eng):
+        # warm run compiles everything and yields the parity stream
+        out = eng.generate([list(p) for p in prompts], sp)
+        kv0 = eng.counters()["kv_bytes_read"]
+        for p in prompts:
+            eng.submit(list(p), sp)
+        eng.admit()                     # prefill outside the window
+        t0 = time.time()
+        while eng.scheduler.has_work:
+            eng.step(horizon=horizon)
+        dt = time.time() - t0
+        kv = eng.counters()["kv_bytes_read"] - kv0
+        return out, dt, kv
+
+    ref = Engine(model, EngineConfig(**ecfg), register_profiler=False)
+    ref_out, ref_dt, ref_kv = measure(ref)
+    ref.close()
+
+    eng = MeshEngine(model, EngineConfig(**ecfg), tp=2,
+                     register_profiler=False)
+    out, dt, kv = measure(eng)
+    bitwise = out == ref_out
+    if not bitwise:                      # the row must not lie
+        raise AssertionError("tp2 stream diverged from single chip")
+    census = eng.decode_comms_report(horizon=horizon).counts()
+    eng.close()
+
+    toks = n_req * new_tokens
+    tag = f"{backend}8"                  # 8 virtual devices
+    return [
+        {
+            "metric": f"sharded decode tokens/s tp1 single-chip "
+                      f"b{n_req} (prefill 16 + {new_tokens} new, {tag})",
+            "value": round(toks / ref_dt, 1),
+            "unit": "tokens/s",
+            "per_token_ms": round(ref_dt * 1000.0 / toks, 3),
+            "kv_bytes_read": ref_kv,
+        },
+        {
+            "metric": f"sharded decode tokens/s tp2 mesh "
+                      f"b{n_req} (prefill 16 + {new_tokens} new, {tag})",
+            "value": round(toks / dt, 1),
+            "unit": "tokens/s",
+            "per_token_ms": round(dt * 1000.0 / toks, 3),
+            "bitwise_equal_to_single_chip": bitwise,
+            "virtual_devices": True,     # correctness row, no speedup claim
+            "kv_bytes_read": kv,
+            "kv_bytes_read_per_shard": kv // 2,
+            "psum_calls_per_horizon": census[("psum", "tp")],
+            "all_gather_calls_per_horizon": census[("all_gather", "tp")],
+        },
+    ]
+
+
 def _bench_tracing_overhead(backend, on_tpu, rng):
     """Observability phase-2 overhead gate: the SAME b1 horizon-8
     decode stream as _bench_engine_horizons, run PAIRED in one process
@@ -1136,7 +1230,8 @@ def _git_sha():
 #: rest map 1:1 onto the _bench_* section functions
 SECTIONS = ("core", "engine_horizons", "engine", "paged_ablation",
             "prefix_prefill", "spec_decode", "quant_ablation",
-            "tracing_overhead", "observatory_overhead", "gateway")
+            "sharded", "tracing_overhead", "observatory_overhead",
+            "gateway")
 
 
 def main(argv=None):
@@ -1284,6 +1379,8 @@ def main(argv=None):
         results.extend(_bench_spec_decode(backend, on_tpu, rng))
     if "quant_ablation" in only:
         results.extend(_bench_quant_ablation(backend, on_tpu, rng))
+    if "sharded" in only:
+        results.extend(_bench_sharded(backend, on_tpu, rng))
     if "tracing_overhead" in only:
         results.extend(_bench_tracing_overhead(backend, on_tpu, rng))
     if "observatory_overhead" in only:

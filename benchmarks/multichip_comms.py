@@ -214,6 +214,34 @@ def build_moe_ep4():
     return fn, (x,) + weights, {("all_to_all", "dp"): 2}
 
 
+def build_sharded_decode_tp2():
+    """The REAL sharded-serving decode program: a tp=2 MeshEngine's
+    horizon-scanned fused decode (``_decode_fn``, horizon=4) over the
+    mesh-sharded paged pool.  Census is the hand-derived per-layer
+    count: per scanned step, 1 psum head-combine + 3 all_gathers per
+    layer (o_proj, SwiGLU intermediate, down_proj) + 1 all_gather for
+    the lm_head logits — L=2, h=4 gives psum@tp=8, all_gather@tp=28.
+    Unlike the skeletons above this walks a full engine program
+    (shard_map under lax.scan under the sampling/masking machinery), so
+    it also pins the walker's scan×shard_map multiplication."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.serving import EngineConfig, MeshEngine
+
+    cfg = GPTConfig(vocab_size=128, hidden_size=64,
+                    intermediate_size=128, num_hidden_layers=2,
+                    num_attention_heads=4, max_position_embeddings=64)
+    paddle.seed(0)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    eng = MeshEngine(m, EngineConfig(num_slots=2, max_seq_len=32,
+                                     max_horizon=4),
+                     tp=2, register_profiler=False)
+    horizon = 4
+    fn, args = eng.decode_census_program(horizon=horizon)
+    return fn, args, eng.expected_decode_census(horizon)
+
+
 CONFIGS = {
     "dp8": build_dp8,
     "dp4xmp2": build_dp4xmp2,
@@ -221,6 +249,7 @@ CONFIGS = {
     "ring_sep4": build_ring_sep4,
     "zero3_sharding8": build_zero3_sharding8,
     "moe_ep4": build_moe_ep4,
+    "sharded_decode_tp2": build_sharded_decode_tp2,
 }
 
 

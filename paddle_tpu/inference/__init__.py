@@ -136,7 +136,7 @@ def create_predictor(config):
     return Predictor(config)
 
 
-def create_llm_engine(model, **config_kwargs):
+def create_llm_engine(model, mesh_shape=None, tp=None, **config_kwargs):
     """Predictor-style entry point for LLM serving: wrap a CausalLM Layer
     in the continuous-batching `paddle_tpu.serving.Engine` (the TPU
     rebuild of the reference's AnalysisPredictor + fused_multi_transformer
@@ -180,10 +180,26 @@ def create_llm_engine(model, **config_kwargs):
     telemetry_port — start an HTTP telemetry endpoint (``/metrics``,
     ``/healthz``, ``/readyz``, ``/debug/requests``, ``/debug/slo``,
     ``/trace``) on a background thread at engine construction, 0 for an
-    ephemeral port, stopped by ``engine.close()``)."""
-    from ..serving import Engine, EngineConfig
+    ephemeral port, stopped by ``engine.close()``).
 
-    return Engine(model, EngineConfig(**config_kwargs))
+    ``mesh_shape`` / ``tp`` pick the sharded engine: ``tp=N`` (or
+    ``mesh_shape=(1, N)``; both knobs must agree when both are given)
+    returns a ``serving.sharded.MeshEngine`` running tensor-parallel
+    over N devices with the mesh-sharded paged KV pool — same API, same
+    knobs, output bitwise-equal to the single-chip engine.  ``tp=1``
+    (or both None, the default) returns the plain single-chip
+    ``Engine``; dp > 1 raises (reserved for disaggregated
+    prefill/decode)."""
+    from ..serving import Engine, EngineConfig
+    from ..serving.sharded import MeshEngine
+
+    if mesh_shape is None and tp is None:
+        return Engine(model, EngineConfig(**config_kwargs))
+    shape = MeshEngine._norm_mesh_knob(mesh_shape, tp)
+    if shape == (1, 1):
+        return Engine(model, EngineConfig(**config_kwargs))
+    return MeshEngine(model, EngineConfig(**config_kwargs),
+                      mesh_shape=shape)
 
 
 # reference module aliases

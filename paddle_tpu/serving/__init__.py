@@ -55,6 +55,15 @@ around three ideas the benches point at (DECODE_BENCH.json):
   output bitwise-equal to ``spec_k=0``.  ``spec_adaptive`` gates
   low-acceptance lanes off and shrinks the dispatch back to plain
   decode when nobody's drafts are landing;
+* **tensor-parallel sharded serving** (sharded/) — ``MeshEngine`` runs
+  the whole engine over a ``("dp", "tp")`` device mesh: every Linear
+  column-parallel (output-sharded), the paged KV pool sharded over
+  kv_heads so each chip's block pool holds its head slice, per-layer
+  attention combined through ONE disjoint-support psum, everything
+  else through tiled all_gathers — greedy AND seeded output
+  bitwise-equal to the single-chip engine under continuous batching,
+  prefix hits, preemption and speculative decoding
+  (:class:`~.sharded.ServingSpecLayout` holds the placement rules);
 * an **HTTP/SSE front door** (gateway/) — an OpenAI-style
   ``/v1/completions`` endpoint with per-horizon SSE streaming, priority
   + deadline + per-tenant-quota admission (429/503 + Retry-After load
@@ -90,6 +99,7 @@ from .paged_attention import paged_attention
 from .prefix_cache import PrefixCache, PrefixLease
 from .sampling import SamplingParams
 from .scheduler import Request, Scheduler
+from .sharded import MeshEngine, ServingSpecLayout
 
 __all__ = [
     "Engine", "EngineConfig", "CompiledFn",
@@ -100,4 +110,5 @@ __all__ = [
     "draft_tokens",
     "Gateway", "GatewayConfig", "EngineWorker", "PrefixAffinityRouter",
     "TenantQuotas",
+    "MeshEngine", "ServingSpecLayout",
 ]

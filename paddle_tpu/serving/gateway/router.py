@@ -72,9 +72,26 @@ class EngineWorker:
     loop applies them between horizon dispatches, steps while work
     exists, and flushes per-request token deltas after every step.
     Reads exposed to other threads (``load``, ``healthy``, ``stats()``)
-    are GIL-atomic snapshots of host-side counters."""
+    are GIL-atomic snapshots of host-side counters.
+
+    The worker is engine-shape agnostic: any object with the Engine
+    duck type below drives the same loop — the single-chip ``Engine``
+    and the tensor-parallel ``sharded.MeshEngine`` both qualify, so a
+    router can mix single-chip and mesh replicas behind one front
+    door."""
+
+    #: the Engine duck type the worker loop actually exercises
+    _ENGINE_API = ("submit", "abort", "step", "drain", "stats", "close")
 
     def __init__(self, engine, name=None):
+        missing = [a for a in self._ENGINE_API
+                   if not callable(getattr(engine, a, None))]
+        if not hasattr(engine, "scheduler"):
+            missing.append("scheduler")
+        if missing:
+            raise TypeError(
+                f"EngineWorker needs an Engine-shaped object; "
+                f"{type(engine).__name__} lacks {missing}")
         self.engine = engine
         self.name = name or engine._profiler_name
         self._inbox = queue.Queue()

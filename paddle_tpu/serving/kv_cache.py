@@ -209,7 +209,7 @@ def paged_write(pool, new, tables, pos):
 KV_QMAX = 127.0
 
 
-def paged_write_quant(pool, scales, new, tables, pos):
+def paged_write_quant(pool, scales, new, tables, pos, axis_name=None):
     """Quantize-at-append: scatter ``new`` [B, s, H, D] into the int8
     ``pool`` [NB, bs, H, D] with one f32 absmax scale per TOKEN written
     beside it in ``scales`` [NB, bs].
@@ -228,12 +228,21 @@ def paged_write_quant(pool, scales, new, tables, pos):
 
     The per-token floor (``maximum(absmax, 1e-8)``) makes all-zero
     vectors — scratch writes, padding lanes — quantize to exact zeros,
-    matching the fp pool's zero-initialized blocks."""
+    matching the fp pool's zero-initialized blocks.
+
+    ``axis_name``: inside a shard_map where the head axis (H) is split
+    over a mesh axis, pass that axis name and the per-token absmax is
+    ``pmax``ed across shards before quantizing.  max is exact
+    (associative, no rounding), so the scale equals the full-head
+    absmax a single chip would compute and the stored int8 bytes of
+    each shard's head slice match the single-chip pool bitwise."""
     bs = pool.shape[1]
     b, s = new.shape[0], new.shape[1]
     blocks, offs = _write_coords(bs, s, tables, pos)
     x = new.astype(jnp.float32)
     absmax = jnp.max(jnp.abs(x), axis=(2, 3))                    # [B, s]
+    if axis_name is not None:
+        absmax = jax.lax.pmax(absmax, axis_name)
     step = jnp.maximum(absmax, 1e-8) / KV_QMAX
     q = jnp.clip(jnp.round(x / step[..., None, None]),
                  -KV_QMAX, KV_QMAX)

@@ -1,0 +1,14 @@
+"""Tensor-parallel sharded serving: one engine per mesh, not per chip.
+
+``MeshEngine`` wraps the single-chip :class:`~..engine.Engine` with a
+``shard_map``-compiled forward over a ``("dp", "tp")`` mesh under the
+:class:`ServingSpecLayout` placement discipline — scheduler, prefix
+cache, preemption, speculative decoding and the quant knobs ride along
+unmodified, and the output is bitwise-equal to the single-chip engine
+(see docs/PARITY.md N19g).
+"""
+
+from .layout import ServingSpecLayout
+from .mesh_engine import MeshEngine
+
+__all__ = ["MeshEngine", "ServingSpecLayout"]

@@ -1,0 +1,162 @@
+"""ServingSpecLayout: the PartitionSpec discipline for mesh-sharded
+serving (one engine per mesh, not per chip).
+
+Modeled on the SpecLayout idiom (SNIPPETS.md [2]): a frozen dataclass of
+named axes whose methods return the canonical PartitionSpec for each
+parameter/state family, plus a name-based heuristic that maps every
+decode-model parameter to its spec.  The layout here differs from a
+training SpecLayout in one decisive way: **every Linear is sharded on
+its OUTPUT dimension** (column-parallel), including the projections a
+Megatron layout would make row-parallel (o_proj, down_proj).
+
+Why: row-parallel splits the matmul's CONTRACTION dimension, so each
+shard holds a partial sum and the combining psum re-associates float
+adds — bitwise parity with the single-chip engine dies there.  Column-
+parallel keeps every output element a full-length contraction identical
+to the single-chip one; shards are combined by concatenation
+(``lax.all_gather(tiled=True)``), which moves bytes but never re-rounds
+a value, and attention head outputs combine through ONE psum per layer
+over zero-padded disjoint supports (``x + 0.0 == x`` bitwise).  See
+``mesh_engine.MeshEngine`` for the forward that consumes these specs.
+
+The mesh is ``("dp", "tp")``; dp is fixed at 1 (reserved for the
+disaggregated prefill/decode follow-up, see ROADMAP) and tp shards:
+
+==========================  =======================  ====================
+family                      spec                     note
+==========================  =======================  ====================
+q/k/v projections           P(None, "tp")            heads split over tp
+o_proj / down_proj          P(None, "tp")            column-parallel (see
+                                                     above, NOT Megatron
+                                                     row-parallel)
+gate/up projections         P(None, "tp")            SwiGLU split over tp
+lm_head                     P(None, "tp")            vocab split over tp
+embeddings / norms          P()                      replicated
+paged KV pool               P(None, None, "tp", -)   kv_heads split: each
+                                                     chip's block pool
+                                                     holds its head slice
+KV quant scales             P()                      per-token (head-free)
+block tables / scan state   P()                      replicated; host
+                                                     mirrors unchanged
+==========================  =======================  ====================
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from jax.sharding import PartitionSpec as P
+
+#: substrings naming the column-parallel (output-sharded) projections
+_TP_SHARDED = ("q_proj", "k_proj", "v_proj", "o_proj",
+               "gate_proj", "up_proj", "down_proj", "lm_head")
+
+
+@dataclass(frozen=True)
+class ServingSpecLayout:
+    """Canonical PartitionSpecs for the sharded serving engine."""
+
+    dp_axis: str = "dp"
+    tp_axis: str = "tp"
+
+    @property
+    def mesh_axes(self):
+        return (self.dp_axis, self.tp_axis)
+
+    # ------------------------------------------------------- parameters
+    def qkv_projection(self):
+        """q/k/v weights [hidden, heads*head_dim]: heads split over tp."""
+        return P(None, self.tp_axis)
+
+    def attn_output(self):
+        """o_proj [heads*head_dim, hidden]: OUTPUT-sharded (column-
+        parallel), not Megatron row-parallel — see the module docstring."""
+        return P(None, self.tp_axis)
+
+    def ffn(self):
+        """gate/up/down weights: output dimension split over tp."""
+        return P(None, self.tp_axis)
+
+    def lm_head(self):
+        """lm_head [hidden, vocab]: vocab split over tp."""
+        return P(None, self.tp_axis)
+
+    def embedding(self):
+        """Embedding tables replicated (serving reads one row per token;
+        the capacity lever is the KV pool, not the embedding)."""
+        return P()
+
+    def norm(self):
+        return P()
+
+    # ----------------------------------------------------- engine state
+    def kv_pool(self):
+        """Paged pool [num_blocks, block_size, kv_heads, head_dim]: each
+        chip's block pool holds only its KV-head slice."""
+        return P(None, None, self.tp_axis, None)
+
+    def kv_scales(self):
+        """Quantized-pool per-token scales [num_blocks, block_size]:
+        head-free, so replicated (each shard computes the identical
+        full-head absmax via pmax — see kv_cache.paged_write_quant)."""
+        return P()
+
+    def engine_state(self):
+        """Block tables and horizon-scan state (tokens/pos/counts/...):
+        replicated; the host-authoritative mirrors are unchanged."""
+        return P()
+
+    # ------------------------------------------------------- name rules
+    def parameter_spec(self, name):
+        """Heuristic spec from a state_dict parameter name."""
+        n = name.lower()
+        if not n.endswith(".weight"):
+            return self.engine_state()
+        if any(p in n for p in ("q_proj", "k_proj", "v_proj")):
+            return self.qkv_projection()
+        if "o_proj" in n:
+            return self.attn_output()
+        if any(p in n for p in ("gate_proj", "up_proj", "down_proj")):
+            return self.ffn()
+        if "lm_head" in n:
+            return self.lm_head()
+        if "embed" in n:
+            return self.embedding()
+        return self.norm()
+
+    def state_specs(self, names):
+        """One spec per state_dict entry, in order."""
+        return tuple(self.parameter_spec(n) for n in names)
+
+    def is_tp_sharded(self, name):
+        return (name.endswith(".weight")
+                and any(p in name for p in _TP_SHARDED))
+
+    # -------------------------------------------------------- validation
+    def validate(self, model_config, tp):
+        """Eagerly reject shapes the layout cannot shard: every tp-split
+        dimension must divide evenly (a ragged shard would silently
+        change which head/channel lives where), and tied embeddings have
+        no lm_head weight to shard."""
+        c = model_config
+        tp = int(tp)
+        if tp < 1:
+            raise ValueError(f"tp must be >= 1, got {tp}")
+        if getattr(c, "tie_word_embeddings", False):
+            raise ValueError(
+                "sharded serving requires an untied lm_head "
+                "(tie_word_embeddings=True has no lm_head weight to "
+                "shard over tp)")
+        checks = (
+            ("num_key_value_heads (kv_heads)", c.kv_heads),
+            ("num_attention_heads", c.num_attention_heads),
+            ("hidden_size", c.hidden_size),
+            ("intermediate_size", c.intermediate_size),
+            ("vocab_size", c.vocab_size),
+        )
+        bad = [f"{name}={v}" for name, v in checks if v % tp != 0]
+        if bad:
+            raise ValueError(
+                f"model not shardable over tp={tp}: "
+                f"{', '.join(bad)} not divisible by tp")
+        return True

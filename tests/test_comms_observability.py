@@ -77,7 +77,7 @@ class TestWalkerCensus:
 
     @pytest.mark.parametrize("name", ["dp8", "dp4xmp2", "pp2_1f1b",
                                       "ring_sep4", "zero3_sharding8",
-                                      "moe_ep4"])
+                                      "moe_ep4", "sharded_decode_tp2"])
     def test_census_exact(self, mc, name):
         fn, args, expected = mc.CONFIGS[name]()
         report = comms.analyze_fn(fn, *args)

@@ -257,6 +257,49 @@ class TestDrain:
         assert eng.pool.blocks_in_use == 0
         eng.close()
 
+    def test_mixed_mesh_replica_drain_releases_every_block(self):
+        """A router over one single-chip and one tp=2 MeshEngine
+        replica (8 virtual CPU devices): EngineWorker drives the mesh
+        engine through the same duck type, both replicas take work, and
+        drain's block-leak invariant (``kv_blocks_in_use == 0``) holds
+        on the mesh-sharded pool too."""
+        from paddle_tpu.serving import MeshEngine
+
+        # one model INSTANCE per replica: engines trace through
+        # use_state() on their model, and a mesh engine swaps in
+        # locally-SLICED weights — sharing one module object between
+        # concurrently-stepping workers would race the swap (benign
+        # between same-shape single-chip engines, a shape error against
+        # a mesh engine; see the MeshEngine docstring)
+        e0 = Engine(_model(), _cfg(num_slots=2), register_profiler=False)
+        e1 = MeshEngine(_model(), _cfg(num_slots=2), tp=2,
+                        register_profiler=False)
+        w0, w1 = EngineWorker(e0, "chip"), EngineWorker(e1, "mesh")
+        router = PrefixAffinityRouter([w0, w1])
+        handles = []
+        for i in range(4):                 # spread across both replicas
+            h, _, _ = router.submit([1 + i, 2, 3, 4],
+                                    SamplingParams(max_new_tokens=4))
+            handles.append(h)
+        for h in handles:
+            kind, reason = _drain_handle(h)
+            assert (kind, reason) == ("finish", "length")
+        for w in (w0, w1):
+            w.drain()
+            assert w.engine.pool.blocks_in_use == 0
+            assert w.stats()["kv_pool"]["blocks_in_use"] == 0
+            w.stop()
+        assert e1.stats()["mesh"]["mesh_shape"] == {"dp": 1, "tp": 2}
+        e0.close()
+        e1.close()
+
+    def test_worker_rejects_non_engine_objects(self):
+        """The duck-type assertion: a router-level fake without the
+        Engine API fails fast with the missing names, instead of dying
+        later on the worker thread."""
+        with pytest.raises(TypeError, match="submit"):
+            EngineWorker(object(), "bogus")
+
     def test_router_remove_is_graceful(self):
         m = _model()
         e0 = Engine(m, _cfg(num_slots=2), register_profiler=False)

@@ -2021,3 +2021,250 @@ class TestPerformanceObservatory:
         eng.run()
         assert r9.finish_reason == "length"
         eng.close()
+
+
+# ----------------------------------------------------------- sharded serving
+class TestServingSpecLayout:
+    """The sharded layout's placement rules: every decode-model
+    parameter gets a spec, projections are column-parallel, and
+    unshardable shapes are rejected EAGERLY (before any device work)."""
+
+    def test_every_param_gets_a_spec(self):
+        from jax.sharding import PartitionSpec as P
+        from paddle_tpu.serving import ServingSpecLayout
+
+        layout = ServingSpecLayout()
+        m = _model()
+        names = list(m.state_dict().keys())
+        specs = layout.state_specs(names)
+        assert len(specs) == len(names)
+        for n, sp in zip(names, specs):
+            if layout.is_tp_sharded(n):
+                # column-parallel: LAST axis sharded, never the first
+                # (sharding the contraction dim would break bitwise)
+                assert sp == P(None, "tp"), n
+            else:
+                assert sp == P(), n
+        # the decode-model projections really are in the sharded set
+        sharded = [n for n in names if layout.is_tp_sharded(n)]
+        for proj in ("q_proj", "k_proj", "v_proj", "o_proj", "gate_proj",
+                     "up_proj", "down_proj", "lm_head"):
+            assert any(proj in n for n in sharded), proj
+        # engine scan state and the KV pool have placements too
+        assert layout.engine_state() == P()
+        assert layout.kv_pool() == P(None, None, "tp", None)
+        assert layout.kv_scales() == P()
+
+    def test_divisibility_errors_are_eager_and_name_offenders(self):
+        from paddle_tpu.serving import ServingSpecLayout
+
+        layout = ServingSpecLayout()
+        # TINY: 4 heads / hidden 64 / vocab 128 — tp=3 divides nothing
+        with pytest.raises(ValueError, match="num_attention_heads=4"):
+            layout.validate(TINY, 3)
+        # TINY_GQA: 8 q-heads divide by 4 but the 2 kv_heads do not
+        with pytest.raises(ValueError, match="kv_heads"):
+            layout.validate(TINY_GQA, 4)
+        layout.validate(TINY_GQA, 2)            # and tp=2 is fine
+
+    def test_tied_embeddings_rejected(self):
+        from paddle_tpu.serving import ServingSpecLayout
+
+        tied = GPTConfig(vocab_size=128, hidden_size=64,
+                         intermediate_size=128, num_hidden_layers=2,
+                         num_attention_heads=4,
+                         max_position_embeddings=64,
+                         tie_word_embeddings=True)
+        with pytest.raises(ValueError, match="tie_word_embeddings"):
+            ServingSpecLayout().validate(tied, 2)
+
+    def test_mesh_engine_rejects_bad_shapes_before_compiling(self):
+        from paddle_tpu.serving import MeshEngine
+
+        m = _model()
+        with pytest.raises(ValueError, match="not divisible"):
+            MeshEngine(m, EngineConfig(num_slots=2, max_seq_len=32),
+                       tp=3, register_profiler=False)
+        with pytest.raises(ValueError, match="mesh_shape"):
+            MeshEngine._norm_mesh_knob(None, None)
+        with pytest.raises(ValueError, match="contradicts"):
+            MeshEngine._norm_mesh_knob((1, 2), 4)
+        with pytest.raises(ValueError, match="disaggregated"):
+            MeshEngine._norm_mesh_knob((2, 2), None)
+        with pytest.raises(ValueError, match="tp must be"):
+            MeshEngine._norm_mesh_knob(None, 0)
+        assert MeshEngine._norm_mesh_knob(None, 2) == (1, 2)
+        assert MeshEngine._norm_mesh_knob((1, 4), None) == (1, 4)
+
+
+class TestShardedServing:
+    """MeshEngine vs single-chip Engine: greedy AND seeded streams must
+    be bitwise-equal under continuous batching, prefix hits, preemption
+    and speculative decoding (8 virtual CPU devices, tp=2)."""
+
+    PROMPTS = [[3, 1, 4, 1, 5], [9, 2, 6]]
+    SAMP = [SamplingParams(max_new_tokens=10),
+            SamplingParams(temperature=0.8, top_k=20, seed=11,
+                           max_new_tokens=10)]
+
+    @staticmethod
+    def _cfg(**kw):
+        kw.setdefault("num_slots", 2)
+        kw.setdefault("max_seq_len", 32)
+        kw.setdefault("max_horizon", 4)
+        kw.setdefault("prefix_block_size", 4)
+        kw.setdefault("prefix_cache_bytes", 0)
+        return EngineConfig(**kw)
+
+    @classmethod
+    def _ref(cls, m, prompts, samp, **kw):
+        eng = Engine(m, cls._cfg(**kw), register_profiler=False)
+        out = eng.generate(prompts, samp)
+        eng.close()
+        return out
+
+    @classmethod
+    def _mesh(cls, m, tp=2, **kw):
+        from paddle_tpu.serving import MeshEngine
+
+        return MeshEngine(m, cls._cfg(**kw), tp=tp,
+                          register_profiler=False)
+
+    def test_tp2_bitwise_parity_greedy_and_seeded(self):
+        """The core acceptance test: continuous batching over a greedy
+        and a seeded lane, tp=2 vs single chip, bitwise."""
+        m = _model()
+        ref = self._ref(m, self.PROMPTS, self.SAMP)
+        eng = self._mesh(m)
+        assert eng.generate(self.PROMPTS, self.SAMP) == ref
+        assert eng.pool.blocks_in_use == 0
+        s = eng.stats()["mesh"]
+        assert s["mesh_shape"] == {"dp": 1, "tp": 2}
+        assert len(s["devices"]) == 2
+        eng.close()
+
+    def test_tp1_is_the_degenerate_mesh(self):
+        m = _model()
+        ref = self._ref(m, self.PROMPTS, self.SAMP)
+        eng = self._mesh(m, tp=1)
+        assert eng.generate(self.PROMPTS, self.SAMP) == ref
+        eng.close()
+
+    def test_decode_census_matches_hand_formula(self):
+        """The comms walker's census of the REAL compiled decode
+        program equals the hand-derived per-layer count — the same
+        contract MULTICHIP_BENCH.json gates exact in CI."""
+        m = _model()
+        eng = self._mesh(m)
+        rep = eng.decode_comms_report(horizon=4)   # asserts internally
+        L, h = 2, 4
+        assert rep.counts() == {("psum", "tp"): L * h,
+                                ("all_gather", "tp"): (3 * L + 1) * h}
+        eng.close()
+
+    @pytest.mark.slow
+    def test_tp2_parity_gqa(self):
+        m = _model(TINY_GQA)
+        ref = self._ref(m, self.PROMPTS, self.SAMP)
+        eng = self._mesh(m)
+        assert eng.generate(self.PROMPTS, self.SAMP) == ref
+        eng.close()
+
+    @pytest.mark.slow
+    def test_tp2_prefix_hit_parity(self):
+        """A shared-prefix workload over the mesh-sharded pool: leases,
+        COW and the radix store run host-side and unchanged; the leased
+        blocks hold sharded KV.  Streams stay bitwise and the second
+        submission actually hits the cache."""
+        m = _model()
+        shared = [5, 5, 7, 7, 1, 2, 3, 4]
+        prompts = [shared + [9], shared + [8]]
+        samp = [SamplingParams(max_new_tokens=8),
+                SamplingParams(max_new_tokens=8)]
+        kw = dict(prefix_cache_bytes=1 << 20)
+        # sequential submissions so the second prompt can actually hit
+        # the blocks the first one's retirement adopted
+        refeng = Engine(m, self._cfg(**kw), register_profiler=False)
+        ref = [refeng.generate(p, s) for p, s in zip(prompts, samp)]
+        refeng.close()
+        eng = self._mesh(m, **kw)
+        out = [eng.generate(p, s) for p, s in zip(prompts, samp)]
+        assert out == ref
+        assert eng.stats()["prefix"]["hit_tokens"] > 0
+        eng.drain()
+        assert eng.pool.blocks_in_use == 0
+        eng.close()
+
+    @pytest.mark.slow
+    def test_tp2_preempt_resume_parity(self):
+        """Explicit preemption of a seeded lane mid-decode: blocks
+        released, request re-admitted at the queue front, stream still
+        bitwise vs the single-chip run of the same scenario."""
+        m = _model()
+        ref = self._ref(m, self.PROMPTS, self.SAMP)
+        eng = self._mesh(m)
+        reqs = [eng.submit(p, s)
+                for p, s in zip(self.PROMPTS, self.SAMP)]
+        eng.step(horizon=2)
+        victim = reqs[1]
+        eng.preempt(victim)
+        assert victim.status == "waiting"
+        eng.run()
+        assert [r.output_ids for r in reqs] == ref
+        assert eng.counters()["preemptions"] == 1
+        assert eng.pool.blocks_in_use == 0
+        eng.close()
+
+    @pytest.mark.slow
+    def test_tp2_spec_decode_parity(self):
+        """Speculative decoding (K=4) over the mesh: drafts verified
+        through the sharded forward, output bitwise vs the single-chip
+        engine with the same knob — greedy and seeded."""
+        m = _model()
+        rep = TestSpeculativeDecode.REP_PROMPT
+        samp = [SamplingParams(max_new_tokens=10),
+                SamplingParams(temperature=0.9, top_k=20, top_p=0.9,
+                               seed=7, max_new_tokens=10)]
+        prompts = [rep, rep]
+        kw = dict(max_seq_len=48, spec_k=4)
+        ref = self._ref(m, prompts, samp, **kw)
+        eng = self._mesh(m, **kw)
+        assert eng.generate(prompts, samp) == ref
+        assert eng.stats()["spec"]["draft_tokens"] > 0
+        eng.close()
+
+    @pytest.mark.slow
+    def test_tp2_kv_quant_parity(self):
+        """int8 paged KV over the mesh: the pmax'ed absmax gives every
+        shard the full-head scale, so streams match the single-chip
+        int8 engine bitwise (and census grows the 2L pmaxes)."""
+        m = _model()
+        kw = dict(kv_cache_dtype="int8")
+        ref = self._ref(m, self.PROMPTS, self.SAMP, **kw)
+        eng = self._mesh(m, **kw)
+        assert eng.generate(self.PROMPTS, self.SAMP) == ref
+        assert eng.decode_comms_report(horizon=4).counts()[
+            ("pmax", "tp")] == 2 * 2 * 4
+        eng.close()
+
+    def test_create_llm_engine_knobs(self):
+        """The predictor-style entry point: tp picks the engine class,
+        knob contradictions raise like _norm_quant_knob does."""
+        from paddle_tpu.inference import create_llm_engine
+        from paddle_tpu.serving import MeshEngine
+
+        m = _model()
+        eng = create_llm_engine(m, num_slots=2, max_seq_len=32)
+        assert type(eng) is Engine
+        eng.close()
+        eng = create_llm_engine(m, tp=1, num_slots=2, max_seq_len=32)
+        assert type(eng) is Engine
+        eng.close()
+        eng = create_llm_engine(m, tp=2, num_slots=2, max_seq_len=32)
+        assert isinstance(eng, MeshEngine)
+        assert eng.mesh_shape == (1, 2)
+        eng.close()
+        with pytest.raises(ValueError, match="contradicts"):
+            create_llm_engine(m, mesh_shape=(1, 2), tp=4)
+        with pytest.raises(ValueError, match="disaggregated"):
+            create_llm_engine(m, mesh_shape=(2, 2))
