@@ -54,6 +54,9 @@ FIRST_TOKEN = "first_token"  # sampled by the prefill dispatch (TTFT)
 DECODE = "decode"            # one fused decode horizon this lane rode
 PREEMPT = "preempt"          # swapped out under KV block pressure
 RESUME = "resume"            # re-admission re-prefill after a preempt
+FAILOVER = "failover"        # adopted from a dead replica: this trace's
+                             # request resumes another engine's stream
+                             # (records from_replica + resumed_tokens)
 FINISH = "finish"            # retired: EOS or max-tokens
 ABORT = "abort"              # cancelled by the caller
 
@@ -113,7 +116,7 @@ class RequestTrace:
         or per-tenant quota bills against; summed across requests these
         reconstruct the engine's dispatch totals)."""
         tokens = prefix_hit = preempts = horizons = accepted = 0
-        aborted = 0
+        aborted = failovers = resumed_tokens = 0
         flops = bytes_est = 0.0
         for kind, _, args in self._snapshot():
             if kind == FIRST_TOKEN:
@@ -130,12 +133,20 @@ class RequestTrace:
                 prefix_hit = args.get("prefix_hit_tokens", prefix_hit)
             elif kind == PREEMPT:
                 preempts += 1
+            elif kind == FAILOVER:
+                # tokens resumed from the dead replica are NOT counted
+                # as emitted by THIS trace's engine — per-engine sums
+                # still reconcile against engine counters exactly
+                failovers += 1
+                resumed_tokens = args.get("resumed_tokens",
+                                          resumed_tokens)
             if kind in (PREFILL, RESUME, DECODE):
                 flops += args.get("flops_est", 0.0)
                 bytes_est += args.get("bytes_est", 0.0)
         return {"tokens_emitted": tokens, "prefix_hit_tokens": prefix_hit,
                 "preemptions": preempts, "decode_horizons": horizons,
                 "spec_accepted_tokens": accepted, "aborted": aborted,
+                "failovers": failovers, "resumed_tokens": resumed_tokens,
                 "flops_est": flops, "bytes_est": bytes_est}
 
     def to_json(self):

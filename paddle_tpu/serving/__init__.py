@@ -70,7 +70,17 @@ around three ideas the benches point at (DECODE_BENCH.json):
   shedding), and a prefix-affinity router over N in-process engine
   replicas (rendezvous-hashed radix-cache-block keys; SLO-unhealthy
   replicas stop receiving sessions).  Import from
-  ``paddle_tpu.serving.gateway``.
+  ``paddle_tpu.serving.gateway``;
+* **fault tolerance** (faults.py + gateway/router.py) — deterministic
+  seeded fault injection (:class:`FaultPlan`/:class:`FaultInjector`:
+  schedules keyed by dispatch ordinals, never wall clocks), a
+  per-worker heartbeat watchdog, capped-exponential retry/backoff with
+  deterministic jitter (:class:`RetryPolicy`), a graceful-degradation
+  ladder (spec off → horizon 1 → shed) with hysteresis, and mid-stream
+  replica **failover**: a dead replica's in-flight requests re-dispatch
+  to survivors carrying prompt + tokens-already-streamed, resumed via
+  re-prefill under the same ``fold_in(seed, n_generated)`` discipline —
+  the continued stream is bitwise-identical to an uninterrupted run.
 
 Quick start::
 
@@ -91,8 +101,10 @@ hits) are exposed through ``paddle_tpu.profiler.counters()``.
 
 from .drafter import draft_tokens
 from .engine import CompiledFn, Engine, EngineConfig
-from .gateway import (EngineWorker, Gateway, GatewayConfig,
-                      PrefixAffinityRouter, TenantQuotas)
+from .faults import (FaultInjector, FaultPlan, FaultSpec, RetryPolicy,
+                     TransientSubmitError, WorkerCrash, WorkerDeadError)
+from .gateway import (EngineWorker, FleetSupervisor, Gateway,
+                      GatewayConfig, PrefixAffinityRouter, TenantQuotas)
 from .kv_cache import (PagedKV, PagedKVCache, PagedKVPool, SlotKV,
                        SlottedKVCache)
 from .paged_attention import paged_attention
@@ -109,6 +121,8 @@ __all__ = [
     "SamplingParams", "Request", "Scheduler",
     "draft_tokens",
     "Gateway", "GatewayConfig", "EngineWorker", "PrefixAffinityRouter",
-    "TenantQuotas",
+    "TenantQuotas", "FleetSupervisor",
+    "FaultPlan", "FaultSpec", "FaultInjector", "RetryPolicy",
+    "WorkerCrash", "TransientSubmitError", "WorkerDeadError",
     "MeshEngine", "ServingSpecLayout",
 ]

@@ -112,6 +112,8 @@ from ..observability import profiling as _obs_profiling
 from ..observability import tracing as _obs_tracing
 from ..observability.span import span as _obs_span
 from .drafter import draft_tokens
+from .faults import (DEGRADE_LEVELS, FAULT_POOL_EXHAUSTED,
+                     SITE_ENGINE_ADMIT, _SRV_DEGRADATION, _SRV_SHED)
 from .kv_cache import PagedKV, PagedKVCache
 from .prefix_cache import PrefixCache
 from .sampling import (SamplingParams, request_key, sample_token,
@@ -444,6 +446,20 @@ class EngineConfig:
     #: windows burning above 1x budget
     slo_fast_window: int = 64
     slo_slow_window: int = 640
+    #: graceful-degradation ladder: under sustained SLO burn or pool
+    #: pressure the engine steps down one level per ``degrade_patience``
+    #: consecutive burning steps — 1 disables speculative decoding,
+    #: 2 shrinks the decode horizon to 1 (admission at every boundary),
+    #: 3 sheds lowest-priority queued requests down to ``num_slots``
+    #: queued — and recovers one level per ``degrade_recover_patience``
+    #: consecutive calm steps (hysteresis: recovery is deliberately
+    #: slower than escalation, so the ladder can't flap).  Transitions
+    #: ride the event ring and the serving.degradation_level gauge.
+    degrade_enabled: bool = True
+    #: pool occupancy fraction that counts as block-pool pressure
+    degrade_pool_ratio: float = 0.92
+    degrade_patience: int = 4
+    degrade_recover_patience: int = 16
 
 
 class Engine:
@@ -621,6 +637,16 @@ class Engine:
         self._deadline_expired = 0
         self._tenants = {}               # tenant -> accounting dict
         self._draining = False
+        # fault injection (faults.install_faults) + degradation ladder
+        self.faults = None               # FaultInjector or None
+        self._fault_scope = ""
+        self._admit_deferred = False     # injected pool-exhaustion pass
+        self._degrade_level = 0
+        self._burn_streak = 0            # consecutive burning steps
+        self._calm_streak = 0            # consecutive calm steps
+        self._degrade_transitions = 0
+        self._degrade_history = []       # last 64 transitions
+        self._degrade_sheds = 0
         self._prefill_calls = 0          # compiled prefill DISPATCHES
         self._prefill_requests = 0       # requests prefilled (>= calls)
         self._prefix_hit_tokens = 0
@@ -712,6 +738,13 @@ class Engine:
             self.telemetry.stop()
         if self._finalizer is not None:
             self._finalizer()
+
+    def install_faults(self, injector, scope=""):
+        """Arm deterministic fault injection (faults.FaultInjector) on
+        this engine's ``engine.admit`` site; None disarms.  ``scope``
+        names this engine in the plan (usually the worker name)."""
+        self.faults = injector
+        self._fault_scope = scope or self._profiler_name
 
     @staticmethod
     def _norm_quant_knob(value, name):
@@ -992,6 +1025,8 @@ class Engine:
         token per step, i.e. plain decode), and once every lane is
         below the floor the dispatch itself shrinks to K=0 so the
         verify window costs nothing at all."""
+        if self._degrade_level >= 1:
+            return 0                 # ladder level 1+: spec decoding off
         k = max(0, int(self.config.spec_k))
         if not k or not self.config.spec_adaptive:
             return k
@@ -1011,6 +1046,9 @@ class Engine:
         remaining budget so length-retirement never wastes lane steps
         (EOS remains unpredictable — mid-horizon EOS waste is measured
         by ``serving.wasted_lane_tokens``)."""
+        if self._degrade_level >= 2:
+            return 1                 # ladder level 2+: admit at every
+                                     # boundary, shortest commit unit
         max_h = max(1, int(self.config.max_horizon))
         if requested is not None:
             return self._pow2_floor(min(max(1, int(requested)), max_h))
@@ -1022,7 +1060,7 @@ class Engine:
 
     # ------------------------------------------------------------ API
     def submit(self, prompt_ids, sampling=None, priority=0,
-               deadline_s=None, tenant=None):
+               deadline_s=None, tenant=None, resume_ids=None):
         """Queue one request; returns the Request handle (its
         ``output_ids`` fill in as the engine steps).
 
@@ -1032,7 +1070,19 @@ class Engine:
         bounds queue wait — a request still QUEUED when the deadline
         passes is aborted at the next admission pass
         (``finish_reason="abort"``) — and ``tenant`` tags the request
-        for per-tenant accounting in ``stats()['tenants']``."""
+        for per-tenant accounting in ``stats()['tenants']``.
+
+        ``resume_ids`` is the failover entry point: tokens this request
+        already generated **on another engine** before its replica
+        died.  The request queues as ``resumed`` and admission takes
+        the preemption-resume path — re-prefill ``prompt + resume_ids``
+        with ``counts = len(resume_ids) - 1``, so the boundary token is
+        re-sampled and checked bitwise against ``resume_ids[-1]``
+        (sampling is a pure function of ``fold_in(seed, n_generated)``,
+        identical across replicas holding the same weights) — then
+        decode continues the stream exactly where the dead replica left
+        off.  Requires ``len(resume_ids) < max_new_tokens`` (a resume
+        with nothing left to generate is the caller's to finish)."""
         if self._draining:
             raise RuntimeError("engine is draining; submissions refused")
         prompt_ids = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
@@ -1049,9 +1099,22 @@ class Engine:
                 f"prompt_len {len(prompt_ids)} + max_new_tokens "
                 f"{sampling.max_new_tokens} exceeds max_seq_len "
                 f"{self.config.max_seq_len}")
+        resume_ids = ([int(t) for t in resume_ids]
+                      if resume_ids else None)
+        if resume_ids and len(resume_ids) >= sampling.max_new_tokens:
+            raise ValueError(
+                f"resume_ids already holds {len(resume_ids)} tokens, "
+                f">= max_new_tokens {sampling.max_new_tokens}: nothing "
+                "left to generate")
         req = self.scheduler.submit(prompt_ids, sampling,
                                     priority=priority,
                                     deadline_s=deadline_s, tenant=tenant)
+        if resume_ids:
+            # cross-engine resume: admission re-prefills this history
+            # through the preemption path (resumed => queue-head anchor
+            # exemption + the bitwise boundary-token check)
+            req.output_ids = list(resume_ids)
+            req.resumed = True
         t = self._tenants.setdefault(
             tenant if tenant is not None else "",
             {"submitted": 0, "finished": 0, "aborted": 0,
@@ -1067,6 +1130,8 @@ class Engine:
                 gw["deadline_s"] = req.deadline_s
             if req.tenant is not None:
                 gw["tenant"] = req.tenant
+            if resume_ids:
+                gw["resumed_tokens"] = len(resume_ids)
             req.trace.add(_obs_tracing.QUEUED,
                           prompt_len=req.prompt_len,
                           max_new_tokens=sampling.max_new_tokens, **gw)
@@ -1092,6 +1157,26 @@ class Engine:
         An oversubscribed pool therefore defers admission instead of
         failing mid-prefill."""
         self._expire_deadlines()
+        self._admit_deferred = False
+        if self.scheduler.queue_depth:
+            if self._degrade_level >= 3:
+                # ladder level 3: shed lowest-priority queued requests
+                # down to num_slots queued (resumed requests are never
+                # shed — their tokens are already streamed)
+                for req in self.scheduler.shed_victims(
+                        self.cache.num_slots):
+                    self._degrade_sheds += 1
+                    _SRV_SHED.inc(engine=self._profiler_name)
+                    self.abort(req, cause="shed")
+            if self.faults is not None:
+                spec = self.faults.fire(SITE_ENGINE_ADMIT,
+                                        scope=self._fault_scope)
+                if (spec is not None
+                        and spec.kind == FAULT_POOL_EXHAUSTED):
+                    # behave exactly like a dry pool: defer this whole
+                    # admission pass to the next horizon boundary
+                    self._admit_deferred = True
+                    return
         # while draining, the queue can only hold `resumed` requests
         # (submit() refuses and drain() aborted the rest) — re-admitting
         # them is finishing in-flight work, so admission proceeds
@@ -1601,6 +1686,7 @@ class Engine:
         step."""
         t0 = time.time()
         finished = []
+        self._update_degradation()
         self.admit()
         if self.scheduler.running:
             h = self._resolve_horizon(horizon)
@@ -1739,10 +1825,67 @@ class Engine:
         self._wasted_lane_tokens += wasted
         return harvested, wasted
 
+    # ------------------------------------------------- degradation ladder
+    def _degrade_signal(self):
+        """The pressure signal driving the ladder: the reason string
+        while the engine is burning (any SLO objective unhealthy, or
+        pool occupancy at/above ``degrade_pool_ratio``), else None."""
+        if self.slo is not None and not self.slo.healthy:
+            return "slo_burn"
+        if (self.pool.blocks_in_use / self.pool.capacity
+                >= float(self.config.degrade_pool_ratio)):
+            return "pool_pressure"
+        return None
+
+    def _update_degradation(self):
+        """One ladder tick (called every step): ``degrade_patience``
+        consecutive burning steps escalate one level,
+        ``degrade_recover_patience`` consecutive calm steps step back
+        down one level — asymmetric on purpose (hysteresis), so a
+        marginal signal can't flap the ladder."""
+        if not self.config.degrade_enabled:
+            return
+        reason = self._degrade_signal()
+        if reason is not None:
+            self._calm_streak = 0
+            self._burn_streak += 1
+            if (self._degrade_level < len(DEGRADE_LEVELS) - 1
+                    and self._burn_streak
+                    >= int(self.config.degrade_patience)):
+                self._set_degrade_level(self._degrade_level + 1, reason)
+                self._burn_streak = 0
+        else:
+            self._burn_streak = 0
+            if self._degrade_level == 0:
+                return
+            self._calm_streak += 1
+            if (self._calm_streak
+                    >= int(self.config.degrade_recover_patience)):
+                self._set_degrade_level(self._degrade_level - 1,
+                                        "recovered")
+                self._calm_streak = 0
+
+    def _set_degrade_level(self, level, reason):
+        prev, level = self._degrade_level, int(level)
+        self._degrade_level = level
+        self._degrade_transitions += 1
+        self._degrade_history.append(
+            {"from": prev, "to": level,
+             "level": DEGRADE_LEVELS[level], "reason": reason,
+             "decode_horizons": self._decode_horizons})
+        del self._degrade_history[:-64]
+        name = self._profiler_name
+        _SRV_DEGRADATION.set(level, engine=name)
+        _obs_events.instant("serving.degrade", cat="serving",
+                            engine=name, level=level,
+                            level_name=DEGRADE_LEVELS[level],
+                            from_level=prev, reason=reason)
+
     def _publish_gauges(self):
         """Refresh the point-in-time typed gauges (once per step — the
         counters/histograms above accumulate incrementally)."""
         name = self._profiler_name
+        _SRV_DEGRADATION.set(self._degrade_level, engine=name)
         _SRV_QUEUE.set(self.scheduler.queue_depth, engine=name)
         _SRV_ACTIVE.set(self.cache.used_slots, engine=name)
         _SRV_KV_BLOCKS.set(self.pool.blocks_in_use, engine=name)
@@ -1772,7 +1915,8 @@ class Engine:
             before = self._finished
             out.extend(self.step())
             if self._finished == before and not self.scheduler.running \
-                    and self.scheduler.queue_depth:
+                    and self.scheduler.queue_depth \
+                    and not self._admit_deferred:
                 raise RuntimeError("engine stalled with queued work")
         return out
 
@@ -1907,6 +2051,8 @@ class Engine:
             "spec_accept_rate": (
                 self._spec_accepted_tokens / self._spec_draft_tokens
                 if self._spec_draft_tokens else 0.0),
+            "degradation_level": self._degrade_level,
+            "degradation_sheds": self._degrade_sheds,
         }
         if self._decode_steps:
             c["slot_utilization"] = (self._slot_busy_integral
@@ -1936,6 +2082,13 @@ class Engine:
         # events
         s["tenants"] = {k: dict(v) for k, v in self._tenants.items()}
         s["draining"] = self._draining
+        s["degradation"] = {
+            "level": self._degrade_level,
+            "level_name": DEGRADE_LEVELS[self._degrade_level],
+            "transitions": self._degrade_transitions,
+            "sheds": self._degrade_sheds,
+            "history": list(self._degrade_history[-8:]),
+        }
         s["kv_pool"] = {
             "block_size": self._block_size,
             "capacity_blocks": self.pool.capacity,

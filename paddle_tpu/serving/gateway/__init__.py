@@ -7,13 +7,18 @@ Three layers (one module each):
   with SSE streaming, structured OpenAI-style errors.
 * :mod:`.admission` — per-tenant token-bucket quotas (429) and the SLO
   load-shed decision (503 + Retry-After).
-* :mod:`.router` — :class:`EngineWorker` replica threads and
-  prefix-affinity (rendezvous-hashed radix-cache-block) routing.
+* :mod:`.router` — :class:`EngineWorker` replica threads,
+  prefix-affinity (rendezvous-hashed radix-cache-block) routing, and
+  the :class:`FleetSupervisor` watchdog that condemns dead/hung
+  replicas and fails their in-flight streams over (bitwise-seamless
+  resume on a surviving replica; see ``paddle_tpu.serving.faults``
+  for the deterministic chaos layer that tests it).
 """
 
 from .admission import TenantQuotas, TokenBucket
 from .protocol import Gateway, GatewayConfig
-from .router import EngineWorker, PrefixAffinityRouter, StreamHandle
+from .router import (EngineWorker, FleetSupervisor,
+                     PrefixAffinityRouter, StreamHandle)
 
 __all__ = [
     "Gateway",
@@ -21,6 +26,7 @@ __all__ = [
     "TenantQuotas",
     "TokenBucket",
     "EngineWorker",
+    "FleetSupervisor",
     "PrefixAffinityRouter",
     "StreamHandle",
 ]

@@ -59,10 +59,12 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from ...observability import metrics as _obs_metrics
 from ...observability.server import PROM_CONTENT_TYPE
 from ..engine import Engine
+from ..faults import (_SRV_RETRIES, RetryPolicy, TransientSubmitError,
+                      WorkerDeadError)
 from ..sampling import SamplingParams
 from ..scheduler import FINISH_EOS
 from .admission import TenantQuotas
-from .router import EngineWorker, PrefixAffinityRouter
+from .router import EngineWorker, FleetSupervisor, PrefixAffinityRouter
 
 # gateway.* metric families (labels via kwargs, like serving.*)
 _GW_REQS = _obs_metrics.counter(
@@ -109,6 +111,22 @@ class GatewayConfig:
     #: ceiling on one completion's wall time before the gateway aborts
     #: it server-side
     request_timeout_s: float = 120.0
+    #: worker watchdog: a replica holding work that hasn't heartbeat
+    #: within this is condemned and its streams failed over (None
+    #: disables stall detection; dead threads are always detected).
+    #: Generous by default — a cold compile must never look like a hang.
+    watchdog_timeout_s: float | None = 60.0
+    #: how often the fleet supervisor sweeps worker health
+    watchdog_interval_s: float = 0.25
+    #: per-request budget of submit retries after transient failures;
+    #: only a spent budget surfaces a 503 (with the next backoff delay
+    #: as an honest Retry-After)
+    retry_budget: int = 2
+    #: capped-exponential retry backoff: base doubles per attempt up to
+    #: the cap, scaled by deterministic (seeded) jitter
+    retry_backoff_s: float = 0.05
+    retry_backoff_cap_s: float = 1.0
+    retry_seed: int = 0
     #: optional ``tokens -> str`` callable filling the OpenAI ``text``
     #: response field; None leaves ``text`` empty (ids only)
     detokenize: object = None
@@ -154,8 +172,24 @@ class Gateway:
             [EngineWorker(e, name=f"replica{i}")
              for i, e in enumerate(engines)]
             if self._own_workers else list(engines))
+        for w in self.workers:
+            # workers keep an explicit watchdog timeout if the caller
+            # set one; otherwise they inherit the gateway's
+            if w.watchdog_timeout_s is None:
+                w.watchdog_timeout_s = self.config.watchdog_timeout_s
+        self.retry = RetryPolicy(
+            max_retries=self.config.retry_budget,
+            backoff_base_s=self.config.retry_backoff_s,
+            backoff_cap_s=self.config.retry_backoff_cap_s,
+            seed=self.config.retry_seed)
         self.router = PrefixAffinityRouter(
-            self.workers, affinity_blocks=self.config.affinity_blocks)
+            self.workers, affinity_blocks=self.config.affinity_blocks,
+            retry=self.retry)
+        self.supervisor = FleetSupervisor(
+            self.router,
+            watchdog_timeout_s=self.config.watchdog_timeout_s,
+            interval_s=self.config.watchdog_interval_s,
+            retry=self.retry)
         self.quotas = quotas if quotas is not None else TenantQuotas(
             self.config.quota_tokens, self.config.quota_refill_per_s)
         self._httpd = None
@@ -188,6 +222,7 @@ class Gateway:
             target=self._httpd.serve_forever,
             name=f"gateway:{self.port}", daemon=True)
         self._thread.start()
+        self.supervisor.start()
         self._finalizer = weakref.finalize(self, _finalize_httpd,
                                            self._httpd)
         return self
@@ -207,12 +242,18 @@ class Gateway:
             thread.join(timeout=5.0)
 
     def shutdown(self):
-        """Full teardown: stop the listener, drain and stop every
-        worker; engines the gateway wrapped itself are closed too."""
+        """Full teardown: stop the listener and the supervisor, drain
+        and stop every worker; engines the gateway wrapped itself are
+        closed too.  A crashed/condemned replica cannot drain
+        (``WorkerDeadError``) — its streams were already failed over,
+        so teardown skips it rather than fail."""
         self.stop()
+        self.supervisor.stop()
         for w in list(self.workers):
             try:
                 w.drain()
+            except WorkerDeadError:
+                pass
             finally:
                 w.stop()
             if self._own_workers:
@@ -338,9 +379,14 @@ class Gateway:
     def admit_and_route(self, parsed, t_recv):
         """Quota gate then replica routing; returns a submitted
         :class:`StreamHandle`.  Raises :class:`_Reject` with 429
-        (quota), 503 (every replica shedding/draining), or 400
+        (quota), 503 (every replica shedding/draining, or the retry
+        budget spent on transient submit failures — Retry-After then
+        carries the NEXT backoff delay, the honest answer), or 400
         (engine-side validation, e.g. prompt+budget over max_seq_len).
-        """
+        Transient submit failures (and a replica dying between route
+        and submit) are retried up to ``retry_budget`` times with
+        capped exponential backoff and deterministic jitter,
+        re-routing every attempt."""
         cost = (len(parsed["prompt_ids"])
                 + parsed["sampling"].max_new_tokens)
         granted, retry = self.quotas.admit(parsed["tenant"], cost)
@@ -350,32 +396,53 @@ class Gateway:
                 429, f"tenant {parsed['tenant']!r} quota exhausted "
                 f"({cost} tokens requested)", "tenant_quota_exceeded",
                 "quota_exhausted", retry_after=retry)
-        worker, how = self.router.route(parsed["prompt_ids"])
-        if worker is None:
-            _GW_REJECTS.inc(reason="shed")
-            raise _Reject(
-                503, "every replica is unhealthy (SLO burn) or "
-                "draining; retry shortly", "service_unavailable",
-                "slo_shedding",
-                retry_after=self.config.shed_retry_after_s)
-        try:
-            handle = worker.submit(
-                parsed["prompt_ids"], sampling=parsed["sampling"],
-                priority=parsed["priority"],
-                deadline_s=parsed["deadline_s"],
-                tenant=parsed["tenant"],
-                trace_args={"tenant": parsed["tenant"],
-                            "priority": parsed["priority"],
-                            "hop_s": round(time.monotonic() - t_recv,
-                                           6)})
-        except ValueError as e:
-            _GW_REJECTS.inc(reason="invalid")
-            raise _Reject(400, str(e), "invalid_request_error") from None
-        except RuntimeError as e:
-            _GW_REJECTS.inc(reason="shed")
-            raise _Reject(
-                503, str(e), "service_unavailable", "replica_draining",
-                retry_after=self.config.shed_retry_after_s) from None
+        ordinal = self.router.next_ordinal()
+        attempt = 0
+        while True:
+            worker, how = self.router.route(parsed["prompt_ids"])
+            if worker is None:
+                _GW_REJECTS.inc(reason="shed")
+                raise _Reject(
+                    503, "every replica is unhealthy (SLO burn) or "
+                    "draining; retry shortly", "service_unavailable",
+                    "slo_shedding",
+                    retry_after=self.config.shed_retry_after_s)
+            try:
+                handle = worker.submit(
+                    parsed["prompt_ids"], sampling=parsed["sampling"],
+                    priority=parsed["priority"],
+                    deadline_s=parsed["deadline_s"],
+                    tenant=parsed["tenant"],
+                    trace_args={"tenant": parsed["tenant"],
+                                "priority": parsed["priority"],
+                                "hop_s": round(
+                                    time.monotonic() - t_recv, 6)})
+            except ValueError as e:
+                _GW_REJECTS.inc(reason="invalid")
+                raise _Reject(400, str(e),
+                              "invalid_request_error") from None
+            except (TransientSubmitError, WorkerDeadError,
+                    TimeoutError) as e:
+                if attempt >= self.retry.max_retries:
+                    _GW_REJECTS.inc(reason="retry_budget")
+                    raise _Reject(
+                        503, f"submit failed after {attempt + 1} "
+                        f"attempts: {e}", "service_unavailable",
+                        "retry_budget_exhausted",
+                        retry_after=self.retry.delay(
+                            ordinal, attempt + 1)) from None
+                _SRV_RETRIES.inc(replica=worker.name)
+                time.sleep(self.retry.delay(ordinal, attempt))
+                attempt += 1
+                continue
+            except RuntimeError as e:
+                _GW_REJECTS.inc(reason="shed")
+                raise _Reject(
+                    503, str(e), "service_unavailable",
+                    "replica_draining",
+                    retry_after=self.config.shed_retry_after_s) \
+                    from None
+            break
         _GW_ROUTED.inc(replica=worker.name, affinity=how)
         return handle
 

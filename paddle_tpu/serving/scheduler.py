@@ -221,6 +221,21 @@ class Scheduler:
             out.append(self.queue.popleft())
         return out
 
+    def shed_victims(self, max_queue):
+        """Load-shedding selection (the degradation ladder's level 3):
+        the queued requests to drop so at most ``max_queue`` remain —
+        lowest priority first, newest first within a priority, and
+        never a ``resumed`` request (its tokens are already streamed to
+        a client; shedding it would break the zero-dropped-tokens
+        contract).  Pure selection: the victims are still queued when
+        this returns — the caller aborts them, which removes them."""
+        excess = len(self.queue) - max(0, int(max_queue))
+        if excess <= 0:
+            return []
+        sheddable = [r for r in self.queue if not r.resumed]
+        sheddable.sort(key=lambda r: (r.priority, -r.request_id))
+        return sheddable[:excess]
+
     def pop_batch(self, free_slots, bucket_of=None, window=None):
         """Pop one co-bucketed admission batch of up to ``free_slots``
         requests.

@@ -1,0 +1,611 @@
+"""Fault-tolerance tests: deterministic fault plans and replay,
+mid-stream replica failover with bitwise-seamless resume (greedy AND
+seeded), the worker watchdog, typed dead-worker errors, retry/backoff
+determinism, the graceful-degradation ladder, and a chaos run that
+reconciles every injected fault against the recovery it caused."""
+
+import queue
+import time
+
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import GPTConfig, GPTForCausalLM
+from paddle_tpu.serving import (
+    Engine, EngineConfig, SamplingParams,
+)
+from paddle_tpu.serving.faults import (
+    DEGRADE_LEVELS, FAULT_CRASH, FAULT_EXCEPTION, FAULT_POOL_EXHAUSTED,
+    FAULT_STALL, FAULT_SUBMIT_FAIL, SITE_ENGINE_ADMIT,
+    SITE_WORKER_DISPATCH, SITE_WORKER_SUBMIT, DispatchFault,
+    FaultInjector, FaultPlan, FaultSpec, RetryPolicy,
+    TransientSubmitError, WorkerCrash, WorkerDeadError,
+)
+from paddle_tpu.serving.gateway import (
+    EngineWorker, FleetSupervisor, PrefixAffinityRouter,
+)
+
+TINY = GPTConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                 num_hidden_layers=2, num_attention_heads=4,
+                 max_position_embeddings=64)
+
+PROMPT = list(range(1, 9))
+GREEDY = SamplingParams(max_new_tokens=24)
+SEEDED = SamplingParams(temperature=0.8, top_k=20, seed=11,
+                        max_new_tokens=24)
+
+
+def _model(seed=0):
+    paddle.seed(seed)
+    m = GPTForCausalLM(TINY)
+    m.eval()
+    return m
+
+
+def _cfg(**kw):
+    kw.setdefault("num_slots", 4)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("max_horizon", 4)
+    return EngineConfig(**kw)
+
+
+def _reference(prompt, samp):
+    """The uninterrupted single-engine stream every failover run must
+    reproduce bitwise."""
+    eng = Engine(_model(0), _cfg(), register_profiler=False)
+    req = eng.submit(prompt, samp)
+    while eng.scheduler.has_work:
+        eng.step()
+    eng.close()
+    return list(req.output_ids)
+
+
+def _fleet(n, **cfg_kw):
+    workers = [
+        EngineWorker(Engine(_model(0), _cfg(**cfg_kw),
+                            register_profiler=False), name=f"r{i}")
+        for i in range(n)]
+    return workers, PrefixAffinityRouter(workers, retry=RetryPolicy())
+
+
+def _warm(workers, seeded=False):
+    """Run a request through each replica so compile caches are hot
+    before a test arms a tight watchdog (a cold XLA compile would be
+    indistinguishable from a hung dispatch).  ``seeded`` additionally
+    compiles the seeded-sampling decode program and the prefill bucket
+    failover resumes land in."""
+    for w in workers:
+        h = w.submit(list(range(30, 36)),
+                     sampling=SamplingParams(max_new_tokens=3))
+        _drain(h)
+        if seeded:
+            h = w.submit(list(range(50, 62)),
+                         sampling=SamplingParams(max_new_tokens=3,
+                                                 temperature=0.7,
+                                                 top_k=16, seed=1))
+            _drain(h)
+
+
+def _drain(h, timeout=180.0):
+    """Consume a StreamHandle to its terminal event; returns
+    (tokens, finish_reason)."""
+    got, deadline = [], time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            kind, val = h.events.get(timeout=0.5)
+        except queue.Empty:
+            continue
+        if kind == "tokens":
+            got.extend(val)
+        else:
+            return got, val
+    raise TimeoutError(f"stream {h.request_id} did not finish")
+
+
+def _shutdown(workers, sup=None):
+    if sup is not None:
+        sup.stop()
+    for w in workers:
+        if w.alive:
+            w.stop()
+
+
+# ---------------------------------------------------------------- fault plans
+class TestFaultPlan:
+    def test_spec_validation(self):
+        with pytest.raises(ValueError):
+            FaultSpec("no.such.site", FAULT_CRASH, at=0)
+        with pytest.raises(ValueError):
+            FaultSpec(SITE_WORKER_SUBMIT, FAULT_CRASH, at=0)  # wrong site
+        with pytest.raises(ValueError):
+            FaultSpec(SITE_WORKER_DISPATCH, FAULT_CRASH, at=-1)
+        with pytest.raises(ValueError):
+            FaultSpec(SITE_WORKER_DISPATCH, FAULT_CRASH, at=0, times=0)
+
+    def test_spec_matching_window_and_scope(self):
+        s = FaultSpec(SITE_WORKER_DISPATCH, FAULT_EXCEPTION, at=2,
+                      scope="r1", times=3)
+        assert not s.matches("r1", SITE_WORKER_DISPATCH, 1)
+        assert all(s.matches("r1", SITE_WORKER_DISPATCH, n)
+                   for n in (2, 3, 4))
+        assert not s.matches("r1", SITE_WORKER_DISPATCH, 5)
+        assert not s.matches("r0", SITE_WORKER_DISPATCH, 2)
+        wild = FaultSpec(SITE_WORKER_DISPATCH, FAULT_EXCEPTION, at=0)
+        assert wild.matches("anything", SITE_WORKER_DISPATCH, 0)
+
+    def test_injector_raises_by_kind_and_records(self):
+        inj = FaultInjector(FaultPlan([
+            FaultSpec(SITE_WORKER_DISPATCH, FAULT_EXCEPTION, at=1),
+            FaultSpec(SITE_WORKER_DISPATCH, FAULT_STALL, at=2),
+            FaultSpec(SITE_WORKER_SUBMIT, FAULT_SUBMIT_FAIL, at=0),
+            FaultSpec(SITE_ENGINE_ADMIT, FAULT_POOL_EXHAUSTED, at=0),
+        ]))
+        assert inj.fire(SITE_WORKER_DISPATCH, scope="a") is None
+        with pytest.raises(DispatchFault):
+            inj.fire(SITE_WORKER_DISPATCH, scope="a")
+        spec = inj.fire(SITE_WORKER_DISPATCH, scope="a")
+        assert spec.kind == FAULT_STALL      # returned, not raised
+        with pytest.raises(TransientSubmitError):
+            inj.fire(SITE_WORKER_SUBMIT, scope="a")
+        assert inj.fire(SITE_ENGINE_ADMIT,
+                        scope="a").kind == FAULT_POOL_EXHAUSTED
+        assert inj.counts() == {FAULT_EXCEPTION: 1, FAULT_STALL: 1,
+                                FAULT_SUBMIT_FAIL: 1,
+                                FAULT_POOL_EXHAUSTED: 1}
+
+    def test_ordinals_are_scope_independent(self):
+        inj = FaultInjector(FaultPlan([
+            FaultSpec(SITE_WORKER_DISPATCH, FAULT_CRASH, at=1,
+                      scope="r0")]))
+        # r1's visits never advance r0's ordinal
+        for _ in range(5):
+            assert inj.fire(SITE_WORKER_DISPATCH, scope="r1") is None
+        assert inj.fire(SITE_WORKER_DISPATCH, scope="r0") is None
+        with pytest.raises(WorkerCrash):
+            inj.fire(SITE_WORKER_DISPATCH, scope="r0")
+
+    def test_replay_is_bitwise(self):
+        plan = FaultPlan.chaos(seed=42, scopes=("r0", "r1", "r2"))
+
+        def run():
+            inj = FaultInjector(plan)
+            for scope in ("r0", "r1", "r2"):
+                for site in (SITE_WORKER_DISPATCH, SITE_WORKER_SUBMIT,
+                             SITE_ENGINE_ADMIT):
+                    for _ in range(30):
+                        try:
+                            inj.fire(site, scope=scope)
+                        except Exception:
+                            pass
+            return list(inj.fired)
+
+        assert run() == run()
+
+    def test_chaos_schedule_determinism_and_safety(self):
+        a = FaultPlan.chaos(seed=7, scopes=("r0", "r1"))
+        b = FaultPlan.chaos(seed=7, scopes=("r0", "r1"))
+        assert a.specs == b.specs
+        assert a.specs != FaultPlan.chaos(seed=8,
+                                          scopes=("r0", "r1")).specs
+        # at most one fatal fault per scope: a plan that kills every
+        # replica proves nothing about recovery
+        fatal = {}
+        for s in a.specs:
+            if s.kind in (FAULT_CRASH, FAULT_STALL):
+                fatal[s.scope] = fatal.get(s.scope, 0) + 1
+        assert all(n <= 1 for n in fatal.values())
+        doc = a.to_json()
+        assert doc["seed"] == 7
+        assert len(doc["specs"]) == len(a.specs)
+
+
+class TestRetryPolicy:
+    def test_deterministic_jitter(self):
+        p = RetryPolicy(seed=3)
+        assert p.delay(5, 1) == RetryPolicy(seed=3).delay(5, 1)
+        assert p.delay(5, 1) != p.delay(6, 1)      # no thundering herd
+        assert p.delay(5, 1) != RetryPolicy(seed=4).delay(5, 1)
+
+    def test_capped_exponential_bounds(self):
+        p = RetryPolicy(backoff_base_s=0.1, backoff_cap_s=0.4)
+        for attempt in range(6):
+            want = min(0.4, 0.1 * 2 ** attempt)
+            d = p.delay(0, attempt)
+            assert want * 0.5 <= d < want
+
+
+# ----------------------------------------------------------- engine-level
+class TestEngineFaults:
+    def test_pool_exhausted_defers_admission_bitwise(self):
+        ref = _reference(PROMPT, GREEDY)
+        eng = Engine(_model(0), _cfg(), register_profiler=False)
+        eng.install_faults(FaultInjector(FaultPlan([
+            FaultSpec(SITE_ENGINE_ADMIT, FAULT_POOL_EXHAUSTED, at=0)])),
+            scope="e0")
+        req = eng.submit(PROMPT, GREEDY)
+        eng.step()
+        # the injected dry pool deferred the whole admission pass
+        assert eng._admit_deferred
+        assert not req.output_ids
+        while eng.scheduler.has_work:
+            eng.step()
+        assert list(req.output_ids) == ref
+        assert eng.pool.blocks_in_use == 0
+        eng.close()
+
+
+class TestDegradationLadder:
+    def _burning(self, eng, burn=True):
+        for _ in range(8):
+            eng.slo.observe("ttft", 1.0 if burn else 0.0)
+
+    def test_escalation_recovery_and_hysteresis(self):
+        eng = Engine(_model(0),
+                     _cfg(slo_ttft_s=0.01, slo_fast_window=4,
+                          slo_slow_window=4, degrade_patience=2,
+                          degrade_recover_patience=3),
+                     register_profiler=False)
+        self._burning(eng)
+        assert not eng.slo.healthy
+        for want in (1, 2, 3):
+            eng._update_degradation()
+            assert eng._degrade_level == want - 1   # patience not met
+            eng._update_degradation()
+            assert eng._degrade_level == want
+        assert DEGRADE_LEVELS[eng._degrade_level] == "shed"
+        # the ladder tops out
+        for _ in range(4):
+            eng._update_degradation()
+        assert eng._degrade_level == 3
+        # level >= 1 turns speculation off, level >= 2 pins horizon 1
+        assert eng._resolve_spec_k() == 0
+        assert eng._resolve_horizon() == 1
+        # recovery is slower than escalation (hysteresis) ...
+        self._burning(eng, burn=False)
+        assert eng.slo.healthy
+        eng._update_degradation()
+        eng._update_degradation()
+        assert eng._degrade_level == 3
+        eng._update_degradation()
+        assert eng._degrade_level == 2
+        # ... and one burning step resets the calm streak entirely
+        eng._update_degradation()
+        eng._update_degradation()
+        self._burning(eng)
+        eng._update_degradation()
+        self._burning(eng, burn=False)
+        eng._update_degradation()
+        eng._update_degradation()
+        assert eng._degrade_level == 2
+        eng._update_degradation()
+        assert eng._degrade_level == 1
+        hist = eng._degrade_history
+        assert [h["reason"] for h in hist[:3]] == ["slo_burn"] * 3
+        assert hist[-1]["reason"] == "recovered"
+        assert eng.counters()["degradation_level"] == 1
+        eng.close()
+
+    def test_level3_sheds_lowest_priority_never_resumed(self):
+        r_prompt = list(range(40, 48))
+        r_samp = SamplingParams(max_new_tokens=8)
+        # the true first token of the resumed stream — the resume path
+        # asserts the re-sampled boundary token matches it bitwise
+        first = _reference(r_prompt, r_samp)[0]
+        eng = Engine(_model(0), _cfg(num_slots=2),
+                     register_profiler=False)
+        samp = SamplingParams(max_new_tokens=4)
+        keep = eng.submit(list(range(10, 18)), samp, priority=2)
+        low = eng.submit(list(range(20, 28)), samp, priority=0)
+        resumed = eng.submit(r_prompt, r_samp, priority=0,
+                             resume_ids=[first])
+        eng._set_degrade_level(3, "test")
+        eng.admit()
+        # queue shed down to num_slots: the lowest-priority fresh
+        # request goes first; the resumed one is immune (its tokens
+        # are already on the wire)
+        assert low.finish_reason == "abort"
+        assert keep.finish_reason is None
+        assert resumed.finish_reason is None
+        assert eng.counters()["degradation_sheds"] == 1
+        while eng.scheduler.has_work:
+            eng.step()
+        assert len(keep.output_ids) == 4
+        assert len(resumed.output_ids) == 8
+        assert eng.pool.blocks_in_use == 0
+        eng.close()
+
+
+# ------------------------------------------------------------- dead workers
+class TestWorkerDeath:
+    def test_crashed_worker_typed_errors_and_closed_books(self):
+        w = EngineWorker(Engine(_model(0), _cfg(),
+                                register_profiler=False), name="rd")
+        w.set_faults(FaultInjector(FaultPlan([
+            FaultSpec(SITE_WORKER_DISPATCH, FAULT_CRASH, at=0)])))
+        h = w.submit(PROMPT, sampling=SamplingParams(max_new_tokens=8))
+        w._thread.join(60)
+        assert not w._thread.is_alive()
+        assert w.crashed and isinstance(w._crash_error, WorkerCrash)
+        assert not w.healthy
+        # typed, prompt errors instead of hangs (the old behaviour)
+        with pytest.raises(WorkerDeadError):
+            w.drain()
+        with pytest.raises(WorkerDeadError):
+            w.submit(PROMPT)
+        t0 = time.monotonic()
+        w.stop()                                    # no-op, returns now
+        assert time.monotonic() - t0 < 1.0
+        # the dying thread closed its engine's books: the in-flight
+        # request was aborted (trace closure) and every block released
+        assert h.request.finish_reason == "abort"
+        assert w.engine.pool.blocks_in_use == 0
+        assert w.stats()["worker"]["crashed"]
+
+
+# ----------------------------------------------------------------- failover
+class TestFailover:
+    def _crash_run(self, samp):
+        ref = _reference(PROMPT, samp)
+        workers, router = _fleet(2)
+        sup = FleetSupervisor(router, watchdog_timeout_s=None,
+                              interval_s=0.05)
+        try:
+            _warm(workers)
+            target, _ = router.route(PROMPT)
+            inj = FaultInjector(FaultPlan([
+                FaultSpec(SITE_WORKER_DISPATCH, FAULT_CRASH, at=2)]))
+            target.set_faults(inj)
+            sup.start()
+            h, w0, _ = router.submit(PROMPT, sampling=samp)
+            assert w0 is target
+            got, fin = _drain(h)
+            assert fin == "length"
+            assert got == ref                    # bitwise-seamless
+            assert h.failovers == 1
+            assert h.worker is not target
+            assert sup.failovers == 1 and sup.failover_failures == 0
+            assert sup.condemned == [(target.name, "crash")]
+            assert inj.counts() == {FAULT_CRASH: 1}
+            # the adopting engine's flight record shows the seam
+            c = h.request.trace.counts()
+            assert c["failovers"] == 1
+            assert 0 < c["resumed_tokens"] < len(ref)
+            # the resumed tokens are NOT double-counted as emitted
+            assert c["resumed_tokens"] + c["tokens_emitted"] == len(ref)
+            # survivors leak nothing
+            h.worker.drain()
+            assert h.worker.engine.pool.blocks_in_use == 0
+        finally:
+            _shutdown(workers, sup)
+
+    def test_mid_stream_crash_failover_greedy_bitwise(self):
+        self._crash_run(GREEDY)
+
+    def test_mid_stream_crash_failover_seeded_bitwise(self):
+        self._crash_run(SEEDED)
+
+    def test_watchdog_condemns_stalled_worker_and_fails_over(self):
+        ref = _reference(PROMPT, GREEDY)
+        workers, router = _fleet(2)
+        sup = FleetSupervisor(router, watchdog_timeout_s=None,
+                              interval_s=0.05)
+        try:
+            _warm(workers)
+            target, _ = router.route(PROMPT)
+            target.set_faults(FaultInjector(FaultPlan([
+                FaultSpec(SITE_WORKER_DISPATCH, FAULT_STALL, at=2)])))
+            # tight leash on the stall target only — survivors may
+            # still be compiling the resume bucket
+            target.watchdog_timeout_s = 0.3
+            sup.start()
+            h, w0, _ = router.submit(PROMPT, sampling=GREEDY)
+            assert w0 is target
+            got, fin = _drain(h)
+            assert (got, fin) == (ref, "length")
+            assert sup.condemned == [(target.name, "watchdog_stall")]
+            assert sup.failovers == 1
+            # the condemned stall raised out: the thread is dead and
+            # closed its engine's books (serving.* provider included)
+            target._thread.join(30)
+            assert target.crashed
+            assert target.engine.pool.blocks_in_use == 0
+        finally:
+            _shutdown(workers, sup)
+
+    def test_abort_during_failover_cancels_redispatch(self):
+        workers, router = _fleet(2)
+        sup = FleetSupervisor(router, watchdog_timeout_s=None)
+        try:
+            _warm(workers)
+            target, _ = router.route(PROMPT)
+            inj = FaultInjector(FaultPlan([
+                FaultSpec(SITE_WORKER_DISPATCH, FAULT_STALL, at=2)]))
+            target.set_faults(inj)
+            h, w0, _ = router.submit(PROMPT, sampling=GREEDY)
+            deadline = time.monotonic() + 60
+            while not inj.fired and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert inj.fired[0][2] == FAULT_STALL
+            # drive the condemnation by hand so the client abort can
+            # land exactly between claim and re-dispatch
+            target._condemned = True
+            pending = target.take_pending()
+            assert h.failing_over and h.request_id in pending
+            h.abort()                      # client hangs up mid-swap
+            assert h.abort_requested
+            sup._failover(h, target, "watchdog_stall")
+            got, fin = _drain(h)
+            assert fin == "abort"
+            assert sup.failovers == 0      # re-dispatch was cancelled
+        finally:
+            _shutdown(workers, sup)
+
+    def test_abort_after_failover_routes_to_adopting_replica(self):
+        workers, router = _fleet(2)
+        sup = FleetSupervisor(router, watchdog_timeout_s=None,
+                              interval_s=0.05)
+        try:
+            _warm(workers)
+            target, _ = router.route(PROMPT)
+            target.set_faults(FaultInjector(FaultPlan([
+                FaultSpec(SITE_WORKER_DISPATCH, FAULT_CRASH, at=1)])))
+            sup.start()
+            h, w0, _ = router.submit(
+                PROMPT, sampling=SamplingParams(max_new_tokens=48))
+            deadline = time.monotonic() + 120
+            while h.failovers == 0 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert h.failovers == 1
+            # the abort API of the DEAD original worker still lands on
+            # whichever replica holds the request now
+            w0.abort(h)
+            got, fin = _drain(h)
+            assert fin in ("abort", "length")
+        finally:
+            _shutdown(workers, sup)
+
+    def test_finished_resume_history_is_finished_directly(self):
+        workers, router = _fleet(2)
+        sup = FleetSupervisor(router, watchdog_timeout_s=None)
+        try:
+            samp = SamplingParams(max_new_tokens=4)
+            h = workers[0].submit(PROMPT, sampling=samp)
+            got, fin = _drain(h)
+            assert fin == "length" and len(got) == 4
+            # reconstruct the race: the worker died after flushing the
+            # last token but before the finish event reached the client
+            h.failing_over = True
+            sup._failover(h, workers[0], "crash")
+            assert h.events.get(timeout=5) == ("finish", "length")
+            assert sup.failovers == 1      # counted, but no re-decode
+        finally:
+            _shutdown(workers, sup)
+
+
+# ------------------------------------------------------------- router retry
+class TestRouterRetry:
+    def test_transient_submit_retried_to_success(self):
+        workers, router = _fleet(2)
+        try:
+            _warm(workers)
+            inj = FaultInjector(FaultPlan([
+                FaultSpec(SITE_WORKER_SUBMIT, FAULT_SUBMIT_FAIL, at=0)]))
+            for w in workers:
+                w.set_faults(inj)
+            h, w, _ = router.submit(PROMPT, sampling=GREEDY)
+            got, fin = _drain(h)
+            assert fin == "length" and len(got) == 24
+            assert inj.counts()[FAULT_SUBMIT_FAIL] >= 1
+        finally:
+            _shutdown(workers)
+
+    def test_spent_budget_propagates_typed_error(self):
+        workers, router = _fleet(2)
+        router.retry = RetryPolicy(max_retries=1, backoff_base_s=0.001)
+        try:
+            _warm(workers)
+            inj = FaultInjector(FaultPlan([
+                FaultSpec(SITE_WORKER_SUBMIT, FAULT_SUBMIT_FAIL, at=0,
+                          times=100)]))
+            for w in workers:
+                w.set_faults(inj)
+            with pytest.raises(TransientSubmitError):
+                router.submit(PROMPT, sampling=GREEDY)
+            # budget: initial attempt + max_retries
+            assert inj.counts()[FAULT_SUBMIT_FAIL] == 2
+        finally:
+            _shutdown(workers)
+
+
+# -------------------------------------------------------------------- chaos
+@pytest.mark.slow
+class TestChaos:
+    def test_chaos_run_reconciles_and_leaks_nothing(self):
+        """Crash + stall + transient submits + a dry-pool admission
+        over 16 concurrent requests on 3 replicas: every stream
+        finishes bitwise-correct, every injected fault reconciles
+        against the recovery it caused, and survivors leak zero
+        blocks."""
+        n_req = 16
+        prompts = [[(7 * i + j) % 96 + 1 for j in range(8)]
+                   for i in range(n_req)]
+        samps = [SamplingParams(max_new_tokens=8 + (i % 3) * 4,
+                                **({} if i % 2 == 0 else
+                                   dict(temperature=0.7, top_k=16,
+                                        seed=100 + i)))
+                 for i in range(n_req)]
+        refs = {}
+        ref_eng = Engine(_model(0), _cfg(num_slots=4),
+                         register_profiler=False)
+        for p, s in zip(prompts, samps):
+            req = ref_eng.submit(p, s)
+            while ref_eng.scheduler.has_work:
+                ref_eng.step()
+            refs[tuple(p)] = list(req.output_ids)
+        ref_eng.close()
+
+        workers, router = _fleet(3)
+        inj = FaultInjector(FaultPlan([
+            FaultSpec(SITE_WORKER_DISPATCH, FAULT_CRASH, at=3,
+                      scope="r0"),
+            FaultSpec(SITE_WORKER_DISPATCH, FAULT_STALL, at=4,
+                      scope="r1"),
+            FaultSpec(SITE_WORKER_DISPATCH, FAULT_EXCEPTION, at=2,
+                      scope="r2"),
+            FaultSpec(SITE_WORKER_SUBMIT, FAULT_SUBMIT_FAIL, at=4,
+                      scope="r2", times=2),
+            FaultSpec(SITE_ENGINE_ADMIT, FAULT_POOL_EXHAUSTED, at=1,
+                      scope="r2"),
+        ]))
+        sup = FleetSupervisor(router, watchdog_timeout_s=None,
+                              interval_s=0.05)
+        try:
+            _warm(workers, seeded=True)
+            # leash on the stall target: comfortably above any residual
+            # compile (the seeded warm-up covered the big programs) but
+            # short enough that the frozen-heartbeat stall is caught
+            workers[1].watchdog_timeout_s = 5.0
+            for w in workers:
+                w.set_faults(inj)
+            sup.start()
+            handles = []
+            # pin the first six 2-per-replica so every replica holds
+            # in-flight work when its fault fires; route the rest
+            for i in range(6):
+                handles.append(workers[i % 3].submit(
+                    prompts[i], sampling=samps[i]))
+            for i in range(6, n_req):
+                h, _, _ = router.submit(prompts[i], sampling=samps[i])
+                handles.append(h)
+            for p, s, h in zip(prompts, samps, handles):
+                got, fin = _drain(h)
+                assert fin in ("length", "eos")
+                assert got == refs[tuple(p)], (
+                    f"stream diverged for prompt {p}")
+            # reconciliation: the injected fatal faults each condemned
+            # exactly one replica, every adopted stream is counted, and
+            # the transient faults were absorbed (retried), not fatal
+            fired = inj.counts()
+            assert fired[FAULT_CRASH] == 1 and fired[FAULT_STALL] == 1
+            assert fired.get(FAULT_SUBMIT_FAIL, 0) >= 1
+            assert sorted(r for _, r in sup.condemned) == [
+                "crash", "watchdog_stall"]
+            assert sup.failovers == sum(h.failovers for h in handles)
+            assert sup.failovers >= 2       # both fatals held work
+            assert sup.failover_failures == 0
+            assert workers[2]._dispatch_faults == 1
+            # survivors: drain clean, zero leaked blocks, and their
+            # flight records reconcile with their engine counters
+            for w in workers:
+                if not w.alive:
+                    continue
+                w.drain()
+                assert w.engine.pool.blocks_in_use == 0
+                eng = w.engine
+                emitted = sum(
+                    t.counts()["tokens_emitted"]
+                    for t in (eng.recorder.recent() + eng.recorder.live())
+                    if t.engine == eng._profiler_name)
+                assert emitted == eng.counters()["tokens_generated"]
+        finally:
+            _shutdown(workers, sup)

@@ -470,11 +470,15 @@ class TestFlightRecorder:
         tr.add(tracing.PREEMPT)
         tr.add(tracing.RESUME, prefix_hit_tokens=6)
         tr.add(tracing.DECODE, tokens=2, accepted=0, horizon=2)
+        tr.add(tracing.FAILOVER, from_replica="r0", resumed_tokens=6)
         tr.add(tracing.FINISH, reason="length")
         c = tr.counts()
+        # resumed tokens are NOT tokens_emitted: per-engine trace sums
+        # must still reconcile against engine counters exactly
         assert c == {"tokens_emitted": 6, "prefix_hit_tokens": 6,
                      "preemptions": 1, "decode_horizons": 2,
                      "spec_accepted_tokens": 2, "aborted": 0,
+                     "failovers": 1, "resumed_tokens": 6,
                      "flops_est": 0.0, "bytes_est": 0.0}
         assert tr.finished
         # monotonic event times
