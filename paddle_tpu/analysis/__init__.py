@@ -1,7 +1,7 @@
 """paddle_tpu.analysis — trace-safety linter + graph doctor for to_static
 programs (ref: the dy2static error/validation layer, SURVEY.md §2.1–2.2).
 
-Three passes share one structured-diagnostic engine:
+Phase-1 passes share one structured-diagnostic engine:
 
 - ``check(fn)`` / ``lint_source`` / ``lint_file``: AST trace-safety
   linting WITHOUT running the function (unconvertible constructs,
@@ -9,7 +9,22 @@ Three passes share one structured-diagnostic engine:
 - ``doctor(fn, *example_args)`` / ``diagnose_program`` /
   ``diagnose_jaxpr``: post-build graph analysis (dead nodes, unused
   feeds, dtype widening, host syncs, unbound collective axes).
-- ``python -m paddle_tpu.analysis <path>``: the package self-lint CLI.
+- ``python -m paddle_tpu.analysis <path>``: the package self-lint CLI
+  (exit contract: 0 clean / 1 findings / 2 internal error).
+
+Phase 2 adds the serving-stack verifiers (``--serving`` on the CLI):
+
+- ``serving_check(obj)`` / ``serving_lint``: thread-ownership and
+  lock-discipline lint (PTA51x) — engine/pool/store mutation outside
+  the owning worker thread, unlocked StreamHandle mutation, blocking
+  under a lock, wall-clock in fault paths, undisciplined threads.
+- ``diagnose_donation(fn, *args)`` / ``donation_doctor``: jaxpr-level
+  donation doctor (PTA60x) — use-after-donate, double donation,
+  donated buffers never rebound into engine state.
+- ``check_balance`` / ``check_census`` / ``collective_balance``:
+  collective-balance checker (PTA70x) — cond-branch census imbalance,
+  collectives in unbounded loops, unbound axes, census drift vs the
+  registered expected-census formulas.
 
 Every finding is a ``Diagnostic{code, severity, file, line, message,
 hint}`` with a stable PTA rule code (see ``RULES`` and docs/PARITY.md);
@@ -17,15 +32,21 @@ hint}`` with a stable PTA rule code (see ``RULES`` and docs/PARITY.md);
 """
 
 from .diagnostics import (Diagnostic, Rule, RULES, TraceSafetyWarning,
-                          ERROR, WARNING, INFO)
+                          ERROR, WARNING, INFO, apply_noqa_files)
 from .trace_lint import check, lint_source, lint_file
 from .graph_doctor import doctor, diagnose_program, diagnose_jaxpr
+from .serving_lint import serving_check
+from .donation_doctor import diagnose_donation
+from .collective_balance import (check_balance, check_census,
+                                 register_expected_census)
 from .cli import main
 
 __all__ = [
     "Diagnostic", "Rule", "RULES", "TraceSafetyWarning",
-    "ERROR", "WARNING", "INFO",
+    "ERROR", "WARNING", "INFO", "apply_noqa_files",
     "check", "lint_source", "lint_file",
     "doctor", "diagnose_program", "diagnose_jaxpr",
+    "serving_check", "diagnose_donation",
+    "check_balance", "check_census", "register_expected_census",
     "main",
 ]

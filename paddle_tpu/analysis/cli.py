@@ -1,16 +1,34 @@
 """`python -m paddle_tpu.analysis <file-or-package> [...]` — lint python
 sources for trace-safety and library self-lint findings.
 
-Exit status: 0 when no error-severity diagnostics, 1 otherwise (warnings
-and infos print but do not fail the run), 2 on usage errors. `--strict`
-fails on warnings too; `--mode trace` treats EVERY function as traced
-(the default `package` mode applies trace rules only under `to_static`
-decorators and self-lint rules everywhere).
+Exit-code contract (stable — CI depends on it, don't grep rendered
+text):
+
+- **0** — clean: no error-severity diagnostics (no warnings either
+  under ``--strict``).
+- **1** — findings: at least one unsuppressed error (or warning with
+  ``--strict``).
+- **2** — internal/usage error: bad arguments, missing paths, or an
+  analyzer crash.  Never means "findings".
+
+``--serving`` adds the phase-2 serving-stack analyzers (thread-
+ownership/lock-discipline lint PTA51x and the AST half of the donation
+doctor PTA60x) on top of the trace lint.  ``--json`` replaces the
+rendered report with one JSON object on stdout::
+
+    {"files": N, "errors": N, "warnings": N,
+     "diagnostics": [{"code", "severity", "file", "line",
+                      "message", "hint"}, ...]}
+
+`--mode trace` treats EVERY function as traced (the default `package`
+mode applies trace rules only under `to_static` decorators and
+self-lint rules everywhere).
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 
@@ -32,38 +50,87 @@ def _iter_py_files(path):
                 yield os.path.join(root, f)
 
 
-def main(argv=None):
-    ap = argparse.ArgumentParser(
-        prog="python -m paddle_tpu.analysis",
-        description="trace-safety linter for to_static programs")
-    ap.add_argument("paths", nargs="+",
-                    help="python files or package directories")
-    ap.add_argument("--mode", choices=("package", "trace"),
-                    default="package",
-                    help="package: trace rules only under @to_static; "
-                         "trace: every function is assumed traced")
-    ap.add_argument("--strict", action="store_true",
-                    help="exit nonzero on warnings as well as errors")
-    ap.add_argument("--no-hint", action="store_true",
-                    help="omit hint lines from the report")
-    args = ap.parse_args(argv)
+def _run(args):
+    if args.serving:
+        from . import donation_doctor, serving_lint
 
-    n_err = n_warn = n_files = 0
+    # Dedupe across overlapping path args (e.g. `paddle_tpu/serving/
+    # paddle_tpu/serving/gateway/`) so no file is linted — or counted —
+    # twice.
+    seen = set()
+    files = []
     for path in args.paths:
         if not os.path.exists(path):
             print(f"paddle_tpu.analysis: no such path: {path}",
                   file=sys.stderr)
             return 2
         for f in _iter_py_files(path):
-            n_files += 1
-            for d in lint_file(f, mode=args.mode):
+            key = os.path.realpath(f)
+            if key not in seen:
+                seen.add(key)
+                files.append(f)
+
+    n_err = n_warn = 0
+    collected = []
+    for f in files:
+        diags = list(lint_file(f, mode=args.mode))
+        if args.serving:
+            diags.extend(serving_lint.lint_file(f))
+            diags.extend(donation_doctor.lint_file(f))
+            diags.sort(key=lambda d: (d.file, d.line, d.code))
+        for d in diags:
+            if args.json:
+                collected.append({
+                    "code": d.code, "severity": d.severity,
+                    "file": d.file, "line": d.line,
+                    "message": d.message, "hint": d.hint,
+                })
+            else:
                 print(d.format(with_hint=not args.no_hint))
-                if d.severity == ERROR:
-                    n_err += 1
-                elif d.severity == WARNING:
-                    n_warn += 1
-    print(f"paddle_tpu.analysis: {n_files} file(s), {n_err} error(s), "
-          f"{n_warn} warning(s)")
+            if d.severity == ERROR:
+                n_err += 1
+            elif d.severity == WARNING:
+                n_warn += 1
+    if args.json:
+        json.dump({"files": len(files), "errors": n_err,
+                   "warnings": n_warn, "diagnostics": collected},
+                  sys.stdout, indent=2)
+        print()
+    else:
+        print(f"paddle_tpu.analysis: {len(files)} file(s), "
+              f"{n_err} error(s), {n_warn} warning(s)")
     if n_err or (args.strict and n_warn):
         return 1
     return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.analysis",
+        description="trace-safety linter for to_static programs "
+                    "(exit 0 clean / 1 findings / 2 internal error)")
+    ap.add_argument("paths", nargs="+",
+                    help="python files or package directories")
+    ap.add_argument("--mode", choices=("package", "trace"),
+                    default="package",
+                    help="package: trace rules only under @to_static; "
+                         "trace: every function is assumed traced")
+    ap.add_argument("--serving", action="store_true",
+                    help="also run the serving-stack analyzers "
+                         "(thread-ownership lint PTA51x, donation "
+                         "doctor PTA60x)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON report object instead of "
+                         "rendered text")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit nonzero on warnings as well as errors")
+    ap.add_argument("--no-hint", action="store_true",
+                    help="omit hint lines from the report")
+    args = ap.parse_args(argv)
+
+    try:
+        return _run(args)
+    except Exception as exc:  # exit 2: internal error, never "findings"
+        print(f"paddle_tpu.analysis: internal error: "
+              f"{type(exc).__name__}: {exc}", file=sys.stderr)
+        return 2

@@ -1,0 +1,219 @@
+"""Collective-balance checker (analysis phase 2): static rejection of
+collective-comms bugs that are invisible on the CPU proxy.
+
+Extends the PR 11 comms walker (``observability.comms``) from a
+*census* into a *verifier*.  Everything is static — ``jax.make_jaxpr``
+traces the program abstractly, no FLOPs run, no collective dispatches:
+
+- **PTA701 branch balance.**  The branches of a ``lax.cond`` must
+  issue identical ``(op, axis)`` collective censuses: on a real
+  multi-chip mesh, ranks whose predicate picks the other branch stop
+  participating and the collective deadlocks.  (jax itself permits
+  this — the deadlock only materializes on real meshes.)
+- **PTA702 unbounded-loop collectives.**  A collective inside a
+  ``lax.while_loop`` body runs a data-dependent number of times; per-
+  rank divergence deadlocks unless the predicate is replicated.  The
+  comms walker's ``unbounded_loops`` flag, promoted to a finding with
+  a source location.
+- **PTA703 unbound axes.**  A collective over an axis name bound by no
+  enclosing ``shard_map`` mesh and absent from the declared axis
+  environment.  shard_map-aware (axes its mesh binds are fine even
+  under ``lax.scan`` — the MeshEngine decode shape), so this agrees
+  with the graph doctor's PTA505 instead of double-reporting.
+- **PTA704 census drift.**  The statically-walked census is compared
+  against a registered expected-census formula — the MULTICHIP decode
+  gate (psum = L·h, all_gather = (3L+1)·h per dispatch) promoted from
+  a bench assertion into a lint that runs without executing the
+  program.  :func:`register_expected_census` holds the formulas;
+  :func:`check_census` compares.
+
+Findings carry the collective's real source location (jaxpr eqn source
+info), so ``# noqa: PTA70x`` on the flagged source line suppresses
+(via :func:`diagnostics.apply_noqa_files`).
+"""
+
+from __future__ import annotations
+
+from .diagnostics import apply_noqa_files, make
+
+__all__ = ["check_balance", "balance_jaxpr", "check_census",
+           "register_expected_census", "expected_census_registry"]
+
+
+def _comms():
+    from ..observability import comms
+
+    return comms
+
+
+def _doctor():
+    from . import graph_doctor
+
+    return graph_doctor
+
+
+def _census_of(jaxpr, bound_axes):
+    """{(op, axis): calls} for one (sub-)jaxpr, scan-multiplied — the
+    comparison key for branch balance.  Purely structural (no
+    diagnostics)."""
+    comms = _comms()
+    doctor = _doctor()
+    census = {}
+
+    def walk(j, mult):
+        for eqn in j.eqns:
+            name = eqn.primitive.name
+            canon = comms._PRIM_CANON.get(name)
+            if canon is not None:
+                for ax in doctor._axis_names(eqn.params):
+                    key = (canon, ax)
+                    census[key] = census.get(key, 0) + mult
+                continue
+            sub_mult = mult
+            if name == "scan":
+                sub_mult = mult * int(eqn.params.get("length", 1) or 1)
+            for sub in doctor._sub_jaxprs(eqn.params):
+                walk(sub, sub_mult)
+
+    walk(getattr(jaxpr, "jaxpr", jaxpr), 1)
+    return census
+
+
+def balance_jaxpr(closed_jaxpr, axis_sizes=None, file="<jaxpr>"):
+    """Walk a (Closed)Jaxpr and return balance findings
+    [Diagnostic]: PTA701 cond-branch imbalance, PTA702 collectives in
+    data-dependent while loops, PTA703 axes bound by no enclosing
+    shard_map mesh nor ``axis_sizes``."""
+    comms = _comms()
+    doctor = _doctor()
+    diags = []
+
+    def fmt(census):
+        return {f"{op}@{ax}": n
+                for (op, ax), n in sorted(census.items())} or {}
+
+    def walk(j, bound):
+        for eqn in j.eqns:
+            name = eqn.primitive.name
+            f = doctor._eqn_file(eqn, file)
+            ln = doctor._eqn_line(eqn, 0)
+            canon = comms._PRIM_CANON.get(name)
+            if canon is not None:
+                for ax in doctor._axis_names(eqn.params):
+                    if ax not in bound:
+                        diags.append(make(
+                            "PTA703", f, ln,
+                            message=f"collective {canon!r} runs over "
+                                    f"axis {ax!r}, bound by no "
+                                    "enclosing shard_map mesh (bound: "
+                                    f"{sorted(bound)})"))
+                continue
+            sub_bound = bound
+            if name == "cond":
+                branches = list(doctor._sub_jaxprs(eqn.params))
+                censuses = [_census_of(b, bound) for b in branches]
+                if censuses and any(c != censuses[0]
+                                    for c in censuses[1:]):
+                    shown = [fmt(c) for c in censuses]
+                    diags.append(make(
+                        "PTA701", f, ln,
+                        message="cond branches issue different "
+                                f"collective censuses {shown} — ranks "
+                                "taking different branches deadlock on "
+                                "a real mesh"))
+            elif name == "while":
+                for sub in doctor._sub_jaxprs(eqn.params):
+                    inner = _census_of(sub, bound)
+                    if inner:
+                        diags.append(make(
+                            "PTA702", f, ln,
+                            message="collectives "
+                                    f"{fmt(inner)} inside a while loop "
+                                    "run a data-dependent number of "
+                                    "times — per-rank divergence "
+                                    "deadlocks"))
+                        break
+            elif "shard_map" in name:
+                mesh = eqn.params.get("mesh")
+                if mesh is not None:
+                    sub_bound = bound | set(
+                        comms._mesh_axis_sizes(mesh))
+            for sub in doctor._sub_jaxprs(eqn.params):
+                walk(sub, sub_bound)
+
+    walk(getattr(closed_jaxpr, "jaxpr", closed_jaxpr),
+         set(axis_sizes or ()))
+    diags.sort(key=lambda d: (d.file, d.line, d.code))
+    return apply_noqa_files(diags)
+
+
+def check_balance(fn, *args, axis_sizes=None, axis_env=None, **kwargs):
+    """Trace ``fn(*args)`` abstractly and run :func:`balance_jaxpr`.
+    ``axis_sizes``: {axis: size} bound OUTSIDE the traced program (its
+    names also feed ``axis_env`` for tracing bare collectives)."""
+    import jax
+
+    env = axis_env
+    if env is None and axis_sizes:
+        env = [(name, int(size)) for name, size in axis_sizes.items()]
+    closed = jax.make_jaxpr(fn, axis_env=env or None)(*args, **kwargs)
+    code = getattr(fn, "__code__", None)
+    file = code.co_filename if code is not None else "<jaxpr>"
+    return balance_jaxpr(closed, axis_sizes=axis_sizes, file=file)
+
+
+# --------------------------------------------------------------------------
+# census drift (PTA704)
+
+#: name -> callable(**params) returning the expected {(op, axis): calls}
+#: census — the registered hand-derived formulas programs are gated on
+expected_census_registry = {}
+
+
+def register_expected_census(name, formula):
+    """Register a hand-derived census formula (callable returning
+    {(op, axis): calls}) under ``name`` — e.g. the MULTICHIP decode
+    census psum=L*h / all_gather=(3L+1)*h.  Returns ``formula`` so it
+    can be used as a decorator."""
+    # not a trace-time cache: registration happens at import/setup time
+    # with concrete callables — no tracer can reach this store
+    expected_census_registry[name] = formula  # noqa: PTA402
+    return formula
+
+
+def check_census(fn, args=(), expected=None, *, name=None,
+                 axis_sizes=None, formula_kwargs=None, file=None):
+    """Statically verify that ``fn(*args)``'s collective census matches
+    ``expected`` ({(op, axis): calls}) or the registered formula
+    ``name`` called with ``formula_kwargs``.  The census is computed by
+    the PR 11 comms walker (``observability.comms.analyze_jaxpr``) on
+    an abstract trace — the program is never executed.  Returns
+    [Diagnostic] — empty means the census holds exactly."""
+    import jax
+
+    if expected is None:
+        if name is None or name not in expected_census_registry:
+            raise ValueError(
+                "check_census needs `expected` or a registered formula "
+                f"`name` (known: {sorted(expected_census_registry)})")
+        expected = expected_census_registry[name](
+            **(formula_kwargs or {}))
+    env = [(ax, int(sz)) for ax, sz in (axis_sizes or {}).items()]
+    closed = jax.make_jaxpr(fn, axis_env=env or None)(*args)
+    got = _comms().analyze_jaxpr(closed,
+                                 axis_sizes=axis_sizes).counts()
+    if got == dict(expected):
+        return []
+    code = getattr(fn, "__code__", None)
+    f = file or (code.co_filename if code is not None else "<jaxpr>")
+    line = code.co_firstlineno if code is not None else 0
+
+    def fmt(census):
+        return {f"{op}@{ax}": n
+                for (op, ax), n in sorted(census.items())}
+
+    diags = [make(
+        "PTA704", f, line,
+        message=f"collective census drift: program issues {fmt(got)}, "
+                f"the registered formula expects {fmt(dict(expected))}")]
+    return apply_noqa_files(diags)

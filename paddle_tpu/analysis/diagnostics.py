@@ -18,6 +18,13 @@ Rule codes are stable identifiers (PTA = Paddle-Tpu Analysis):
 - PTA3xx  side effects under trace (mutations the staged program drops)
 - PTA4xx  repo-facing self-lint rules for library code
 - PTA5xx  graph-doctor findings on a recorded Program / traced jaxpr
+          (PTA501-505) and the serving thread-ownership / lock-discipline
+          lint (PTA510-514, serving_lint.py)
+- PTA6xx  donation-discipline findings (donation_doctor.py): use-after-
+          donate, double donation, donated state escaping rebind
+- PTA7xx  collective-balance findings (collective_balance.py): branch-
+          unbalanced collectives, unbounded-loop collectives, unbound
+          axes, census drift
 """
 
 from __future__ import annotations
@@ -26,7 +33,8 @@ import ast
 from dataclasses import dataclass, field
 
 __all__ = ["Diagnostic", "Rule", "RULES", "TraceSafetyWarning",
-           "ERROR", "WARNING", "INFO", "scan_statement"]
+           "ERROR", "WARNING", "INFO", "scan_statement",
+           "apply_noqa_files"]
 
 ERROR = "error"
 WARNING = "warning"
@@ -208,6 +216,107 @@ _RULE_LIST = [
          "check fleet topology axis names ('dp','pp','sharding','sep',"
          "'mp')",
          mirrors="ProcessGroup ring-id validation on c_* ops"),
+    # ---- PTA51x: serving thread-ownership & lock-discipline lint
+    Rule("PTA510", ERROR,
+         "engine mutation outside the owning worker thread",
+         "submit/step/abort/drain/close/adopt on an Engine (or its pool/"
+         "radix store) must run on the worker thread that owns it — the "
+         "thread-ownership doctrine: closing a live-threaded engine "
+         "segfaults through donated buffers.  Route the call through the "
+         "worker's command inbox, or suppress with `# noqa: PTA510` where "
+         "ownership was provably transferred (post drain+stop)",
+         mirrors="gateway EngineWorker ownership doctrine (PR 14)"),
+    Rule("PTA511", ERROR,
+         "StreamHandle state mutated outside `with handle.lock`",
+         "request/worker/failing_over/abort_requested/failovers are "
+         "rebound during failover under the handle lock; a bare write "
+         "races the supervisor's swap — wrap the mutation in "
+         "`with handle.lock:`",
+         mirrors="StreamHandle failover-swap atomicity (PR 14)"),
+    Rule("PTA512", WARNING,
+         "blocking call while holding a lock",
+         "queue.get()/join()/adopt()/drain()/sleep() under a held lock "
+         "can deadlock against the thread that needs the lock to make "
+         "progress — move the blocking wait outside the `with ... lock:` "
+         "block",
+         mirrors="EngineWorker inbox protocol (commands block OUTSIDE "
+                 "handle locks)"),
+    Rule("PTA513", WARNING,
+         "wall-clock read inside a fault-scheduling path",
+         "fault injection schedules by dispatch ordinal, never wall "
+         "clock, so fault runs replay deterministically — derive timing "
+         "from site-visit ordinals (FaultPlan) or seeded hashes "
+         "(RetryPolicy.delay), not time.time()/monotonic()/unseeded "
+         "random",
+         mirrors="dispatch-ordinal fault doctrine (PR 14 FaultPlan)"),
+    Rule("PTA514", WARNING,
+         "non-daemon thread with no visible join/stop",
+         "a non-daemon thread without a paired join keeps the process "
+         "alive after main exits; pass daemon=True (the fleet pattern) "
+         "or join it in a stop()/shutdown() path",
+         mirrors="gateway/telemetry daemon-thread lifecycle pattern"),
+    # ---- PTA6xx: donation doctor
+    Rule("PTA601", ERROR,
+         "use after donate: donated buffer read after dispatch",
+         "an argument donated to a compiled function is invalidated by "
+         "the dispatch; reading the host reference afterwards returns "
+         "deleted-buffer errors (or garbage on some backends) — rebind "
+         "the name from the call's outputs before any further use",
+         mirrors="jax donated-buffer invalidation / engine state-rebind "
+                 "discipline"),
+    Rule("PTA602", ERROR,
+         "double donation of one buffer",
+         "the same argument position (or the same expression in two "
+         "donated positions) is donated twice — XLA cannot alias one "
+         "input into two outputs; deduplicate donate_argnums or pass "
+         "distinct buffers",
+         mirrors="XLA input-output aliasing validation"),
+    Rule("PTA603", ERROR,
+         "donated engine state escapes the rebind discipline",
+         "a donated `self.*` buffer is not rebound from the call's "
+         "outputs (directly or via a rebind method on its owner) before "
+         "the function returns — live engine state now points at a "
+         "donated buffer, the documented segfault class; rebind it "
+         "immediately after the dispatch",
+         mirrors="Engine._dispatch_decode pool.rebind discipline"),
+    Rule("PTA604", WARNING,
+         "wasted donation: no output matches the donated buffer",
+         "the donated input's shape/dtype matches no program output, so "
+         "XLA cannot reuse the buffer and the donation only invalidates "
+         "the host reference — drop the argnum or thread the buffer "
+         "through the outputs",
+         mirrors="XLA donation fallback warning"),
+    # ---- PTA7xx: collective-balance checker
+    Rule("PTA701", ERROR,
+         "collectives unbalanced across cond branches",
+         "the branches of a `lax.cond` issue different collective "
+         "censuses; on a real multi-chip mesh the ranks that take the "
+         "other branch stop participating and the collective deadlocks "
+         "(invisible on the CPU proxy) — issue the same collectives in "
+         "every branch (reduce a zero if needed)",
+         mirrors="MULTICHIP cond-balance deadlock class"),
+    Rule("PTA702", WARNING,
+         "collective inside a data-dependent while loop",
+         "the loop's trip count is data-dependent, so per-rank collective "
+         "counts can diverge and deadlock unless the predicate is "
+         "replicated — prefer a bounded scan, or prove the predicate is "
+         "identical on every rank",
+         mirrors="comms walker unbounded_loops flag (PR 11)"),
+    Rule("PTA703", ERROR,
+         "collective over an axis unbound in the enclosing mesh",
+         "no enclosing shard_map (or declared axis environment) binds "
+         "this axis name — the dispatch will fail, or silently no-op "
+         "under an unrelated binding; check the mesh axis names "
+         "('dp','tp')",
+         mirrors="graph doctor PTA505, shard_map-aware"),
+    Rule("PTA704", ERROR,
+         "collective census drift from the registered formula",
+         "the program's statically-walked collective census no longer "
+         "matches the registered expected-census formula (e.g. MULTICHIP "
+         "decode: psum=L*h, all_gather=(3L+1)*h per dispatch) — either "
+         "the program grew/lost a collective (fix it) or the formula is "
+         "stale (update it WITH the derivation)",
+         mirrors="MULTICHIP decode census exact gate (PR 13)"),
 ]
 
 RULES = {r.code: r for r in _RULE_LIST}
@@ -219,6 +328,36 @@ def make(code, file, line, message=None, severity=None, hint=None):
     return Diagnostic(code=code, severity=severity or r.severity,
                       file=file, line=int(line),
                       message=message or r.title, hint=hint or r.hint)
+
+
+def apply_noqa_files(diags):
+    """Honor `# noqa` markers for diagnostics whose ``file`` is a real,
+    readable source file (the jaxpr-level analyzers map findings back to
+    user source via eqn source info; the AST linters apply noqa against
+    the in-memory source instead).  Unreadable files pass through."""
+    cache = {}
+    out = []
+    for d in diags:
+        lines = cache.get(d.file)
+        if lines is None:
+            try:
+                with open(d.file, "r", encoding="utf-8") as f:
+                    lines = f.read().splitlines()
+            except OSError:
+                lines = ()
+            cache[d.file] = lines
+        if 1 <= d.line <= len(lines):
+            line = lines[d.line - 1]
+            idx = line.find("# noqa")
+            if idx >= 0:
+                rest = line[idx + len("# noqa"):]
+                if not rest.lstrip().startswith(":"):
+                    continue
+                codes = rest.lstrip()[1:].replace(",", " ").split()
+                if d.code in codes:
+                    continue
+        out.append(d)
+    return out
 
 
 # --------------------------------------------------------------------------

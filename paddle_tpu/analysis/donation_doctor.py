@@ -1,0 +1,416 @@
+"""Donation doctor (analysis phase 2): the engine's state-rebind
+discipline as machine-checked rules.
+
+The serving engine donates its hot buffers (`decode_donate`/
+`prefill_donate` in ``Engine.__init__``) so XLA reuses them in place;
+the price is a strict host-side discipline — every donated reference
+must be REBOUND from the dispatch's outputs before anyone reads it
+again.  PR 14's documented segfault class is exactly this discipline
+broken (closing an engine whose live state aliased donated buffers).
+Two surfaces:
+
+**AST pass** (:func:`lint_source` / :func:`lint_file`, the
+``--serving`` CLI path).  It binds ``X = CompiledFn(fn,
+donate_argnums=...)`` / ``jax.jit(..., donate_argnums=...)`` specs —
+resolving literal tuples, simple local names (including ``+=``
+extensions, the engine's kv-quant pattern), and ``a if cond else b``
+either-branch unions — then walks each call site of a bound spec:
+
+- PTA601 use-after-donate: a donated name/attribute path is READ in a
+  later statement of the same function before being re-assigned.
+- PTA602 double donation: duplicate argnums in the spec, or one
+  expression passed in two donated positions.
+- PTA603 donated state escape: a donated ``self.*`` path that is
+  neither re-assigned nor re-established through a method call on its
+  owner (``self.pool.rebind(...)``) before the function ends — live
+  engine state left aliasing a donated buffer.
+
+**Jaxpr pass** (:func:`diagnose_donation`).  Traces the function
+abstractly (``jax.make_jaxpr`` — no FLOPs run) and checks the donation
+spec against the program itself: PTA602 duplicate/out-of-range
+argnums, PTA604 donated inputs whose shape/dtype matches no output
+(XLA cannot alias them — the donation only invalidates the host
+reference).
+
+False negatives are fine (it is a linter); false positives carry
+``# noqa: PTA60x`` with a one-line justification.
+"""
+
+from __future__ import annotations
+
+import ast
+import textwrap
+
+from .diagnostics import Diagnostic, apply_noqa_files, make
+from .trace_lint import _dotted, apply_noqa
+
+__all__ = ["lint_source", "lint_file", "diagnose_donation"]
+
+
+# --------------------------------------------------------------------------
+# donation-spec resolution
+
+
+def _literal_ints(node):
+    """frozenset of ints for a Tuple/List/Constant-int literal, else
+    None (unresolvable)."""
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if not (isinstance(e, ast.Constant)
+                    and isinstance(e.value, int)):
+                return None
+            out.append(e.value)
+        return tuple(out)
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    return None
+
+
+def _resolve_argnums(node, local_literals):
+    """Resolve a ``donate_argnums=`` value to a tuple of ints (possibly
+    with duplicates, for PTA602), or None when it cannot be resolved
+    statically.  ``local_literals`` maps local names to accumulated
+    literal tuples (Assign + AugAssign extension)."""
+    lit = _literal_ints(node)
+    if lit is not None:
+        return lit
+    if isinstance(node, ast.Name):
+        return local_literals.get(node.id)
+    if isinstance(node, ast.IfExp):
+        # `donate_argnums=decode_donate if donate else ()` — the engine
+        # pattern; analyze the union of resolvable branches so the
+        # donating configuration is what gets checked
+        a = _resolve_argnums(node.body, local_literals)
+        b = _resolve_argnums(node.orelse, local_literals)
+        if a is None and b is None:
+            return None
+        return tuple(a or ()) + tuple(b or ())
+    return None
+
+
+def _collect_local_literals(fdef):
+    """name -> accumulated literal int tuple for simple assignments in
+    one function body (``x = (1, 2)`` then ``x += (3,)`` accumulates —
+    branches are unioned, matching the kv-quant donate pattern)."""
+    out = {}
+    for node in ast.walk(fdef):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            lit = _literal_ints(node.value)
+            if lit is not None:
+                out[node.targets[0].id] = \
+                    out.get(node.targets[0].id, ()) + lit
+        elif isinstance(node, ast.AugAssign) \
+                and isinstance(node.target, ast.Name) \
+                and isinstance(node.op, ast.Add):
+            lit = _literal_ints(node.value)
+            if lit is not None and node.target.id in out:
+                out[node.target.id] = out[node.target.id] + lit
+    return out
+
+
+def _is_compiled_ctor(call):
+    d = _dotted(call.func) or ""
+    last = d.split(".")[-1]
+    return last in ("CompiledFn", "jit")
+
+
+def _donation_kw(call):
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            return kw.value
+    return None
+
+
+def _spec_target(node):
+    """Dotted key for the assignment target holding a compiled fn:
+    a Name or a self-attribute chain."""
+    d = _dotted(node)
+    return d
+
+
+# --------------------------------------------------------------------------
+# per-function call-site analysis
+
+
+def _stmt_stores(stmt):
+    """Dotted paths a statement assigns to (direct re-binds)."""
+    out = set()
+    targets = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        targets = [stmt.target]
+    flat = []
+    for t in targets:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            flat.extend(t.elts)
+        else:
+            flat.append(t)
+    for t in flat:
+        d = _dotted(t)
+        if d is not None:
+            out.add(d)
+    return out
+
+
+def _loads_in(node, paths):
+    """(path, lineno) for every Load of a dotted path in ``paths``
+    inside ``node`` — exact-path matches only."""
+    hits = []
+    for n in ast.walk(node):
+        if isinstance(n, (ast.Attribute, ast.Name)) \
+                and isinstance(getattr(n, "ctx", None), ast.Load):
+            d = _dotted(n)
+            if d in paths:
+                hits.append((d, n.lineno))
+    return hits
+
+
+def _own_calls(stmt):
+    """Calls in a statement's OWN expressions only — compound bodies
+    belong to the nested statements, which the linear scan visits
+    separately."""
+    if isinstance(stmt, (ast.If, ast.While)):
+        exprs = [stmt.test]
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        exprs = [stmt.iter]
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        exprs = [i.context_expr for i in stmt.items]
+    elif isinstance(stmt, ast.Try):
+        exprs = []
+    else:
+        return [n for n in ast.walk(stmt) if isinstance(n, ast.Call)]
+    out = []
+    for e in exprs:
+        out.extend(n for n in ast.walk(e) if isinstance(n, ast.Call))
+    return out
+
+
+def _owner_method_calls(stmt):
+    """Dotted receivers of method calls in a statement — a call on
+    ``self.pool`` re-establishes ``self.pool.*`` donated paths (the
+    ``pool.rebind(new_k, ...)`` idiom)."""
+    out = set()
+    for n in ast.walk(stmt):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute):
+            d = _dotted(n.func.value)
+            if d is not None:
+                out.add(d)
+    return out
+
+
+class _DonationLinter:
+    def __init__(self, filename):
+        self.filename = filename
+        self.diags = []
+        #: spec key (dotted) -> tuple of donated argnums
+        self.specs = {}
+
+    def emit(self, code, line, message=None):
+        self.diags.append(make(code, self.filename, line,
+                               message=message))
+
+    # -- pass 1: bind donation specs --------------------------------------
+    def collect_specs(self, tree):
+        visited = set()
+        for fdef in [n for n in ast.walk(tree)
+                     if isinstance(n, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))] + [tree]:
+            local_literals = _collect_local_literals(fdef) \
+                if not isinstance(fdef, ast.Module) else {}
+            for node in ast.walk(fdef):
+                if not (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1
+                        and isinstance(node.value, ast.Call)
+                        and _is_compiled_ctor(node.value)):
+                    continue
+                if id(node) in visited:
+                    continue          # nested defs are walked twice
+                visited.add(id(node))
+                kw = _donation_kw(node.value)
+                if kw is None:
+                    continue
+                argnums = _resolve_argnums(kw, local_literals)
+                key = _spec_target(node.targets[0])
+                if argnums is None or key is None:
+                    continue
+                dupes = sorted({a for a in argnums
+                                if argnums.count(a) > 1})
+                if dupes:
+                    self.emit(
+                        "PTA602", node.lineno,
+                        message=f"donate_argnums for {key!r} donates "
+                                f"position(s) {dupes} more than once")
+                self.specs[key] = tuple(sorted(set(argnums)))
+
+    # -- pass 2: call sites ------------------------------------------------
+    def check_function(self, fdef):
+        stmts = self._linear_stmts(fdef)
+        for i, stmt in enumerate(stmts):
+            for call in _own_calls(stmt):
+                key = _dotted(call.func)
+                if key is None or key not in self.specs:
+                    continue
+                self._check_site(call, stmt, stmts[i + 1:])
+
+    def _linear_stmts(self, fdef):
+        """Function statements flattened in source order (branch bodies
+        inline) — the linear scan use-after-donate rides on."""
+        out = []
+
+        def walk(body):
+            for s in body:
+                if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                    continue
+                out.append(s)
+                for attr in ("body", "orelse", "finalbody"):
+                    walk(getattr(s, attr, None) or [])
+                for h in getattr(s, "handlers", ()) or ():
+                    walk(h.body)
+
+        walk(fdef.body)
+        out.sort(key=lambda s: s.lineno)
+        return out
+
+    def _check_site(self, call, call_stmt, later_stmts):
+        argnums = self.specs[_dotted(call.func)]
+        donated = {}                  # dotted path -> argnum
+        seen_exprs = {}
+        for pos in argnums:
+            if pos >= len(call.args):
+                continue
+            arg = call.args[pos]
+            d = _dotted(arg)
+            if d is None:
+                continue
+            if d in seen_exprs:
+                self.emit(
+                    "PTA602", call.lineno,
+                    message=f"{d!r} is passed in two donated positions "
+                            f"({seen_exprs[d]} and {pos}) — one buffer "
+                            "cannot alias two outputs")
+            else:
+                seen_exprs[d] = pos
+                donated[d] = pos
+        if not donated:
+            return
+        # what the CALL STATEMENT itself rebinds (outputs assigned back)
+        poisoned = set(donated) - _stmt_stores(call_stmt)
+        unrebound_self = {d for d in poisoned if d.startswith("self.")}
+        for stmt in later_stmts:
+            if not poisoned:
+                break
+            reads = _loads_in(stmt, poisoned)
+            stores = _stmt_stores(stmt) & poisoned
+            owner_calls = _owner_method_calls(stmt)
+            # a read in the same statement that re-binds the path is the
+            # rebind itself (`x = f(x)` later) — stores win on ties
+            for d, line in reads:
+                if d in stores:
+                    continue
+                self.emit(
+                    "PTA601", line,
+                    message=f"{d!r} was donated to the dispatch at line "
+                            f"{call.lineno} and read here before being "
+                            "rebound")
+                poisoned.discard(d)
+                unrebound_self.discard(d)
+            poisoned -= stores
+            unrebound_self -= stores
+            # `self.pool.rebind(...)` re-establishes self.pool.* paths
+            rebound = {d for d in poisoned
+                       if any(d.startswith(owner + ".")
+                              for owner in owner_calls)}
+            poisoned -= rebound
+            unrebound_self -= rebound
+        for d in sorted(unrebound_self):
+            self.emit(
+                "PTA603", call.lineno,
+                message=f"donated engine state {d!r} is never rebound "
+                        "from the dispatch outputs — live state aliases "
+                        "a donated buffer (the documented segfault "
+                        "class)")
+
+
+def lint_source(source, filename="<string>", line_offset=0):
+    """Donation-discipline lint of python source; returns [Diagnostic]
+    sorted by line, with `# noqa` applied."""
+    source = textwrap.dedent(source)
+    tree = ast.parse(source)
+    linter = _DonationLinter(filename)
+    linter.collect_specs(tree)
+    if linter.specs:
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                linter.check_function(node)
+    diags = apply_noqa(linter.diags, source)
+    for d in diags:
+        d.line += line_offset
+    diags.sort(key=lambda d: (d.line, d.code))
+    return diags
+
+
+def lint_file(path):
+    with open(path, "r", encoding="utf-8") as f:
+        src = f.read()
+    try:
+        return lint_source(src, filename=str(path))
+    except SyntaxError as e:
+        return [Diagnostic(code="PTA000", severity="error",
+                           file=str(path), line=int(e.lineno or 0),
+                           message=f"could not parse: {e.msg}", hint="")]
+
+
+# --------------------------------------------------------------------------
+# jaxpr surface
+
+
+def diagnose_donation(fn, *args, donate_argnums=(), file=None, **kwargs):
+    """Trace ``fn(*args)`` abstractly and check ``donate_argnums``
+    against the program: PTA602 duplicate/out-of-range argnums, PTA604
+    donated inputs with no shape/dtype-matching output (XLA cannot
+    alias them).  ``fn`` may also be a serving ``CompiledFn`` — its
+    wrapped function and recorded donate spec are used.  Returns
+    [Diagnostic]."""
+    import jax
+
+    inner = getattr(fn, "_jit", None) or getattr(fn, "_fn", None) or fn
+    spec = tuple(donate_argnums) or tuple(getattr(fn, "_donate", ()))
+    code = getattr(inner, "__code__", None)
+    f = file or (code.co_filename if code is not None else "<jaxpr>")
+    line = code.co_firstlineno if code is not None else 0
+    diags = []
+    seen = set()
+    for a in spec:
+        if a in seen:
+            diags.append(make(
+                "PTA602", f, line,
+                message=f"donate_argnums donates position {a} twice"))
+        seen.add(a)
+    closed = jax.make_jaxpr(inner)(*args, **kwargs)
+    invars = closed.jaxpr.invars
+    out_shapes = {(tuple(v.aval.shape), str(v.aval.dtype))
+                  for v in closed.jaxpr.outvars
+                  if hasattr(v, "aval")}
+    for a in sorted(seen):
+        if not 0 <= a < len(invars):
+            diags.append(make(
+                "PTA602", f, line,
+                message=f"donate_argnums position {a} is out of range "
+                        f"for a {len(invars)}-input program"))
+            continue
+        aval = invars[a].aval
+        key = (tuple(aval.shape), str(aval.dtype))
+        if key not in out_shapes:
+            diags.append(make(
+                "PTA604", f, line,
+                message=f"donated input #{a} ({key[1]}{list(key[0])}) "
+                        "matches no output shape/dtype — the donation "
+                        "is wasted"))
+    diags.sort(key=lambda d: (d.line, d.code))
+    return apply_noqa_files(diags)

@@ -250,8 +250,19 @@ def diagnose_jaxpr(closed_jaxpr, mesh_axes=None, file="<jaxpr>"):
                         message=f"collective {pname!r} runs over axis "
                                 f"{name!r}, not bound in the mesh "
                                 f"(axes: {sorted(mesh_axes)})"))
+        sub_axes = mesh_axes
+        if mesh_axes is not None and "shard_map" in pname:
+            # shard_map binds its mesh's axis names for the body, even
+            # when the shard_map itself sits under lax.scan (the
+            # MeshEngine decode shape) — collectives over those axes
+            # are well-bound, not PTA505.
+            mesh = eqn.params.get("mesh")
+            if mesh is not None:
+                shape = getattr(mesh, "shape", None)
+                if shape:
+                    sub_axes = mesh_axes | set(dict(shape))
         for sub in _sub_jaxprs(eqn.params):
-            diags.extend(diagnose_jaxpr(sub, mesh_axes=mesh_axes, file=f))
+            diags.extend(diagnose_jaxpr(sub, mesh_axes=sub_axes, file=f))
 
     # ---- unused invars ----
     for j, v in enumerate(jaxpr.invars):

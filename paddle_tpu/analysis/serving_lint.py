@@ -1,0 +1,399 @@
+"""Serving thread-ownership & lock-discipline lint (analysis phase 2).
+
+The serving fleet's correctness rests on doctrines PR 14 states as
+prose; this pass makes them machine-checked, per file, without running
+anything:
+
+- **PTA510 engine ownership.**  One daemon thread per replica owns
+  every mutating engine call (``submit/step/abort/drain/close/adopt``
+  and mutations on the engine's ``pool``/``prefix`` store).  The lint
+  rebuilds each class's intra-class call graph, roots it at every
+  ``threading.Thread(target=self.X)`` entry, and flags mutating
+  ``self.engine.*`` calls from methods OUTSIDE that worker-owned set —
+  and mutating ``<other>.engine.*`` calls anywhere (another object's
+  engine is never yours).  Local aliases (``eng = self.engine``) are
+  tracked.  Ownership handoffs that are doctrine-sanctioned (closing
+  an engine after ``drain()+stop()`` joined its thread) carry a
+  justified ``# noqa: PTA510``.
+- **PTA511 handle-lock atomicity.**  ``StreamHandle.request/worker/
+  failing_over/abort_requested/failovers`` are rebound during failover
+  under ``handle.lock``; writes outside a ``with <handle>.lock:``
+  block race the supervisor's swap.  ``sent`` is deliberately NOT
+  guarded — it is worker-thread-owned (flushed without the lock).
+  Constructors (``__init__``) are pre-publication and exempt.
+- **PTA512 blocking under a lock.**  ``queue.get()`` (argless or with
+  a timeout), ``join`` on thread-ish receivers, ``adopt``/``drain``,
+  ``Event.wait()``, and nonzero ``time.sleep`` inside a
+  ``with ... lock:`` body can deadlock against the thread that needs
+  the lock to make progress.  ``dict.get(key, default)`` (positional
+  args) does not flag.
+- **PTA513 wall clock in fault paths.**  Fault scheduling is keyed by
+  dispatch ordinals so fault runs replay deterministically; inside
+  fault/chaos/inject-named scopes, ``time.time/monotonic/
+  perf_counter``, ``datetime.now``, and unseeded module-level
+  ``random.*`` calls flag.  ``random.Random(seed)`` construction is
+  the sanctioned pattern and does not.
+- **PTA514 thread lifecycle.**  ``threading.Thread(...)`` without
+  ``daemon=True`` flags unless the enclosing class (or module) joins a
+  thread somewhere — the fleet pattern is daemon threads with explicit
+  ``stop()`` joins.
+
+Entry points: :func:`lint_source` / :func:`lint_file` (per-file, the
+``--serving`` CLI path) and :func:`serving_check` (a live function or
+class, source-mapped like ``analysis.check``).  All findings honor
+``# noqa: PTA51x`` on the flagged line.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+import textwrap
+
+from .diagnostics import Diagnostic, make
+from .trace_lint import _dotted, apply_noqa
+
+__all__ = ["lint_source", "lint_file", "serving_check"]
+
+#: engine methods the worker thread alone may call (reads like
+#: ``.engine.stats()`` / ``.engine.scheduler.has_work`` stay free, and
+#: ``install_faults`` is a GIL-atomic configuration store)
+_ENGINE_MUTATORS = frozenset(
+    {"submit", "step", "abort", "drain", "close", "adopt"})
+#: mutating methods on the engine's pool / radix (prefix) store
+_STORE_MUTATORS = frozenset(
+    {"rebind", "reclaim", "insert", "adopt", "evict", "free",
+     "allocate", "reset"})
+#: StreamHandle attrs the failover swap rebinds under ``handle.lock``
+#: (``sent`` is worker-thread-owned and deliberately absent)
+_GUARDED_HANDLE_ATTRS = frozenset(
+    {"request", "worker", "failing_over", "abort_requested",
+     "failovers"})
+#: names conventionally bound to StreamHandles in the gateway code
+_HANDLE_NAMES = frozenset({"handle", "h", "stream_handle", "sh"})
+
+_WALLCLOCK_CALLS = frozenset({
+    "time.time", "time.monotonic", "time.perf_counter",
+    "time.time_ns", "time.monotonic_ns", "time.perf_counter_ns",
+    "datetime.now", "datetime.utcnow", "datetime.datetime.now",
+    "datetime.datetime.utcnow"})
+_FAULT_SCOPE = re.compile(r"fault|chaos|inject", re.IGNORECASE)
+
+
+def _last2(dotted):
+    return dotted.split(".")[-2:] if dotted else []
+
+
+def _is_thread_ctor(call):
+    d = _dotted(call.func) or ""
+    return d.split(".")[-1] == "Thread"
+
+
+def _self_method_target(call):
+    """'X' when a Thread(...) call has target=self.X, else None."""
+    for kw in call.keywords:
+        if kw.arg == "target" and isinstance(kw.value, ast.Attribute) \
+                and isinstance(kw.value.value, ast.Name) \
+                and kw.value.value.id in ("self", "cls"):
+            return kw.value.attr
+    return None
+
+
+def _worker_owned_methods(cdef):
+    """Methods of ``cdef`` that run on a thread the class itself
+    started: every ``Thread(target=self.X)`` entry plus its same-class
+    transitive callees (``self.m()`` edges)."""
+    methods = {n.name: n for n in cdef.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    entries, edges = set(), {name: set() for name in methods}
+    for name, fdef in methods.items():
+        for node in ast.walk(fdef):
+            if not isinstance(node, ast.Call):
+                continue
+            if _is_thread_ctor(node):
+                tgt = _self_method_target(node)
+                if tgt is not None:
+                    entries.add(tgt)
+            if isinstance(node.func, ast.Attribute) \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id in ("self", "cls") \
+                    and node.func.attr in methods:
+                edges[name].add(node.func.attr)
+    owned, stack = set(), [e for e in entries if e in methods]
+    while stack:
+        m = stack.pop()
+        if m in owned:
+            continue
+        owned.add(m)
+        stack.extend(edges.get(m, ()))
+    return owned
+
+
+def _joins_anywhere(node):
+    """True when the subtree contains a ``<x>.join(...)`` call — used
+    to decide whether a non-daemon thread has a visible join path."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                and n.func.attr == "join" \
+                and not (n.args and isinstance(n.args[0], ast.Constant)
+                         and isinstance(n.args[0].value, str)):
+            return True
+    return False
+
+
+class _ServingLinter(ast.NodeVisitor):
+    """One pass over a module tree; context (class / function / lock /
+    engine-alias) is tracked on explicit stacks."""
+
+    def __init__(self, filename):
+        self.filename = filename
+        self.diags = []
+        self._seen = set()
+        self.class_stack = []        # (cdef, owned_methods, has_join)
+        self.func_stack = []         # ast.FunctionDef
+        self.lock_stack = []         # dotted lock owners ('self', 'handle')
+        self.engine_aliases = []     # per-function set of local names
+        self.module_has_join = False
+
+    # -- emission ---------------------------------------------------------
+    def emit(self, code, line, message=None):
+        key = (code, line)
+        if key not in self._seen:
+            self._seen.add(key)
+            self.diags.append(make(code, self.filename, line,
+                                   message=message))
+
+    # -- context ----------------------------------------------------------
+    def visit_ClassDef(self, node):
+        self.class_stack.append(
+            (node, _worker_owned_methods(node), _joins_anywhere(node)))
+        self.generic_visit(node)
+        self.class_stack.pop()
+
+    def visit_FunctionDef(self, node):
+        self.func_stack.append(node)
+        self.engine_aliases.append(set())
+        self.generic_visit(node)
+        self.engine_aliases.pop()
+        self.func_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_With(self, node):
+        held = []
+        for item in node.items:
+            d = _dotted(item.context_expr) or ""
+            parts = d.split(".")
+            if parts and parts[-1] in ("lock", "_lock"):
+                owner = ".".join(parts[:-1])
+                held.append(owner)
+        for v in node.items:
+            self.visit(v.context_expr)
+        self.lock_stack.extend(held)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in held:
+            self.lock_stack.pop()
+
+    visit_AsyncWith = visit_With
+
+    # -- assignments: handle-lock discipline + engine aliases -------------
+    def _check_target(self, target, line):
+        if not isinstance(target, ast.Attribute) \
+                or target.attr not in _GUARDED_HANDLE_ATTRS:
+            return
+        root = target.value
+        d = _dotted(root)
+        if d is None or "." in d:
+            return                    # only direct <handle>.<attr> writes
+        in_handle_class = (
+            d in ("self", "cls") and self.class_stack
+            and self.class_stack[-1][0].name.endswith("Handle"))
+        if d not in _HANDLE_NAMES and not in_handle_class:
+            return
+        if self.func_stack and self.func_stack[-1].name == "__init__":
+            return                    # pre-publication construction
+        if d in self.lock_stack:
+            return                    # lexically under `with <d>.lock:`
+        self.emit(
+            "PTA511", line,
+            message=f"StreamHandle state {d}.{target.attr!r} mutated "
+                    f"outside `with {d}.lock` — races the failover swap")
+
+    def visit_Assign(self, node):
+        for t in node.targets:
+            self._check_target(t, node.lineno)
+            if isinstance(t, (ast.Tuple, ast.List)):
+                for e in t.elts:
+                    self._check_target(e, node.lineno)
+        # track `eng = <...>.engine` aliases for the ownership rule
+        if self.engine_aliases and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            d = _dotted(node.value) or ""
+            if d.split(".")[-1] == "engine":
+                self.engine_aliases[-1].add(node.targets[0].id)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        self._check_target(node.target, node.lineno)
+        self.generic_visit(node)
+
+    # -- calls: ownership, blocking-under-lock, wall clock, threads -------
+    def _in_fault_scope(self):
+        if self.func_stack and _FAULT_SCOPE.search(
+                self.func_stack[-1].name):
+            return True
+        return bool(self.class_stack and _FAULT_SCOPE.search(
+            self.class_stack[-1][0].name))
+
+    def _owned_here(self):
+        """True when the current method runs on a thread its class
+        started (the worker-owned call-graph set)."""
+        if not self.class_stack or not self.func_stack:
+            return False
+        return self.func_stack[-1].name in self.class_stack[-1][1]
+
+    def _check_engine_ownership(self, node, dotted):
+        parts = dotted.split(".")
+        method = parts[-1]
+        aliases = self.engine_aliases[-1] if self.engine_aliases else set()
+        # direct chains: <root>(...).engine.<mut>() / .engine.pool.<mut>()
+        owner_is_self = parts[0] in ("self", "cls")
+        alias_root = len(parts) == 2 and parts[0] in aliases
+        if "engine" in parts[:-1]:
+            eng_rel = parts[parts.index("engine") + 1:]
+        elif alias_root:
+            eng_rel = parts[1:]
+        else:
+            return
+        flagged = None
+        if len(eng_rel) == 1 and method in _ENGINE_MUTATORS:
+            flagged = f"engine.{method}()"
+        elif len(eng_rel) == 2 and eng_rel[0] in ("pool", "prefix") \
+                and method in _STORE_MUTATORS:
+            flagged = f"engine.{eng_rel[0]}.{method}()"
+        if flagged is None:
+            return
+        if (owner_is_self or alias_root) and self._owned_here():
+            return                    # on the thread that owns the engine
+        where = (f"method {self.func_stack[-1].name!r}"
+                 if self.func_stack else "module level")
+        self.emit(
+            "PTA510", node.lineno,
+            message=f"{flagged} called from {where}, outside the "
+                    "engine-owning worker thread"
+                    + ("" if owner_is_self or alias_root
+                       else " (another object's engine is never yours)"))
+
+    def _check_blocking_under_lock(self, node, dotted):
+        if not self.lock_stack:
+            return
+        f = node.func
+        attr = f.attr if isinstance(f, ast.Attribute) else None
+        has_timeout = any(kw.arg == "timeout" for kw in node.keywords)
+        if attr in ("get", "wait") and (not node.args or has_timeout):
+            # argless .get()/.wait() is a queue/event block;
+            # dict.get(key, default) passes positional args
+            if not node.args:
+                self.emit("PTA512", node.lineno,
+                          message=f".{attr}() blocks while holding a "
+                                  "lock")
+        elif attr in ("adopt", "drain"):
+            self.emit("PTA512", node.lineno,
+                      message=f".{attr}() blocks on the worker inbox "
+                              "while holding a lock")
+        elif attr == "join" and (
+                not node.args or has_timeout) and not (
+                node.args and isinstance(node.args[0], ast.Constant)):
+            recv = _dotted(f.value) or ""
+            if not node.args or "thread" in recv.lower():
+                self.emit("PTA512", node.lineno,
+                          message=".join() blocks while holding a lock")
+        elif dotted == "time.sleep":
+            arg = node.args[0] if node.args else None
+            if not (isinstance(arg, ast.Constant) and arg.value == 0):
+                self.emit("PTA512", node.lineno,
+                          message="time.sleep() while holding a lock")
+
+    def _check_wallclock(self, node, dotted):
+        if not self._in_fault_scope():
+            return
+        parts = dotted.split(".")
+        if dotted in _WALLCLOCK_CALLS:
+            self.emit("PTA513", node.lineno,
+                      message=f"{dotted}() inside a fault-scheduling "
+                              "path — schedule by dispatch ordinal")
+        elif parts[0] in ("random", "np", "numpy") and "random" in parts \
+                and parts[-1] != "Random":
+            self.emit("PTA513", node.lineno,
+                      message=f"unseeded {dotted}() inside a fault-"
+                              "scheduling path — use random.Random(seed)")
+
+    def _check_thread_ctor(self, node):
+        if not _is_thread_ctor(node):
+            return
+        for kw in node.keywords:
+            if kw.arg == "daemon" and isinstance(kw.value, ast.Constant) \
+                    and kw.value.value is True:
+                return
+        has_join = self.module_has_join if not self.class_stack \
+            else self.class_stack[-1][2]
+        if not has_join:
+            self.emit("PTA514", node.lineno,
+                      message="non-daemon Thread with no join/stop in "
+                              "scope keeps the process alive at exit")
+
+    def visit_Call(self, node):
+        dotted = _dotted(node.func) or ""
+        if dotted:
+            self._check_engine_ownership(node, dotted)
+            self._check_wallclock(node, dotted)
+        self._check_blocking_under_lock(node, dotted)
+        self._check_thread_ctor(node)
+        self.generic_visit(node)
+
+
+def lint_source(source, filename="<string>", line_offset=0):
+    """Serving-doctrine lint of python source; returns [Diagnostic]
+    sorted by line, with `# noqa` applied."""
+    source = textwrap.dedent(source)
+    tree = ast.parse(source)
+    linter = _ServingLinter(filename)
+    linter.module_has_join = _joins_anywhere(tree)
+    linter.visit(tree)
+    diags = apply_noqa(linter.diags, source)
+    for d in diags:
+        d.line += line_offset
+    diags.sort(key=lambda d: (d.line, d.code))
+    return diags
+
+
+def lint_file(path):
+    with open(path, "r", encoding="utf-8") as f:
+        src = f.read()
+    try:
+        return lint_source(src, filename=str(path))
+    except SyntaxError as e:
+        return [Diagnostic(code="PTA000", severity="error",
+                           file=str(path), line=int(e.lineno or 0),
+                           message=f"could not parse: {e.msg}", hint="")]
+
+
+def serving_check(obj):
+    """Lint a live function or class against the serving doctrines,
+    with real file/line numbers (the programmatic peer of `check`)."""
+    import inspect
+
+    target = obj
+    if inspect.ismethod(target):
+        target = target.__func__
+    try:
+        src_lines, start = inspect.getsourcelines(target)
+        srcfile = inspect.getsourcefile(target) or "<unknown>"
+    except (OSError, TypeError):
+        return []
+    try:
+        return lint_source("".join(src_lines), filename=srcfile,
+                           line_offset=start - 1)
+    except SyntaxError:
+        return []

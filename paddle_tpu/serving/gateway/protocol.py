@@ -257,7 +257,9 @@ class Gateway:
             finally:
                 w.stop()
             if self._own_workers:
-                w.engine.close()
+                # ownership transferred: gateway-built engines, closed
+                # only after drain() + stop() joined the worker thread
+                w.engine.close()  # noqa: PTA510
 
     def __enter__(self):
         return self.start()

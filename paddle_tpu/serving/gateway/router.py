@@ -671,7 +671,9 @@ class PrefixAffinityRouter:
         worker.drain()
         worker.stop()
         if close_engine:
-            worker.engine.close()
+            # ownership transferred: drain() emptied it and stop()
+            # joined the worker thread — no live thread can touch it
+            worker.engine.close()  # noqa: PTA510
 
 
 class FleetSupervisor:

@@ -1,0 +1,14 @@
+"""Seeded PTA510 violation: engine mutation outside the owning worker
+thread (the PR 14 thread-owned teardown doctrine)."""
+
+
+class RogueSupervisor:
+    def kill(self, worker):
+        # TRIPS: close() on another object's engine, from a supervisor
+        # method — exactly the segfault-through-donated-buffers class.
+        worker.engine.close()
+
+    def kill_after_handoff(self, worker):
+        worker.drain()
+        worker.stop()
+        worker.engine.close()  # noqa: PTA510 — ownership transferred post drain+stop

@@ -1,0 +1,16 @@
+"""Seeded PTA511 violation: StreamHandle guarded state mutated outside
+`with handle.lock`."""
+
+
+class RacyRouter:
+    def mark_failing(self, handle):
+        # TRIPS: guarded attr written lock-free — races the worker's
+        # failover read.
+        handle.failing_over = True
+
+    def mark_failing_suppressed(self, handle):
+        handle.failing_over = True  # noqa: PTA511 — fixture counterpart
+
+    def mark_failing_locked(self, handle):
+        with handle.lock:
+            handle.failing_over = True  # clean: under the handle lock

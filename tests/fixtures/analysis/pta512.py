@@ -1,0 +1,20 @@
+"""Seeded PTA512 violation: blocking operation performed while holding
+a lock."""
+
+
+class StallingWorker:
+    def pump(self):
+        with self.lock:
+            # TRIPS: unbounded queue.get() under the lock — every
+            # other thread contending on self.lock stalls with it.
+            item = self.q.get()
+        return item
+
+    def pump_suppressed(self):
+        with self.lock:
+            item = self.q.get()  # noqa: PTA512 — fixture counterpart
+        return item
+
+    def pump_outside(self):
+        item = self.q.get()  # clean: no lock held
+        return item

@@ -1,0 +1,17 @@
+"""Seeded PTA513 violation: wall-clock call inside a fault-scheduling
+path (the dispatch-ordinal doctrine: fault schedules must be
+deterministic in dispatch ordinals, never in wall time)."""
+
+import time
+
+
+class FaultSchedule:
+    def next_fire(self):
+        # TRIPS: wall clock inside a fault-scoped class.
+        return time.time()
+
+    def next_fire_suppressed(self):
+        return time.time()  # noqa: PTA513 — fixture counterpart
+
+    def next_ordinal(self, ordinals, scope):
+        return ordinals.get(scope, 0) + 1  # clean: ordinal arithmetic

@@ -1,0 +1,27 @@
+"""Seeded PTA514 violation: non-daemon thread with no join/stop in
+sight — leaks past interpreter shutdown."""
+
+import threading
+
+
+class LeakySpawner:
+    def start(self):
+        # TRIPS: non-daemon, and nothing in this class ever joins it.
+        self.t = threading.Thread(target=self._run)
+        self.t.start()
+
+    def start_suppressed(self):
+        self.t = threading.Thread(target=self._run)  # noqa: PTA514 — fixture counterpart
+        self.t.start()
+
+    def _run(self):
+        pass
+
+
+class DisciplinedSpawner:
+    def start(self):
+        self.t = threading.Thread(target=self._run, daemon=True)  # clean
+        self.t.start()
+
+    def _run(self):
+        pass

@@ -1,0 +1,24 @@
+"""Seeded PTA601 violation: host read of a buffer after it was donated
+to a dispatch — the buffer's device memory now belongs to the output."""
+
+from paddle_tpu.serving.engine import CompiledFn
+
+
+class UseAfterDonate:
+    def dispatch(self, step):
+        fn = CompiledFn(step, donate_argnums=(0,))
+        out = fn(self.buf)
+        # TRIPS: self.buf was donated on the line above; reading it
+        # now dereferences freed device memory.
+        return self.buf.sum()
+
+    def dispatch_suppressed(self, step):
+        fn = CompiledFn(step, donate_argnums=(0,))
+        out = fn(self.buf)
+        return self.buf.sum()  # noqa: PTA601 — fixture counterpart
+
+    def dispatch_rebound(self, step):
+        fn = CompiledFn(step, donate_argnums=(0,))
+        out = fn(self.buf)
+        self.buf = out  # clean: rebound before any read
+        return self.buf.sum()

@@ -1,0 +1,25 @@
+"""Seeded PTA602 violation: the same buffer donated through two argnums
+of one dispatch — double free on real hardware."""
+
+from paddle_tpu.serving.engine import CompiledFn
+
+
+class DoubleDonor:
+    def dispatch(self, step):
+        fn = CompiledFn(step, donate_argnums=(0, 1))
+        # TRIPS: self.buf fills two donated positions.
+        out = fn(self.buf, self.buf)
+        self.buf = out
+        return out
+
+    def dispatch_suppressed(self, step):
+        fn = CompiledFn(step, donate_argnums=(0, 1))
+        out = fn(self.buf, self.buf)  # noqa: PTA602 — fixture counterpart
+        self.buf = out
+        return out
+
+    def dispatch_distinct(self, step):
+        fn = CompiledFn(step, donate_argnums=(0, 1))
+        out = fn(self.k, self.v)  # clean: distinct buffers
+        self.k, self.v = out
+        return out

@@ -1,0 +1,24 @@
+"""Seeded PTA603 violation: donated engine-state buffer never rebound —
+live engine state now points at donated (freed) memory."""
+
+from paddle_tpu.serving.engine import CompiledFn
+
+
+class LeakyRebind:
+    def dispatch(self, step):
+        fn = CompiledFn(step, donate_argnums=(0,))
+        # TRIPS: self.pool.k donated but no rebind of self.pool
+        # follows in this method.
+        out = fn(self.pool.k)
+        return out
+
+    def dispatch_suppressed(self, step):
+        fn = CompiledFn(step, donate_argnums=(0,))
+        out = fn(self.pool.k)  # noqa: PTA603 — fixture counterpart
+        return out
+
+    def dispatch_rebound(self, step):
+        fn = CompiledFn(step, donate_argnums=(0,))
+        out = fn(self.pool.k)
+        self.pool.rebind(out)  # clean: owner call re-establishes state
+        return out

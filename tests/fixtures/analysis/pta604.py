@@ -1,0 +1,20 @@
+"""Seeded PTA604 violation (jaxpr level): a donated input whose shape
+and dtype match no output — the donation can never be fulfilled and the
+buffer is silently copied instead of reused.
+
+Imported and traced by tests via ``diagnose_donation(fn, a, b,
+donate_argnums=(0,))``.
+"""
+
+
+def unfulfillable(a, b):
+    # TRIPS: donating a (4,4) input into a scalar-output program.
+    return (a + b).sum()
+
+
+def unfulfillable_suppressed(a, b):  # noqa: PTA604 — fixture counterpart
+    return (a + b).sum()
+
+
+def fulfillable(a, b):
+    return a + b  # clean: output aliases the donated shape/dtype

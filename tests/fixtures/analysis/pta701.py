@@ -1,0 +1,22 @@
+"""Seeded PTA701 violation (jaxpr level): lax.cond branches issuing
+different collective censuses — ranks taking different branches
+deadlock on a real mesh.
+
+Traced by tests via ``check_balance(fn, x, p, axis_sizes={"dp": 2})``.
+"""
+
+from jax import lax
+
+
+def lopsided(x, p):
+    # TRIPS: true branch psums over "dp", false branch is collective-free.
+    return lax.cond(p, lambda v: lax.psum(v, "dp"), lambda v: v * 2.0, x)
+
+
+def lopsided_suppressed(x, p):
+    return lax.cond(p, lambda v: lax.psum(v, "dp"), lambda v: v * 2.0, x)  # noqa: PTA701
+
+
+def balanced(x, p):
+    return lax.cond(p, lambda v: lax.psum(v, "dp"),
+                    lambda v: lax.psum(v * 2.0, "dp"), x)  # clean

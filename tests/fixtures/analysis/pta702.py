@@ -1,0 +1,21 @@
+"""Seeded PTA702 violation (jaxpr level): a collective inside a
+lax.while_loop body runs a data-dependent number of times — per-rank
+predicate divergence deadlocks.
+
+Traced by tests via ``check_balance(fn, x, axis_sizes={"dp": 2})``.
+"""
+
+from jax import lax
+
+
+def chatty_loop(x):
+    # TRIPS: psum inside the data-dependent loop body.
+    return lax.while_loop(lambda v: v.sum() < 10.0, lambda v: lax.psum(v, "dp"), x)
+
+
+def chatty_loop_suppressed(x):
+    return lax.while_loop(lambda v: v.sum() < 10.0, lambda v: lax.psum(v, "dp"), x)  # noqa: PTA702
+
+
+def quiet_loop(x):
+    return lax.while_loop(lambda v: v.sum() < 10.0, lambda v: v * 2.0, x)  # clean

@@ -1,0 +1,19 @@
+"""Seeded PTA703 violation (jaxpr level): a collective over an axis
+name bound by no enclosing shard_map mesh nor declared axis
+environment.
+
+Traced by tests via ``check_balance(fn, x, axis_env=[("mystery", 2)])``
+— the axis env makes the trace legal, but the balance checker's bound
+set is empty, so the axis is unbound from the mesh's point of view.
+"""
+
+from jax import lax
+
+
+def stray_axis(x):
+    # TRIPS: "mystery" is bound by no shard_map in this program.
+    return lax.psum(x, "mystery")
+
+
+def stray_axis_suppressed(x):
+    return lax.psum(x, "mystery")  # noqa: PTA703 — fixture counterpart

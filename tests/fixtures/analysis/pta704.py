@@ -1,0 +1,23 @@
+"""Seeded PTA704 violation (jaxpr level): collective census drift —
+the program issues more collectives than its registered expected-census
+formula allows.
+
+Traced by tests via ``check_census(fn, (x,), expected={("psum", "dp"):
+1}, axis_sizes={"dp": 2})``.  The diagnostic anchors at the function's
+``def`` line, so the suppressed counterpart carries its noqa there.
+"""
+
+from jax import lax
+
+
+def census_drifter(x):
+    # TRIPS: two psums against an expected census of one.
+    return lax.psum(x, "dp") + lax.psum(x * 2.0, "dp")
+
+
+def census_drifter_suppressed(x):  # noqa: PTA704 — fixture counterpart
+    return lax.psum(x, "dp") + lax.psum(x * 2.0, "dp")
+
+
+def census_exact(x):
+    return lax.psum(x, "dp")  # clean: matches {("psum","dp"): 1}

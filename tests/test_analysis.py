@@ -13,8 +13,23 @@ import pytest
 import paddle_tpu as paddle
 from paddle_tpu.analysis import (check, lint_source, lint_file,
                                  diagnose_jaxpr, diagnose_program,
-                                 doctor, RULES, ERROR, TraceSafetyWarning)
+                                 doctor, RULES, ERROR, TraceSafetyWarning,
+                                 check_balance, check_census,
+                                 diagnose_donation, serving_check)
+from paddle_tpu.analysis import donation_doctor, serving_lint
 from paddle_tpu.analysis.diagnostics import scan_statement
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "analysis")
+
+
+def _load_fixture(name):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        f"_analysis_fixture_{name}", os.path.join(FIXTURES, f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 
 def _t(a):
@@ -646,6 +661,470 @@ class TestCli:
         assert diags[0].severity == ERROR
 
 
+def _jx():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+class TestServingLintRules:
+    """PTA51x: thread-ownership & lock-discipline doctrine as code."""
+
+    def _codes(self, src):
+        return [d.code for d in serving_lint.lint_source(src, "t.py")]
+
+    def test_pta510_engine_mutation_outside_worker(self):
+        src = """
+class Supervisor:
+    def kill(self, worker):
+        worker.engine.close()
+"""
+        assert self._codes(src) == ["PTA510"]
+
+    def test_pta510_worker_owned_methods_are_clean(self):
+        src = """
+import threading
+
+class Worker:
+    def __init__(self):
+        self.t = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        self._step()
+
+    def _step(self):
+        self.engine.step()
+"""
+        assert self._codes(src) == []
+
+    def test_pta510_alias_is_tracked(self):
+        src = """
+class Supervisor:
+    def reap(self):
+        eng = self.engine
+        eng.abort(1)
+"""
+        assert self._codes(src) == ["PTA510"]
+
+    def test_pta511_handle_mutation_needs_lock(self):
+        src = """
+class Router:
+    def mark(self, handle):
+        handle.failing_over = True
+"""
+        assert self._codes(src) == ["PTA511"]
+        locked = """
+class Router:
+    def mark(self, handle):
+        with handle.lock:
+            handle.failing_over = True
+"""
+        assert self._codes(locked) == []
+
+    def test_pta512_blocking_under_lock(self):
+        src = """
+class W:
+    def pump(self):
+        with self.lock:
+            item = self.q.get()
+"""
+        assert self._codes(src) == ["PTA512"]
+        # dict.get(key, default) is a lookup, not a blocking wait
+        lookup = """
+class W:
+    def pump(self):
+        with self.lock:
+            n = self.ordinals.get(("a", "b"), 0)
+"""
+        assert self._codes(lookup) == []
+
+    def test_pta513_wallclock_in_fault_scope(self):
+        src = """
+import time
+
+class FaultPlan:
+    def schedule(self):
+        return time.monotonic()
+"""
+        assert self._codes(src) == ["PTA513"]
+        # failover paths are not fault-injection paths
+        other = """
+import time
+
+class FailoverPolicy:
+    def delay(self):
+        return time.monotonic()
+"""
+        assert self._codes(other) == []
+
+    def test_pta514_undisciplined_thread(self):
+        src = """
+import threading
+
+class P:
+    def start(self):
+        self.t = threading.Thread(target=self._run)
+
+    def _run(self):
+        pass
+"""
+        assert self._codes(src) == ["PTA514"]
+        joined = src.replace("def _run", """def stop(self):
+        self.t.join()
+
+    def _run""")
+        assert self._codes(joined) == []
+
+    @pytest.mark.parametrize("code", ["510", "511", "512", "513", "514"])
+    def test_fixture_fires_exactly_once_and_noqa_suppresses(self, code):
+        path = os.path.join(FIXTURES, f"pta{code}.py")
+        diags = serving_lint.lint_file(path)
+        assert [d.code for d in diags] == [f"PTA{code}"]
+        d = diags[0]
+        assert d.file == path and d.line > 0
+        # the fixture's noqa'd counterpart was suppressed: the same
+        # construct appears >= twice in the source
+        with open(path) as fh:
+            assert fh.read().count(f"noqa: PTA{code}") == 1
+
+    def test_serving_check_maps_to_real_source(self):
+        class Rogue:
+            def kill(self, worker):
+                worker.engine.close()
+
+        diags = serving_check(Rogue)
+        assert [d.code for d in diags] == ["PTA510"]
+        assert diags[0].file.endswith("test_analysis.py")
+        assert diags[0].line > 0
+
+
+class TestDonationDoctor:
+    """PTA60x: donation discipline, AST and jaxpr level."""
+
+    def _codes(self, src):
+        return [d.code for d in donation_doctor.lint_source(src, "t.py")]
+
+    def test_pta601_use_after_donate(self):
+        src = """
+class E:
+    def dispatch(self, step):
+        fn = CompiledFn(step, donate_argnums=(0,))
+        out = fn(self.buf)
+        return self.buf.sum()
+"""
+        assert self._codes(src) == ["PTA601"]
+        rebound = """
+class E:
+    def dispatch(self, step):
+        fn = CompiledFn(step, donate_argnums=(0,))
+        out = fn(self.buf)
+        self.buf = out
+        return self.buf.sum()
+"""
+        assert self._codes(rebound) == []
+
+    def test_pta602_double_donation(self):
+        src = """
+class E:
+    def dispatch(self, step):
+        fn = CompiledFn(step, donate_argnums=(0, 1))
+        out = fn(self.buf, self.buf)
+        self.buf = out
+        return out
+"""
+        assert self._codes(src) == ["PTA602"]
+
+    def test_pta603_unrebound_engine_state(self):
+        src = """
+class E:
+    def dispatch(self, step):
+        fn = CompiledFn(step, donate_argnums=(0,))
+        out = fn(self.pool.k)
+        return out
+"""
+        assert self._codes(src) == ["PTA603"]
+        rebound = """
+class E:
+    def dispatch(self, step):
+        fn = CompiledFn(step, donate_argnums=(0,))
+        out = fn(self.pool.k)
+        self.pool.rebind(out)
+        return out
+"""
+        assert self._codes(rebound) == []
+
+    def test_donate_spec_resolves_ifexp_and_augassign(self):
+        # the real engine shape: accumulated literal + conditional spec
+        src = """
+class E:
+    def build(self, donate, quant):
+        spec = (1, 2)
+        if quant:
+            spec += (3, 4)
+        fn = CompiledFn(step, donate_argnums=spec if donate else ())
+        out = fn(x, self.a, self.b, self.c, self.d)
+        self.a, self.b = out[:2]
+        self.c, self.d = out[2:]
+        return out
+"""
+        assert self._codes(src) == []
+
+    @pytest.mark.parametrize("code", ["601", "602", "603"])
+    def test_fixture_fires_exactly_once_and_noqa_suppresses(self, code):
+        path = os.path.join(FIXTURES, f"pta{code}.py")
+        diags = donation_doctor.lint_file(path)
+        assert [d.code for d in diags] == [f"PTA{code}"]
+        assert diags[0].file == path and diags[0].line > 0
+
+    def test_pta604_unfulfillable_donation_jaxpr(self):
+        jnp = _jx()
+        a = jnp.ones((4, 4))
+        mod = _load_fixture("pta604")
+        diags = diagnose_donation(mod.unfulfillable, a, a,
+                                  donate_argnums=(0,))
+        assert [d.code for d in diags] == ["PTA604"]
+        assert diags[0].file.endswith("pta604.py") and diags[0].line > 0
+        assert diagnose_donation(mod.unfulfillable_suppressed, a, a,
+                                 donate_argnums=(0,)) == []
+        assert diagnose_donation(mod.fulfillable, a, a,
+                                 donate_argnums=(0,)) == []
+
+    def test_pta602_out_of_range_and_duplicate_argnums(self):
+        jnp = _jx()
+
+        def f(a):
+            return a
+
+        diags = diagnose_donation(f, jnp.ones(3), donate_argnums=(0, 0))
+        assert "PTA602" in {d.code for d in diags}
+        diags = diagnose_donation(f, jnp.ones(3), donate_argnums=(5,))
+        assert [d.code for d in diags] == ["PTA602"]
+
+    def test_diagnose_donation_accepts_compiled_fn(self):
+        from paddle_tpu.serving.engine import CompiledFn
+
+        jnp = _jx()
+
+        def step(a, b):
+            return (a + b).sum()   # scalar out: donation unfulfillable
+
+        fn = CompiledFn(step, donate_argnums=(0,))
+        diags = diagnose_donation(fn, jnp.ones((4, 4)), jnp.ones((4, 4)))
+        assert [d.code for d in diags] == ["PTA604"]
+
+
+class TestCollectiveBalance:
+    """PTA70x: static balance + census verification, no execution."""
+
+    def test_pta701_unbalanced_cond(self):
+        jnp = _jx()
+        mod = _load_fixture("pta701")
+        x = jnp.ones(4)
+        diags = check_balance(mod.lopsided, x, True, axis_sizes={"dp": 2})
+        assert [d.code for d in diags] == ["PTA701"]
+        assert diags[0].file.endswith("pta701.py") and diags[0].line > 0
+        assert check_balance(mod.lopsided_suppressed, x, True,
+                             axis_sizes={"dp": 2}) == []
+        assert check_balance(mod.balanced, x, True,
+                             axis_sizes={"dp": 2}) == []
+
+    def test_pta702_collective_in_while(self):
+        jnp = _jx()
+        mod = _load_fixture("pta702")
+        x = jnp.ones(4)
+        diags = check_balance(mod.chatty_loop, x, axis_sizes={"dp": 2})
+        assert [d.code for d in diags] == ["PTA702"]
+        assert check_balance(mod.chatty_loop_suppressed, x,
+                             axis_sizes={"dp": 2}) == []
+        assert check_balance(mod.quiet_loop, x, axis_sizes={"dp": 2}) == []
+
+    def test_pta703_unbound_axis(self):
+        jnp = _jx()
+        mod = _load_fixture("pta703")
+        x = jnp.ones(4)
+        diags = check_balance(mod.stray_axis, x,
+                              axis_env=[("mystery", 2)])
+        assert [d.code for d in diags] == ["PTA703"]
+        # declaring the axis (axis_sizes) binds it
+        assert check_balance(mod.stray_axis, x,
+                             axis_sizes={"mystery": 2}) == []
+        assert check_balance(mod.stray_axis_suppressed, x,
+                             axis_env=[("mystery", 2)]) == []
+
+    def test_pta704_census_drift(self):
+        jnp = _jx()
+        mod = _load_fixture("pta704")
+        x = jnp.ones(4)
+        expected = {("psum", "dp"): 1}
+        diags = check_census(mod.census_drifter, (x,), expected=expected,
+                             axis_sizes={"dp": 2})
+        assert [d.code for d in diags] == ["PTA704"]
+        assert diags[0].file.endswith("pta704.py") and diags[0].line > 0
+        assert check_census(mod.census_drifter_suppressed, (x,),
+                            expected=expected, axis_sizes={"dp": 2}) == []
+        assert check_census(mod.census_exact, (x,), expected=expected,
+                            axis_sizes={"dp": 2}) == []
+
+    def test_census_registry_formulas(self):
+        from paddle_tpu.analysis import register_expected_census
+
+        jnp = _jx()
+        register_expected_census(
+            "test-psum-linear", lambda n: {("psum", "dp"): n})
+
+        def f(x):
+            from jax import lax
+
+            return lax.psum(x, "dp")
+
+        assert check_census(f, (jnp.ones(4),), name="test-psum-linear",
+                            formula_kwargs={"n": 1},
+                            axis_sizes={"dp": 2}) == []
+        drift = check_census(f, (jnp.ones(4),), name="test-psum-linear",
+                             formula_kwargs={"n": 3},
+                             axis_sizes={"dp": 2})
+        assert [d.code for d in drift] == ["PTA704"]
+        with pytest.raises(ValueError, match="registered formula"):
+            check_census(f, (jnp.ones(4),), name="no-such-formula")
+
+    def test_multichip_decode_census_reproduced_statically(self):
+        """The acceptance gate: the balance checker reproduces the
+        committed MULTICHIP decode census (psum=L*h,
+        all_gather=(3L+1)*h) from the REAL compiled decode program
+        without executing it, and finds the program balanced."""
+        from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+        from paddle_tpu.serving import EngineConfig, MeshEngine
+
+        cfg = GPTConfig(vocab_size=128, hidden_size=64,
+                        intermediate_size=128, num_hidden_layers=2,
+                        num_attention_heads=4,
+                        max_position_embeddings=64)
+        paddle.seed(0)
+        m = GPTForCausalLM(cfg)
+        m.eval()
+        eng = MeshEngine(m, EngineConfig(num_slots=2, max_seq_len=32,
+                                         max_horizon=4,
+                                         prefix_block_size=4,
+                                         prefix_cache_bytes=0),
+                         tp=2, register_profiler=False)
+        try:
+            L, h = 2, 4
+            fn, args = eng.decode_census_program(horizon=h)
+            expected = eng.expected_decode_census(horizon=h)
+            assert expected == {("psum", "tp"): L * h,
+                                ("all_gather", "tp"): (3 * L + 1) * h}
+            assert check_census(fn, args, expected=expected) == []
+            # and a deliberately-wrong formula is caught
+            bad = dict(expected)
+            bad[("psum", "tp")] += 1
+            assert [d.code for d in
+                    check_census(fn, args, expected=bad)] == ["PTA704"]
+            # balance: shard_map binds "tp" even under lax.scan
+            assert check_balance(fn, *args) == []
+        finally:
+            eng.close()
+
+
+class TestGraphDoctorShardMapScan:
+    def test_pta505_respects_shard_map_bound_axes_under_scan(self):
+        """Regression: shard_map under lax.scan (the MeshEngine decode
+        shape) binds its mesh axes for the body — PTA505 must not
+        fire, and truly-unbound axes still must."""
+        import jax
+        from jax import lax
+        from jax.sharding import Mesh
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        jnp = _jx()
+        mesh = Mesh(np.array(jax.devices("cpu")[:2]), ("tp",))
+
+        def body(x):
+            return x + lax.psum(x, "tp")
+
+        smapped = shard_map(body, mesh=mesh, in_specs=P("tp"),
+                            out_specs=P("tp"))
+
+        def scanned(x):
+            def step(carry, _):
+                return smapped(carry), None
+
+            out, _ = lax.scan(step, x, None, length=3)
+            return out
+
+        closed = jax.make_jaxpr(scanned)(jnp.ones(2))
+        diags = diagnose_jaxpr(closed, mesh_axes=set())
+        assert not any(d.code == "PTA505" for d in diags)
+        # the doctor and the balance checker agree (no double report)
+        assert not any(d.code == "PTA703"
+                       for d in check_balance(scanned, jnp.ones(2)))
+
+
+class TestServingCli:
+    def test_serving_flag_runs_phase2_analyzers(self, capsys):
+        from paddle_tpu.analysis.cli import main
+
+        path = os.path.join(FIXTURES, "pta510.py")
+        assert main([path]) == 0          # phase 1 alone: clean
+        assert main(["--serving", path]) == 1
+        out = capsys.readouterr().out
+        assert "PTA510" in out
+
+    def test_json_mode_and_exit_contract(self, capsys):
+        import json
+
+        from paddle_tpu.analysis.cli import main
+
+        path = os.path.join(FIXTURES, "pta511.py")
+        assert main(["--serving", "--json", path]) == 1
+        report = json.loads(capsys.readouterr().out)
+        assert report["files"] == 1 and report["errors"] == 1
+        [diag] = report["diagnostics"]
+        assert diag["code"] == "PTA511" and diag["file"] == path
+        assert diag["line"] > 0 and "lock" in diag["hint"]
+
+    def test_json_clean_run(self, tmp_path, capsys):
+        import json
+
+        from paddle_tpu.analysis.cli import main
+
+        good = tmp_path / "good.py"
+        good.write_text("def f(x):\n    return x + 1\n")
+        assert main(["--serving", "--json", str(good)]) == 0
+        report = json.loads(capsys.readouterr().out)
+        assert report == {"files": 1, "errors": 0, "warnings": 0,
+                          "diagnostics": []}
+
+    def test_overlapping_paths_deduped(self, capsys):
+        import json
+
+        from paddle_tpu.analysis.cli import main
+
+        path = os.path.join(FIXTURES, "pta511.py")
+        assert main(["--serving", "--json", path, FIXTURES, path]) == 1
+        report = json.loads(capsys.readouterr().out)
+        n511 = [d["code"] for d in report["diagnostics"]].count("PTA511")
+        assert n511 == 1
+
+    def test_missing_path_and_internal_error_exit_two(self, capsys):
+        from paddle_tpu.analysis.cli import main
+
+        assert main(["/nonexistent/nowhere.py"]) == 2
+
+    def test_repo_serving_gate_is_clean(self):
+        """The acceptance gate CI runs: zero unsuppressed findings over
+        the serving stack, strict (warnings fail too)."""
+        from paddle_tpu.analysis.cli import main
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        pkg = os.path.join(root, "paddle_tpu")
+        paths = [os.path.join(pkg, "serving"),
+                 os.path.join(pkg, "serving", "gateway"),
+                 os.path.join(pkg, "serving", "sharded"),
+                 os.path.join(pkg, "observability")]
+        assert main(["--serving", "--strict", "--json"] + paths) == 0
+
+
 def test_rule_code_count_meets_acceptance():
     """The issue requires >= 8 distinct demonstrated rule codes; keep the
     registry honest about what this suite demonstrates."""
@@ -654,6 +1133,10 @@ def test_rule_code_count_meets_acceptance():
         "PTA007", "PTA101", "PTA102", "PTA103", "PTA201", "PTA202",
         "PTA203", "PTA301", "PTA302", "PTA401", "PTA402",
         "PTA501", "PTA502", "PTA503", "PTA504", "PTA505",
+        # phase 2: serving-stack verifiers
+        "PTA510", "PTA511", "PTA512", "PTA513", "PTA514",
+        "PTA601", "PTA602", "PTA603", "PTA604",
+        "PTA701", "PTA702", "PTA703", "PTA704",
     }
     assert demonstrated <= (set(RULES) | {"PTA000"})
     assert len(demonstrated) >= 8
