@@ -706,6 +706,125 @@ def _bench_spec_decode(backend, on_tpu, rng):
     return rows
 
 
+def _structured_vocab(size, eos_id=95):
+    """Printable single-char tokens (ids 0..94), ``<eos>`` at 95, JSON
+    skeleton multi-char tokens, ``<unusedN>`` padding to the model's
+    vocab size — the token-string table the grammar compiler
+    crossproducts against."""
+    vocab = [chr(32 + i) for i in range(95)]
+    vocab.append("<eos>")
+    vocab.extend(['{"', '":', '",', '"}', '": "', '", "', '},{"',
+                  'true', 'false', 'null', '["', '"]', '":"'])
+    while len(vocab) < size:
+        vocab.append(f"<unused{len(vocab)}>")
+    return vocab
+
+
+def _bench_structured(backend, on_tpu, rng):
+    """Structured-generation ablation and gate: greedy tok/s on a JSON
+    workload (array-of-objects schema, unbounded length so lanes run to
+    the token budget) vs the free-text baseline, K in {0, 4}, forced
+    drafting on/off.
+
+    The acceptance gate: **structured decode with forced drafting must
+    not be slower than free-text decode at the same draft width** — the
+    grammar mask adds one gather + one ``where`` per window, and the
+    JSON skeleton's sole-legal-token states hand the drafter free
+    accepts that more than pay it back (same-K comparison isolates the
+    constraint cost; the K-vs-0 speculation tradeoff is the spec_decode
+    section's gate, and on a compute-bound CPU proxy the K+1-wide
+    verify forward legitimately loses to width-1 decode).  Constrained
+    rows also report forced-token and accept-length telemetry from
+    ``stats()``."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.serving import Engine, EngineConfig, SamplingParams
+
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=32000, hidden_size=1536,
+                        intermediate_size=4096, num_hidden_layers=12,
+                        num_attention_heads=12,
+                        max_position_embeddings=1024)
+        max_seq, new_tokens = 768, 128
+    else:
+        cfg = GPTConfig(vocab_size=1024, hidden_size=256,
+                        intermediate_size=512, num_hidden_layers=2,
+                        num_attention_heads=4,
+                        max_position_embeddings=128)
+        max_seq, new_tokens = 96, 32
+
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    vocab, eos = _structured_vocab(cfg.vocab_size), 95
+    schema = {"type": "array",
+              "items": {"type": "object",
+                        "properties": {"a": {"enum": ["x", "y"]},
+                                       "b": {"type": "boolean"}},
+                        "required": ["a", "b"]}}
+    prompt = rng.randint(0, cfg.vocab_size, 16).tolist()
+    n_req = 8
+    variants = (
+        ("free-text", 0, None, True),
+        ("free-text", 4, None, True),
+        ("structured", 0, schema, True),
+        ("structured", 4, schema, False),     # plain n-gram drafts
+        ("structured", 4, schema, True),      # + forced-token drafts
+    )
+    rows, tps = [], {}
+    for workload, k, grammar, fd in variants:
+        eng = Engine(model, EngineConfig(
+            num_slots=n_req, max_seq_len=max_seq, max_horizon=8,
+            spec_k=k, spec_adaptive=False,
+            grammar_max_states=256 if grammar else 0,
+            grammar_vocab=vocab if grammar else None,
+            grammar_forced_drafting=fd), register_profiler=False)
+        sp = (SamplingParams(max_new_tokens=new_tokens,
+                             eos_token_id=eos) if grammar
+              else SamplingParams(max_new_tokens=new_tokens))
+        best, toks = None, 0
+        for it in range(4):                    # it 0 warms the compiles
+            reqs = [eng.submit(list(prompt), sp, grammar=grammar)
+                    for _ in range(n_req)]
+            eng.admit()                        # prefill outside window
+            t0 = time.time()
+            while eng.scheduler.has_work:
+                eng.step(horizon=8)
+            dt = time.time() - t0
+            if it and (best is None or dt < best):
+                best, toks = dt, sum(len(r.output_ids) for r in reqs)
+        s = eng.stats()
+        eng.close()
+        key = (workload, k, fd)
+        tps[key] = toks / best
+        row = {
+            "metric": f"engine structured tokens/s b{n_req} K{k} "
+                      f"[{workload}{'+forced' if grammar and k and fd else ''}"
+                      f"] (prefill {len(prompt)} + <= {new_tokens} new, "
+                      f"{backend})",
+            "value": round(tps[key], 1),
+            "unit": "tokens/s",
+            "per_token_ms": round(best * 1000.0 / toks, 3),
+            "spec_k": k,
+        }
+        if grammar:
+            row["forced_tokens"] = s["structured"]["forced_tokens"]
+        if k:
+            row["mean_accept_len"] = round(s["spec"]["mean_accept_len"],
+                                           3)
+        rows.append(row)
+    # the gate: at the same draft width, the grammar mask + forced
+    # drafting must not lose to free-text decode
+    gated, baseline = tps[("structured", 4, True)], tps[("free-text", 4,
+                                                         True)]
+    print(f"structured+forced K4 {gated:.1f} tok/s vs free-text K4 "
+          f"{baseline:.1f} tok/s (gate: >=)")
+    assert gated >= baseline, (
+        f"structured decode with forced drafting ({gated:.1f} tok/s) "
+        f"slower than free-text at the same K ({baseline:.1f} tok/s)")
+    return rows
+
+
 def _bench_quant_ablation(backend, on_tpu, rng):
     """Quantized-serving ablation (int8 weight-only decode + int8 paged
     KV) — the PR-8 levers on the decode roofline's two byte streams:
@@ -1383,9 +1502,9 @@ def _git_sha():
 #: --only choices: "core" is the raw per-step/scan driver loop, the
 #: rest map 1:1 onto the _bench_* section functions
 SECTIONS = ("core", "engine_horizons", "engine", "paged_ablation",
-            "prefix_prefill", "spec_decode", "quant_ablation",
-            "sharded", "tracing_overhead", "observatory_overhead",
-            "gateway", "failover")
+            "prefix_prefill", "spec_decode", "structured",
+            "quant_ablation", "sharded", "tracing_overhead",
+            "observatory_overhead", "gateway", "failover")
 
 
 def main(argv=None):
@@ -1531,6 +1650,8 @@ def main(argv=None):
         results.extend(_bench_prefix_prefill(backend, on_tpu, rng))
     if "spec_decode" in only:
         results.extend(_bench_spec_decode(backend, on_tpu, rng))
+    if "structured" in only:
+        results.extend(_bench_structured(backend, on_tpu, rng))
     if "quant_ablation" in only:
         results.extend(_bench_quant_ablation(backend, on_tpu, rng))
     if "sharded" in only:

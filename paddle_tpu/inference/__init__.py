@@ -180,7 +180,20 @@ def create_llm_engine(model, mesh_shape=None, tp=None, **config_kwargs):
     telemetry_port — start an HTTP telemetry endpoint (``/metrics``,
     ``/healthz``, ``/readyz``, ``/debug/requests``, ``/debug/slo``,
     ``/trace``) on a background thread at engine construction, 0 for an
-    ephemeral port, stopped by ``engine.close()``).
+    ephemeral port, stopped by ``engine.close()``;
+    grammar_max_states / grammar_vocab / grammar_forced_drafting —
+    structured generation: ``grammar_max_states=N`` (rows of the
+    device-resident DFA slab; 0, the default, disables and keeps every
+    compiled program grammar-free) plus ``grammar_vocab`` (token-id ->
+    string list the grammar compiler crossproducts against) let
+    ``engine.submit(..., grammar=...)`` take a regex string, a
+    JSON-schema dict, or a ``GrammarSpec`` — constrained lanes emit
+    only grammar-legal tokens (EOS exactly at accept states; requires
+    ``eos_token_id``), stay bitwise batched-vs-sequential, and share
+    the compiled program with free lanes via the accept-all sentinel;
+    ``grammar_forced_drafting`` (default True, needs ``spec_k > 0``)
+    drafts sole-legal-token chains ahead of n-gram proposals so JSON
+    skeleton punctuation is accepted at draft price).
 
     ``mesh_shape`` / ``tp`` pick the sharded engine: ``tp=N`` (or
     ``mesh_shape=(1, N)``; both knobs must agree when both are given)

@@ -116,7 +116,7 @@ class RequestTrace:
         or per-tenant quota bills against; summed across requests these
         reconstruct the engine's dispatch totals)."""
         tokens = prefix_hit = preempts = horizons = accepted = 0
-        aborted = failovers = resumed_tokens = 0
+        aborted = failovers = resumed_tokens = forced = 0
         flops = bytes_est = 0.0
         for kind, _, args in self._snapshot():
             if kind == FIRST_TOKEN:
@@ -126,6 +126,7 @@ class RequestTrace:
             elif kind == DECODE:
                 tokens += args.get("tokens", 0)
                 accepted += args.get("accepted", 0)
+                forced += args.get("forced", 0)
                 horizons += 1
             elif kind in (PREFILL, RESUME):
                 # last admission wins, matching the engine's
@@ -145,7 +146,8 @@ class RequestTrace:
                 bytes_est += args.get("bytes_est", 0.0)
         return {"tokens_emitted": tokens, "prefix_hit_tokens": prefix_hit,
                 "preemptions": preempts, "decode_horizons": horizons,
-                "spec_accepted_tokens": accepted, "aborted": aborted,
+                "spec_accepted_tokens": accepted,
+                "spec_forced_tokens": forced, "aborted": aborted,
                 "failovers": failovers, "resumed_tokens": resumed_tokens,
                 "flops_est": flops, "bytes_est": bytes_est}
 

@@ -71,6 +71,21 @@ around three ideas the benches point at (DECODE_BENCH.json):
   replicas (rendezvous-hashed radix-cache-block keys; SLO-unhealthy
   replicas stop receiving sessions).  Import from
   ``paddle_tpu.serving.gateway``;
+* **structured generation** (structured/ + engine.py + drafter.py) —
+  grammar-constrained decoding: a regex or JSON-schema request grammar
+  compiles to a token-level DFA over the vocab (regex → NFA →
+  minimized char DFA → vocab crossproduct, dense transitions + packed
+  legality bitmask), per-lane DFA states ride the donated decode-scan
+  carry like ``pos``/``counts``, and disallowed logits drop to a
+  finite floor inside ``sample_window`` BEFORE the greedy fast path /
+  categorical — constrained output is always grammar-valid, bitwise
+  batched-vs-sequential under the same ``fold_in`` PRNG, and free
+  lanes ride an accept-all sentinel state at zero cost.  States whose
+  sole legal token is forced (JSON skeleton punctuation) feed the
+  drafter ahead of its n-gram guesses (``forced_chain``), turning
+  grammar structure into ~free speculative accepts.  With
+  ``grammar_max_states=0`` every grammar argument threads ``None`` and
+  the compiled programs are the unconstrained ones;
 * **fault tolerance** (faults.py + gateway/router.py) — deterministic
   seeded fault injection (:class:`FaultPlan`/:class:`FaultInjector`:
   schedules keyed by dispatch ordinals, never wall clocks), a
@@ -99,7 +114,7 @@ Counters (queue depth, TTFT, tokens/s, slot utilization, compile-cache
 hits) are exposed through ``paddle_tpu.profiler.counters()``.
 """
 
-from .drafter import draft_tokens
+from .drafter import draft_tokens, forced_chain
 from .engine import CompiledFn, Engine, EngineConfig
 from .faults import (FaultInjector, FaultPlan, FaultSpec, RetryPolicy,
                      TransientSubmitError, WorkerCrash, WorkerDeadError)
@@ -112,6 +127,9 @@ from .prefix_cache import PrefixCache, PrefixLease
 from .sampling import SamplingParams
 from .scheduler import Request, Scheduler
 from .sharded import MeshEngine, ServingSpecLayout
+from .structured import (GrammarError, GrammarSlab, GrammarSpec,
+                         TokenDFA, compile_grammar, compile_regex,
+                         schema_to_regex)
 
 __all__ = [
     "Engine", "EngineConfig", "CompiledFn",
@@ -119,7 +137,9 @@ __all__ = [
     "SlotKV", "SlottedKVCache",
     "PrefixCache", "PrefixLease",
     "SamplingParams", "Request", "Scheduler",
-    "draft_tokens",
+    "draft_tokens", "forced_chain",
+    "GrammarError", "GrammarSlab", "GrammarSpec", "TokenDFA",
+    "compile_grammar", "compile_regex", "schema_to_regex",
     "Gateway", "GatewayConfig", "EngineWorker", "PrefixAffinityRouter",
     "TenantQuotas", "FleetSupervisor",
     "FaultPlan", "FaultSpec", "FaultInjector", "RetryPolicy",

@@ -85,3 +85,36 @@ def draft_tokens(hist, lengths, k, ngram=2):
         return jnp.where(valid, cand, -1).astype(jnp.int32)
 
     return jax.vmap(one)(hist, lengths)
+
+
+def forced_chain(states, next_table, forced, k):
+    """Constraint-aware draft proposals: chain the grammar's FORCED
+    tokens from each lane's DFA state.
+
+    When a lane's DFA state admits exactly one legal token — closing
+    braces, quoted keys, commas, the skeleton of any JSON output —
+    ``forced[state]`` names it and the model's verify forward must
+    agree (every other logit is at the mask floor), so proposing it is
+    a ~100%-acceptance draft.  Chains extend while each successor state
+    stays forced, up to ``k``; the first non-forced state ends the
+    chain with ``-1`` sentinels from there on, and the engine overlays
+    these proposals on the n-gram drafter's (forced wins where
+    present).  Unconstrained lanes sit in the accept-all sentinel state
+    whose ``forced`` entry is ``-1``, so they never chain.
+
+    states [N] int32       per-lane DFA state ids (slab-global rows)
+    next_table [S, V] i32  dense transition table (slab rows)
+    forced [S] int32       the state's sole legal token, or -1
+    k                      static chain length (the draft width)
+
+    Returns [N, k] int32 proposals with ``-1`` where not forced.
+    """
+    cols = []
+    st = states
+    ok = None
+    for _ in range(k):
+        f = forced[st]
+        ok = (f >= 0) if ok is None else (ok & (f >= 0))
+        cols.append(jnp.where(ok, f, -1))
+        st = jnp.where(ok, next_table[st, jnp.maximum(f, 0)], st)
+    return jnp.stack(cols, axis=1)

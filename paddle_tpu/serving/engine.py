@@ -111,14 +111,16 @@ from ..observability import metrics as _obs_metrics
 from ..observability import profiling as _obs_profiling
 from ..observability import tracing as _obs_tracing
 from ..observability.span import span as _obs_span
-from .drafter import draft_tokens
+from .drafter import draft_tokens, forced_chain
 from .faults import (DEGRADE_LEVELS, FAULT_POOL_EXHAUSTED,
                      SITE_ENGINE_ADMIT, _SRV_DEGRADATION, _SRV_SHED)
 from .kv_cache import PagedKV, PagedKVCache
 from .prefix_cache import PrefixCache
-from .sampling import (SamplingParams, request_key, sample_token,
-                       sample_window)
+from .sampling import (MASK_FLOOR, SamplingParams, request_key,
+                       sample_token, sample_window)
 from .scheduler import Scheduler
+from .structured.grammar import (GrammarSlab, as_grammar_spec,
+                                 compile_grammar)
 
 # typed registry families the engine publishes into (labeled by engine
 # instance so two engines in one process stay distinguishable); the
@@ -186,6 +188,14 @@ _SRV_SPEC_RATE = _obs_metrics.gauge(
 _SRV_SPEC_EMA = _obs_metrics.gauge(
     "serving.spec_lane_accept_ema",
     "per-lane speculative acceptance EMA driving the adaptive gates")
+_SRV_SPEC_FORCED = _obs_metrics.counter(
+    "serving.spec_forced_tokens",
+    "accepted draft tokens proposed by the grammar's forced-token "
+    "chains (a subset of serving.spec_accepted_tokens)")
+_SRV_GRAMMAR_MASKED = _obs_metrics.histogram(
+    "serving.grammar_masked_fraction",
+    "fraction of the vocab masked per constrained emitted token",
+    buckets=(0.5, 0.9, 0.99, 0.999, 0.9999, 1.0))
 _SRV_KV_OCC = _obs_metrics.gauge(
     "serving.kv_pool_occupancy_ratio",
     "unified KV pool blocks in use / pool capacity")
@@ -460,6 +470,41 @@ class EngineConfig:
     degrade_pool_ratio: float = 0.92
     degrade_patience: int = 4
     degrade_recover_patience: int = 16
+    #: structured generation (grammar-constrained decoding): capacity of
+    #: the token-DFA state slab, in states.  0 (default) disables the
+    #: subsystem entirely — every grammar argument threads ``None``
+    #: (an empty pytree) through the compiled programs, so the knobs-off
+    #: decode/prefill programs are structurally the unconstrained ones.
+    #: Row 0 of the slab is the accept-all sentinel state unconstrained
+    #: lanes ride; request grammars are compiled to token DFAs and
+    #: installed at refcounted offsets >= 1, so mixed constrained /
+    #: free-text batches share one program (no per-grammar retracing).
+    grammar_max_states: int = 0
+    #: the tokenizer vocabulary as a sequence of token STRINGS indexed
+    #: by token id (ids >= len() are unreachable fillers).  Required to
+    #: compile grammars: the compiler walks every token's characters
+    #: through the grammar's character DFA to build the token-level
+    #: transition table and legality bitmask.
+    grammar_vocab: object = None
+    #: propose the grammar's forced-token chains (states with exactly
+    #: one legal token — JSON skeleton punctuation) ahead of the n-gram
+    #: drafter's guesses.  Forced proposals are ~100%-acceptance drafts;
+    #: the PR 7 acceptance rule and EMA gating are unchanged.
+    grammar_forced_drafting: bool = True
+
+
+def _unpack_mask(rows, vocab):
+    """Unpack packed legality-bitmask rows to a boolean mask.
+
+    rows [..., W32] uint32   bit ``t % 32`` of word ``t // 32`` set
+                             means token ``t`` is legal
+    Returns [..., vocab] bool.  A pure shift/compare — XLA fuses it
+    into the ``where`` that applies the mask, so the dense [S, vocab]
+    boolean form never materializes in HBM per state table."""
+    bits = jnp.arange(32, dtype=jnp.uint32)
+    b = (rows[..., :, None] >> bits) & jnp.uint32(1)
+    flat = b.reshape(rows.shape[:-1] + (rows.shape[-1] * 32,))
+    return flat[..., :vocab].astype(bool)
 
 
 class Engine:
@@ -573,6 +618,27 @@ class Engine:
         self._d_tables = None
         self._d_tables_nb = -1
 
+        # structured generation: per-lane DFA state ids (0 = the
+        # accept-all sentinel free lanes ride) mirror + the host-master
+        # slab of token-DFA tables.  The state column rides the donated
+        # decode carry exactly like pos/counts; the slab tables are
+        # loop-invariant operands re-uploaded only when installs or
+        # releases dirty them (like the block tables).
+        cap = int(self.config.grammar_max_states or 0)
+        if cap < 0:
+            raise ValueError(
+                f"grammar_max_states must be >= 0, got {cap}")
+        self._structured = cap > 0
+        self._grammar_slab = (GrammarSlab(cap, mc.vocab_size)
+                              if self._structured else None)
+        self._dfa_state = np.zeros(n, np.int32)
+        self._d_dfa_state = None
+        self._d_dfa_next = self._d_dfa_mask = self._d_dfa_forced = None
+        self._grammar_cache = {}     # (spec key, eos id) -> TokenDFA
+        self._grammar_keys = {}      # request_id -> slab segment key
+        self._grammar_cache_hits = 0
+        self._grammar_cache_misses = 0
+
         # donation buys in-place HBM pool updates on accelerators; CPU
         # would only warn that donation is unimplemented.  The scale
         # pools (args 16/17 decode, 10/11 prefill) are donated only when
@@ -585,6 +651,11 @@ class Engine:
         if self._kv_quant:
             decode_donate += (16, 17)
             prefill_donate += (10, 11)
+        if self._structured:
+            # the per-lane DFA state (arg 20) rides the scan carry like
+            # pos — donated; the slab tables (21-23) are loop-invariant
+            # inputs shared by every lane and are NOT donated
+            decode_donate += (20,)
         # program-card metadata: the human-readable bucket key of each
         # compiled program, read off the dispatch's own arguments
         # (decode: tables arg 13, horizon/k statics 18/19; prefill: the
@@ -624,6 +695,7 @@ class Engine:
         self._spec_accepted_tokens = 0
         self._spec_windows = 0           # verify windows of drafting lanes
         self._spec_accept_hist = {}      # tokens-emitted-per-window -> n
+        self._spec_forced_tokens = 0     # accepted forced-chain drafts
         self._kv_bytes_read = 0
         # engine-local cost-model totals: card FLOPs/bytes summed over
         # THIS engine's dispatches (card.dispatches is process-global
@@ -783,7 +855,8 @@ class Engine:
 
     def _prefill_fn(self, state_arrays, ids, lengths, prefix_lens,
                     tables, cow_src, cow_dst, counts, pool_k, pool_v,
-                    pool_ks, pool_vs, seeds, temps, top_ks, top_ps):
+                    pool_ks, pool_vs, seeds, temps, top_ks, top_ps,
+                    dfa_state=None, dfa_mask=None):
         """Batched fused prefill over the paged pool: one compiled
         dispatch prefills a whole admission batch.
 
@@ -816,7 +889,15 @@ class Engine:
         buffers (None on the fp path — an empty pytree, so the traced
         program is unchanged when the knob is off).  The COW copy moves
         a block's scales with its bytes, keeping every stored token's
-        dequantization step attached to it."""
+        dequantization step attached to it.
+
+        ``dfa_state``/``dfa_mask`` are the structured-generation lane
+        states ([L] slab-global row ids) and the slab legality bitmask —
+        the first sampled token of a constrained lane is masked to its
+        admission state's legal set.  Free and padding lanes ride row 0
+        (the accept-all sentinel), whose all-ones mask makes the
+        ``where`` a bitwise identity; with ``grammar_max_states=0`` both
+        thread None, leaving the traced program unchanged."""
         # COW first: duplicate-dst lanes (all no-COW lanes share dst 0)
         # write identical values, so the scatter is collision-safe
         pool_k = [pk.at[cow_dst].set(pk[cow_src]) for pk in pool_k]
@@ -834,6 +915,9 @@ class Engine:
         last = jax.vmap(
             lambda lg, n: jax.lax.dynamic_index_in_dim(
                 lg, n - 1, axis=0, keepdims=False))(logits, lengths)
+        if dfa_mask is not None:
+            allowed = _unpack_mask(dfa_mask[dfa_state], last.shape[-1])
+            last = jnp.where(allowed, last, MASK_FLOOR)
         keys = jax.vmap(request_key)(seeds, counts)
         first = jax.vmap(sample_token)(last, keys, temps, top_ks, top_ps)
         return (first, [nv.k for nv in new_views],
@@ -844,7 +928,8 @@ class Engine:
     def _decode_fn(self, state_arrays, tokens, pos, counts, active, hist,
                    gates, seeds, temps, top_ks, top_ps, eos_ids, limits,
                    tables, pool_k, pool_v, pool_ks, pool_vs, horizon,
-                   k_draft):
+                   k_draft, dfa_state=None, dfa_next=None,
+                   dfa_mask=None, dfa_forced=None):
         """The horizon-scanned fused decode: ``lax.scan`` over ``horizon``
         fused steps, all slots, static shapes everywhere — the pool is
         the scan carry (donated on accelerators, so writes are in-place
@@ -879,7 +964,28 @@ class Engine:
         A quantized pool's scale buffers (``pool_ks``/``pool_vs``) ride
         the scan carry beside the pools they describe; the fp path
         carries tuples of None — empty pytrees, so the scan's jaxpr is
-        unchanged with the knob off."""
+        unchanged with the knob off.
+
+        Structured generation adds the per-lane DFA state ``dfa_state``
+        to the carry (advanced only by EMITTED tokens, so it freezes
+        with the lane) and three loop-invariant slab tables:
+        ``dfa_next`` [S, V] dense transitions, ``dfa_mask`` [S, W32]
+        packed legality bits, ``dfa_forced`` [S] the state's sole legal
+        token or -1.  Verify-window position j is masked by the state
+        reached by walking ``drafts[:j]`` through ``dfa_next``; for
+        every emitted position that walk equals the true state over the
+        actually-emitted tokens (the acceptance chain only survives
+        position j when ``drafts[j]`` matched the mask-constrained
+        sample, so the first illegal or absent draft breaks the chain
+        there, and later positions — whose walked states are
+        garbage-but-in-bounds rows, REJECT storing row 0 — are never
+        emitted).  Masking happens before sampling inside
+        ``sample_window``, so the ``fold_in(seed, count)`` key
+        discipline and bitwise batched-vs-sequential parity carry over
+        verbatim; free lanes ride the accept-all sentinel row 0 whose
+        mask is the identity.  With ``grammar_max_states=0`` all four
+        grammar arguments thread None — empty pytrees, the
+        unconstrained program."""
         n, s = hist.shape
         lanes = jnp.arange(n)[:, None]
         j_idx = jnp.arange(k_draft + 1, dtype=counts.dtype)[None, :]
@@ -888,10 +994,17 @@ class Engine:
             pool_vs = [None] * len(pool_v)
 
         def body(carry, _):
-            tok, p, cnt, act, hb, pk, pv, pks, pvs = carry
+            tok, p, cnt, act, hb, ds, pk, pv, pks, pvs = carry
             if k_draft:
                 drafts = draft_tokens(hb, p + 1, k_draft,
                                       self.config.spec_ngram)
+                if (dfa_next is not None
+                        and self.config.grammar_forced_drafting):
+                    # constraint-aware drafting: forced-token chains
+                    # override the n-gram guesses BEFORE the gate mask,
+                    # so the EMA gating semantics are unchanged
+                    fd = forced_chain(ds, dfa_next, dfa_forced, k_draft)
+                    drafts = jnp.where(fd >= 0, fd, drafts)
                 drafts = jnp.where(gates[:, None], drafts, -1)
                 ids = jnp.concatenate(
                     [tok[:, None], jnp.maximum(drafts, 0)], axis=1)
@@ -900,7 +1013,19 @@ class Engine:
             views = [PagedKV(k, v, tables, p, ks, vs)
                      for k, v, ks, vs in zip(pk, pv, pks, pvs)]
             logits, new_views = self._run_model(state_arrays, ids, views)
-            e = sample_window(logits, seeds, cnt, temps, top_ks, top_ps)
+            if dfa_mask is not None:
+                sts = [ds]
+                for j in range(k_draft):
+                    sts.append(dfa_next[sts[-1],
+                                        jnp.maximum(drafts[:, j], 0)])
+                win_states = jnp.stack(sts, axis=1)
+                allowed = _unpack_mask(dfa_mask[win_states],
+                                       logits.shape[-1])
+                e = sample_window(logits, seeds, cnt, temps, top_ks,
+                                  top_ps, allowed=allowed)
+            else:
+                e = sample_window(logits, seeds, cnt, temps, top_ks,
+                                  top_ps)
             if k_draft:
                 chain = jnp.cumprod(
                     (drafts == e[:, :k_draft]).astype(jnp.int32), axis=1)
@@ -918,6 +1043,15 @@ class Engine:
             emitted = (j_idx <= n_acc[:, None]) & (prev_ok > 0) \
                 & act[:, None]
             n_emit = jnp.sum(emitted.astype(cnt.dtype), axis=1)
+            if dfa_next is not None:
+                # advance each lane's DFA by its emitted tokens only —
+                # frozen lanes emit nothing and keep their state
+                nds = ds
+                for j in range(k_draft + 1):
+                    nds = jnp.where(emitted[:, j],
+                                    dfa_next[nds, e[:, j]], nds)
+            else:
+                nds = ds
             done = act & jnp.any(emitted & stop, axis=1)
             last = jnp.take_along_axis(
                 e, jnp.maximum(n_emit - 1, 0)[:, None], axis=1)[:, 0]
@@ -929,18 +1063,18 @@ class Engine:
             cols = jnp.where(emitted, p[:, None] + 1 + j_idx, s)
             hb = hb.at[lanes, cols].set(e, mode="drop")
             harvest = jnp.where(emitted, e, -1)
-            return ((nxt, new_p, new_cnt, act & ~done, hb,
+            return ((nxt, new_p, new_cnt, act & ~done, hb, nds,
                      tuple(v.k for v in new_views),
                      tuple(v.v for v in new_views),
                      tuple(v.k_scale for v in new_views),
                      tuple(v.v_scale for v in new_views)), harvest)
 
-        init = (tokens, pos, counts, active, hist,
+        init = (tokens, pos, counts, active, hist, dfa_state,
                 tuple(pool_k), tuple(pool_v),
                 tuple(pool_ks), tuple(pool_vs))
-        (tok, p, cnt, act, hb, pk, pv, pks, pvs), toks = jax.lax.scan(
+        (tok, p, cnt, act, hb, ds, pk, pv, pks, pvs), toks = jax.lax.scan(
             body, init, None, length=horizon)
-        return ((tok, p, cnt, act, hb), list(pk), list(pv),
+        return ((tok, p, cnt, act, hb, ds), list(pk), list(pv),
                 list(pks), list(pvs), toks)
 
     # ------------------------------------------------------------ buckets
@@ -1058,9 +1192,101 @@ class Engine:
                   for r in self.scheduler.running.values())
         return self._pow2_floor(max(1, min(max_h, self._grow, rem)))
 
+    # ------------------------------------------------ structured decoding
+    def _norm_grammar(self, grammar, sampling):
+        """Validate and eagerly compile a request grammar; returns the
+        ``GrammarSpec`` or None.  All failures surface HERE — at
+        submit(), before anything queues — as ``GrammarError`` (for
+        unsupported grammar features, naming them) or ``ValueError``
+        (for engine-configuration problems)."""
+        if grammar is None:
+            return None
+        spec = as_grammar_spec(grammar)
+        if not self._structured:
+            raise ValueError(
+                "grammar-constrained request on an engine without "
+                "structured generation (set "
+                "EngineConfig.grammar_max_states > 0 and grammar_vocab)")
+        if sampling.eos_token_id is None:
+            raise ValueError(
+                "grammar-constrained requests require "
+                "sampling.eos_token_id: EOS is legal exactly in the "
+                "grammar's accept states, so without one the lane "
+                "could never legally stop")
+        key = (spec.key, int(sampling.eos_token_id))
+        if key in self._grammar_cache:
+            self._grammar_cache_hits += 1
+        else:
+            if self.config.grammar_vocab is None:
+                raise ValueError(
+                    "EngineConfig.grammar_vocab is required for "
+                    "structured generation: the compiler walks every "
+                    "vocab token's characters through the grammar")
+            self._grammar_cache[key] = compile_grammar(
+                spec, self.config.grammar_vocab,
+                int(sampling.eos_token_id),
+                vocab_size=self.model.config.vocab_size)
+            self._grammar_cache_misses += 1
+        return spec
+
+    def _dfa_admission_state(self, req):
+        """The slab-global DFA state a (re-)admitted constrained lane
+        samples its next token from: the grammar's start row advanced
+        by every token already emitted EXCEPT the last — the prefill
+        itself re-samples that one under the masked logits, the same
+        bitwise boundary check the PRNG resume path performs.  Fresh
+        admissions have no output yet and get the start row."""
+        key = self._grammar_keys[req.request_id]
+        st = self._grammar_slab.offset(key)
+        for t in req.output_ids[:-1]:
+            st = int(self._grammar_slab.next[st, int(t)])
+        return st
+
+    def _release_grammar(self, req):
+        """Drop a finished/aborted request's slab segment reference and
+        park its lane back on the accept-all sentinel."""
+        key = self._grammar_keys.pop(req.request_id, None)
+        if key is not None:
+            self._grammar_slab.release(key)
+        if req.slot is not None:
+            self._dfa_state[req.slot] = 0
+
+    def _sync_grammar_tables(self):
+        """Upload the grammar slab tables — only when an install or
+        release dirtied them.  Loop-invariant within a dispatch, like
+        the block tables."""
+        if not self._structured or not self._grammar_slab.dirty:
+            return
+        self._d_dfa_next = jnp.asarray(self._grammar_slab.next)
+        self._d_dfa_mask = jnp.asarray(self._grammar_slab.mask)
+        self._d_dfa_forced = jnp.asarray(self._grammar_slab.forced)
+        self._grammar_slab.dirty = False
+
+    def _grammar_prefill_args(self, dfa):
+        """The prefill dispatch's (dfa_state, dfa_mask) tail — Nones
+        with the knob off, so the fp/unconstrained program is traced
+        with empty pytrees exactly as before."""
+        if not self._structured:
+            return (None, None)
+        self._sync_grammar_tables()
+        return (jnp.asarray(dfa), self._d_dfa_mask)
+
+    def _grammar_program_args(self):
+        """The decode dispatch's grammar argument tail (dfa_state,
+        dfa_next, dfa_mask, dfa_forced) for a representative program
+        trace — used by the sharded engine's collective census so smoke
+        traces stay in lockstep with real dispatches.  Nones when
+        structured generation is off."""
+        if not self._structured:
+            return (None, None, None, None)
+        self._sync_grammar_tables()
+        return (jnp.zeros(self.config.num_slots, jnp.int32),
+                self._d_dfa_next, self._d_dfa_mask, self._d_dfa_forced)
+
     # ------------------------------------------------------------ API
     def submit(self, prompt_ids, sampling=None, priority=0,
-               deadline_s=None, tenant=None, resume_ids=None):
+               deadline_s=None, tenant=None, resume_ids=None,
+               grammar=None):
         """Queue one request; returns the Request handle (its
         ``output_ids`` fill in as the engine steps).
 
@@ -1082,7 +1308,19 @@ class Engine:
         identical across replicas holding the same weights) — then
         decode continues the stream exactly where the dead replica left
         off.  Requires ``len(resume_ids) < max_new_tokens`` (a resume
-        with nothing left to generate is the caller's to finish)."""
+        with nothing left to generate is the caller's to finish).
+
+        ``grammar`` constrains the request's output: a regex string, a
+        JSON-schema dict, or a prebuilt ``GrammarSpec``.  Validation
+        and compilation happen HERE, eagerly — an unsupported grammar
+        raises ``GrammarError`` (and the gateway maps it to a 400
+        ``invalid_grammar``) before anything queues.  Requires
+        ``grammar_max_states > 0``, ``grammar_vocab``, and a
+        ``sampling.eos_token_id`` (EOS is legal exactly in the
+        grammar's accept states; without it the lane could never
+        legally stop).  Compiled token DFAs are cached per
+        ``(grammar, eos)`` and installed into the slab refcounted, so
+        repeat grammars cost a dict hit."""
         if self._draining:
             raise RuntimeError("engine is draining; submissions refused")
         prompt_ids = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
@@ -1106,9 +1344,15 @@ class Engine:
                 f"resume_ids already holds {len(resume_ids)} tokens, "
                 f">= max_new_tokens {sampling.max_new_tokens}: nothing "
                 "left to generate")
+        grammar = self._norm_grammar(grammar, sampling)
         req = self.scheduler.submit(prompt_ids, sampling,
                                     priority=priority,
-                                    deadline_s=deadline_s, tenant=tenant)
+                                    deadline_s=deadline_s, tenant=tenant,
+                                    grammar=grammar)
+        if grammar is not None:
+            key = (grammar.key, int(sampling.eos_token_id))
+            self._grammar_slab.install(key, self._grammar_cache[key])
+            self._grammar_keys[req.request_id] = key
         if resume_ids:
             # cross-engine resume: admission re-prefills this history
             # through the preemption path (resumed => queue-head anchor
@@ -1132,6 +1376,8 @@ class Engine:
                 gw["tenant"] = req.tenant
             if resume_ids:
                 gw["resumed_tokens"] = len(resume_ids)
+            if grammar is not None:
+                gw["grammar"] = grammar.kind
             req.trace.add(_obs_tracing.QUEUED,
                           prompt_len=req.prompt_len,
                           max_new_tokens=sampling.max_new_tokens, **gw)
@@ -1307,8 +1553,13 @@ class Engine:
         temps = np.zeros(lanes, np.float32)
         top_ks = np.zeros(lanes, np.int32)
         top_ps = np.ones(lanes, np.float32)
+        # per-lane DFA admission states; 0 (accept-all sentinel) for
+        # free and padding lanes
+        dfa = np.zeros(lanes, np.int32)
         for i in range(n):
             req, lease, toks = batch[i], leases[i], all_tokens[i]
+            if req.grammar is not None:
+                dfa[i] = self._dfa_admission_state(req)
             suffix = toks[lease.matched_tokens:]
             ids[i, :len(suffix)] = suffix
             lengths[i] = len(suffix)
@@ -1338,7 +1589,8 @@ class Engine:
                 self.pool.k, self.pool.v,
                 self.pool.k_scale, self.pool.v_scale,
                 jnp.asarray(seeds), jnp.asarray(temps),
-                jnp.asarray(top_ks), jnp.asarray(top_ps))
+                jnp.asarray(top_ks), jnp.asarray(top_ps),
+                *self._grammar_prefill_args(dfa))
         self.pool.rebind(new_k, new_v, new_ks, new_vs)
         self._prefill_calls += 1
         self._prefill_requests += n
@@ -1409,6 +1661,12 @@ class Engine:
             self._hist[slot, len(all_tokens[i]) + 1:] = 0
             self._spec_ema[slot] = 1.0   # optimistic: draft until shown
             self._spec_gates[slot] = True  # not to pay off
+            # the lane's DFA state AFTER the prefill-sampled token: the
+            # admission state advanced one transition (sentinel row 0
+            # self-loops, so free lanes stay at 0)
+            self._dfa_state[slot] = (
+                int(self._grammar_slab.next[int(dfa[i]), tok])
+                if req.grammar is not None else 0)
             self._seeds[slot] = np.uint32(s.seed)
             self._counts[slot] = req.n_generated
             self._temps[slot] = s.temperature
@@ -1428,6 +1686,8 @@ class Engine:
         # retire), blocks the radix store adopted live on under its
         # references, and the zeroed row routes any still-masked lane
         # writes to scratch
+        if self._structured:
+            self._release_grammar(req)
         self.cache.release_slot_blocks(req.slot)
         self.cache.free(req.slot)
         self.scheduler.finish(req)
@@ -1490,6 +1750,10 @@ class Engine:
         if lease is not None:
             self.prefix.release(lease)
         self._active[slot] = False
+        # the vacated lane rides the accept-all sentinel; the request
+        # KEEPS its slab segment reference (it is still live and will
+        # re-admit), so its grammar tables stay installed
+        self._dfa_state[slot] = 0
         self._state_dirty = True
         self.scheduler.requeue_front(req)
         self.cache.free(slot)
@@ -1526,9 +1790,13 @@ class Engine:
                     f"cannot abort request {req.request_id}: waiting "
                     "but not queued on this engine") from None
             req.status = FINISHED
+            if self._structured:
+                self._release_grammar(req)
         else:
             assert req.status == RUNNING
             slot = req.slot
+            if self._structured:
+                self._release_grammar(req)
             self.cache.release_slot_blocks(slot)
             lease = self._leases.pop(req.request_id, None)
             if lease is not None:
@@ -1611,6 +1879,8 @@ class Engine:
             jnp.asarray(a) for a in (self._seeds, self._temps,
                                      self._top_ks, self._top_ps,
                                      self._eos_ids, self._limits))
+        if self._structured:
+            self._d_dfa_state = jnp.asarray(self._dfa_state)
         self._state_dirty = False
 
     def _sync_tables(self, nb):
@@ -1636,20 +1906,24 @@ class Engine:
         nb = self._attn_blocks(h, k + 1)
         self._sync_device_state()
         self._sync_tables(nb)
+        self._sync_grammar_tables()
         seeds, temps, top_ks, top_ps, eos_ids, limits = self._d_params
         misses0 = self._decode.misses
         t_disp = time.perf_counter()
-        (tok, p, cnt, act, hb), new_k, new_v, new_ks, new_vs, toks = \
-            self._decode(
+        (tok, p, cnt, act, hb, nds), new_k, new_v, new_ks, new_vs, \
+            toks = self._decode(
                 self._state_arrays, self._d_tokens, self._d_pos,
                 self._d_counts, self._d_active, self._d_hist,
                 self._d_gates, seeds, temps, top_ks, top_ps, eos_ids,
                 limits, self._d_tables, self.pool.k, self.pool.v,
-                self.pool.k_scale, self.pool.v_scale, h, k)
+                self.pool.k_scale, self.pool.v_scale, h, k,
+                self._d_dfa_state, self._d_dfa_next, self._d_dfa_mask,
+                self._d_dfa_forced)
         self.pool.rebind(new_k, new_v, new_ks, new_vs)
         self._d_tokens, self._d_pos = tok, p
         self._d_counts, self._d_active = cnt, act
         self._d_hist = hb
+        self._d_dfa_state = nds
         self._decode_buckets.add((h, nb, k))
         # KV traffic actually gathered by the fallback scan (and the
         # upper bound for the block-culling Pallas kernel): every lane
@@ -1753,11 +2027,24 @@ class Engine:
             if card.bytes_accessed is not None:
                 bytes_share = card.bytes_accessed / len(active)
         drafted = accepted = 0
+        forced_total = 0
+        slab = self._grammar_slab
+        vocab = int(self.model.config.vocab_size)
         floor = float(self.config.spec_accept_floor)
         gated = self._spec_gates.copy()  # gates the dispatch ran with
         for slot, req in active.items():
             done = False
-            lane_tokens = lane_accept = 0
+            lane_tokens = lane_accept = lane_forced = 0
+            # replay the lane's DFA walk on the host mirror: the same
+            # slab tables the device walked, advanced by the same
+            # emitted tokens, so the mirror state stays equal to the
+            # (frozen) device carry — and yields per-token telemetry
+            # (masked fraction, forced-draft hits) with no extra
+            # device outputs
+            st = int(self._dfa_state[slot]) if self._structured else 0
+            constrained = st != 0
+            fd_on = (constrained and k_draft
+                     and bool(self.config.grammar_forced_drafting))
             for step_i in range(h):
                 row = toks[step_i, slot]
                 if done:
@@ -1770,6 +2057,12 @@ class Engine:
                         "request — in-scan EOS/limit logic diverged "
                         "from record_token")
                 n_emit = 0
+                # a forced-chain draft counts only while the window's
+                # chain from its START state held: the device proposed
+                # forced[st] at position j iff every earlier position
+                # was forced too (forced_chain breaks at the first
+                # non-forced state)
+                win_chain = fd_on and bool(gated[slot])
                 for j in range(w):
                     t = int(row[j])
                     if t < 0:
@@ -1780,6 +2073,16 @@ class Engine:
                     self._tokens[slot] = t
                     self._pos[slot] += 1
                     self._hist[slot, self._pos[slot]] = t
+                    if constrained:
+                        _SRV_GRAMMAR_MASKED.observe(
+                            1.0 - float(slab.popcount[st]) / vocab,
+                            engine=self._profiler_name)
+                        if (win_chain and j < k_draft
+                                and int(slab.forced[st]) == t):
+                            lane_forced += 1
+                        else:
+                            win_chain = False
+                        st = int(slab.next[st, t])
                     if req.record_token(t):
                         done = True      # retire AFTER the lane's trace
                         break            # event, below
@@ -1801,10 +2104,14 @@ class Engine:
                             (ema >= floor) != bool(self._spec_gates[slot]):
                         self._spec_gates[slot] = ema >= floor
                         self._state_dirty = True
+            if constrained:
+                self._dfa_state[slot] = st
+                forced_total += lane_forced
             if req.trace is not None and lane_tokens:
+                extra = {"forced": lane_forced} if constrained else {}
                 ev = req.trace.add(_obs_tracing.DECODE, horizon=h,
                                    spec_k=k_draft, tokens=lane_tokens,
-                                   accepted=lane_accept)
+                                   accepted=lane_accept, **extra)
                 if flops_share is not None:
                     ev["flops_est"] = flops_share
                 if bytes_share is not None:
@@ -1812,6 +2119,10 @@ class Engine:
             if done:
                 self._retire(req)
                 finished.append(req)
+        if forced_total:
+            self._spec_forced_tokens += forced_total
+            _SRV_SPEC_FORCED.inc(forced_total,
+                                 engine=self._profiler_name)
         if drafted:
             self._spec_draft_tokens += drafted
             self._spec_accepted_tokens += accepted
@@ -2010,7 +2321,9 @@ class Engine:
     def _state_device_bytes(self):
         return self._tree_bytes([
             self._d_tokens, self._d_pos, self._d_counts, self._d_active,
-            self._d_hist, self._d_gates, self._d_params, self._d_tables])
+            self._d_hist, self._d_gates, self._d_params, self._d_tables,
+            self._d_dfa_state, self._d_dfa_next, self._d_dfa_mask,
+            self._d_dfa_forced])
 
     def counters(self):
         """Observability snapshot (also exposed via
@@ -2051,6 +2364,7 @@ class Engine:
             "spec_accept_rate": (
                 self._spec_accepted_tokens / self._spec_draft_tokens
                 if self._spec_draft_tokens else 0.0),
+            "spec_forced_tokens": self._spec_forced_tokens,
             "degradation_level": self._degrade_level,
             "degradation_sheds": self._degrade_sheds,
         }
@@ -2133,6 +2447,22 @@ class Engine:
                 / self._spec_windows if self._spec_windows else 0.0),
             "lane_accept_ema": [round(float(x), 4)
                                 for x in self._spec_ema],
+        }
+        slab = self._grammar_slab
+        s["structured"] = {
+            "enabled": self._structured,
+            # lanes currently decoding under a grammar: active with a
+            # non-sentinel DFA state
+            "constrained_lanes": int(sum(
+                1 for slot in range(self.cache.num_slots)
+                if self._active[slot] and self._dfa_state[slot] != 0)),
+            "capacity_states": slab.capacity if slab else 0,
+            "states_used": slab.states_used if slab else 0,
+            "grammars_installed": slab.grammars_installed if slab else 0,
+            "table_bytes": slab.device_bytes if slab else 0,
+            "compile_cache_hits": self._grammar_cache_hits,
+            "compile_cache_misses": self._grammar_cache_misses,
+            "forced_tokens": self._spec_forced_tokens,
         }
         # observability phase 3: program-card cost model + memory ledger
         s["cost"] = {

@@ -176,8 +176,8 @@ class EngineWorker:
 
     # ------------------------------------------------------------- control
     def submit(self, prompt_ids, sampling=None, priority=0,
-               deadline_s=None, tenant=None, trace_args=None,
-               timeout=30.0):
+               deadline_s=None, tenant=None, grammar=None,
+               trace_args=None, timeout=30.0):
         """Submit on the worker thread; returns a :class:`StreamHandle`.
         ``trace_args`` (tenant/priority/hop_s from the gateway) are
         appended to the flight record as the ``gateway`` event — on the
@@ -189,15 +189,16 @@ class EngineWorker:
         reply = queue.Queue(1)
         self._inbox.put(("submit", dict(
             prompt_ids=prompt_ids, sampling=sampling, priority=priority,
-            deadline_s=deadline_s, tenant=tenant), trace_args, reply))
+            deadline_s=deadline_s, tenant=tenant, grammar=grammar),
+            trace_args, reply))
         kind, value = self._await(reply, timeout)
         if kind == "error":
             raise value
         return value
 
     def adopt(self, handle, prompt_ids, sampling=None, priority=0,
-              tenant=None, resume_ids=(), from_replica="", reason="",
-              timeout=30.0):
+              tenant=None, grammar=None, resume_ids=(),
+              from_replica="", reason="", timeout=30.0):
         """Failover adoption: re-submit a condemned replica's in-flight
         request on THIS worker, resuming from ``resume_ids`` (the
         tokens the client has already received).  On the worker thread
@@ -211,7 +212,8 @@ class EngineWorker:
         reply = queue.Queue(1)
         self._inbox.put(("adopt", dict(
             prompt_ids=prompt_ids, sampling=sampling, priority=priority,
-            tenant=tenant, resume_ids=list(resume_ids),
+            tenant=tenant, grammar=grammar,
+            resume_ids=list(resume_ids),
             from_replica=from_replica, reason=reason),
             handle, reply))
         kind, value = self._await(reply, timeout)
@@ -517,6 +519,7 @@ class EngineWorker:
                     req = self.engine.submit(
                         arg["prompt_ids"], sampling=arg["sampling"],
                         priority=arg["priority"], tenant=arg["tenant"],
+                        grammar=arg.get("grammar"),
                         resume_ids=arg["resume_ids"])
                 except Exception as e:
                     reply.put(("error", e))
@@ -827,6 +830,7 @@ class FleetSupervisor:
                 worker.adopt(handle, prompt_ids=req.prompt_ids,
                              sampling=req.sampling,
                              priority=req.priority, tenant=req.tenant,
+                             grammar=req.grammar,
                              resume_ids=resume,
                              from_replica=from_worker.name,
                              reason=reason,

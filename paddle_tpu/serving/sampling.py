@@ -23,6 +23,13 @@ from dataclasses import dataclass
 import jax
 import jax.numpy as jnp
 
+#: logit floor for grammar-masked (disallowed) tokens: finite (so the
+#: temperature divide and softmax stay NaN-free at any temperature) but
+#: far below every real logit, so neither argmax nor categorical can
+#: pick a masked token.  Matches the established masking floor used by
+#: the attention kernels.
+MASK_FLOOR = -1.0e30
+
 
 @dataclass
 class SamplingParams:
@@ -111,7 +118,8 @@ def sample_batch(logits, seeds, counts, temperatures, top_ks, top_ps):
                         lambda _: greedy, None)
 
 
-def sample_window(logits, seeds, counts, temperatures, top_ks, top_ps):
+def sample_window(logits, seeds, counts, temperatures, top_ks, top_ps,
+                  allowed=None):
     """Sampling across a speculative verify window: logits [N, W, vocab]
     -> token ids [N, W], where window position j of lane i is sampled
     with key ``request_key(seeds[i], counts[i] + j)`` — the exact key
@@ -119,8 +127,17 @@ def sample_window(logits, seeds, counts, temperatures, top_ks, top_ps):
     Keys are pure functions of (seed, index), so the verify forward
     consumes no PRNG state for positions the acceptance rule discards:
     emitted token k of a request is bitwise the token sequential
-    ``generate()`` samples, whatever W the engine verified with."""
+    ``generate()`` samples, whatever W the engine verified with.
+
+    ``allowed`` (optional, [N, W, vocab] bool) is the grammar mask:
+    disallowed logits drop to ``MASK_FLOOR`` BEFORE the all-greedy fast
+    path / categorical pipeline, so constrained sampling inherits the
+    same key discipline and stays bitwise-reproducible; an all-True row
+    (the accept-all sentinel state unconstrained lanes ride) is the
+    identity — ``where(True, x, floor)`` is bitwise ``x``."""
     n, w, vocab = logits.shape
+    if allowed is not None:
+        logits = jnp.where(allowed, logits, MASK_FLOOR)
     js = jnp.arange(w, dtype=counts.dtype)
     rep = lambda a: jnp.repeat(a, w, axis=0)
     flat_counts = (counts[:, None] + js[None, :]).reshape(-1)

@@ -95,6 +95,11 @@ class Request:
     #: the tenant this request bills against (gateway quota key); None
     #: for in-process callers
     tenant: str | None = None
+    #: structured generation: the validated GrammarSpec constraining
+    #: this request's output (None = free text).  The engine compiles
+    #: and installs it at submit; the scheduler only carries it so
+    #: admission and failover can see which requests are constrained.
+    grammar: object = None
 
     @property
     def deadline_expired(self):
@@ -163,10 +168,11 @@ class Scheduler:
         self._next_id = 0
 
     def submit(self, prompt_ids, sampling, priority=0, deadline_s=None,
-               tenant=None):
+               tenant=None, grammar=None):
         req = Request(self._next_id, list(prompt_ids),
                       sampling.validate(), priority=int(priority),
-                      deadline_s=deadline_s, tenant=tenant)
+                      deadline_s=deadline_s, tenant=tenant,
+                      grammar=grammar)
         self._next_id += 1
         self.queue.append(req)
         return req

@@ -106,6 +106,14 @@ class ServingSpecLayout:
         replicated; the host-authoritative mirrors are unchanged."""
         return P()
 
+    def dfa_tables(self):
+        """Structured-generation slab tables (transitions, legality
+        bitmask, forced tokens) and the per-lane DFA state column:
+        replicated — every chip masks its own vocab shard's logits from
+        the same table, and the state walk is lane-indexed host logic,
+        not a sharded tensor op."""
+        return self.engine_state()
+
     # ------------------------------------------------------- name rules
     def parameter_spec(self, name):
         """Heuristic spec from a state_dict parameter name."""

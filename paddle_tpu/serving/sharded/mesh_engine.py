@@ -339,8 +339,15 @@ class MeshEngine(Engine):
                 jnp.full(n, self.config.max_seq_len, i32),
                 jnp.zeros((n, nb), i32),
                 list(self.pool.k), list(self.pool.v), pool_ks, pool_vs)
+        # grammar args ride as keywords (positional would land on the
+        # horizon/k_draft slots already bound above); Nones with
+        # structured generation off, slab tables + sentinel states on
+        dfa_state, dfa_next, dfa_mask, dfa_forced = \
+            self._grammar_program_args()
         fn = functools.partial(self._decode_fn, horizon=h,
-                               k_draft=int(k_draft))
+                               k_draft=int(k_draft),
+                               dfa_state=dfa_state, dfa_next=dfa_next,
+                               dfa_mask=dfa_mask, dfa_forced=dfa_forced)
         return fn, args
 
     def decode_comms_report(self, horizon=None, k_draft=0, publish=False):

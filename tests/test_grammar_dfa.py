@@ -1,0 +1,306 @@
+"""Grammar-compiler unit tests: regex -> char DFA (corner syntax,
+minimization, full-match semantics), JSON-schema lowering, the char-DFA
+x vocab crossproduct (multi-char token walks, EOS-iff-accepting,
+dense-vs-bitmask equivalence, REJECT unreachability), and the
+GrammarSlab lifecycle.  Pure host-side compiler machinery — engine-level
+structured-decoding acceptance lives in test_structured.py."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.serving import (
+    GrammarError, GrammarSlab, GrammarSpec, compile_grammar,
+    compile_regex, schema_to_regex,
+)
+from paddle_tpu.serving.structured.grammar import REJECT, as_grammar_spec
+
+
+def make_vocab(size=128, eos_id=95):
+    """Printable-ASCII single chars (ids 0..94), <eos> at 95, then a
+    handful of multi-char tokens exercising tokenizer boundaries."""
+    vocab = [chr(32 + i) for i in range(95)]
+    vocab.append("<eos>")
+    vocab.extend(['{"', '":', '",', '"}', 'true', 'false', 'null',
+                  '": "', '", "', 'ab', 'abc', '0', '12'])
+    while len(vocab) < size:
+        vocab.append(f"<unused{len(vocab)}>")
+    return vocab
+
+
+VOCAB = make_vocab()
+EOS = 95
+SCHEMA = {"type": "object",
+          "properties": {"a": {"enum": ["x", "y"]},
+                         "b": {"type": "boolean"}},
+          "required": ["a", "b"]}
+
+
+class TestRegexCompiler:
+    """compile_regex corners: the char-DFA must implement full-match
+    semantics over the supported dialect and 400 the rest by name."""
+
+    def test_literal_and_alternation(self):
+        d = compile_regex("ab|cd")
+        assert d.matches("ab") and d.matches("cd")
+        assert not d.matches("a") and not d.matches("abcd")
+        assert not d.matches("")
+
+    def test_bounded_repetition(self):
+        d = compile_regex("a{2,4}")
+        assert [d.matches("a" * n) for n in range(6)] == \
+            [False, False, True, True, True, False]
+        assert compile_regex("a{3}").matches("aaa")
+        assert not compile_regex("a{3}").matches("aa")
+        d = compile_regex("a{2,}")
+        assert not d.matches("a") and d.matches("a" * 7)
+
+    def test_char_classes_and_escapes(self):
+        d = compile_regex(r"[a-c]+[0-9]?")
+        assert d.matches("abc") and d.matches("cab7")
+        assert not d.matches("7") and not d.matches("abd")
+        assert compile_regex(r"[^x]").matches("y")
+        assert not compile_regex(r"[^x]").matches("x")
+        assert compile_regex(r"\d+").matches("42")
+        assert not compile_regex(r"\d+").matches("4a")
+        assert compile_regex(r"\w+\s\w+").matches("ab cd")
+
+    def test_star_plus_optional_dot(self):
+        assert compile_regex("(ab)*").matches("")
+        assert compile_regex("(ab)*").matches("ababab")
+        assert not compile_regex("(ab)*").matches("aba")
+        assert compile_regex("a+").matches("aaa")
+        assert not compile_regex("a+").matches("")
+        assert compile_regex("a?b").matches("b")
+        d = compile_regex("a.c")
+        assert d.matches("abc") and d.matches("a.c")
+        assert not d.matches("ac")
+
+    def test_minimization_merges_equivalent_states(self):
+        # "a|a" and "a" must land on the same minimized machine
+        assert compile_regex("a|a").n_states == compile_regex("a").n_states
+
+    def test_nullable_repetition(self):
+        """Star/plus over a nullable body ("(a*)*", "()") must produce
+        the one-state accept machine, not crash minimization."""
+        for pat in ("(a*)*", "(a?)+", "(a|)*", "a**"):
+            d = compile_regex(pat)
+            assert d.n_states == 1
+            assert d.matches("") and d.matches("aaa")
+        d = compile_regex("()")
+        assert d.matches("") and not d.matches("a")
+
+    def test_unsupported_constructs_raise_by_name(self):
+        for pat in ("(?=a)", "(a", "[a", "a{4,2}", "*a", "a{,3}"):
+            with pytest.raises(GrammarError, match="regex"):
+                compile_regex(pat)
+
+
+class TestSchemaLowering:
+    """JSON-schema subset -> regex: the lowered language must contain
+    the valid instances and exclude the malformed ones."""
+
+    def _dfa(self, schema):
+        return compile_regex(schema_to_regex(schema))
+
+    def test_object_required_and_types(self):
+        d = self._dfa(SCHEMA)
+        assert d.matches('{"a":"x","b":true}')
+        assert d.matches('{"a":"y","b":false}')
+        assert not d.matches('{"a":"z","b":true}')      # enum violation
+        assert not d.matches('{"b":true}')              # missing required
+        assert not d.matches('{"a":"x","b":true')       # unterminated
+
+    def test_optional_property(self):
+        schema = {"type": "object",
+                  "properties": {"a": {"type": "boolean"},
+                                 "b": {"type": "null"}},
+                  "required": ["a"]}
+        d = self._dfa(schema)
+        assert d.matches('{"a":true}')
+        assert d.matches('{"a":false,"b":null}')
+        assert not d.matches('{"b":null}')
+
+    def test_top_level_enum_and_const(self):
+        d = self._dfa({"enum": [1, "x", True]})
+        assert d.matches("1") and d.matches('"x"') and d.matches("true")
+        assert not d.matches('"y"') and not d.matches("2")
+
+    def test_nested_arrays(self):
+        schema = {"type": "array",
+                  "items": {"type": "array",
+                            "items": {"type": "integer"}}}
+        d = self._dfa(schema)
+        assert d.matches("[]") and d.matches("[[1,2],[-3]]")
+        assert not d.matches("[[1,]]") and not d.matches("[1]")
+
+    def test_scalar_types(self):
+        assert self._dfa({"type": "integer"}).matches("-12")
+        assert not self._dfa({"type": "integer"}).matches("01")
+        assert self._dfa({"type": "number"}).matches("3.5e-2")
+        assert self._dfa({"type": "string"}).matches('"hi"')
+        assert not self._dfa({"type": "string"}).matches('"a')
+        assert self._dfa({"type": "boolean"}).matches("false")
+        assert self._dfa({"type": "null"}).matches("null")
+
+    def test_unsupported_features_named_in_error(self):
+        for key in ("anyOf", "$ref", "patternProperties", "minimum"):
+            with pytest.raises(GrammarError, match=key.replace("$", "\\$")):
+                schema_to_regex({key: []})
+
+    def test_grammar_spec_validates_eagerly(self):
+        with pytest.raises(GrammarError):
+            GrammarSpec.regex("(a")
+        with pytest.raises(GrammarError):
+            GrammarSpec.json_schema({"anyOf": []})
+        spec = as_grammar_spec(SCHEMA)
+        assert spec.kind == "json_schema"
+        assert as_grammar_spec(spec) is spec
+        assert as_grammar_spec("a+").kind == "regex"
+        with pytest.raises(GrammarError):
+            as_grammar_spec(17)
+
+
+# --------------------------------------------------- vocab crossproduct
+class TestTokenDFA:
+    """char DFA x vocab: multi-char token walks, EOS-iff-accepting,
+    dense-vs-bitmask equivalence, REJECT unreachability."""
+
+    SMALL_VOCAB = ["a", "b", "c", "ab", "x", "", "<eos>"]
+    SMALL_EOS = 6
+
+    def _dfa(self, pattern="ab*c"):
+        return compile_grammar(pattern, self.SMALL_VOCAB, self.SMALL_EOS)
+
+    def test_multichar_token_boundaries(self):
+        d = self._dfa()
+        # from the start of "ab*c": 'a' and the multi-char 'ab' both
+        # begin a match, 'b'/'c'/'x' do not
+        assert d.allows(0, 0) and d.allows(0, 3)
+        assert not d.allows(0, 1) and not d.allows(0, 2)
+        assert not d.allows(0, 4)
+        # after 'ab' the walk sits mid-repetition: 'b' and 'c' legal
+        s = d.step(0, 3)
+        assert d.allows(s, 1) and d.allows(s, 2)
+
+    def test_empty_token_never_legal(self):
+        d = self._dfa()
+        assert not any(d.allows(s, 5) for s in range(d.n_states))
+
+    def test_ids_beyond_vocab_illegal(self):
+        d = compile_grammar("a+", self.SMALL_VOCAB, self.SMALL_EOS,
+                            vocab_size=16)
+        assert d.vocab_size == 16
+        assert not any(d.allows(s, t) for s in range(d.n_states)
+                       for t in range(len(self.SMALL_VOCAB), 16))
+
+    def test_eos_legal_iff_accepting_and_self_loops(self):
+        d = self._dfa()
+        assert d.accepting.any() and not d.accepting.all()
+        for s in range(d.n_states):
+            assert d.allows(s, self.SMALL_EOS) == bool(d.accepting[s])
+            if d.accepting[s]:
+                assert d.step(s, self.SMALL_EOS) == s
+
+    def test_dense_vs_bitmask_equivalence(self):
+        for grammar in ("ab*c", SCHEMA):
+            vocab = self.SMALL_VOCAB if grammar == "ab*c" else VOCAB
+            eos = self.SMALL_EOS if grammar == "ab*c" else EOS
+            d = compile_grammar(grammar, vocab, eos)
+            unpacked = np.unpackbits(
+                d.mask.view(np.uint8), bitorder="little",
+            ).reshape(d.n_states, -1)[:, :d.vocab_size].astype(bool)
+            np.testing.assert_array_equal(unpacked, d.next_state >= 0)
+            np.testing.assert_array_equal(
+                d.popcount, unpacked.sum(axis=1))
+
+    def test_forced_iff_popcount_one(self):
+        d = compile_grammar(SCHEMA, VOCAB, EOS)
+        for s in range(d.n_states):
+            if d.popcount[s] == 1:
+                assert d.forced[s] >= 0 and d.allows(s, int(d.forced[s]))
+            else:
+                assert d.forced[s] == REJECT
+        # a JSON-skeleton grammar has forced punctuation states
+        assert (d.forced >= 0).any()
+
+    def test_reject_states_unreachable_via_legal_tokens(self):
+        d = compile_grammar(SCHEMA, VOCAB, EOS)
+        seen, stack = {0}, [0]
+        while stack:
+            s = stack.pop()
+            assert d.popcount[s] > 0          # no lane can strand
+            for t in range(d.vocab_size):
+                if d.allows(s, t):
+                    nxt = d.step(s, t)
+                    assert nxt != REJECT
+                    if nxt not in seen:
+                        seen.add(nxt)
+                        stack.append(nxt)
+
+    def test_inexpressible_grammar_raises(self):
+        with pytest.raises(GrammarError, match="cannot express"):
+            compile_grammar("z+", self.SMALL_VOCAB, self.SMALL_EOS)
+        with pytest.raises(GrammarError, match="eos_id"):
+            compile_grammar("a", self.SMALL_VOCAB, 99)
+
+
+class TestGrammarSlab:
+    """Fixed-capacity device-table master: sentinel row 0, refcounted
+    segments, exhaustion."""
+
+    def _dfa(self, pattern="ab*c"):
+        return compile_grammar(pattern, TestTokenDFA.SMALL_VOCAB,
+                               TestTokenDFA.SMALL_EOS)
+
+    def test_sentinel_row_accepts_everything(self):
+        slab = GrammarSlab(16, 7)
+        assert slab.popcount[0] == 7
+        unpacked = np.unpackbits(slab.mask[0:1].view(np.uint8),
+                                 bitorder="little")[:7]
+        assert unpacked.all()
+        assert (slab.next[0] == 0).all()      # self-loop on row 0
+        with pytest.raises(ValueError, match=">= 2"):
+            GrammarSlab(1, 7)
+
+    def test_install_is_refcounted(self):
+        slab = GrammarSlab(64, 7)
+        dfa = self._dfa()
+        off = slab.install("k", dfa)
+        assert off >= 1 and slab.grammars_installed == 1
+        used = slab.states_used
+        assert slab.install("k", dfa) == off       # re-reference
+        assert slab.states_used == used
+        slab.release("k")
+        assert slab.grammars_installed == 1        # one ref left
+        slab.release("k")
+        assert slab.grammars_installed == 0
+        assert slab.states_used == 1
+        slab.release("missing")                    # no-op
+
+    def test_global_next_ids_and_reject_rows_point_at_sentinel(self):
+        slab = GrammarSlab(64, 7)
+        dfa = self._dfa()
+        off = slab.install("k", dfa)
+        rows = slab.next[off:off + dfa.n_states]
+        assert rows.min() >= 0 and rows.max() < slab.capacity
+        # REJECT entries store row 0: a rejected gather stays a valid
+        # index; legality comes from the bitmask alone
+        assert (rows[dfa.next_state == REJECT] == 0).all()
+        legal = dfa.next_state >= 0
+        np.testing.assert_array_equal(rows[legal],
+                                      dfa.next_state[legal] + off)
+
+    def test_two_grammars_disjoint_and_exhaustion(self):
+        d1, d2 = self._dfa("ab*c"), self._dfa("(a|b)c{2}")
+        slab = GrammarSlab(d1.n_states + d2.n_states + 1, 7)
+        o1 = slab.install("g1", d1)
+        o2 = slab.install("g2", d2)
+        r1 = set(range(o1, o1 + d1.n_states))
+        r2 = set(range(o2, o2 + d2.n_states))
+        assert not (r1 & r2) and 0 not in (r1 | r2)
+        with pytest.raises(RuntimeError, match="exhausted"):
+            slab.install("g3", self._dfa("a{2,9}b"))
+        # releasing one frees its rows for reuse
+        slab.release("g1")
+        assert slab.install("g3", d1) >= 1
+

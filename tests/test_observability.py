@@ -477,8 +477,8 @@ class TestFlightRecorder:
         # must still reconcile against engine counters exactly
         assert c == {"tokens_emitted": 6, "prefix_hit_tokens": 6,
                      "preemptions": 1, "decode_horizons": 2,
-                     "spec_accepted_tokens": 2, "aborted": 0,
-                     "failovers": 1, "resumed_tokens": 6,
+                     "spec_accepted_tokens": 2, "spec_forced_tokens": 0,
+                     "aborted": 0, "failovers": 1, "resumed_tokens": 6,
                      "flops_est": 0.0, "bytes_est": 0.0}
         assert tr.finished
         # monotonic event times
