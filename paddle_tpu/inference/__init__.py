@@ -193,7 +193,11 @@ def create_llm_engine(model, mesh_shape=None, tp=None, **config_kwargs):
     the compiled program with free lanes via the accept-all sentinel;
     ``grammar_forced_drafting`` (default True, needs ``spec_k > 0``)
     drafts sole-legal-token chains ahead of n-gram proposals so JSON
-    skeleton punctuation is accepted at draft price).
+    skeleton punctuation is accepted at draft price;
+    ``grammar_cache_keep`` (default 8) bounds the host compile cache —
+    DFAs stay pinned while a live request references them, plus this
+    many retired entries kept LRU so repeat grammars skip
+    recompilation).
 
     ``mesh_shape`` / ``tp`` pick the sharded engine: ``tp=N`` (or
     ``mesh_shape=(1, N)``; both knobs must agree when both are given)

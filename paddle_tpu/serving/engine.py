@@ -491,6 +491,15 @@ class EngineConfig:
     #: drafter's guesses.  Forced proposals are ~100%-acceptance drafts;
     #: the PR 7 acceptance rule and EMA gating are unchanged.
     grammar_forced_drafting: bool = True
+    #: host compile-cache bound: a compiled token DFA stays cached per
+    #: (grammar, eos) while any live request references its slab
+    #: segment (pinned — admission walks resume histories through it),
+    #: plus up to this many RETIRED entries kept LRU after the last
+    #: reference drops, so repeat grammars skip recompilation without
+    #: the host cache growing unboundedly under a stream of unique
+    #: gateway grammars (each entry holds a dense [states, vocab]
+    #: int32 table).
+    grammar_cache_keep: int = 8
 
 
 def _unpack_mask(rows, vocab):
@@ -634,7 +643,9 @@ class Engine:
         self._dfa_state = np.zeros(n, np.int32)
         self._d_dfa_state = None
         self._d_dfa_next = self._d_dfa_mask = self._d_dfa_forced = None
-        self._grammar_cache = {}     # (spec key, eos id) -> TokenDFA
+        self._grammar_cache = {}     # (spec key, eos id) -> TokenDFA;
+                                     # pinned while slab-installed, then
+                                     # LRU-bounded (grammar_cache_keep)
         self._grammar_keys = {}      # request_id -> slab segment key
         self._grammar_cache_hits = 0
         self._grammar_cache_misses = 0
@@ -1215,6 +1226,9 @@ class Engine:
                 "could never legally stop")
         key = (spec.key, int(sampling.eos_token_id))
         if key in self._grammar_cache:
+            # LRU touch: re-insertion order is eviction order for
+            # retired (refcount-zero) entries in _trim_grammar_cache
+            self._grammar_cache[key] = self._grammar_cache.pop(key)
             self._grammar_cache_hits += 1
         else:
             if self.config.grammar_vocab is None:
@@ -1229,18 +1243,48 @@ class Engine:
             self._grammar_cache_misses += 1
         return spec
 
+    def _walk_grammar(self, dfa, tokens):
+        """Advance the compiled ``TokenDFA`` through ``tokens`` from its
+        start state; returns the final grammar-LOCAL state id.  The walk
+        uses the cached TokenDFA, where REJECT is ``-1`` — NOT the slab,
+        which stores REJECT as row 0 (the accept-all sentinel), so a
+        slab walk over an illegal token would silently un-constrain the
+        lane instead of surfacing it.  Raises ``ValueError`` naming the
+        first illegal transition."""
+        st = 0
+        for i, t in enumerate(tokens):
+            t = int(t)
+            nxt = (int(dfa.next_state[st, t])
+                   if 0 <= t < dfa.vocab_size else -1)
+            if nxt < 0:
+                raise ValueError(
+                    f"token {t} at output position {i} is illegal "
+                    f"under the request grammar (DFA state {st})")
+            st = nxt
+        return st
+
     def _dfa_admission_state(self, req):
         """The slab-global DFA state a (re-)admitted constrained lane
         samples its next token from: the grammar's start row advanced
         by every token already emitted EXCEPT the last — the prefill
         itself re-samples that one under the masked logits, the same
         bitwise boundary check the PRNG resume path performs.  Fresh
-        admissions have no output yet and get the start row."""
+        admissions have no output yet and get the start row.
+
+        The cache entry is pinned while the request holds its slab
+        reference (see ``_trim_grammar_cache``), and an illegal token
+        in the history is an invariant violation here — preempted
+        lanes emitted under the mask, and cross-engine ``resume_ids``
+        were validated at ``submit()``."""
         key = self._grammar_keys[req.request_id]
-        st = self._grammar_slab.offset(key)
-        for t in req.output_ids[:-1]:
-            st = int(self._grammar_slab.next[st, int(t)])
-        return st
+        try:
+            st = self._walk_grammar(self._grammar_cache[key],
+                                    req.output_ids[:-1])
+        except ValueError as e:
+            raise RuntimeError(
+                f"request {req.request_id} diverged from its grammar "
+                f"mid-admission — {e}") from None
+        return self._grammar_slab.offset(key) + st
 
     def _release_grammar(self, req):
         """Drop a finished/aborted request's slab segment reference and
@@ -1248,8 +1292,22 @@ class Engine:
         key = self._grammar_keys.pop(req.request_id, None)
         if key is not None:
             self._grammar_slab.release(key)
+            self._trim_grammar_cache()
         if req.slot is not None:
             self._dfa_state[req.slot] = 0
+
+    def _trim_grammar_cache(self):
+        """Bound the host compile cache.  Entries whose slab segment is
+        live are pinned — some request still references the grammar and
+        admission walks its history through the cached TokenDFA — and
+        retired entries survive as an LRU of ``grammar_cache_keep``, so
+        repeat grammars stay a dict hit while a stream of unique
+        gateway grammars cannot grow host memory without bound."""
+        keep = max(0, int(self.config.grammar_cache_keep))
+        retired = [k for k in self._grammar_cache
+                   if not self._grammar_slab.installed(k)]
+        for k in retired[:len(retired) - keep]:
+            del self._grammar_cache[k]
 
     def _sync_grammar_tables(self):
         """Upload the grammar slab tables — only when an install or
@@ -1320,7 +1378,11 @@ class Engine:
         grammar's accept states; without it the lane could never
         legally stop).  Compiled token DFAs are cached per
         ``(grammar, eos)`` and installed into the slab refcounted, so
-        repeat grammars cost a dict hit."""
+        repeat grammars cost a dict hit; slab exhaustion (more live
+        grammar states than ``grammar_max_states``) raises
+        ``RuntimeError`` here, before anything queues, and a grammar +
+        ``resume_ids`` combination is refused (``ValueError``) when the
+        resumed tokens don't walk the grammar legally."""
         if self._draining:
             raise RuntimeError("engine is draining; submissions refused")
         prompt_ids = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
@@ -1345,13 +1407,44 @@ class Engine:
                 f">= max_new_tokens {sampling.max_new_tokens}: nothing "
                 "left to generate")
         grammar = self._norm_grammar(grammar, sampling)
-        req = self.scheduler.submit(prompt_ids, sampling,
-                                    priority=priority,
-                                    deadline_s=deadline_s, tenant=tenant,
-                                    grammar=grammar)
+        key = None
         if grammar is not None:
             key = (grammar.key, int(sampling.eos_token_id))
-            self._grammar_slab.install(key, self._grammar_cache[key])
+            if resume_ids:
+                # cross-engine resume under a grammar: the dead replica
+                # generated these under the same mask, so any illegal
+                # transition means corrupt resume data — refused HERE,
+                # eagerly, not silently un-constrained at admission
+                try:
+                    self._walk_grammar(self._grammar_cache[key],
+                                       resume_ids)
+                except ValueError as e:
+                    raise ValueError(
+                        f"resume_ids diverged from the request "
+                        f"grammar: {e}") from None
+            # install BEFORE the scheduler sees the request: slab
+            # exhaustion is a documented, recoverable submit() error,
+            # and raising it after queueing would strand a request
+            # with req.grammar set but no _grammar_keys entry — the
+            # next admission pass would then KeyError the step loop
+            try:
+                self._grammar_slab.install(key, self._grammar_cache[key])
+            except Exception:
+                # the freshly compiled entry is unpinned; trim so a
+                # stream of refused grammars can't grow the cache
+                self._trim_grammar_cache()
+                raise
+        try:
+            req = self.scheduler.submit(prompt_ids, sampling,
+                                        priority=priority,
+                                        deadline_s=deadline_s,
+                                        tenant=tenant, grammar=grammar)
+        except BaseException:
+            if key is not None:
+                self._grammar_slab.release(key)
+                self._trim_grammar_cache()
+            raise
+        if key is not None:
             self._grammar_keys[req.request_id] = key
         if resume_ids:
             # cross-engine resume: admission re-prefills this history
@@ -2462,6 +2555,7 @@ class Engine:
             "table_bytes": slab.device_bytes if slab else 0,
             "compile_cache_hits": self._grammar_cache_hits,
             "compile_cache_misses": self._grammar_cache_misses,
+            "compile_cache_entries": len(self._grammar_cache),
             "forced_tokens": self._spec_forced_tokens,
         }
         # observability phase 3: program-card cost model + memory ledger

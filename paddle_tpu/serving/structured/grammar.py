@@ -120,6 +120,7 @@ _ESCAPE_SETS = {
 }
 _ESCAPE_CHARS = {"n": "\n", "t": "\t", "r": "\r", "f": "\f", "v": "\v",
                  "0": "\0"}
+_HEXDIGITS = frozenset("0123456789abcdefABCDEF")
 
 
 # ---------------------------------------------------------------------------
@@ -245,20 +246,27 @@ class _RegexParser:
             o = ord(_ESCAPE_CHARS[c])
             return ("set", ((o, o),))
         if c in ("x", "u"):
-            n = 2 if c == "x" else 4
-            hexs = self.p[self.i:self.i + n]
-            try:
-                o = int(hexs, 16)
-            except ValueError:
-                raise GrammarError(
-                    f"regex: malformed \\{c} escape") from None
-            self.i += n
+            o = self._hex_escape(c)
             return ("set", ((o, o),))
         if not c.isalnum():
             return ("set", ((ord(c), ord(c)),))
         raise GrammarError(f"regex: unsupported escape \\{c}"
                            " (\\b word boundaries and backreferences "
                            "are not supported)")
+
+    def _hex_escape(self, kind):
+        """``\\xHH`` / ``\\uHHHH``: exactly 2/4 hex digits.  ``int(_,
+        16)`` alone would accept a truncated escape ('a\\x4', '\\u12')
+        — or '+'/'_'-decorated strings — as a shorter codepoint instead
+        of raising."""
+        n = 2 if kind == "x" else 4
+        hexs = self.p[self.i:self.i + n]
+        if len(hexs) != n or any(h not in _HEXDIGITS for h in hexs):
+            raise GrammarError(
+                f"regex: malformed \\{kind} escape (expected exactly "
+                f"{n} hex digits, got {hexs!r})")
+        self.i += n
+        return int(hexs, 16)
 
     def _cls(self):
         negate = False
@@ -304,14 +312,7 @@ class _RegexParser:
         if e in _ESCAPE_CHARS:
             return ord(_ESCAPE_CHARS[e])
         if e in ("x", "u"):
-            n = 2 if e == "x" else 4
-            try:
-                o = int(self.p[self.i:self.i + n], 16)
-            except ValueError:
-                raise GrammarError(
-                    f"regex: malformed \\{e} escape") from None
-            self.i += n
-            return o
+            return self._hex_escape(e)
         if e == "b":               # backspace inside a class
             return 8
         if not e.isalnum():
@@ -948,6 +949,10 @@ class GrammarSlab:
 
     def offset(self, key):
         return self._segments[key][0]
+
+    def installed(self, key):
+        """True while ``key`` holds a live (refcount > 0) segment."""
+        return key in self._segments
 
     def _alloc(self, size):
         taken = sorted((off, sz) for off, sz, _ in

@@ -94,6 +94,16 @@ class TestRegexCompiler:
             with pytest.raises(GrammarError, match="regex"):
                 compile_regex(pat)
 
+    def test_hex_escapes_wellformed_and_truncated(self):
+        assert compile_regex(r"\x41B").matches("AB")
+        assert compile_regex(r"[\x41-\x43]").matches("B")
+        # truncated/decorated escapes must raise, not silently parse as
+        # a shorter codepoint (int('4', 16) and int('+4', 16) succeed)
+        for pat in (r"a\x4", r"\u12", r"\x", r"\x4g", r"\u004g",
+                    r"\x+4", r"[\x4]", r"[\u123]", r"[a-\x4]"):
+            with pytest.raises(GrammarError, match="malformed"):
+                compile_regex(pat)
+
 
 class TestSchemaLowering:
     """JSON-schema subset -> regex: the lowered language must contain

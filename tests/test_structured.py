@@ -199,6 +199,99 @@ class TestStructuredEngine:
         assert eng.pool.blocks_in_use == 0
         eng.close()
 
+    def test_slab_exhaustion_refused_before_queueing(self):
+        """An over-capacity grammar raises at submit() with NOTHING
+        queued — the engine keeps serving.  (Regression: install() used
+        to run after scheduler.submit(), stranding a request with
+        ``grammar`` set but no slab segment, and the next admission
+        pass crashed the step loop for every request.)"""
+        m = _model()
+        eng = Engine(m, _cfg(grammar_max_states=8),
+                     register_profiler=False)
+        with pytest.raises(RuntimeError, match="slab exhausted"):
+            eng.submit([3, 1, 4], sampling=GREEDY, grammar=SCHEMA)
+        assert eng.scheduler.queue_depth == 0
+        assert eng.stats()["structured"]["grammars_installed"] == 0
+        # still healthy: a small grammar and a free lane decode fine
+        r = eng.submit([3, 1, 4], sampling=GREEDY, grammar="a{2}")
+        free = eng.submit([9, 2, 6],
+                          sampling=SamplingParams(max_new_tokens=4))
+        _drive(eng)
+        assert _text(r) == "aa" and r.finish_reason == "eos"
+        assert len(free.output_ids) == 4
+        assert eng.stats()["structured"]["grammars_installed"] == 0
+        eng.close()
+
+    def test_compile_cache_bounded_lru(self):
+        """A stream of unique gateway grammars cannot grow the host DFA
+        cache without bound: retired entries trim to
+        ``grammar_cache_keep`` LRU, a repeat inside the window is still
+        a hit, and an evicted grammar recompiles."""
+        m = _model()
+        eng = Engine(m, _cfg(grammar_cache_keep=2),
+                     register_profiler=False)
+        pats = ["a{%d}" % n for n in (1, 2, 3, 4)]
+        for p in pats:
+            eng.submit([3], sampling=GREEDY, grammar=p)
+            _drive(eng)
+        st = eng.stats()["structured"]
+        assert st["compile_cache_entries"] == 2
+        assert st["compile_cache_misses"] == 4
+        eng.submit([3], sampling=GREEDY, grammar=pats[-1])  # kept: hit
+        _drive(eng)
+        assert eng.stats()["structured"]["compile_cache_hits"] == 1
+        eng.submit([3], sampling=GREEDY, grammar=pats[0])   # evicted
+        _drive(eng)
+        st = eng.stats()["structured"]
+        assert st["compile_cache_misses"] == 5
+        assert st["compile_cache_entries"] == 2
+        eng.close()
+        # live grammars are PINNED even at keep=0 (the admission walk
+        # reads the cached TokenDFA), and fully evict once retired
+        eng = Engine(m, _cfg(grammar_cache_keep=0, num_slots=1),
+                     register_profiler=False)
+        eng.submit([3, 1, 4], sampling=GREEDY, grammar=SCHEMA)
+        eng.submit([9, 2, 6], sampling=GREEDY, grammar="a{2}")
+        assert eng.stats()["structured"]["compile_cache_entries"] == 2
+        _drive(eng)
+        assert eng.stats()["structured"]["compile_cache_entries"] == 0
+        eng.close()
+
+    def test_resume_ids_must_walk_grammar(self):
+        """Cross-engine resume tokens that are illegal under the
+        request grammar are refused at submit() — not silently
+        un-constrained at admission (the slab stores REJECT as the
+        accept-all sentinel row, so only the eager cache walk can see
+        the divergence)."""
+        m = _model()
+        eng = Engine(m, _cfg(), register_profiler=False)
+        for bad in ([90, 1],      # 'z' can't open the schema's object
+                    [5000]):      # beyond the vocab entirely
+            with pytest.raises(ValueError, match="illegal"):
+                eng.submit([3, 1, 4], sampling=SEEDED, grammar=SCHEMA,
+                           resume_ids=bad)
+        assert eng.scheduler.queue_depth == 0
+        assert eng.stats()["structured"]["grammars_installed"] == 0
+        eng.close()
+
+    def test_cross_engine_constrained_resume_bitwise(self):
+        """A constrained seeded stream cut mid-generation resumes
+        bitwise on a fresh engine via resume_ids (the failover path)."""
+        m = _model()
+        ref = Engine(m, _cfg(), register_profiler=False)
+        want = ref.submit([3, 1, 4], sampling=SEEDED, grammar=SCHEMA)
+        _drive(ref)
+        ref.close()
+        cut = 5
+        assert len(want.output_ids) > cut
+        eng = Engine(m, _cfg(), register_profiler=False)
+        r = eng.submit([3, 1, 4], sampling=SEEDED, grammar=SCHEMA,
+                       resume_ids=want.output_ids[:cut])
+        _drive(eng)
+        eng.close()
+        assert r.output_ids == want.output_ids
+        json.loads(_text(r))
+
     def test_submit_validation(self):
         m = _model()
         eng = Engine(m, _cfg(), register_profiler=False)
