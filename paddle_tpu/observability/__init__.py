@@ -58,15 +58,33 @@ Phase 4 (the mesh stack):
   (``/debug/mesh``, chrome-trace mesh stamp), and skew gauges
   (pipeline-bubble ratio, MoE expert-load imbalance).
 
+Phase 5 (the fleet observatory):
+
+* :mod:`~paddle_tpu.observability.loadgen` — seeded, fully
+  deterministic workload traces (heavy-tailed lengths, MMPP bursty
+  multi-tenant arrivals, Zipf shared-prefix populations, batch/
+  deadline/abort mixes) with byte-identical serialization, a live
+  HTTP/SSE replay harness against the serving gateway, and per-
+  tenant/per-tier SLO-attainment rollups reconstructed from flight
+  records.
+* :mod:`~paddle_tpu.observability.fleetsim` — discrete-event fleet
+  capacity simulator stepping the SAME trace through a modeled fleet
+  (affinity routing, priority overtake bound, ProgramCard-derived
+  service times against the backend datasheet): attainment-vs-
+  replica-count curves plus the sim-vs-live calibration report
+  FLEET_BENCH.json commits (``/debug/fleet``, CLI ``fleet`` mode).
+
 CLI: ``python -m paddle_tpu.observability
-{snapshot,prometheus,trace,programs,mesh,check-bench,serve}``.
+{snapshot,prometheus,trace,programs,mesh,check-bench,fleet,serve}``.
 """
 
 from __future__ import annotations
 
-from . import (comms, events, memory, metrics, profiling, regression,
-               slo, tracing)
+from . import (comms, events, fleetsim, loadgen, memory, metrics,
+               profiling, regression, slo, tracing)
 from .events import export_chrome_trace
+from .fleetsim import ServiceModel
+from .loadgen import SLOSpec, WorkloadSpec, WorkloadTrace
 from .metrics import (
     Counter,
     Gauge,
@@ -100,6 +118,8 @@ __all__ = [
     "TelemetryServer",
     "comms", "memory", "profiling", "regression",
     "MemoryLedger", "ProgramCard", "ProgramCardRegistry",
+    "loadgen", "fleetsim",
+    "WorkloadSpec", "WorkloadTrace", "SLOSpec", "ServiceModel",
 ]
 
 

@@ -14,9 +14,17 @@ Modes:
                 (--fresh, from ``bench_decode.py --out`` or
                 ``bench_models.py bench_multichip_comms --out``)
                 against the committed baseline (--baseline /
-                --bench-file, DECODE_BENCH.json or
-                MULTICHIP_BENCH.json); exits 1 on an unallowed
+                --bench-file, DECODE_BENCH.json, MULTICHIP_BENCH.json
+                or FLEET_BENCH.json); exits 1 on an unallowed
                 regression
+  fleet         fleet observatory: generate seeded workload traces
+                (--shapes, e.g. chat,mixed), run the discrete-event
+                capacity simulator across --replicas, and print
+                SLO-attainment-vs-replica-count curves as JSON; with
+                --live, also replay the first shape against real
+                CPU-proxy gateways over HTTP/SSE and attach the
+                sim-vs-live calibration report (exits 1 when the
+                calibration gate fails)
   serve         start the telemetry HTTP endpoint (blocks; --port,
                 --duration to exit after N seconds)
 
@@ -41,7 +49,7 @@ def main(argv=None):
     parser.add_argument("mode", nargs="?", default="snapshot",
                         choices=("snapshot", "prometheus", "trace",
                                  "programs", "mesh", "check-bench",
-                                 "serve"))
+                                 "fleet", "serve"))
     parser.add_argument("-o", "--output", default=None,
                         help="write to FILE instead of stdout")
     parser.add_argument("--exec", dest="script", default=None,
@@ -71,12 +79,42 @@ def main(argv=None):
     parser.add_argument("--duration", type=float, default=None,
                         help="serve mode: exit after N seconds "
                         "(default: serve until interrupted)")
+    parser.add_argument("--shapes", default="chat,mixed",
+                        help="fleet mode: comma-separated workload "
+                        "shapes (chat, mixed)")
+    parser.add_argument("--replicas", default="1,2,4",
+                        help="fleet mode: comma-separated replica "
+                        "counts for the attainment curve")
+    parser.add_argument("--requests", type=int, default=48,
+                        help="fleet mode: requests per workload trace")
+    parser.add_argument("--seed", type=int, default=0,
+                        help="fleet mode: workload trace seed")
+    parser.add_argument("--live", action="store_true",
+                        help="fleet mode: also replay against live "
+                        "CPU-proxy gateways and attach the sim-vs-live "
+                        "calibration report")
+    parser.add_argument("--speed", type=float, default=4.0,
+                        help="fleet mode: virtual-time compression for "
+                        "replay/sim timelines (higher = burstier wall-"
+                        "clock load; keep moderate with --live so the "
+                        "shared-core CPU proxy stays uncontended)")
+    parser.add_argument("--slo-ttft", type=float, default=2.0,
+                        help="fleet mode: TTFT attainment threshold "
+                        "(wall seconds at replay speed)")
+    parser.add_argument("--slo-tpot", type=float, default=0.5,
+                        help="fleet mode: per-token attainment "
+                        "threshold (wall seconds)")
+    parser.add_argument("--fleet-tolerance", type=float, default=0.25,
+                        help="fleet mode: sim-vs-live attainment "
+                        "tolerance for the calibration gate")
     args = parser.parse_args(argv)
 
     if args.mode == "serve":
         return _serve(args)
     if args.mode == "check-bench":
         return _check_bench(args)
+    if args.mode == "fleet":
+        return _fleet(args)
 
     if args.script:
         with open(args.script) as f:
@@ -127,6 +165,26 @@ def _check_bench(args):
         with open(args.output, "w") as f:
             f.write(json.dumps(report, indent=2) + "\n")
     sys.stdout.write(text)
+    return 0 if report["ok"] else 1
+
+
+def _fleet(args):
+    from . import fleetsim, loadgen
+
+    report = fleetsim.fleet_report(
+        shapes=[s.strip() for s in args.shapes.split(",") if s.strip()],
+        replica_counts=[int(n) for n in args.replicas.split(",")],
+        n_requests=args.requests, seed=args.seed, live=args.live,
+        speed=args.speed,
+        slo=loadgen.SLOSpec(ttft_s=args.slo_ttft,
+                            tpot_s=args.slo_tpot),
+        tolerance=args.fleet_tolerance)
+    text = json.dumps(report, indent=2, default=repr) + "\n"
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(text)
+    else:
+        sys.stdout.write(text)
     return 0 if report["ok"] else 1
 
 

@@ -24,6 +24,9 @@ path               payload
                    values + the interconnect datasheet
 ``/debug/mesh``    live ``HybridCommunicateGroup`` topology (axes,
                    dims, comm rank-lists) plus the comms ledger
+``/debug/fleet``   latest fleet-observatory report (attainment curves,
+                   calibration) — attach one via
+                   ``TelemetryServer(fleet=...)``
 ``/trace``         chrome-trace JSON: process event ring merged with
                    per-request async spans (load in Perfetto)
 ``/``              tiny JSON index of the above
@@ -64,7 +67,7 @@ PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 ROUTES = ("/metrics", "/healthz", "/readyz", "/debug/requests",
           "/debug/slo", "/debug/programs", "/debug/comms",
-          "/debug/mesh", "/trace")
+          "/debug/mesh", "/debug/fleet", "/trace")
 
 
 class TelemetryServer:
@@ -78,13 +81,17 @@ class TelemetryServer:
     ready)."""
 
     def __init__(self, port=0, host="127.0.0.1", registry=None,
-                 event_log=None, recorder=None, slo=None):
+                 event_log=None, recorder=None, slo=None, fleet=None):
         self._host = host
         self._want_port = int(port)
         self.registry = registry
         self.event_log = event_log
         self.recorder = recorder
         self.slo = slo
+        #: fleet-observatory report for ``/debug/fleet``: a dict, or a
+        #: zero-arg callable returning the latest one (the CLI
+        #: ``fleet`` mode and the replay harness attach theirs here)
+        self.fleet = fleet
         self._httpd = None
         self._thread = None
         self._provider_name = None
@@ -209,6 +216,15 @@ class TelemetryServer:
             return 200, "application/json", _js(_comms.to_json())
         if path == "/debug/mesh":
             return 200, "application/json", _js(_comms.mesh_json())
+        if path == "/debug/fleet":
+            payload = self.fleet() if callable(self.fleet) else self.fleet
+            if payload is None:
+                payload = {
+                    "fleet": None,
+                    "hint": "no fleet report attached — run `python -m "
+                            "paddle_tpu.observability fleet` or pass "
+                            "TelemetryServer(fleet=...)"}
+            return 200, "application/json", _js(payload)
         if path == "/trace":
             extra = (self.recorder.chrome_events()
                      if self.recorder is not None else None)

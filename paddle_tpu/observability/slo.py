@@ -15,7 +15,10 @@ injection).
 Per window the tracker computes:
 
 * **compliance** — fraction of the window's observations that met the
-  threshold (1.0 while the window is empty: no evidence of breach);
+  threshold (1.0 while the window is empty: no evidence of breach —
+  but snapshots carry a per-window ``idle`` flag and a per-objective
+  ``idle`` so consumers can tell "healthy" from "unmeasured"; the
+  fleet attainment curves must not credit idle replicas);
 * **burn rate** — ``(1 - compliance) / (1 - target)``: how many times
   faster than budget the error budget is burning (1.0 = exactly on
   budget, 20 = a full fast-window outage at target 0.95).
@@ -121,6 +124,15 @@ class Objective:
         return not (self.burn_rate("fast") > self.burn_threshold
                     and self.burn_rate("slow") > self.burn_threshold)
 
+    @property
+    def idle(self):
+        """True while BOTH windows are empty: compliance/burn report
+        the vacuous defaults with zero evidence behind them.  A
+        zero-traffic replica is "compliant" only in the sense that it
+        was never measured — consumers building attainment curves must
+        check this flag instead of crediting the 1.0."""
+        return all(not self._samples(w) for w in WINDOWS)
+
     def snapshot(self):
         out = {
             "threshold": self.threshold,
@@ -130,11 +142,16 @@ class Objective:
             "observations": self.observations,
             "breaches": self.breaches,
             "healthy": self.healthy,
+            "idle": self.idle,
         }
         for w in WINDOWS:
+            samples = len(self._samples(w))
             out[w] = {
                 "window_steps": self.window_size(w),
-                "samples": len(self._samples(w)),
+                "samples": samples,
+                # an empty window's compliance=1.0 is vacuous, not
+                # evidence of health — the flag keeps the distinction
+                "idle": samples == 0,
                 "compliance": round(self.compliance(w), 6),
                 "burn_rate": round(self.burn_rate(w), 6),
             }
@@ -216,10 +233,19 @@ class SLOTracker:
         tracker with no objectives is vacuously healthy)."""
         return all(o.healthy for o in self._objectives.values())
 
+    @property
+    def idle(self):
+        """True while every declared objective is idle (or none are
+        declared): the tracker's ``healthy`` is vacuous — nothing was
+        measured.  The fleet harness uses this to keep zero-traffic
+        replicas out of attainment credit."""
+        return all(o.idle for o in self._objectives.values())
+
     def snapshot(self):
         return {
             "tracker": self.name,
             "healthy": self.healthy,
+            "idle": self.idle,
             "objectives": {n: o.snapshot()
                            for n, o in sorted(self._objectives.items())},
         }

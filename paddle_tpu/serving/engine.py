@@ -1350,11 +1350,14 @@ class Engine:
 
         The gateway-era admission fields are optional and inert for
         plain in-process callers: ``priority`` widens the scheduler's
-        overtake budget (see ``Scheduler.overtake_cap``), ``deadline_s``
-        bounds queue wait — a request still QUEUED when the deadline
-        passes is aborted at the next admission pass
-        (``finish_reason="abort"``) — and ``tenant`` tags the request
-        for per-tenant accounting in ``stats()['tenants']``.
+        overtake budget (see ``Scheduler.overtake_cap``; a NEGATIVE
+        priority is the offline batch lane — interactive traffic
+        overtakes it without bound, shedding and preemption pick it
+        first), ``deadline_s`` bounds queue wait — a request still
+        QUEUED when the deadline passes is aborted at the next
+        admission pass (``finish_reason="abort"``) — and ``tenant``
+        tags the request for per-tenant accounting in
+        ``stats()['tenants']``.
 
         ``resume_ids`` is the failover entry point: tokens this request
         already generated **on another engine** before its replica
@@ -1388,8 +1391,6 @@ class Engine:
         prompt_ids = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
         if not prompt_ids:
             raise ValueError("empty prompt")
-        if int(priority) < 0:
-            raise ValueError(f"priority must be >= 0, got {priority}")
         if deadline_s is not None and not float(deadline_s) > 0:
             raise ValueError(
                 f"deadline_s must be > 0 or None, got {deadline_s}")
@@ -1931,11 +1932,13 @@ class Engine:
         entries that already exist when the compiled program scatters
         through them (lazy allocation: rows only hold blocks they have
         reached).  Under pool pressure: reclaim unpinned prefix blocks
-        first, then preempt the YOUNGEST other running request (most
-        recently submitted — it has the least sunk decode work and
-        re-prefills cheapest) until the allocation fits.  Runs BEFORE
-        the step() harvest snapshot, so a preempted lane is never
-        mistaken for a mid-horizon retirement."""
+        first, then preempt the LOWEST-PRIORITY other running request
+        (the offline batch lane, priority < 0, is the designated
+        preemption fodder), youngest within a priority (most recently
+        submitted — it has the least sunk decode work and re-prefills
+        cheapest), until the allocation fits.  Runs BEFORE the step()
+        harvest snapshot, so a preempted lane is never mistaken for a
+        mid-horizon retirement."""
         for slot, req in sorted(self.scheduler.running.items()):
             if self.scheduler.running.get(slot) is not req:
                 continue                 # preempted earlier in this loop
@@ -1947,7 +1950,8 @@ class Engine:
                 victim = max(
                     (r for r in self.scheduler.running.values()
                      if r is not req),
-                    key=lambda r: r.request_id, default=None)
+                    key=lambda r: (-r.priority, r.request_id),
+                    default=None)
                 if victim is None:
                     raise RuntimeError(
                         f"KV pool exhausted: slot {slot} needs blocks "
@@ -2470,6 +2474,14 @@ class Engine:
             c["tokens_per_s"] = self._tokens_generated / self._busy_s
         return c
 
+    def tenant_ledger(self):
+        """The per-tenant accounting ledger (tenant None bills to "")
+        as a cheap copy — the gateway republishes it as
+        ``gateway.tenant_tokens_served`` gauges and the fleet replay
+        harness reconciles streamed tokens against it, without paying
+        for a full ``stats()`` pass."""
+        return {k: dict(v) for k, v in self._tenants.items()}
+
     def stats(self):
         """counters() plus derived stats: the distinct compiled horizon
         buckets, the fraction of scanned lane steps wasted on lanes that
@@ -2487,7 +2499,7 @@ class Engine:
         # None bills to "") and the deadline-abort tally; priorities
         # live on the requests themselves and in their QUEUED trace
         # events
-        s["tenants"] = {k: dict(v) for k, v in self._tenants.items()}
+        s["tenants"] = self.tenant_ledger()
         s["draining"] = self._draining
         s["degradation"] = {
             "level": self._degrade_level,

@@ -36,7 +36,11 @@ optional ``detokenize`` callable on the config fills the OpenAI
 ``SamplingParams`` (``max_tokens`` -> ``max_new_tokens``,
 ``stop_token_id`` -> ``eos_token_id``) plus the gateway-era admission
 fields ``priority``, ``deadline_s``, and ``tenant`` (OpenAI's ``user``
-is accepted as an alias).  Because the engine's sampling is bitwise
+is accepted as an alias).  A NEGATIVE ``priority`` selects the offline
+batch lane: normalized to one tier (-1), non-streaming only (400
+``batch_no_stream`` with ``"stream": true``), preemptible, and exempt
+from the scheduler's starvation window — interactive traffic passes
+it without bound.  Because the engine's sampling is bitwise
 deterministic per ``(seed, token index)``, a streamed completion is
 token-for-token identical to in-process ``Engine.run()`` for the same
 request — tested both greedy and seeded-stochastic.
@@ -84,6 +88,18 @@ _GW_TTFT = _obs_metrics.histogram(
     "gateway receive to first streamed token chunk")
 _GW_LATENCY = _obs_metrics.histogram(
     "gateway.request_seconds", "gateway receive to completion sent")
+# the per-tenant ledger, promoted from stats() to scrapeable metrics:
+# tokens mirror the engines' authoritative per-tenant accounting
+# (republished at each completion), sheds count this gateway's
+# admission rejections (quota + SLO shed + retry-budget) per tenant
+_GW_TENANT_TOKENS = _obs_metrics.gauge(
+    "gateway.tenant_tokens_served",
+    "tokens generated per tenant across the fleet (engine ledger, "
+    "republished at completion)")
+_GW_TENANT_SHEDS = _obs_metrics.gauge(
+    "gateway.tenant_sheds",
+    "admission rejections per tenant (quota exhausted, SLO shed, "
+    "retry budget spent)")
 
 #: finish_reason wire mapping (OpenAI uses "stop" for EOS)
 _FINISH_WIRE = {FINISH_EOS: "stop"}
@@ -106,8 +122,12 @@ class GatewayConfig:
     shed_retry_after_s: float = 1.0
     #: leading radix-cache blocks hashed into the routing affinity key
     affinity_blocks: int = 2
-    #: priorities are clamped to [0, max_priority] (the scheduler's
-    #: starvation bound is reorder_window * (1 + max_priority))
+    #: interactive priorities are validated to [0, max_priority] (the
+    #: scheduler's starvation bound is reorder_window *
+    #: (1 + max_priority)).  NEGATIVE priorities are the offline batch
+    #: lane: normalized to -1, non-streaming only, preemptible, and
+    #: exempt from the starvation window (interactive traffic passes
+    #: without bound)
     max_priority: int = 8
     #: ceiling on one completion's wall time before the gateway aborts
     #: it server-side
@@ -198,6 +218,11 @@ class Gateway:
         self._finalizer = None
         self._next_cmpl = 0
         self._cmpl_lock = threading.Lock()
+        # gateway-side half of the per-tenant ledger: admission sheds
+        # (the engines never see a shed request, so only the gateway
+        # can bill it)
+        self._tenant_sheds = {}
+        self._shed_lock = threading.Lock()
 
     # ----------------------------------------------------------- lifecycle
     @property
@@ -283,6 +308,60 @@ class Gateway:
     def _wire_reason(reason):
         return _FINISH_WIRE.get(reason, reason)
 
+    # ----------------------------------------------------- tenant ledger
+    def _bill_shed(self, tenant):
+        """Charge one admission rejection to a tenant and republish its
+        ``gateway.tenant_sheds`` gauge."""
+        tenant = tenant or ""
+        with self._shed_lock:
+            n = self._tenant_sheds.get(tenant, 0) + 1
+            self._tenant_sheds[tenant] = n
+        _GW_TENANT_SHEDS.set(n, tenant=tenant)
+
+    def _publish_tenant_tokens(self, tenant):
+        """Republish one tenant's fleet-wide generated-token total
+        (the engines' authoritative ledger summed across replicas) as
+        the ``gateway.tenant_tokens_served`` gauge."""
+        tenant = tenant or ""
+        total = 0
+        for w in self.workers:
+            eng = getattr(w, "engine", None)
+            if eng is None:
+                continue
+            try:
+                total += eng.tenant_ledger().get(tenant, {}).get(
+                    "tokens_generated", 0)
+            except Exception:
+                continue     # a crashed replica has nothing to report
+        _GW_TENANT_TOKENS.set(total, tenant=tenant)
+
+    def tenant_ledger(self):
+        """The fleet-wide per-tenant attainment ledger: the engines'
+        per-tenant accounting summed across replicas, plus this
+        gateway's admission-shed tally — the hook the fleet replay
+        harness aggregates per-tenant attainment from (and the source
+        of the ``gateway.tenant_*`` gauges on ``/metrics``)."""
+        zero = {"submitted": 0, "finished": 0, "aborted": 0,
+                "tokens_generated": 0, "sheds": 0}
+        out = {}
+        for w in self.workers:
+            eng = getattr(w, "engine", None)
+            if eng is None:
+                continue
+            try:
+                ledger = eng.tenant_ledger()
+            except Exception:
+                continue
+            for tenant, counts in ledger.items():
+                agg = out.setdefault(tenant, dict(zero))
+                for k, v in counts.items():
+                    agg[k] = agg.get(k, 0) + v
+        with self._shed_lock:
+            sheds = dict(self._tenant_sheds)
+        for tenant, n in sheds.items():
+            out.setdefault(tenant, dict(zero))["sheds"] = n
+        return out
+
     # ------------------------------------------------------------ GET side
     def handle_get(self, path):
         """Route one GET; returns (status, content_type, body bytes).
@@ -360,9 +439,19 @@ class Gateway:
             raise bad(str(e)) from None
         priority = payload.get("priority", 0)
         if (isinstance(priority, bool) or not isinstance(priority, int)
-                or not 0 <= priority <= self.config.max_priority):
-            raise bad(f"'priority' must be an int in "
-                      f"[0, {self.config.max_priority}]")
+                or priority > self.config.max_priority):
+            raise bad(f"'priority' must be an int <= "
+                      f"{self.config.max_priority} (negative = the "
+                      f"offline batch lane)")
+        if priority < 0:
+            # the offline batch lane is one tier: lowest, non-streaming,
+            # preemptible, overtaken without bound
+            priority = -1
+            if payload.get("stream"):
+                raise bad("batch-lane requests (priority < 0) cannot "
+                          "stream: the lane is preemptible and "
+                          "non-interactive — poll the JSON completion "
+                          "instead", "batch_no_stream")
         deadline = payload.get("deadline_s")
         if deadline is not None and (
                 isinstance(deadline, bool)
@@ -452,6 +541,7 @@ class Gateway:
         granted, retry = self.quotas.admit(parsed["tenant"], cost)
         if not granted:
             _GW_REJECTS.inc(reason="quota")
+            self._bill_shed(parsed["tenant"])
             raise _Reject(
                 429, f"tenant {parsed['tenant']!r} quota exhausted "
                 f"({cost} tokens requested)", "tenant_quota_exceeded",
@@ -462,6 +552,7 @@ class Gateway:
             worker, how = self.router.route(parsed["prompt_ids"])
             if worker is None:
                 _GW_REJECTS.inc(reason="shed")
+                self._bill_shed(parsed["tenant"])
                 raise _Reject(
                     503, "every replica is unhealthy (SLO burn) or "
                     "draining; retry shortly", "service_unavailable",
@@ -486,6 +577,7 @@ class Gateway:
                     TimeoutError) as e:
                 if attempt >= self.retry.max_retries:
                     _GW_REJECTS.inc(reason="retry_budget")
+                    self._bill_shed(parsed["tenant"])
                     raise _Reject(
                         503, f"submit failed after {attempt + 1} "
                         f"attempts: {e}", "service_unavailable",
@@ -498,6 +590,7 @@ class Gateway:
                 continue
             except RuntimeError as e:
                 _GW_REJECTS.inc(reason="shed")
+                self._bill_shed(parsed["tenant"])
                 raise _Reject(
                     503, str(e), "service_unavailable",
                     "replica_draining",
@@ -545,6 +638,7 @@ class Gateway:
                                        self._wire_reason(value)))
                 yield b"data: [DONE]\n\n"
                 _GW_LATENCY.observe(time.monotonic() - t_recv)
+                self._publish_tenant_tokens(handle.request.tenant)
                 return
 
     def complete_sync(self, handle, t_recv):
@@ -562,6 +656,7 @@ class Gateway:
                 break
         req = handle.request
         _GW_LATENCY.observe(time.monotonic() - t_recv)
+        self._publish_tenant_tokens(req.tenant)
         return {
             "id": self._cmpl_id(), "object": "text_completion",
             "created": int(time.time()),

@@ -31,6 +31,16 @@ capped at ``P``, a queued request is overtaken by at most
 anchor the next batch.  A bounded stable promotion pass
 (:meth:`Scheduler.promote`) bubbles higher-priority requests toward
 the head inside that budget before each ``pop_batch``.
+
+The exception is the **offline batch lane**: a request with
+``priority < 0`` opts out of the starvation bound entirely —
+interactive traffic (``priority >= 0``) overtakes it WITHOUT bound
+(:meth:`Scheduler.overtake_cap` returns infinity against it, and a
+skipped batch request never seals the ``pop_batch`` scan).  Batch
+requests still run FIFO among themselves, still anchor a batch when
+they reach the head of an otherwise-idle queue, and are first in line
+for load shedding (:meth:`shed_victims` drops lowest priority first),
+so the lane is preemptible capacity filler, not a starvation hazard.
 """
 
 from __future__ import annotations
@@ -85,7 +95,9 @@ class Request:
     trace: object = None
     #: admission priority (gateway-era field): 0 is baseline; a higher
     #: value widens the overtake budget against lower-priority queued
-    #: requests by ``reorder_window * priority_gap`` (see module doc)
+    #: requests by ``reorder_window * priority_gap`` (see module doc).
+    #: Negative = the offline batch lane: interactive traffic passes
+    #: it without bound and load shedding drops it first.
     priority: int = 0
     #: seconds after ``submit_time`` by which the request must have been
     #: admitted; the engine aborts still-QUEUED requests whose deadline
@@ -186,8 +198,16 @@ class Scheduler:
         cap bounds BOTH reorder sources — same-bucket co-batching and
         the priority promotion pass — so the documented starvation
         bound (``window * (1 + max priority gap)`` total overtakes)
-        holds across them combined."""
+        holds across them combined.
+
+        A batch-lane victim (``priority < 0``) has NO budget limit
+        against interactive traffic: the cap is infinite, so the
+        starvation bound applies only among interactive tiers (and
+        among batch requests themselves, which keep the plain
+        window)."""
         w = self.reorder_window if window is None else int(window)
+        if victim.priority < 0 <= overtaker.priority:
+            return float("inf")
         gap = max(0, int(overtaker.priority) - int(victim.priority))
         return w * (1 + gap)
 
@@ -295,9 +315,10 @@ class Scheduler:
                 continue
             if sealed:
                 continue
-            if skipped and idx >= max(w, 1):
+            if (any(s.priority >= 0 for s in skipped)
+                    and idx >= max(w, 1)):
                 sealed = True    # reordering beyond the window forbidden
-                continue
+                continue         # (batch-lane skips don't bound the scan)
             if bucket_of(r) == anchor_bucket:
                 if any(s.bypassed >= self.overtake_cap(s, r, w)
                        for s in skipped):
@@ -308,7 +329,7 @@ class Scheduler:
                     s.bypassed += 1
             else:
                 skipped.append(r)
-                if w <= 0 or r.bypassed >= w:
+                if w <= 0 or (r.priority >= 0 and r.bypassed >= w):
                     sealed = True  # nobody may pass this request anymore
         taken = {id(r) for r in batch}
         self.queue = deque(r for r in q if id(r) not in taken)

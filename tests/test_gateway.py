@@ -415,7 +415,10 @@ class TestGatewayHTTP:
                 ({"prompt": [1, 2], "model": "other"}, 404,
                  "model_not_found"),
                 ({"prompt": [1, 2], "top_p": 0.0}, 400, None),
-                ({"prompt": [1, 2], "priority": -1}, 400, None),
+                ({"prompt": [1, 2], "priority": 99}, 400, None),
+                ({"prompt": [1, 2], "priority": "high"}, 400, None),
+                ({"prompt": [1, 2], "priority": -1, "stream": True},
+                 400, "batch_no_stream"),
                 ({"prompt": [1, 2], "deadline_s": 0}, 400, None),
                 ({"prompt": [1, 2], "stream": "yes"}, 400, None),
                 ({"prompt": [1] * 100, "max_tokens": 10}, 400, None)):
