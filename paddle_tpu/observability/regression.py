@@ -54,6 +54,14 @@ DETERMINISTIC_FIELDS = {
     "ppermute_calls": False,
     "collective_calls_total": False,
     "modeled_wire_bytes_per_step": False,
+    # chunked prefill: how many chunk dispatches a long prompt takes
+    # and the chunk bucket itself are schedule facts, not timings — a
+    # change that silently doubles per-boundary prefill work (or stops
+    # chunking at all) gates exact even when the stall numbers are
+    # noise-bound on cpu runners
+    "chunk_dispatches": False,
+    "chunk_tokens": False,
+    "max_dispatch_bucket": False,
 }
 
 

@@ -212,6 +212,16 @@ _SRV_QUEUE_WAIT = _obs_metrics.histogram(
     "serving.queue_wait_seconds",
     "submit-to-admission wall seconds, observed when a request claims "
     "a slot (re-admissions after preemption observe again)")
+_SRV_PREFILL_CHUNKS = _obs_metrics.histogram(
+    "serving.prefill_chunks",
+    "chunk dispatches per chunked-prefill request, observed when its "
+    "final chunk samples the first token",
+    buckets=(1, 2, 4, 8, 16, 32, 64))
+_SRV_PREFILL_INTERFERE = _obs_metrics.counter(
+    "serving.prefill_interference_seconds",
+    "wall seconds decode horizons were delayed by interleaved prefill "
+    "chunk dispatches (chunk dispatches issued while decode lanes were "
+    "active)")
 # compile/cache families SHARED with jit/api.py: one place answers
 # "which function retraced" for both to_static and serving programs
 _COMPILE_COUNT = _obs_metrics.counter(
@@ -358,6 +368,23 @@ class EngineConfig:
     max_seq_len: int = 256
     #: smallest prefill bucket; prompts pad up to the next power of two
     min_prefill_bucket: int = 8
+    #: chunked prefill (Sarathi-style): split every prefill whose
+    #: suffix exceeds this many tokens into fixed-size chunks dispatched
+    #: one per step boundary, interleaved with decode horizons, so a
+    #: long prompt can no longer monopolize the engine (TPOT spikes for
+    #: the active decode batch shrink to one chunk-bucket program per
+    #: boundary).  Normalized to a power of two >= min_prefill_bucket
+    #: (the compile-cache discipline: every chunk dispatch reuses ONE
+    #: program per lane bucket), and the per-dispatch token budget is
+    #: chunk_tokens per lane.  The lane's block table grows chunk by
+    #: chunk, partial progress is adopted into the prefix radix store at
+    #: every chunk boundary (preemption mid-prefill resumes from the
+    #: boundary via an ordinary prefix hit), and the final chunk samples
+    #: the request's first token under the unchanged
+    #: ``request_key(seed, counts)`` PRNG — so chunked output is
+    #: BITWISE-equal to whole-prompt prefill, greedy and seeded.
+    #: 0 disables (whole-prompt prefill).
+    prefill_chunk_tokens: int = 0
     #: kv cache dtype; None = the model's parameter dtype
     cache_dtype: object = None
     #: largest number of fused decode steps one compiled dispatch may
@@ -516,6 +543,25 @@ def _unpack_mask(rows, vocab):
     return flat[..., :vocab].astype(bool)
 
 
+@dataclass
+class _ChunkProgress:
+    """Host ledger of one in-flight chunked prefill.  The request holds
+    its slot (scheduler RUNNING, decode-INACTIVE — the horizon scan
+    masks the lane like a retired one) while fixed-size chunks of its
+    admission token sequence dispatch one per step boundary.  ``covered``
+    tokens are already written into the lane's KV blocks; every chunk
+    boundary adopts the newly completed full blocks into the prefix
+    radix store, so the boundary doubles as the preemption resume point
+    (re-admission finds the progress as an ordinary prefix hit)."""
+
+    req: object
+    slot: int
+    lease: object
+    toks: list
+    covered: int              # tokens written into the lane's KV so far
+    chunks: int = 0           # chunk dispatches taken (incl. admission)
+
+
 class Engine:
     """Submit/step/generate over a causal-LM Layer (GPTForCausalLM /
     LlamaForCausalLM or anything with ``.model``, ``.config`` and
@@ -591,6 +637,26 @@ class Engine:
             bytes_per_block=self.pool.bytes_per_block)
         self._max_blocks = self.cache.max_blocks_per_slot
         self._leases = {}            # request_id -> PrefixLease
+
+        # chunked prefill: normalize the chunk size to a power of two in
+        # [min_prefill_bucket, max_seq_len] so every chunk dispatch hits
+        # one compiled program per lane bucket (0 = whole-prompt prefill)
+        ct = int(self.config.prefill_chunk_tokens or 0)
+        if ct < 0:
+            raise ValueError(
+                f"prefill_chunk_tokens must be >= 0, got {ct}")
+        if ct:
+            ct = min(self._pow2_ceil(max(ct,
+                                         self.config.min_prefill_bucket)),
+                     self.config.max_seq_len)
+        self._chunk_tokens = ct
+        self._chunking = {}          # request_id -> _ChunkProgress
+        self._chunk_dispatches = 0   # compiled chunk-continuation calls
+        self._chunked_requests = 0   # requests admitted chunk-wise
+        self._chunk_count_total = 0  # chunk dispatches across requests
+        self._prefill_interference_s = 0.0
+        self._prefill_buckets = set()   # (lanes, bucket) per dispatch
+        self._context_high_water = 0    # deepest prefilled position
 
         # host MIRRORS of the per-slot decode state.  The authoritative
         # copy lives on device between horizons (updated inside the
@@ -1118,22 +1184,35 @@ class Engine:
     def _admission_bucket(self, req):
         """The prefill length bucket a request would dispatch in right
         now: its suffix past the cached prefix, padded to a power of
-        two, clamped so prefix + bucket fits the slot row.  Used both
-        for co-batch grouping (Scheduler.pop_batch) and for sizing the
-        actual dispatch."""
+        two, clamped so prefix + bucket fits the slot row.  With
+        chunked prefill on, the bucket is additionally capped at the
+        chunk size — the admission dispatch covers only the FIRST chunk
+        of a long suffix, so no compiled prefill program is ever wider
+        than the chunk bucket (the whole-prompt context cap is gone).
+        Used both for co-batch grouping (Scheduler.pop_batch) and for
+        sizing the actual dispatch."""
         toks = self._admission_tokens(req)
         matched = self.prefix.lookup(toks)
         bucket = min(self._bucket(len(toks) - matched),
                      self.config.max_seq_len - matched)
+        if self._chunk_tokens:
+            bucket = min(bucket, self._chunk_tokens)
         return bucket
 
     def _blocks_needed(self, req):
         """Fresh pool blocks this request's admission would allocate:
         its table entries minus the full-block prefix hits it would
-        lease (a COW tail match still needs its own private block)."""
+        lease (a COW tail match still needs its own private block).
+        Under chunked prefill only the FIRST chunk's coverage is
+        allocated at admission — later chunks grow the table chunk by
+        chunk, with their own pool-pressure handling."""
         toks = self._admission_tokens(req)
-        full = self.prefix.lookup(toks) // self._block_size
-        return -(-len(toks) // self._block_size) - full
+        matched = self.prefix.lookup(toks)
+        full = matched // self._block_size
+        cover = len(toks)
+        if self._chunk_tokens:
+            cover = min(cover, matched + self._admission_bucket(req))
+        return -(-cover // self._block_size) - full
 
     @staticmethod
     def _pow2_floor(x):
@@ -1197,7 +1276,9 @@ class Engine:
         max_h = max(1, int(self.config.max_horizon))
         if requested is not None:
             return self._pow2_floor(min(max(1, int(requested)), max_h))
-        if self.scheduler.queue_depth:
+        if self.scheduler.queue_depth or self._chunking:
+            # pending work at the boundary (queued requests, or prompts
+            # mid-chunked-prefill): tightest interleave
             return 1
         rem = min(r.remaining_budget
                   for r in self.scheduler.running.values())
@@ -1517,6 +1598,11 @@ class Engine:
                     # admission pass to the next horizon boundary
                     self._admit_deferred = True
                     return
+        # continuation chunks first: in-flight chunked prefills advance
+        # one chunk per boundary ahead of new admissions (their blocks
+        # are already partly written — finishing them frees capacity
+        # soonest and keeps TTFT ordering honest)
+        self._advance_chunks()
         # while draining, the queue can only hold `resumed` requests
         # (submit() refuses and drain() aborted the rest) — re-admitting
         # them is finishing in-flight work, so admission proceeds
@@ -1577,37 +1663,54 @@ class Engine:
         the block tables, allocate private blocks for the rest, COW +
         suffix-prefill every lane, adopt the new blocks into the radix
         store (refcounting only), then harvest first tokens and arm the
-        decode state."""
+        decode state.
+
+        With chunked prefill on, a lane whose suffix exceeds the batch
+        bucket dispatches only its FIRST chunk here; the rest of its
+        prompt continues one chunk per step boundary in
+        :meth:`_advance_chunks`, and its first token is sampled by the
+        final chunk."""
         n = len(batch)
         bucket = max(self._admission_bucket(r) for r in batch)
         lanes = self._lane_bucket(n)
         bs = self._block_size
-        slots, leases, all_tokens = [], [], []
+        entries = []
         admit_events = []            # per-request trace args, for cost
         for req in batch:
             slot = self.cache.alloc()
-            slots.append(slot)
+            was_resumed = req.resumed
             self.scheduler.start(req, slot)
             _SRV_QUEUE_WAIT.observe(req.queue_seconds,
                                     engine=self._profiler_name)
             toks = self._admission_tokens(req)
-            all_tokens.append(toks)
             lease = self.prefix.acquire(toks)
-            leases.append(lease)
             self._leases[req.request_id] = lease
             req.prefix_hit_tokens = lease.matched_tokens
+            start = lease.matched_tokens
+            take = len(toks) - start
+            if self._chunk_tokens:
+                take = min(take, bucket)
+            cover = start + take
             # table row: leased full-match blocks first (copy-free,
-            # shared), then private blocks out to the last prompt token
+            # shared), then private blocks out to the last covered token
             # (the COW tail copy, if any, lands in the first private one)
             full = len(lease.block_ids)
             for j, bid in enumerate(lease.block_ids):
                 self.cache.lease_block(slot, j, bid)
-            for j in range(full, -(-len(toks) // bs)):
+            for j in range(full, -(-cover // bs)):
                 if self.cache.alloc_entry(slot, j) is None:
                     raise RuntimeError(
                         "KV pool exhausted mid-admission — "
                         "admit()'s capacity pre-check diverged from "
                         "the blocks actually allocated")
+            cow = None
+            if lease.tail_tokens:
+                cow = (lease.tail_block,
+                       self.cache.tables[slot, len(lease.block_ids)])
+                self._cow_copies += 1
+            entries.append(dict(req=req, slot=slot, lease=lease,
+                                toks=toks, start=start, take=take,
+                                final=cover == len(toks), cow=cow))
             _obs_events.instant("serving.slot_alloc", cat="serving",
                                 slot=slot, request=req.request_id,
                                 prompt_len=req.prompt_len, bucket=bucket,
@@ -1617,14 +1720,14 @@ class Engine:
                 # isn't known until the dispatch below, so its cost
                 # share is patched in afterwards
                 admit_events.append(req.trace.add(
-                    _obs_tracing.RESUME if req.output_ids
+                    _obs_tracing.RESUME if (req.output_ids or was_resumed)
                     else _obs_tracing.PREFILL,
                     slot=slot, bucket=bucket,
                     prefill_tokens=len(toks),
                     prefix_hit_tokens=lease.matched_tokens))
             else:
                 admit_events.append(None)
-            if not req.output_ids:
+            if not req.output_ids and not was_resumed:
                 # async span: a request's life overlaps other requests
                 # on this thread, so it pairs by id, not by B/E nesting
                 # (a preempted request's span is already open)
@@ -1634,6 +1737,67 @@ class Engine:
                     args={"slot": slot, "prompt_len": req.prompt_len,
                           "prefix_hit_tokens": lease.matched_tokens})
 
+        first_np, dfa = self._dispatch_prefill(entries, bucket, lanes)
+        name = self._profiler_name
+        self._prefill_requests += n
+        _SRV_PREFILL_REQS.inc(n, engine=name)
+        _SRV_PREFILL_BATCH.observe(n, engine=name)
+
+        # cost attribution: the dispatch's program-card totals split
+        # evenly over the n REAL requests (padding lanes ride free but
+        # their work is part of serving these n), so per-request shares
+        # sum back to the engine's _program_* totals exactly
+        card = self._prefill.last_card
+        if card is not None:
+            for ev in admit_events:
+                if ev is not None:
+                    if card.flops is not None:
+                        ev["flops_est"] = card.flops / n
+                    if card.bytes_accessed is not None:
+                        ev["bytes_est"] = card.bytes_accessed / n
+
+        # cache the new full blocks of every admitted prompt (chunked
+        # lanes: the blocks their first chunk just completed): the radix
+        # store takes shared references on the slot's freshly written
+        # private blocks — pure host-side refcounting, no data motion
+        for e in entries:
+            row = self.cache.tables[e["slot"]]
+            self.prefix.adopt(e["toks"][:e["start"] + e["take"]],
+                              e["lease"],
+                              block_of=lambda j, row=row: row[j])
+
+        for i, e in enumerate(entries):
+            req, lease, slot = e["req"], e["lease"], e["slot"]
+            hit = lease.matched_tokens
+            self._prefix_hit_tokens += hit
+            self._prompt_tokens += len(e["toks"])
+            if hit:
+                _SRV_PREFIX_HIT.inc(hit, engine=name)
+            if not e["final"]:
+                # chunked admission: first chunk written, no token
+                # sampled yet — register the continuation ledger and
+                # leave the lane decode-inactive
+                cover = e["start"] + e["take"]
+                self._chunked_requests += 1
+                self._chunk_count_total += 1
+                self._chunking[req.request_id] = _ChunkProgress(
+                    req, slot, lease, e["toks"], cover, chunks=1)
+                self._pos[slot] = cover
+                self._active[slot] = False
+                self._state_dirty = True
+                self._context_high_water = max(
+                    self._context_high_water, cover)
+                continue
+            self._finish_prefill_lane(req, slot, e["toks"],
+                                      int(first_np[i]), int(dfa[i]))
+
+    def _dispatch_prefill(self, entries, bucket, lanes):
+        """Build the lane arrays for a prefill dispatch (admission
+        batches and chunk continuations share this) and run the ONE
+        compiled call.  Returns ``(first_np, dfa)`` — the sampled
+        first-token array after the host sync, and the per-lane DFA
+        admission states the dispatch ran with (callers advance the
+        armed lanes' mirrors through them)."""
         # lane arrays: real requests first, then padding lanes whose
         # all-zero table rows route every write to scratch block 0
         ids = np.zeros((lanes, bucket), np.int32)
@@ -1648,23 +1812,22 @@ class Engine:
         top_ks = np.zeros(lanes, np.int32)
         top_ps = np.ones(lanes, np.float32)
         # per-lane DFA admission states; 0 (accept-all sentinel) for
-        # free and padding lanes
+        # free, padding, and non-final chunk lanes (whose sampled token
+        # is discarded)
         dfa = np.zeros(lanes, np.int32)
-        for i in range(n):
-            req, lease, toks = batch[i], leases[i], all_tokens[i]
-            if req.grammar is not None:
+        for i, e in enumerate(entries):
+            req = e["req"]
+            if e["final"] and req.grammar is not None:
                 dfa[i] = self._dfa_admission_state(req)
-            suffix = toks[lease.matched_tokens:]
-            ids[i, :len(suffix)] = suffix
-            lengths[i] = len(suffix)
-            prefix_lens[i] = lease.matched_tokens
-            tables[i] = self.cache.tables[slots[i]]
-            if lease.tail_tokens:
-                cow_src[i] = lease.tail_block
-                cow_dst[i] = self.cache.tables[slots[i],
-                                               len(lease.block_ids)]
-                self._cow_copies += 1
-            counts[i] = max(0, req.n_generated - 1)
+            window = e["toks"][e["start"]:e["start"] + e["take"]]
+            ids[i, :len(window)] = window
+            lengths[i] = len(window)
+            prefix_lens[i] = e["start"]
+            tables[i] = self.cache.tables[e["slot"]]
+            if e["cow"] is not None:
+                cow_src[i], cow_dst[i] = e["cow"]
+            if e["final"]:
+                counts[i] = max(0, req.n_generated - 1)
             s = req.sampling
             seeds[i] = np.uint32(s.seed)
             temps[i] = s.temperature
@@ -1673,8 +1836,8 @@ class Engine:
 
         with _obs_span("serving.prefill_pass", cat="serving",
                        engine=self._profiler_name,
-                       event_args={"batch_size": n, "lanes": lanes,
-                                   "bucket": bucket}):
+                       event_args={"batch_size": len(entries),
+                                   "lanes": lanes, "bucket": bucket}):
             first, new_k, new_v, new_ks, new_vs = self._prefill(
                 self._state_arrays, jnp.asarray(ids),
                 jnp.asarray(lengths), jnp.asarray(prefix_lens),
@@ -1687,92 +1850,168 @@ class Engine:
                 *self._grammar_prefill_args(dfa))
         self.pool.rebind(new_k, new_v, new_ks, new_vs)
         self._prefill_calls += 1
-        self._prefill_requests += n
-        name = self._profiler_name
-        _SRV_PREFILL.inc(engine=name)
-        _SRV_PREFILL_REQS.inc(n, engine=name)
-        _SRV_PREFILL_BATCH.observe(n, engine=name)
-
-        # cost attribution: the dispatch's program-card totals split
-        # evenly over the n REAL requests (padding lanes ride free but
-        # their work is part of serving these n), so per-request shares
-        # sum back to the engine's _program_* totals exactly
+        self._prefill_buckets.add((lanes, bucket))
+        _SRV_PREFILL.inc(engine=self._profiler_name)
         card = self._prefill.last_card
         if card is not None:
             self._program_flops += card.flops or 0.0
             self._program_bytes += card.bytes_accessed or 0.0
-            for ev in admit_events:
-                if ev is not None:
-                    if card.flops is not None:
-                        ev["flops_est"] = card.flops / n
-                    if card.bytes_accessed is not None:
-                        ev["bytes_est"] = card.bytes_accessed / n
+        return np.asarray(first), dfa    # the one prefill host sync
 
-        # cache the new full blocks of every admitted prompt: the radix
-        # store takes shared references on the slot's freshly written
-        # private blocks — pure host-side refcounting, no data motion
-        for lease, toks, slot in zip(leases, all_tokens, slots):
-            row = self.cache.tables[slot]
-            self.prefix.adopt(toks, lease,
-                              block_of=lambda j, row=row: row[j])
+    def _finish_prefill_lane(self, req, slot, toks, tok, dfa_i):
+        """Arm one lane whose prefill just completed — whole-prompt, or
+        the final chunk of a chunked one: verify/record the sampled
+        first token and bring the lane's decode mirrors live."""
+        name = self._profiler_name
+        if req.output_ids:
+            # preemption swap-in: the prefill re-sampled the token
+            # that was in flight when the request was swapped out —
+            # fold_in(seed, n-1) must reproduce it bitwise
+            if tok != req.output_ids[-1]:
+                raise RuntimeError(
+                    f"preemption resume diverged for request "
+                    f"{req.request_id}: re-prefill sampled {tok}, "
+                    f"expected {req.output_ids[-1]}")
+        else:
+            self._tokens_generated += 1
+            _SRV_TOKENS.inc(engine=name)
+            done = req.record_token(tok)
+            if req.trace is not None:
+                req.trace.add(_obs_tracing.FIRST_TOKEN, token=tok,
+                              ttft_s=round(req.ttft, 6))
+            if done:
+                self._retire(req)
+                return
+        s = req.sampling
+        self._tokens[slot] = tok
+        self._pos[slot] = len(toks)
+        self._context_high_water = max(self._context_high_water,
+                                       len(toks))
+        # the drafter's corpus: prompt (plus regenerated tokens on a
+        # preemption resume) followed by the first sampled token —
+        # the tail past the valid length is never matched, but zero
+        # it so a reused slot carries nothing of its previous tenant
+        self._hist[slot, :len(toks)] = toks
+        self._hist[slot, len(toks)] = tok
+        self._hist[slot, len(toks) + 1:] = 0
+        self._spec_ema[slot] = 1.0   # optimistic: draft until shown
+        self._spec_gates[slot] = True  # not to pay off
+        # the lane's DFA state AFTER the prefill-sampled token: the
+        # admission state advanced one transition (sentinel row 0
+        # self-loops, so free lanes stay at 0)
+        self._dfa_state[slot] = (
+            int(self._grammar_slab.next[dfa_i, tok])
+            if req.grammar is not None else 0)
+        self._seeds[slot] = np.uint32(s.seed)
+        self._counts[slot] = req.n_generated
+        self._temps[slot] = s.temperature
+        self._top_ks[slot] = s.top_k
+        self._top_ps[slot] = s.top_p
+        self._eos_ids[slot] = -1 if s.eos_token_id is None \
+            else int(s.eos_token_id)
+        self._limits[slot] = s.max_new_tokens
+        self._active[slot] = True
+        self._state_dirty = True     # admission is the ONLY host
+        # write into device-resident state; retirement is detected
+        # inside the scan, so it needs no re-upload
 
-        first_np = np.asarray(first)     # the one prefill host sync
-        for i, (req, lease, slot) in enumerate(zip(batch, leases, slots)):
-            hit = lease.matched_tokens
-            self._prefix_hit_tokens += hit
-            self._prompt_tokens += len(all_tokens[i])
-            if hit:
-                _SRV_PREFIX_HIT.inc(hit, engine=name)
-            tok = int(first_np[i])
-            if req.output_ids:
-                # preemption swap-in: the prefill re-sampled the token
-                # that was in flight when the request was swapped out —
-                # fold_in(seed, n-1) must reproduce it bitwise
-                if tok != req.output_ids[-1]:
-                    raise RuntimeError(
-                        f"preemption resume diverged for request "
-                        f"{req.request_id}: re-prefill sampled {tok}, "
-                        f"expected {req.output_ids[-1]}")
-            else:
-                self._tokens_generated += 1
-                _SRV_TOKENS.inc(engine=name)
-                done = req.record_token(tok)
-                if req.trace is not None:
-                    req.trace.add(_obs_tracing.FIRST_TOKEN, token=tok,
-                                  ttft_s=round(req.ttft, 6))
-                if done:
-                    self._retire(req)
+    def _advance_chunks(self):
+        """Dispatch one continuation chunk for every in-flight chunked
+        prefill — called at each step boundary, BEFORE admission, so a
+        decode horizon runs between consecutive chunks of the same
+        prompt (the interleave policy; the per-boundary prefill budget
+        is one chunk-bucket program).  Each lane's block table grows to
+        cover its next chunk first (reclaiming prefix blocks, then
+        preempting the lowest-priority/youngest other running request
+        under pool pressure — the `_ensure_blocks` ladder); all pending
+        lanes then ride ONE compiled dispatch at the chunk bucket.
+        Completed full blocks are adopted into the radix store at every
+        boundary, so mid-prefill preemption resumes from the chunk
+        boundary as an ordinary prefix hit.  A lane's final chunk
+        samples its first token and arms decode."""
+        if not self._chunking:
+            return
+        decode_live = any(bool(self._active[s])
+                          for s in self.scheduler.running)
+        entries = []
+        for prog in list(self._chunking.values()):
+            req, slot = prog.req, prog.slot
+            if self.scheduler.running.get(slot) is not req:
+                continue             # preempted/aborted meanwhile
+            remaining = len(prog.toks) - prog.covered
+            take = min(remaining, self._chunk_tokens)
+            preempted_self = False
+            while not self.cache.ensure_blocks(slot,
+                                               prog.covered + take):
+                if self.prefix.reclaim(1):
                     continue
-            s = req.sampling
-            self._tokens[slot] = tok
-            self._pos[slot] = len(all_tokens[i])
-            # the drafter's corpus: prompt (plus regenerated tokens on a
-            # preemption resume) followed by the first sampled token —
-            # the tail past the valid length is never matched, but zero
-            # it so a reused slot carries nothing of its previous tenant
-            self._hist[slot, :len(all_tokens[i])] = all_tokens[i]
-            self._hist[slot, len(all_tokens[i])] = tok
-            self._hist[slot, len(all_tokens[i]) + 1:] = 0
-            self._spec_ema[slot] = 1.0   # optimistic: draft until shown
-            self._spec_gates[slot] = True  # not to pay off
-            # the lane's DFA state AFTER the prefill-sampled token: the
-            # admission state advanced one transition (sentinel row 0
-            # self-loops, so free lanes stay at 0)
-            self._dfa_state[slot] = (
-                int(self._grammar_slab.next[int(dfa[i]), tok])
-                if req.grammar is not None else 0)
-            self._seeds[slot] = np.uint32(s.seed)
-            self._counts[slot] = req.n_generated
-            self._temps[slot] = s.temperature
-            self._top_ks[slot] = s.top_k
-            self._top_ps[slot] = s.top_p
-            self._eos_ids[slot] = -1 if s.eos_token_id is None \
-                else int(s.eos_token_id)
-            self._limits[slot] = s.max_new_tokens
-            self._active[slot] = True
-            self._state_dirty = True     # admission is the ONLY host
-            # write into device-resident state; retirement is detected
-            # inside the scan, so it needs no re-upload
+                victim = max(
+                    (r for r in self.scheduler.running.values()
+                     if r is not req),
+                    key=lambda r: (-r.priority, r.request_id),
+                    default=None)
+                if victim is None:
+                    raise RuntimeError(
+                        f"KV pool exhausted: chunked prefill for "
+                        f"request {req.request_id} needs blocks and "
+                        "there is nothing left to reclaim or preempt "
+                        "(raise kv_pool_blocks)")
+                self.preempt(victim)
+                if self.scheduler.running.get(slot) is not req:
+                    preempted_self = True
+                    break
+            if preempted_self:
+                continue
+            entries.append(dict(req=req, slot=slot, lease=prog.lease,
+                                toks=prog.toks, start=prog.covered,
+                                take=take, final=take == remaining,
+                                cow=None, prog=prog))
+        # a later lane's pressure loop may have preempted an earlier
+        # lane in `entries` — its blocks are gone, drop the entry
+        entries = [e for e in entries
+                   if self.scheduler.running.get(e["slot"]) is e["req"]]
+        if not entries:
+            return
+        lanes = self._lane_bucket(len(entries))
+        t0 = time.perf_counter()
+        first_np, dfa = self._dispatch_prefill(entries,
+                                               self._chunk_tokens, lanes)
+        dt = time.perf_counter() - t0
+        name = self._profiler_name
+        self._chunk_dispatches += 1
+        self._chunk_count_total += len(entries)
+        if decode_live:
+            # decode lanes were live: this boundary's horizon was
+            # delayed by exactly this dispatch
+            self._prefill_interference_s += dt
+            _SRV_PREFILL_INTERFERE.inc(dt, engine=name)
+        for i, e in enumerate(entries):
+            req, lease, slot = e["req"], e["lease"], e["slot"]
+            prog = e["prog"]
+            cover = e["start"] + e["take"]
+            row = self.cache.tables[slot]
+            self.prefix.adopt(e["toks"][:cover], lease,
+                              block_of=lambda j, row=row: row[j])
+            prog.covered = cover
+            prog.chunks += 1
+            self._context_high_water = max(self._context_high_water,
+                                           cover)
+            _obs_events.instant("serving.prefill_chunk", cat="serving",
+                                slot=slot, request=req.request_id,
+                                chunk=prog.chunks, covered=cover,
+                                total=len(prog.toks))
+            if e["final"]:
+                del self._chunking[req.request_id]
+                _SRV_PREFILL_CHUNKS.observe(prog.chunks, engine=name)
+                if req.trace is not None:
+                    req.trace.add("prefill_chunked",
+                                  chunks=prog.chunks,
+                                  prefill_tokens=len(prog.toks))
+                self._finish_prefill_lane(req, slot, e["toks"],
+                                          int(first_np[i]), int(dfa[i]))
+            else:
+                self._pos[slot] = cover
+                self._state_dirty = True
 
     def _retire(self, req):
         # release every table entry: private blocks return to the pool
@@ -1839,6 +2078,11 @@ class Engine:
             raise ValueError(
                 f"cannot preempt request {req.request_id}: {req.status}")
         slot = req.slot
+        # mid-chunked-prefill: drop the continuation ledger — the chunks
+        # already adopted into the radix store survive (refcounted), so
+        # re-admission resumes from the last chunk boundary as an
+        # ordinary prefix hit
+        self._chunking.pop(req.request_id, None)
         self.cache.release_slot_blocks(slot)
         lease = self._leases.pop(req.request_id, None)
         if lease is not None:
@@ -1889,6 +2133,7 @@ class Engine:
         else:
             assert req.status == RUNNING
             slot = req.slot
+            self._chunking.pop(req.request_id, None)
             if self._structured:
                 self._release_grammar(req)
             self.cache.release_slot_blocks(slot)
@@ -1942,6 +2187,10 @@ class Engine:
         for slot, req in sorted(self.scheduler.running.items()):
             if self.scheduler.running.get(slot) is not req:
                 continue                 # preempted earlier in this loop
+            if not self._active[slot]:
+                continue                 # mid-chunked-prefill lane: its
+                                         # table grows chunk-wise in
+                                         # _advance_chunks, not by decode
             need = min(int(self._pos[slot]) + h * w,
                        self.config.max_seq_len)
             while not self.cache.ensure_blocks(slot, need):
@@ -2059,14 +2308,19 @@ class Engine:
         finished = []
         self._update_degradation()
         self.admit()
-        if self.scheduler.running:
+        # mid-chunked-prefill lanes are RUNNING but decode-inactive —
+        # they hold a slot and blocks but emit nothing until their final
+        # chunk arms them, so the decode snapshot excludes them (their
+        # masked -1 rows must never reach the harvest walk)
+        if any(self._active[s] for s in self.scheduler.running):
             h = self._resolve_horizon(horizon)
             k = self._resolve_spec_k()
             # block coverage (and any pressure preemption) BEFORE the
             # harvest snapshot: a lane preempted here simply isn't in
             # `active`, so its -1 harvest rows are never misread
             self._ensure_blocks(h, k + 1)
-        active = dict(self.scheduler.running)
+        active = {s: r for s, r in self.scheduler.running.items()
+                  if self._active[s]}
         if active:
             self._horizon_buckets.add(h)
             with _obs_span("serving.decode_step", cat="serving",
@@ -2438,6 +2692,8 @@ class Engine:
             "wasted_lane_tokens": self._wasted_lane_tokens,
             "prefill_calls": self._prefill_calls,
             "prefill_requests": self._prefill_requests,
+            "prefill_chunk_dispatches": self._chunk_dispatches,
+            "prefill_chunked_requests": self._chunked_requests,
             "prefix_hit_tokens": self._prefix_hit_tokens,
             "prompt_tokens": self._prompt_tokens,
             "prefix_hit_ratio": (
@@ -2494,6 +2750,19 @@ class Engine:
         s["horizon_buckets"] = sorted(self._horizon_buckets)
         s["decode_buckets"] = sorted(self._decode_buckets)
         s["next_horizon_growth"] = self._grow
+        s["prefill"] = {
+            "chunk_tokens": self._chunk_tokens,
+            "chunks_in_flight": len(self._chunking),
+            "chunk_dispatches": self._chunk_dispatches,
+            "chunked_requests": self._chunked_requests,
+            "chunk_count_total": self._chunk_count_total,
+            "interference_seconds": self._prefill_interference_s,
+            "context_high_water": self._context_high_water,
+            # every (lanes, bucket) prefill program this engine ran —
+            # with chunking on, no bucket exceeds chunk_tokens, which is
+            # what bounds a long prompt's hold on the engine
+            "buckets": sorted(self._prefill_buckets),
+        }
         s["prefix"] = self.prefix.stats()
         # gateway-era admission fields: per-tenant accounting (tenant
         # None bills to "") and the deadline-abort tally; priorities

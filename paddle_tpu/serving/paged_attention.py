@@ -10,9 +10,15 @@ proportional to the live sequence length.
 
 Two implementations behind one entry point:
 
-* :func:`paged_attention` — the router.  A Pallas TPU kernel serves the
-  single-token decode hot path on TPU; everything else (CPU tier-1,
-  multi-token prefill) runs the XLA fallback.  Override with
+* :func:`paged_attention` — the router.  A Pallas TPU kernel serves
+  every TPU query window — single-token decode, speculative K+1 verify
+  windows, and chunked-prefill windows all hit the kernel; CPU tier-1
+  runs the XLA fallback (the parity reference).  The kernel executes
+  the fallback's exact per-block recurrence; since the two compile as
+  separate programs, raw outputs agree to reassociation-level ulps
+  (exact at most shapes), and the serving gate is BITWISE stream
+  equality of whole-engine runs under kernel routing, which CPU tests
+  assert in interpret mode.  Override with
   ``PADDLE_TPU_PAGED_ATTN=xla|pallas``.
 * **XLA fallback** — a blockwise online-softmax ``lax.scan`` over the
   table entries (flash-attention recurrence: running max ``m``, running
@@ -30,8 +36,12 @@ Two implementations behind one entry point:
   k/v BlockSpec index maps, so each grid cell DMAs exactly one pool
   block); ``pl.when`` skips cells whose block starts past the lane's
   visible window, so a short sequence's tail blocks cost neither
-  bandwidth nor compute.  f32 accumulation in VMEM scratch, finalized
-  on the last block column.
+  bandwidth nor compute.  The query window is a static dimension s >= 1:
+  each grid cell scores all s query rows against its block under an
+  in-kernel causal mask (``key_idx <= pos[b] + row``), so spec verify
+  windows and chunked-prefill chunks run the same kernel as s == 1
+  decode.  f32 accumulation in VMEM scratch, finalized on the last
+  block column.
 
 Layout contract (matches ``kv_cache.PagedKV``): q ``[B, s, QH, D]``,
 pools ``[NB, bs, KH, D]`` with GQA group size ``G = QH // KH`` (query
@@ -55,16 +65,21 @@ try:  # pallas import is TPU-oriented; CPU-only builds may lack it
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
     _HAVE_PALLAS = True
+    # jax renamed TPUCompilerParams -> CompilerParams across releases;
+    # accept either so interpret-mode CPU tests run on both
+    _COMPILER_PARAMS = getattr(pltpu, "CompilerParams", None) or \
+        getattr(pltpu, "TPUCompilerParams", None)
 except Exception:  # pragma: no cover - exercised only without pallas
     pl = pltpu = None
     _HAVE_PALLAS = False
+    _COMPILER_PARAMS = None
 
 
 def paged_attention(q, k_pool, v_pool, tables, pos,
                     k_scale=None, v_scale=None):
-    """Route to the Pallas decode kernel (TPU, s == 1) or the XLA
-    online-softmax fallback (everything else — including all of CPU
-    tier-1, which is also the bitwise parity reference).
+    """Route to the Pallas ragged kernel (TPU, any window s >= 1) or
+    the XLA online-softmax fallback (CPU tier-1, which is also the
+    parity reference for every s).
 
     ``k_scale``/``v_scale`` ([NB, bs] f32, or None) mark a quantized
     pool: both implementations dequantize each gathered block token-wise
@@ -73,11 +88,15 @@ def paged_attention(q, k_pool, v_pool, tables, pos,
     nb-invariance — just over dequantized values."""
     impl = os.environ.get("PADDLE_TPU_PAGED_ATTN", "auto")
     use_pallas = impl == "pallas" or (
-        impl == "auto" and q.shape[1] == 1
-        and jax.default_backend() == "tpu")
+        impl == "auto" and jax.default_backend() == "tpu")
     if use_pallas:
-        return _pallas_paged_decode(q, k_pool, v_pool, tables, pos,
-                                    k_scale, v_scale)
+        # forcing `pallas` off-TPU runs the kernel in interpret mode —
+        # how CPU tests drive the kernel through whole-engine (and
+        # shard_map per-shard) paths and assert bitwise parity with the
+        # fallback
+        return _pallas_paged_attention(
+            q, k_pool, v_pool, tables, pos, k_scale, v_scale,
+            interpret=jax.default_backend() != "tpu")
     return _xla_paged_attention(q, k_pool, v_pool, tables, pos,
                                 k_scale, v_scale)
 
@@ -138,15 +157,21 @@ def _xla_paged_attention(q, k_pool, v_pool, tables, pos,
 
 # --------------------------------------------------------------- Pallas
 
-def _paged_decode_kernel(tables, pos, q_ref, k_ref, v_ref, *refs,
-                         block_size, groups, nb, scale, quantized):
+def _paged_attn_kernel(tables, pos, q_ref, k_ref, v_ref, *refs,
+                       block_size, groups, nb, q_len, scale, quantized):
     """One grid cell = (lane b, table column i): accumulate pool block
-    ``tables[b, i]`` into lane b's online-softmax state.  The k/v
-    BlockSpec index maps already selected the pool block from the
-    scalar-prefetched table, so refs hold exactly one block.  On a
-    quantized pool two extra [1, bs] scale refs ride between the pool
-    refs and the output: the block is dequantized token-wise right
-    after its DMA, before any softmax math."""
+    ``tables[b, i]`` into lane b's online-softmax state for all q_len
+    query rows at once.  The k/v BlockSpec index maps already selected
+    the pool block from the scalar-prefetched table, so refs hold
+    exactly one block.  Query row r (a static offset into the window)
+    sits at absolute position ``pos[b] + r``, and the causal mask
+    ``key_idx <= pos[b] + r`` is evaluated in-kernel per row — the same
+    visibility rule, masking (exact-zero probabilities), and update
+    order the XLA fallback applies, so the recurrences are term-for-
+    term identical.  On a quantized pool two extra [1, bs]
+    scale refs ride between the pool refs and the output: the block is
+    dequantized token-wise right after its DMA, before any softmax
+    math."""
     if quantized:
         ksc_ref, vsc_ref, o_ref, m_ref, l_ref, acc_ref = refs
     else:
@@ -162,25 +187,27 @@ def _paged_decode_kernel(tables, pos, q_ref, k_ref, v_ref, *refs,
 
     p_b = pos[b]
 
-    # skip blocks that start past the lane's visible window [0, pos]:
-    # a retired/short lane's tail blocks are never read at all
-    @pl.when(i * block_size <= p_b)
+    # skip blocks that start past the window's deepest visible key
+    # (row q_len-1 sees up to pos + q_len - 1): a retired/short lane's
+    # tail blocks are never read at all
+    @pl.when(i * block_size <= p_b + (q_len - 1))
     def _accumulate():
         kh = k_ref.shape[2]
         d = k_ref.shape[3]
-        q = q_ref[0].astype(jnp.float32) * scale          # [QH, D]
-        q = q.reshape(kh, groups, d)
+        q = q_ref[0].astype(jnp.float32) * scale          # [s, QH, D]
+        q = q.reshape(q_len, kh, groups, d)
         k = k_ref[0].astype(jnp.float32)                  # [bs, KH, D]
         v = v_ref[0].astype(jnp.float32)
         if quantized:
             k = k * ksc_ref[0][:, None, None]
             v = v * vsc_ref[0][:, None, None]
         sc = jax.lax.dot_general(
-            q, k, (((2,), (2,)), ((0,), (1,))),
-            preferred_element_type=jnp.float32)           # [KH, G, bs]
+            q, k, (((3,), (2,)), ((1,), (1,))),
+            preferred_element_type=jnp.float32)           # [KH, s, G, bs]
+        row = jax.lax.broadcasted_iota(jnp.int32, sc.shape, 1)
         key_idx = i * block_size + jax.lax.broadcasted_iota(
-            jnp.int32, sc.shape, 2)
-        vis = key_idx <= p_b
+            jnp.int32, sc.shape, 3)
+        vis = key_idx <= p_b + row
         sc = jnp.where(vis, sc, NEG_INF)
         m_prev = m_ref[...]
         m_new = jnp.maximum(m_prev, jnp.max(sc, axis=-1))
@@ -188,46 +215,52 @@ def _paged_decode_kernel(tables, pos, q_ref, k_ref, v_ref, *refs,
         alpha = jnp.exp(m_prev - m_new)
         l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=-1)
         pv = jax.lax.dot_general(
-            p, v, (((2,), (0,)), ((0,), (1,))),
-            preferred_element_type=jnp.float32)           # [KH, G, D]
+            p, v, (((3,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)           # [KH, s, G, D]
         acc_ref[...] = acc_ref[...] * alpha[..., None] + pv
         m_ref[...] = m_new
 
     @pl.when(i == nb - 1)
     def _finalize():
-        out = acc_ref[...] / l_ref[...][..., None]        # [KH, G, D]
+        out = acc_ref[...] / l_ref[...][..., None]        # [KH, s, G, D]
+        out = out.transpose(1, 0, 2, 3)                   # [s, KH, G, D]
         o_ref[0] = out.reshape(o_ref.shape[1:]).astype(o_ref.dtype)
 
 
-def _pallas_paged_decode(q, k_pool, v_pool, tables, pos,
-                         k_scale=None, v_scale=None):
-    """Decode-path (s == 1) ragged kernel: grid (B, nb), block table +
-    lane lengths scalar-prefetched so the k/v index maps gather pool
-    blocks directly and ``pl.when`` culls dead columns.  Quantized
-    pools add two [1, bs] scale inputs gathered through the same table
-    index map as their blocks."""
+def _pallas_paged_attention(q, k_pool, v_pool, tables, pos,
+                            k_scale=None, v_scale=None, *,
+                            interpret=False):
+    """Ragged kernel for any static query window s >= 1: grid (B, nb),
+    block table + lane lengths scalar-prefetched so the k/v index maps
+    gather pool blocks directly and ``pl.when`` culls dead columns.
+    The accumulator carries all s rows ([KH, s, G] / [KH, s, G, D]
+    VMEM scratch), so one pool-block DMA serves the whole window —
+    decode (s=1), spec verify (s=K+1), and chunked-prefill windows
+    share the program structure.  Quantized pools add two [1, bs]
+    scale inputs gathered through the same table index map as their
+    blocks.  ``interpret=True`` runs the kernel in Pallas interpret
+    mode (the CPU test path)."""
     if not _HAVE_PALLAS:  # pragma: no cover
         return _xla_paged_attention(q, k_pool, v_pool, tables, pos,
                                     k_scale, v_scale)
     b, s, qh, d = q.shape
-    assert s == 1, "the Pallas kernel serves single-token decode"
     bs, kh = k_pool.shape[1], k_pool.shape[2]
     g = qh // kh
     nb = tables.shape[1]
-    q2 = q.reshape(b, qh, d)
     quantized = k_scale is not None
 
     kernel = functools.partial(
-        _paged_decode_kernel, block_size=bs, groups=g, nb=nb,
+        _paged_attn_kernel, block_size=bs, groups=g, nb=nb, q_len=s,
         scale=1.0 / math.sqrt(d), quantized=quantized)
     in_specs = [
-        pl.BlockSpec((1, qh, d), lambda bb, i, tables, pos: (bb, 0, 0)),
+        pl.BlockSpec((1, s, qh, d),
+                     lambda bb, i, tables, pos: (bb, 0, 0, 0)),
         pl.BlockSpec((1, bs, kh, d),
                      lambda bb, i, tables, pos: (tables[bb, i], 0, 0, 0)),
         pl.BlockSpec((1, bs, kh, d),
                      lambda bb, i, tables, pos: (tables[bb, i], 0, 0, 0)),
     ]
-    operands = [tables, pos, q2, k_pool, v_pool]
+    operands = [tables, pos, q, k_pool, v_pool]
     if quantized:
         in_specs += [
             pl.BlockSpec((1, bs),
@@ -240,19 +273,23 @@ def _pallas_paged_decode(q, k_pool, v_pool, tables, pos,
         num_scalar_prefetch=2,                 # tables, pos
         grid=(b, nb),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, qh, d),
-                               lambda bb, i, tables, pos: (bb, 0, 0)),
+        out_specs=pl.BlockSpec((1, s, qh, d),
+                               lambda bb, i, tables, pos: (bb, 0, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((kh, g), jnp.float32),       # running max m
-            pltpu.VMEM((kh, g), jnp.float32),       # running sum l
-            pltpu.VMEM((kh, g, d), jnp.float32),    # accumulator
+            pltpu.VMEM((kh, s, g), jnp.float32),       # running max m
+            pltpu.VMEM((kh, s, g), jnp.float32),       # running sum l
+            pltpu.VMEM((kh, s, g, d), jnp.float32),    # accumulator
         ],
     )
-    out = pl.pallas_call(
+    return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, qh, d), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        out_shape=jax.ShapeDtypeStruct((b, s, qh, d), q.dtype),
+        compiler_params=_COMPILER_PARAMS(
             dimension_semantics=("arbitrary", "arbitrary")),
+        interpret=interpret,
     )(*operands)
-    return out.reshape(b, s, qh, d)
+
+
+# backwards-compat alias (pre-s>1 name)
+_pallas_paged_decode = _pallas_paged_attention

@@ -22,7 +22,9 @@ from paddle_tpu.quantization import (
 from paddle_tpu.serving.kv_cache import (
     paged_write, paged_write_quant, visible_mask, write_slots,
 )
-from paddle_tpu.serving.paged_attention import _xla_paged_attention
+from paddle_tpu.serving.paged_attention import (
+    _pallas_paged_attention, _xla_paged_attention,
+)
 
 TINY = GPTConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
                  num_hidden_layers=2, num_attention_heads=4,
@@ -1428,6 +1430,92 @@ class TestPagedAttentionVerify:
         np.testing.assert_array_equal(out_shared, out_private)
 
 
+class TestPallasMultiToken:
+    """The generalized Pallas ragged kernel (interpret mode on CPU) vs
+    the XLA fallback for every query window size s >= 1, on fp32 and
+    int8-quantized pools, including COW-aliased tables.  The kernel
+    runs the fallback's exact per-block recurrence, but the interpret
+    grid loop and the fallback's scan compile separately, so XLA:CPU
+    may reassociate the tiny per-block reductions — raw outputs match
+    to ~1 ulp (exact at most shapes), asserted here with a tight
+    tolerance; the BITWISE gate is stream equality of whole-engine runs
+    under ``PADDLE_TPU_PAGED_ATTN=pallas`` (see
+    ``test_tp2_chunked_prefill_pallas_kernel_parity``).  Kernel-vs-
+    kernel comparisons (same program, different tables) stay exact."""
+
+    ATOL = 1e-5
+
+    @staticmethod
+    def _case(b=2, s=1, qh=4, kh=2, d=8, bs=4, nb=4, seed=0,
+              pos_vals=(9, 13)):
+        return TestPagedAttentionVerify._case(b, s, qh, kh, d, bs, nb,
+                                              seed, pos_vals)
+
+    @pytest.mark.parametrize("w", [1, 2, 4, 8])
+    def test_kernel_matches_fallback(self, w):
+        q, k, v, tables, pos = self._case(s=w)
+        base = pos - (w - 1)
+        ref = np.asarray(_xla_paged_attention(q, k, v, tables, base))
+        out = np.asarray(_pallas_paged_attention(q, k, v, tables, base,
+                                                 interpret=True))
+        np.testing.assert_allclose(out, ref, rtol=0, atol=self.ATOL)
+
+    @pytest.mark.parametrize("w", [1, 4])
+    def test_kernel_matches_fallback_quantized(self, w):
+        q, kf, vf, tables, pos = self._case(s=w)
+        r = np.random.RandomState(3)
+        k = jnp.asarray(r.randint(-127, 128, kf.shape).astype(np.int8))
+        v = jnp.asarray(r.randint(-127, 128, vf.shape).astype(np.int8))
+        ks = jnp.asarray(
+            r.uniform(0.01, 0.1, kf.shape[:2]).astype(np.float32))
+        vs = jnp.asarray(
+            r.uniform(0.01, 0.1, vf.shape[:2]).astype(np.float32))
+        base = pos - (w - 1)
+        ref = np.asarray(
+            _xla_paged_attention(q, k, v, tables, base, ks, vs))
+        out = np.asarray(_pallas_paged_attention(q, k, v, tables, base,
+                                                 ks, vs, interpret=True))
+        np.testing.assert_allclose(out, ref, rtol=0, atol=self.ATOL)
+
+    def test_kernel_cow_aliased_tail_blocks(self):
+        """Two lanes alias a shared prefix block through their tables;
+        the kernel must read it once per lane without bleed, matching
+        both the fallback and a private-copy run bitwise."""
+        r = np.random.RandomState(1)
+        bs, kh, d, qh, w = 4, 2, 8, 4, 2
+        k = jnp.asarray(r.randn(6, bs, kh, d).astype(np.float32))
+        v = jnp.asarray(r.randn(6, bs, kh, d).astype(np.float32))
+        q = jnp.asarray(r.randn(2, w, qh, d).astype(np.float32))
+        shared = jnp.asarray([[1, 2], [1, 3]], jnp.int32)
+        base = jnp.asarray([4, 4], jnp.int32)
+        out = np.asarray(_pallas_paged_attention(q, k, v, shared, base,
+                                                 interpret=True))
+        ref = np.asarray(_xla_paged_attention(q, k, v, shared, base))
+        np.testing.assert_allclose(out, ref, rtol=0, atol=self.ATOL)
+        k2 = k.at[4].set(k[1]).at[5].set(k[1])
+        v2 = v.at[4].set(v[1]).at[5].set(v[1])
+        private = jnp.asarray([[4, 2], [5, 3]], jnp.int32)
+        out_p = np.asarray(_pallas_paged_attention(q, k2, v2, private,
+                                                   base, interpret=True))
+        # same compiled kernel, different tables: aliasing itself is
+        # BITWISE-neutral
+        np.testing.assert_array_equal(out, out_p)
+
+    def test_router_env_override_runs_kernel_on_cpu(self, monkeypatch):
+        """``PADDLE_TPU_PAGED_ATTN=pallas`` off-TPU routes to the kernel
+        in interpret mode — the switch the whole-engine and shard_map
+        kernel tests ride — and stays bitwise with the fallback."""
+        from paddle_tpu.serving.paged_attention import paged_attention
+
+        q, k, v, tables, pos = self._case(s=2)
+        base = pos - 1
+        monkeypatch.setenv("PADDLE_TPU_PAGED_ATTN", "pallas")
+        out = np.asarray(paged_attention(q, k, v, tables, base))
+        monkeypatch.setenv("PADDLE_TPU_PAGED_ATTN", "xla")
+        ref = np.asarray(paged_attention(q, k, v, tables, base))
+        np.testing.assert_allclose(out, ref, rtol=0, atol=self.ATOL)
+
+
 class TestQuantServing:
     """``EngineConfig(weight_dtype="int8", kv_cache_dtype="int8")``:
     int8 weight-only decode + int8 paged KV.
@@ -2097,6 +2185,188 @@ class TestServingSpecLayout:
         assert MeshEngine._norm_mesh_knob((1, 4), None) == (1, 4)
 
 
+class TestChunkedPrefill:
+    """Chunked prefill (``prefill_chunk_tokens``) vs whole-prompt
+    prefill: the token streams must be BITWISE equal — greedy and
+    seeded — under continuous batching, prefix hits at and across chunk
+    boundaries, preemption (mid-prefill and mid-decode), speculative
+    decoding, and int8 KV.  Chunking is pure scheduling: each chunk is
+    an iterated prefix-extension of the same lane, so the streams can
+    only diverge if the interleave machinery breaks."""
+
+    _rng = np.random.default_rng(11)
+    BASE = list(map(int, _rng.integers(1, 127, 26)))
+    # phase-2 prompts: shared prefix ending exactly AT a chunk boundary
+    # (16 = 2 chunks of 8) and ACROSS one (20 straddles chunk 3)
+    PROMPTS1 = [BASE,
+                list(map(int, _rng.integers(1, 127, 9))),
+                list(map(int, _rng.integers(1, 127, 23)))]
+    PROMPTS2 = [BASE[:16] + list(map(int, _rng.integers(1, 127, 7))),
+                BASE[:20] + list(map(int, _rng.integers(1, 127, 5)))]
+    SAMP1 = [SamplingParams(max_new_tokens=8),
+             SamplingParams(max_new_tokens=7, temperature=0.9, seed=5),
+             SamplingParams(max_new_tokens=8, temperature=1.2, top_k=13,
+                            seed=2)]
+    SAMP2 = [SamplingParams(max_new_tokens=6),
+             SamplingParams(max_new_tokens=6, temperature=0.8, seed=9)]
+
+    @staticmethod
+    def _engine(m, chunk, **kw):
+        kw.setdefault("num_slots", 4)
+        kw.setdefault("max_seq_len", 64)
+        kw.setdefault("kv_pool_blocks", 96)
+        return Engine(m, EngineConfig(prefill_chunk_tokens=chunk, **kw),
+                      register_profiler=False)
+
+    @classmethod
+    def _run(cls, chunk, **kw):
+        eng = cls._engine(_model(), chunk, **kw)
+        out = [eng.generate(cls.PROMPTS1, cls.SAMP1),
+               eng.generate(cls.PROMPTS2, cls.SAMP2)]
+        return eng, out
+
+    _whole1 = None
+
+    @classmethod
+    def _whole_phase1(cls):
+        """Phase-1 whole-prompt reference, computed once per session."""
+        if cls._whole1 is None:
+            eng = cls._engine(_model(), 0)
+            cls._whole1 = eng.generate(cls.PROMPTS1, cls.SAMP1)
+            eng.close()
+        return cls._whole1
+
+    def test_parity_greedy_seeded_prefix_hits(self):
+        """The core gate: chunk=8 streams bitwise-equal whole-prompt
+        across two phases, where phase 2's prompts hit the radix cache
+        at and across chunk boundaries; the compiled prefill programs
+        never exceed the chunk bucket, yet a 26-token prompt (> any
+        single 8-wide dispatch) completes — the context cap the chunking
+        lifts."""
+        e0, whole = self._run(0)
+        e1, chunked = self._run(8)
+        assert chunked == whole
+        st = e1.stats()["prefill"]
+        assert st["chunked_requests"] >= 3
+        assert st["chunks_in_flight"] == 0
+        assert st["context_high_water"] == len(self.BASE)
+        assert all(b <= st["chunk_tokens"] for _, b in st["buckets"])
+        # whole-prompt compiled a 32-wide program for the same work
+        assert max(b for _, b in e0.stats()["prefill"]["buckets"]) == 32
+        assert e1.stats()["prefix"]["hit_tokens"] > 0
+        e1.drain()                   # radix store may still hold blocks
+        assert e1.pool.blocks_in_use == 0
+        e0.close()
+        e1.close()
+
+    @pytest.mark.slow
+    def test_interleave_schedule_is_deterministic(self):
+        """Identical workload -> identical chunk/dispatch counters; the
+        same fields DECODE_BENCH.json gates exact so the interleave
+        schedule can't silently drift."""
+        e1, out1 = self._run(8)
+        e2, out2 = self._run(8)
+        keys = ("prefill_calls", "prefill_chunk_dispatches",
+                "prefill_chunked_requests")
+        c1, c2 = e1.counters(), e2.counters()
+        assert out1 == out2
+        assert {k: c1[k] for k in keys} == {k: c2[k] for k in keys}
+        s1, s2 = e1.stats()["prefill"], e2.stats()["prefill"]
+        assert s1["chunk_count_total"] == s2["chunk_count_total"]
+        assert s1["buckets"] == s2["buckets"]
+        e1.close()
+        e2.close()
+
+    def test_mid_prefill_preempt_resumes_at_chunk_boundary(self):
+        """Preempting a lane mid-chunked-prefill drops its ledger; the
+        blocks its finished chunks adopted survive in the radix store,
+        so re-admission resumes from the chunk boundary as an ordinary
+        prefix hit — and the stream stays bitwise."""
+        whole = self._whole_phase1()
+        eng = self._engine(_model(), 8)
+        reqs = [eng.submit(p, s)
+                for p, s in zip(self.PROMPTS1, self.SAMP1)]
+        eng.admit()                  # first chunks dispatched
+        eng.step()                   # chunk 2: 16 tokens = 1 full block
+        victim = reqs[0]             # 26-token prompt, mid-prefill
+        assert victim.request_id in eng._chunking
+        eng.preempt(victim)
+        assert victim.request_id not in eng._chunking
+        eng.run()
+        assert [r.output_ids for r in reqs] == whole
+        assert victim.prefix_hit_tokens >= 16
+        assert eng.counters()["preemptions"] == 1
+        eng.close()
+
+    @pytest.mark.slow
+    def test_decode_preempt_reprefills_through_chunks(self):
+        """A lane preempted mid-DECODE re-prefills prompt + generated
+        tokens through chunked dispatches; the final chunk re-samples
+        the in-flight token and the PR 6 bitwise consistency check runs
+        against it."""
+        whole = self._whole_phase1()
+        eng = self._engine(_model(), 8)
+        reqs = [eng.submit(p, s)
+                for p, s in zip(self.PROMPTS1, self.SAMP1)]
+        while not all(r.output_ids for r in reqs):
+            eng.step()
+        eng.preempt(reqs[0])
+        eng.run()
+        assert [r.output_ids for r in reqs] == whole
+        eng.close()
+
+    @pytest.mark.slow
+    def test_spec_k4_parity(self):
+        m = _model()
+        e0 = self._engine(m, 0, spec_k=4)
+        whole = e0.generate(self.PROMPTS1, self.SAMP1)
+        e0.close()
+        e1 = self._engine(m, 8, spec_k=4)
+        assert e1.generate(self.PROMPTS1, self.SAMP1) == whole
+        assert e1.stats()["prefill"]["chunked_requests"] >= 1
+        e1.close()
+
+    @pytest.mark.slow
+    def test_int8_kv_parity(self):
+        m = _model()
+        e0 = self._engine(m, 0, kv_cache_dtype="int8")
+        whole = e0.generate(self.PROMPTS1, self.SAMP1)
+        e0.close()
+        e1 = self._engine(m, 8, kv_cache_dtype="int8")
+        assert e1.generate(self.PROMPTS1, self.SAMP1) == whole
+        e1.close()
+
+    def test_chunk_size_normalization(self):
+        """The knob normalizes to a power of two in
+        [min_prefill_bucket, max_seq_len] (compile-cache discipline);
+        negative rejects."""
+        m = _model()
+        eng = self._engine(m, 10)
+        assert eng._chunk_tokens == 16
+        eng.close()
+        eng = self._engine(m, 2)     # below min_prefill_bucket (8)
+        assert eng._chunk_tokens == 8
+        eng.close()
+        with pytest.raises(ValueError):
+            self._engine(m, -4)
+
+    def test_abort_mid_chunked_prefill_releases_blocks(self):
+        eng = self._engine(_model(), 8)
+        reqs = [eng.submit(p, s)
+                for p, s in zip(self.PROMPTS1, self.SAMP1)]
+        eng.admit()
+        victim = reqs[0]
+        assert victim.request_id in eng._chunking
+        eng.abort(victim)
+        assert victim.request_id not in eng._chunking
+        assert victim.finish_reason == "abort"
+        eng.run()
+        assert all(r.output_ids for r in reqs[1:])
+        eng.drain()
+        assert eng.pool.blocks_in_use == 0
+        eng.close()
+
+
 class TestShardedServing:
     """MeshEngine vs single-chip Engine: greedy AND seeded streams must
     be bitwise-equal under continuous batching, prefix hits, preemption
@@ -2160,6 +2430,33 @@ class TestShardedServing:
         L, h = 2, 4
         assert rep.counts() == {("psum", "tp"): L * h,
                                 ("all_gather", "tp"): (3 * L + 1) * h}
+        eng.close()
+
+    @pytest.mark.slow
+    def test_tp2_chunked_prefill_pallas_kernel_parity(self, monkeypatch):
+        """Chunked prefill over the mesh WITH the Pallas ragged kernel
+        running inside shard_map on each shard's head slice (interpret
+        mode on CPU): streams bitwise vs the single-chip whole-prompt
+        engine, and the decode collective census stays EXACT — the
+        kernel adds no collectives."""
+        m = _model()
+        prompts = [[3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8, 9, 7, 9, 3, 2,
+                    3, 8, 4, 6, 2], [9, 2, 6]]
+        samp = [SamplingParams(max_new_tokens=8),
+                SamplingParams(temperature=0.8, top_k=20, seed=11,
+                               max_new_tokens=8)]
+        ref = self._ref(m, prompts, samp)
+        monkeypatch.setenv("PADDLE_TPU_PAGED_ATTN", "pallas")
+        eng = self._mesh(m, prefill_chunk_tokens=8)
+        assert eng.generate(prompts, samp) == ref
+        st = eng.stats()["prefill"]
+        assert st["chunked_requests"] >= 1
+        assert all(b <= st["chunk_tokens"] for _, b in st["buckets"])
+        rep = eng.decode_comms_report(horizon=4)   # asserts internally
+        L, h = 2, 4
+        assert rep.counts() == {("psum", "tp"): L * h,
+                                ("all_gather", "tp"): (3 * L + 1) * h}
+        assert eng.pool.blocks_in_use == 0
         eng.close()
 
     @pytest.mark.slow
