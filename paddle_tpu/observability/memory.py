@@ -57,6 +57,13 @@ _UNACCT = _metrics.gauge(
 _LEAK = _metrics.gauge(
     "memory.leak_delta_bytes",
     "growth of the unaccounted residue since the baseline mark")
+_HOST_ACCT = _metrics.gauge(
+    "memory.host_arena_bytes",
+    "pinned host-RAM bytes each registered host component holds (the "
+    "tiered-KV spill arena); deliberately OUTSIDE the device "
+    "reconciliation — host numpy buffers never appear in "
+    "jax.live_arrays(), so folding them into accounted_total_bytes "
+    "would poison unaccounted/leak_delta")
 _ROOFLINE = _metrics.gauge(
     "memory.roofline_utilization",
     "achieved bytes/s of the last decode dispatch / backend bandwidth")
@@ -69,6 +76,13 @@ _ACHIEVED = _metrics.gauge(
 #: tunneled plugin.  Unlisted backends (cpu in CI) are measured once
 #: per process by a memcpy probe instead of being skipped.
 _HBM_BW_TABLE = {"tpu": 819.0, "axon": 819.0}
+#: Host<->device transfer bandwidth per backend (GB/s) — the tiered-KV
+#: swap path's roofline, NOT the HBM number above.  v5e attaches over
+#: PCIe gen3 x16 (~16 GB/s per direction in practice); unlisted
+#: backends (cpu in CI, where "upload" is a memcpy) fall through to
+#: the same memcpy probe as the HBM path, keyed separately so the two
+#: memoized figures never alias.
+_HOST_BW_TABLE = {"tpu": 16.0, "axon": 16.0}
 _BW_PROBED = {}
 _BW_LOCK = threading.Lock()
 
@@ -82,8 +96,24 @@ def backend_bandwidth_gbs(backend):
     number."""
     if backend in _HBM_BW_TABLE:
         return _HBM_BW_TABLE[backend]
+    return _memcpy_probe_gbs(backend)
+
+
+def host_device_bandwidth_gbs(backend):
+    """Host<->device transfer bandwidth for ``backend`` in GB/s — what
+    a tiered-KV swap's upload seconds divide by (the swap-vs-recompute
+    policy and bench crossover both normalize with this one number).
+    Datasheet PCIe figure for known accelerators; on cpu backends a
+    host->device "transfer" is a memcpy, so the memcpy probe IS the
+    honest figure."""
+    if backend in _HOST_BW_TABLE:
+        return _HOST_BW_TABLE[backend]
+    return _memcpy_probe_gbs(("host", backend))
+
+
+def _memcpy_probe_gbs(key):
     with _BW_LOCK:
-        if backend not in _BW_PROBED:
+        if key not in _BW_PROBED:
             src = np.ones(1 << 26, np.uint8)          # 64 MiB
             dst = np.empty_like(src)
             np.copyto(dst, src)                       # fault pages in
@@ -93,8 +123,8 @@ def backend_bandwidth_gbs(backend):
                 np.copyto(dst, src)
                 dt = time.perf_counter() - t0
                 best = dt if best is None else min(best, dt)
-            _BW_PROBED[backend] = round(2.0 * src.nbytes / best / 1e9, 1)
-        return _BW_PROBED[backend]
+            _BW_PROBED[key] = round(2.0 * src.nbytes / best / 1e9, 1)
+        return _BW_PROBED[key]
 
 
 def live_device_bytes():
@@ -142,6 +172,7 @@ class MemoryLedger:
         self.name = name
         self._lock = threading.Lock()
         self._components = {}
+        self._host_components = {}
         self._baseline_unaccounted = None
 
     def register(self, component, fn):
@@ -149,6 +180,20 @@ class MemoryLedger:
             raise TypeError("component accounting fn must be callable")
         with self._lock:
             self._components[component] = fn
+        return self
+
+    def register_host(self, component, fn):
+        """Register a HOST-memory component (pinned numpy arenas — the
+        tiered-KV spill tier).  Host bytes are published as
+        ``memory.host_arena_bytes`` and reported in the snapshot, but
+        NEVER summed into the device reconciliation: they are invisible
+        to ``jax.live_arrays()``, so counting them as accounted would
+        drive ``unaccounted_bytes`` negative and break the
+        ``leak_delta_bytes`` exactness the leak detector rests on."""
+        if not callable(fn):
+            raise TypeError("component accounting fn must be callable")
+        with self._lock:
+            self._host_components[component] = fn
         return self
 
     def unregister(self, component):
@@ -164,6 +209,18 @@ class MemoryLedger:
         raises reports 0 rather than poisoning the snapshot)."""
         with self._lock:
             items = list(self._components.items())
+        out = {}
+        for name, fn in items:
+            try:
+                out[name] = int(fn())
+            except Exception:        # pragma: no cover - defensive
+                out[name] = 0
+        return out
+
+    def account_host(self):
+        """Poll every host component: {component: bytes}."""
+        with self._lock:
+            items = list(self._host_components.items())
         out = {}
         for name, fn in items:
             try:
@@ -197,7 +254,10 @@ class MemoryLedger:
         _LIVE.set(live, **labels)
         _UNACCT.set(unaccounted, **labels)
         _LEAK.set(leak, **labels)
-        return {
+        host = self.account_host()
+        for comp, b in host.items():
+            _HOST_ACCT.set(b, component=comp, **labels)
+        out = {
             "ledger": self.name,
             "components": acct,
             "accounted_total_bytes": accounted,
@@ -205,3 +265,10 @@ class MemoryLedger:
             "unaccounted_bytes": unaccounted,
             "leak_delta_bytes": leak,
         }
+        if host:
+            # reported alongside, summed into NOTHING above: see
+            # register_host for why host bytes stay out of the device
+            # reconciliation
+            out["host_components"] = host
+            out["host_total_bytes"] = sum(host.values())
+        return out

@@ -62,6 +62,19 @@ DETERMINISTIC_FIELDS = {
     "chunk_dispatches": False,
     "chunk_tokens": False,
     "max_dispatch_bucket": False,
+    # tiered KV: how many blocks/bytes a swap round-trip moves is a
+    # pure function of (context length, block size, store dtype) — a
+    # change that silently fattens the host<->device payload (or stops
+    # swapping and falls back to recompute) gates exact even when the
+    # crossover timings are noise-bound; averted tokens gate UP (fewer
+    # re-prefilled tokens per swap-in is the whole point)
+    "swap_ins": True,
+    "swap_outs": True,
+    "swap_in_blocks": False,
+    "swap_out_blocks": False,
+    "swap_in_bytes": False,
+    "swap_out_bytes": False,
+    "swap_averted_tokens": True,
 }
 
 

@@ -53,6 +53,14 @@ PREFILL = "prefill"          # first admission: batched fused prefill
 FIRST_TOKEN = "first_token"  # sampled by the prefill dispatch (TTFT)
 DECODE = "decode"            # one fused decode horizon this lane rode
 PREEMPT = "preempt"          # swapped out under KV block pressure
+SWAP_OUT = "swap_out"        # tiered KV: the preempted lane's block
+                             # chain was saved into the host arena
+                             # (records blocks + bytes moved) — always
+                             # paired with the preceding PREEMPT
+SWAP_IN = "swap_in"          # tiered KV: host-arena blocks were
+                             # uploaded and re-bound for this request's
+                             # re-admission instead of re-prefilled
+                             # (records blocks, bytes, averted tokens)
 RESUME = "resume"            # re-admission re-prefill after a preempt
 FAILOVER = "failover"        # adopted from a dead replica: this trace's
                              # request resumes another engine's stream
@@ -117,6 +125,7 @@ class RequestTrace:
         reconstruct the engine's dispatch totals)."""
         tokens = prefix_hit = preempts = horizons = accepted = 0
         aborted = failovers = resumed_tokens = forced = 0
+        swap_ins = swap_outs = swap_in_bytes = swap_out_bytes = 0
         flops = bytes_est = 0.0
         for kind, _, args in self._snapshot():
             if kind == FIRST_TOKEN:
@@ -134,6 +143,12 @@ class RequestTrace:
                 prefix_hit = args.get("prefix_hit_tokens", prefix_hit)
             elif kind == PREEMPT:
                 preempts += 1
+            elif kind == SWAP_OUT:
+                swap_outs += 1
+                swap_out_bytes += args.get("bytes", 0)
+            elif kind == SWAP_IN:
+                swap_ins += 1
+                swap_in_bytes += args.get("bytes", 0)
             elif kind == FAILOVER:
                 # tokens resumed from the dead replica are NOT counted
                 # as emitted by THIS trace's engine — per-engine sums
@@ -149,6 +164,9 @@ class RequestTrace:
                 "spec_accepted_tokens": accepted,
                 "spec_forced_tokens": forced, "aborted": aborted,
                 "failovers": failovers, "resumed_tokens": resumed_tokens,
+                "swap_ins": swap_ins, "swap_outs": swap_outs,
+                "swap_in_bytes": swap_in_bytes,
+                "swap_out_bytes": swap_out_bytes,
                 "flops_est": flops, "bytes_est": bytes_est}
 
     def to_json(self):

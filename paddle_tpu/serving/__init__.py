@@ -122,6 +122,7 @@ from .gateway import (EngineWorker, FleetSupervisor, Gateway,
                       GatewayConfig, PrefixAffinityRouter, TenantQuotas)
 from .kv_cache import (PagedKV, PagedKVCache, PagedKVPool, SlotKV,
                        SlottedKVCache)
+from .kv_host_tier import HostKVTier
 from .paged_attention import paged_attention
 from .prefix_cache import PrefixCache, PrefixLease
 from .sampling import SamplingParams
@@ -134,6 +135,7 @@ from .structured import (GrammarError, GrammarSlab, GrammarSpec,
 __all__ = [
     "Engine", "EngineConfig", "CompiledFn",
     "PagedKV", "PagedKVCache", "PagedKVPool", "paged_attention",
+    "HostKVTier",
     "SlotKV", "SlottedKVCache",
     "PrefixCache", "PrefixLease",
     "SamplingParams", "Request", "Scheduler",

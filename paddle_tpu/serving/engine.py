@@ -115,6 +115,7 @@ from .drafter import draft_tokens, forced_chain
 from .faults import (DEGRADE_LEVELS, FAULT_POOL_EXHAUSTED,
                      SITE_ENGINE_ADMIT, _SRV_DEGRADATION, _SRV_SHED)
 from .kv_cache import PagedKV, PagedKVCache
+from .kv_host_tier import HostKVTier
 from .prefix_cache import PrefixCache
 from .sampling import (MASK_FLOOR, SamplingParams, request_key,
                        sample_token, sample_window)
@@ -172,6 +173,21 @@ _SRV_KV_BYTES = _obs_metrics.counter(
 _SRV_PREEMPTIONS = _obs_metrics.counter(
     "serving.preemptions",
     "running requests swapped out under KV block pressure")
+_SRV_SWAP_OUT_BYTES = _obs_metrics.counter(
+    "serving.kv_swap_out_bytes",
+    "KV bytes moved device->host by the tiered cache (kind=\"lane\" "
+    "preempted-lane chains, kind=\"demote\" evicted prefix blocks)")
+_SRV_SWAP_IN_BYTES = _obs_metrics.counter(
+    "serving.kv_swap_in_bytes",
+    "KV bytes uploaded host->device by tiered-cache swap-ins instead "
+    "of being recomputed")
+_SRV_SWAP_AVERTED = _obs_metrics.counter(
+    "serving.kv_swaps_averted_flops",
+    "estimated prefill FLOPs swap-ins avoided (averted tokens x the "
+    "program-card per-token prefill cost)")
+_SRV_HOST_OCC = _obs_metrics.gauge(
+    "serving.host_arena_occupancy_ratio",
+    "host spill-arena blocks in use / arena capacity")
 _SRV_SPEC_ACCEPT = _obs_metrics.histogram(
     "serving.spec_accept_len",
     "tokens emitted per speculative verify window (accepted prefix + 1)",
@@ -447,6 +463,21 @@ class EngineConfig:
     #: ~2x-ing how many sequences fit a fixed kv_pool_blocks byte
     #: budget.  None keeps the fp pool (cache_dtype).
     kv_cache_dtype: object = None
+    #: tiered KV cache: host-RAM byte budget for the spill arena under
+    #: the device pool (serving/kv_host_tier.py).  LRU-evicted prefix
+    #: blocks demote into it instead of dropping, preempted lanes save
+    #: their whole block chain, and re-admission swaps state back in
+    #: with one batched host->device upload instead of re-prefilling.
+    #: int8 pools spill at their quantized density (~4x more contexts
+    #: per host byte).  0 disables the tier entirely.
+    kv_host_bytes: int = 0
+    #: swap-vs-recompute policy: "auto" swaps when estimated upload
+    #: seconds (bytes / measured host<->device bandwidth) beat the
+    #: estimated re-prefill seconds (measured per-token prefill
+    #: throughput over this engine's own dispatches); "always"/"never"
+    #: pin the decision (the bench's crossover sweep and the parity
+    #: tests use the pinned modes).
+    kv_swap_policy: str = "auto"
     #: request-scoped tracing: attach a RequestTrace flight record to
     #: every request at submit, retained by a bounded FlightRecorder
     #: (all live traces + the last ``flight_recorder_capacity``
@@ -638,6 +669,52 @@ class Engine:
         self._max_blocks = self.cache.max_blocks_per_slot
         self._leases = {}            # request_id -> PrefixLease
 
+        # tiered KV: the host-RAM spill arena under the device pool.
+        # Prefix eviction demotes into it (the spill hook runs while
+        # the victim's pool block is still live), preemption saves lane
+        # images, and admission promotes matching host blocks back into
+        # the radix store via one batched upload (_swap_in) — so the
+        # swap-in path IS the ordinary prefix-hit path and inherits its
+        # bitwise guarantees.
+        policy = str(self.config.kv_swap_policy)
+        if policy not in ("auto", "always", "never"):
+            raise ValueError(
+                f"unsupported kv_swap_policy {policy!r} "
+                "(supported: 'auto', 'always', 'never')")
+        self._swap_policy = policy
+        host_budget = int(self.config.kv_host_bytes or 0)
+        if host_budget < 0:
+            raise ValueError(
+                f"kv_host_bytes must be >= 0, got {host_budget}")
+        self.host_tier = None
+        if host_budget:
+            self.host_tier = HostKVTier(
+                num_layers=len(model.model.layers),
+                block_size=self._block_size,
+                kv_heads=mc.kv_heads, head_dim=mc.head_dim,
+                store_dtype=np.dtype(jnp.dtype(self.pool.store_dtype)),
+                budget_bytes=host_budget,
+                bytes_per_block=self.pool.bytes_per_block,
+                quantized=bool(self._kv_quant))
+            self.prefix.spill = self._demote_block
+            self.prefix.spill_batch = self._demote_blocks
+        self._swap_ins = 0               # lane/prefix swap-in passes
+        self._swap_outs = 0              # lane images saved at preempt
+        self._swap_in_blocks = 0
+        self._swap_out_blocks = 0
+        self._swap_in_bytes = 0
+        self._swap_out_bytes = 0         # lane-save bytes (trace-exact)
+        self._demote_bytes = 0           # prefix-demotion bytes
+        self._swaps_averted_tokens = 0
+        self._swaps_averted_flops = 0.0
+        # measured inputs the "auto" swap policy compares: per-token
+        # prefill seconds over this engine's own non-compiling
+        # dispatches, and per-token prefill FLOPs from program cards
+        self._prefill_dispatch_s = 0.0
+        self._prefill_tokens_dispatched = 0
+        self._prefill_card_flops = 0.0
+        self._prefill_card_tokens = 0
+
         # chunked prefill: normalize the chunk size to a power of two in
         # [min_prefill_bucket, max_seq_len] so every chunk dispatch hits
         # one compiled program per lane bucket (0 = whole-prompt prefill)
@@ -758,6 +835,19 @@ class Engine:
                                    name="serving.prefill",
                                    capture_cards=cards,
                                    meta_fn=_prefill_meta)
+        # tiered-KV swap upload: scatter n host blocks into the pool at
+        # freshly allocated ids — ONE compiled call per swap-in pass,
+        # n padded to a power of two (padding rows target scratch block
+        # 0) so the compile cache stays bounded by log2(max chain)
+        def _upload_meta(args):
+            return {"blocks": int(args[4].shape[0])}
+
+        self._upload = CompiledFn(
+            self._upload_fn,
+            donate_argnums=(((0, 1) + ((2, 3) if self._kv_quant else ()))
+                            if donate else ()),
+            name="serving.swap_upload", capture_cards=cards,
+            meta_fn=_upload_meta)
 
         # observability
         self._decode_steps = 0
@@ -809,6 +899,9 @@ class Engine:
 
         Engine._instances += 1
         self._profiler_name = f"serving.engine{Engine._instances}"
+        # the radix store's eviction-destination counter labels by
+        # engine instance like every other serving.* family
+        self.prefix.metric_label = self._profiler_name
         self._finalizer = None
         if register_profiler:
             from .. import profiler as _profiler
@@ -841,6 +934,15 @@ class Engine:
         self.ledger.register("kv_pool", self._kv_pool_bytes)
         self.ledger.register("weights", self._weight_device_bytes)
         self.ledger.register("engine_state", self._state_device_bytes)
+        if self.host_tier is not None:
+            # host arena: accounted SEPARATELY from the device ledger
+            # (numpy buffers never appear in jax.live_arrays(), so
+            # folding them into the device sum would poison
+            # leak_delta_bytes) — register_host keeps the reconciliation
+            # exact while memory.host_arena_bytes reports the pinned
+            # footprint
+            self.ledger.register_host("kv_host_arena",
+                                      self._host_arena_bytes)
 
         # observability phase 2: per-request flight records, declared
         # SLOs over the retirement stream, and the HTTP telemetry
@@ -1603,6 +1705,22 @@ class Engine:
         # are already partly written — finishing them frees capacity
         # soonest and keeps TTFT ordering honest)
         self._advance_chunks()
+        # tiered KV: promote host-arena state for the requests this
+        # admission pass could plausibly pop (the free slots plus the
+        # reorder window it may look past), so their admission becomes
+        # a prefix hit instead of a re-prefill
+        if self.host_tier is not None and self.scheduler.queue_depth:
+            window = self.cache.free_slots + self.config.reorder_window
+            for req in list(self.scheduler.queue)[:window]:
+                if self._swap_in(req) is None:
+                    # pool dry even after reclaim — a later request's
+                    # swap-in can't fare better, and pressing on would
+                    # only churn (each attempt's reclaim retry eats
+                    # LRU radix blocks, possibly an earlier request's
+                    # freshly grafted chain).  Host state is intact:
+                    # swap-in consumes nothing before its device
+                    # blocks are allocated.
+                    break
         # while draining, the queue can only hold `resumed` requests
         # (submit() refuses and drain() aborted the rest) — re-admitting
         # them is finishing in-flight work, so admission proceeds
@@ -1613,8 +1731,16 @@ class Engine:
                 break
             need = sum(self._blocks_needed(r) for r in batch)
             short = need - self.pool.free_blocks
-            if short > 0:
-                short -= self.prefix.reclaim(short)
+            while short > 0 and self.prefix.reclaim(short):
+                # reclaim may have evicted unpinned blocks this very
+                # batch counted as prefix hits (promoted or cached
+                # chains are fair LRU victims until acquire pins them),
+                # so re-derive the need against the post-reclaim radix
+                # and keep reclaiming until it stabilizes — each pass
+                # either closes the gap or strictly shrinks the set of
+                # unpinned blocks, so this terminates
+                need = sum(self._blocks_needed(r) for r in batch)
+                short = need - self.pool.free_blocks
             if short > 0:
                 self.scheduler.queue.extendleft(reversed(batch))
                 if self.scheduler.running:
@@ -1699,10 +1825,17 @@ class Engine:
                 self.cache.lease_block(slot, j, bid)
             for j in range(full, -(-cover // bs)):
                 if self.cache.alloc_entry(slot, j) is None:
-                    raise RuntimeError(
-                        "KV pool exhausted mid-admission — "
-                        "admit()'s capacity pre-check diverged from "
-                        "the blocks actually allocated")
+                    # the pre-check's reclaim (or a batch-mate's
+                    # acquire) may have evicted unpinned blocks this
+                    # lane's lookup counted as hits — every lease taken
+                    # so far is pinned, so reclaiming here only drops
+                    # blocks nobody in this batch holds yet
+                    if (not self.prefix.reclaim(1)
+                            or self.cache.alloc_entry(slot, j) is None):
+                        raise RuntimeError(
+                            "KV pool exhausted mid-admission — "
+                            "admit()'s capacity pre-check diverged "
+                            "from the blocks actually allocated")
             cow = None
             if lease.tail_tokens:
                 cow = (lease.tail_block,
@@ -1834,6 +1967,8 @@ class Engine:
             top_ks[i] = s.top_k
             top_ps[i] = s.top_p
 
+        miss0 = self._prefill.misses
+        t0 = time.perf_counter()
         with _obs_span("serving.prefill_pass", cat="serving",
                        engine=self._profiler_name,
                        event_args={"batch_size": len(entries),
@@ -1849,6 +1984,14 @@ class Engine:
                 jnp.asarray(top_ks), jnp.asarray(top_ps),
                 *self._grammar_prefill_args(dfa))
         self.pool.rebind(new_k, new_v, new_ks, new_vs)
+        first_np = np.asarray(first)     # the one prefill host sync
+        if self._prefill.misses == miss0:
+            # measured per-token prefill throughput feeding the "auto"
+            # swap-vs-recompute policy (compiling dispatches excluded:
+            # trace+compile seconds are not recompute cost)
+            self._prefill_dispatch_s += time.perf_counter() - t0
+            self._prefill_tokens_dispatched += int(
+                lengths[:len(entries)].sum())
         self._prefill_calls += 1
         self._prefill_buckets.add((lanes, bucket))
         _SRV_PREFILL.inc(engine=self._profiler_name)
@@ -1856,7 +1999,11 @@ class Engine:
         if card is not None:
             self._program_flops += card.flops or 0.0
             self._program_bytes += card.bytes_accessed or 0.0
-        return np.asarray(first), dfa    # the one prefill host sync
+            # per-token prefill FLOPs (over the program's padded token
+            # grid) — the unit kv_swaps_averted_flops bills in
+            self._prefill_card_flops += card.flops or 0.0
+            self._prefill_card_tokens += lanes * bucket
+        return first_np, dfa
 
     def _finish_prefill_lane(self, req, slot, toks, tok, dfa_i):
         """Arm one lane whose prefill just completed — whole-prompt, or
@@ -2021,6 +2168,10 @@ class Engine:
         # writes to scratch
         if self._structured:
             self._release_grammar(req)
+        if self.host_tier is not None:
+            # an unconsumed lane image is dead weight once the request
+            # retires — free its pinned host blocks
+            self.host_tier.drop_lane(req.request_id)
         self.cache.release_slot_blocks(req.slot)
         self.cache.free(req.slot)
         self.scheduler.finish(req)
@@ -2078,6 +2229,10 @@ class Engine:
             raise ValueError(
                 f"cannot preempt request {req.request_id}: {req.status}")
         slot = req.slot
+        # tiered KV: save the lane's block chain into the host arena
+        # BEFORE the pool references drop (the device bytes must still
+        # be live to device_get); re-admission swaps it back in
+        self._swap_out_lane(req, slot)
         # mid-chunked-prefill: drop the continuation ledger — the chunks
         # already adopted into the radix store survive (refcounted), so
         # re-admission resumes from the last chunk boundary as an
@@ -2120,6 +2275,8 @@ class Engine:
             raise ValueError(
                 f"cannot abort request {req.request_id}: already "
                 f"finished ({req.finish_reason})")
+        if self.host_tier is not None:
+            self.host_tier.drop_lane(req.request_id)
         if req.status == WAITING:
             try:
                 self.scheduler.queue.remove(req)
@@ -2208,6 +2365,344 @@ class Engine:
                         "left to reclaim or preempt (raise "
                         "kv_pool_blocks)")
                 self.preempt(victim)
+
+    # ---------------------------------------------------------- tiered KV
+    def _upload_fn(self, pool_k, pool_v, pool_ks, pool_vs, ids,
+                   kd, vd, ksd, vsd):
+        """Swap-in upload program: scatter ``n`` whole host blocks into
+        the pool arrays at freshly allocated ``ids``.  ``kd``/``vd``
+        are ``[n, num_layers, block_size, kv_heads, head_dim]`` at the
+        pool's storage dtype; scale planes ride beside them on
+        quantized pools (``None`` placeholders otherwise, keeping the
+        fp program structurally scale-free).  Pure byte movement — the
+        uploaded bytes ARE the bytes the pool once held, which is what
+        makes a swap-in bitwise-indistinguishable from recompute."""
+        new_k, new_v, new_ks, new_vs = [], [], [], []
+        for l in range(len(pool_k)):
+            new_k.append(pool_k[l].at[ids].set(kd[:, l]))
+            new_v.append(pool_v[l].at[ids].set(vd[:, l]))
+            if ksd is not None:
+                new_ks.append(pool_ks[l].at[ids].set(ksd[:, l]))
+                new_vs.append(pool_vs[l].at[ids].set(vsd[:, l]))
+        if ksd is None:
+            new_ks, new_vs = pool_ks, pool_vs
+        return new_k, new_v, new_ks, new_vs
+
+    def _place_pool(self):
+        """Re-place the pool arrays after a swap upload rebinds them.
+        No-op here; MeshEngine overrides to restore the head-sharded
+        placement before the next dispatch."""
+
+    def _host_arena_bytes(self):
+        """Pinned host-RAM footprint of the spill arena (the ledger's
+        host-component accounting: the payload arrays are allocated in
+        full at construction, so this is constant while the tier
+        lives)."""
+        t = self.host_tier
+        if t is None:
+            return 0
+        total = t.k.nbytes + t.v.nbytes
+        if t.quantized:
+            total += t.k_scale.nbytes + t.v_scale.nbytes
+        return total
+
+    def _fetch_blocks(self, bids):
+        """Host copies of device pool blocks: ``[n, L, bs, kvh, hd]``
+        k/v plus ``[n, L, bs]`` scale planes (None on fp pools).  One
+        gather + device_get per layer array; on a sharded pool the get
+        assembles the full block across shards (pure byte movement —
+        re-sharding on the way back up is the upload's problem)."""
+        idx = jnp.asarray(np.asarray(bids, np.int32))
+        L = len(self.pool.k)
+        k = np.stack([np.asarray(jax.device_get(self.pool.k[l][idx]))
+                      for l in range(L)], axis=1)
+        v = np.stack([np.asarray(jax.device_get(self.pool.v[l][idx]))
+                      for l in range(L)], axis=1)
+        if not self._kv_quant:
+            return k, v, None, None
+        ks = np.stack(
+            [np.asarray(jax.device_get(self.pool.k_scale[l][idx]))
+             for l in range(L)], axis=1)
+        vs = np.stack(
+            [np.asarray(jax.device_get(self.pool.v_scale[l][idx]))
+             for l in range(L)], axis=1)
+        return k, v, ks, vs
+
+    def _demote_block(self, path, block_id):
+        """``PrefixCache.spill`` hook: device_get one evicted radix
+        block into the host arena (called BEFORE the pool block is
+        released, while its bytes are still live).  True means the
+        arena kept it — the eviction is a demotion, not a loss."""
+        return self._demote_blocks([path], [block_id])[0]
+
+    def _demote_blocks(self, paths, bids):
+        """``PrefixCache.spill_batch`` hook: demote a whole eviction
+        pass's victims with ONE batched gather + device_get (called
+        BEFORE the pool blocks are released, while their bytes are
+        still live).  Bulk reclaims — admission evicting many blocks
+        to fit a batch — would otherwise serialize one synchronous
+        device round-trip per victim on the admission hot path; this
+        bounds the copy cost per reclaim pass instead.  One bool per
+        block: True means the arena kept it."""
+        tier = self.host_tier
+        if tier is None or not tier.capacity:
+            return [False] * len(bids)
+        k, v, ks, vs = self._fetch_blocks(bids)
+        name = self._profiler_name
+        nbytes = self.pool.bytes_per_block
+        out = []
+        for i, path in enumerate(paths):
+            ok = tier.store_prefix(path, k[i], v[i],
+                                   None if ks is None else ks[i],
+                                   None if vs is None else vs[i])
+            if ok:
+                self._demote_bytes += nbytes
+                _SRV_SWAP_OUT_BYTES.inc(nbytes, engine=name,
+                                        kind="demote")
+            out.append(ok)
+        return out
+
+    def _swap_worthwhile(self, n_blocks, n_tokens):
+        """The swap-vs-recompute crossover model: estimated upload
+        seconds (bytes / measured host<->device bandwidth) vs estimated
+        re-prefill seconds (this engine's own measured per-token
+        prefill throughput).  ``always``/``never`` pin the decision;
+        ``auto`` with no throughput sample yet swaps optimistically
+        (the first measurement lands with the first prefill)."""
+        if self._swap_policy == "always":
+            return True
+        if self._swap_policy == "never":
+            return False
+        if n_blocks <= 0 or n_tokens <= 0:
+            return False
+        if not self._prefill_tokens_dispatched:
+            return True
+        recompute_s = (self._prefill_dispatch_s
+                       / self._prefill_tokens_dispatched) * n_tokens
+        bw = _obs_memory.host_device_bandwidth_gbs(jax.default_backend())
+        upload_s = n_blocks * self.pool.bytes_per_block / (bw * 1e9)
+        return upload_s < recompute_s
+
+    def _swap_out_lane(self, req, slot):
+        """Tiered KV at preempt: save the lane's whole block chain into
+        the host arena BEFORE its pool blocks are released, so
+        re-admission can swap it back in instead of re-prefilling.
+        Skipped for mid-chunked-prefill lanes (their completed chunks
+        already live in the radix store and resume as a prefix hit) and
+        when the policy prefers recompute; ``save_lane`` failing (arena
+        full of pinned images) silently falls back to recompute."""
+        tier = self.host_tier
+        if tier is None or not tier.capacity:
+            return False
+        if req.request_id in self._chunking or not self._active[slot]:
+            return False
+        pos = int(self._pos[slot])
+        bs = self._block_size
+        nb = -(-pos // bs)
+        if pos <= 0 or not self._swap_worthwhile(nb, pos):
+            return False
+        row = self.cache.tables[slot]
+        bids = [int(row[j]) for j in range(nb)]
+        if any(b == 0 for b in bids):
+            return False             # defensive: chain has a hole
+        k, v, ks, vs = self._fetch_blocks(bids)
+        blocks = [(k[i], v[i],
+                   None if ks is None else ks[i],
+                   None if vs is None else vs[i]) for i in range(nb)]
+        if not tier.save_lane(req.request_id, pos, blocks):
+            return False
+        nbytes = nb * self.pool.bytes_per_block
+        self._swap_outs += 1
+        self._swap_out_blocks += nb
+        self._swap_out_bytes += nbytes
+        name = self._profiler_name
+        _SRV_SWAP_OUT_BYTES.inc(nbytes, engine=name, kind="lane")
+        _obs_events.instant("serving.swap_out", cat="serving",
+                            slot=slot, request=req.request_id,
+                            blocks=nb, bytes=nbytes, n_tokens=pos)
+        if req.trace is not None:
+            req.trace.add(_obs_tracing.SWAP_OUT, blocks=nb,
+                          bytes=nbytes, n_tokens=pos)
+        return True
+
+    def _swap_in(self, req):
+        """Promote a QUEUED request's host-arena KV into the device
+        radix store so the coming admission serves it as an ordinary
+        prefix hit — no new prefill plumbing, and sharded parity is
+        automatic because promotion is pure byte movement feeding the
+        already-parity-gated prefill path.
+
+        A lane image (preempt swap-out) restores the full chain
+        including the partial tail block, grafted under its SHORT token
+        key that only copy-on-write matching can hit — so the resume
+        prefill still computes >= 1 suffix token and the engine's
+        bitwise resume-divergence check stays the parity gate.  Without
+        an image, demoted prefix blocks extending the device-side radix
+        match are promoted instead.  Any failure (policy says
+        recompute, pool dry, graft refused) degrades to recompute —
+        never an error.  Returns True on a landed swap-in, None when
+        the pool was dry (the admission promotion loop stops on that —
+        no host state is consumed before device blocks are secured),
+        False otherwise."""
+        tier = self.host_tier
+        if tier is None:
+            return False
+        toks = self._admission_tokens(req)
+        bs = self._block_size
+        before = self.prefix.lookup(toks)
+        chain = self.prefix._walk(toks, len(toks) - 1)
+        have = len(chain)
+        # pin the matched parent chain for the duration of the swap-in:
+        # the pool.alloc() reclaim fallback below evicts LRU unpinned
+        # radix blocks, and eating this request's own parents would
+        # break every graft ("promotions must land in path order") —
+        # under pool pressure that turns swap-in into pure churn
+        for n in chain:
+            n.refcount += 1
+        try:
+            return self._swap_in_pinned(req, tier, toks, bs, before,
+                                        have)
+        finally:
+            for n in chain:
+                if n.refcount > 0:
+                    n.refcount -= 1
+
+    def _swap_in_pinned(self, req, tier, toks, bs, before, have):
+        img = tier.peek_lane(req.request_id)
+        lane = img is not None and img.n_tokens == len(toks)
+        if img is not None and not lane:
+            tier.drop_lane(req.request_id)   # stale: tokens moved on
+        paths = []
+        if lane:
+            nb_chain = -(-len(toks) // bs)
+            idxs = list(range(have, nb_chain))
+            if not idxs or not self._swap_worthwhile(
+                    len(idxs), len(toks) - have * bs):
+                return False
+        else:
+            paths = tier.match_prefix(toks, have)
+            if not paths or not self._swap_worthwhile(
+                    len(paths), len(paths) * bs):
+                return False
+            idxs = [have + j for j in range(len(paths))]
+        # allocate the device blocks BEFORE consuming any host state:
+        # a dry pool then leaves the lane image / demoted entries
+        # intact for the next admission pass (under a preemption storm
+        # the first attempts routinely race a full pool — consuming
+        # first would destroy the saved KV and force recompute forever).
+        # The matched arena entries are pinned across the loop: the
+        # reclaim(1) fallback fires the spill hook, and store_prefix
+        # making room for a NEW demotion must not LRU-evict the entries
+        # this swap-in is about to pop (device-pool-dry + arena-full is
+        # exactly the pressure regime the tier serves).
+        tier.pin_prefix(paths)
+        try:
+            dev_ids = []
+            for _ in idxs:
+                bid = self.pool.alloc()
+                if bid is None and self.prefix.reclaim(1):
+                    bid = self.pool.alloc()
+                if bid is None:
+                    for b in dev_ids:
+                        self.pool.release(b)
+                    # pool dry: recompute covers it.  None (vs False)
+                    # tells the admission promotion loop to stop trying
+                    # — no host state was consumed, so the next
+                    # boundary retries.
+                    return None
+                dev_ids.append(bid)
+            if lane:
+                img = tier.take_lane(req.request_id)
+                plan = [(i, img.hbs[i]) for i in idxs]
+                consumed = list(img.hbs)
+            else:
+                # defense in depth: should an entry be gone anyway,
+                # stop at the break (later blocks could not graft
+                # without their parent), return the unused device
+                # blocks, and leave the unconsumed entries resident
+                plan = []
+                for i, p in zip(idxs, paths):
+                    hb = tier.pop_prefix(p)
+                    if hb is None:
+                        break
+                    plan.append((i, hb))
+                for b in dev_ids[len(plan):]:
+                    self.pool.release(b)
+                dev_ids = dev_ids[:len(plan)]
+                if not plan:
+                    return False
+                consumed = [hb for _, hb in plan]
+        finally:
+            tier.unpin_prefix(paths)
+        n = len(plan)
+        kd = np.empty((n,) + tier.k.shape[1:], tier.k.dtype)
+        vd = np.empty_like(kd)
+        ksd = vsd = None
+        if tier.quantized:
+            ksd = np.empty((n,) + tier.k_scale.shape[1:], np.float32)
+            vsd = np.empty_like(ksd)
+        for j, (_, hb) in enumerate(plan):
+            bk, bv, bks, bvs = tier.read_block(hb)
+            kd[j], vd[j] = bk, bv
+            if ksd is not None:
+                ksd[j], vsd[j] = bks, bvs
+        for hb in consumed:
+            tier.release(hb)
+        # pad to a power of two so the compile cache stays bounded;
+        # padding rows scatter zeros into scratch block 0, whose
+        # content is meaningless by design
+        lanes = self._pow2_ceil(n)
+        ids = np.zeros(lanes, np.int32)
+        ids[:n] = dev_ids
+        if lanes > n:
+            pad = lanes - n
+            kd = np.concatenate(
+                [kd, np.zeros((pad,) + kd.shape[1:], kd.dtype)])
+            vd = np.concatenate(
+                [vd, np.zeros((pad,) + vd.shape[1:], vd.dtype)])
+            if ksd is not None:
+                ksd = np.concatenate(
+                    [ksd, np.zeros((pad,) + ksd.shape[1:], np.float32)])
+                vsd = np.concatenate(
+                    [vsd, np.zeros((pad,) + vsd.shape[1:], np.float32)])
+        new_k, new_v, new_ks, new_vs = self._upload(
+            self.pool.k, self.pool.v,
+            self.pool.k_scale, self.pool.v_scale,
+            jnp.asarray(ids), jnp.asarray(kd), jnp.asarray(vd),
+            None if ksd is None else jnp.asarray(ksd),
+            None if vsd is None else jnp.asarray(vsd))
+        self.pool.rebind(new_k, new_v, new_ks, new_vs)
+        self._place_pool()
+        grafted = 0
+        for (idx, _), bid in zip(plan, dev_ids):
+            if self.prefix.graft(toks, idx, bid):
+                grafted += 1
+            else:
+                self.pool.release(bid)   # chain broke: recompute covers
+        if not grafted:
+            return False
+        averted = max(0, self.prefix.lookup(toks) - before)
+        nbytes = n * self.pool.bytes_per_block
+        name = self._profiler_name
+        self._swap_ins += 1
+        self._swap_in_blocks += n
+        self._swap_in_bytes += nbytes
+        _SRV_SWAP_IN_BYTES.inc(nbytes, engine=name)
+        self._swaps_averted_tokens += averted
+        if self._prefill_card_tokens:
+            fl = averted * (self._prefill_card_flops
+                            / self._prefill_card_tokens)
+            self._swaps_averted_flops += fl
+            _SRV_SWAP_AVERTED.inc(fl, engine=name)
+        _obs_events.instant("serving.swap_in", cat="serving",
+                            request=req.request_id, blocks=n,
+                            bytes=nbytes, averted_tokens=averted,
+                            source="lane" if lane else "prefix")
+        if req.trace is not None:
+            req.trace.add(_obs_tracing.SWAP_IN, blocks=n, bytes=nbytes,
+                          averted_tokens=averted,
+                          source="lane" if lane else "prefix")
+        return True
 
     def _sync_device_state(self):
         """Upload the per-slot state mirrors — only when admission
@@ -2553,6 +3048,8 @@ class Engine:
         _SRV_KV_BLOCKS.set(self.pool.blocks_in_use, engine=name)
         _SRV_KV_OCC.set(self.pool.blocks_in_use / self.pool.capacity,
                         engine=name)
+        if self.host_tier is not None:
+            _SRV_HOST_OCC.set(self.host_tier.occupancy, engine=name)
         _SRV_BUCKETS.set(len(self._decode_buckets), engine=name)
         if self.config.spec_k:
             for slot in range(self.cache.num_slots):
@@ -2607,14 +3104,37 @@ class Engine:
         finally:
             self._draining = False
         # all leases are back, so every prefix chain is unpinned and
-        # reclaimable; anything the reclaim cannot free is a leak
-        self.prefix.reclaim(self.prefix._held)
+        # reclaimable; anything the reclaim cannot free is a leak.
+        # The spill hook is disabled for this final sweep — shutdown
+        # eviction is disposal, not demotion (demoting here would just
+        # copy soon-to-be-cleared bytes into the host arena)
+        spill, self.prefix.spill = self.prefix.spill, None
+        spill_batch = self.prefix.spill_batch
+        self.prefix.spill_batch = None
+        try:
+            self.prefix.reclaim(self.prefix._held)
+        finally:
+            self.prefix.spill = spill
+            self.prefix.spill_batch = spill_batch
         if self.pool.blocks_in_use != 0:
             raise RuntimeError(
                 f"drain() left {self.pool.blocks_in_use} KV pool blocks "
                 f"referenced ({self.cache.leased_blocks} leased by slot "
                 f"tables, {self.prefix._held} pinned by the prefix "
                 "store) — block-leak invariant violated")
+        if self.host_tier is not None:
+            # the host-tier extension of the block-leak invariant:
+            # demoted prefix entries are disposable cache content, but
+            # any block still referenced after clearing them is a
+            # leaked lane image (every request retired or aborted above
+            # dropped its image)
+            self.host_tier.clear_prefixes()
+            if self.host_tier.blocks_in_use != 0:
+                raise RuntimeError(
+                    f"drain() left {self.host_tier.blocks_in_use} host "
+                    f"arena blocks referenced "
+                    f"({len(self.host_tier._lanes)} lane images) — "
+                    "host block-leak invariant violated")
         self._publish_gauges()
         return out
 
@@ -2710,6 +3230,10 @@ class Engine:
             "kv_bytes_read": self._kv_bytes_read,
             "cow_copies": self._cow_copies,
             "preemptions": self._preemptions,
+            "kv_swap_ins": self._swap_ins,
+            "kv_swap_outs": self._swap_outs,
+            "kv_swap_in_bytes": self._swap_in_bytes,
+            "kv_swap_out_bytes": self._swap_out_bytes,
             "requests_aborted": self._aborted,
             "deadline_expired": self._deadline_expired,
             "spec_draft_tokens": self._spec_draft_tokens,
@@ -2791,6 +3315,29 @@ class Engine:
             "dtype": str(jnp.dtype(self.pool.store_dtype)),
             "quant_dtype": self.pool.quant_dtype,
         }
+        # tiered KV: the host spill arena under the pool.  Counters are
+        # trace-exact per kind: kv_swap_out_bytes covers lane saves
+        # (paired SWAP_OUT trace events), demote_bytes covers prefix
+        # demotions (engine-level, no owning request).
+        tier = self.host_tier
+        s["kv_pool"].update({
+            "host_capacity_blocks": tier.capacity if tier else 0,
+            "host_blocks_in_use": tier.blocks_in_use if tier else 0,
+            "host_arena_bytes": self._host_arena_bytes(),
+            "host_occupancy_ratio": tier.occupancy if tier else 0.0,
+            "kv_swap_ins": self._swap_ins,
+            "kv_swap_outs": self._swap_outs,
+            "kv_swap_in_blocks": self._swap_in_blocks,
+            "kv_swap_out_blocks": self._swap_out_blocks,
+            "kv_swap_in_bytes": self._swap_in_bytes,
+            "kv_swap_out_bytes": self._swap_out_bytes,
+            "kv_demote_bytes": self._demote_bytes,
+            "kv_swaps_averted_tokens": self._swaps_averted_tokens,
+            "kv_swaps_averted_flops": self._swaps_averted_flops,
+            "swap_policy": self._swap_policy,
+        })
+        if tier is not None:
+            s["kv_pool"]["host_tier"] = tier.stats()
         s["quant"] = {
             "weight_dtype": self._weight_dtype,
             "kv_cache_dtype": self._kv_quant,

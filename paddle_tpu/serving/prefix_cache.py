@@ -55,6 +55,13 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from ..observability import metrics as _obs_metrics
+
+_SRV_PREFIX_EVICT = _obs_metrics.counter(
+    "serving.prefix_evictions",
+    "radix-store evictions by destination: dest=\"host\" demoted into "
+    "the host spill arena, dest=\"dropped\" lost and recomputable only")
+
 
 class _Node:
     """One full-block edge of the radix store."""
@@ -143,10 +150,29 @@ class PrefixCache:
             self._free = []
         self._root = _Node((), 0, None)
         self._clock = 0
+        #: demotion hook (tiered KV): ``spill(path_tokens, block_id) ->
+        #: bool`` is called by ``_evict`` with the victim's FULL token
+        #: path and its still-live pool block BEFORE the block is
+        #: released — a True return means the block's bytes now live in
+        #: the host arena (dest="host"); False/None means the eviction
+        #: is a real drop (dest="dropped").  The engine installs it;
+        #: None keeps the pre-tier drop-on-evict behavior.
+        self.spill = None
+        #: batched demotion hook: ``spill_batch(paths, block_ids) ->
+        #: [bool, ...]`` — the same contract as ``spill`` over a whole
+        #: eviction pass at once, so a bulk ``reclaim()`` pays ONE
+        #: device round-trip for all its victims instead of one per
+        #: block.  Preferred over ``spill`` wherever it is installed.
+        self.spill_batch = None
+        #: metric label for the eviction counters (the engine's
+        #: profiler name, so two engines stay distinguishable)
+        self.metric_label = ""
         # counters (engine surfaces them through stats())
         self.hit_tokens = 0
         self.miss_tokens = 0
         self.evictions = 0
+        self.evictions_demoted = 0
+        self.evictions_dropped = 0
         self.inserted_blocks = 0
 
     # ------------------------------------------------------------ match
@@ -322,18 +348,62 @@ class PrefixCache:
             node = child
         return adopted
 
+    def graft(self, tokens, index, block):
+        """Unified-mode promotion (tiered KV swap-in): hang an
+        engine-allocated pool block — freshly uploaded from the host
+        arena — onto the radix tree at full-block ``index`` of
+        ``tokens``.  Ownership of the block's reference TRANSFERS to
+        the new node (the caller must have ``pool.alloc()``d it and
+        must NOT release it on success).  The node's key is
+        ``tokens[index*bs : (index+1)*bs]`` — shorter than a block for
+        a partial tail, which only ever matches copy-on-write.  Returns
+        False (caller keeps ownership) when the parent chain is missing
+        — promotions must land in path order — or when the byte budget
+        is exhausted and nothing is evictable."""
+        bs = self.block_size
+        if self.pool is None:
+            raise RuntimeError("graft() requires unified-pool mode")
+        if not bs or self.capacity == 0:
+            return False
+        node = self._root
+        for i in range(index):
+            node = node.children.get(
+                tuple(tokens[i * bs:(i + 1) * bs]))
+            if node is None:
+                return False
+        key = tuple(tokens[index * bs:(index + 1) * bs])
+        if not key or key in node.children:
+            return False
+        if self._held >= self.capacity and self.reclaim(1) == 0:
+            return False
+        self._clock += 1
+        self._held += 1
+        child = _Node(key, int(block), node)
+        node.children[key] = child
+        child.last_used = self._clock
+        self.inserted_blocks += 1
+        return True
+
     def reclaim(self, n_blocks):
         """Evict up to ``n_blocks`` LRU unpinned leaves, returning their
         pool blocks to the engine's free list.  Returns how many were
-        freed (0 when everything live is pinned)."""
-        freed = 0
-        while freed < n_blocks:
+        freed (0 when everything live is pinned).  Victims are detached
+        first and demoted in ONE batched spill pass — bulk reclaims
+        (admission evicting many blocks to fit a batch) pay a single
+        device round-trip, not one per block — then released."""
+        victims = []
+        while len(victims) < n_blocks:
             victim = self._lru_evictable()
             if victim is None:
                 break
-            self._evict(victim)
-            freed += 1
-        return freed
+            # detach now (so the victim's parent can become the next
+            # eligible leaf) but defer spill + release: the blocks'
+            # bytes must stay live for the batched copy below
+            del victim.parent.children[victim.tokens]
+            victims.append(victim)
+        for node, demoted in zip(victims, self._spill_nodes(victims)):
+            self._release_evicted(node, demoted)
+        return len(victims)
 
     def _alloc_block(self):
         if self._free:
@@ -359,14 +429,66 @@ class PrefixCache:
                 best = node
         return best
 
+    def _node_path(self, node):
+        """The full token path from the root through ``node`` — the key
+        a demoted block re-matches under."""
+        parts = []
+        while node is not self._root:
+            parts.append(node.tokens)
+            node = node.parent
+        out = ()
+        for tokens in reversed(parts):
+            out += tokens
+        return out
+
     def _evict(self, node):
         del node.parent.children[node.tokens]
+        self._release_evicted(node, self._spill_nodes([node])[0])
+
+    def _spill_nodes(self, nodes):
+        """Demote-instead-of-drop for a pass of detached victims: one
+        bool per node, True when its bytes now live in the host tier.
+        Must run BEFORE the victims' pool blocks are released (the
+        spill callbacks device_get them).  Full-block victims go
+        through ``spill_batch`` when installed — one device round-trip
+        for the whole pass — else per-node ``spill``; partial-tail
+        graft nodes (token key shorter than a block) are worth less
+        than a full block and are dropped like before."""
+        out = [False] * len(nodes)
+        if self.pool is None or (self.spill is None
+                                 and self.spill_batch is None):
+            return out
+        full = [i for i, n in enumerate(nodes)
+                if len(n.tokens) == self.block_size]
+        if not full:
+            return out
+        if self.spill_batch is not None:
+            kept = self.spill_batch(
+                [self._node_path(nodes[i]) for i in full],
+                [nodes[i].block for i in full])
+            for i, ok in zip(full, kept):
+                out[i] = bool(ok)
+        else:
+            for i in full:
+                out[i] = bool(self.spill(self._node_path(nodes[i]),
+                                         nodes[i].block))
+        return out
+
+    def _release_evicted(self, node, demoted):
+        """Return a detached victim's block and settle the eviction
+        counters (any demotion already happened in ``_spill_nodes``)."""
         if self.pool is not None:
             self.pool.release(node.block)   # back to the engine free list
             self._held -= 1
         else:
             self._free.append(node.block)
         self.evictions += 1
+        if demoted:
+            self.evictions_demoted += 1
+        else:
+            self.evictions_dropped += 1
+        _SRV_PREFIX_EVICT.inc(engine=self.metric_label,
+                              dest="host" if demoted else "dropped")
 
     # ------------------------------------------------------------ device
     def rebind(self, new_k, new_v):
@@ -395,5 +517,7 @@ class PrefixCache:
             "miss_tokens": self.miss_tokens,
             "hit_ratio": (self.hit_tokens / total) if total else 0.0,
             "evictions": self.evictions,
+            "evictions_demoted": self.evictions_demoted,
+            "evictions_dropped": self.evictions_dropped,
             "inserted_blocks": self.inserted_blocks,
         }

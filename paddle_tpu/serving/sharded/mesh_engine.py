@@ -156,6 +156,18 @@ class MeshEngine(Engine):
             else:
                 arrays.append(self._put(a, sp))
         self._state_arrays = arrays
+        self._place_pool()
+
+    def _place_pool(self):
+        """(Re-)place the paged pool arrays under the layout's
+        shardings.  Beyond construction this is the tiered-KV swap-in
+        hook: a host-arena upload rebinds pool buffers whose sharding
+        XLA inferred, and re-putting them restores the head-sharded
+        placement before the next dispatch.  The swap itself is pure
+        byte movement — the host arena holds GATHERED full blocks
+        (device_get assembles shards on the way out), so placement is
+        the only sharded-serving concern; per-shard local-slice arenas
+        are deliberately NOT built (see ARCHITECTURE \"Tiered KV\")."""
         pool_spec = self.layout.kv_pool()
         self.pool.k = [self._put(a, pool_spec) for a in self.pool.k]
         self.pool.v = [self._put(a, pool_spec) for a in self.pool.v]

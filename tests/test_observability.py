@@ -468,6 +468,9 @@ class TestFlightRecorder:
         tr.add(tracing.FIRST_TOKEN, token=1)
         tr.add(tracing.DECODE, tokens=3, accepted=2, horizon=4)
         tr.add(tracing.PREEMPT)
+        tr.add(tracing.SWAP_OUT, blocks=2, bytes=4096, n_tokens=8)
+        tr.add(tracing.SWAP_IN, blocks=2, bytes=4096,
+               averted_tokens=6, source="lane")
         tr.add(tracing.RESUME, prefix_hit_tokens=6)
         tr.add(tracing.DECODE, tokens=2, accepted=0, horizon=2)
         tr.add(tracing.FAILOVER, from_replica="r0", resumed_tokens=6)
@@ -479,6 +482,8 @@ class TestFlightRecorder:
                      "preemptions": 1, "decode_horizons": 2,
                      "spec_accepted_tokens": 2, "spec_forced_tokens": 0,
                      "aborted": 0, "failovers": 1, "resumed_tokens": 6,
+                     "swap_ins": 1, "swap_outs": 1,
+                     "swap_in_bytes": 4096, "swap_out_bytes": 4096,
                      "flops_est": 0.0, "bytes_est": 0.0}
         assert tr.finished
         # monotonic event times

@@ -12,8 +12,8 @@ from paddle_tpu.core import tape as _tape
 from paddle_tpu.models import GPTConfig, GPTForCausalLM
 from paddle_tpu.models.llama import LlamaForCausalLM
 from paddle_tpu.serving import (
-    Engine, EngineConfig, PagedKVCache, PagedKVPool, PrefixCache,
-    SamplingParams, Scheduler, SlotKV, SlottedKVCache,
+    Engine, EngineConfig, HostKVTier, PagedKVCache, PagedKVPool,
+    PrefixCache, SamplingParams, Scheduler, SlotKV, SlottedKVCache,
 )
 from paddle_tpu.quantization import (
     PerChannelAbsmaxObserver, channelwise_scales, dequantize_weight,
@@ -2565,3 +2565,353 @@ class TestShardedServing:
             create_llm_engine(m, mesh_shape=(1, 2), tp=4)
         with pytest.raises(ValueError, match="disaggregated"):
             create_llm_engine(m, mesh_shape=(2, 2))
+
+
+class TestHostKVTier:
+    """Tiered KV: the host-RAM spill arena (kv_host_tier.py).
+    Preempted lanes swap back in with one batched upload instead of
+    re-prefilling, LRU-evicted prefix blocks demote to host and
+    re-match later — and every path must be bitwise-equal to the
+    recompute it replaces (the engine's resume-divergence check is the
+    standing parity gate)."""
+
+    PROMPTS = [[3, 1, 4, 1, 5], [9, 2, 6]]
+    SAMP = [SamplingParams(max_new_tokens=10),
+            SamplingParams(temperature=0.8, top_k=20, seed=11,
+                           max_new_tokens=10)]
+
+    @staticmethod
+    def _cfg(**kw):
+        kw.setdefault("num_slots", 2)
+        kw.setdefault("max_seq_len", 48)
+        kw.setdefault("max_horizon", 4)
+        kw.setdefault("prefix_block_size", 4)
+        kw.setdefault("prefix_cache_bytes", 1 << 20)
+        kw.setdefault("kv_host_bytes", 1 << 20)
+        kw.setdefault("kv_swap_policy", "always")
+        return EngineConfig(**kw)
+
+    @classmethod
+    def _preempt_run(cls, eng):
+        """Both lanes decode, both get preempted mid-stream, the run
+        finishes through re-admission (swap-in when a tier is on,
+        re-prefill otherwise)."""
+        reqs = [eng.submit(list(p), s)
+                for p, s in zip(cls.PROMPTS, cls.SAMP)]
+        eng.step(horizon=2)
+        eng.preempt(reqs[0])
+        eng.preempt(reqs[1])
+        eng.run()
+        return reqs
+
+    def test_preempt_swap_in_resume_bitwise(self):
+        """The core acceptance: a greedy AND a seeded lane preempted
+        mid-decode finish bitwise-equal whether their KV came back via
+        host-arena swap-in or recompute, per-request traces restate the
+        engine's swap counters exactly, and drain leaves zero host
+        blocks."""
+        m = _model()
+        ref = Engine(m, self._cfg(kv_host_bytes=0),
+                     register_profiler=False)
+        r0 = self._preempt_run(ref)
+        ref.close()
+        eng = Engine(m, self._cfg(), register_profiler=False)
+        r1 = self._preempt_run(eng)
+        assert [r.output_ids for r in r1] == [r.output_ids for r in r0]
+        c = eng.counters()
+        assert c["kv_swap_outs"] >= 1 and c["kv_swap_ins"] >= 1
+        tcs = [r.trace.counts() for r in r1]
+        assert sum(t["swap_outs"] for t in tcs) == c["kv_swap_outs"]
+        assert sum(t["swap_ins"] for t in tcs) == c["kv_swap_ins"]
+        assert (sum(t["swap_out_bytes"] for t in tcs)
+                == c["kv_swap_out_bytes"])
+        assert (sum(t["swap_in_bytes"] for t in tcs)
+                == c["kv_swap_in_bytes"])
+        eng.drain()
+        s = eng.stats()["kv_pool"]
+        assert s["host_blocks_in_use"] == 0
+        assert s["kv_swaps_averted_tokens"] > 0
+        eng.close()
+
+    def test_demoted_prefix_rematch_beats_drop(self):
+        """A tight device radix budget plus churn evicts a warm
+        prompt's chain; with the host tier the eviction is a demotion,
+        so a later identical prompt re-matches at least as many tokens
+        as a never-evicted control does under an ample budget (the
+        budget is 8 blocks — enough to graft the promoted chain back,
+        small enough that 12 blocks of churn still evicts it)."""
+        m = _model()
+        P = [5, 5, 7, 7, 1, 2, 3, 4, 9, 8, 7, 6,
+             1, 3, 5, 7, 2, 4, 6, 8]
+        churn = [[c] * 12 for c in (11, 22, 33)]
+        samp = SamplingParams(max_new_tokens=4)
+
+        def warm_probe(eng):
+            eng.generate(list(P), samp)
+            for q in churn:
+                eng.generate(list(q), samp)
+            r = eng.submit(list(P), samp)
+            eng.run()
+            return r
+
+        ctrl = Engine(m, self._cfg(kv_host_bytes=0),
+                      register_profiler=False)
+        bpb = ctrl.pool.bytes_per_block
+        ctrl_hit = warm_probe(ctrl).prefix_hit_tokens
+        ctrl.close()
+        eng = Engine(m, self._cfg(prefix_cache_bytes=8 * bpb),
+                     register_profiler=False)
+        probe = warm_probe(eng)
+        st = eng.stats()
+        assert st["prefix"]["evictions_demoted"] > 0
+        assert st["kv_pool"]["host_tier"]["promotions"] > 0
+        assert probe.prefix_hit_tokens >= ctrl_hit > 0
+        eng.drain()
+        assert eng.stats()["kv_pool"]["host_blocks_in_use"] == 0
+        eng.close()
+
+    @pytest.mark.slow
+    def test_int8_roundtrip_stored_bytes_identical(self):
+        """int8 KV swaps at quantized density: the device bytes of the
+        re-bound blocks after a swap round-trip equal the pre-preempt
+        pool bytes exactly — payloads AND scale planes — and the
+        resumed stream matches the no-tier recompute engine."""
+        m = _model()
+        prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+        samp = SamplingParams(temperature=0.8, top_k=20, seed=11,
+                              max_new_tokens=16)
+        ref = Engine(m, self._cfg(kv_host_bytes=0,
+                                  kv_cache_dtype="int8"),
+                     register_profiler=False)
+        r0 = ref.submit(list(prompt), samp)
+        ref.step(horizon=4)
+        ref.preempt(r0)
+        ref.run()
+        ref.close()
+        eng = Engine(m, self._cfg(kv_cache_dtype="int8"),
+                     register_profiler=False)
+        r = eng.submit(list(prompt), samp)
+        eng.step(horizon=4)
+        assert r.status == "running"
+        slot, bs = r.slot, eng._block_size
+        pos = int(eng._pos[slot])
+        nb = -(-pos // bs)
+        bids = [int(eng.cache.tables[slot][j]) for j in range(nb)]
+        k0, v0, ks0, vs0 = eng._fetch_blocks(bids)
+        eng.preempt(r)
+        assert eng.host_tier.stats()["lane_images"] == 1
+        assert eng._swap_in(r)
+        toks = eng._admission_tokens(r)
+        chain = eng.prefix._walk(toks, len(toks))
+        fb = pos // bs
+        assert len(chain) == fb
+        k1, v1, ks1, vs1 = eng._fetch_blocks([n.block for n in chain])
+        assert k1.dtype == np.int8              # quantized density
+        assert np.array_equal(k1, k0[:fb])
+        assert np.array_equal(v1, v0[:fb])
+        assert np.array_equal(ks1, ks0[:fb])
+        assert np.array_equal(vs1, vs0[:fb])
+        eng.run()
+        assert r.output_ids == r0.output_ids
+        eng.drain()
+        assert eng.stats()["kv_pool"]["host_blocks_in_use"] == 0
+        eng.close()
+
+    @pytest.mark.slow
+    def test_tp2_swap_parity(self):
+        """Swap-in over the mesh-sharded pool: device_get gathers the
+        full block, the upload re-places through the layout, and the
+        stream stays bitwise vs the single-chip NO-tier engine (swap ==
+        recompute across both axes at once)."""
+        from paddle_tpu.serving import MeshEngine
+
+        m = _model()
+        ref = Engine(m, self._cfg(kv_host_bytes=0),
+                     register_profiler=False)
+        r0 = self._preempt_run(ref)
+        ref.close()
+        eng = MeshEngine(m, self._cfg(), tp=2, register_profiler=False)
+        r1 = self._preempt_run(eng)
+        assert [r.output_ids for r in r1] == [r.output_ids for r in r0]
+        assert eng.counters()["kv_swap_ins"] >= 1
+        eng.drain()
+        assert eng.stats()["kv_pool"]["host_blocks_in_use"] == 0
+        eng.close()
+
+    def test_arena_exhaustion_and_policy_never_fall_back(self):
+        """A one-byte arena (capacity 0 blocks) and policy "never" both
+        degrade to plain recompute — same bitwise output, zero swap
+        counters, no errors.  Bad knob values raise at construction."""
+        m = _model()
+        ref = Engine(m, self._cfg(kv_host_bytes=0),
+                     register_profiler=False)
+        r0 = self._preempt_run(ref)
+        ref.close()
+        for kw in (dict(kv_host_bytes=1), dict(kv_swap_policy="never")):
+            eng = Engine(m, self._cfg(**kw), register_profiler=False)
+            rs = self._preempt_run(eng)
+            assert ([r.output_ids for r in rs]
+                    == [r.output_ids for r in r0])
+            c = eng.counters()
+            assert c["kv_swap_ins"] == 0 and c["kv_swap_outs"] == 0
+            if "kv_host_bytes" in kw:
+                assert eng.host_tier.capacity == 0
+            eng.drain()
+            assert eng.stats()["kv_pool"]["host_blocks_in_use"] == 0
+            eng.close()
+        with pytest.raises(ValueError, match="kv_swap_policy"):
+            Engine(m, self._cfg(kv_swap_policy="sometimes"),
+                   register_profiler=False)
+
+    def test_host_block_leak_invariant(self):
+        """After preempt + abort + drain: zero host blocks in use and
+        zero retained lane images — aborting a swapped-out request must
+        drop its pinned image (the host-side leak smoke invariant)."""
+        m = _model()
+        eng = Engine(m, self._cfg(), register_profiler=False)
+        reqs = [eng.submit(list(p), s)
+                for p, s in zip(self.PROMPTS, self.SAMP)]
+        eng.step(horizon=2)
+        eng.preempt(reqs[1])
+        assert eng.host_tier.stats()["lane_images"] == 1
+        eng.abort(reqs[1])
+        eng.submit([7, 7, 7, 7, 2], SamplingParams(max_new_tokens=6))
+        eng.run()
+        eng.drain()
+        s = eng.stats()["kv_pool"]
+        assert s["host_blocks_in_use"] == 0
+        assert s["host_tier"]["lane_images"] == 0
+        assert s["host_tier"]["lane_drops"] >= 1
+        eng.close()
+
+    def test_host_tier_unit(self):
+        """HostKVTier in isolation: refresh-in-place demotion,
+        consecutive-run matching capped at len-1, all-or-nothing lane
+        saves with LRU prefix eviction, refcount guards."""
+        L, bs, kvh, hd = 2, 4, 2, 8
+        bpb = 2 * L * bs * kvh * hd * 4
+        tier = HostKVTier(L, bs, kvh, hd, np.float32,
+                          budget_bytes=3 * bpb, bytes_per_block=bpb)
+        assert tier.capacity == 3
+
+        def blk(x):
+            return np.full((L, bs, kvh, hd), x, np.float32)
+
+        toks = list(range(12))
+        assert tier.store_prefix(tuple(toks[:4]), blk(1), blk(-1))
+        assert tier.store_prefix(tuple(toks[:8]), blk(2), blk(-2))
+        # re-demotion of a held path refreshes in place — no new block
+        in_use = tier.blocks_in_use
+        assert tier.store_prefix(tuple(toks[:4]), blk(9), blk(-9))
+        assert tier.blocks_in_use == in_use and tier.demotions == 3
+        # consecutive-run match; a block covering exactly len(tokens)
+        # is still promotable (served partially via COW after graft)
+        assert (tier.match_prefix(toks[:8] + [99], 0)
+                == [tuple(toks[:4]), tuple(toks[:8])])
+        assert (tier.match_prefix(toks[:8], 0)
+                == [tuple(toks[:4]), tuple(toks[:8])])
+        assert tier.match_prefix(toks[:7], 0) == [tuple(toks[:4])]
+        assert tier.match_prefix([99] + toks[1:8], 0) == []
+        # promotion consumes the entry; roundtrip bytes identical
+        hb = tier.pop_prefix(tuple(toks[:4]))
+        k, v, ks, vs = tier.read_block(hb)
+        assert np.array_equal(k, blk(9)) and ks is None
+        tier.release(hb)
+        # lane save fits by spending the free list
+        payload = [(blk(7), blk(-7), None, None)] * 2
+        assert tier.save_lane("r1", 8, payload)
+        assert tier.blocks_in_use == 3
+        # all-or-nothing: evicting every prefix entry still isn't
+        # enough room, so nothing is kept
+        assert not tier.save_lane("r2", 16, [payload[0]] * 4)
+        assert tier.peek_lane("r2") is None
+        assert tier.blocks_in_use == 2          # just r1's pinned image
+        img = tier.take_lane("r1")
+        assert img.n_tokens == 8 and tier.peek_lane("r1") is None
+        for h in img.hbs:
+            tier.release(h)
+        with pytest.raises(ValueError, match="over-released"):
+            tier.release(img.hbs[0])
+        assert not tier.drop_lane("r1")         # idempotent
+        assert tier.blocks_in_use == 0
+
+    def test_pinned_match_survives_midswap_spill(self):
+        """Regression: between match_prefix and pop_prefix the engine
+        allocates device blocks, and that reclaim path can spill NEW
+        victims into the arena — with the arena full, store_prefix
+        making room must not LRU-evict the pinned match (that used to
+        KeyError pop_prefix and crash the engine under exactly the
+        device-dry + arena-full pressure the tier serves).  Unpinned
+        entries stay fair victims, and a pop that lost the race
+        returns None (degrade to recompute) instead of raising."""
+        L, bs, kvh, hd = 2, 4, 2, 8
+        bpb = 2 * L * bs * kvh * hd * 4
+        tier = HostKVTier(L, bs, kvh, hd, np.float32,
+                          budget_bytes=2 * bpb, bytes_per_block=bpb)
+
+        def blk(x):
+            return np.full((L, bs, kvh, hd), x, np.float32)
+
+        toks = list(range(8))
+        assert tier.store_prefix(tuple(toks[:4]), blk(1), blk(-1))
+        assert tier.store_prefix(tuple(toks[:8]), blk(2), blk(-2))
+        paths = tier.match_prefix(toks, 0)
+        assert len(paths) == 2
+        tier.pin_prefix(paths)
+        # the mid-swap spill finds everything pinned: refused (counted
+        # as a dropped demotion), the matched entries stay resident
+        assert not tier.store_prefix((9, 9, 9, 9), blk(3), blk(-3))
+        assert tier.demotions_dropped == 1
+        for p in paths:
+            hb = tier.pop_prefix(p)
+            assert hb is not None
+            tier.release(hb)
+        tier.unpin_prefix(paths)                # no-op after the pops
+        assert tier.blocks_in_use == 0
+        # an UNPINNED matched entry can still lose the race to later
+        # spills; the pop then reports None instead of raising
+        assert tier.store_prefix(tuple(toks[:4]), blk(4), blk(-4))
+        stale = tier.match_prefix(toks[:5], 0)
+        assert stale == [tuple(toks[:4])]
+        assert tier.store_prefix((7, 7, 7, 7), blk(5), blk(-5))
+        assert tier.store_prefix((6, 6, 6, 6), blk(6), blk(-6))
+        assert tier.prefix_evictions == 1       # the stale match
+        assert tier.pop_prefix(stale[0]) is None
+        # mixed arena: the pinned entry is skipped, the unpinned
+        # sibling is the victim
+        tier.pin_prefix([(7, 7, 7, 7)])
+        assert tier.store_prefix((5, 5, 5, 5), blk(7), blk(-7))
+        assert tier.pop_prefix((6, 6, 6, 6)) is None
+        hb = tier.pop_prefix((7, 7, 7, 7))
+        assert hb is not None
+        tier.release(hb)
+        tier.unpin_prefix([(7, 7, 7, 7)])
+
+    def test_bulk_reclaim_batches_demotion_copies(self):
+        """A bulk radix reclaim demotes ALL its victims through ONE
+        spill_batch pass — one gather + device_get per reclaim pass,
+        not one synchronous device round-trip per block on the
+        admission hot path."""
+        m = _model()
+        eng = Engine(m, self._cfg(), register_profiler=False)
+        assert eng.prefix.spill_batch == eng._demote_blocks
+        eng.generate([5, 5, 7, 7, 1, 2, 3, 4, 9, 8, 7, 6],
+                     SamplingParams(max_new_tokens=4))
+        held = eng.prefix._held
+        assert held > 1
+        calls = []
+        orig = eng._fetch_blocks
+        eng._fetch_blocks = (
+            lambda bids: calls.append(list(bids)) or orig(bids))
+        try:
+            assert eng.prefix.reclaim(held) == held
+        finally:
+            eng._fetch_blocks = orig
+        assert len(calls) == 1 and len(calls[0]) > 1
+        st = eng.stats()
+        assert st["prefix"]["evictions_demoted"] >= len(calls[0])
+        assert (st["kv_pool"]["host_tier"]["demotions"]
+                >= len(calls[0]))
+        eng.drain()
+        assert eng.stats()["kv_pool"]["host_blocks_in_use"] == 0
+        eng.close()
